@@ -1,0 +1,9 @@
+"""deepflow-tpu: a TPU-native zero-code observability framework.
+
+Capability surface mirrors deepflowio/deepflow (see SURVEY.md): a per-host
+agent (continuous profiling, flow metrics, L7 tracing, TPU HLO device spans)
+plus a horizontally-scalable server (controller / ingester / querier) over a
+SmartEncoding columnar store — redesigned TPU-first around JAX/XLA.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,135 @@
+"""Framed transport codec: the agent->ingester wire format.
+
+Reference analog: agent/src/sender/uniform_sender.rs:149-210 (Header) and
+server/libs/receiver/receiver.go:424 (frame parse), with the message-type
+registry of server/libs/datatype/droplet-message.go:36-62.
+
+Frame layout (big-endian), 18-byte header followed by the payload:
+
+    u32 frame_size | u16 magic 0xDF70 | u8 version | u8 msg_type |
+    u16 agent_id | u16 org_id | u16 team_id | u32 crc32(payload)
+
+frame_size counts the whole frame including the header. Payloads are
+protobuf-encoded batches (ProfileBatch, TpuSpanBatch, ...), optionally
+zlib-compressed (flag bit in version byte).
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from dataclasses import dataclass
+from enum import IntEnum
+
+MAGIC = 0xDF70
+VERSION = 1
+COMPRESS_FLAG = 0x80  # or-ed into the version byte when payload is zlib'd
+HEADER_FMT = ">IHBBHHHI"
+HEADER_SIZE = struct.calcsize(HEADER_FMT)  # 18
+MAX_FRAME_SIZE = 64 << 20
+
+
+class MessageType(IntEnum):
+    """Per-frame payload type (reference: droplet-message.go registry)."""
+
+    METRICS = 1          # DocumentBatch -> flow_metrics tables
+    L4_LOG = 2           # FlowLogBatch.l4 -> l4_flow_log
+    L7_LOG = 3           # FlowLogBatch.l7 -> l7_flow_log
+    PROFILE = 4          # ProfileBatch -> in_process_profile
+    TPU_SPAN = 5         # TpuSpanBatch -> tpu_hlo_span
+    DFSTATS = 6          # StatsBatch -> deepflow_system
+    EVENT = 7            # EventBatch -> event
+    OTEL = 8             # OTLP passthrough (integration collector)
+    PROMETHEUS = 9       # remote-write passthrough
+    APP_LOG = 10
+
+
+@dataclass(frozen=True)
+class FrameHeader:
+    msg_type: MessageType
+    agent_id: int = 0
+    org_id: int = 0
+    team_id: int = 0
+    compressed: bool = False
+
+
+def encode_frame(header: FrameHeader, payload: bytes, compress: bool | None = None) -> bytes:
+    """Encode one frame. If compress is None, compress payloads > 512B."""
+    if compress is None:
+        compress = len(payload) > 512
+    if compress:
+        payload = zlib.compress(payload, 1)
+    ver = VERSION | (COMPRESS_FLAG if compress else 0)
+    crc = zlib.crc32(payload) & 0xFFFFFFFF
+    size = HEADER_SIZE + len(payload)
+    if size > MAX_FRAME_SIZE:
+        raise ValueError(f"frame too large: {size}")
+    hdr = struct.pack(
+        HEADER_FMT, size, MAGIC, ver, int(header.msg_type),
+        header.agent_id, header.org_id, header.team_id, crc,
+    )
+    return hdr + payload
+
+
+class FrameDecodeError(Exception):
+    pass
+
+
+def decode_frame(buf: bytes | memoryview) -> tuple[FrameHeader, bytes, int]:
+    """Decode one frame from buf. Returns (header, payload, consumed_bytes).
+
+    Raises FrameDecodeError on corruption; returns consumed=0 when buf does
+    not yet hold a complete frame (streaming use).
+    """
+    if len(buf) < HEADER_SIZE:
+        return None, b"", 0  # type: ignore[return-value]
+    size, magic, ver, mtype, agent_id, org_id, team_id, crc = struct.unpack_from(
+        HEADER_FMT, buf)
+    if magic != MAGIC:
+        raise FrameDecodeError(f"bad magic {magic:#x}")
+    if size > MAX_FRAME_SIZE or size < HEADER_SIZE:
+        raise FrameDecodeError(f"bad frame size {size}")
+    if len(buf) < size:
+        return None, b"", 0  # type: ignore[return-value]
+    payload = bytes(buf[HEADER_SIZE:size])
+    if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+        raise FrameDecodeError("crc mismatch")
+    compressed = bool(ver & COMPRESS_FLAG)
+    if (ver & ~COMPRESS_FLAG) != VERSION:
+        raise FrameDecodeError(f"bad version {ver}")
+    if compressed:
+        payload = zlib.decompress(payload)
+    header = FrameHeader(
+        msg_type=MessageType(mtype), agent_id=agent_id, org_id=org_id,
+        team_id=team_id, compressed=compressed)
+    return header, payload, size
+
+
+class StreamDecoder:
+    """Incremental frame decoder over a TCP byte stream."""
+
+    def __init__(self) -> None:
+        self._buf = bytearray()
+
+    def feed(self, data: bytes) -> list[tuple[FrameHeader, bytes]]:
+        """Decode all complete frames. On corruption the buffer is discarded
+        and FrameDecodeError raised — the owner must drop the connection
+        (there is no resync marker mid-stream, same stance as the
+        reference's receiver)."""
+        self._buf.extend(data)
+        out = []
+        while True:
+            mv = memoryview(self._buf)
+            try:
+                header, payload, consumed = decode_frame(mv)
+            except FrameDecodeError:
+                mv.release()
+                self._buf.clear()
+                raise
+            finally:
+                mv.release()
+            if consumed == 0:
+                break
+            del self._buf[:consumed]
+            out.append((header, payload))
+        return out

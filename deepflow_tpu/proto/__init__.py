@@ -1,0 +1,8 @@
+"""Wire contracts (protobuf) for agent <-> server telemetry.
+
+Reference analog: message/*.proto. Regenerate with:
+    protoc --python_out=deepflow_tpu/proto -I deepflow_tpu/proto \
+        deepflow_tpu/proto/messages.proto
+"""
+
+from deepflow_tpu.proto import messages_pb2 as pb  # noqa: F401

@@ -1,0 +1,12 @@
+"""Query layer: DF-SQL dialect over the embedded columnar store.
+
+Reference analog: server/querier/engine/clickhouse (SQL dialect -> ClickHouse
+SQL). Here the dialect compiles to vectorized numpy execution over
+ColumnarTables, with SmartEncoding dictionary translation at the edges.
+"""
+
+from deepflow_tpu.query.sql import parse
+from deepflow_tpu.query.engine import execute, QueryResult
+from deepflow_tpu.query.flamegraph import build_flame_tree
+
+__all__ = ["parse", "execute", "QueryResult", "build_flame_tree"]

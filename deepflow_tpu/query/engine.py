@@ -1,0 +1,346 @@
+"""Vectorized DF-SQL executor over ColumnarTables.
+
+Reference analog: server/querier/engine/clickhouse/clickhouse.go:184
+(CHEngine.ExecuteQuery) — but instead of translating to ClickHouse SQL we
+compile the AST to numpy ops, with SmartEncoding dictionary translation
+pushed down onto the (small) dictionaries rather than the rows.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+from dataclasses import dataclass
+
+import numpy as np
+
+from deepflow_tpu.query import sql as S
+from deepflow_tpu.store.table import ColumnarTable
+
+
+@dataclass
+class QueryResult:
+    columns: list[str]
+    values: list[list]
+
+    def to_dict(self) -> dict:
+        return {"columns": self.columns, "values": self.values}
+
+    def column(self, name: str) -> list:
+        return [row[self.columns.index(name)] for row in self.values]
+
+
+class QueryError(Exception):
+    pass
+
+
+@dataclass
+class _Val:
+    """Evaluated vector + decode metadata."""
+    arr: np.ndarray
+    kind: str = "num"           # num | str | enum | bool
+    dict_ = None                # Dictionary when kind == 'str'
+    labels: tuple = ()          # when kind == 'enum'
+    unit: str | None = None     # 'ns' | 's' for time columns
+
+    def decoded(self) -> list:
+        if self.kind == "str":
+            return self.dict_.decode_many(self.arr)
+        if self.kind == "enum":
+            lab = self.labels
+            return [lab[i] for i in self.arr.tolist()]
+        if self.kind == "bool":
+            return self.arr.astype(bool).tolist()
+        return self.arr.tolist()
+
+
+def _col_val(table: ColumnarTable, name: str, arr: np.ndarray) -> _Val:
+    spec = table.columns[name]
+    if spec.kind == "str":
+        v = _Val(arr, "str")
+        v.dict_ = table.dicts[name]
+        return v
+    if spec.kind == "enum":
+        return _Val(arr, "enum", labels=spec.enum_values)
+    unit = None
+    if name in ("time", "start_time", "end_time"):
+        unit = "ns" if spec.kind == "u64" else "s"
+    return _Val(arr, "num", unit=unit)
+
+
+def _collect_cols(e, out: set) -> None:
+    if isinstance(e, S.Col):
+        out.add(e.name)
+    elif isinstance(e, S.Func):
+        for a in e.args:
+            _collect_cols(a, out)
+    elif isinstance(e, S.BinOp):
+        _collect_cols(e.left, out)
+        if not isinstance(e.right, tuple):
+            _collect_cols(e.right, out)
+    elif isinstance(e, S.Not):
+        _collect_cols(e.expr, out)
+
+
+def _like_to_pred(pattern: str):
+    pat = pattern.replace("%", "*").replace("_", "?")
+    return lambda s: fnmatch.fnmatchcase(s, pat)
+
+
+class _Env:
+    """Column arrays for one evaluation scope."""
+
+    def __init__(self, table: ColumnarTable, cols: dict[str, np.ndarray]):
+        self.table = table
+        self.cols = cols
+
+    def eval(self, e) -> _Val:
+        if isinstance(e, S.Lit):
+            return _Val(np.asarray(e.value), "num")
+        if isinstance(e, S.Col):
+            if e.name not in self.cols:
+                raise QueryError(f"unknown column {e.name!r} in "
+                                 f"{self.table.name}")
+            return _col_val(self.table, e.name, self.cols[e.name])
+        if isinstance(e, S.Not):
+            v = self.eval(e.expr)
+            return _Val(~v.arr.astype(bool), "bool")
+        if isinstance(e, S.Func):
+            return self._eval_func(e)
+        if isinstance(e, S.BinOp):
+            return self._eval_binop(e)
+        if isinstance(e, S.Star):
+            raise QueryError("* only valid inside Count()")
+        raise QueryError(f"cannot evaluate {e!r}")
+
+    def _eval_func(self, e: S.Func) -> _Val:
+        if e.name in S.AGG_FUNCS:
+            raise QueryError(f"aggregate {e.name} outside aggregation")
+        if e.name == "TIME":
+            if len(e.args) != 2:
+                raise QueryError("time(col, interval_s) takes 2 args")
+            v = self.eval(e.args[0])
+            iv = self.eval(e.args[1]).arr
+            interval = int(iv)
+            t = v.arr.astype(np.int64)
+            if v.unit == "ns":
+                t = t // 1_000_000_000
+            return _Val((t // interval) * interval, "num", unit="s")
+        raise QueryError(f"unknown function {e.name}")
+
+    def _eval_binop(self, e: S.BinOp) -> _Val:
+        op = e.op
+        if op in ("AND", "OR"):
+            lv = self.eval(e.left).arr.astype(bool)
+            rv = self.eval(e.right).arr.astype(bool)
+            return _Val(lv & rv if op == "AND" else lv | rv, "bool")
+        if op == "IN":
+            lv = self.eval(e.left)
+            vals = [self._coerce_lit(lv, lit.value) for lit in e.right]
+            return _Val(np.isin(lv.arr, vals), "bool")
+        if op == "LIKE":
+            lv = self.eval(e.left)
+            if lv.kind == "str":
+                ids = lv.dict_.match_ids(_like_to_pred(e.right.value))
+                return _Val(np.isin(lv.arr, ids), "bool")
+            if lv.kind == "enum":
+                pred = _like_to_pred(e.right.value)
+                ids = [i for i, s in enumerate(lv.labels) if pred(s)]
+                return _Val(np.isin(lv.arr, ids), "bool")
+            raise QueryError("LIKE requires a string column")
+        lv = self.eval(e.left)
+        if op in ("=", "!=", "<", "<=", ">", ">="):
+            rv_raw = e.right
+            if isinstance(rv_raw, S.Lit) and isinstance(rv_raw.value, str):
+                code = self._coerce_lit(lv, rv_raw.value)
+                r = np.asarray(code)
+            else:
+                r = self.eval(rv_raw).arr
+            l = lv.arr
+            res = {"=": l.__eq__, "!=": l.__ne__, "<": l.__lt__,
+                   "<=": l.__le__, ">": l.__gt__, ">=": l.__ge__}[op](r)
+            return _Val(res, "bool")
+        # arithmetic
+        rv = self.eval(e.right)
+        l = lv.arr.astype(np.float64)
+        r = rv.arr.astype(np.float64)
+        if op == "+":
+            return _Val(l + r)
+        if op == "-":
+            return _Val(l - r)
+        if op == "*":
+            return _Val(l * r)
+        if op == "/":
+            with np.errstate(divide="ignore", invalid="ignore"):
+                out = np.where(r != 0, l / np.where(r == 0, 1, r), 0.0)
+            return _Val(out)
+        raise QueryError(f"unknown op {op}")
+
+    def _coerce_lit(self, lv: _Val, value):
+        """Translate a literal to the column's encoded space."""
+        if lv.kind == "str" and isinstance(value, str):
+            sid = lv.dict_.lookup(value)
+            return np.uint32(sid) if sid is not None else np.uint32(0xFFFFFFFF)
+        if lv.kind == "enum" and isinstance(value, str):
+            try:
+                return np.uint16(lv.labels.index(value))
+            except ValueError:
+                return np.uint16(0xFFFF)
+        return value
+
+
+# -- aggregation ------------------------------------------------------------
+
+def _agg_eval(e, env: _Env, order: np.ndarray, bounds: np.ndarray) -> _Val:
+    """Evaluate expr containing aggregates; per-group output.
+
+    order: row permutation grouping rows; bounds: group start indices into
+    order (len == n_groups, implicit end at len(order)).
+    """
+    starts = bounds
+    ends = np.append(bounds[1:], len(order))
+    if isinstance(e, S.Func) and e.name in S.AGG_FUNCS:
+        if e.name == "COUNT":
+            return _Val((ends - starts).astype(np.float64))
+        arg = e.args[0] if e.args else S.Star()
+        if isinstance(arg, S.Star):
+            return _Val((ends - starts).astype(np.float64))
+        v = env.eval(arg)
+        if v.kind in ("str", "enum") and e.name != "LAST":
+            raise QueryError(
+                f"{e.name} over string column {S.expr_name(arg)!r}")
+        a = v.arr.astype(np.float64)[order]
+        if e.name == "SUM":
+            return _Val(np.add.reduceat(a, starts) if len(a) else a)
+        if e.name == "AVG":
+            s = np.add.reduceat(a, starts) if len(a) else a
+            n = (ends - starts)
+            return _Val(s / np.maximum(n, 1))
+        if e.name == "MIN":
+            return _Val(np.minimum.reduceat(a, starts) if len(a) else a)
+        if e.name == "MAX":
+            return _Val(np.maximum.reduceat(a, starts) if len(a) else a)
+        if e.name == "LAST":
+            out = a[ends - 1] if len(a) else a
+            v2 = _Val(out, v.kind, labels=v.labels)
+            v2.dict_ = v.dict_
+            if v.kind in ("str", "enum"):
+                v2.arr = v.arr[order][ends - 1] if len(a) else v.arr
+            return v2
+        if e.name == "PERCENTILE":
+            p = float(env.eval(e.args[1]).arr)
+            out = np.empty(len(starts), dtype=np.float64)
+            for i, (s0, e0) in enumerate(zip(starts, ends)):
+                out[i] = np.percentile(a[s0:e0], p) if e0 > s0 else 0.0
+            return _Val(out)
+        raise QueryError(f"unknown aggregate {e.name}")
+    if isinstance(e, S.BinOp):
+        lv = _agg_eval(e.left, env, order, bounds)
+        rv = _agg_eval(e.right, env, order, bounds)
+        l, r = lv.arr.astype(np.float64), rv.arr.astype(np.float64)
+        if e.op == "+":
+            return _Val(l + r)
+        if e.op == "-":
+            return _Val(l - r)
+        if e.op == "*":
+            return _Val(l * r)
+        if e.op == "/":
+            with np.errstate(divide="ignore", invalid="ignore"):
+                return _Val(np.where(r != 0, l / np.where(r == 0, 1, r), 0.0))
+        raise QueryError(f"op {e.op} not valid over aggregates")
+    if isinstance(e, S.Lit):
+        return _Val(np.asarray(e.value))
+    if isinstance(e, (S.Col, S.Func)):
+        # group-key expression: first value per group
+        v = env.eval(e)
+        out = _Val(v.arr[order][bounds], v.kind, labels=v.labels, unit=v.unit)
+        out.dict_ = v.dict_
+        return out
+    raise QueryError(f"cannot aggregate {e!r}")
+
+
+def execute(table: ColumnarTable, query: S.Select | str) -> QueryResult:
+    if isinstance(query, str):
+        query = S.parse(query)
+    needed: set[str] = set()
+    for item in query.items:
+        _collect_cols(item.expr, needed)
+    for g in query.group_by:
+        _collect_cols(g, needed)
+    aliases = {i.alias for i in query.items if i.alias}
+    for e, _ in query.order_by:
+        if isinstance(e, S.Col) and e.name in aliases:
+            continue  # refers to a SELECT alias, not a table column
+        _collect_cols(e, needed)
+    if query.where is not None:
+        _collect_cols(query.where, needed)
+    unknown = needed - set(table.columns)
+    if unknown:
+        raise QueryError(f"unknown columns {sorted(unknown)} in {table.name}")
+
+    # filter per chunk, then materialize needed columns
+    chunks = table.snapshot()
+    if query.where is not None:
+        masks = []
+        for ch in chunks:
+            env = _Env(table, ch)
+            masks.append(env.eval(query.where).arr.astype(bool))
+        cols = {}
+        for name in needed:
+            parts = [ch[name][m] for ch, m in zip(chunks, masks)]
+            cols[name] = (np.concatenate(parts) if parts else
+                          np.empty(0, dtype=table.columns[name].np_dtype))
+    else:
+        cols = {}
+        for name in needed:
+            parts = [ch[name] for ch in chunks]
+            cols[name] = (np.concatenate(parts) if parts else
+                          np.empty(0, dtype=table.columns[name].np_dtype))
+    env = _Env(table, cols)
+
+    is_agg = bool(query.group_by) or any(
+        S.contains_agg(i.expr) for i in query.items)
+
+    names = [i.alias or S.expr_name(i.expr) for i in query.items]
+    if not is_agg:
+        outs = [env.eval(i.expr) for i in query.items]
+    else:
+        n_rows = len(next(iter(cols.values()))) if cols else 0
+        if query.group_by:
+            key_vals = [env.eval(g) for g in query.group_by]
+            if n_rows == 0:
+                order = np.empty(0, dtype=np.int64)
+                bounds = np.empty(0, dtype=np.int64)
+            else:
+                key = np.zeros(n_rows, dtype=np.int64)
+                for kv in key_vals:
+                    _, inv = np.unique(kv.arr, return_inverse=True)
+                    key = key * (int(inv.max(initial=0)) + 1) + inv
+                order = np.argsort(key, kind="stable")
+                sk = key[order]
+                bounds = np.flatnonzero(np.append(True, sk[1:] != sk[:-1]))
+        else:
+            # one group over all rows; zero rows -> zero groups
+            order = np.arange(n_rows)
+            bounds = np.zeros(1 if n_rows else 0, dtype=np.int64)
+        outs = [_agg_eval(i.expr, env, order, bounds) for i in query.items]
+
+    decoded = [v.decoded() for v in outs]
+    n_out = max((len(d) for d in decoded), default=0)
+    # broadcast scalars (e.g. literals)
+    decoded = [d if len(d) == n_out else list(d) * n_out for d in decoded]
+    rows = [list(r) for r in zip(*decoded)] if n_out else []
+
+    # ORDER BY over output columns
+    for e, desc in reversed(query.order_by):
+        key_name = S.expr_name(e)
+        if key_name in names:
+            idx = names.index(key_name)
+        elif isinstance(e, S.Col) and e.name in names:
+            idx = names.index(e.name)
+        else:
+            raise QueryError(f"ORDER BY {key_name!r} must appear in SELECT")
+        rows.sort(key=lambda r: r[idx], reverse=desc)
+
+    if query.limit is not None:
+        rows = rows[:query.limit]
+    return QueryResult(columns=names, values=rows)

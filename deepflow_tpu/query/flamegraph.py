@@ -1,0 +1,108 @@
+"""Flame-graph tree assembly from folded stacks.
+
+Reference analog: server/querier/profile/service/profile.go:113
+(GenerateProfile: SQL over in_process_profile -> location tree with self/total
+values) and :308 (newProfileTreeNode).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from deepflow_tpu.store.table import ColumnarTable
+
+SEP = ";"
+
+
+@dataclass
+class FlameNode:
+    name: str
+    total_value: int = 0
+    self_value: int = 0
+    children: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "total_value": int(self.total_value),
+            "self_value": int(self.self_value),
+            "children": [c.to_dict() for c in
+                         sorted(self.children.values(),
+                                key=lambda n: -n.total_value)],
+        }
+
+
+def build_flame_tree(stacks: list[str], values: list[int],
+                     root_name: str = "root") -> FlameNode:
+    """Merge folded stacks ("a;b;c") weighted by values into a tree."""
+    root = FlameNode(root_name)
+    for stack, value in zip(stacks, values):
+        if not stack:
+            continue
+        root.total_value += value
+        node = root
+        for frame in stack.split(SEP):
+            child = node.children.get(frame)
+            if child is None:
+                child = FlameNode(frame)
+                node.children[frame] = child
+            child.total_value += value
+            node = child
+        node.self_value += value
+    return root
+
+
+def profile_flame_tree(table: ColumnarTable,
+                       time_start_ns: int | None = None,
+                       time_end_ns: int | None = None,
+                       event_type: str | None = None,
+                       app_service: str | None = None,
+                       profiler: str | None = None,
+                       stack_col: str = "stack",
+                       value_col: str = "value") -> FlameNode:
+    """Flame tree straight off the in_process_profile table.
+
+    Aggregates by stack *in encoded space* (SmartEncoding: group by the
+    dictionary id, decode only the surviving unique stacks).
+    """
+    chunks = table.snapshot()
+    spec = table.columns[stack_col]
+    d = table.dicts[stack_col]
+    agg: dict[int, int] = {}
+    etype_code = None
+    if event_type is not None:
+        etype_code = table.columns["event_type"].enum_of(event_type)
+    svc_code = None
+    if app_service is not None:
+        svc_code = table.dicts["app_service"].lookup(app_service)
+        if svc_code is None:
+            return FlameNode("root")
+    prof_code = None
+    if profiler is not None:
+        prof_code = table.dicts["profiler"].lookup(profiler)
+        if prof_code is None:
+            return FlameNode("root")
+    for ch in chunks:
+        mask = np.ones(len(ch[stack_col]), dtype=bool)
+        if time_start_ns is not None:
+            mask &= ch["time"] >= time_start_ns
+        if time_end_ns is not None:
+            mask &= ch["time"] < time_end_ns
+        if etype_code is not None:
+            mask &= ch["event_type"] == etype_code
+        if svc_code is not None:
+            mask &= ch["app_service"] == svc_code
+        if prof_code is not None:
+            mask &= ch["profiler"] == prof_code
+        sids = ch[stack_col][mask]
+        vals = ch[value_col][mask]
+        if not len(sids):
+            continue
+        uniq, inv = np.unique(sids, return_inverse=True)
+        sums = np.bincount(inv, weights=vals.astype(np.float64))
+        for sid, v in zip(uniq.tolist(), sums.tolist()):
+            agg[sid] = agg.get(sid, 0) + int(v)
+    stacks = [d.decode(sid) for sid in agg]
+    return build_flame_tree(stacks, list(agg.values()))

@@ -1,0 +1,15 @@
+"""SmartEncoding columnar store.
+
+Reference analog: ClickHouse + server/libs/ckdb (DDL/batched writer) +
+controller/tagrecorder (dictionary tables). Here the store is embedded:
+numpy-chunked columns with dictionary-encoded strings, so tags cost a small
+int per row and decode at query time — the SmartEncoding design
+(reference README.md:29, 10x storage reduction claim).
+"""
+
+from deepflow_tpu.store.dictionary import Dictionary
+from deepflow_tpu.store.table import ColumnSpec, ColumnarTable
+from deepflow_tpu.store.db import Database
+from deepflow_tpu.store import schema
+
+__all__ = ["Dictionary", "ColumnSpec", "ColumnarTable", "Database", "schema"]

@@ -1,0 +1,59 @@
+"""Embedded database: named columnar tables created from schema.py."""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from deepflow_tpu.store import schema
+from deepflow_tpu.store.table import ColumnarTable, ColumnSpec
+
+
+class Database:
+    """A set of named ColumnarTables (the ClickHouse analog, embedded)."""
+
+    def __init__(self, data_dir: str | None = None,
+                 chunk_rows: int = 1 << 16) -> None:
+        self.data_dir = data_dir
+        self.chunk_rows = chunk_rows
+        self._tables: dict[str, ColumnarTable] = {}
+        self._lock = threading.Lock()
+        for name, cols in schema.TABLES.items():
+            self.create_table(name, cols)
+
+    def create_table(self, name: str,
+                     columns: list[ColumnSpec]) -> ColumnarTable:
+        with self._lock:
+            if name in self._tables:
+                return self._tables[name]
+            t = ColumnarTable(name, columns, chunk_rows=self.chunk_rows)
+            self._tables[name] = t
+            return t
+
+    def table(self, name: str) -> ColumnarTable:
+        try:
+            return self._tables[name]
+        except KeyError:
+            raise KeyError(
+                f"no such table {name!r}; known: {sorted(self._tables)}")
+
+    def tables(self) -> list[str]:
+        return sorted(self._tables)
+
+    def flush(self) -> None:
+        for t in self._tables.values():
+            t.flush()
+
+    def save(self) -> None:
+        if not self.data_dir:
+            return
+        for name, t in self._tables.items():
+            t.save(os.path.join(self.data_dir, name.replace(".", "/")))
+
+    def load(self) -> None:
+        if not self.data_dir:
+            return
+        for name, t in self._tables.items():
+            d = os.path.join(self.data_dir, name.replace(".", "/"))
+            if os.path.isdir(d):
+                t.load(d)

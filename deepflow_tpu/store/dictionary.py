@@ -1,0 +1,80 @@
+"""SmartEncoding dictionaries: string <-> small-int id.
+
+Reference analog: controller/tagrecorder ch_* dictionary tables (const.go:66+)
+joined at query time by the querier. Ours are embedded, per-column, and
+persistable; id 0 is always the empty string.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import numpy as np
+
+
+class Dictionary:
+    """Append-only string dictionary. Thread-safe encode; lock-free decode
+    via immutable snapshots."""
+
+    def __init__(self, name: str = "") -> None:
+        self.name = name
+        self._lock = threading.Lock()
+        self._str_to_id: dict[str, int] = {"": 0}
+        self._strings: list[str] = [""]
+
+    def __len__(self) -> int:
+        return len(self._strings)
+
+    def encode(self, s: str) -> int:
+        sid = self._str_to_id.get(s)
+        if sid is not None:
+            return sid
+        with self._lock:
+            sid = self._str_to_id.get(s)
+            if sid is None:
+                sid = len(self._strings)
+                self._strings.append(s)
+                self._str_to_id[s] = sid
+            return sid
+
+    def encode_many(self, values: list[str]) -> np.ndarray:
+        return np.fromiter((self.encode(v) for v in values), dtype=np.uint32,
+                           count=len(values))
+
+    def decode(self, sid: int) -> str:
+        return self._strings[sid]
+
+    def decode_many(self, ids: np.ndarray) -> list[str]:
+        strings = self._strings
+        return [strings[i] for i in ids.tolist()]
+
+    def lookup(self, s: str) -> int | None:
+        """Return id without inserting (query-side)."""
+        return self._str_to_id.get(s)
+
+    def snapshot(self) -> list[str]:
+        with self._lock:
+            return list(self._strings)
+
+    def match_ids(self, predicate) -> np.ndarray:
+        """Ids of all entries satisfying predicate(str) — used to push LIKE /
+        regex filters down onto the (small) dictionary instead of the rows."""
+        snap = self.snapshot()
+        return np.fromiter(
+            (i for i, s in enumerate(snap) if predicate(s)), dtype=np.uint32)
+
+    # -- persistence ---------------------------------------------------------
+
+    def dump(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.snapshot(), f)
+
+    @classmethod
+    def load(cls, path: str, name: str = "") -> "Dictionary":
+        d = cls(name)
+        with open(path) as f:
+            strings = json.load(f)
+        d._strings = strings
+        d._str_to_id = {s: i for i, s in enumerate(strings)}
+        return d

@@ -1,0 +1,227 @@
+"""Table schemas for the embedded store.
+
+Reference analog: the ClickHouse table families created by the ingester
+(flow_log, flow_metrics, profile, event, deepflow_system — see
+server/ingester/*/dbwriter and server/libs/ckdb). Times are u64 nanoseconds
+unless noted; `*_s` columns are u32 epoch seconds for aggregate tables.
+"""
+
+from __future__ import annotations
+
+from deepflow_tpu.store.table import ColumnSpec as C
+
+L4_PROTOS = ("unknown", "tcp", "udp", "icmp")
+L7_PROTOS = (
+    "unknown", "http1", "http2", "grpc", "dns", "mysql", "redis", "kafka",
+    "postgresql", "mongodb", "memcached", "mqtt", "amqp", "nats", "dubbo",
+    "fastcgi", "tls", "ping")
+RESPONSE_STATUS = ("unknown", "ok", "client_error", "server_error", "timeout")
+PROFILE_EVENT_TYPES = (
+    "unknown", "on-cpu", "off-cpu", "mem-alloc", "tpu-device", "tpu-host")
+TPU_SPAN_KINDS = (
+    "unknown", "device-compute", "device-collective", "device-transfer",
+    "host-runtime", "host-compile")
+CLOSE_TYPES = ("unknown", "fin", "rst", "timeout", "forced")
+
+# Universal tags injected by the ingester on every row
+# (reference: server/libs/grpc/grpc_platformdata.go PlatformInfoTable).
+UNIVERSAL_TAGS = [
+    C("agent_id", "u16"),
+    C("host_id", "u16"),
+    C("host", "str"),
+    C("pod_name", "str"),
+    C("pod_ns", "str"),
+    C("tpu_pod", "str"),        # TPU topology tags (TPU-native SmartEncoding)
+    C("tpu_worker", "u16"),
+    C("slice_id", "u16"),
+]
+
+TABLES: dict[str, list[C]] = {}
+
+
+def _table(name: str, cols: list[C]) -> None:
+    TABLES[name] = cols
+
+
+# -- profile ---------------------------------------------------------------
+# reference: server/ingester/profile/dbwriter/profile.go:48
+_table("profile.in_process_profile", [
+    C("time", "u64"),                   # ns
+    C("app_service", "str"),
+    C("process_name", "str"),
+    C("event_type", "enum", PROFILE_EVENT_TYPES),
+    C("profiler", "str"),
+    C("pid", "u32"),
+    C("tid", "u32"),
+    C("thread_name", "str"),
+    C("stack", "str"),                  # folded stack, dictionary-encoded
+    C("value", "u64"),                  # us or bytes
+    C("count", "u32"),
+    *UNIVERSAL_TAGS,
+])
+
+# -- TPU device spans (new: the CUDA->TPU re-imagination) ------------------
+_table("profile.tpu_hlo_span", [
+    C("time", "u64"),                   # start ns
+    C("duration_ns", "u64"),
+    C("device_id", "u16"),
+    C("chip_id", "u16"),
+    C("core_id", "u16"),
+    C("kind", "enum", TPU_SPAN_KINDS),
+    C("hlo_module", "str"),
+    C("hlo_op", "str"),
+    C("hlo_category", "str"),
+    C("flops", "u64"),
+    C("bytes_accessed", "u64"),
+    C("program_id", "u32"),
+    C("run_id", "u32"),
+    C("collective", "str"),
+    C("bytes_transferred", "u64"),
+    C("replica_group_size", "u16"),
+    C("step", "u64"),
+    C("pid", "u32"),
+    C("process_name", "str"),
+    C("app_service", "str"),
+    *UNIVERSAL_TAGS,
+])
+
+# -- flow logs -------------------------------------------------------------
+# reference: server/ingester/flow_log/log_data/l4_flow_log.go
+_table("flow_log.l4_flow_log", [
+    C("time", "u64"),                   # flow end ns
+    C("flow_id", "u64"),
+    C("ip4_src", "u32"),
+    C("ip4_dst", "u32"),
+    C("ip_src", "str"),                 # printable (v4/v6)
+    C("ip_dst", "str"),
+    C("port_src", "u16"),
+    C("port_dst", "u16"),
+    C("protocol", "enum", L4_PROTOS),
+    C("tap_port", "u32"),
+    C("start_time", "u64"),
+    C("end_time", "u64"),
+    C("packet_tx", "u64"),
+    C("packet_rx", "u64"),
+    C("byte_tx", "u64"),
+    C("byte_rx", "u64"),
+    C("l7_request", "u64"),
+    C("l7_response", "u64"),
+    C("rtt", "u32"),                    # us
+    C("art", "u32"),                    # us
+    C("retrans_tx", "u32"),
+    C("retrans_rx", "u32"),
+    C("zero_win_tx", "u32"),
+    C("zero_win_rx", "u32"),
+    C("close_type", "enum", CLOSE_TYPES),
+    C("syn_count", "u32"),
+    C("synack_count", "u32"),
+    C("gprocess_id_0", "u32"),
+    C("gprocess_id_1", "u32"),
+    *UNIVERSAL_TAGS,
+])
+
+# reference: server/ingester/flow_log/log_data/l7_flow_log.go
+_table("flow_log.l7_flow_log", [
+    C("time", "u64"),                   # request start ns
+    C("flow_id", "u64"),
+    C("ip_src", "str"),
+    C("ip_dst", "str"),
+    C("port_src", "u16"),
+    C("port_dst", "u16"),
+    C("l7_protocol", "enum", L7_PROTOS),
+    C("version", "str"),
+    C("request_type", "str"),
+    C("request_domain", "str"),
+    C("request_resource", "str"),
+    C("endpoint", "str"),
+    C("request_id", "u32"),
+    C("response_status", "enum", RESPONSE_STATUS),
+    C("response_code", "i32"),
+    C("response_exception", "str"),
+    C("response_result", "str"),
+    C("response_duration", "u64"),      # ns
+    C("trace_id", "str"),
+    C("span_id", "str"),
+    C("parent_span_id", "str"),
+    C("x_request_id", "str"),
+    C("syscall_trace_id_request", "u64"),
+    C("syscall_trace_id_response", "u64"),
+    C("syscall_thread_0", "u32"),
+    C("syscall_thread_1", "u32"),
+    C("captured_request_byte", "u64"),
+    C("captured_response_byte", "u64"),
+    C("gprocess_id_0", "u32"),
+    C("gprocess_id_1", "u32"),
+    C("process_kname_0", "str"),
+    C("process_kname_1", "str"),
+    *UNIVERSAL_TAGS,
+])
+
+# -- flow metrics ----------------------------------------------------------
+# reference: server/libs/flow-metrics (network/application 1s/1m tables)
+_NETWORK_COLS = [
+    C("time", "u32"),                   # epoch seconds
+    C("ip_src", "str"),
+    C("ip_dst", "str"),
+    C("server_port", "u16"),
+    C("protocol", "enum", L4_PROTOS),
+    C("direction", "u8"),
+    C("packet_tx", "u64"),
+    C("packet_rx", "u64"),
+    C("byte_tx", "u64"),
+    C("byte_rx", "u64"),
+    C("flow_count", "u64"),
+    C("new_flow", "u64"),
+    C("closed_flow", "u64"),
+    C("rtt_sum", "u64"),                # us
+    C("rtt_count", "u64"),
+    C("retrans", "u64"),
+    C("syn_count", "u64"),
+    C("synack_count", "u64"),
+    *UNIVERSAL_TAGS,
+]
+_table("flow_metrics.network.1s", list(_NETWORK_COLS))
+_table("flow_metrics.network.1m", list(_NETWORK_COLS))
+
+_APP_COLS = [
+    C("time", "u32"),
+    C("ip_src", "str"),
+    C("ip_dst", "str"),
+    C("server_port", "u16"),
+    C("l7_protocol", "enum", L7_PROTOS),
+    C("app_service", "str"),
+    C("request", "u64"),
+    C("response", "u64"),
+    C("rrt_sum", "u64"),                # us
+    C("rrt_count", "u64"),
+    C("rrt_max", "u64"),
+    C("error_client", "u64"),
+    C("error_server", "u64"),
+    C("timeout", "u64"),
+    *UNIVERSAL_TAGS,
+]
+_table("flow_metrics.application.1s", list(_APP_COLS))
+_table("flow_metrics.application.1m", list(_APP_COLS))
+
+# -- events ----------------------------------------------------------------
+_table("event.event", [
+    C("time", "u64"),
+    C("event_type", "str"),
+    C("resource_type", "str"),
+    C("resource_name", "str"),
+    C("pid", "u32"),
+    C("description", "str"),
+    C("attrs", "str"),                  # json
+    *UNIVERSAL_TAGS,
+])
+
+# -- self telemetry --------------------------------------------------------
+# reference: deepflow_system DB (agent/src/utils/stats.rs -> ext_metrics)
+_table("deepflow_system.deepflow_system", [
+    C("time", "u64"),
+    C("metric_name", "str"),
+    C("tag_json", "str"),
+    C("value_name", "str"),
+    C("value", "f64"),
+    *UNIVERSAL_TAGS,
+])

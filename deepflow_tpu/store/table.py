@@ -1,0 +1,193 @@
+"""Chunked columnar table with dictionary-encoded string columns.
+
+Reference analog: server/libs/ckdb (table DDL + batched columnar inserts into
+ClickHouse). Embedded design: each table holds a list of immutable chunks
+(dict column-name -> np.ndarray); writers buffer rows and seal chunks; readers
+snapshot the chunk list — single-writer / many-reader without locks on the
+read path.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from deepflow_tpu.store.dictionary import Dictionary
+
+_DTYPES = {
+    "u8": np.uint8, "u16": np.uint16, "u32": np.uint32, "u64": np.uint64,
+    "i8": np.int8, "i16": np.int16, "i32": np.int32, "i64": np.int64,
+    "f32": np.float32, "f64": np.float64,
+    "str": np.uint32,   # dictionary-encoded
+    "enum": np.uint16,  # fixed enum mapping provided in spec
+}
+
+
+@dataclass(frozen=True)
+class ColumnSpec:
+    name: str
+    kind: str                      # key of _DTYPES
+    enum_values: tuple[str, ...] = ()  # for kind == "enum": index -> label
+    default: object = 0
+
+    @property
+    def np_dtype(self):
+        return _DTYPES[self.kind]
+
+    def enum_of(self, label: str) -> int:
+        return self.enum_values.index(label)
+
+
+class ColumnarTable:
+    """Append-only columnar table; chunked; per-str-column dictionaries."""
+
+    def __init__(self, name: str, columns: list[ColumnSpec],
+                 chunk_rows: int = 1 << 16) -> None:
+        self.name = name
+        self.columns = {c.name: c for c in columns}
+        self.chunk_rows = chunk_rows
+        self.dicts: dict[str, Dictionary] = {
+            c.name: Dictionary(f"{name}.{c.name}")
+            for c in columns if c.kind == "str"}
+        self._chunks: list[dict[str, np.ndarray]] = []
+        self._buf: dict[str, list] = {c.name: [] for c in columns}
+        self._buf_rows = 0
+        self._lock = threading.Lock()
+        self.rows_written = 0
+
+    # -- write path ----------------------------------------------------------
+
+    def append_rows(self, rows: list[dict]) -> None:
+        """Append a batch of row dicts. Missing columns take the default."""
+        if not rows:
+            return
+        with self._lock:
+            for name, spec in self.columns.items():
+                col = self._buf[name]
+                if spec.kind == "str":
+                    d = self.dicts[name]
+                    col.extend(d.encode(r.get(name, "")) for r in rows)
+                else:
+                    dflt = spec.default
+                    col.extend(r.get(name, dflt) for r in rows)
+            self._buf_rows += len(rows)
+            self.rows_written += len(rows)
+            if self._buf_rows >= self.chunk_rows:
+                self._seal_locked()
+
+    def append_columns(self, cols: dict[str, list | np.ndarray],
+                       n: int | None = None) -> None:
+        """Column-oriented append (fast path for decoders)."""
+        if n is None:
+            n = len(next(iter(cols.values())))
+        if n == 0:
+            return
+        with self._lock:
+            for name, spec in self.columns.items():
+                col = self._buf[name]
+                if name in cols:
+                    v = cols[name]
+                    if spec.kind == "str":
+                        d = self.dicts[name]
+                        col.extend(d.encode(s) for s in v)
+                    elif isinstance(v, np.ndarray):
+                        col.extend(v.tolist())
+                    else:
+                        col.extend(v)
+                else:
+                    col.extend([spec.default] * n)
+            self._buf_rows += n
+            self.rows_written += n
+            if self._buf_rows >= self.chunk_rows:
+                self._seal_locked()
+
+    def _seal_locked(self) -> None:
+        if self._buf_rows == 0:
+            return
+        chunk = {}
+        for name, spec in self.columns.items():
+            chunk[name] = np.asarray(self._buf[name], dtype=spec.np_dtype)
+            self._buf[name] = []
+        self._chunks.append(chunk)
+        self._buf_rows = 0
+
+    def flush(self) -> None:
+        with self._lock:
+            self._seal_locked()
+
+    # -- read path -----------------------------------------------------------
+
+    def snapshot(self) -> list[dict[str, np.ndarray]]:
+        """Chunk list incl. current buffer (sealed copy)."""
+        with self._lock:
+            chunks = list(self._chunks)
+            if self._buf_rows:
+                chunks.append({
+                    name: np.asarray(self._buf[name], dtype=spec.np_dtype)
+                    for name, spec in self.columns.items()})
+        return chunks
+
+    def column_concat(self, names: list[str],
+                      mask_chunks: list[np.ndarray] | None = None
+                      ) -> dict[str, np.ndarray]:
+        """Materialize selected columns (optionally per-chunk filtered)."""
+        chunks = self.snapshot()
+        out: dict[str, np.ndarray] = {}
+        for name in names:
+            spec = self.columns[name]
+            parts = []
+            for i, ch in enumerate(chunks):
+                a = ch[name]
+                if mask_chunks is not None:
+                    a = a[mask_chunks[i]]
+                parts.append(a)
+            out[name] = (np.concatenate(parts) if parts
+                         else np.empty(0, dtype=spec.np_dtype))
+        return out
+
+    def __len__(self) -> int:
+        return self.rows_written
+
+    # -- retention -----------------------------------------------------------
+
+    def trim_before(self, time_col: str, cutoff: int) -> int:
+        """Drop whole sealed chunks entirely older than cutoff. Returns rows
+        dropped (coarse TTL, like CK partition drops)."""
+        dropped = 0
+        with self._lock:
+            kept = []
+            for ch in self._chunks:
+                t = ch.get(time_col)
+                if t is not None and len(t) and t.max() < cutoff:
+                    dropped += len(t)
+                else:
+                    kept.append(ch)
+            self._chunks = kept
+        return dropped
+
+    # -- persistence (npz per chunk + dict json) -----------------------------
+
+    def save(self, dirpath: str) -> None:
+        os.makedirs(dirpath, exist_ok=True)
+        chunks = self.snapshot()
+        for i, ch in enumerate(chunks):
+            np.savez_compressed(os.path.join(dirpath, f"chunk_{i:06d}.npz"), **ch)
+        for name, d in self.dicts.items():
+            d.dump(os.path.join(dirpath, f"dict_{name}.json"))
+
+    def load(self, dirpath: str) -> None:
+        with self._lock:
+            self._chunks = []
+            for fn in sorted(os.listdir(dirpath)):
+                if fn.startswith("chunk_") and fn.endswith(".npz"):
+                    z = np.load(os.path.join(dirpath, fn))
+                    self._chunks.append({k: z[k] for k in z.files})
+            for name in self.dicts:
+                p = os.path.join(dirpath, f"dict_{name}.json")
+                if os.path.exists(p):
+                    self.dicts[name] = Dictionary.load(p, name)
+            self.rows_written = sum(
+                len(next(iter(ch.values()))) for ch in self._chunks if ch)

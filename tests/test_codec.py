@@ -1,0 +1,78 @@
+import pytest
+
+from deepflow_tpu.codec import (
+    FrameDecodeError, FrameHeader, MessageType, StreamDecoder,
+    decode_frame, encode_frame)
+from deepflow_tpu.proto import pb
+
+
+def test_roundtrip_small():
+    h = FrameHeader(MessageType.PROFILE, agent_id=7)
+    frame = encode_frame(h, b"hello")
+    h2, payload, consumed = decode_frame(frame)
+    assert consumed == len(frame)
+    assert payload == b"hello"
+    assert h2.msg_type == MessageType.PROFILE
+    assert h2.agent_id == 7
+    assert not h2.compressed
+
+
+def test_roundtrip_compressed():
+    data = b"x" * 10000
+    frame = encode_frame(FrameHeader(MessageType.METRICS), data)
+    h2, payload, _ = decode_frame(frame)
+    assert h2.compressed
+    assert payload == data
+    assert len(frame) < len(data)
+
+
+def test_partial_and_stream():
+    frames = [encode_frame(FrameHeader(MessageType.L4_LOG, agent_id=i),
+                           bytes([i]) * (10 + i)) for i in range(5)]
+    blob = b"".join(frames)
+    dec = StreamDecoder()
+    got = []
+    # feed in awkward 7-byte chunks
+    for i in range(0, len(blob), 7):
+        got.extend(dec.feed(blob[i:i + 7]))
+    assert len(got) == 5
+    for i, (h, p) in enumerate(got):
+        assert h.agent_id == i
+        assert p == bytes([i]) * (10 + i)
+
+
+def test_corruption_detected():
+    frame = bytearray(encode_frame(FrameHeader(MessageType.PROFILE), b"data!"))
+    frame[-1] ^= 0xFF
+    with pytest.raises(FrameDecodeError):
+        decode_frame(bytes(frame))
+    frame2 = bytearray(encode_frame(FrameHeader(MessageType.PROFILE), b"y"))
+    frame2[4] = 0  # magic
+    with pytest.raises(FrameDecodeError):
+        decode_frame(bytes(frame2))
+
+
+def test_protobuf_payload():
+    batch = pb.ProfileBatch()
+    p = batch.profiles.add()
+    p.process_name = "querier"
+    p.event_type = pb.ON_CPU
+    p.stack = b"main;run;loop"
+    p.value = 10000
+    p.count = 1
+    frame = encode_frame(FrameHeader(MessageType.PROFILE),
+                         batch.SerializeToString())
+    _, payload, _ = decode_frame(frame)
+    out = pb.ProfileBatch.FromString(payload)
+    assert out.profiles[0].stack == b"main;run;loop"
+
+
+def test_stream_decoder_recovers_after_corruption():
+    good = encode_frame(FrameHeader(MessageType.PROFILE), b"ok")
+    bad = bytearray(good)
+    bad[-1] ^= 0xFF
+    dec = StreamDecoder()
+    with pytest.raises(FrameDecodeError):
+        dec.feed(bytes(bad))
+    # buffer discarded: a fresh good frame decodes fine
+    assert dec.feed(good)[0][1] == b"ok"

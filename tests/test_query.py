@@ -1,0 +1,152 @@
+import pytest
+
+from deepflow_tpu.query import execute, parse
+from deepflow_tpu.query.engine import QueryError
+from deepflow_tpu.query.flamegraph import build_flame_tree, profile_flame_tree
+from deepflow_tpu.store.table import ColumnSpec, ColumnarTable
+
+
+def make_table():
+    t = ColumnarTable("flow", [
+        ColumnSpec("time", "u32"),
+        ColumnSpec("svc", "str"),
+        ColumnSpec("proto", "enum", ("unknown", "tcp", "udp")),
+        ColumnSpec("bytes", "u64"),
+        ColumnSpec("latency", "f64"),
+    ], chunk_rows=3)
+    rows = [
+        {"time": 0, "svc": "api", "proto": 1, "bytes": 100, "latency": 1.0},
+        {"time": 1, "svc": "api", "proto": 1, "bytes": 200, "latency": 3.0},
+        {"time": 2, "svc": "db", "proto": 1, "bytes": 50, "latency": 10.0},
+        {"time": 61, "svc": "api", "proto": 2, "bytes": 400, "latency": 2.0},
+        {"time": 62, "svc": "db", "proto": 1, "bytes": 25, "latency": 20.0},
+        {"time": 63, "svc": "cache", "proto": 2, "bytes": 10, "latency": 0.5},
+    ]
+    t.append_rows(rows)
+    return t
+
+
+def test_parse_basic():
+    q = parse("SELECT Sum(bytes) AS b, svc FROM flow WHERE proto = 'tcp' "
+              "GROUP BY svc ORDER BY b DESC LIMIT 10")
+    assert q.table == "flow"
+    assert q.limit == 10
+    assert len(q.items) == 2
+
+
+def test_projection_and_where():
+    t = make_table()
+    r = execute(t, "SELECT svc, bytes FROM flow WHERE bytes >= 100")
+    assert r.columns == ["svc", "bytes"]
+    assert sorted(r.column("svc")) == ["api", "api", "api"]
+    r2 = execute(t, "SELECT svc FROM flow WHERE proto = 'udp'")
+    assert sorted(r2.column("svc")) == ["api", "cache"]
+
+
+def test_string_filters():
+    t = make_table()
+    r = execute(t, "SELECT bytes FROM flow WHERE svc = 'db'")
+    assert sorted(r.column("bytes")) == [25, 50]
+    r = execute(t, "SELECT bytes FROM flow WHERE svc LIKE 'a%'")
+    assert sorted(r.column("bytes")) == [100, 200, 400]
+    r = execute(t, "SELECT bytes FROM flow WHERE svc IN ('db', 'cache')")
+    assert sorted(r.column("bytes")) == [10, 25, 50]
+    r = execute(t, "SELECT bytes FROM flow WHERE svc = 'absent'")
+    assert r.values == []
+
+
+def test_group_by_aggregates():
+    t = make_table()
+    r = execute(t, "SELECT svc, Sum(bytes) AS total, Count(*) AS n, "
+                   "Avg(latency) AS lat FROM flow GROUP BY svc "
+                   "ORDER BY total DESC")
+    assert r.columns == ["svc", "total", "n", "lat"]
+    assert r.values[0][0] == "api"
+    assert r.values[0][1] == 700.0
+    d = {row[0]: row for row in r.values}
+    assert d["db"][2] == 2.0
+    assert d["db"][3] == pytest.approx(15.0)
+
+
+def test_global_aggregate_and_arith():
+    t = make_table()
+    r = execute(t, "SELECT Sum(bytes) / Count(*) AS avg_bytes, "
+                   "Max(latency) AS ml FROM flow")
+    assert r.values[0][0] == pytest.approx(785 / 6)
+    assert r.values[0][1] == 20.0
+
+
+def test_time_bucketing():
+    t = make_table()
+    r = execute(t, "SELECT time(time, 60) AS ts, Sum(bytes) AS b FROM flow "
+                   "GROUP BY time(time, 60) ORDER BY ts")
+    assert r.values == [[0, 350.0], [60, 435.0]]
+
+
+def test_percentile():
+    t = make_table()
+    r = execute(t, "SELECT Percentile(latency, 50) AS p50 FROM flow")
+    assert r.values[0][0] == pytest.approx(2.5)
+
+
+def test_empty_table():
+    t = ColumnarTable("e", [ColumnSpec("time", "u32"),
+                            ColumnSpec("v", "u64")])
+    assert execute(t, "SELECT v FROM e").values == []
+    assert execute(t, "SELECT Sum(v) FROM e").values == []
+
+
+def test_errors():
+    t = make_table()
+    with pytest.raises(QueryError):
+        execute(t, "SELECT nope FROM flow")
+    with pytest.raises(QueryError):
+        execute(t, "SELECT Sum(bytes) FROM flow ORDER BY latency")
+
+
+def test_flame_tree():
+    root = build_flame_tree(
+        ["main;a;b", "main;a;c", "main;a;b", "main;d"],
+        [10, 5, 15, 2])
+    assert root.total_value == 32
+    main = root.children["main"]
+    assert main.total_value == 32
+    a = main.children["a"]
+    assert a.total_value == 30
+    assert a.children["b"].self_value == 25
+    assert main.children["d"].self_value == 2
+
+
+def test_profile_flame_tree_from_table():
+    t = ColumnarTable("p", [
+        ColumnSpec("time", "u64"),
+        ColumnSpec("event_type", "enum", ("unknown", "on-cpu", "tpu-device")),
+        ColumnSpec("app_service", "str"),
+        ColumnSpec("profiler", "str"),
+        ColumnSpec("stack", "str"),
+        ColumnSpec("value", "u64"),
+    ])
+    t.append_rows([
+        {"time": 10, "event_type": 1, "app_service": "svc",
+         "profiler": "py", "stack": "m;f", "value": 7},
+        {"time": 20, "event_type": 1, "app_service": "svc",
+         "profiler": "py", "stack": "m;f", "value": 3},
+        {"time": 30, "event_type": 2, "app_service": "svc",
+         "profiler": "xp", "stack": "step;matmul", "value": 100},
+    ])
+    root = profile_flame_tree(t, event_type="on-cpu")
+    assert root.total_value == 10
+    assert root.children["m"].children["f"].self_value == 10
+    root2 = profile_flame_tree(t, event_type="tpu-device")
+    assert root2.children["step"].total_value == 100
+    root3 = profile_flame_tree(t, time_start_ns=15, event_type="on-cpu")
+    assert root3.total_value == 3
+
+
+def test_agg_over_string_column_rejected():
+    t = make_table()
+    with pytest.raises(QueryError):
+        execute(t, "SELECT Sum(svc) FROM flow")
+    # Last over a string is fine
+    r = execute(t, "SELECT Last(svc) FROM flow")
+    assert r.values[0][0] == "cache"

@@ -1,0 +1,85 @@
+import numpy as np
+
+from deepflow_tpu.store import Database, Dictionary
+from deepflow_tpu.store.table import ColumnSpec, ColumnarTable
+
+
+def test_dictionary():
+    d = Dictionary("t")
+    assert d.encode("") == 0
+    a = d.encode("alpha")
+    b = d.encode("beta")
+    assert d.encode("alpha") == a != b
+    assert d.decode(b) == "beta"
+    assert d.lookup("nope") is None
+    ids = d.encode_many(["alpha", "beta", "alpha"])
+    assert ids.tolist() == [a, b, a]
+    assert d.decode_many(ids) == ["alpha", "beta", "alpha"]
+    m = d.match_ids(lambda s: s.startswith("a"))
+    assert m.tolist() == [a]
+
+
+def test_table_append_and_snapshot():
+    t = ColumnarTable("t", [
+        ColumnSpec("time", "u64"),
+        ColumnSpec("name", "str"),
+        ColumnSpec("kind", "enum", ("unknown", "tcp", "udp")),
+        ColumnSpec("value", "f64"),
+    ], chunk_rows=4)
+    for i in range(10):  # one-by-one: chunks seal exactly at chunk_rows
+        t.append_rows([{"time": i, "name": f"n{i % 3}",
+                        "kind": 1 + (i % 2), "value": i * 1.5}])
+    assert len(t) == 10
+    chunks = t.snapshot()
+    assert sum(len(c["time"]) for c in chunks) == 10
+    # sealed chunks of 4 rows + tail buffer
+    assert [len(c["time"]) for c in chunks] == [4, 4, 2]
+    # dictionary encoding: only 3 unique names (+ empty)
+    assert len(t.dicts["name"]) == 4
+    cols = t.column_concat(["name", "value"])
+    assert t.dicts["name"].decode(int(cols["name"][4])) == "n1"
+
+
+def test_table_columns_append_defaults():
+    t = ColumnarTable("t", [
+        ColumnSpec("time", "u64"),
+        ColumnSpec("svc", "str"),
+        ColumnSpec("v", "u32", default=9),
+    ])
+    t.append_columns({"time": np.arange(3), "svc": ["a", "b", "a"]})
+    cols = t.column_concat(["v", "svc"])
+    assert cols["v"].tolist() == [9, 9, 9]
+
+
+def test_trim_before():
+    t = ColumnarTable("t", [ColumnSpec("time", "u64")], chunk_rows=10)
+    for lo in range(0, 25, 10):
+        t.append_rows([{"time": i} for i in range(lo, min(lo + 10, 25))])
+    t.flush()
+    dropped = t.trim_before("time", 10)
+    assert dropped == 10
+    assert len(t.snapshot()) == 2
+
+
+def test_database_schema_tables():
+    db = Database()
+    assert "profile.in_process_profile" in db.tables()
+    assert "profile.tpu_hlo_span" in db.tables()
+    assert "flow_log.l7_flow_log" in db.tables()
+    t = db.table("profile.in_process_profile")
+    t.append_rows([{"time": 1, "stack": "a;b", "value": 5, "count": 1,
+                    "event_type": 1, "app_service": "x"}])
+    assert len(t) == 1
+
+
+def test_save_load(tmp_path):
+    t = ColumnarTable("t", [ColumnSpec("time", "u64"),
+                            ColumnSpec("s", "str")])
+    t.append_rows([{"time": 1, "s": "x"}, {"time": 2, "s": "y"}])
+    t.save(str(tmp_path))
+    t2 = ColumnarTable("t", [ColumnSpec("time", "u64"),
+                             ColumnSpec("s", "str")])
+    t2.load(str(tmp_path))
+    assert len(t2) == 2
+    cols = t2.column_concat(["s"])
+    assert t2.dicts["s"].decode_many(cols["s"]) == ["x", "y"]
