@@ -1,0 +1,6 @@
+"""deepflow-tpu agent: per-host telemetry collection.
+
+Reference analog: agent/src (Rust userspace) + agent/src/ebpf (C). The TPU
+build keeps the same shape — profilers, dispatch/flow pipeline, senders,
+config, sync — with TPU-native probes (tpuprobe/) in place of CUDA uprobes.
+"""

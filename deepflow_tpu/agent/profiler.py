@@ -1,0 +1,142 @@
+"""In-process OnCPU continuous profiler: periodic stack sampling.
+
+Reference analog: the eBPF perf_event profiler chain
+(agent/src/ebpf/kernel/perf_profiler.bpf.c:688 oncpu sampling,
+user/profile/profile_common.c aggregation, stringifier.c:696 folded stacks).
+This is the in-process flavor: a sampler thread walks every Python thread's
+frame stack at `hz`, folds frames into "mod.func" strings, aggregates
+(thread, stack) -> count over an emit window, and hands batches to a sink.
+Double-buffered aggregation mirrors the profiler_output_a/b A/B-swap design.
+
+The out-of-process native sampler (perf_event_open) is a separate component;
+this one covers the primary TPU use case — profiling the JAX workload from
+inside (zero-code via `deepflow-run`).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class ProfileSample:
+    timestamp_ns: int
+    pid: int
+    tid: int
+    thread_name: str
+    stack: str          # folded: root;...;leaf
+    count: int
+    value_us: int       # count * sample period
+    event_type: str = "on-cpu"
+    profiler: str = "pysampler"
+
+
+@dataclass
+class SamplerStats:
+    samples: int = 0
+    emits: int = 0
+    overruns: int = 0   # sampling tick took longer than the period
+    last_emit_stacks: int = 0
+
+
+def fold_frame(frame) -> str:
+    code = frame.f_code
+    mod = frame.f_globals.get("__name__", "?")
+    return f"{mod}.{code.co_name}"
+
+
+def fold_stack(frame, max_depth: int = 128) -> str:
+    """Walk frame -> outermost, emit root;...;leaf."""
+    frames = []
+    depth = 0
+    while frame is not None and depth < max_depth:
+        frames.append(fold_frame(frame))
+        frame = frame.f_back
+        depth += 1
+    return ";".join(reversed(frames))
+
+
+class OnCpuSampler:
+    """99 Hz (default) Python-stack sampler with windowed aggregation."""
+
+    def __init__(self, sink, hz: float = 99.0, emit_interval_s: float = 1.0,
+                 process_name: str = "", app_service: str = "") -> None:
+        self.sink = sink
+        self.period_s = 1.0 / hz
+        self.period_us = int(1_000_000 / hz)
+        self.emit_interval_s = emit_interval_s
+        self.process_name = process_name
+        self.app_service = app_service
+        self.stats = SamplerStats()
+        self._agg: dict[tuple[int, str], int] = {}
+        self._thread_names: dict[int, str] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        import os
+        self.pid = os.getpid()
+
+    def start(self) -> "OnCpuSampler":
+        self._thread = threading.Thread(
+            target=self._run, name="df-oncpu-sampler", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2.0)
+        self._emit()  # flush the tail window
+
+    def _run(self) -> None:
+        my_tid = threading.get_ident()
+        next_tick = time.monotonic()
+        next_emit = next_tick + self.emit_interval_s
+        while not self._stop.is_set():
+            now = time.monotonic()
+            if now >= next_tick:
+                self._sample(my_tid)
+                next_tick += self.period_s
+                if now - next_tick > self.period_s:
+                    # fell behind (GIL contention): skip missed ticks
+                    self.stats.overruns += 1
+                    next_tick = now + self.period_s
+                if now >= next_emit:
+                    self._emit()
+                    next_emit = now + self.emit_interval_s
+            time.sleep(max(0.0, min(next_tick - time.monotonic(),
+                                    self.period_s)))
+
+    def _sample(self, my_tid: int) -> None:
+        names = {t.ident: t.name for t in threading.enumerate()}
+        for tid, frame in sys._current_frames().items():
+            if tid == my_tid:
+                continue
+            stack = fold_stack(frame)
+            if not stack:
+                continue
+            key = (tid, stack)
+            self._agg[key] = self._agg.get(key, 0) + 1
+            self._thread_names[tid] = names.get(tid, str(tid))
+            self.stats.samples += 1
+
+    def _emit(self) -> None:
+        if not self._agg:
+            return
+        agg, self._agg = self._agg, {}  # A/B swap
+        ts = time.time_ns()
+        batch = [
+            ProfileSample(
+                timestamp_ns=ts, pid=self.pid, tid=tid,
+                thread_name=self._thread_names.get(tid, str(tid)),
+                stack=stack, count=n, value_us=n * self.period_us)
+            for (tid, stack), n in agg.items()
+        ]
+        self.stats.emits += 1
+        self.stats.last_emit_stacks = len(batch)
+        try:
+            self.sink(batch)
+        except Exception:
+            pass  # a failing sink must never kill the sampler

@@ -1,0 +1,10 @@
+"""Reference JAX workloads that deepflow-tpu observes.
+
+These are the instrumented subjects of the north-star benchmark configs
+(BASELINE.md: jnp.matmul jit, MaxText-style Llama, ResNet DP) — TPU-first
+implementations (bf16, scan layers, mesh-sharded train steps) that double as
+the framework's flagship models for bench.py and __graft_entry__.py.
+"""
+
+from deepflow_tpu.models.llama import (  # noqa: F401
+    LlamaConfig, init_params, forward, loss_fn, make_train_step)

@@ -1,0 +1,206 @@
+"""Llama-style decoder-only transformer, pure JAX, TPU-first.
+
+Design notes (TPU):
+- bf16 params/activations, f32 softmax + loss: keeps matmuls on the MXU.
+- layers stacked and scanned (lax.scan) -> one compiled layer body.
+- GQA + RoPE, SwiGLU MLP, RMSNorm — the MaxText/Llama recipe.
+- sharding is expressed as PartitionSpec trees over a ('data','fsdp','tensor')
+  mesh; XLA inserts the collectives (psum for tensor-parallel reductions,
+  all-gather for fsdp) — see parallel/mesh.py.
+
+This is a workload-under-observation for the profiler (BASELINE configs 3/5),
+not a port of anything in the reference repo (which contains no ML code).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    d_ff: int = 11008
+    max_seq: int = 2048
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @staticmethod
+    def tiny(**kw) -> "LlamaConfig":
+        d = dict(vocab=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+                 d_ff=128, max_seq=128)
+        d.update(kw)
+        return LlamaConfig(**d)
+
+    @staticmethod
+    def llama7b() -> "LlamaConfig":
+        return LlamaConfig()  # defaults are 7B
+
+
+def init_params(cfg: LlamaConfig, key: jax.Array) -> dict:
+    """Stacked-layer param tree (leading dim = n_layers for scanned blocks)."""
+    k = jax.random.split(key, 8)
+    hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+    L, D, F, V = cfg.n_layers, cfg.d_model, cfg.d_ff, cfg.vocab
+
+    def norm_init(*shape):
+        return jnp.ones(shape, dtype=cfg.dtype)
+
+    def dense_init(key, *shape):
+        scale = 1.0 / np.sqrt(shape[-2])
+        return (jax.random.normal(key, shape, dtype=jnp.float32)
+                * scale).astype(cfg.dtype)
+
+    return {
+        "tok_embed": dense_init(k[0], V, D),
+        "layers": {
+            "attn_norm": norm_init(L, D),
+            "wq": dense_init(k[1], L, D, nh * hd),
+            "wk": dense_init(k[2], L, D, nkv * hd),
+            "wv": dense_init(k[3], L, D, nkv * hd),
+            "wo": dense_init(k[4], L, nh * hd, D),
+            "mlp_norm": norm_init(L, D),
+            "w_gate": dense_init(k[5], L, D, F),
+            "w_up": dense_init(k[6], L, D, F),
+            "w_down": dense_init(k[7], L, F, D),
+        },
+        "final_norm": norm_init(D),
+    }
+
+
+def param_specs(cfg: LlamaConfig) -> dict:
+    """PartitionSpecs over mesh axes ('data','fsdp','tensor').
+
+    Megatron-style: attention heads and MLP hidden dim split on 'tensor';
+    the orthogonal dim sharded on 'fsdp' (ZeRO-3-ish weight sharding).
+    """
+    return {
+        "tok_embed": P("tensor", "fsdp"),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, "fsdp", "tensor"),
+            "wk": P(None, "fsdp", "tensor"),
+            "wv": P(None, "fsdp", "tensor"),
+            "wo": P(None, "tensor", "fsdp"),
+            "mlp_norm": P(None, None),
+            "w_gate": P(None, "fsdp", "tensor"),
+            "w_up": P(None, "fsdp", "tensor"),
+            "w_down": P(None, "tensor", "fsdp"),
+        },
+        "final_norm": P(None),
+    }
+
+
+def _rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    rms = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * rms).astype(x.dtype) * w
+
+
+def _rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x: (B, S, H, hd); cos/sin: (S, hd/2)."""
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(x.dtype)  # rotation in f32, activations stay bf16
+
+
+def rope_tables(cfg: LlamaConfig, seq: int) -> tuple[jax.Array, jax.Array]:
+    hd = cfg.head_dim
+    inv = 1.0 / (cfg.rope_theta ** (np.arange(0, hd, 2) / hd))
+    t = np.arange(seq)
+    freqs = np.outer(t, inv)
+    return (jnp.asarray(np.cos(freqs), dtype=jnp.float32),
+            jnp.asarray(np.sin(freqs), dtype=jnp.float32))
+
+
+def _attention(q, k, v, cfg: LlamaConfig) -> jax.Array:
+    """Causal GQA attention. q: (B,S,H,hd) k,v: (B,S,KV,hd)."""
+    B, S, H, hd = q.shape
+    groups = cfg.n_heads // cfg.n_kv_heads
+    k = jnp.repeat(k, groups, axis=2)
+    v = jnp.repeat(v, groups, axis=2)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
+    mask = jnp.tril(jnp.ones((S, S), dtype=bool))
+    scores = jnp.where(mask[None, None], scores.astype(jnp.float32), -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _layer(cfg: LlamaConfig, cos, sin, x, layer_params):
+    lp = layer_params
+    B, S, D = x.shape
+    hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+
+    h = _rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = (h @ lp["wq"]).reshape(B, S, nh, hd)
+    k = (h @ lp["wk"]).reshape(B, S, nkv, hd)
+    v = (h @ lp["wv"]).reshape(B, S, nkv, hd)
+    q = _rope(q, cos, sin)
+    k = _rope(k, cos, sin)
+    attn = _attention(q, k, v, cfg).reshape(B, S, nh * hd)
+    x = x + attn @ lp["wo"]
+
+    h = _rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
+    x = x + (gate * (h @ lp["w_up"])) @ lp["w_down"]
+    return x, None
+
+
+def forward(cfg: LlamaConfig, params: dict, tokens: jax.Array) -> jax.Array:
+    """tokens (B, S) int32 -> logits (B, S, V) f32."""
+    B, S = tokens.shape
+    cos, sin = rope_tables(cfg, S)
+    x = params["tok_embed"][tokens]
+    body = partial(_layer, cfg, cos, sin)
+    x, _ = jax.lax.scan(
+        lambda carry, lp: body(carry, lp), x, params["layers"])
+    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    # tied embeddings for the LM head
+    logits = jnp.einsum("bsd,vd->bsv", x, params["tok_embed"])
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(cfg: LlamaConfig, params: dict, tokens: jax.Array) -> jax.Array:
+    """Next-token cross-entropy over tokens[:, :-1] -> tokens[:, 1:]."""
+    logits = forward(cfg, params, tokens[:, :-1])
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def make_train_step(cfg: LlamaConfig, optimizer=None):
+    """Returns (train_step, init_opt_state). SGD-with-momentum by default to
+    keep opt-state memory light; pass any optax optimizer instead."""
+    import optax
+    if optimizer is None:
+        optimizer = optax.sgd(3e-4, momentum=0.9)
+
+    def init_opt_state(params):
+        return optimizer.init(params)
+
+    def train_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(cfg, p, tokens))(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return train_step, init_opt_state

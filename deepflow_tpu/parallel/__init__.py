@@ -1,0 +1,11 @@
+"""Mesh/sharding utilities and sequence-parallel primitives.
+
+The observability pipeline itself is host-side; this package exists because
+deepflow-tpu ships TPU-first reference workloads (models/) whose dp/fsdp/tp/sp
+shardings the probes observe — and because the driver dry-runs our multi-chip
+training path over a virtual mesh.
+"""
+
+from deepflow_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh, shard_params, factor_devices)
+from deepflow_tpu.parallel.ring_attention import ring_attention  # noqa: F401

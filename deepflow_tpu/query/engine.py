@@ -8,7 +8,7 @@ pushed down onto the (small) dictionaries rather than the rows.
 
 from __future__ import annotations
 
-import fnmatch
+import re
 from dataclasses import dataclass
 
 import numpy as np
@@ -82,8 +82,17 @@ def _collect_cols(e, out: set) -> None:
 
 
 def _like_to_pred(pattern: str):
-    pat = pattern.replace("%", "*").replace("_", "?")
-    return lambda s: fnmatch.fnmatchcase(s, pat)
+    """SQL LIKE: % and _ are wildcards, everything else literal."""
+    parts = []
+    for ch in pattern:
+        if ch == "%":
+            parts.append(".*")
+        elif ch == "_":
+            parts.append(".")
+        else:
+            parts.append(re.escape(ch))
+    rx = re.compile("^" + "".join(parts) + "$", re.DOTALL)
+    return lambda s: rx.match(s) is not None
 
 
 class _Env:
@@ -152,10 +161,10 @@ class _Env:
             rv_raw = e.right
             if isinstance(rv_raw, S.Lit) and isinstance(rv_raw.value, str):
                 code = self._coerce_lit(lv, rv_raw.value)
-                r = np.asarray(code)
+                l, r = lv.arr, np.asarray(code)
             else:
-                r = self.eval(rv_raw).arr
-            l = lv.arr
+                rv = self.eval(rv_raw)
+                l, r = self._align_encoded(lv, rv, op)
             res = {"=": l.__eq__, "!=": l.__ne__, "<": l.__lt__,
                    "<=": l.__le__, ">": l.__gt__, ">=": l.__ge__}[op](r)
             return _Val(res, "bool")
@@ -174,6 +183,39 @@ class _Env:
                 out = np.where(r != 0, l / np.where(r == 0, 1, r), 0.0)
             return _Val(out)
         raise QueryError(f"unknown op {op}")
+
+    def _align_encoded(self, lv: _Val, rv: _Val, op: str):
+        """Align two columns for comparison. Dictionary-encoded codes from
+        *different* dictionaries are not comparable — remap the right side's
+        ids into the left dictionary via the (small) unique-id set."""
+        enc_l = lv.kind in ("str", "enum")
+        enc_r = rv.kind in ("str", "enum")
+        if not (enc_l or enc_r):
+            return lv.arr, rv.arr
+        if op not in ("=", "!="):
+            raise QueryError(
+                "ordered comparison between string columns is not supported")
+        if lv.kind == "str" and rv.kind == "str":
+            if lv.dict_ is rv.dict_:
+                return lv.arr, rv.arr
+            uniq = np.unique(rv.arr)
+            sentinel = np.uint32(0xFFFFFFFF)
+            remap = {int(u): (lambda s: np.uint32(s) if s is not None
+                              else sentinel)(lv.dict_.lookup(rv.dict_.decode(int(u))))
+                     for u in uniq}
+            mapped = np.array([remap[int(c)] for c in rv.arr],
+                              dtype=np.uint32)
+            return lv.arr, mapped
+        if lv.kind == "enum" and rv.kind == "enum":
+            if lv.labels == rv.labels:
+                return lv.arr, rv.arr
+            remap = {i: (rv.labels.index(s) if s in rv.labels else 0xFFFF)
+                     for i, s in enumerate(lv.labels)}
+            mapped = np.array([remap[int(c)] for c in lv.arr],
+                              dtype=np.uint16)
+            return mapped, rv.arr
+        raise QueryError(
+            f"cannot compare {lv.kind} column with {rv.kind} column")
 
     def _coerce_lit(self, lv: _Val, value):
         """Translate a literal to the column's encoded space."""
@@ -227,6 +269,8 @@ def _agg_eval(e, env: _Env, order: np.ndarray, bounds: np.ndarray) -> _Val:
                 v2.arr = v.arr[order][ends - 1] if len(a) else v.arr
             return v2
         if e.name == "PERCENTILE":
+            if len(e.args) != 2:
+                raise QueryError("Percentile(col, p) takes 2 args")
             p = float(env.eval(e.args[1]).arr)
             out = np.empty(len(starts), dtype=np.float64)
             for i, (s0, e0) in enumerate(zip(starts, ends)):
@@ -279,17 +323,23 @@ def execute(table: ColumnarTable, query: S.Select | str) -> QueryResult:
 
     # filter per chunk, then materialize needed columns
     chunks = table.snapshot()
+    chunk_sizes = [len(next(iter(ch.values()))) if ch else 0 for ch in chunks]
     if query.where is not None:
         masks = []
-        for ch in chunks:
+        for ch, sz in zip(chunks, chunk_sizes):
             env = _Env(table, ch)
-            masks.append(env.eval(query.where).arr.astype(bool))
+            m = env.eval(query.where).arr
+            if m.ndim == 0:  # WHERE with no column refs: scalar condition
+                m = np.full(sz, bool(m))
+            masks.append(m.astype(bool))
+        n_rows = int(sum(m.sum() for m in masks))
         cols = {}
         for name in needed:
             parts = [ch[name][m] for ch, m in zip(chunks, masks)]
             cols[name] = (np.concatenate(parts) if parts else
                           np.empty(0, dtype=table.columns[name].np_dtype))
     else:
+        n_rows = int(sum(chunk_sizes))
         cols = {}
         for name in needed:
             parts = [ch[name] for ch in chunks]
@@ -302,9 +352,13 @@ def execute(table: ColumnarTable, query: S.Select | str) -> QueryResult:
 
     names = [i.alias or S.expr_name(i.expr) for i in query.items]
     if not is_agg:
-        outs = [env.eval(i.expr) for i in query.items]
+        outs = []
+        for i in query.items:
+            v = env.eval(i.expr)
+            if v.arr.ndim == 0:  # bare literal: broadcast over rows
+                v = _Val(np.full(n_rows, v.arr.item()), v.kind)
+            outs.append(v)
     else:
-        n_rows = len(next(iter(cols.values()))) if cols else 0
         if query.group_by:
             key_vals = [env.eval(g) for g in query.group_by]
             if n_rows == 0:
@@ -322,12 +376,16 @@ def execute(table: ColumnarTable, query: S.Select | str) -> QueryResult:
             # one group over all rows; zero rows -> zero groups
             order = np.arange(n_rows)
             bounds = np.zeros(1 if n_rows else 0, dtype=np.int64)
-        outs = [_agg_eval(i.expr, env, order, bounds) for i in query.items]
+        n_groups = len(bounds)
+        outs = []
+        for i in query.items:
+            v = _agg_eval(i.expr, env, order, bounds)
+            if v.arr.ndim == 0:  # bare literal: broadcast over groups
+                v = _Val(np.full(n_groups, v.arr.item()), v.kind)
+            outs.append(v)
 
     decoded = [v.decoded() for v in outs]
     n_out = max((len(d) for d in decoded), default=0)
-    # broadcast scalars (e.g. literals)
-    decoded = [d if len(d) == n_out else list(d) * n_out for d in decoded]
     rows = [list(r) for r in zip(*decoded)] if n_out else []
 
     # ORDER BY over output columns

@@ -83,6 +83,11 @@ class ColumnarTable:
         """Column-oriented append (fast path for decoders)."""
         if n is None:
             n = len(next(iter(cols.values())))
+        for name, v in cols.items():
+            if len(v) != n:
+                raise ValueError(
+                    f"{self.name}: column {name!r} has {len(v)} values, "
+                    f"expected {n}")
         if n == 0:
             return
         with self._lock:
@@ -131,10 +136,19 @@ class ColumnarTable:
         return chunks
 
     def column_concat(self, names: list[str],
-                      mask_chunks: list[np.ndarray] | None = None
+                      mask_chunks: list[np.ndarray] | None = None,
+                      chunks: list[dict[str, np.ndarray]] | None = None
                       ) -> dict[str, np.ndarray]:
-        """Materialize selected columns (optionally per-chunk filtered)."""
-        chunks = self.snapshot()
+        """Materialize selected columns (optionally per-chunk filtered).
+
+        When mask_chunks were computed against an earlier snapshot, pass that
+        snapshot via `chunks` — a writer may seal new chunks in between.
+        """
+        if chunks is None:
+            chunks = self.snapshot()
+        if mask_chunks is not None and len(mask_chunks) != len(chunks):
+            raise ValueError("mask_chunks/chunks length mismatch — compute "
+                             "both from the same snapshot")
         out: dict[str, np.ndarray] = {}
         for name in names:
             spec = self.columns[name]

@@ -1,11 +1,18 @@
 """Test config: force JAX onto a virtual 8-device CPU mesh so sharding
-paths compile and run without TPU hardware."""
+paths compile and run without TPU hardware.
+
+The image's sitecustomize registers the real TPU ("axon" platform) and
+forces jax_platforms at interpreter start, so the env var alone is not
+enough — override via jax.config after import.
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,106 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepflow_tpu.models.llama import (
+    LlamaConfig, forward, init_params, loss_fn, make_train_step, param_specs)
+from deepflow_tpu.parallel import make_mesh, ring_attention, shard_params
+from deepflow_tpu.parallel.mesh import factor_devices, named_sharding_tree
+
+
+def test_factor_devices():
+    assert factor_devices(8) == (1, 2, 4)
+    assert factor_devices(1) == (1, 1, 1)
+    assert factor_devices(16) == (1, 4, 4)
+    for n in (1, 2, 4, 8, 16, 64):
+        d, f, t = factor_devices(n)
+        assert d * f * t == n
+
+
+def test_forward_shapes_and_loss():
+    cfg = LlamaConfig.tiny()
+    params = init_params(cfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, cfg.vocab)
+    logits = forward(cfg, params, tokens)
+    assert logits.shape == (2, 16, cfg.vocab)
+    assert logits.dtype == jnp.float32
+    loss = loss_fn(cfg, params, tokens)
+    assert np.isfinite(float(loss))
+    # fresh init should be near uniform
+    assert float(loss) == pytest.approx(np.log(cfg.vocab), rel=0.2)
+
+
+def test_train_step_learns():
+    cfg = LlamaConfig.tiny()
+    params = init_params(cfg, jax.random.key(0))
+    train_step, init_opt = make_train_step(cfg)
+    step = jax.jit(train_step)
+    opt_state = init_opt(params)
+    tokens = jax.random.randint(jax.random.key(1), (4, 32), 0, cfg.vocab)
+    losses = []
+    for _ in range(10):
+        params, opt_state, loss = step(params, opt_state, tokens)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]  # memorizing one batch
+
+
+def test_sharded_train_step_8dev():
+    """Full dp/fsdp/tp sharded training step on the virtual 8-device mesh."""
+    cfg = LlamaConfig.tiny()
+    mesh = make_mesh()  # 8 cpu devices -> (1, 2, 4)
+    assert mesh.devices.size == 8
+    params = init_params(cfg, jax.random.key(0))
+    specs = param_specs(cfg)
+    params = shard_params(params, specs, mesh)
+    train_step, init_opt = make_train_step(cfg)
+    opt_state = init_opt(params)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    tok_sharding = NamedSharding(mesh, P("data", None))
+    step = jax.jit(train_step)
+    tokens = jax.device_put(
+        jax.random.randint(jax.random.key(1), (4, 32), 0, cfg.vocab),
+        tok_sharding)
+    params2, opt_state, loss = step(params, opt_state, tokens)
+    assert np.isfinite(float(loss))
+    # params keep their sharding through the step
+    wq = params2["layers"]["wq"]
+    assert wq.sharding.spec == specs["layers"]["wq"]
+
+
+def test_ring_attention_matches_full():
+    from jax.sharding import Mesh
+    devs = np.asarray(jax.devices()[:4]).reshape(4)
+    mesh = Mesh(devs, ("sp",))
+    B, S, H, hd = 2, 32, 4, 16
+    k1, k2, k3 = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(k1, (B, S, H, hd), dtype=jnp.float32)
+    k = jax.random.normal(k2, (B, S, H, hd), dtype=jnp.float32)
+    v = jax.random.normal(k3, (B, S, H, hd), dtype=jnp.float32)
+
+    out_ring = ring_attention(q, k, v, mesh, axis="sp", causal=True)
+
+    # dense causal reference
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
+    mask = jnp.tril(jnp.ones((S, S), dtype=bool))
+    scores = jnp.where(mask[None, None], scores, -1e30)
+    ref = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(scores, -1), v)
+
+    np.testing.assert_allclose(np.asarray(out_ring), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_non_causal():
+    from jax.sharding import Mesh
+    devs = np.asarray(jax.devices()[:8]).reshape(8)
+    mesh = Mesh(devs, ("sp",))
+    B, S, H, hd = 1, 64, 2, 8
+    k1, k2, k3 = jax.random.split(jax.random.key(7), 3)
+    q = jax.random.normal(k1, (B, S, H, hd), dtype=jnp.float32)
+    k = jax.random.normal(k2, (B, S, H, hd), dtype=jnp.float32)
+    v = jax.random.normal(k3, (B, S, H, hd), dtype=jnp.float32)
+    out = ring_attention(q, k, v, mesh, axis="sp", causal=False)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
+    ref = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(scores, -1), v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)

@@ -1,0 +1,56 @@
+import threading
+import time
+
+from deepflow_tpu.agent.profiler import OnCpuSampler, fold_stack
+
+
+def busy_work(stop):
+    while not stop.is_set():
+        sum(i * i for i in range(1000))
+
+
+def test_sampler_collects_folded_stacks():
+    batches = []
+    stop = threading.Event()
+    worker = threading.Thread(target=busy_work, args=(stop,),
+                              name="busy-worker")
+    worker.start()
+    s = OnCpuSampler(batches.append, hz=200.0, emit_interval_s=0.2).start()
+    time.sleep(1.0)
+    s.stop()
+    stop.set()
+    worker.join()
+
+    assert s.stats.samples > 50
+    assert batches, "no batches emitted"
+    samples = [p for b in batches for p in b]
+    # the busy thread must show up with a stack ending in busy_work
+    busy = [p for p in samples if p.thread_name == "busy-worker"]
+    assert busy
+    assert any("busy_work" in p.stack for p in busy)
+    # folded format: root;...;leaf with module-qualified frames
+    st = busy[0].stack
+    assert ";" in st and st.split(";")[-1].startswith(("test_profiler", "<"))
+    # value accounting: value_us == count * period
+    for p in samples:
+        assert p.value_us == p.count * s.period_us
+
+
+def test_sampler_sink_failure_does_not_kill():
+    def bad_sink(batch):
+        raise RuntimeError("boom")
+    s = OnCpuSampler(bad_sink, hz=100.0, emit_interval_s=0.05).start()
+    time.sleep(0.3)
+    s.stop()
+    assert s.stats.emits >= 1  # kept emitting despite sink failures
+
+
+def test_fold_stack_depth_cap():
+    def deep(n):
+        if n == 0:
+            import sys
+            frame = sys._current_frames()[threading.get_ident()]
+            return fold_stack(frame, max_depth=16)
+        return deep(n - 1)
+    st = deep(50)
+    assert len(st.split(";")) == 16

@@ -150,3 +150,49 @@ def test_agg_over_string_column_rejected():
     # Last over a string is fine
     r = execute(t, "SELECT Last(svc) FROM flow")
     assert r.values[0][0] == "cache"
+
+
+def test_count_star_without_columns():
+    t = make_table()
+    r = execute(t, "SELECT Count(*) AS n FROM flow")
+    assert r.values == [[6.0]]
+    r = execute(t, "SELECT Count(*) AS n FROM flow WHERE proto = 'udp'")
+    assert r.values == [[2.0]]
+
+
+def test_literal_in_select():
+    t = make_table()
+    r = execute(t, "SELECT 5 AS c, svc FROM flow LIMIT 2")
+    assert [row[0] for row in r.values] == [5, 5]
+    r = execute(t, "SELECT Sum(bytes) AS b, 7 AS c FROM flow")
+    assert r.values[0][1] == 7
+
+
+def test_str_col_vs_str_col_comparison():
+    t = ColumnarTable("f", [ColumnSpec("a", "str"), ColumnSpec("b", "str"),
+                            ColumnSpec("v", "u32")])
+    # encode order differs between the two dictionaries on purpose
+    t.append_rows([
+        {"a": "x", "b": "y", "v": 1},
+        {"a": "y", "b": "y", "v": 2},
+        {"a": "z", "b": "x", "v": 3},
+    ])
+    r = execute(t, "SELECT v FROM f WHERE a = b")
+    assert r.column("v") == [2]
+    r = execute(t, "SELECT v FROM f WHERE a != b")
+    assert sorted(r.column("v")) == [1, 3]
+
+
+def test_like_metacharacters_literal():
+    t = ColumnarTable("f", [ColumnSpec("s", "str")])
+    t.append_rows([{"s": "foo[1]bar"}, {"s": "foo1bar"}, {"s": "a.b*c"}])
+    r = execute(t, "SELECT s FROM f WHERE s LIKE 'foo[1]%'")
+    assert r.column("s") == ["foo[1]bar"]
+    r = execute(t, "SELECT s FROM f WHERE s LIKE 'a.b*%'")
+    assert r.column("s") == ["a.b*c"]
+
+
+def test_percentile_arity_error():
+    t = make_table()
+    with pytest.raises(QueryError):
+        execute(t, "SELECT Percentile(latency) FROM flow")

@@ -83,3 +83,11 @@ def test_save_load(tmp_path):
     assert len(t2) == 2
     cols = t2.column_concat(["s"])
     assert t2.dicts["s"].decode_many(cols["s"]) == ["x", "y"]
+
+
+def test_append_columns_ragged_rejected():
+    import pytest
+    t = ColumnarTable("t", [ColumnSpec("a", "u32"), ColumnSpec("b", "u32")])
+    with pytest.raises(ValueError):
+        t.append_columns({"a": [1, 2, 3], "b": [10, 20]})
+    assert len(t) == 0
