@@ -1,0 +1,191 @@
+"""Agent runtime: wires profilers + probes + stats into the uniform sender.
+
+Reference analog: agent/src/trident.rs (Components wiring) — scaled to the
+round-1 component set: OnCPU sampler, TPU probe, self-stats. Runs standalone
+(no controller, reference `--standalone` mode) or controller-managed once the
+sync plane lands.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import threading
+import time
+
+from deepflow_tpu.agent.config import AgentConfig
+from deepflow_tpu.agent.profiler import OnCpuSampler, ProfileSample
+from deepflow_tpu.agent.sender import UniformSender
+from deepflow_tpu.codec import MessageType
+from deepflow_tpu.proto import pb
+
+log = logging.getLogger("df.agent")
+
+
+class Agent:
+    def __init__(self, config: AgentConfig | None = None) -> None:
+        self.config = config or AgentConfig()
+        self.process_name = os.path.basename(sys.argv[0]) or "python"
+        self.app_service = self.config.app_service or self.process_name
+        self.sender = UniformSender(
+            self.config.sender.servers, agent_id=self.config.agent_id,
+            queue_size=self.config.sender.queue_size)
+        self.sampler: OnCpuSampler | None = None
+        self.tpuprobe = None
+        self._stats_thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._components: list[str] = []
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "Agent":
+        self.sender.start()
+        self._components.append("sender")
+        if self.config.profiler.enabled:
+            self.sampler = OnCpuSampler(
+                self._profile_sink,
+                hz=self.config.profiler.sample_hz,
+                emit_interval_s=self.config.profiler.emit_interval_s,
+                process_name=self.process_name,
+                app_service=self.app_service).start()
+            self._components.append("oncpu-sampler")
+        if self.config.tpuprobe.enabled:
+            try:
+                from deepflow_tpu.tpuprobe.probe import TpuProbe
+                self.tpuprobe = TpuProbe(self).start()
+                self._components.append("tpuprobe")
+            except ImportError:
+                log.debug("tpuprobe unavailable")
+        self._stats_thread = threading.Thread(
+            target=self._stats_loop, name="df-agent-stats", daemon=True)
+        self._stats_thread.start()
+        self._components.append("stats")
+        log.info("agent started: %s", ", ".join(self._components))
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self.sampler:
+            self.sampler.stop()
+        if self.tpuprobe:
+            self.tpuprobe.stop()
+        self._emit_stats()  # final stats flush
+        self.sender.flush_and_stop()
+
+    # -- sinks ---------------------------------------------------------------
+
+    def _profile_sink(self, batch: list[ProfileSample]) -> None:
+        out = pb.ProfileBatch()
+        for s in batch:
+            p = out.profiles.add()
+            p.process_name = self.process_name
+            p.app_service = self.app_service
+            p.pid = s.pid
+            p.tid = s.tid & 0xFFFFFFFF
+            p.thread_name = s.thread_name
+            p.event_type = _EVENT_TYPES.get(s.event_type, pb.ON_CPU)
+            p.timestamp_ns = s.timestamp_ns
+            p.stack = s.stack.encode()
+            p.value = s.value_us
+            p.count = s.count
+            p.profiler = s.profiler
+        self.sender.send(MessageType.PROFILE, out.SerializeToString())
+
+    def send_tpu_spans(self, spans_pb: "pb.TpuSpanBatch") -> None:
+        self.sender.send(MessageType.TPU_SPAN, spans_pb.SerializeToString())
+
+    # -- self-telemetry (reference: agent/src/utils/stats.rs -> dfstats) -----
+
+    def _stats_loop(self) -> None:
+        while not self._stop.wait(self.config.stats_interval_s):
+            self._emit_stats()
+
+    def _emit_stats(self) -> None:
+        batch = pb.StatsBatch()
+        ts = time.time_ns()
+
+        def metric(name: str, values: dict) -> None:
+            m = batch.metrics.add()
+            m.name = name
+            m.timestamp_ns = ts
+            m.tags["process"] = self.process_name
+            for k, v in values.items():
+                m.values[k] = float(v)
+
+        metric("agent.sender", self.sender.stats)
+        if self.sampler:
+            st = self.sampler.stats
+            metric("agent.oncpu_sampler", {
+                "samples": st.samples, "emits": st.emits,
+                "overruns": st.overruns})
+        if self.tpuprobe is not None:
+            metric("agent.tpuprobe", self.tpuprobe.stats)
+        self.sender.send(MessageType.DFSTATS, batch.SerializeToString())
+
+
+_EVENT_TYPES = {
+    "on-cpu": pb.ON_CPU,
+    "off-cpu": pb.OFF_CPU,
+    "mem-alloc": pb.MEM_ALLOC,
+    "tpu-device": pb.TPU_DEVICE,
+    "tpu-host": pb.TPU_HOST,
+}
+
+_GLOBAL_AGENT: Agent | None = None
+
+
+def attach(app_service: str = "", servers: list | None = None,
+           **overrides) -> Agent:
+    """In-process zero-code attach: start an agent inside the current
+    process (used by `deepflow-run` and direct instrumentation)."""
+    global _GLOBAL_AGENT
+    if _GLOBAL_AGENT is not None:
+        return _GLOBAL_AGENT
+    cfg = AgentConfig()
+    if app_service:
+        cfg.app_service = app_service
+    if servers:
+        cfg.sender.servers = servers
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    _GLOBAL_AGENT = Agent(cfg).start()
+    return _GLOBAL_AGENT
+
+
+def detach() -> None:
+    global _GLOBAL_AGENT
+    if _GLOBAL_AGENT is not None:
+        _GLOBAL_AGENT.stop()
+        _GLOBAL_AGENT = None
+
+
+def main() -> None:
+    import argparse
+    parser = argparse.ArgumentParser(description="deepflow-tpu agent")
+    parser.add_argument("-f", "--config", default=None)
+    parser.add_argument("--standalone", action="store_true")
+    parser.add_argument("--server", default=None,
+                        help="host:port (overrides config when given)")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args()
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    cfg = AgentConfig.load(args.config)
+    if args.standalone:
+        cfg.standalone = True
+        cfg.controller = ""
+    if args.server is not None:
+        from deepflow_tpu.agent.config import _parse_addr
+        cfg.sender.servers = [_parse_addr(args.server)]
+    agent = Agent(cfg).start()
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        agent.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,82 @@
+"""Agent configuration.
+
+Reference analog: agent/src/config (static UserConfig + controller-pushed
+RuntimeConfig, hot-applied by ConfigHandler callbacks). Round-1 surface: a
+typed dataclass loadable from YAML, controller push lands with the control
+plane.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from dataclasses import dataclass, field
+
+
+@dataclass
+class ProfilerConfig:
+    enabled: bool = True
+    sample_hz: float = 99.0
+    emit_interval_s: float = 1.0
+
+
+@dataclass
+class TpuProbeConfig:
+    enabled: bool = True
+    source: str = "auto"          # auto | xplane | hooks | sim
+    trace_interval_s: float = 10.0  # xplane capture cadence
+    trace_duration_ms: int = 1000
+
+
+@dataclass
+class SenderConfig:
+    servers: list = field(default_factory=lambda: [("127.0.0.1", 20033)])
+    queue_size: int = 8192
+
+
+@dataclass
+class AgentConfig:
+    agent_id: int = 0
+    app_service: str = ""
+    controller: str = ""          # host:port; empty = standalone mode
+    standalone: bool = True
+    profiler: ProfilerConfig = field(default_factory=ProfilerConfig)
+    tpuprobe: TpuProbeConfig = field(default_factory=TpuProbeConfig)
+    sender: SenderConfig = field(default_factory=SenderConfig)
+    stats_interval_s: float = 10.0
+    sync_interval_s: float = 10.0
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "AgentConfig":
+        cfg = cls()
+        if isinstance(d.get("profiler"), dict):
+            cfg.profiler = ProfilerConfig(**d["profiler"])
+        if isinstance(d.get("tpuprobe"), dict):
+            cfg.tpuprobe = TpuProbeConfig(**d["tpuprobe"])
+        if isinstance(d.get("sender"), dict):
+            sd = dict(d["sender"])
+            if "servers" in sd:
+                sd["servers"] = [
+                    tuple(x) if isinstance(x, (list, tuple))
+                    else _parse_addr(x) for x in sd["servers"]]
+            cfg.sender = SenderConfig(**sd)
+        for f in dataclasses.fields(cls):
+            if f.name in ("profiler", "tpuprobe", "sender"):
+                continue
+            if f.name in d:
+                setattr(cfg, f.name, d[f.name])
+        return cfg
+
+    @classmethod
+    def load(cls, path: str | None = None) -> "AgentConfig":
+        if path is None or not os.path.exists(path):
+            return cls()
+        import yaml
+        with open(path) as f:
+            data = yaml.safe_load(f) or {}
+        return cls.from_dict(data)
+
+
+def _parse_addr(s: str) -> tuple[str, int]:
+    host, _, port = s.rpartition(":")
+    return (host or "127.0.0.1", int(port))

@@ -1,0 +1,121 @@
+"""UniformSender: batched, framed, reconnecting TCP telemetry sender.
+
+Reference analog: agent/src/sender/uniform_sender.rs (Header prepend
+:149-210, batching, compression, server failover).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import socket
+import threading
+import time
+
+from deepflow_tpu.codec import FrameHeader, MessageType, encode_frame
+
+log = logging.getLogger("df.sender")
+
+
+class UniformSender:
+    """One TCP connection shipping frames for all message types.
+
+    Thread-safe send(): enqueue (msg_type, payload); a background thread
+    frames and writes, reconnecting with exponential backoff across the
+    configured server list (failover, like the reference's sender)."""
+
+    def __init__(self, servers: list[tuple[str, int]], agent_id: int = 0,
+                 org_id: int = 0, team_id: int = 0, queue_size: int = 8192,
+                 connect_timeout: float = 3.0) -> None:
+        if not servers:
+            raise ValueError("need at least one server address")
+        from deepflow_tpu.agent.config import _parse_addr
+        self.servers = [_parse_addr(s) if isinstance(s, str) else tuple(s)
+                        for s in servers]
+        self.agent_id = agent_id
+        self.org_id = org_id
+        self.team_id = team_id
+        self.connect_timeout = connect_timeout
+        self._q: queue.Queue = queue.Queue(maxsize=queue_size)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._sock: socket.socket | None = None
+        self._server_idx = 0
+        self.stats = {"sent_frames": 0, "sent_bytes": 0, "dropped": 0,
+                      "reconnects": 0, "errors": 0}
+
+    def start(self) -> "UniformSender":
+        self._thread = threading.Thread(
+            target=self._run, name="df-uniform-sender", daemon=True)
+        self._thread.start()
+        return self
+
+    def send(self, msg_type: MessageType, payload: bytes) -> bool:
+        try:
+            self._q.put_nowait((msg_type, payload))
+            return True
+        except queue.Full:
+            self.stats["dropped"] += 1
+            return False
+
+    def flush_and_stop(self, timeout: float = 5.0) -> None:
+        deadline = time.monotonic() + timeout
+        while not self._q.empty() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2.0)
+        self._close()
+
+    def _close(self) -> None:
+        if self._sock:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _connect(self) -> bool:
+        """Try servers round-robin starting at the current index."""
+        for i in range(len(self.servers)):
+            host, port = self.servers[(self._server_idx + i)
+                                      % len(self.servers)]
+            try:
+                s = socket.create_connection(
+                    (host, port), timeout=self.connect_timeout)
+                s.settimeout(10.0)
+                self._sock = s
+                self._server_idx = (self._server_idx + i) % len(self.servers)
+                self.stats["reconnects"] += 1
+                return True
+            except OSError:
+                continue
+        return False
+
+    def _run(self) -> None:
+        backoff = 0.1
+        while not self._stop.is_set():
+            if self._sock is None:
+                if not self._connect():
+                    time.sleep(min(backoff, 5.0))
+                    backoff *= 2
+                    continue
+                backoff = 0.1
+            try:
+                msg_type, payload = self._q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            frame = encode_frame(
+                FrameHeader(msg_type, agent_id=self.agent_id,
+                            org_id=self.org_id, team_id=self.team_id),
+                payload)
+            try:
+                self._sock.sendall(frame)
+                self.stats["sent_frames"] += 1
+                self.stats["sent_bytes"] += len(frame)
+            except OSError as e:
+                # the frame is lost; rotate to the next server
+                self.stats["errors"] += 1
+                log.warning("send failed (%s); reconnecting", e)
+                self._close()
+                self._server_idx = (self._server_idx + 1) % len(self.servers)

@@ -1,0 +1,9 @@
+"""deepflow-tpu server: one process running ingester + querier + controller.
+
+Reference analog: server/cmd/server/main.go:112-115 (one Go binary, three
+logical services). Here: receiver (framed TCP :20033) -> per-type decoder
+queues -> tag injection -> columnar store; querier HTTP (:20416); controller
+gRPC (:20035).
+"""
+
+from deepflow_tpu.server.server import Server  # noqa: F401

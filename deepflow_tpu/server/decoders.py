@@ -1,0 +1,345 @@
+"""Per-message-type decoders: pb payloads -> tag-injected store rows.
+
+Reference analog: server/ingester/*/decoder (e.g. profile/decoder/decoder.go
+:190 handleProfileData, flow_log/decoder/decoder.go:151 Run). Each decoder
+owns one receiver queue, runs on its own thread, and writes columnar batches.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import threading
+
+from deepflow_tpu.codec import FrameHeader, MessageType
+from deepflow_tpu.proto import pb
+from deepflow_tpu.store.db import Database
+from deepflow_tpu.store.schema import (
+    L4_PROTOS, L7_PROTOS, PROFILE_EVENT_TYPES, RESPONSE_STATUS,
+    TPU_SPAN_KINDS, CLOSE_TYPES)
+from deepflow_tpu.server.platform_info import PlatformInfoTable
+
+log = logging.getLogger("df.decoder")
+
+
+class Decoder:
+    """Base: drain one queue, decode, write. Subclasses set MSG_TYPE."""
+
+    MSG_TYPE: MessageType
+
+    def __init__(self, q: queue.Queue, db: Database,
+                 platform: PlatformInfoTable) -> None:
+        self.q = q
+        self.db = db
+        self.platform = platform
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.stats = {"batches": 0, "rows": 0, "errors": 0}
+
+    def start(self) -> "Decoder":
+        self._thread = threading.Thread(
+            target=self._run, name=f"df-decoder-{self.MSG_TYPE.name}",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2.0)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                header, payload = self.q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            try:
+                n = self.handle(header, payload)
+                self.stats["batches"] += 1
+                self.stats["rows"] += n
+            except Exception:
+                self.stats["errors"] += 1
+                log.exception("decode error (%s)", self.MSG_TYPE.name)
+
+    def handle(self, header: FrameHeader, payload: bytes) -> int:
+        raise NotImplementedError
+
+
+class ProfileDecoder(Decoder):
+    """ProfileBatch -> profile.in_process_profile."""
+
+    MSG_TYPE = MessageType.PROFILE
+
+    def handle(self, header: FrameHeader, payload: bytes) -> int:
+        batch = pb.ProfileBatch.FromString(payload)
+        tags = self.platform.tags_for(header.agent_id)
+        table = self.db.table("profile.in_process_profile")
+        rows = []
+        for p in batch.profiles:
+            rows.append({
+                "time": p.timestamp_ns,
+                "app_service": p.app_service or p.process_name,
+                "process_name": p.process_name,
+                "event_type": int(p.event_type),
+                "profiler": p.profiler,
+                "pid": p.pid,
+                "tid": p.tid,
+                "thread_name": p.thread_name,
+                "stack": p.stack.decode("utf-8", "replace"),
+                "value": p.value,
+                "count": p.count,
+                **tags,
+            })
+        table.append_rows(rows)
+        return len(rows)
+
+
+class TpuSpanDecoder(Decoder):
+    """TpuSpanBatch -> profile.tpu_hlo_span."""
+
+    MSG_TYPE = MessageType.TPU_SPAN
+
+    def handle(self, header: FrameHeader, payload: bytes) -> int:
+        batch = pb.TpuSpanBatch.FromString(payload)
+        tags = self.platform.tags_for(header.agent_id)
+        table = self.db.table("profile.tpu_hlo_span")
+        rows = []
+        for s in batch.spans:
+            rows.append({
+                "time": s.start_ns,
+                "duration_ns": s.duration_ns,
+                "device_id": s.device_id,
+                "chip_id": s.chip_id,
+                "core_id": s.core_id,
+                "kind": int(s.kind),
+                "hlo_module": s.hlo_module,
+                "hlo_op": s.hlo_op,
+                "hlo_category": s.hlo_category,
+                "flops": s.flops,
+                "bytes_accessed": s.bytes_accessed,
+                "program_id": s.program_id,
+                "run_id": s.run_id,
+                "collective": s.collective,
+                "bytes_transferred": s.bytes_transferred,
+                "replica_group_size": s.replica_group_size,
+                "step": s.step,
+                "pid": s.pid,
+                "process_name": s.process_name,
+                "app_service": s.process_name,
+                **{**tags, "slice_id": s.slice_id or tags.get("slice_id", 0)},
+            })
+        table.append_rows(rows)
+        return len(rows)
+
+
+class FlowLogDecoder(Decoder):
+    """FlowLogBatch -> flow_log.l4_flow_log / l7_flow_log. Registered for
+    both L4_LOG and L7_LOG message types."""
+
+    MSG_TYPE = MessageType.L4_LOG
+
+    def handle(self, header: FrameHeader, payload: bytes) -> int:
+        batch = pb.FlowLogBatch.FromString(payload)
+        tags = self.platform.tags_for(header.agent_id)
+        n = 0
+        if batch.l4:
+            t4 = self.db.table("flow_log.l4_flow_log")
+            rows = []
+            for f in batch.l4:
+                rows.append({
+                    "time": f.end_time_ns,
+                    "flow_id": f.flow_id,
+                    "ip_src": _ip_str(f.key.ip_src),
+                    "ip_dst": _ip_str(f.key.ip_dst),
+                    "ip4_src": _ip4_u32(f.key.ip_src),
+                    "ip4_dst": _ip4_u32(f.key.ip_dst),
+                    "port_src": f.key.port_src,
+                    "port_dst": f.key.port_dst,
+                    "protocol": int(f.key.proto),
+                    "tap_port": f.key.tap_port,
+                    "start_time": f.start_time_ns,
+                    "end_time": f.end_time_ns,
+                    "packet_tx": f.packet_tx, "packet_rx": f.packet_rx,
+                    "byte_tx": f.byte_tx, "byte_rx": f.byte_rx,
+                    "l7_request": f.l7_request, "l7_response": f.l7_response,
+                    "rtt": f.rtt_us, "art": f.art_us,
+                    "retrans_tx": f.retrans_tx, "retrans_rx": f.retrans_rx,
+                    "zero_win_tx": f.zero_win_tx, "zero_win_rx": f.zero_win_rx,
+                    "close_type": _close_type_idx(f.close_type),
+                    "syn_count": f.syn_count, "synack_count": f.synack_count,
+                    "gprocess_id_0": f.gpid_0, "gprocess_id_1": f.gpid_1,
+                    **tags,
+                })
+            t4.append_rows(rows)
+            n += len(rows)
+        if batch.l7:
+            t7 = self.db.table("flow_log.l7_flow_log")
+            rows = []
+            for f in batch.l7:
+                rows.append({
+                    "time": f.start_time_ns,
+                    "flow_id": f.flow_id,
+                    "ip_src": _ip_str(f.key.ip_src),
+                    "ip_dst": _ip_str(f.key.ip_dst),
+                    "port_src": f.key.port_src,
+                    "port_dst": f.key.port_dst,
+                    "l7_protocol": int(f.l7_protocol),
+                    "version": f.version,
+                    "request_type": f.request_type,
+                    "request_domain": f.request_domain,
+                    "request_resource": f.request_resource,
+                    "endpoint": f.endpoint,
+                    "request_id": f.request_id,
+                    "response_status": int(f.response_status),
+                    "response_code": f.response_code,
+                    "response_exception": f.response_exception,
+                    "response_result": f.response_result,
+                    "response_duration": max(0, f.end_time_ns - f.start_time_ns),
+                    "trace_id": f.trace_id,
+                    "span_id": f.span_id,
+                    "parent_span_id": f.parent_span_id,
+                    "x_request_id": f.x_request_id,
+                    "syscall_trace_id_request": f.syscall_trace_id_request,
+                    "syscall_trace_id_response": f.syscall_trace_id_response,
+                    "syscall_thread_0": f.syscall_thread_0,
+                    "syscall_thread_1": f.syscall_thread_1,
+                    "captured_request_byte": f.captured_request_byte,
+                    "captured_response_byte": f.captured_response_byte,
+                    "gprocess_id_0": f.gpid_0, "gprocess_id_1": f.gpid_1,
+                    "process_kname_0": f.process_kname_0,
+                    "process_kname_1": f.process_kname_1,
+                    **tags,
+                })
+            t7.append_rows(rows)
+            n += len(rows)
+        return n
+
+
+class MetricsDecoder(Decoder):
+    """DocumentBatch -> flow_metrics.network/application 1s tables.
+    1m rollups are produced by the datasource rollup job, not here."""
+
+    MSG_TYPE = MessageType.METRICS
+
+    def handle(self, header: FrameHeader, payload: bytes) -> int:
+        batch = pb.DocumentBatch.FromString(payload)
+        tags = self.platform.tags_for(header.agent_id)
+        net_rows, app_rows = [], []
+        for d in batch.docs:
+            tag = d.tag
+            base = {
+                "time": d.timestamp_s,
+                "ip_src": _ip_str(tag.ip_src),
+                "ip_dst": _ip_str(tag.ip_dst),
+                "server_port": tag.port,
+                **tags,
+            }
+            if d.HasField("flow_meter"):
+                m = d.flow_meter
+                net_rows.append({
+                    **base,
+                    "protocol": int(tag.proto),
+                    "direction": tag.direction,
+                    "packet_tx": m.packet_tx, "packet_rx": m.packet_rx,
+                    "byte_tx": m.byte_tx, "byte_rx": m.byte_rx,
+                    "flow_count": m.flow_count, "new_flow": m.new_flow,
+                    "closed_flow": m.closed_flow,
+                    "rtt_sum": m.rtt_sum_us, "rtt_count": m.rtt_count,
+                    "retrans": m.retrans,
+                    "syn_count": m.syn_count, "synack_count": m.synack_count,
+                })
+            if d.HasField("app_meter"):
+                m = d.app_meter
+                app_rows.append({
+                    **base,
+                    "l7_protocol": int(tag.l7_protocol),
+                    "app_service": tag.app_service,
+                    "request": m.request, "response": m.response,
+                    "rrt_sum": m.rrt_sum_us, "rrt_count": m.rrt_count,
+                    "rrt_max": m.rrt_max_us,
+                    "error_client": m.error_client,
+                    "error_server": m.error_server,
+                    "timeout": m.timeout,
+                })
+        if net_rows:
+            self.db.table("flow_metrics.network.1s").append_rows(net_rows)
+        if app_rows:
+            self.db.table("flow_metrics.application.1s").append_rows(app_rows)
+        return len(net_rows) + len(app_rows)
+
+
+class StatsDecoder(Decoder):
+    """StatsBatch -> deepflow_system (self-telemetry)."""
+
+    MSG_TYPE = MessageType.DFSTATS
+
+    def handle(self, header: FrameHeader, payload: bytes) -> int:
+        batch = pb.StatsBatch.FromString(payload)
+        tags = self.platform.tags_for(header.agent_id)
+        table = self.db.table("deepflow_system.deepflow_system")
+        rows = []
+        for m in batch.metrics:
+            tag_json = json.dumps(dict(m.tags), sort_keys=True)
+            for vname, v in m.values.items():
+                rows.append({
+                    "time": m.timestamp_ns,
+                    "metric_name": m.name,
+                    "tag_json": tag_json,
+                    "value_name": vname,
+                    "value": v,
+                    **tags,
+                })
+        table.append_rows(rows)
+        return len(rows)
+
+
+class EventDecoder(Decoder):
+    """EventBatch -> event.event."""
+
+    MSG_TYPE = MessageType.EVENT
+
+    def handle(self, header: FrameHeader, payload: bytes) -> int:
+        batch = pb.EventBatch.FromString(payload)
+        tags = self.platform.tags_for(header.agent_id)
+        table = self.db.table("event.event")
+        rows = [{
+            "time": e.timestamp_ns,
+            "event_type": e.event_type,
+            "resource_type": e.resource_type,
+            "resource_name": e.resource_name,
+            "pid": e.pid,
+            "description": e.description,
+            "attrs": json.dumps(dict(e.attrs), sort_keys=True),
+            **tags,
+        } for e in batch.events]
+        table.append_rows(rows)
+        return len(rows)
+
+
+def _ip_str(raw: bytes) -> str:
+    import ipaddress
+    if not raw:
+        return ""
+    try:
+        return str(ipaddress.ip_address(raw))
+    except ValueError:
+        return raw.hex()
+
+
+def _ip4_u32(raw: bytes) -> int:
+    if len(raw) == 4:
+        return int.from_bytes(raw, "big")
+    return 0
+
+
+def _close_type_idx(name: str) -> int:
+    try:
+        return CLOSE_TYPES.index(name or "unknown")
+    except ValueError:
+        return 0
+
+
+ALL_DECODERS = [ProfileDecoder, TpuSpanDecoder, FlowLogDecoder,
+                MetricsDecoder, StatsDecoder, EventDecoder]

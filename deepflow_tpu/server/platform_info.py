@@ -1,0 +1,73 @@
+"""PlatformInfoTable: agent_id -> platform/topology tags for ingest-time
+universal tag injection.
+
+Reference analog: server/libs/grpc/grpc_platformdata.go:147 — the ingester's
+cache of controller platform data, queried per row to inject universal tags.
+TPU-native: tags carry TPU pod topology (tpu_pod, worker, slice) alongside
+host/pod identity.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+
+@dataclass
+class AgentInfo:
+    agent_id: int
+    host: str = ""
+    host_id: int = 0
+    pod_name: str = ""
+    pod_ns: str = ""
+    tpu_pod: str = ""
+    tpu_worker: int = 0
+    slice_id: int = 0
+
+    def tags(self) -> dict:
+        return {
+            "agent_id": self.agent_id,
+            "host_id": self.host_id,
+            "host": self.host,
+            "pod_name": self.pod_name,
+            "pod_ns": self.pod_ns,
+            "tpu_pod": self.tpu_pod,
+            "tpu_worker": self.tpu_worker,
+            "slice_id": self.slice_id,
+        }
+
+
+_EMPTY = AgentInfo(agent_id=0)
+
+
+class PlatformInfoTable:
+    """Thread-safe agent registry; fed by the controller (or directly by
+    agent hello frames in standalone mode)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._agents: dict[int, AgentInfo] = {}
+        self._next_host_id = 1
+
+    def update(self, info: AgentInfo) -> None:
+        with self._lock:
+            prev = self._agents.get(info.agent_id)
+            if info.host_id == 0:
+                info.host_id = (prev.host_id if prev
+                                else self._alloc_host_id_locked())
+            self._agents[info.agent_id] = info
+
+    def _alloc_host_id_locked(self) -> int:
+        hid = self._next_host_id
+        self._next_host_id += 1
+        return hid
+
+    def query(self, agent_id: int) -> AgentInfo:
+        with self._lock:
+            return self._agents.get(agent_id, _EMPTY)
+
+    def tags_for(self, agent_id: int) -> dict:
+        info = self.query(agent_id)
+        if info is _EMPTY:
+            return {"agent_id": agent_id}
+        return info.tags()

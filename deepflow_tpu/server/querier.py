@@ -1,0 +1,159 @@
+"""Querier HTTP API.
+
+Reference analog: server/querier/router/query.go:30 (POST /v1/query/) and
+server/querier/profile/router/query.go:33 (POST /v1/profile/ProfileTracing).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from deepflow_tpu.query import engine as qengine
+from deepflow_tpu.query import sql as qsql
+from deepflow_tpu.query.flamegraph import profile_flame_tree
+from deepflow_tpu.store.db import Database
+
+log = logging.getLogger("df.querier")
+
+
+class QuerierAPI:
+    """Route logic, separated from HTTP plumbing for in-process use."""
+
+    def __init__(self, db: Database, stats_provider=None) -> None:
+        self.db = db
+        self.stats_provider = stats_provider or (lambda: {})
+
+    def query(self, body: dict) -> dict:
+        sql_text = body.get("sql", "")
+        db_name = body.get("db", "")
+        select = qsql.parse(sql_text)
+        table_name = select.table
+        if "." not in table_name and db_name:
+            table_name = f"{db_name}.{table_name}"
+        # flow_metrics tables carry an interval suffix
+        candidates = [table_name, f"{table_name}.1s"]
+        table = None
+        for cand in candidates:
+            try:
+                table = self.db.table(cand)
+                break
+            except KeyError:
+                continue
+        if table is None:
+            raise qengine.QueryError(
+                f"no such table {table_name!r}; known: {self.db.tables()}")
+        result = qengine.execute(table, select)
+        return {"result": result.to_dict(), "debug": {"table": table.name}}
+
+    def profile_tracing(self, body: dict) -> dict:
+        table = self.db.table("profile.in_process_profile")
+        tree = profile_flame_tree(
+            table,
+            time_start_ns=body.get("time_start"),
+            time_end_ns=body.get("time_end"),
+            event_type=body.get("event_type"),
+            app_service=body.get("app_service"),
+            profiler=body.get("profiler"),
+        )
+        return {"result": tree.to_dict()}
+
+    def tpu_flame(self, body: dict) -> dict:
+        """Flame view over HLO device spans: module -> op hierarchy."""
+        table = self.db.table("profile.tpu_hlo_span")
+        where = ["duration_ns > 0"]
+        if body.get("time_start"):
+            where.append(f"time >= {int(body['time_start'])}")
+        if body.get("time_end"):
+            where.append(f"time < {int(body['time_end'])}")
+        if body.get("device_id") is not None:
+            where.append(f"device_id = {int(body['device_id'])}")
+        sql_text = (
+            "SELECT hlo_module, hlo_category, hlo_op, Sum(duration_ns) AS d "
+            f"FROM t WHERE {' AND '.join(where)} "
+            "GROUP BY hlo_module, hlo_category, hlo_op")
+        res = qengine.execute(table, sql_text)
+        from deepflow_tpu.query.flamegraph import build_flame_tree
+        stacks, values = [], []
+        for mod, cat, op, d in res.values:
+            stacks.append(";".join(x for x in (mod, cat or "other", op) if x))
+            values.append(int(d))
+        return {"result": build_flame_tree(stacks, values).to_dict()}
+
+    def health(self) -> dict:
+        return {
+            "status": "ok",
+            "tables": {name: len(self.db.table(name))
+                       for name in self.db.tables()},
+            "stats": self.stats_provider(),
+        }
+
+
+class QuerierHTTP:
+    def __init__(self, api: QuerierAPI, host: str = "127.0.0.1",
+                 port: int = 20416) -> None:
+        self.api = api
+        self.host = host
+        self.port = port
+        self._httpd: ThreadingHTTPServer | None = None
+
+    def start(self) -> "QuerierHTTP":
+        api = self.api
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                log.debug(fmt, *args)
+
+            def _send(self, code: int, obj: dict) -> None:
+                data = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _body(self) -> dict:
+                n = int(self.headers.get("Content-Length", 0))
+                if n == 0:
+                    return {}
+                return json.loads(self.rfile.read(n) or b"{}")
+
+            def do_GET(self) -> None:
+                if self.path.rstrip("/") in ("/v1/health", "/health"):
+                    self._send(200, api.health())
+                else:
+                    self._send(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self) -> None:
+                try:
+                    body = self._body()
+                    path = self.path.rstrip("/")
+                    if path == "/v1/query":
+                        self._send(200, api.query(body))
+                    elif path == "/v1/profile/ProfileTracing":
+                        self._send(200, api.profile_tracing(body))
+                    elif path == "/v1/profile/TpuFlame":
+                        self._send(200, api.tpu_flame(body))
+                    else:
+                        self._send(404, {"error": f"no route {self.path}"})
+                except (qengine.QueryError, qsql.SqlError, KeyError,
+                        json.JSONDecodeError, ValueError) as e:
+                    self._send(400, {"error": str(e)})
+                except Exception as e:  # pragma: no cover
+                    log.exception("querier 500")
+                    self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        t = threading.Thread(target=self._httpd.serve_forever,
+                             name="df-querier-http", daemon=True)
+        t.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

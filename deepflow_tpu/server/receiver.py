@@ -1,0 +1,135 @@
+"""Framed TCP/UDP receiver with per-message-type handler queues.
+
+Reference analog: server/libs/receiver/receiver.go:424 (NewReceiver) and
+:448 (RegistHandler) — one listener, a registry of per-message-type queues,
+decoders consume from their queue.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import socket
+import socketserver
+import threading
+
+from deepflow_tpu.codec import (
+    FrameDecodeError, FrameHeader, MessageType, StreamDecoder, decode_frame)
+
+log = logging.getLogger("df.receiver")
+
+
+class Receiver:
+    """Listens on TCP (and UDP) and fans frames out to registered queues."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 20033,
+                 queue_size: int = 4096, enable_udp: bool = True) -> None:
+        self.host = host
+        self.port = port
+        self._queues: dict[MessageType, queue.Queue] = {}
+        self._queue_size = queue_size
+        self._tcp: socketserver.ThreadingTCPServer | None = None
+        self._udp_sock: socket.socket | None = None
+        self._threads: list[threading.Thread] = []
+        self._enable_udp = enable_udp
+        self.stats = {"frames": 0, "bytes": 0, "dropped": 0, "bad_frames": 0,
+                      "connections": 0}
+
+    def register(self, msg_type: MessageType) -> queue.Queue:
+        q = self._queues.get(msg_type)
+        if q is None:
+            q = queue.Queue(maxsize=self._queue_size)
+            self._queues[msg_type] = q
+        return q
+
+    def _dispatch(self, header: FrameHeader, payload: bytes) -> None:
+        self.stats["frames"] += 1
+        self.stats["bytes"] += len(payload)
+        q = self._queues.get(header.msg_type)
+        if q is None:
+            self.stats["dropped"] += 1
+            return
+        try:
+            q.put_nowait((header, payload))
+        except queue.Full:
+            # backpressure stance: drop newest, count it (reference drops too)
+            self.stats["dropped"] += 1
+
+    # -- TCP -----------------------------------------------------------------
+
+    def start(self) -> "Receiver":
+        recv = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self) -> None:
+                recv.stats["connections"] += 1
+                dec = StreamDecoder()
+                sock = self.request
+                sock.settimeout(60.0)
+                while True:
+                    try:
+                        data = sock.recv(256 << 10)
+                    except (socket.timeout, OSError):
+                        return
+                    if not data:
+                        return
+                    try:
+                        for header, payload in dec.feed(data):
+                            recv._dispatch(header, payload)
+                    except FrameDecodeError as e:
+                        recv.stats["bad_frames"] += 1
+                        log.warning("dropping connection: %s", e)
+                        return
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._tcp = Server((self.host, self.port), Handler)
+        self.port = self._tcp.server_address[1]  # resolve port 0
+        t = threading.Thread(target=self._tcp.serve_forever,
+                             name="df-receiver-tcp", daemon=True)
+        t.start()
+        self._threads.append(t)
+        if self._enable_udp:
+            self._start_udp()
+        return self
+
+    # -- UDP (one frame per datagram) ---------------------------------------
+
+    def _start_udp(self) -> None:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((self.host, self.port))
+        s.settimeout(0.5)
+        self._udp_sock = s
+
+        def run() -> None:
+            while self._udp_sock is not None:
+                try:
+                    data, _ = s.recvfrom(64 << 10)
+                except socket.timeout:
+                    continue
+                except OSError:
+                    return
+                try:
+                    header, payload, consumed = decode_frame(data)
+                    if consumed:
+                        self._dispatch(header, payload)
+                    else:
+                        self.stats["bad_frames"] += 1
+                except FrameDecodeError:
+                    self.stats["bad_frames"] += 1
+
+        t = threading.Thread(target=run, name="df-receiver-udp", daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def stop(self) -> None:
+        if self._tcp:
+            self._tcp.shutdown()
+            self._tcp.server_close()
+            self._tcp = None
+        if self._udp_sock:
+            s, self._udp_sock = self._udp_sock, None
+            s.close()

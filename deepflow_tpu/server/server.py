@@ -1,0 +1,135 @@
+"""deepflow-tpu server wiring: receiver + decoders + querier (+ controller).
+
+Reference analog: server/ingester/ingester/ingester.go:69 (Start: configs,
+receiver, modules) combined with server/cmd/server/main.go (one process).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from deepflow_tpu.codec import MessageType
+from deepflow_tpu.server.decoders import (
+    EventDecoder, FlowLogDecoder, MetricsDecoder, ProfileDecoder,
+    StatsDecoder, TpuSpanDecoder)
+from deepflow_tpu.server.platform_info import PlatformInfoTable
+from deepflow_tpu.server.querier import QuerierAPI, QuerierHTTP
+from deepflow_tpu.server.receiver import Receiver
+from deepflow_tpu.store.db import Database
+
+log = logging.getLogger("df.server")
+
+
+class Server:
+    def __init__(self, host: str = "127.0.0.1", ingest_port: int = 20033,
+                 query_port: int = 20416, data_dir: str | None = None,
+                 sync_port: int = 20035, enable_controller: bool = False,
+                 ) -> None:
+        self.db = Database(data_dir=data_dir)
+        self.platform = PlatformInfoTable()
+        self.receiver = Receiver(host=host, port=ingest_port)
+        self.decoders = []
+        self.api = QuerierAPI(self.db, stats_provider=self._stats)
+        self.http = QuerierHTTP(self.api, host=host, port=query_port)
+        self.controller = None
+        if enable_controller:
+            try:
+                from deepflow_tpu.server.controller import Controller
+            except ImportError:  # controller lands with the control plane
+                log.warning("controller module unavailable; sync disabled")
+            else:
+                self.controller = Controller(
+                    self.platform, host=host, port=sync_port)
+        self._started = False
+
+    def _stats(self) -> dict:
+        return {
+            "receiver": dict(self.receiver.stats),
+            "decoders": {d.MSG_TYPE.name: dict(d.stats)
+                         for d in self.decoders},
+        }
+
+    def start(self) -> "Server":
+        # register all queues BEFORE listening: no drop window on restart
+        pairs = [
+            (ProfileDecoder, MessageType.PROFILE),
+            (TpuSpanDecoder, MessageType.TPU_SPAN),
+            (FlowLogDecoder, MessageType.L4_LOG),
+            (FlowLogDecoder, MessageType.L7_LOG),
+            (MetricsDecoder, MessageType.METRICS),
+            (StatsDecoder, MessageType.DFSTATS),
+            (EventDecoder, MessageType.EVENT),
+        ]
+        for cls, mtype in pairs:
+            q = self.receiver.register(mtype)
+            d = cls(q, self.db, self.platform)
+            d.MSG_TYPE = mtype  # FlowLogDecoder serves two types
+            self.decoders.append(d.start())
+        self.receiver.start()
+        self.http.start()
+        if self.controller:
+            self.controller.start()
+        self._started = True
+        log.info("server up: ingest :%d query :%d",
+                 self.receiver.port, self.http.port)
+        return self
+
+    def stop(self) -> None:
+        if not self._started:
+            return
+        self.receiver.stop()
+        for d in self.decoders:
+            d.stop()
+        self.http.stop()
+        if self.controller:
+            self.controller.stop()
+        self.db.flush()
+        self._started = False
+
+    @property
+    def ingest_port(self) -> int:
+        return self.receiver.port
+
+    @property
+    def query_port(self) -> int:
+        return self.http.port
+
+    def wait_for_rows(self, table: str, n: int, timeout: float = 5.0) -> bool:
+        """Test/ops helper: block until a table holds >= n rows."""
+        deadline = time.monotonic() + timeout
+        t = self.db.table(table)
+        while time.monotonic() < deadline:
+            if len(t) >= n:
+                return True
+            time.sleep(0.02)
+        return len(t) >= n
+
+
+def main() -> None:
+    import argparse
+    parser = argparse.ArgumentParser(description="deepflow-tpu server")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--ingest-port", type=int, default=20033)
+    parser.add_argument("--query-port", type=int, default=20416)
+    parser.add_argument("--sync-port", type=int, default=20035)
+    parser.add_argument("--data-dir", default=None)
+    parser.add_argument("--no-controller", action="store_true")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args()
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    server = Server(host=args.host, ingest_port=args.ingest_port,
+                    query_port=args.query_port, sync_port=args.sync_port,
+                    data_dir=args.data_dir,
+                    enable_controller=not args.no_controller).start()
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,167 @@
+"""End-to-end: agent -> TCP -> server decoders -> store -> querier HTTP."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from deepflow_tpu.agent.agent import Agent
+from deepflow_tpu.agent.config import AgentConfig
+from deepflow_tpu.codec import FrameHeader, MessageType, encode_frame
+from deepflow_tpu.proto import pb
+from deepflow_tpu.server import Server
+
+
+@pytest.fixture
+def server():
+    s = Server(host="127.0.0.1", ingest_port=0, query_port=0).start()
+    yield s
+    s.stop()
+
+
+def _post(port: int, path: str, body: dict) -> dict:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        return json.loads(resp.read())
+
+
+def test_agent_profile_to_flamegraph(server):
+    cfg = AgentConfig()
+    cfg.app_service = "e2e-test"
+    cfg.sender.servers = [("127.0.0.1", server.ingest_port)]
+    cfg.profiler.sample_hz = 200.0
+    cfg.profiler.emit_interval_s = 0.2
+    cfg.tpuprobe.enabled = False
+    agent = Agent(cfg).start()
+
+    stop = threading.Event()
+
+    def busy():
+        while not stop.is_set():
+            sum(i * i for i in range(2000))
+
+    t = threading.Thread(target=busy, name="busy")
+    t.start()
+    time.sleep(1.2)
+    stop.set()
+    t.join()
+    agent.stop()
+
+    assert server.wait_for_rows("profile.in_process_profile", 1)
+
+    # DF-SQL over HTTP
+    out = _post(server.query_port, "/v1/query/", {
+        "db": "profile",
+        "sql": "SELECT app_service, Sum(value) AS v FROM in_process_profile "
+               "WHERE app_service = 'e2e-test' GROUP BY app_service"})
+    assert out["result"]["values"], out
+    assert out["result"]["values"][0][0] == "e2e-test"
+
+    # flame graph API
+    out = _post(server.query_port, "/v1/profile/ProfileTracing",
+                {"app_service": "e2e-test", "event_type": "on-cpu"})
+    tree = out["result"]
+    assert tree["total_value"] > 0
+    flat = json.dumps(tree)
+    assert "busy" in flat  # the busy thread's frames made it through
+
+    # self-telemetry also flowed
+    assert server.wait_for_rows("deepflow_system.deepflow_system", 1)
+
+
+def test_tpu_span_ingest_and_flame(server):
+    batch = pb.TpuSpanBatch()
+    t0 = time.time_ns()
+    for i, (op, cat, dur) in enumerate([
+            ("fusion.1", "fusion", 500_000),
+            ("fusion.1", "fusion", 400_000),
+            ("all-reduce.2", "all-reduce", 1_200_000),
+            ("copy.3", "copy", 50_000)]):
+        s = batch.spans.add()
+        s.start_ns = t0 + i * 1_000_000
+        s.duration_ns = dur
+        s.device_id = 0
+        s.hlo_module = "jit_train_step"
+        s.hlo_op = op
+        s.hlo_category = cat
+        s.kind = pb.DEVICE_COLLECTIVE if "reduce" in op else pb.DEVICE_COMPUTE
+    frame = encode_frame(FrameHeader(MessageType.TPU_SPAN, agent_id=1),
+                         batch.SerializeToString())
+    import socket
+    with socket.create_connection(("127.0.0.1", server.ingest_port)) as sock:
+        sock.sendall(frame)
+    assert server.wait_for_rows("profile.tpu_hlo_span", 4)
+
+    out = _post(server.query_port, "/v1/query/", {
+        "db": "profile",
+        "sql": "SELECT hlo_op, Sum(duration_ns) AS d FROM tpu_hlo_span "
+               "GROUP BY hlo_op ORDER BY d DESC"})
+    vals = out["result"]["values"]
+    assert vals[0] == ["all-reduce.2", 1_200_000.0]
+    assert vals[1] == ["fusion.1", 900_000.0]
+
+    out = _post(server.query_port, "/v1/profile/TpuFlame", {})
+    tree = out["result"]
+    assert tree["total_value"] == 2_150_000
+    mod = tree["children"][0]
+    assert mod["name"] == "jit_train_step"
+
+
+def test_querier_error_handling(server):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(server.query_port, "/v1/query/",
+              {"db": "profile", "sql": "SELECT nope FROM in_process_profile"})
+    assert ei.value.code == 400
+    body = json.loads(ei.value.read())
+    assert "nope" in body["error"]
+
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(server.query_port, "/v1/query/",
+              {"db": "x", "sql": "SELECT a FROM not_a_table"})
+    assert ei.value.code == 400
+
+
+def test_sender_failover_and_reconnect(server):
+    from deepflow_tpu.agent.sender import UniformSender
+    # first server does not exist; sender must fail over to the live one
+    sender = UniformSender(
+        [("127.0.0.1", 1), ("127.0.0.1", server.ingest_port)],
+        agent_id=9).start()
+    batch = pb.EventBatch()
+    e = batch.events.add()
+    e.event_type = "process-start"
+    e.resource_name = "test"
+    e.timestamp_ns = time.time_ns()
+    assert sender.send(MessageType.EVENT, batch.SerializeToString())
+    assert server.wait_for_rows("event.event", 1)
+    sender.flush_and_stop()
+
+    t = server.db.table("event.event")
+    cols = t.column_concat(["agent_id"])
+    assert cols["agent_id"].tolist() == [9]
+
+
+def test_health_endpoint(server):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.query_port}/v1/health",
+            timeout=5) as resp:
+        h = json.loads(resp.read())
+    assert h["status"] == "ok"
+    assert "profile.in_process_profile" in h["tables"]
+
+
+def test_sender_accepts_string_addresses(server):
+    from deepflow_tpu.agent.sender import UniformSender
+    sender = UniformSender([f"127.0.0.1:{server.ingest_port}"]).start()
+    batch = pb.EventBatch()
+    e = batch.events.add()
+    e.event_type = "x"
+    e.timestamp_ns = time.time_ns()
+    sender.send(MessageType.EVENT, batch.SerializeToString())
+    assert server.wait_for_rows("event.event", 1)
+    sender.flush_and_stop()
