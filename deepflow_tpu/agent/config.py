@@ -77,6 +77,15 @@ class AgentConfig:
         return cls.from_dict(data)
 
 
+DEFAULT_INGEST_PORT = 20033
+
+
 def _parse_addr(s: str) -> tuple[str, int]:
-    host, _, port = s.rpartition(":")
-    return (host or "127.0.0.1", int(port))
+    host, sep, port = s.rpartition(":")
+    if not sep:
+        return (s or "127.0.0.1", DEFAULT_INGEST_PORT)
+    try:
+        return (host or "127.0.0.1", int(port))
+    except ValueError:
+        raise ValueError(f"bad server address {s!r}: expected host[:port]"
+                         ) from None

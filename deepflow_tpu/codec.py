@@ -99,8 +99,12 @@ def decode_frame(buf: bytes | memoryview) -> tuple[FrameHeader, bytes, int]:
         raise FrameDecodeError(f"bad version {ver}")
     if compressed:
         payload = zlib.decompress(payload)
+    try:
+        msg_type = MessageType(mtype)
+    except ValueError:
+        raise FrameDecodeError(f"unknown message type {mtype}") from None
     header = FrameHeader(
-        msg_type=MessageType(mtype), agent_id=agent_id, org_id=org_id,
+        msg_type=msg_type, agent_id=agent_id, org_id=org_id,
         team_id=team_id, compressed=compressed)
     return header, payload, size
 

@@ -160,6 +160,11 @@ class _Env:
         if op in ("=", "!=", "<", "<=", ">", ">="):
             rv_raw = e.right
             if isinstance(rv_raw, S.Lit) and isinstance(rv_raw.value, str):
+                if op not in ("=", "!="):
+                    # dictionary codes reflect insertion order, not collation
+                    raise QueryError(
+                        "ordered comparison against a string is not "
+                        "supported (dictionary-encoded column)")
                 code = self._coerce_lit(lv, rv_raw.value)
                 l, r = lv.arr, np.asarray(code)
             else:

@@ -214,19 +214,8 @@ class _Parser:
             self.next()
             op = "!=" if t.value == "<>" else t.value
             return BinOp(op, left, self.parse_add())
-        if t.kind == "kw" and t.value == "IN":
-            self.next()
-            self.expect("op", "(")
-            vals = [self.parse_literal()]
-            while self.peek().value == ",":
-                self.next()
-                vals.append(self.parse_literal())
-            self.expect("op", ")")
-            return BinOp("IN", left, tuple(vals))
-        if t.kind == "kw" and t.value == "LIKE":
-            self.next()
-            pat = self.expect("str").value
-            return BinOp("LIKE", left, Lit(pat))
+        if t.kind == "kw" and t.value in ("IN", "LIKE"):
+            return self.parse_cmp_tail(left)
         if t.kind == "kw" and t.value == "NOT":
             # x NOT IN (...) / NOT LIKE
             save = self.i

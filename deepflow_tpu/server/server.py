@@ -51,6 +51,8 @@ class Server:
         }
 
     def start(self) -> "Server":
+        if self.db.data_dir:
+            self.db.load()  # resume persisted tables
         # register all queues BEFORE listening: no drop window on restart
         pairs = [
             (ProfileDecoder, MessageType.PROFILE),
@@ -85,6 +87,8 @@ class Server:
         if self.controller:
             self.controller.stop()
         self.db.flush()
+        if self.db.data_dir:
+            self.db.save()
         self._started = False
 
     @property

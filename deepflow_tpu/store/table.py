@@ -113,8 +113,20 @@ class ColumnarTable:
         if self._buf_rows == 0:
             return
         chunk = {}
-        for name, spec in self.columns.items():
-            chunk[name] = np.asarray(self._buf[name], dtype=spec.np_dtype)
+        try:
+            for name, spec in self.columns.items():
+                chunk[name] = np.asarray(self._buf[name], dtype=spec.np_dtype)
+        except (OverflowError, ValueError, TypeError) as e:
+            # a poisoned value must not wedge the table: drop the window
+            dropped = self._buf_rows
+            for name in self.columns:
+                self._buf[name] = []
+            self._buf_rows = 0
+            self.rows_written -= dropped
+            raise ValueError(
+                f"{self.name}: dropped {dropped} buffered rows — "
+                f"value out of range for a column: {e}") from e
+        for name in self.columns:
             self._buf[name] = []
         self._chunks.append(chunk)
         self._buf_rows = 0

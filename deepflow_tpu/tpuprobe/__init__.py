@@ -1,0 +1,15 @@
+"""TPU probe layer: XLA/libtpu device-event instrumentation.
+
+The TPU-native re-imagination of the reference's GPU/CUDA profiling hooks
+(agent/src/ebpf/user/extended/extended.h:46, mod.rs:261 CUDA-memory flag —
+EE-only there, first-class here). Event sources:
+
+- XPlaneSource: duty-cycled jax.profiler captures parsed straight from the
+  xplane protobuf (no tensorflow dependency — own wire-format reader).
+  Device timings are xprof's own, so flame graphs match xprof by
+  construction.
+- HooksSource: jax.monitoring listeners for compile/dispatch host events.
+- SimSource: deterministic synthetic HLO span streams for CI without TPU.
+"""
+
+from deepflow_tpu.tpuprobe.events import TpuSpanEvent  # noqa: F401

@@ -1,0 +1,93 @@
+"""Normalized TPU span events shared by all probe sources."""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+from deepflow_tpu.proto import pb
+
+# xprof hlo_category -> collective name (ICI/DCN traffic classes)
+_COLLECTIVES = {
+    "all-reduce": "all-reduce",
+    "all-gather": "all-gather",
+    "all-to-all": "all-to-all",
+    "reduce-scatter": "reduce-scatter",
+    "collective-permute": "collective-permute",
+    "collective": "collective",
+    "send": "send",
+    "recv": "recv",
+    "host send": "send",
+    "host recv": "recv",
+}
+
+_PROGRAM_ID_RE = re.compile(r"^(.*?)\((\d+)\)$")
+
+
+def classify(category: str, name: str) -> tuple[int, str]:
+    """(TpuSpanKind, collective) from an xprof category/op name."""
+    cat = (category or "").lower()
+    nm = (name or "").lower()
+    for key, coll in _COLLECTIVES.items():
+        if key in cat or nm.startswith(key.replace(" ", "-")):
+            return pb.DEVICE_COLLECTIVE, coll
+    if "infeed" in cat or "outfeed" in cat or "copy" in cat or "transfer" in cat:
+        return pb.DEVICE_TRANSFER, ""
+    return pb.DEVICE_COMPUTE, ""
+
+
+@dataclass
+class TpuSpanEvent:
+    start_ns: int
+    duration_ns: int
+    device_id: int = 0
+    chip_id: int = 0
+    core_id: int = 0
+    hlo_module: str = ""
+    hlo_op: str = ""
+    hlo_category: str = ""
+    kind: int = pb.DEVICE_COMPUTE
+    flops: int = 0
+    bytes_accessed: int = 0
+    program_id: int = 0
+    run_id: int = 0
+    collective: str = ""
+    bytes_transferred: int = 0
+    step: int = 0
+
+    def fill_pb(self, s: "pb.TpuSpan", pid: int = 0,
+                process_name: str = "") -> None:
+        s.start_ns = max(0, self.start_ns)
+        s.duration_ns = self.duration_ns
+        s.device_id = self.device_id
+        s.chip_id = self.chip_id
+        s.core_id = self.core_id
+        s.hlo_module = self.hlo_module
+        s.hlo_op = self.hlo_op
+        s.hlo_category = self.hlo_category
+        s.kind = self.kind
+        s.flops = self.flops
+        s.bytes_accessed = self.bytes_accessed
+        s.program_id = self.program_id & 0xFFFFFFFF
+        s.run_id = self.run_id & 0xFFFFFFFF
+        s.collective = self.collective
+        s.bytes_transferred = self.bytes_transferred
+        s.step = self.step
+        s.pid = pid
+        s.process_name = process_name
+
+
+def split_program_id(module_name: str) -> tuple[str, int]:
+    """'jit_train_step(123456)' -> ('jit_train_step', 123456)."""
+    m = _PROGRAM_ID_RE.match(module_name)
+    if m:
+        return m.group(1), int(m.group(2))
+    return module_name, 0
+
+
+def batch_to_pb(events: list[TpuSpanEvent], pid: int = 0,
+                process_name: str = "") -> "pb.TpuSpanBatch":
+    batch = pb.TpuSpanBatch()
+    for ev in events:
+        ev.fill_pb(batch.spans.add(), pid=pid, process_name=process_name)
+    return batch

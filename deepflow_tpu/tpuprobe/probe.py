@@ -1,0 +1,63 @@
+"""TpuProbe: the agent component that owns TPU event sources.
+
+Reference analog: agent/src/ebpf_dispatcher.rs (EbpfCollector) — starts the
+native tracers, receives callbacks, converts to wire messages, hands them to
+the sender.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import threading
+
+from deepflow_tpu.tpuprobe.events import TpuSpanEvent, batch_to_pb
+from deepflow_tpu.tpuprobe.sources import HooksSource, SimSource, XPlaneSource
+
+log = logging.getLogger("df.tpuprobe")
+
+
+class TpuProbe:
+    def __init__(self, agent) -> None:
+        self.agent = agent
+        cfg = agent.config.tpuprobe
+        self.cfg = cfg
+        self.sources: list = []
+        self._lock = threading.Lock()
+        self.stats = {"spans_sent": 0, "batches": 0}
+
+    def start(self) -> "TpuProbe":
+        mode = self.cfg.source
+        if mode == "auto":
+            mode = "sim" if os.environ.get("DFTPU_SIM") else "xplane"
+        if mode == "xplane":
+            self.sources.append(XPlaneSource(
+                self._sink,
+                interval_s=self.cfg.trace_interval_s,
+                duration_ms=self.cfg.trace_duration_ms).start())
+            self.sources.append(HooksSource(self._sink).start())
+        elif mode == "hooks":
+            self.sources.append(HooksSource(self._sink).start())
+        elif mode == "sim":
+            src = SimSource(self._sink)
+            self.sources.append(src)
+            src.generate()
+        return self
+
+    def stop(self) -> None:
+        for s in self.sources:
+            stop = getattr(s, "stop", None)
+            if stop:
+                stop()
+
+    def _sink(self, events: list[TpuSpanEvent]) -> None:
+        if not events:
+            return
+        batch = batch_to_pb(
+            events, pid=os.getpid(),
+            process_name=self.agent.process_name)
+        with self._lock:
+            self.stats["spans_sent"] += len(events)
+            self.stats["batches"] += 1
+        self.agent.send_tpu_spans(batch)

@@ -76,3 +76,11 @@ def test_stream_decoder_recovers_after_corruption():
         dec.feed(bytes(bad))
     # buffer discarded: a fresh good frame decodes fine
     assert dec.feed(good)[0][1] == b"ok"
+
+
+def test_unknown_msg_type_is_decode_error():
+    frame = bytearray(encode_frame(FrameHeader(MessageType.PROFILE), b"x"))
+    frame[7] = 200  # msg_type byte
+    # crc covers payload only, so this is a header corruption case
+    with pytest.raises(FrameDecodeError):
+        decode_frame(bytes(frame))

@@ -196,3 +196,12 @@ def test_percentile_arity_error():
     t = make_table()
     with pytest.raises(QueryError):
         execute(t, "SELECT Percentile(latency) FROM flow")
+
+
+def test_ordered_string_comparison_rejected():
+    t = make_table()
+    with pytest.raises(QueryError):
+        execute(t, "SELECT svc FROM flow WHERE svc < 'banana'")
+    # NOT IN / NOT LIKE still parse through the shared tail
+    r = execute(t, "SELECT bytes FROM flow WHERE svc NOT IN ('api')")
+    assert sorted(r.column("bytes")) == [10, 25, 50]

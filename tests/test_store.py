@@ -91,3 +91,15 @@ def test_append_columns_ragged_rejected():
     with pytest.raises(ValueError):
         t.append_columns({"a": [1, 2, 3], "b": [10, 20]})
     assert len(t) == 0
+
+
+def test_seal_poison_drops_window_not_table():
+    import pytest
+    t = ColumnarTable("t", [ColumnSpec("a", "u32")], chunk_rows=2)
+    with pytest.raises(ValueError):
+        t.append_rows([{"a": 1}, {"a": 10**18}])  # overflows u32 at seal
+    # table still usable afterwards
+    t.append_rows([{"a": 5}, {"a": 6}])
+    t.flush()
+    assert t.column_concat(["a"])["a"].tolist() == [5, 6]
+    assert len(t) == 2
