@@ -1,0 +1,197 @@
+"""Dispatcher: packet sources -> FlowMap -> flow logs + metric documents.
+
+Reference analog: agent/src/dispatcher (capture loop) + the sender
+conversion in flow_generator. Sources: pcap replay and synthetic injection
+(live AF_PACKET capture needs CAP_NET_RAW; gated behind a flag so the same
+pipeline runs everywhere — the reference's golden-test stance).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from deepflow_tpu.agent.collector import QuadrupleGenerator
+from deepflow_tpu.agent.flow_map import FlowMap, FlowNode, L7Record
+from deepflow_tpu.agent.packet import MetaPacket, read_pcap
+from deepflow_tpu.codec import MessageType
+from deepflow_tpu.proto import pb
+
+log = logging.getLogger("df.dispatcher")
+
+
+def flow_to_l4_pb(node: FlowNode) -> pb.L4FlowLog:
+    f = pb.L4FlowLog()
+    f.flow_id = node.flow_id
+    f.key.ip_src = node.ip_src
+    f.key.ip_dst = node.ip_dst
+    f.key.port_src = node.port_src
+    f.key.port_dst = node.port_dst
+    f.key.proto = node.protocol
+    f.key.tap_port = node.tap_port
+    f.start_time_ns = node.start_ns
+    f.end_time_ns = node.end_ns
+    f.packet_tx = node.tx.packets
+    f.packet_rx = node.rx.packets
+    f.byte_tx = node.tx.bytes
+    f.byte_rx = node.rx.bytes
+    f.l7_request = node.l7_request
+    f.l7_response = node.l7_response
+    f.rtt_us = node.rtt_us
+    if node.art_count:
+        f.art_us = node.art_sum_us // node.art_count
+    f.retrans_tx = node.tx.retrans
+    f.retrans_rx = node.rx.retrans
+    f.zero_win_tx = node.tx.zero_window
+    f.zero_win_rx = node.rx.zero_window
+    f.close_type = node.close_type
+    f.tcp_flags_bit_tx = node.tx.tcp_flags_bits
+    f.tcp_flags_bit_rx = node.rx.tcp_flags_bits
+    f.syn_count = node.syn_count
+    f.synack_count = node.synack_count
+    return f
+
+
+def record_to_l7_pb(r: L7Record) -> pb.L7FlowLog:
+    node = r.flow
+    f = pb.L7FlowLog()
+    f.flow_id = node.flow_id
+    f.key.ip_src = node.ip_src
+    f.key.ip_dst = node.ip_dst
+    f.key.port_src = node.port_src
+    f.key.port_dst = node.port_dst
+    f.key.proto = node.protocol
+    f.l7_protocol = node.l7_protocol
+    f.start_time_ns = r.start_ns
+    f.end_time_ns = r.end_ns
+    req, resp = r.request, r.response
+    if req is not None:
+        f.version = req.version
+        f.request_type = req.request_type
+        f.request_domain = req.request_domain
+        f.request_resource = req.request_resource
+        f.endpoint = req.endpoint
+        f.request_id = req.request_id
+        f.trace_id = req.trace_id
+        f.span_id = req.span_id
+        f.x_request_id = req.x_request_id
+        f.captured_request_byte = req.captured_byte
+        if req.l7_protocol:
+            f.l7_protocol = req.l7_protocol
+    if resp is not None:
+        f.response_status = resp.response_status
+        f.response_code = resp.response_code
+        f.response_exception = resp.response_exception
+        f.response_result = resp.response_result[:256]
+        f.captured_response_byte = resp.captured_byte
+        if not resp.trace_id == "" and not f.trace_id:
+            f.trace_id = resp.trace_id
+    elif req is not None:
+        f.response_status = 4  # unanswered request -> timeout
+    return f
+
+
+class Dispatcher:
+    """Owns one FlowMap shard and converts outputs to wire batches."""
+
+    def __init__(self, sender=None, agent_id: int = 0,
+                 flush_interval_s: float = 1.0,
+                 batch_size: int = 256) -> None:
+        self.sender = sender
+        self.batch_size = batch_size
+        self.flush_interval_s = flush_interval_s
+        self._l4_buf: list[pb.L4FlowLog] = []
+        self._l7_buf: list[pb.L7FlowLog] = []
+        self.quadruple = QuadrupleGenerator(self._emit_docs)
+        self.flow_map = FlowMap(
+            on_l4_log=self._on_l4, on_l7_log=self._on_l7,
+            on_flow_update=self.quadruple.add_flow, agent_id=agent_id)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+
+    # -- pipeline callbacks ----------------------------------------------------
+
+    def _on_l4(self, node: FlowNode) -> None:
+        self._l4_buf.append(flow_to_l4_pb(node))
+        if len(self._l4_buf) >= self.batch_size:
+            self._flush_l4()
+
+    def _on_l7(self, record: L7Record) -> None:
+        self.quadruple.add_l7(record)
+        self._l7_buf.append(record_to_l7_pb(record))
+        if len(self._l7_buf) >= self.batch_size:
+            self._flush_l7()
+
+    def _flush_l4(self) -> None:
+        if not self._l4_buf or self.sender is None:
+            self._l4_buf = []
+            return
+        batch = pb.FlowLogBatch()
+        batch.l4.extend(self._l4_buf)
+        self._l4_buf = []
+        self.sender.send(MessageType.L4_LOG, batch.SerializeToString())
+
+    def _flush_l7(self) -> None:
+        if not self._l7_buf or self.sender is None:
+            self._l7_buf = []
+            return
+        batch = pb.FlowLogBatch()
+        batch.l7.extend(self._l7_buf)
+        self._l7_buf = []
+        self.sender.send(MessageType.L7_LOG, batch.SerializeToString())
+
+    def _emit_docs(self, docs: list) -> None:
+        if self.sender is None:
+            return
+        batch = pb.DocumentBatch()
+        batch.docs.extend(docs)
+        self.sender.send(MessageType.METRICS, batch.SerializeToString())
+
+    # -- feeding ----------------------------------------------------------------
+
+    def inject(self, packet: MetaPacket) -> None:
+        with self._lock:
+            self.flow_map.inject(packet)
+
+    def replay_pcap(self, path: str, tick: bool = True) -> int:
+        """Replay a pcap through the pipeline (golden tests / dfctl replay)."""
+        packets = read_pcap(path)
+        for p in packets:
+            self.inject(p)
+        if tick:
+            self.flush(force=True)
+        return len(packets)
+
+    def flush(self, force: bool = False, now_ns: int | None = None) -> None:
+        with self._lock:
+            if force:
+                self.flow_map.flush_all()
+            else:
+                self.flow_map.tick(now_ns)
+            self.quadruple.flush(
+                None if now_ns is None else now_ns // 1_000_000_000)
+            self._flush_l4()
+            self._flush_l7()
+
+    # -- background loop ---------------------------------------------------------
+
+    def start(self) -> "Dispatcher":
+        self._thread = threading.Thread(
+            target=self._run, name="df-dispatcher", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2.0)
+        self.flush(force=True)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.flush_interval_s):
+            try:
+                self.flush()
+            except Exception:
+                log.exception("dispatcher flush failed")

@@ -1,0 +1,343 @@
+"""FlowMap: MetaPacket stream -> flows, perf stats, L7 session logs.
+
+Reference analog: agent/src/flow_generator/flow_map.rs (FlowMap::new :255,
+inject_meta_packet :716, flush :2015), flow_state.rs (TCP FSM), perf/tcp.rs
+(RTT/ART), protocol_logs/parser.rs:368 (SessionQueue request/response
+matching).
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from enum import IntEnum
+
+from deepflow_tpu.agent.packet import MetaPacket, TcpFlags
+from deepflow_tpu.agent.protocol_logs.base import (
+    MSG_REQUEST, MSG_RESPONSE, L7ParseResult, get_parser, infer_and_parse)
+from deepflow_tpu.proto import pb
+
+
+class FlowState(IntEnum):
+    INIT = 0
+    SYN_SENT = 1
+    SYN_ACK = 2
+    ESTABLISHED = 3
+    FIN_1 = 4
+    CLOSED = 5
+    RST = 6
+
+
+CLOSE_TYPE = {FlowState.CLOSED: "fin", FlowState.RST: "rst"}
+
+# common service ports for the no-SYN direction heuristic
+KNOWN_SERVER_PORTS = frozenset({
+    22, 25, 53, 80, 88, 110, 143, 389, 443, 465, 587, 993, 995, 1433, 1521,
+    2379, 3000, 3306, 4222, 5000, 5432, 5672, 6379, 8000, 8080, 8443, 8888,
+    9000, 9090, 9092, 9200, 11211, 27017, 50051})
+
+
+@dataclass
+class DirectionStats:
+    packets: int = 0
+    bytes: int = 0
+    tcp_flags_bits: int = 0
+    retrans: int = 0
+    zero_window: int = 0
+    max_seq: int = 0
+    max_payload_seq: int = 0
+
+
+@dataclass
+class PendingRequest:
+    timestamp_ns: int
+    record: L7ParseResult
+
+
+@dataclass
+class FlowNode:
+    flow_id: int
+    ip_src: bytes              # client side (flow initiator)
+    ip_dst: bytes
+    port_src: int
+    port_dst: int
+    protocol: int
+    start_ns: int
+    tap_port: int = 0
+    end_ns: int = 0
+    state: FlowState = FlowState.INIT
+    tx: DirectionStats = field(default_factory=DirectionStats)  # client->srv
+    rx: DirectionStats = field(default_factory=DirectionStats)
+    syn_count: int = 0
+    synack_count: int = 0
+    syn_ns: int = 0
+    synack_ns: int = 0
+    rtt_us: int = 0
+    art_sum_us: int = 0
+    art_count: int = 0
+    l7_protocol: int = pb.L7_UNKNOWN
+    l7_inferred: bool = False
+    l7_request: int = 0
+    l7_response: int = 0
+    pending: deque = field(default_factory=deque)   # PendingRequest FIFO
+    pending_by_id: dict = field(default_factory=dict)
+    close_type: str = "unknown"
+    new_flow_reported: bool = False
+
+    def ip_src_str(self) -> str:
+        return str(ipaddress.ip_address(self.ip_src))
+
+    def ip_dst_str(self) -> str:
+        return str(ipaddress.ip_address(self.ip_dst))
+
+
+@dataclass
+class L7Record:
+    """A matched (or lone) request/response pair ready to become a row."""
+    flow: FlowNode
+    request: L7ParseResult | None
+    response: L7ParseResult | None
+    start_ns: int
+    end_ns: int
+
+
+class FlowMap:
+    """Single-threaded flow table (shard it per dispatcher, like the
+    reference's per-queue FlowMaps)."""
+
+    FLOW_TIMEOUT_NS = {
+        FlowState.INIT: 5_000_000_000,
+        FlowState.SYN_SENT: 5_000_000_000,
+        FlowState.SYN_ACK: 5_000_000_000,
+        FlowState.ESTABLISHED: 300_000_000_000,
+        FlowState.FIN_1: 30_000_000_000,
+    }
+    MAX_PENDING = 128
+
+    def __init__(self, on_l4_log=None, on_l7_log=None, on_flow_update=None,
+                 agent_id: int = 0, max_flows: int = 1 << 16) -> None:
+        self.flows: dict[tuple, FlowNode] = {}
+        self.on_l4_log = on_l4_log or (lambda f: None)
+        self.on_l7_log = on_l7_log or (lambda r: None)
+        self.on_flow_update = on_flow_update or (lambda f, closed: None)
+        self.agent_id = agent_id
+        self.max_flows = max_flows
+        self._next_flow_id = 1
+        self.stats = {"packets": 0, "flows_created": 0, "flows_closed": 0,
+                      "l7_records": 0, "evicted": 0}
+
+    # -- ingest ---------------------------------------------------------------
+
+    def inject(self, p: MetaPacket) -> None:
+        self.stats["packets"] += 1
+        node, is_tx = self._lookup_or_create(p)
+        if node is None:
+            return
+        node.end_ns = p.timestamp_ns
+        d = node.tx if is_tx else node.rx
+        d.packets += 1
+        d.bytes += p.packet_len
+        if p.protocol == 1:
+            self._tcp_update(node, p, d, is_tx)
+        if p.payload:
+            self._l7_update(node, p, is_tx)
+
+    def _lookup_or_create(self, p: MetaPacket):
+        node = self.flows.get(p.key)
+        if node is not None:
+            return node, True
+        node = self.flows.get(p.reverse_key)
+        if node is not None:
+            return node, False
+        if len(self.flows) >= self.max_flows:
+            self._evict_oldest()
+        # direction heuristic when no SYN is seen (mid-stream pickup):
+        # a well-known/privileged source port marks the SERVER side
+        if p.protocol == 1 and not (p.tcp_flags & TcpFlags.SYN):
+            src_is_server = (p.port_src in KNOWN_SERVER_PORTS
+                             or p.port_src < 1024) and not (
+                p.port_dst in KNOWN_SERVER_PORTS or p.port_dst < 1024)
+            if src_is_server:
+                node = self._new_node(p, flipped=True)
+                self.flows[p.reverse_key] = node
+                return node, False
+        node = self._new_node(p, flipped=False)
+        self.flows[p.key] = node
+        return node, True
+
+    def _new_node(self, p: MetaPacket, flipped: bool) -> FlowNode:
+        fid = self._next_flow_id
+        self._next_flow_id += 1
+        self.stats["flows_created"] += 1
+        if flipped:
+            return FlowNode(
+                flow_id=fid, ip_src=p.ip_dst, ip_dst=p.ip_src,
+                port_src=p.port_dst, port_dst=p.port_src,
+                protocol=p.protocol, start_ns=p.timestamp_ns,
+                tap_port=p.tap_port)
+        return FlowNode(
+            flow_id=fid, ip_src=p.ip_src, ip_dst=p.ip_dst,
+            port_src=p.port_src, port_dst=p.port_dst,
+            protocol=p.protocol, start_ns=p.timestamp_ns,
+            tap_port=p.tap_port)
+
+    def _evict_oldest(self) -> None:
+        oldest_key = min(self.flows, key=lambda k: self.flows[k].end_ns)
+        node = self.flows.pop(oldest_key)
+        node.close_type = "forced"
+        self._close(node)
+        self.stats["evicted"] += 1
+
+    # -- TCP state machine + perf ---------------------------------------------
+
+    def _tcp_update(self, node: FlowNode, p: MetaPacket,
+                    d: DirectionStats, is_tx: bool) -> None:
+        flags = p.tcp_flags
+        d.tcp_flags_bits |= flags
+        if p.window == 0 and not (flags & TcpFlags.RST):
+            d.zero_window += 1
+        # retransmission: repeated seq with payload below the high-water mark
+        if p.payload:
+            if d.max_payload_seq and p.seq < d.max_payload_seq:
+                d.retrans += 1
+            else:
+                d.max_payload_seq = max(d.max_payload_seq,
+                                        p.seq + len(p.payload))
+        if flags & TcpFlags.RST:
+            node.state = FlowState.RST
+            node.close_type = "rst"
+            return
+        syn = bool(flags & TcpFlags.SYN)
+        ack = bool(flags & TcpFlags.ACK)
+        fin = bool(flags & TcpFlags.FIN)
+        if syn and not ack:
+            node.syn_count += 1
+            if node.state == FlowState.INIT:
+                node.state = FlowState.SYN_SENT
+                node.syn_ns = p.timestamp_ns
+        elif syn and ack:
+            node.synack_count += 1
+            if node.state == FlowState.SYN_SENT:
+                node.state = FlowState.SYN_ACK
+                node.synack_ns = p.timestamp_ns
+        elif fin:
+            if node.state in (FlowState.ESTABLISHED, FlowState.SYN_ACK,
+                              FlowState.INIT):
+                node.state = FlowState.FIN_1
+            elif node.state == FlowState.FIN_1:
+                node.state = FlowState.CLOSED
+                node.close_type = "fin"
+        elif ack:
+            if node.state == FlowState.SYN_ACK:
+                node.state = FlowState.ESTABLISHED
+                if node.syn_ns and node.synack_ns:
+                    node.rtt_us = max(
+                        0, (p.timestamp_ns - node.syn_ns) // 1000)
+            elif node.state == FlowState.INIT:
+                # mid-stream pickup (agent started after the handshake):
+                # promote so the flow gets the ESTABLISHED idle timeout
+                node.state = FlowState.ESTABLISHED
+
+    # -- L7 -------------------------------------------------------------------
+
+    def _l7_update(self, node: FlowNode, p: MetaPacket, is_tx: bool) -> None:
+        records: list[L7ParseResult] = []
+        if not node.l7_inferred:
+            proto, records = infer_and_parse(p.payload, node.port_dst)
+            if proto != pb.L7_UNKNOWN:
+                node.l7_protocol = proto
+                node.l7_inferred = True
+            elif node.tx.packets + node.rx.packets > 10:
+                node.l7_inferred = True  # give up (stays unknown)
+            if not records:
+                return
+        else:
+            parser = get_parser(node.l7_protocol)
+            if parser is None:
+                return
+            try:
+                records = parser.parse(p.payload, is_request=is_tx)
+            except Exception:
+                return
+        for rec in records:
+            self._session_match(node, rec, p.timestamp_ns)
+
+    def _session_match(self, node: FlowNode, rec: L7ParseResult,
+                       ts_ns: int) -> None:
+        if rec.msg_type == MSG_REQUEST:
+            node.l7_request += 1
+            pending = PendingRequest(ts_ns, rec)
+            if len(node.pending) >= self.MAX_PENDING:
+                old = node.pending.popleft()
+                node.pending_by_id.pop(old.record.request_id, None)
+                self._emit_l7(node, old.record, None, old.timestamp_ns, 0)
+            node.pending.append(pending)
+            if rec.request_id:
+                node.pending_by_id[rec.request_id] = pending
+        else:
+            node.l7_response += 1
+            match = None
+            if rec.request_id and rec.request_id in node.pending_by_id:
+                match = node.pending_by_id.pop(rec.request_id)
+                try:
+                    node.pending.remove(match)
+                except ValueError:
+                    pass
+            elif node.pending:
+                match = node.pending.popleft()
+                node.pending_by_id.pop(match.record.request_id, None)
+            if match is not None:
+                art_us = max(0, (ts_ns - match.timestamp_ns) // 1000)
+                node.art_sum_us += art_us
+                node.art_count += 1
+                self._emit_l7(node, match.record, rec, match.timestamp_ns,
+                              ts_ns)
+            else:
+                self._emit_l7(node, None, rec, ts_ns, ts_ns)
+
+    def _emit_l7(self, node: FlowNode, req: L7ParseResult | None,
+                 resp: L7ParseResult | None, start_ns: int,
+                 end_ns: int) -> None:
+        self.stats["l7_records"] += 1
+        self.on_l7_log(L7Record(
+            flow=node, request=req, response=resp,
+            start_ns=start_ns, end_ns=end_ns or start_ns))
+
+    # -- flush / close ---------------------------------------------------------
+
+    def tick(self, now_ns: int | None = None) -> None:
+        """Expire idle/closed flows; call periodically (1s)."""
+        now = now_ns if now_ns is not None else time.time_ns()
+        to_close = []
+        for key, node in self.flows.items():
+            if node.state in (FlowState.CLOSED, FlowState.RST):
+                to_close.append(key)
+                continue
+            timeout = self.FLOW_TIMEOUT_NS.get(node.state, 60_000_000_000)
+            if now - node.end_ns > timeout:
+                node.close_type = "timeout"
+                to_close.append(key)
+        for key in to_close:
+            self._close(self.flows.pop(key))
+        # live flow updates for metering
+        for node in self.flows.values():
+            self.on_flow_update(node, False)
+
+    def flush_all(self) -> None:
+        for key in list(self.flows):
+            node = self.flows.pop(key)
+            if node.close_type == "unknown":
+                node.close_type = "forced"
+            self._close(node)
+
+    def _close(self, node: FlowNode) -> None:
+        self.stats["flows_closed"] += 1
+        # flush unanswered requests
+        while node.pending:
+            old = node.pending.popleft()
+            self._emit_l7(node, old.record, None, old.timestamp_ns, 0)
+        node.pending_by_id.clear()
+        self.on_flow_update(node, True)
+        self.on_l4_log(node)

@@ -1,0 +1,197 @@
+"""MetaPacket: decoded packet header view + capture sources.
+
+Reference analog: agent/src/common/meta_packet.rs (MetaPacket) and
+agent/src/dispatcher/recv_engine (capture backends). Sources here:
+pcap files (own reader, classic libpcap format) and synthetic builders —
+the reference's own golden-test strategy (agent/resources/test/*.pcap
+replayed through FlowMap, SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import time
+from dataclasses import dataclass, field
+from enum import IntFlag
+
+
+class TcpFlags(IntFlag):
+    FIN = 0x01
+    SYN = 0x02
+    RST = 0x04
+    PSH = 0x08
+    ACK = 0x10
+    URG = 0x20
+
+
+@dataclass
+class MetaPacket:
+    timestamp_ns: int = 0
+    ip_src: bytes = b""
+    ip_dst: bytes = b""
+    port_src: int = 0
+    port_dst: int = 0
+    protocol: int = 0            # pb.L4Protocol values: 1 tcp, 2 udp, 3 icmp
+    tcp_flags: int = 0
+    seq: int = 0
+    ack: int = 0
+    window: int = 0
+    payload: bytes = b""
+    packet_len: int = 0          # on-wire length
+    tap_port: int = 0
+
+    @property
+    def key(self) -> tuple:
+        return (self.ip_src, self.ip_dst, self.port_src, self.port_dst,
+                self.protocol)
+
+    @property
+    def reverse_key(self) -> tuple:
+        return (self.ip_dst, self.ip_src, self.port_dst, self.port_src,
+                self.protocol)
+
+
+ETH_IPV4 = 0x0800
+ETH_IPV6 = 0x86DD
+
+
+def decode_ethernet(frame: bytes, timestamp_ns: int = 0,
+                    tap_port: int = 0) -> MetaPacket | None:
+    """Ethernet II -> IPv4/IPv6 -> TCP/UDP/ICMP header decode."""
+    if len(frame) < 14:
+        return None
+    eth_type = struct.unpack_from(">H", frame, 12)[0]
+    off = 14
+    if eth_type == 0x8100 and len(frame) >= 18:  # 802.1Q VLAN
+        eth_type = struct.unpack_from(">H", frame, 16)[0]
+        off = 18
+    if eth_type == ETH_IPV4:
+        return _decode_ipv4(frame, off, timestamp_ns, tap_port, len(frame))
+    if eth_type == ETH_IPV6:
+        return _decode_ipv6(frame, off, timestamp_ns, tap_port, len(frame))
+    return None
+
+
+def _decode_ipv4(frame: bytes, off: int, ts: int, tap: int,
+                 wire_len: int) -> MetaPacket | None:
+    if len(frame) < off + 20:
+        return None
+    ver_ihl = frame[off]
+    ihl = (ver_ihl & 0x0F) * 4
+    total_len = struct.unpack_from(">H", frame, off + 2)[0]
+    proto = frame[off + 9]
+    ip_src = frame[off + 12:off + 16]
+    ip_dst = frame[off + 16:off + 20]
+    l4_off = off + ihl
+    end = min(len(frame), off + total_len)
+    return _decode_l4(frame, l4_off, end, proto, ip_src, ip_dst, ts, tap,
+                      wire_len)
+
+
+def _decode_ipv6(frame: bytes, off: int, ts: int, tap: int,
+                 wire_len: int) -> MetaPacket | None:
+    if len(frame) < off + 40:
+        return None
+    next_header = frame[off + 6]
+    payload_len = struct.unpack_from(">H", frame, off + 4)[0]
+    ip_src = frame[off + 8:off + 24]
+    ip_dst = frame[off + 24:off + 40]
+    l4_off = off + 40
+    end = min(len(frame), l4_off + payload_len)
+    return _decode_l4(frame, l4_off, end, next_header, ip_src, ip_dst, ts,
+                      tap, wire_len)
+
+
+def _decode_l4(frame: bytes, off: int, end: int, proto: int, ip_src: bytes,
+               ip_dst: bytes, ts: int, tap: int,
+               wire_len: int) -> MetaPacket | None:
+    p = MetaPacket(timestamp_ns=ts, ip_src=ip_src, ip_dst=ip_dst,
+                   tap_port=tap, packet_len=wire_len)
+    if proto == 6:  # TCP
+        if end < off + 20:
+            return None
+        (p.port_src, p.port_dst, p.seq, p.ack) = struct.unpack_from(
+            ">HHII", frame, off)
+        data_off = (frame[off + 12] >> 4) * 4
+        p.tcp_flags = frame[off + 13]
+        p.window = struct.unpack_from(">H", frame, off + 14)[0]
+        p.protocol = 1
+        p.payload = frame[off + data_off:end]
+        return p
+    if proto == 17:  # UDP
+        if end < off + 8:
+            return None
+        p.port_src, p.port_dst = struct.unpack_from(">HH", frame, off)
+        p.protocol = 2
+        p.payload = frame[off + 8:end]
+        return p
+    if proto in (1, 58):  # ICMP / ICMPv6
+        p.protocol = 3
+        p.payload = frame[off:end]
+        return p
+    return None
+
+
+# -- pcap file source (classic format, both endiannesses) --------------------
+
+PCAP_MAGIC_US_LE = 0xA1B2C3D4
+PCAP_MAGIC_NS_LE = 0xA1B23C4D
+
+
+def read_pcap(path: str) -> list[MetaPacket]:
+    """Own pcap reader — no libpcap dependency. Returns decoded packets."""
+    out = []
+    with open(path, "rb") as f:
+        hdr = f.read(24)
+        if len(hdr) < 24:
+            raise ValueError(f"not a pcap file (too short): {path}")
+        magic = struct.unpack_from("<I", hdr, 0)[0]
+        if magic == PCAP_MAGIC_US_LE:
+            endian, scale = "<", 1000
+        elif magic == PCAP_MAGIC_NS_LE:
+            endian, scale = "<", 1
+        elif struct.unpack_from(">I", hdr, 0)[0] == PCAP_MAGIC_US_LE:
+            endian, scale = ">", 1000
+        elif struct.unpack_from(">I", hdr, 0)[0] == PCAP_MAGIC_NS_LE:
+            endian, scale = ">", 1
+        else:
+            raise ValueError(f"not a pcap file: {path}")
+        while True:
+            rec = f.read(16)
+            if len(rec) < 16:
+                break
+            ts_sec, ts_frac, incl, orig = struct.unpack(endian + "IIII", rec)
+            data = f.read(incl)
+            if len(data) < incl:
+                break
+            ts_ns = ts_sec * 1_000_000_000 + ts_frac * scale
+            mp = decode_ethernet(data, timestamp_ns=ts_ns)
+            if mp is not None:
+                mp.packet_len = orig
+                out.append(mp)
+    return out
+
+
+# -- synthetic builders (tests + fake traffic) --------------------------------
+
+def build_tcp(ip_src: str, ip_dst: str, port_src: int, port_dst: int,
+              flags: int = TcpFlags.ACK, payload: bytes = b"",
+              seq: int = 0, ack: int = 0, timestamp_ns: int | None = None,
+              window: int = 65535) -> MetaPacket:
+    return MetaPacket(
+        timestamp_ns=time.time_ns() if timestamp_ns is None else timestamp_ns,
+        ip_src=socket.inet_aton(ip_src), ip_dst=socket.inet_aton(ip_dst),
+        port_src=port_src, port_dst=port_dst, protocol=1,
+        tcp_flags=int(flags), seq=seq, ack=ack, window=window,
+        payload=payload, packet_len=54 + len(payload))
+
+
+def build_udp(ip_src: str, ip_dst: str, port_src: int, port_dst: int,
+              payload: bytes = b"",
+              timestamp_ns: int | None = None) -> MetaPacket:
+    return MetaPacket(
+        timestamp_ns=time.time_ns() if timestamp_ns is None else timestamp_ns,
+        ip_src=socket.inet_aton(ip_src), ip_dst=socket.inet_aton(ip_dst),
+        port_src=port_src, port_dst=port_dst, protocol=2,
+        payload=payload, packet_len=42 + len(payload))

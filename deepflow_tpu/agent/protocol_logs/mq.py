@@ -1,0 +1,78 @@
+"""Kafka parser (reference analog: protocol_logs/mq/kafka.rs)."""
+
+from __future__ import annotations
+
+import struct
+
+from deepflow_tpu.proto import pb
+from deepflow_tpu.agent.protocol_logs.base import (
+    L7Parser, L7ParseResult, MSG_REQUEST, MSG_RESPONSE, register)
+
+_API_KEYS = {
+    0: "Produce", 1: "Fetch", 2: "ListOffsets", 3: "Metadata",
+    8: "OffsetCommit", 9: "OffsetFetch", 10: "FindCoordinator",
+    11: "JoinGroup", 12: "Heartbeat", 13: "LeaveGroup", 14: "SyncGroup",
+    15: "DescribeGroups", 16: "ListGroups", 18: "ApiVersions",
+    19: "CreateTopics", 20: "DeleteTopics",
+}
+
+
+@register
+class KafkaParser(L7Parser):
+    PROTOCOL = pb.KAFKA
+    NAME = "kafka"
+
+    def check(self, payload: bytes, port_dst: int = 0) -> bool:
+        if len(payload) < 14:
+            return False
+        size = struct.unpack_from(">i", payload, 0)[0]
+        api_key, api_ver = struct.unpack_from(">hh", payload, 4)
+        corr = struct.unpack_from(">i", payload, 8)[0]
+        client_len = struct.unpack_from(">h", payload, 12)[0]
+        return (8 <= size < (1 << 24) and api_key in _API_KEYS
+                and 0 <= api_ver <= 20 and corr >= 0
+                and -1 <= client_len < 256
+                and (port_dst == 9092 or size <= len(payload) + 4096))
+
+    def parse(self, payload: bytes,
+              is_request: bool = True) -> list[L7ParseResult]:
+        if not is_request:
+            # response layout: size + correlation_id + body (no api key)
+            if len(payload) < 8:
+                return []
+            corr = struct.unpack_from(">i", payload, 4)[0]
+            return [L7ParseResult(
+                l7_protocol=self.PROTOCOL, msg_type=MSG_RESPONSE,
+                request_id=corr, response_status=1,
+                captured_byte=len(payload))]
+        api_key, api_ver = struct.unpack_from(">hh", payload, 4)
+        corr = struct.unpack_from(">i", payload, 8)[0]
+        name = _API_KEYS.get(api_key, str(api_key))
+        res = L7ParseResult(
+            l7_protocol=self.PROTOCOL, msg_type=MSG_REQUEST,
+            version=str(api_ver),
+            request_type=name,
+            request_id=corr,
+            endpoint=name,
+            captured_byte=len(payload))
+        # topic extraction for Produce/Fetch v0-ish layouts (best effort)
+        client_len = struct.unpack_from(">h", payload, 12)[0]
+        off = 14 + max(0, client_len)
+        if api_key in (0, 1) and off + 6 < len(payload):
+            probe = payload[off:off + 64]
+            topic = _first_string(probe)
+            if topic:
+                res.request_resource = topic
+        return [res]
+
+def _first_string(buf: bytes) -> str:
+    """Scan for a plausible length-prefixed string (kafka topic)."""
+    for i in range(0, max(0, len(buf) - 2)):
+        ln = struct.unpack_from(">h", buf, i)[0]
+        if 1 <= ln <= 64 and i + 2 + ln <= len(buf):
+            s = buf[i + 2:i + 2 + ln]
+            if all(32 <= c < 127 for c in s) and (
+                    s.replace(b"-", b"").replace(b"_", b"")
+                    .replace(b".", b"").isalnum()):
+                return s.decode()
+    return ""

@@ -86,10 +86,13 @@ class Server:
         self.http.stop()
         if self.controller:
             self.controller.stop()
-        self.db.flush()
-        if self.db.data_dir:
-            self.db.save()
-        self._started = False
+        try:
+            for err in self.db.flush():
+                log.error("flush: %s", err)
+            if self.db.data_dir:
+                self.db.save()
+        finally:
+            self._started = False
 
     @property
     def ingest_port(self) -> int:

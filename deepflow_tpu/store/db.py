@@ -40,9 +40,16 @@ class Database:
     def tables(self) -> list[str]:
         return sorted(self._tables)
 
-    def flush(self) -> None:
+    def flush(self) -> list[str]:
+        """Seal every table's buffer. A poisoned buffer in one table must
+        not stop the others (or a subsequent save) — collect the errors."""
+        errors = []
         for t in self._tables.values():
-            t.flush()
+            try:
+                t.flush()
+            except ValueError as e:
+                errors.append(str(e))
+        return errors
 
     def save(self) -> None:
         if not self.data_dir:

@@ -198,6 +198,9 @@ class ColumnarTable:
 
     def save(self, dirpath: str) -> None:
         os.makedirs(dirpath, exist_ok=True)
+        for fn in os.listdir(dirpath):  # stale chunks must not resurrect
+            if fn.startswith("chunk_") and fn.endswith(".npz"):
+                os.unlink(os.path.join(dirpath, fn))
         chunks = self.snapshot()
         for i, ch in enumerate(chunks):
             np.savez_compressed(os.path.join(dirpath, f"chunk_{i:06d}.npz"), **ch)

@@ -1,0 +1,348 @@
+"""Flow pipeline tests: FlowMap, L7 parsers, collector, pcap replay."""
+
+import socket
+import struct
+import time
+
+import pytest
+
+from deepflow_tpu.agent.collector import QuadrupleGenerator
+from deepflow_tpu.agent.dispatcher import Dispatcher
+from deepflow_tpu.agent.flow_map import FlowMap, FlowState
+from deepflow_tpu.agent.packet import (
+    TcpFlags, build_tcp, build_udp, decode_ethernet, read_pcap)
+from deepflow_tpu.agent.protocol_logs.base import infer_and_parse
+from deepflow_tpu.proto import pb
+
+T0 = 1_700_000_000_000_000_000
+
+
+def http_session(flow_map, t0=T0, port_src=51000):
+    """Replay a full HTTP/1.1 session through the flow map."""
+    c, s = "10.0.0.1", "10.0.0.2"
+    fm = flow_map
+    fm.inject(build_tcp(c, s, port_src, 80, TcpFlags.SYN, seq=100,
+                        timestamp_ns=t0))
+    fm.inject(build_tcp(s, c, 80, port_src, TcpFlags.SYN | TcpFlags.ACK,
+                        seq=300, ack=101, timestamp_ns=t0 + 1_000_000))
+    fm.inject(build_tcp(c, s, port_src, 80, TcpFlags.ACK, seq=101, ack=301,
+                        timestamp_ns=t0 + 2_000_000))
+    req = (b"GET /api/users?id=7 HTTP/1.1\r\nHost: api.example.com\r\n"
+           b"traceparent: 00-4bf92f3577b34da6a3ce929d0e0e4736-00f067aa0ba902b7-01\r\n"
+           b"\r\n")
+    fm.inject(build_tcp(c, s, port_src, 80, TcpFlags.ACK | TcpFlags.PSH,
+                        payload=req, seq=101, timestamp_ns=t0 + 3_000_000))
+    resp = b"HTTP/1.1 200 OK\r\nContent-Length: 2\r\n\r\nok"
+    fm.inject(build_tcp(s, c, 80, port_src, TcpFlags.ACK | TcpFlags.PSH,
+                        payload=resp, seq=301, timestamp_ns=t0 + 13_000_000))
+    fm.inject(build_tcp(c, s, port_src, 80, TcpFlags.FIN | TcpFlags.ACK,
+                        timestamp_ns=t0 + 20_000_000))
+    fm.inject(build_tcp(s, c, 80, port_src, TcpFlags.FIN | TcpFlags.ACK,
+                        timestamp_ns=t0 + 21_000_000))
+
+
+def test_flow_map_http_session():
+    l4_logs, l7_logs = [], []
+    fm = FlowMap(on_l4_log=l4_logs.append, on_l7_log=l7_logs.append)
+    http_session(fm)
+    fm.tick(T0 + 30_000_000)
+
+    assert len(l4_logs) == 1
+    f = l4_logs[0]
+    assert f.close_type == "fin"
+    assert f.rtt_us == 2000            # syn->ack handshake: 2ms
+    assert f.syn_count == 1 and f.synack_count == 1
+    assert f.tx.packets == 4 and f.rx.packets == 3  # SYN,ACK,GET,FIN / SA,resp,FIN
+    assert f.l7_request == 1 and f.l7_response == 1
+    assert f.art_count == 1 and f.art_sum_us == 10_000  # 10ms ART
+
+    assert len(l7_logs) == 1
+    r = l7_logs[0]
+    assert r.flow.l7_protocol == pb.HTTP1
+    assert r.request.request_type == "GET"
+    assert r.request.request_domain == "api.example.com"
+    assert r.request.endpoint == "/api/users"
+    assert r.request.trace_id == "4bf92f3577b34da6a3ce929d0e0e4736"
+    assert r.response.response_code == 200
+    assert r.response.response_status == 1
+    assert (r.end_ns - r.start_ns) == 10_000_000
+
+
+def test_flow_map_rst_and_timeout():
+    l4_logs = []
+    fm = FlowMap(on_l4_log=l4_logs.append)
+    fm.inject(build_tcp("1.1.1.1", "2.2.2.2", 5000, 80, TcpFlags.SYN,
+                        timestamp_ns=T0))
+    fm.inject(build_tcp("2.2.2.2", "1.1.1.1", 80, 5000, TcpFlags.RST,
+                        timestamp_ns=T0 + 1_000_000))
+    fm.tick(T0 + 2_000_000)
+    assert len(l4_logs) == 1
+    assert l4_logs[0].close_type == "rst"
+
+    fm.inject(build_udp("1.1.1.1", "2.2.2.2", 5000, 9999, b"hi",
+                        timestamp_ns=T0))
+    fm.tick(T0 + 120_000_000_000)  # 2 minutes later
+    assert len(l4_logs) == 2
+    assert l4_logs[1].close_type == "timeout"
+
+
+def test_retransmission_and_zero_window():
+    l4_logs = []
+    fm = FlowMap(on_l4_log=l4_logs.append)
+    c, s = "10.0.0.1", "10.0.0.9"
+    fm.inject(build_tcp(c, s, 1234, 80, TcpFlags.ACK | TcpFlags.PSH,
+                        payload=b"x" * 10, seq=1000, timestamp_ns=T0))
+    fm.inject(build_tcp(c, s, 1234, 80, TcpFlags.ACK | TcpFlags.PSH,
+                        payload=b"x" * 10, seq=1000, timestamp_ns=T0 + 1))
+    fm.inject(build_tcp(s, c, 80, 1234, TcpFlags.ACK, window=0,
+                        timestamp_ns=T0 + 2))
+    fm.flush_all()
+    f = l4_logs[0]
+    assert f.tx.retrans == 1
+    assert f.rx.zero_window == 1
+
+
+def test_dns_parse():
+    # query for example.com A
+    q = (struct.pack(">HHHHHH", 0x1234, 0x0100, 1, 0, 0, 0)
+         + b"\x07example\x03com\x00" + struct.pack(">HH", 1, 1))
+    proto, recs = infer_and_parse(q, port_dst=53)
+    assert proto == pb.DNS
+    assert recs[0].request_resource == "example.com"
+    assert recs[0].request_type == "A"
+    # response with one A answer
+    r = (struct.pack(">HHHHHH", 0x1234, 0x8180, 1, 1, 0, 0)
+         + b"\x07example\x03com\x00" + struct.pack(">HH", 1, 1)
+         + b"\xc0\x0c" + struct.pack(">HHIH", 1, 1, 60, 4)
+         + bytes([93, 184, 216, 34]))
+    proto, recs = infer_and_parse(r, port_dst=53)
+    assert recs[0].msg_type == 1
+    assert recs[0].response_result == "93.184.216.34"
+    assert recs[0].response_status == 1
+
+
+def test_redis_parse():
+    req = b"*3\r\n$3\r\nSET\r\n$5\r\nmykey\r\n$5\r\nhello\r\n"
+    proto, recs = infer_and_parse(req)
+    assert proto == pb.REDIS
+    assert recs[0].request_type == "SET"
+    assert recs[0].request_resource == "mykey"
+    proto, recs = infer_and_parse(b"-ERR unknown command\r\n", port_dst=6379)
+    assert proto == pb.REDIS
+    assert recs[0].response_status == 3
+    assert "unknown command" in recs[0].response_exception
+
+
+def test_mysql_parse():
+    sql = b"SELECT * FROM users WHERE id=1"
+    packet = len(sql).to_bytes(3, "little") + bytes([0]) + b"\x03" + sql[:-0]
+    # header length counts command byte + sql
+    packet = (len(sql) + 1).to_bytes(3, "little") + bytes([0, 3]) + sql
+    proto, recs = infer_and_parse(packet)
+    assert proto == pb.MYSQL
+    assert recs[0].request_type == "SELECT"
+    assert recs[0].request_resource == "users"
+
+
+def test_postgres_parse():
+    sql = b"INSERT INTO orders VALUES (1)\x00"
+    msg = b"Q" + struct.pack(">I", 4 + len(sql)) + sql
+    proto, recs = infer_and_parse(msg)
+    assert proto == pb.POSTGRESQL
+    assert recs[0].request_type == "INSERT"
+    assert recs[0].request_resource == "orders"
+
+
+def test_memcached_and_mongo_and_kafka():
+    proto, recs = infer_and_parse(b"get session:abc\r\n")
+    assert proto == pb.MEMCACHED
+    assert recs[0].request_type == "GET"
+
+    # mongo OP_MSG find
+    bson = (b"\x00\x00\x00\x00"  # placeholder len
+            b"\x02find\x00\x06\x00\x00\x00users\x00\x00")
+    body = struct.pack("<I", 0) + b"\x00" + bson
+    msg = struct.pack("<IIII", 16 + len(body), 42, 0, 2013) + body
+    proto, recs = infer_and_parse(msg, port_dst=27017)
+    assert proto == pb.MONGODB
+    assert recs[0].request_type == "find"
+    assert recs[0].request_resource == "users"
+
+    # kafka metadata request v4
+    kmsg = struct.pack(">ihhih", 20, 3, 4, 7, 6) + b"my-app" + b"\x00\x00"
+    proto, recs = infer_and_parse(kmsg, port_dst=9092)
+    assert proto == pb.KAFKA
+    assert recs[0].request_type == "Metadata"
+    assert recs[0].request_id == 7
+
+
+def test_http2_grpc_detect():
+    preface = b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
+    settings = b"\x00\x00\x00\x04\x00\x00\x00\x00\x00"
+    proto, recs = infer_and_parse(preface + settings)
+    assert proto == pb.HTTP2
+
+
+def test_collector_documents():
+    docs_out = []
+    gen = QuadrupleGenerator(docs_out.extend)
+    l7 = []
+    fm = FlowMap(on_flow_update=gen.add_flow, on_l7_log=lambda r: (
+        gen.add_l7(r), l7.append(r)))
+    http_session(fm)
+    fm.tick(T0 + 30_000_000)
+    gen.flush(now_s=1_700_000_030)
+    assert docs_out
+    net = [d for d in docs_out if d.HasField("flow_meter")]
+    app = [d for d in docs_out if d.HasField("app_meter")]
+    assert net[0].flow_meter.packet_tx == 4
+    assert net[0].flow_meter.closed_flow == 1
+    assert net[0].flow_meter.rtt_count == 1
+    assert net[0].tag.port == 80
+    assert app[0].app_meter.request == 1
+    assert app[0].app_meter.response == 1
+    assert app[0].app_meter.rrt_max_us == 10_000
+    assert app[0].tag.l7_protocol == pb.HTTP1
+
+
+def write_pcap(path, frames, ts_base=1_700_000_000):
+    """Minimal pcap writer for fixtures."""
+    with open(path, "wb") as f:
+        f.write(struct.pack("<IHHiIII", 0xA1B2C3D4, 2, 4, 0, 0, 65535, 1))
+        for i, frame in enumerate(frames):
+            f.write(struct.pack("<IIII", ts_base + i, i * 1000, len(frame),
+                                len(frame)))
+            f.write(frame)
+
+
+def eth_tcp_frame(ip_src, ip_dst, sport, dport, flags, payload=b"",
+                  seq=0, ack=0):
+    eth = b"\x02" * 6 + b"\x04" * 6 + struct.pack(">H", 0x0800)
+    tcp_len = 20 + len(payload)
+    ip = struct.pack(">BBHHHBBH4s4s", 0x45, 0, 20 + tcp_len, 1, 0, 64, 6, 0,
+                     socket.inet_aton(ip_src), socket.inet_aton(ip_dst))
+    offs = (5 << 4)
+    tcp = struct.pack(">HHIIBBHHH", sport, dport, seq, ack, offs,
+                      int(flags), 65535, 0, 0)
+    return eth + ip + tcp + payload
+
+
+def test_pcap_replay_golden(tmp_path):
+    """Golden pcap test (reference pattern: agent/resources/test pcaps)."""
+    req = b"GET /health HTTP/1.1\r\nHost: svc\r\n\r\n"
+    resp = b"HTTP/1.1 503 Service Unavailable\r\n\r\n"
+    frames = [
+        eth_tcp_frame("192.168.0.1", "192.168.0.2", 40000, 80, TcpFlags.SYN,
+                      seq=1),
+        eth_tcp_frame("192.168.0.2", "192.168.0.1", 80, 40000,
+                      TcpFlags.SYN | TcpFlags.ACK, seq=9, ack=2),
+        eth_tcp_frame("192.168.0.1", "192.168.0.2", 40000, 80, TcpFlags.ACK,
+                      seq=2, ack=10),
+        eth_tcp_frame("192.168.0.1", "192.168.0.2", 40000, 80,
+                      TcpFlags.ACK | TcpFlags.PSH, payload=req, seq=2),
+        eth_tcp_frame("192.168.0.2", "192.168.0.1", 80, 40000,
+                      TcpFlags.ACK | TcpFlags.PSH, payload=resp, seq=10),
+        eth_tcp_frame("192.168.0.1", "192.168.0.2", 40000, 80, TcpFlags.RST,
+                      seq=40),
+    ]
+    path = str(tmp_path / "http503.pcap")
+    write_pcap(path, frames)
+
+    packets = read_pcap(path)
+    assert len(packets) == 6
+    assert packets[0].protocol == 1
+
+    sent = []
+
+    class FakeSender:
+        def send(self, mt, payload):
+            sent.append((mt, payload))
+            return True
+
+    disp = Dispatcher(sender=FakeSender())
+    n = disp.replay_pcap(path)
+    assert n == 6
+    from deepflow_tpu.codec import MessageType
+    types = {mt for mt, _ in sent}
+    assert MessageType.L4_LOG in types
+    assert MessageType.L7_LOG in types
+    l7 = pb.FlowLogBatch.FromString(
+        dict((mt, p) for mt, p in sent)[MessageType.L7_LOG]).l7[0]
+    assert l7.request_resource == "/health"
+    assert l7.response_code == 503
+    assert l7.response_status == 3  # server error
+    l4 = pb.FlowLogBatch.FromString(
+        dict((mt, p) for mt, p in sent)[MessageType.L4_LOG]).l4[0]
+    assert l4.close_type == "rst"
+    assert l4.l7_request == 1
+
+
+def test_flow_eviction():
+    fm = FlowMap(max_flows=4)
+    for i in range(8):
+        fm.inject(build_udp("1.1.1.1", "2.2.2.2", 10000 + i, 53, b"x",
+                            timestamp_ns=T0 + i))
+    assert len(fm.flows) <= 4
+    assert fm.stats["evicted"] == 4
+
+
+def test_garbage_payload_no_false_positive():
+    fm = FlowMap()
+    recs = []
+    fm.on_l7_log = recs.append
+    for i in range(15):
+        fm.inject(build_tcp("1.1.1.1", "2.2.2.2", 9999, 3306,
+                            TcpFlags.PSH | TcpFlags.ACK,
+                            payload=bytes([i % 251]) * 37, seq=i * 37,
+                            timestamp_ns=T0 + i))
+    fm.flush_all()
+    assert not recs
+
+
+def test_short_pcap_rejected(tmp_path):
+    p = tmp_path / "bad.pcap"
+    p.write_bytes(b"NOT A PCAP")
+    with pytest.raises(ValueError):
+        read_pcap(str(p))
+
+
+def test_kafka_response_direction_matching():
+    l7 = []
+    fm = FlowMap(on_l7_log=l7.append)
+    kreq = struct.pack(">ihhih", 20, 3, 4, 77, 6) + b"my-app" + b"\x00\x00"
+    fm.inject(build_tcp("1.1.1.1", "2.2.2.2", 5123, 9092,
+                        TcpFlags.PSH | TcpFlags.ACK, payload=kreq,
+                        seq=1, timestamp_ns=T0))
+    kresp = struct.pack(">ii", 100, 77) + b"\x00" * 20
+    fm.inject(build_tcp("2.2.2.2", "1.1.1.1", 9092, 5123,
+                        TcpFlags.PSH | TcpFlags.ACK, payload=kresp,
+                        seq=1, timestamp_ns=T0 + 5_000_000))
+    fm.flush_all()
+    matched = [r for r in l7 if r.request and r.response]
+    assert len(matched) == 1
+    assert matched[0].request.request_id == 77
+    assert matched[0].response.request_id == 77
+    assert (matched[0].end_ns - matched[0].start_ns) == 5_000_000
+
+
+def test_midstream_flow_promoted_to_established():
+    l4 = []
+    fm = FlowMap(on_l4_log=l4.append)
+    # no SYN observed: plain data packets (agent started mid-connection)
+    fm.inject(build_tcp("9.9.9.9", "8.8.8.8", 44000, 8080,
+                        TcpFlags.PSH | TcpFlags.ACK, payload=b"x",
+                        timestamp_ns=T0))
+    node = next(iter(fm.flows.values()))
+    assert node.state == FlowState.ESTABLISHED
+    # 60s idle: must NOT expire with the 5s INIT timeout
+    fm.tick(T0 + 60_000_000_000)
+    assert not l4
+    # graceful FIN close is labeled fin, not timeout
+    fm.inject(build_tcp("9.9.9.9", "8.8.8.8", 44000, 8080,
+                        TcpFlags.FIN | TcpFlags.ACK,
+                        timestamp_ns=T0 + 61_000_000_000))
+    fm.inject(build_tcp("8.8.8.8", "9.9.9.9", 8080, 44000,
+                        TcpFlags.FIN | TcpFlags.ACK,
+                        timestamp_ns=T0 + 61_100_000_000))
+    fm.tick(T0 + 62_000_000_000)
+    assert len(l4) == 1 and l4[0].close_type == "fin"
