@@ -33,30 +33,45 @@ class Agent:
             queue_size=self.config.sender.queue_size)
         self.sampler: OnCpuSampler | None = None
         self.tpuprobe = None
+        self.synchronizer = None
         self._stats_thread: threading.Thread | None = None
         self._stop = threading.Event()
         self._components: list[str] = []
 
     # -- lifecycle -----------------------------------------------------------
 
+    def start_sampler(self) -> None:
+        self.sampler = OnCpuSampler(
+            self._profile_sink,
+            hz=self.config.profiler.sample_hz,
+            emit_interval_s=self.config.profiler.emit_interval_s,
+            process_name=self.process_name,
+            app_service=self.app_service).start()
+
+    def start_tpuprobe(self) -> None:
+        try:
+            from deepflow_tpu.tpuprobe.probe import TpuProbe
+        except ImportError:
+            log.debug("tpuprobe unavailable")
+            return
+        self.tpuprobe = TpuProbe(self).start()
+
     def start(self) -> "Agent":
         self.sender.start()
         self._components.append("sender")
         if self.config.profiler.enabled:
-            self.sampler = OnCpuSampler(
-                self._profile_sink,
-                hz=self.config.profiler.sample_hz,
-                emit_interval_s=self.config.profiler.emit_interval_s,
-                process_name=self.process_name,
-                app_service=self.app_service).start()
+            self.start_sampler()
             self._components.append("oncpu-sampler")
         if self.config.tpuprobe.enabled:
-            try:
-                from deepflow_tpu.tpuprobe.probe import TpuProbe
-                self.tpuprobe = TpuProbe(self).start()
+            self.start_tpuprobe()
+            if self.tpuprobe is not None:
                 self._components.append("tpuprobe")
-            except ImportError:
-                log.debug("tpuprobe unavailable")
+        if self.config.controller:
+            from deepflow_tpu.agent.synchronizer import Synchronizer
+            self.synchronizer = Synchronizer(
+                self, self.config.controller,
+                interval_s=self.config.sync_interval_s).start()
+            self._components.append("synchronizer")
         self._stats_thread = threading.Thread(
             target=self._stats_loop, name="df-agent-stats", daemon=True)
         self._stats_thread.start()
@@ -66,6 +81,8 @@ class Agent:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.synchronizer:
+            self.synchronizer.stop()
         if self.sampler:
             self.sampler.stop()
         if self.tpuprobe:

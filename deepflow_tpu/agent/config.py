@@ -38,6 +38,7 @@ class SenderConfig:
 class AgentConfig:
     agent_id: int = 0
     app_service: str = ""
+    group: str = "default"        # agent-group for config routing
     controller: str = ""          # host:port; empty = standalone mode
     standalone: bool = True
     profiler: ProfilerConfig = field(default_factory=ProfilerConfig)
@@ -66,6 +67,33 @@ class AgentConfig:
             if f.name in d:
                 setattr(cfg, f.name, d[f.name])
         return cfg
+
+    def validate(self) -> "AgentConfig":
+        """Type/range checks (reference: template.yaml-driven validation)."""
+        def num(v, name, lo=None, hi=None):
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                raise ValueError(f"{name} must be a number, got {v!r}")
+            if lo is not None and v < lo:
+                raise ValueError(f"{name} must be >= {lo}, got {v}")
+            if hi is not None and v > hi:
+                raise ValueError(f"{name} must be <= {hi}, got {v}")
+
+        num(self.profiler.sample_hz, "profiler.sample_hz", 0.1, 10_000)
+        num(self.profiler.emit_interval_s, "profiler.emit_interval_s", 0.01)
+        num(self.tpuprobe.trace_interval_s, "tpuprobe.trace_interval_s", 0.1)
+        num(self.tpuprobe.trace_duration_ms, "tpuprobe.trace_duration_ms", 1)
+        num(self.stats_interval_s, "stats_interval_s", 0.1)
+        num(self.sync_interval_s, "sync_interval_s", 0.1)
+        if self.tpuprobe.source not in ("auto", "xplane", "hooks", "sim"):
+            raise ValueError(
+                f"tpuprobe.source must be auto|xplane|hooks|sim, "
+                f"got {self.tpuprobe.source!r}")
+        for b, name in ((self.profiler.enabled, "profiler.enabled"),
+                        (self.tpuprobe.enabled, "tpuprobe.enabled"),
+                        (self.standalone, "standalone")):
+            if not isinstance(b, bool):
+                raise ValueError(f"{name} must be a bool, got {b!r}")
+        return self
 
     @classmethod
     def load(cls, path: str | None = None) -> "AgentConfig":

@@ -42,6 +42,23 @@ class SamplerStats:
     last_emit_stacks: int = 0
 
 
+# leaf functions that mean the thread is parked, not running: count the
+# sample as off-cpu (blocked time), the reference's OffCPU profiler analog
+_BLOCKING_LEAVES = frozenset({
+    "wait", "get", "put", "sleep", "select", "poll", "epoll", "kqueue",
+    "accept", "recv", "recvfrom", "recv_into", "read", "readinto", "readline",
+    "acquire", "join", "wait_for", "settimeout", "flush", "dowait",
+    "_recv_bytes", "poll_once", "getaddrinfo", "connect", "sendall",
+})
+
+
+def classify_sample(stack: str) -> str:
+    """on-cpu vs off-cpu by leaf frame (mod.func -> func)."""
+    leaf = stack.rsplit(";", 1)[-1]
+    func = leaf.rsplit(".", 1)[-1]
+    return "off-cpu" if func in _BLOCKING_LEAVES else "on-cpu"
+
+
 def fold_frame(frame) -> str:
     code = frame.f_code
     mod = frame.f_globals.get("__name__", "?")
@@ -63,7 +80,9 @@ class OnCpuSampler:
     """99 Hz (default) Python-stack sampler with windowed aggregation."""
 
     def __init__(self, sink, hz: float = 99.0, emit_interval_s: float = 1.0,
-                 process_name: str = "", app_service: str = "") -> None:
+                 process_name: str = "", app_service: str = "",
+                 include_agent_threads: bool = False) -> None:
+        self.include_agent_threads = include_agent_threads
         self.sink = sink
         self.period_s = 1.0 / hz
         self.period_us = int(1_000_000 / hz)
@@ -114,12 +133,15 @@ class OnCpuSampler:
         for tid, frame in sys._current_frames().items():
             if tid == my_tid:
                 continue
+            name = names.get(tid, str(tid))
+            if not self.include_agent_threads and name.startswith("df-"):
+                continue  # never profile our own plumbing by default
             stack = fold_stack(frame)
             if not stack:
                 continue
             key = (tid, stack)
             self._agg[key] = self._agg.get(key, 0) + 1
-            self._thread_names[tid] = names.get(tid, str(tid))
+            self._thread_names[tid] = name
             self.stats.samples += 1
 
     def _emit(self) -> None:
@@ -131,7 +153,8 @@ class OnCpuSampler:
             ProfileSample(
                 timestamp_ns=ts, pid=self.pid, tid=tid,
                 thread_name=self._thread_names.get(tid, str(tid)),
-                stack=stack, count=n, value_us=n * self.period_us)
+                stack=stack, count=n, value_us=n * self.period_us,
+                event_type=classify_sample(stack))
             for (tid, stack), n in agg.items()
         ]
         self.stats.emits += 1

@@ -1,0 +1,164 @@
+"""Agent-side controller sync loop.
+
+Reference analog: agent/src/rpc/synchronizer.rs (run :1921 — periodic Sync,
+on_response :1135 config diff + hot apply). Hand-built gRPC method calls
+(no generated stubs on this image).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import threading
+
+import grpc
+
+from deepflow_tpu.proto import pb
+
+log = logging.getLogger("df.sync")
+
+_SYNC = "/deepflow_tpu.Synchronizer/Sync"
+_GPID = "/deepflow_tpu.Synchronizer/GpidSync"
+
+
+class Synchronizer:
+    def __init__(self, agent, controller_addr: str,
+                 interval_s: float = 10.0) -> None:
+        self.agent = agent
+        self.addr = controller_addr
+        self.interval_s = interval_s
+        self._channel: grpc.Channel | None = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.config_version = 0
+        self.platform_version = 0
+        self._platform_cache: pb.PlatformData | None = None
+        self.stats = {"syncs": 0, "errors": 0, "config_updates": 0}
+
+    def start(self) -> "Synchronizer":
+        self._channel = grpc.insecure_channel(self.addr)
+        self._thread = threading.Thread(
+            target=self._run, name="df-synchronizer", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2.0)
+        if self._channel:
+            self._channel.close()
+
+    def _run(self) -> None:
+        # first sync immediately, then on the interval
+        while True:
+            try:
+                self.sync_once()
+            except Exception as e:
+                self.stats["errors"] += 1
+                log.debug("sync failed: %s", e)
+            if self._stop.wait(self.interval_s):
+                return
+
+    def sync_once(self) -> pb.SyncResponse:
+        req = pb.SyncRequest()
+        req.ctrl_ip = _local_ip()
+        req.hostname = socket.gethostname()
+        req.agent_id = self.agent.config.agent_id
+        req.config_version = self.config_version
+        req.platform_version = self.platform_version
+        req.state = pb.RUNNING
+        req.version = "0.1.0"
+        req.agent_group = getattr(self.agent.config, "group", "") or "default"
+        # collect topology once, but RE-SEND every sync: a restarted
+        # controller must be able to rebuild its platform/gpid state from
+        # long-lived agents (the request is tiny)
+        if self._platform_cache is None:
+            from deepflow_tpu.tpuprobe.topology import collect_platform_data
+            self._platform_cache = collect_platform_data()
+        req.platform.CopyFrom(self._platform_cache)
+        p = req.processes.add()
+        p.pid = os.getpid()
+        p.name = self.agent.process_name
+        call = self._channel.unary_unary(
+            _SYNC,
+            request_serializer=pb.SyncRequest.SerializeToString,
+            response_deserializer=pb.SyncResponse.FromString)
+        resp = call(req, timeout=5.0)
+        self.stats["syncs"] += 1
+        self._on_response(resp)
+        return resp
+
+    def _on_response(self, resp: pb.SyncResponse) -> None:
+        if resp.agent_id and resp.agent_id != self.agent.config.agent_id:
+            self.agent.config.agent_id = resp.agent_id
+            self.agent.sender.agent_id = resp.agent_id
+        if resp.user_config_yaml and \
+                resp.config_version != self.config_version:
+            self._apply_config(resp.user_config_yaml, resp.config_version)
+            self.config_version = resp.config_version
+            self.stats["config_updates"] += 1
+        self.platform_version = resp.platform_version
+
+    def _apply_config(self, yaml_bytes: bytes, version: int) -> None:
+        """Hot-apply the pushed config (reference: ConfigHandler per-module
+        callbacks): sampler rate, probe cadence, AND enable/disable take
+        effect live."""
+        import yaml
+        from deepflow_tpu.agent.config import AgentConfig
+        try:
+            new = AgentConfig.from_dict(
+                yaml.safe_load(yaml_bytes) or {}).validate()
+        except Exception as e:
+            log.warning("rejecting bad pushed config: %s", e)
+            return
+        cfg = self.agent.config
+        cfg.profiler = new.profiler
+        cfg.tpuprobe = new.tpuprobe
+        cfg.stats_interval_s = new.stats_interval_s
+
+        sampler = self.agent.sampler
+        if new.profiler.enabled and sampler is None:
+            self.agent.start_sampler()
+        elif not new.profiler.enabled and sampler is not None:
+            sampler.stop()
+            self.agent.sampler = None
+        elif sampler is not None:
+            sampler.period_s = 1.0 / new.profiler.sample_hz
+            sampler.period_us = int(1_000_000 / new.profiler.sample_hz)
+            sampler.emit_interval_s = new.profiler.emit_interval_s
+
+        probe = self.agent.tpuprobe
+        if new.tpuprobe.enabled and probe is None:
+            self.agent.start_tpuprobe()
+        elif not new.tpuprobe.enabled and probe is not None:
+            probe.stop()
+            self.agent.tpuprobe = None
+        elif probe is not None:
+            for src in probe.sources:
+                if hasattr(src, "interval_s"):
+                    src.interval_s = new.tpuprobe.trace_interval_s
+                    src.duration_ms = new.tpuprobe.trace_duration_ms
+        log.info("applied pushed config v%d", version)
+
+    def gpid_sync(self, entries: list[pb.GpidEntry]) -> pb.GpidSyncResponse:
+        req = pb.GpidSyncRequest()
+        req.agent_id = self.agent.config.agent_id
+        req.entries.extend(entries)
+        call = self._channel.unary_unary(
+            _GPID,
+            request_serializer=pb.GpidSyncRequest.SerializeToString,
+            response_deserializer=pb.GpidSyncResponse.FromString)
+        return call(req, timeout=5.0)
+
+
+def _local_ip() -> str:
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("10.255.255.255", 1))
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except OSError:
+        return "127.0.0.1"

@@ -1,0 +1,4 @@
+"""CLI tools: dfctl (operator CLI) and deepflow-run (zero-code attach).
+
+Reference analog: cli/ctl (deepflow-ctl cobra CLI, cli/ctl/agent.go:49).
+"""

@@ -1,0 +1,139 @@
+"""dfctl: operator CLI against the querier/controller HTTP API.
+
+Reference analog: cli/ctl/*.go (deepflow-ctl). Subcommands:
+
+    dfctl health
+    dfctl agent list
+    dfctl agent-group-config set config.yaml
+    dfctl query "SELECT ..." --db profile
+    dfctl flame --service my-svc [--event-type on-cpu]
+    dfctl tpu-flame [--device 0]
+    dfctl replay capture.pcap --server host:20033
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.request
+
+
+def _api(server: str, path: str, body: dict | None = None) -> dict:
+    url = f"http://{server}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        err = e.read().decode("utf-8", "replace")
+        raise SystemExit(f"error {e.code}: {err}")
+    except urllib.error.URLError as e:
+        raise SystemExit(f"cannot reach {url}: {e.reason}")
+
+
+def print_table(columns: list[str], rows: list[list]) -> None:
+    if not rows:
+        print("(no rows)")
+        return
+    widths = [max(len(str(c)), *(len(str(r[i])) for r in rows))
+              for i, c in enumerate(columns)]
+    print("  ".join(str(c).ljust(w) for c, w in zip(columns, widths)))
+    print("  ".join("-" * w for w in widths))
+    for r in rows:
+        print("  ".join(str(v).ljust(w) for v, w in zip(r, widths)))
+
+
+def print_flame(node: dict, depth: int = 0, total: int | None = None,
+                max_depth: int = 12) -> None:
+    if total is None:
+        total = node["total_value"] or 1
+    if depth > max_depth:
+        return
+    pct = 100.0 * node["total_value"] / total
+    bar = "▇" * max(1, int(pct / 5)) if depth else ""
+    print(f"{'  ' * depth}{node['name']}  {node['total_value']:,} "
+          f"({pct:.1f}%) {bar}")
+    for child in node.get("children", [])[:20]:
+        print_flame(child, depth + 1, total, max_depth)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="dfctl")
+    parser.add_argument("--server", default="127.0.0.1:20416",
+                        help="querier host:port")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    sub.add_parser("health")
+
+    p_agent = sub.add_parser("agent")
+    p_agent.add_argument("action", choices=["list"])
+
+    p_cfg = sub.add_parser("agent-group-config")
+    p_cfg.add_argument("action", choices=["set"])
+    p_cfg.add_argument("file")
+    p_cfg.add_argument("--group", default="default")
+
+    p_query = sub.add_parser("query")
+    p_query.add_argument("sql")
+    p_query.add_argument("--db", default="")
+
+    p_flame = sub.add_parser("flame")
+    p_flame.add_argument("--service", default=None)
+    p_flame.add_argument("--event-type", default="on-cpu")
+
+    p_tpu = sub.add_parser("tpu-flame")
+    p_tpu.add_argument("--device", type=int, default=None)
+
+    p_replay = sub.add_parser("replay")
+    p_replay.add_argument("pcap")
+    p_replay.add_argument("--ingest", default="127.0.0.1:20033")
+
+    args = parser.parse_args(argv)
+
+    if args.cmd == "health":
+        h = _api(args.server, "/v1/health")
+        print(json.dumps(h, indent=2))
+    elif args.cmd == "agent":
+        out = _api(args.server, "/v1/agents")
+        rows = [[a["agent_id"], a["hostname"], a["ctrl_ip"],
+                 a["last_seen_ns"]] for a in out["agents"]]
+        print_table(["ID", "HOSTNAME", "CTRL_IP", "LAST_SEEN_NS"], rows)
+    elif args.cmd == "agent-group-config":
+        with open(args.file) as f:
+            yaml_text = f.read()
+        out = _api(args.server, "/v1/agent-group-config",
+                   {"group": args.group, "yaml": yaml_text})
+        print(f"group {out['group']} -> version {out['version']}")
+    elif args.cmd == "query":
+        out = _api(args.server, "/v1/query/",
+                   {"db": args.db, "sql": args.sql})
+        r = out["result"]
+        print_table(r["columns"], r["values"])
+    elif args.cmd == "flame":
+        body = {"event_type": args.event_type}
+        if args.service:
+            body["app_service"] = args.service
+        out = _api(args.server, "/v1/profile/ProfileTracing", body)
+        print_flame(out["result"])
+    elif args.cmd == "tpu-flame":
+        body = {}
+        if args.device is not None:
+            body["device_id"] = args.device
+        out = _api(args.server, "/v1/profile/TpuFlame", body)
+        print_flame(out["result"])
+    elif args.cmd == "replay":
+        from deepflow_tpu.agent.dispatcher import Dispatcher
+        from deepflow_tpu.agent.sender import UniformSender
+        sender = UniformSender([args.ingest]).start()
+        disp = Dispatcher(sender=sender)
+        n = disp.replay_pcap(args.pcap)
+        sender.flush_and_stop()
+        print(f"replayed {n} packets: {disp.flow_map.stats}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,48 @@
+"""deepflow-run: zero-code instrumentation launcher.
+
+    python -m deepflow_tpu.cli.runner [--server H:P] [--service NAME] \
+        script.py [args...]
+
+Attaches the in-process agent (OnCPU sampler + TPU probe) before handing
+control to the target script via runpy — the workload needs no code change.
+Reference analog: the agent's zero-intrusion stance; in-process because TPU
+workloads are long-lived Python processes and the xplane probe must live
+inside them.
+"""
+
+from __future__ import annotations
+
+import argparse
+import runpy
+import sys
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(prog="deepflow-run")
+    parser.add_argument("--server", default="127.0.0.1:20033")
+    parser.add_argument("--controller", default="")
+    parser.add_argument("--service", default="")
+    parser.add_argument("-m", dest="module", action="store_true",
+                        help="run target as a module (python -m style)")
+    parser.add_argument("target")
+    parser.add_argument("args", nargs=argparse.REMAINDER)
+    opts = parser.parse_args()
+
+    from deepflow_tpu.agent.agent import attach, detach
+    attach(app_service=opts.service or opts.target,
+           servers=[opts.server], controller=opts.controller)
+
+    sys.argv = [opts.target] + opts.args
+    try:
+        if opts.module:
+            runpy.run_module(opts.target, run_name="__main__",
+                             alter_sys=True)
+        else:
+            runpy.run_path(opts.target, run_name="__main__")
+        return 0
+    finally:
+        detach()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,242 @@
+"""Controller: agent management over gRPC (trisolaris-lite).
+
+Reference analog: server/controller/trisolaris (sync_push.go:166 AgentEvent.
+Sync — per-agent SyncResponse with versioned config + platform data) and
+trisolaris/services/grpc/agentsynchronize/process_info.go (GPID allocation).
+gRPC service methods are hand-registered (generic handlers) because the
+image has protoc but not grpcio-tools.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from concurrent import futures
+
+import grpc
+
+from deepflow_tpu.proto import pb
+from deepflow_tpu.server.platform_info import AgentInfo, PlatformInfoTable
+
+log = logging.getLogger("df.controller")
+
+DEFAULT_AGENT_CONFIG_YAML = b"""\
+# deepflow-tpu rendered agent config (controller-pushed)
+profiler:
+  enabled: true
+  sample_hz: 99.0
+  emit_interval_s: 1.0
+tpuprobe:
+  enabled: true
+  source: auto
+  trace_interval_s: 10.0
+  trace_duration_ms: 1000
+stats_interval_s: 10.0
+"""
+
+
+class AgentRegistry:
+    """Agent identity + state; the vtap cache analog."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._by_key: dict[tuple, dict] = {}
+        self._next_id = 1
+
+    def register(self, ctrl_ip: str, hostname: str, agent_id: int) -> dict:
+        key = (ctrl_ip, hostname)
+        with self._lock:
+            entry = self._by_key.get(key)
+            if entry is None:
+                entry = {
+                    "agent_id": agent_id or self._next_id,
+                    "ctrl_ip": ctrl_ip,
+                    "hostname": hostname,
+                    "first_seen_ns": time.time_ns(),
+                }
+                if not agent_id:
+                    self._next_id += 1
+                else:
+                    self._next_id = max(self._next_id, agent_id + 1)
+                self._by_key[key] = entry
+            entry["last_seen_ns"] = time.time_ns()
+            return entry
+
+    def list(self) -> list[dict]:
+        with self._lock:
+            return [dict(v) for v in self._by_key.values()]
+
+
+class GpidAllocator:
+    """Global process IDs: (agent_id, pid) -> gpid, plus the 5-tuple table
+    that lets the ingester join client/server sides of one connection
+    (reference §2.8 GPID glue)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._gpids: dict[tuple, int] = {}
+        self._entries: dict[tuple, pb.GpidEntry] = {}
+        self._next = 1
+
+    def gpid_for(self, agent_id: int, pid: int) -> int:
+        key = (agent_id, pid)
+        with self._lock:
+            g = self._gpids.get(key)
+            if g is None:
+                g = self._next
+                self._next += 1
+                self._gpids[key] = g
+            return g
+
+    def sync(self, req: pb.GpidSyncRequest) -> pb.GpidSyncResponse:
+        with self._lock:
+            for e in req.entries:
+                e.gpid = self._gpids.get((req.agent_id, e.pid), 0) or \
+                    self._alloc_locked(req.agent_id, e.pid)
+                self._entries[(bytes(e.ip), e.port, int(e.proto),
+                               e.role)] = e
+            resp = pb.GpidSyncResponse()
+            resp.entries.extend(self._entries.values())
+            return resp
+
+    def _alloc_locked(self, agent_id: int, pid: int) -> int:
+        g = self._next
+        self._next += 1
+        self._gpids[(agent_id, pid)] = g
+        return g
+
+
+class ConfigStore:
+    """Versioned agent-group configs (reference: agent-group config YAML
+    validated against the template; push on version bump)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._configs: dict[str, tuple[bytes, int]] = {
+            "default": (DEFAULT_AGENT_CONFIG_YAML, 1)}
+
+    def get(self, group: str = "default") -> tuple[bytes, int]:
+        with self._lock:
+            return self._configs.get(group, self._configs["default"])
+
+    def update(self, group: str, yaml_bytes: bytes) -> int:
+        self.validate(yaml_bytes)
+        with self._lock:
+            _, version = self._configs.get(group, (b"", 0))
+            version += 1
+            self._configs[group] = (yaml_bytes, version)
+            return version
+
+    @staticmethod
+    def validate(yaml_bytes: bytes) -> None:
+        import yaml
+        from deepflow_tpu.agent.config import AgentConfig
+        data = yaml.safe_load(yaml_bytes) or {}
+        if not isinstance(data, dict):
+            raise ValueError("agent config must be a YAML mapping")
+        AgentConfig.from_dict(data).validate()
+
+
+class Controller:
+    """The gRPC Synchronizer service + shared state."""
+
+    def __init__(self, platform_table: PlatformInfoTable,
+                 host: str = "127.0.0.1", port: int = 20035) -> None:
+        self.platform_table = platform_table
+        self.registry = AgentRegistry()
+        self.gpids = GpidAllocator()
+        self.configs = ConfigStore()
+        self.host = host
+        self.port = port
+        self._server: grpc.Server | None = None
+        # cluster-wide platform snapshot (genesis -> recorder analog)
+        self._platform_lock = threading.Lock()
+        self._platforms: dict[int, pb.PlatformData] = {}
+        self._platform_version = 1
+
+    # -- rpc handlers ---------------------------------------------------------
+
+    def Sync(self, request: pb.SyncRequest, context) -> pb.SyncResponse:
+        entry = self.registry.register(
+            request.ctrl_ip, request.hostname, request.agent_id)
+        agent_id = entry["agent_id"]
+        resp = pb.SyncResponse()
+        resp.status = pb.SUCCESS
+        resp.agent_id = agent_id
+
+        cfg, version = self.configs.get(request.agent_group or "default")
+        if request.config_version != version:
+            resp.user_config_yaml = cfg
+        resp.config_version = version
+
+        if request.HasField("platform"):
+            self._ingest_platform(agent_id, request.platform)
+        for proc in request.processes:
+            self.gpids.gpid_for(agent_id, proc.pid)
+
+        with self._platform_lock:
+            # version only: agents pull the snapshot when they grow a
+            # policy/labeler consumer for it (reference pushes full
+            # platform data because its agents label packets with it)
+            resp.platform_version = self._platform_version
+        return resp
+
+    def GpidSync(self, request: pb.GpidSyncRequest,
+                 context) -> pb.GpidSyncResponse:
+        return self.gpids.sync(request)
+
+    def _ingest_platform(self, agent_id: int, p: pb.PlatformData) -> None:
+        """Genesis upload -> platform snapshot + ingester tag table."""
+        with self._platform_lock:
+            prev = self._platforms.get(agent_id)
+            if prev is None or prev.SerializeToString() != \
+                    p.SerializeToString():
+                self._platforms[agent_id] = pb.PlatformData()
+                self._platforms[agent_id].CopyFrom(p)
+                self._platform_version += 1
+        self.platform_table.update(AgentInfo(
+            agent_id=agent_id,
+            host=p.hostname,
+            pod_name=p.pod_name,
+            pod_ns=p.pod_namespace,
+            tpu_pod=p.tpu_pod_name,
+            tpu_worker=int(p.tpu_worker_id or 0),
+            slice_id=p.devices[0].slice_id if p.devices else 0,
+        ))
+
+    def _merged_platform_locked(self) -> pb.PlatformData:
+        merged = pb.PlatformData()
+        for p in self._platforms.values():
+            merged.devices.extend(p.devices)
+            merged.slice_count = max(merged.slice_count, p.slice_count)
+        return merged
+
+    # -- server lifecycle -----------------------------------------------------
+
+    def start(self) -> "Controller":
+        handlers = {
+            "Sync": grpc.unary_unary_rpc_method_handler(
+                self.Sync,
+                request_deserializer=pb.SyncRequest.FromString,
+                response_serializer=pb.SyncResponse.SerializeToString),
+            "GpidSync": grpc.unary_unary_rpc_method_handler(
+                self.GpidSync,
+                request_deserializer=pb.GpidSyncRequest.FromString,
+                response_serializer=pb.GpidSyncResponse.SerializeToString),
+        }
+        generic = grpc.method_handlers_generic_handler(
+            "deepflow_tpu.Synchronizer", handlers)
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=8))
+        self._server.add_generic_rpc_handlers((generic,))
+        self.port = self._server.add_insecure_port(
+            f"{self.host}:{self.port}")
+        self._server.start()
+        log.info("controller sync up on :%d", self.port)
+        return self
+
+    def stop(self) -> None:
+        if self._server:
+            self._server.stop(grace=0.5)
+            self._server = None

@@ -11,6 +11,8 @@ import logging
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+import yaml
+
 from deepflow_tpu.query import engine as qengine
 from deepflow_tpu.query import sql as qsql
 from deepflow_tpu.query.flamegraph import profile_flame_tree
@@ -22,9 +24,11 @@ log = logging.getLogger("df.querier")
 class QuerierAPI:
     """Route logic, separated from HTTP plumbing for in-process use."""
 
-    def __init__(self, db: Database, stats_provider=None) -> None:
+    def __init__(self, db: Database, stats_provider=None,
+                 controller=None) -> None:
         self.db = db
         self.stats_provider = stats_provider or (lambda: {})
+        self.controller = controller
 
     def query(self, body: dict) -> dict:
         sql_text = body.get("sql", "")
@@ -82,6 +86,26 @@ class QuerierAPI:
             values.append(int(d))
         return {"result": build_flame_tree(stacks, values).to_dict()}
 
+    def agents(self) -> dict:
+        """Agent fleet listing (reference: deepflow-ctl agent list)."""
+        if self.controller is None:
+            return {"agents": []}
+        out = [{
+            "agent_id": a["agent_id"],
+            "hostname": a["hostname"],
+            "ctrl_ip": a["ctrl_ip"],
+            "last_seen_ns": a.get("last_seen_ns", 0),
+        } for a in self.controller.registry.list()]
+        return {"agents": out}
+
+    def update_agent_config(self, body: dict) -> dict:
+        if self.controller is None:
+            raise qengine.QueryError("controller not running")
+        group = body.get("group", "default")
+        yaml_text = body.get("yaml", "")
+        version = self.controller.configs.update(group, yaml_text.encode())
+        return {"group": group, "version": version}
+
     def health(self) -> dict:
         return {
             "status": "ok",
@@ -121,8 +145,11 @@ class QuerierHTTP:
                 return json.loads(self.rfile.read(n) or b"{}")
 
             def do_GET(self) -> None:
-                if self.path.rstrip("/") in ("/v1/health", "/health"):
+                path = self.path.rstrip("/")
+                if path in ("/v1/health", "/health"):
                     self._send(200, api.health())
+                elif path == "/v1/agents":
+                    self._send(200, api.agents())
                 else:
                     self._send(404, {"error": f"no route {self.path}"})
 
@@ -136,10 +163,13 @@ class QuerierHTTP:
                         self._send(200, api.profile_tracing(body))
                     elif path == "/v1/profile/TpuFlame":
                         self._send(200, api.tpu_flame(body))
+                    elif path == "/v1/agent-group-config":
+                        self._send(200, api.update_agent_config(body))
                     else:
                         self._send(404, {"error": f"no route {self.path}"})
                 except (qengine.QueryError, qsql.SqlError, KeyError,
-                        json.JSONDecodeError, ValueError) as e:
+                        json.JSONDecodeError, ValueError,
+                        yaml.YAMLError) as e:
                     self._send(400, {"error": str(e)})
                 except Exception as e:  # pragma: no cover
                     log.exception("querier 500")

@@ -30,17 +30,18 @@ class Server:
         self.platform = PlatformInfoTable()
         self.receiver = Receiver(host=host, port=ingest_port)
         self.decoders = []
-        self.api = QuerierAPI(self.db, stats_provider=self._stats)
-        self.http = QuerierHTTP(self.api, host=host, port=query_port)
         self.controller = None
         if enable_controller:
             try:
                 from deepflow_tpu.server.controller import Controller
-            except ImportError:  # controller lands with the control plane
-                log.warning("controller module unavailable; sync disabled")
+            except ImportError as e:  # no grpcio: degrade, keep ingest+query
+                log.warning("controller disabled (%s)", e)
             else:
                 self.controller = Controller(
                     self.platform, host=host, port=sync_port)
+        self.api = QuerierAPI(self.db, stats_provider=self._stats,
+                              controller=self.controller)
+        self.http = QuerierHTTP(self.api, host=host, port=query_port)
         self._started = False
 
     def _stats(self) -> dict:

@@ -1,0 +1,184 @@
+"""Control plane tests: gRPC sync, config push, GPID, tag injection."""
+
+import time
+
+import pytest
+
+from deepflow_tpu.agent.agent import Agent
+from deepflow_tpu.agent.config import AgentConfig
+from deepflow_tpu.proto import pb
+from deepflow_tpu.server import Server
+
+
+@pytest.fixture
+def server():
+    s = Server(host="127.0.0.1", ingest_port=0, query_port=0, sync_port=0,
+               enable_controller=True).start()
+    yield s
+    s.stop()
+
+
+def make_agent(server, **kw):
+    cfg = AgentConfig()
+    cfg.sender.servers = [("127.0.0.1", server.ingest_port)]
+    cfg.controller = f"127.0.0.1:{server.controller.port}"
+    cfg.standalone = False
+    cfg.profiler.enabled = False
+    cfg.tpuprobe.enabled = False
+    cfg.sync_interval_s = 0.2
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    return Agent(cfg)
+
+
+def test_sync_assigns_agent_id_and_platform(server):
+    agent = make_agent(server).start()
+    try:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and \
+                agent.synchronizer.stats["syncs"] == 0:
+            time.sleep(0.05)
+        assert agent.synchronizer.stats["syncs"] >= 1
+        assert agent.config.agent_id == 1
+        assert agent.sender.agent_id == 1
+        # platform data reached the ingester tag table
+        info = server.platform.query(1)
+        assert info.host  # hostname recorded
+        agents = server.controller.registry.list()
+        assert len(agents) == 1 and agents[0]["agent_id"] == 1
+    finally:
+        agent.stop()
+
+
+def test_config_push_hot_applies(server):
+    agent = make_agent(server).start()
+    agent.config.profiler.enabled = True  # pretend sampler running
+    from deepflow_tpu.agent.profiler import OnCpuSampler
+    agent.sampler = OnCpuSampler(lambda b: None, hz=99.0)
+    try:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and \
+                agent.synchronizer.stats["config_updates"] == 0:
+            time.sleep(0.05)
+        assert agent.synchronizer.config_version == 1
+
+        new_yaml = b"profiler:\n  sample_hz: 250.0\n  emit_interval_s: 0.5\n"
+        v = server.controller.configs.update("default", new_yaml)
+        assert v == 2
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and \
+                agent.synchronizer.config_version != 2:
+            time.sleep(0.05)
+        assert agent.synchronizer.config_version == 2
+        assert agent.config.profiler.sample_hz == 250.0
+        assert agent.sampler.period_us == 4000
+    finally:
+        agent.stop()
+
+
+def test_config_validation_rejects_garbage(server):
+    with pytest.raises(Exception):
+        server.controller.configs.update("default", b"- just\n- a list\n")
+    with pytest.raises(Exception):
+        server.controller.configs.update(
+            "default", b"profiler:\n  sample_hz: not_a_number\n")
+
+
+def test_gpid_sync(server):
+    agent = make_agent(server).start()
+    try:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and \
+                agent.synchronizer.stats["syncs"] == 0:
+            time.sleep(0.05)
+        e = pb.GpidEntry()
+        e.pid = 4242
+        e.ip = b"\x0a\x00\x00\x01"
+        e.port = 8080
+        e.proto = pb.TCP
+        e.role = 1
+        resp = agent.synchronizer.gpid_sync([e])
+        assert len(resp.entries) == 1
+        assert resp.entries[0].gpid > 0
+        # same (agent, pid) keeps its gpid
+        resp2 = agent.synchronizer.gpid_sync([e])
+        assert resp2.entries[0].gpid == resp.entries[0].gpid
+    finally:
+        agent.stop()
+
+
+def test_tag_injection_uses_sync_platform(server):
+    """Rows ingested after sync carry the host tag from platform data."""
+    agent = make_agent(server).start()
+    try:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and \
+                agent.synchronizer.stats["syncs"] == 0:
+            time.sleep(0.05)
+        batch = pb.EventBatch()
+        ev = batch.events.add()
+        ev.event_type = "test"
+        ev.timestamp_ns = time.time_ns()
+        from deepflow_tpu.codec import MessageType
+        agent.sender.send(MessageType.EVENT, batch.SerializeToString())
+        assert server.wait_for_rows("event.event", 1)
+        t = server.db.table("event.event")
+        cols = t.column_concat(["host", "agent_id"])
+        host = t.dicts["host"].decode(int(cols["host"][0]))
+        assert host != ""
+        assert cols["agent_id"].tolist() == [1]
+    finally:
+        agent.stop()
+
+
+def test_group_config_routing(server):
+    server.controller.configs.update("prod", b"profiler:\n  sample_hz: 42.0\n")
+    agent = make_agent(server, group="prod")
+    agent.start()
+    try:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and \
+                agent.synchronizer.stats["config_updates"] == 0:
+            time.sleep(0.05)
+        assert agent.config.profiler.sample_hz == 42.0
+    finally:
+        agent.stop()
+
+
+def test_enable_flag_hot_applies(server):
+    agent = make_agent(server).start()
+    try:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and \
+                agent.synchronizer.stats["config_updates"] == 0:
+            time.sleep(0.05)
+        # default config enables the profiler -> sampler was started
+        assert agent.sampler is not None
+        server.controller.configs.update(
+            "default", b"profiler:\n  enabled: false\n"
+                       b"tpuprobe:\n  enabled: false\n")
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and agent.sampler is not None:
+            time.sleep(0.05)
+        assert agent.sampler is None
+    finally:
+        agent.stop()
+
+
+def test_controller_restart_recovers_platform(server):
+    agent = make_agent(server).start()
+    try:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and \
+                agent.synchronizer.stats["syncs"] == 0:
+            time.sleep(0.05)
+        # simulate controller state loss
+        server.controller._platforms.clear()
+        server.platform._agents.clear()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and \
+                not server.platform.query(1).host:
+            time.sleep(0.05)
+        assert server.platform.query(1).host  # repopulated by re-sent sync
+    finally:
+        agent.stop()

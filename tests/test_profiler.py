@@ -54,3 +54,23 @@ def test_fold_stack_depth_cap():
         return deep(n - 1)
     st = deep(50)
     assert len(st.split(";")) == 16
+
+
+def test_classify_and_agent_thread_exclusion():
+    from deepflow_tpu.agent.profiler import classify_sample
+    assert classify_sample("m.main;q.get") == "off-cpu"
+    assert classify_sample("m.main;threading.wait") == "off-cpu"
+    assert classify_sample("m.main;m.fib") == "on-cpu"
+
+    # agent's own df- threads are excluded from samples
+    batches = []
+    s = OnCpuSampler(batches.append, hz=200.0, emit_interval_s=0.2)
+    agentish = threading.Thread(target=lambda: time.sleep(1.0),
+                                name="df-uniform-sender")
+    agentish.start()
+    s.start()
+    time.sleep(0.6)
+    s.stop()
+    agentish.join()
+    samples = [p for b in batches for p in b]
+    assert all(not p.thread_name.startswith("df-") for p in samples)
