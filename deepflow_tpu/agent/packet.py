@@ -139,9 +139,13 @@ PCAP_MAGIC_US_LE = 0xA1B2C3D4
 PCAP_MAGIC_NS_LE = 0xA1B23C4D
 
 
-def read_pcap(path: str) -> list[MetaPacket]:
-    """Own pcap reader — no libpcap dependency. Returns decoded packets."""
-    out = []
+def read_pcap(path: str, use_native: bool = True) -> list[MetaPacket]:
+    """Own pcap reader — no libpcap dependency. Returns decoded packets.
+
+    When libdfnative.so is available, IPv4 frames decode through the C++
+    batch fast path; v6/vlan/other frames fall back to the Python decoder.
+    """
+    raw: list[tuple[bytes, int, int]] = []  # (frame, ts_ns, orig_len)
     with open(path, "rb") as f:
         hdr = f.read(24)
         if len(hdr) < 24:
@@ -166,10 +170,49 @@ def read_pcap(path: str) -> list[MetaPacket]:
             if len(data) < incl:
                 break
             ts_ns = ts_sec * 1_000_000_000 + ts_frac * scale
-            mp = decode_ethernet(data, timestamp_ns=ts_ns)
-            if mp is not None:
-                mp.packet_len = orig
-                out.append(mp)
+            raw.append((data, ts_ns, orig))
+
+    out: list[MetaPacket] = []
+    decoded = None
+    if use_native:
+        try:
+            from deepflow_tpu.native import decode_eth_batch
+            decoded = decode_eth_batch([r[0] for r in raw])
+        except Exception:
+            decoded = None
+    if decoded is not None:
+        recs, ok = decoded
+        # column-wise extraction once (structured-scalar access is slow)
+        cols = {name: recs[name].tolist() for name in
+                ("ip_src", "ip_dst", "port_src", "port_dst", "protocol",
+                 "tcp_flags", "window", "seq", "ack", "payload_off",
+                 "payload_len")}
+        ok_l = ok.tolist()
+        for i, (data, ts_ns, orig) in enumerate(raw):
+            if ok_l[i]:
+                po = cols["payload_off"][i]
+                pl = cols["payload_len"][i]
+                out.append(MetaPacket(
+                    timestamp_ns=ts_ns,
+                    ip_src=cols["ip_src"][i].to_bytes(4, "big"),
+                    ip_dst=cols["ip_dst"][i].to_bytes(4, "big"),
+                    port_src=cols["port_src"][i],
+                    port_dst=cols["port_dst"][i],
+                    protocol=cols["protocol"][i],
+                    tcp_flags=cols["tcp_flags"][i], seq=cols["seq"][i],
+                    ack=cols["ack"][i], window=cols["window"][i],
+                    payload=data[po:po + pl], packet_len=orig))
+            else:  # v6 / vlan / odd frames: Python slow path
+                mp = decode_ethernet(data, timestamp_ns=ts_ns)
+                if mp is not None:
+                    mp.packet_len = orig
+                    out.append(mp)
+        return out
+    for data, ts_ns, orig in raw:
+        mp = decode_ethernet(data, timestamp_ns=ts_ns)
+        if mp is not None:
+            mp.packet_len = orig
+            out.append(mp)
     return out
 
 

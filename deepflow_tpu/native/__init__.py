@@ -1,0 +1,163 @@
+"""ctypes bindings for libdfnative.so (C++ hot paths) with pure-Python
+fallback. Build: `make -C deepflow_tpu/native` (auto-attempted on first
+import; failures leave the Python paths in charge)."""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+
+import numpy as np
+
+log = logging.getLogger("df.native")
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libdfnative.so")
+_lib = None
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(["make", "-C", _DIR], capture_output=True,
+                       timeout=120, check=True)
+        return True
+    except Exception as e:
+        log.debug("dfnative build failed: %s", e)
+        return False
+
+
+def load():
+    """Load (building first — make is mtime-based so a fresh dfnative.cpp
+    always rebuilds). Returns the ctypes lib or None."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not _build() and not os.path.exists(_SO):
+        return None
+    try:
+        lib = ctypes.CDLL(_SO)
+    except OSError as e:
+        log.debug("dfnative load failed: %s", e)
+        return None
+    lib.df_dict_new.restype = ctypes.c_void_p
+    lib.df_dict_free.argtypes = [ctypes.c_void_p]
+    lib.df_dict_len.argtypes = [ctypes.c_void_p]
+    lib.df_dict_len.restype = ctypes.c_uint64
+    lib.df_dict_encode_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p,
+        np.ctypeslib.ndpointer(np.uint32), ctypes.c_uint32,
+        np.ctypeslib.ndpointer(np.uint32)]
+    lib.df_dict_lookup.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_uint32]
+    lib.df_dict_lookup.restype = ctypes.c_uint32
+    lib.df_dict_get.argtypes = [ctypes.c_void_p, ctypes.c_uint32,
+                                ctypes.c_char_p, ctypes.c_uint32]
+    lib.df_dict_get.restype = ctypes.c_int32
+    lib.df_dict_load.argtypes = lib.df_dict_encode_batch.argtypes[:4]
+    lib.df_decode_eth.argtypes = [ctypes.c_char_p, ctypes.c_uint32,
+                                  ctypes.c_void_p]
+    lib.df_decode_eth.restype = ctypes.c_int32
+    lib.df_decode_eth_batch.argtypes = [
+        ctypes.c_char_p, np.ctypeslib.ndpointer(np.uint32), ctypes.c_uint32,
+        ctypes.c_void_p, np.ctypeslib.ndpointer(np.uint8)]
+    _lib = lib
+    return lib
+
+
+# packet record layout must match struct DfPacketOut in dfnative.cpp
+PACKET_DTYPE = np.dtype([
+    ("ip_src", np.uint32), ("ip_dst", np.uint32),
+    ("port_src", np.uint16), ("port_dst", np.uint16),
+    ("protocol", np.uint8), ("tcp_flags", np.uint8),
+    ("window", np.uint16), ("seq", np.uint32), ("ack", np.uint32),
+    ("payload_off", np.uint32), ("payload_len", np.uint32)], align=True)
+
+
+def decode_eth_batch(frames: list[bytes]):
+    """Decode a batch of ethernet frames natively.
+
+    Returns (records: structured array PACKET_DTYPE, ok: bool array) or
+    None when the native lib is unavailable.
+    """
+    lib = load()
+    if lib is None:
+        return None
+    n = len(frames)
+    offsets = np.zeros(n + 1, dtype=np.uint32)
+    total = 0
+    for i, f in enumerate(frames):
+        total += len(f)
+        offsets[i + 1] = total
+    data = b"".join(frames)
+    outs = np.zeros(n, dtype=PACKET_DTYPE)
+    ok = np.zeros(n, dtype=np.uint8)
+    lib.df_decode_eth_batch(data, offsets, n,
+                            outs.ctypes.data_as(ctypes.c_void_p), ok)
+    return outs, ok.astype(bool)
+
+
+class NativeDict:
+    """C++-backed string dictionary. NOT wired into the store hot path:
+    measured slower than CPython's dict through ctypes marshalling (see
+    dfnative.cpp header); kept for the future all-native decode pipeline."""
+
+    def __init__(self) -> None:
+        lib = load()
+        if lib is None:
+            raise RuntimeError("dfnative unavailable")
+        self._lib = lib
+        self._h = lib.df_dict_new()
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.df_dict_free(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+    def __len__(self) -> int:
+        return self._lib.df_dict_len(self._h)
+
+    def encode_many(self, values: list[str]) -> np.ndarray:
+        n = len(values)
+        enc = [v.encode("utf-8", "replace") for v in values]
+        offsets = np.zeros(n + 1, dtype=np.uint32)
+        total = 0
+        for i, b in enumerate(enc):
+            total += len(b)
+            offsets[i + 1] = total
+        data = b"".join(enc)
+        out = np.empty(n, dtype=np.uint32)
+        self._lib.df_dict_encode_batch(self._h, data, offsets, n, out)
+        return out
+
+    def lookup(self, s: str):
+        b = s.encode("utf-8", "replace")
+        r = self._lib.df_dict_lookup(self._h, b, len(b))
+        return None if r == 0xFFFFFFFF else int(r)
+
+    def decode(self, sid: int) -> str:
+        buf = ctypes.create_string_buffer(4096)
+        n = self._lib.df_dict_get(self._h, sid, buf, 4096)
+        if n < 0:
+            raise IndexError(sid)
+        if n > 4096:
+            buf = ctypes.create_string_buffer(n)
+            self._lib.df_dict_get(self._h, sid, buf, n)
+        return buf.raw[:n].decode("utf-8", "replace")
+
+    def load_entries(self, values: list[str]) -> None:
+        enc = [v.encode("utf-8", "replace") for v in values]
+        offsets = np.zeros(len(enc) + 1, dtype=np.uint32)
+        total = 0
+        for i, b in enumerate(enc):
+            total += len(b)
+            offsets[i + 1] = total
+        self._lib.df_dict_load(self._h, b"".join(enc), offsets, len(enc))
+
+
+def available() -> bool:
+    return load() is not None

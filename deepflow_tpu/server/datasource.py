@@ -1,0 +1,123 @@
+"""Datasource rollups: derived 1m aggregates from 1s metric tables.
+
+Reference analog: server/ingester/datasource (1m->1h->1d rollup management).
+A periodic job aggregates completed minutes from flow_metrics.*.1s into
+flow_metrics.*.1m using the query engine itself.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from deepflow_tpu.query import engine as qengine
+from deepflow_tpu.query import sql as qsql
+from deepflow_tpu.store.db import Database
+
+log = logging.getLogger("df.datasource")
+
+# per family: (tag columns, summed meter columns, max meter columns)
+_FAMILIES = {
+    "flow_metrics.network": (
+        ["ip_src", "ip_dst", "server_port", "protocol", "direction",
+         "agent_id", "host_id", "host", "pod_name", "pod_ns", "tpu_pod",
+         "tpu_worker", "slice_id"],
+        ["packet_tx", "packet_rx", "byte_tx", "byte_rx", "flow_count",
+         "new_flow", "closed_flow", "rtt_sum", "rtt_count", "retrans",
+         "syn_count", "synack_count"],
+        []),
+    "flow_metrics.application": (
+        ["ip_src", "ip_dst", "server_port", "l7_protocol", "app_service",
+         "agent_id", "host_id", "host", "pod_name", "pod_ns", "tpu_pod",
+         "tpu_worker", "slice_id"],
+        ["request", "response", "rrt_sum", "rrt_count", "error_client",
+         "error_server", "timeout"],
+        ["rrt_max"]),
+}
+
+
+class RollupJob:
+    def __init__(self, db: Database, interval_s: float = 15.0,
+                 lateness_s: int = 90) -> None:
+        self.db = db
+        self.interval_s = interval_s
+        self.lateness_s = lateness_s  # wait for flow-timeout stragglers
+        # per family: last fully-rolled minute (epoch s); restart-safe —
+        # initialized from the destination table's max(time)
+        self._watermark: dict[str, int] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.stats = {"rollups": 0, "rows": 0}
+
+    def start(self) -> "RollupJob":
+        self._thread = threading.Thread(
+            target=self._run, name="df-rollup", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2.0)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.roll(now_s=int(time.time()))
+            except Exception:
+                log.exception("rollup failed")
+
+    def _initial_watermark(self, dst) -> int:
+        """Resume after restart: already-rolled minutes must not re-roll."""
+        best = 0
+        for ch in dst.snapshot():
+            t = ch.get("time")
+            if t is not None and len(t):
+                best = max(best, int(t.max()) + 60)
+        return best
+
+    def roll(self, now_s: int) -> int:
+        """Aggregate every complete minute older than now - lateness."""
+        total = 0
+        # hold back: 1s rows can arrive up to flow-timeout after their
+        # capture minute closes (flow_map flush semantics)
+        horizon = ((now_s - self.lateness_s) // 60) * 60
+        for family, (tags, sums, maxes) in _FAMILIES.items():
+            src = self.db.table(f"{family}.1s")
+            dst = self.db.table(f"{family}.1m")
+            if len(src) == 0:
+                continue
+            if family not in self._watermark:
+                self._watermark[family] = self._initial_watermark(dst)
+            wm = self._watermark[family]
+            if horizon <= wm:
+                continue
+            select = ", ".join(
+                ["time(time, 60) AS tmin"] + tags
+                + [f"Sum({c}) AS {c}" for c in sums]
+                + [f"Max({c}) AS {c}" for c in maxes])
+            group = ", ".join(["time(time, 60)"] + tags)
+            sql_text = (f"SELECT {select} FROM t "
+                        f"WHERE time >= {wm} AND time < {horizon} "
+                        f"GROUP BY {group}")
+            res = qengine.execute(src, sql_text)
+            if res.values:
+                cols = {name: [] for name in res.columns}
+                for row in res.values:
+                    for name, v in zip(res.columns, row):
+                        cols[name].append(v)
+                cols["time"] = [int(t) for t in cols.pop("tmin")]
+                for c in sums + maxes:
+                    cols[c] = [int(v) for v in cols[c]]
+                for c in list(cols):
+                    spec = dst.columns[c]
+                    if spec.kind == "enum":  # labels -> indices for append
+                        cols[c] = [spec.enum_of(v) for v in cols[c]]
+                dst.append_columns(cols, n=len(res.values))
+                total += len(res.values)
+            self._watermark[family] = horizon
+        if total:
+            self.stats["rollups"] += 1
+            self.stats["rows"] += total
+        return total

@@ -35,10 +35,12 @@ class QuerierAPI:
         db_name = body.get("db", "")
         select = qsql.parse(sql_text)
         table_name = select.table
-        if "." not in table_name and db_name:
-            table_name = f"{db_name}.{table_name}"
-        # flow_metrics tables carry an interval suffix
+        # resolution order: as-given, db-prefixed, then with the default
+        # interval suffix (flow_metrics tables are <name>.<interval>)
         candidates = [table_name, f"{table_name}.1s"]
+        if db_name:
+            candidates = [f"{db_name}.{table_name}",
+                          f"{db_name}.{table_name}.1s"] + candidates
         table = None
         for cand in candidates:
             try:
@@ -85,6 +87,16 @@ class QuerierAPI:
             stacks.append(";".join(x for x in (mod, cat or "other", op) if x))
             values.append(int(d))
         return {"result": build_flame_tree(stacks, values).to_dict()}
+
+    def trace(self, body: dict) -> dict:
+        """Distributed trace tree by trace_id (reference: tracemap)."""
+        trace_id = body.get("trace_id", "")
+        if not trace_id:
+            raise qengine.QueryError("trace_id required")
+        from deepflow_tpu.query.tracing import build_trace
+        return {"result": build_trace(
+            self.db.table("flow_log.l7_flow_log"), trace_id,
+            tpu_table=self.db.table("profile.tpu_hlo_span"))}
 
     def agents(self) -> dict:
         """Agent fleet listing (reference: deepflow-ctl agent list)."""
@@ -165,6 +177,8 @@ class QuerierHTTP:
                         self._send(200, api.tpu_flame(body))
                     elif path == "/v1/agent-group-config":
                         self._send(200, api.update_agent_config(body))
+                    elif path == "/v1/trace/Tracing":
+                        self._send(200, api.trace(body))
                     else:
                         self._send(404, {"error": f"no route {self.path}"})
                 except (qengine.QueryError, qsql.SqlError, KeyError,

@@ -42,6 +42,8 @@ class Server:
         self.api = QuerierAPI(self.db, stats_provider=self._stats,
                               controller=self.controller)
         self.http = QuerierHTTP(self.api, host=host, port=query_port)
+        from deepflow_tpu.server.datasource import RollupJob
+        self.rollup = RollupJob(self.db)
         self._started = False
 
     def _stats(self) -> dict:
@@ -71,6 +73,7 @@ class Server:
             self.decoders.append(d.start())
         self.receiver.start()
         self.http.start()
+        self.rollup.start()
         if self.controller:
             self.controller.start()
         self._started = True
@@ -85,6 +88,7 @@ class Server:
         for d in self.decoders:
             d.stop()
         self.http.stop()
+        self.rollup.stop()
         if self.controller:
             self.controller.stop()
         try:

@@ -165,3 +165,13 @@ def test_sender_accepts_string_addresses(server):
     sender.send(MessageType.EVENT, batch.SerializeToString())
     assert server.wait_for_rows("event.event", 1)
     sender.flush_and_stop()
+
+
+def test_query_dotted_table_with_db_prefix(server):
+    t = server.db.table("flow_metrics.network.1m")
+    t.append_rows([{"time": 60, "byte_tx": 5, "ip_src": "1.1.1.1",
+                    "ip_dst": "2.2.2.2", "protocol": 1}])
+    out = _post(server.query_port, "/v1/query/", {
+        "db": "flow_metrics",
+        "sql": "SELECT Sum(byte_tx) AS b FROM network.1m"})
+    assert out["result"]["values"] == [[5.0]]

@@ -1,0 +1,62 @@
+"""Native (C++) component tests. Skip cleanly when the toolchain is absent."""
+
+import pytest
+
+native = pytest.importorskip("deepflow_tpu.native")
+
+if not native.available():
+    pytest.skip("libdfnative.so not buildable here", allow_module_level=True)
+
+
+def test_native_dict_roundtrip():
+    d = native.NativeDict()
+    ids = d.encode_many(["", "a", "b", "a", "c"])
+    assert ids.tolist() == [0, 1, 2, 1, 3]
+    assert len(d) == 4
+    assert d.decode(2) == "b"
+    assert d.lookup("c") == 3
+    assert d.lookup("zz") is None
+    d.load_entries(["x", "a"])  # load dedups against existing
+    assert d.lookup("x") == 4
+    assert len(d) == 5
+
+
+def test_native_decode_matches_python():
+    from tests.test_flow import eth_tcp_frame
+    from deepflow_tpu.agent.packet import TcpFlags, decode_ethernet
+
+    frames = [
+        eth_tcp_frame("1.2.3.4", "5.6.7.8", 1234, 80,
+                      TcpFlags.SYN | TcpFlags.ACK, seq=42, ack=7),
+        eth_tcp_frame("9.9.9.9", "8.8.8.8", 53, 4444, TcpFlags.PSH,
+                      payload=b"hello world"),
+        b"\x00" * 20,  # junk: native must flag not-ok
+    ]
+    recs, ok = native.decode_eth_batch(frames)
+    assert ok.tolist() == [True, True, False]
+    for i in (0, 1):
+        mp = decode_ethernet(frames[i])
+        assert int(recs[i]["port_src"]) == mp.port_src
+        assert int(recs[i]["port_dst"]) == mp.port_dst
+        assert int(recs[i]["tcp_flags"]) == mp.tcp_flags
+        assert int(recs[i]["seq"]) == mp.seq
+        assert int(recs[i]["ip_src"]).to_bytes(4, "big") == mp.ip_src
+        po, pl = int(recs[i]["payload_off"]), int(recs[i]["payload_len"])
+        assert frames[i][po:po + pl] == mp.payload
+
+
+def test_read_pcap_native_equals_python(tmp_path):
+    from tests.test_flow import eth_tcp_frame, write_pcap
+    from deepflow_tpu.agent.packet import TcpFlags, read_pcap
+
+    frames = [eth_tcp_frame("10.0.0.1", "10.0.0.2", 40000 + i, 80,
+                            TcpFlags.PSH | TcpFlags.ACK,
+                            payload=b"x" * i, seq=i) for i in range(50)]
+    p = str(tmp_path / "t.pcap")
+    write_pcap(p, frames)
+    a = read_pcap(p, use_native=True)
+    b = read_pcap(p, use_native=False)
+    assert len(a) == len(b) == 50
+    for x, y in zip(a, b):
+        assert (x.ip_src, x.port_src, x.seq, x.payload, x.packet_len) == \
+               (y.ip_src, y.port_src, y.seq, y.payload, y.packet_len)

@@ -1,0 +1,158 @@
+"""Trace stitching + datasource rollup tests."""
+
+import pytest
+
+from deepflow_tpu.query.tracing import build_trace
+from deepflow_tpu.server.datasource import RollupJob
+from deepflow_tpu.store import Database
+
+T0 = 1_700_000_000_000_000_000
+
+
+def test_trace_stitching_with_device_overlay():
+    db = Database()
+    l7 = db.table("flow_log.l7_flow_log")
+    # client-side span (frontend -> api), explicit span ids
+    l7.append_rows([
+        {"time": T0, "flow_id": 1, "trace_id": "t1", "span_id": "s-root",
+         "parent_span_id": "", "request_type": "GET", "endpoint": "/checkout",
+         "response_duration": 50_000_000, "response_status": 1,
+         "response_code": 200, "l7_protocol": 1,
+         "ip_src": "10.0.0.1", "ip_dst": "10.0.0.2", "host": "fe"},
+        # server-side child via parent_span_id
+        {"time": T0 + 5_000_000, "flow_id": 2, "trace_id": "t1",
+         "span_id": "s-api", "parent_span_id": "s-root",
+         "request_type": "POST", "endpoint": "/charge",
+         "response_duration": 30_000_000, "response_status": 1,
+         "response_code": 200, "l7_protocol": 3,
+         "ip_src": "10.0.0.2", "ip_dst": "10.0.0.3", "host": "api"},
+        # db call with NO span ids: nested by time containment
+        {"time": T0 + 10_000_000, "flow_id": 3, "trace_id": "t1",
+         "span_id": "", "parent_span_id": "",
+         "request_type": "SELECT", "endpoint": "orders",
+         "response_duration": 8_000_000, "response_status": 1,
+         "response_code": 0, "l7_protocol": 5,
+         "ip_src": "10.0.0.3", "ip_dst": "10.0.0.4", "host": "db"},
+        # unrelated trace
+        {"time": T0, "flow_id": 9, "trace_id": "other", "span_id": "x",
+         "request_type": "GET", "endpoint": "/", "response_duration": 1000,
+         "response_status": 1, "l7_protocol": 1},
+    ])
+    tpu = db.table("profile.tpu_hlo_span")
+    tpu.append_rows([
+        {"time": T0 + 12_000_000, "duration_ns": 2_000_000, "device_id": 0,
+         "kind": 1, "hlo_module": "jit_rank", "hlo_op": "fusion.9",
+         "hlo_category": "fusion", "run_id": 5},
+    ])
+
+    out = build_trace(l7, "t1", tpu_table=tpu)
+    assert out["span_count"] == 3
+    assert len(out["spans"]) == 1  # single root
+    root = out["spans"][0]
+    assert root["name"] == "GET /checkout"
+    api = root["children"][0]
+    assert api["name"] == "POST /charge"
+    db_span = api["children"][0]
+    assert db_span["name"] == "SELECT orders"  # containment fallback
+    # device overlay attached under the (leaf) db span
+    dev = db_span["children"][0]
+    assert dev["kind"] == "device"
+    assert dev["name"] == "fusion.9"
+
+    assert build_trace(l7, "missing")["span_count"] == 0
+
+
+def test_rollup_1s_to_1m():
+    db = Database()
+    src = db.table("flow_metrics.network.1s")
+    rows = []
+    for minute in (100, 101):
+        for s in range(0, 60, 10):
+            rows.append({
+                "time": minute * 60 + s, "ip_src": "1.1.1.1",
+                "ip_dst": "2.2.2.2", "server_port": 80, "protocol": 1,
+                "byte_tx": 100, "packet_tx": 1, "host": "h1"})
+    src.append_rows(rows)
+    job = RollupJob(db, lateness_s=0)
+    n = job.roll(now_s=102 * 60)  # both minutes complete
+    assert n == 2
+    dst = db.table("flow_metrics.network.1m")
+    from deepflow_tpu.query import execute
+    r = execute(dst, "SELECT time, Sum(byte_tx) AS b, Sum(packet_tx) AS p "
+                     "FROM t GROUP BY time ORDER BY time")
+    assert r.values == [[6000, 600.0, 6.0], [6060, 600.0, 6.0]]
+    # idempotent: watermark prevents double-rolling
+    assert job.roll(now_s=102 * 60) == 0
+
+    # a later minute rolls incrementally
+    src.append_rows([{"time": 102 * 60 + 5, "ip_src": "1.1.1.1",
+                      "ip_dst": "2.2.2.2", "server_port": 80, "protocol": 1,
+                      "byte_tx": 7, "packet_tx": 1, "host": "h1"}])
+    assert job.roll(now_s=103 * 60) == 1
+    assert len(dst) == 3
+
+
+def test_rollup_restart_no_double_count():
+    db = Database()
+    src = db.table("flow_metrics.network.1s")
+    src.append_rows([{"time": 6000 + s, "ip_src": "1.1.1.1",
+                      "ip_dst": "2.2.2.2", "server_port": 80, "protocol": 1,
+                      "byte_tx": 10} for s in range(0, 60, 10)])
+    job = RollupJob(db, lateness_s=0)
+    assert job.roll(now_s=6060) == 1
+    # "restart": fresh job over the same db must NOT re-roll minute 6000
+    job2 = RollupJob(db, lateness_s=0)
+    assert job2.roll(now_s=6060) == 0
+    dst = db.table("flow_metrics.network.1m")
+    from deepflow_tpu.query import execute
+    r = execute(dst, "SELECT Sum(byte_tx) AS b FROM t")
+    assert r.values == [[60.0]]
+
+
+def test_rollup_lateness_holdback():
+    db = Database()
+    src = db.table("flow_metrics.network.1s")
+    src.append_rows([{"time": 6000, "ip_src": "1.1.1.1", "ip_dst": "2.2.2.2",
+                      "server_port": 80, "protocol": 1, "byte_tx": 1}])
+    job = RollupJob(db, lateness_s=90)
+    # minute 6000 just closed; lateness holds it back
+    assert job.roll(now_s=6061) == 0
+    # straggler lands late, then the horizon passes: both aggregate
+    src.append_rows([{"time": 6059, "ip_src": "1.1.1.1", "ip_dst": "2.2.2.2",
+                      "server_port": 80, "protocol": 1, "byte_tx": 2}])
+    assert job.roll(now_s=6151) == 1
+    dst = db.table("flow_metrics.network.1m")
+    from deepflow_tpu.query import execute
+    assert execute(dst, "SELECT Sum(byte_tx) AS b FROM t").values == [[3.0]]
+
+
+def test_device_overlay_attaches_once_and_skips_host_spans():
+    db = Database()
+    l7 = db.table("flow_log.l7_flow_log")
+    # two overlapping leaves; the inner one must win the device span
+    l7.append_rows([
+        {"time": T0, "flow_id": 1, "trace_id": "t2", "span_id": "outer",
+         "request_type": "GET", "endpoint": "/a",
+         "response_duration": 100_000_000, "response_status": 1,
+         "l7_protocol": 1},
+        {"time": T0 + 10_000_000, "flow_id": 2, "trace_id": "t2",
+         "span_id": "inner", "parent_span_id": "outer",
+         "request_type": "GET", "endpoint": "/b",
+         "response_duration": 50_000_000, "response_status": 1,
+         "l7_protocol": 1},
+    ])
+    tpu = db.table("profile.tpu_hlo_span")
+    tpu.append_rows([
+        {"time": T0 + 20_000_000, "duration_ns": 1_000_000, "kind": 1,
+         "hlo_op": "fusion.1", "run_id": 1},
+        # host-compile span in-window must NOT appear as a device span
+        {"time": T0 + 21_000_000, "duration_ns": 1_000_000, "kind": 5,
+         "hlo_module": "compile", "run_id": 2},
+    ])
+    out = build_trace(l7, "t2", tpu_table=tpu)
+    root = out["spans"][0]
+    inner = root["children"][0]
+    devs_inner = [c for c in inner["children"] if c["kind"] == "device"]
+    devs_root = [c for c in root["children"] if c["kind"] == "device"]
+    assert len(devs_inner) == 1 and devs_inner[0]["name"] == "fusion.1"
+    assert not devs_root  # attached once, to the tightest leaf
