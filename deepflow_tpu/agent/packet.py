@@ -173,47 +173,59 @@ def read_pcap(path: str, use_native: bool = True) -> list[MetaPacket]:
             raw.append((data, ts_ns, orig))
 
     out: list[MetaPacket] = []
-    decoded = None
     if use_native:
         try:
             from deepflow_tpu.native import decode_eth_batch
-            decoded = decode_eth_batch([r[0] for r in raw])
         except Exception:
-            decoded = None
-    if decoded is not None:
-        recs, ok = decoded
-        # column-wise extraction once (structured-scalar access is slow)
-        cols = {name: recs[name].tolist() for name in
-                ("ip_src", "ip_dst", "port_src", "port_dst", "protocol",
-                 "tcp_flags", "window", "seq", "ack", "payload_off",
-                 "payload_len")}
-        ok_l = ok.tolist()
-        for i, (data, ts_ns, orig) in enumerate(raw):
-            if ok_l[i]:
-                po = cols["payload_off"][i]
-                pl = cols["payload_len"][i]
-                out.append(MetaPacket(
-                    timestamp_ns=ts_ns,
-                    ip_src=cols["ip_src"][i].to_bytes(4, "big"),
-                    ip_dst=cols["ip_dst"][i].to_bytes(4, "big"),
-                    port_src=cols["port_src"][i],
-                    port_dst=cols["port_dst"][i],
-                    protocol=cols["protocol"][i],
-                    tcp_flags=cols["tcp_flags"][i], seq=cols["seq"][i],
-                    ack=cols["ack"][i], window=cols["window"][i],
-                    payload=data[po:po + pl], packet_len=orig))
-            else:  # v6 / vlan / odd frames: Python slow path
-                mp = decode_ethernet(data, timestamp_ns=ts_ns)
-                if mp is not None:
-                    mp.packet_len = orig
-                    out.append(mp)
-        return out
+            decode_eth_batch = None
+        if decode_eth_batch is not None:
+            # chunk the native batches so a large capture never holds a
+            # second full copy of itself in the join buffer
+            for lo in range(0, len(raw), 65536):
+                chunk = raw[lo:lo + 65536]
+                decoded = decode_eth_batch([r[0] for r in chunk])
+                if decoded is None:
+                    out = []  # native unavailable mid-way: full Python pass
+                    break
+                _decode_chunk(chunk, decoded, out)
+            else:
+                return out
     for data, ts_ns, orig in raw:
         mp = decode_ethernet(data, timestamp_ns=ts_ns)
         if mp is not None:
             mp.packet_len = orig
             out.append(mp)
     return out
+
+
+def _decode_chunk(raw, decoded, out: list) -> None:
+    """Materialize MetaPackets from one native decode batch."""
+    recs, ok = decoded
+    # column-wise extraction once (structured-scalar access is slow)
+    cols = {name: recs[name].tolist() for name in
+            ("ip_src", "ip_dst", "port_src", "port_dst", "protocol",
+             "tcp_flags", "window", "seq", "ack", "payload_off",
+             "payload_len")}
+    ok_l = ok.tolist()
+    for i, (data, ts_ns, orig) in enumerate(raw):
+        if ok_l[i]:
+            po = cols["payload_off"][i]
+            pl = cols["payload_len"][i]
+            out.append(MetaPacket(
+                timestamp_ns=ts_ns,
+                ip_src=cols["ip_src"][i].to_bytes(4, "big"),
+                ip_dst=cols["ip_dst"][i].to_bytes(4, "big"),
+                port_src=cols["port_src"][i],
+                port_dst=cols["port_dst"][i],
+                protocol=cols["protocol"][i],
+                tcp_flags=cols["tcp_flags"][i], seq=cols["seq"][i],
+                ack=cols["ack"][i], window=cols["window"][i],
+                payload=data[po:po + pl], packet_len=orig))
+        else:  # v6 / vlan / odd frames: Python slow path
+            mp = decode_ethernet(data, timestamp_ns=ts_ns)
+            if mp is not None:
+                mp.packet_len = orig
+                out.append(mp)
 
 
 # -- synthetic builders (tests + fake traffic) --------------------------------

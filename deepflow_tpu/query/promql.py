@@ -1,0 +1,368 @@
+"""PromQL subset over the metric tables.
+
+Reference analog: server/querier/app/prometheus (full upstream promql engine
+over DeepFlow storage). Embedded subset with the shapes Grafana panels
+actually send:
+
+    metric
+    metric{label="v", label2!="w"}
+    rate(metric[5m])            (also irate, increase)
+    sum(expr) / avg / min / max / count
+    sum by (label, ...) (expr)
+    expr / expr  (scalar arithmetic between aggregates is NOT supported;
+                  binary ops are vector-scalar only: expr * 8, expr / 60)
+
+Metric naming: <family>_<column>, e.g. flow_metrics_network_byte_tx or
+flow_metrics_application_request. Labels are the table's tag columns.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from deepflow_tpu.store.db import Database
+
+_DUR_RE = re.compile(r"^(\d+)(ms|s|m|h|d)$")
+_DUR_S = {"ms": 0.001, "s": 1, "m": 60, "h": 3600, "d": 86400}
+
+_AGGS = ("sum", "avg", "min", "max", "count")
+_RATES = ("rate", "irate", "increase")
+
+# metric prefix -> (table, tag label columns)
+_NETWORK_TAGS = ["ip_src", "ip_dst", "server_port", "protocol", "host",
+                 "pod_name", "tpu_pod", "slice_id", "agent_id"]
+_APP_TAGS = ["ip_src", "ip_dst", "server_port", "l7_protocol", "app_service",
+             "host", "pod_name", "tpu_pod", "slice_id", "agent_id"]
+
+_FAMILIES = {
+    "flow_metrics_network_": ("flow_metrics.network.1s", _NETWORK_TAGS),
+    "flow_metrics_application_": ("flow_metrics.application.1s", _APP_TAGS),
+}
+
+
+class PromqlError(Exception):
+    pass
+
+
+def parse_duration_s(s: str) -> float:
+    m = _DUR_RE.match(s)
+    if not m:
+        raise PromqlError(f"bad duration {s!r}")
+    return int(m.group(1)) * _DUR_S[m.group(2)]
+
+
+@dataclass
+class Selector:
+    metric: str
+    matchers: list = field(default_factory=list)  # (label, op, value)
+    range_s: float = 0.0
+
+
+@dataclass
+class Query:
+    selector: Selector
+    rate_fn: str = ""          # rate | irate | increase | ""
+    agg: str = ""              # sum | avg | ...
+    by: list = field(default_factory=list)
+    scalar_op: str = ""        # * / + -
+    scalar: float = 0.0
+
+
+_TOKEN = re.compile(r"""
+    (?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)
+  | (?P<lbrace>\{) | (?P<rbrace>\})
+  | (?P<lparen>\() | (?P<rparen>\))
+  | (?P<lbrack>\[) | (?P<rbrack>\])
+  | (?P<str>"(?:[^"\\]|\\.)*")
+  | (?P<num>\d+\.\d+|\d+)
+  | (?P<op>!=|=~|!~|=|,|\*|/|\+|-)
+  | (?P<ws>\s+)
+""", re.VERBOSE)
+
+
+def _tokens(q: str):
+    out, i = [], 0
+    while i < len(q):
+        m = _TOKEN.match(q, i)
+        if not m:
+            raise PromqlError(f"bad token at {i}: {q[i:i+10]!r}")
+        i = m.end()
+        if m.lastgroup != "ws":
+            out.append((m.lastgroup, m.group()))
+    return out
+
+
+def parse(q: str) -> Query:
+    toks = _tokens(q)
+    pos = [0]
+
+    def peek():
+        return toks[pos[0]] if pos[0] < len(toks) else ("eof", "")
+
+    def next_():
+        t = peek()
+        pos[0] += 1
+        return t
+
+    def expect(kind):
+        t = next_()
+        if t[0] != kind:
+            raise PromqlError(f"expected {kind}, got {t[1]!r}")
+        return t
+
+    def parse_selector() -> Selector:
+        name = expect("name")[1]
+        sel = Selector(metric=name)
+        if peek()[0] == "lbrace":
+            next_()
+            while peek()[0] != "rbrace":
+                lbl = expect("name")[1]
+                op = expect("op")[1]
+                if op not in ("=", "!=", "=~", "!~"):
+                    raise PromqlError(f"bad matcher op {op}")
+                val = expect("str")[1][1:-1]
+                sel.matchers.append((lbl, op, val))
+                if peek()[0] == "op" and peek()[1] == ",":
+                    next_()
+            expect("rbrace")
+        if peek()[0] == "lbrack":
+            next_()
+            parts = []  # "5m" lexes as num "5" + name "m": join tokens
+            while peek()[0] not in ("rbrack", "eof"):
+                parts.append(next_()[1])
+            sel.range_s = parse_duration_s("".join(parts))
+            expect("rbrack")
+        return sel
+
+    def parse_expr() -> Query:
+        t = peek()
+        if t[0] == "name" and t[1] in _AGGS:
+            agg = next_()[1]
+            by = []
+            if peek()[0] == "name" and peek()[1] == "by":
+                next_()
+                expect("lparen")
+                while peek()[0] != "rparen":
+                    by.append(expect("name")[1])
+                    if peek()[1] == ",":
+                        next_()
+                expect("rparen")
+            expect("lparen")
+            inner = parse_expr()
+            expect("rparen")
+            if peek()[0] == "name" and peek()[1] == "by":
+                next_()
+                expect("lparen")
+                while peek()[0] != "rparen":
+                    by.append(expect("name")[1])
+                    if peek()[1] == ",":
+                        next_()
+                expect("rparen")
+            inner.agg = agg
+            inner.by = by
+            return inner
+        if t[0] == "name" and t[1] in _RATES:
+            fn = next_()[1]
+            expect("lparen")
+            sel = parse_selector()
+            expect("rparen")
+            if not sel.range_s:
+                raise PromqlError(f"{fn}() needs a [range]")
+            return Query(selector=sel, rate_fn=fn)
+        return Query(selector=parse_selector())
+
+    q_ast = parse_expr()
+    t = peek()
+    if t[0] == "op" and t[1] in "*/+-":
+        op = next_()[1]
+        num = expect("num")[1]
+        q_ast.scalar_op = op
+        q_ast.scalar = float(num)
+    if peek()[0] != "eof":
+        raise PromqlError(f"trailing input: {peek()[1]!r}")
+    return q_ast
+
+
+# -- evaluation --------------------------------------------------------------
+
+def _resolve_metric(db: Database, name: str):
+    """-> (table, value_column, tag_columns, extra_filter)."""
+    for prefix, (tname, tags) in _FAMILIES.items():
+        if name.startswith(prefix):
+            col = name[len(prefix):]
+            table = db.table(tname)
+            if col not in table.columns:
+                raise PromqlError(f"unknown metric column {col!r}")
+            return table, col, tags, None
+    raise PromqlError(f"unknown metric {name!r}")
+
+
+def evaluate(db: Database, query: str | Query, start_s: int, end_s: int,
+             step_s: int = 15) -> list[dict]:
+    """Range evaluation -> prometheus matrix result."""
+    if isinstance(query, str):
+        query = parse(query)
+    sel = query.selector
+    table, col, tags, _ = _resolve_metric(db, sel.metric)
+
+    chunks = table.snapshot()
+    times, values, tag_arrays = [], [], {t: [] for t in tags}
+    # prefetch must cover the instant-vector 300s staleness lookback too
+    window = max(sel.range_s or 0, 300)
+    for ch in chunks:
+        if not ch or not len(ch["time"]):
+            continue
+        t = ch["time"].astype(np.int64)
+        mask = (t >= start_s - window) & (t <= end_s)
+        for lbl, op, val in sel.matchers:
+            if lbl not in table.columns:
+                raise PromqlError(f"unknown label {lbl!r}")
+            spec = table.columns[lbl]
+            arr = ch[lbl]
+            if spec.kind == "str":
+                if op in ("=", "!="):
+                    code = table.dicts[lbl].lookup(val)
+                    m = (arr == (code if code is not None else 0xFFFFFFFF))
+                else:
+                    rx = re.compile(val)  # PromQL regexes are anchored
+                    ids = table.dicts[lbl].match_ids(
+                        lambda s: rx.fullmatch(s) is not None)
+                    m = np.isin(arr, ids)
+                if op in ("!=", "!~"):
+                    m = ~m
+            elif spec.kind == "enum":
+                if op in ("=~", "!~"):
+                    rx = re.compile(val)
+                    ids = [i for i, s in enumerate(spec.enum_values)
+                           if rx.fullmatch(s)]
+                    m = np.isin(arr, ids)
+                else:
+                    try:
+                        idx = spec.enum_values.index(val)
+                    except ValueError:
+                        idx = 0xFFFF
+                    m = (arr == idx)
+                if op in ("!=", "!~"):
+                    m = ~m
+            else:
+                m = (arr == type(arr.dtype.type(0))(int(val))) \
+                    if val.isdigit() else np.zeros(len(arr), bool)
+                if op in ("!=", "!~"):
+                    m = ~m
+            mask &= m
+        idx = np.flatnonzero(mask)
+        if not len(idx):
+            continue
+        times.append(t[idx])
+        values.append(ch[col][idx].astype(np.float64))
+        for lbl in tags:
+            tag_arrays[lbl].append(ch[lbl][idx])
+    if not times:
+        return []
+    t_all = np.concatenate(times)
+    v_all = np.concatenate(values)
+    tag_all = {lbl: np.concatenate(tag_arrays[lbl]) for lbl in tags}
+
+    # series key: group by (possibly aggregated-away) label set
+    group_labels = query.by if query.agg else tags
+    group_labels = [g for g in group_labels if g in tag_all]
+    if group_labels:
+        key = np.zeros(len(t_all), dtype=np.int64)
+        for lbl in group_labels:
+            _, inv = np.unique(tag_all[lbl], return_inverse=True)
+            key = key * (int(inv.max(initial=0)) + 1) + inv
+    else:
+        key = np.zeros(len(t_all), dtype=np.int64)
+
+    out = []
+    steps = np.arange(start_s, end_s + 1, step_s)
+    for gk in np.unique(key):
+        gmask = key == gk
+        gt, gv = t_all[gmask], v_all[gmask]
+        order = np.argsort(gt, kind="stable")
+        gt, gv = gt[order], gv[order]
+        labels = {"__name__": sel.metric}
+        gi = np.flatnonzero(gmask)[0]
+        for lbl in group_labels:
+            spec = table.columns[lbl]
+            raw = tag_all[lbl][gi]
+            if spec.kind == "str":
+                labels[lbl] = table.dicts[lbl].decode(int(raw))
+            elif spec.kind == "enum":
+                labels[lbl] = spec.enum_values[int(raw)]
+            else:
+                labels[lbl] = str(int(raw))
+        samples = []
+        for ts in steps:
+            if query.rate_fn:
+                lo = ts - sel.range_s
+                m = (gt > lo) & (gt <= ts)
+                if not m.any():
+                    continue
+                total = float(gv[m].sum())
+                if query.rate_fn in ("rate", "irate"):
+                    total /= max(sel.range_s, 1e-9)
+                samples.append((int(ts), total))
+            else:
+                m = gt <= ts
+                if not m.any():
+                    continue
+                # instant: most recent sample within 5m lookback
+                last_i = np.flatnonzero(m)[-1]
+                if ts - gt[last_i] > 300:
+                    continue
+                samples.append((int(ts), float(gv[last_i])))
+        if samples:
+            out.append({"metric": labels, "values": samples})
+
+    if query.agg:
+        out = _aggregate_series(out, query.agg, query.by)
+    if query.scalar_op:
+        for series in out:
+            series["values"] = [
+                (t, _scalar(v, query.scalar_op, query.scalar))
+                for t, v in series["values"]]
+    return out
+
+
+def _scalar(v: float, op: str, s: float) -> float:
+    if op == "*":
+        return v * s
+    if op == "/":
+        return v / s if s else 0.0
+    if op == "+":
+        return v + s
+    return v - s
+
+
+def _aggregate_series(series: list[dict], agg: str,
+                      by: list[str]) -> list[dict]:
+    groups: dict[tuple, list] = {}
+    for s in series:
+        key = tuple((lbl, s["metric"].get(lbl, "")) for lbl in by)
+        groups.setdefault(key, []).append(s)
+    out = []
+    for key, members in groups.items():
+        merged: dict[int, list[float]] = {}
+        for s in members:
+            for t, v in s["values"]:
+                merged.setdefault(t, []).append(v)
+        labels = dict(key)
+        vals = []
+        for t in sorted(merged):
+            vs = merged[t]
+            if agg == "sum":
+                vals.append((t, float(sum(vs))))
+            elif agg == "avg":
+                vals.append((t, float(sum(vs) / len(vs))))
+            elif agg == "min":
+                vals.append((t, float(min(vs))))
+            elif agg == "max":
+                vals.append((t, float(max(vs))))
+            else:  # count
+                vals.append((t, float(len(vs))))
+        out.append({"metric": labels, "values": vals})
+    return out

@@ -95,7 +95,7 @@ def build_trace(l7_table: ColumnarTable, trace_id: str,
             span_id=r["span_id"] or f"flow-{r['flow_id']}-{r['request_id']}",
             parent_span_id=r["parent_span_id"],
             name=f"{r['request_type']} {name}".strip(),
-            service=r["app_service"] if "app_service" in r else r["host"],
+            service=r.get("app_service") or r.get("host", ""),
             l7_protocol=r["l7_protocol"],
             start_ns=r["time"],
             end_ns=r["time"] + r["response_duration"],

@@ -1,0 +1,133 @@
+"""Integration ingest: OTLP traces (JSON), Pyroscope-style profiles, app logs.
+
+Reference analog: agent/src/integration_collector.rs (OTLP :643, Pyroscope
+ingest :780, app logs :828) + server/ingester/flow_log OTel decoding. Here
+the endpoints live on the server's querier HTTP port; agents can also proxy
+to them.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+
+from deepflow_tpu.store.db import Database
+
+log = logging.getLogger("df.integration")
+
+
+def _attr_map(attrs: list) -> dict:
+    out = {}
+    for a in attrs or []:
+        v = a.get("value", {})
+        out[a.get("key", "")] = (
+            v.get("stringValue") or v.get("intValue")
+            or v.get("doubleValue") or v.get("boolValue") or "")
+    return out
+
+
+class IntegrationAPI:
+    def __init__(self, db: Database) -> None:
+        self.db = db
+        self.stats = {"otlp_spans": 0, "profiles": 0, "app_logs": 0}
+
+    # -- OTLP/HTTP JSON traces (POST /api/v1/otlp/traces) --------------------
+
+    def ingest_otlp_traces(self, body: dict) -> dict:
+        table = self.db.table("flow_log.l7_flow_log")
+        rows = []
+        if not isinstance(body, dict):
+            raise ValueError("OTLP body must be a JSON object")
+        for rs in body.get("resourceSpans", []):
+            if not isinstance(rs, dict):
+                raise ValueError("resourceSpans entries must be objects")
+            res_attrs = _attr_map(rs.get("resource", {}).get("attributes"))
+            service = str(res_attrs.get("service.name", ""))
+            for ss in rs.get("scopeSpans", rs.get("instrumentationLibrarySpans", [])):
+                for span in ss.get("spans", []):
+                    attrs = _attr_map(span.get("attributes"))
+                    start = int(span.get("startTimeUnixNano", 0))
+                    end = int(span.get("endTimeUnixNano", start))
+                    code = int(span.get("status", {}).get("code", 0))
+                    status = {0: 0, 1: 1, 2: 3}.get(code, 0)
+                    http_code = int(attrs.get("http.status_code", 0) or 0)
+                    rows.append({
+                        "time": start,
+                        "app_service": service,
+                        "l7_protocol": 3 if str(
+                            attrs.get("rpc.system", "")) == "grpc" else 1,
+                        "request_type": str(
+                            attrs.get("http.method",
+                                      attrs.get("rpc.method", ""))),
+                        "endpoint": span.get("name", ""),
+                        "request_resource": str(
+                            attrs.get("http.target",
+                                      attrs.get("url.path", ""))),
+                        "request_domain": str(
+                            attrs.get("http.host",
+                                      attrs.get("server.address", ""))),
+                        "response_status": status,
+                        "response_code": http_code,
+                        "response_duration": max(0, end - start),
+                        "trace_id": span.get("traceId", ""),
+                        "span_id": span.get("spanId", ""),
+                        "parent_span_id": span.get("parentSpanId", ""),
+                    })
+        table.append_rows(rows)
+        self.stats["otlp_spans"] += len(rows)
+        return {"accepted_spans": len(rows)}
+
+    # -- Pyroscope-style folded profiles (POST /api/v1/profile/ingest) -------
+
+    def ingest_profile(self, params: dict, raw: bytes) -> dict:
+        """Body: folded-stack text, one 'frame;frame;leaf <value>' per line
+        (pyroscope collapsed format)."""
+        name = params.get("name", "external")
+        units = params.get("units", "samples")
+        now = time.time_ns()
+        table = self.db.table("profile.in_process_profile")
+        rows = []
+        for line in raw.decode("utf-8", "replace").splitlines():
+            line = line.strip()
+            if not line or " " not in line:
+                continue
+            stack, _, value = line.rpartition(" ")
+            try:
+                v = int(float(value))
+            except ValueError:
+                continue
+            rows.append({
+                "time": now,
+                "app_service": name,
+                "process_name": name,
+                "event_type": 1,  # on-cpu
+                "profiler": "pyroscope",
+                "stack": stack,
+                "value": v,
+                "count": 1,
+            })
+        table.append_rows(rows)
+        self.stats["profiles"] += len(rows)
+        return {"accepted_stacks": len(rows), "units": units}
+
+    # -- app logs (POST /api/v1/log) -----------------------------------------
+
+    def ingest_app_log(self, body: dict) -> dict:
+        table = self.db.table("event.event")
+        entries = body if isinstance(body, list) else [body]
+        entries = [e for e in entries if isinstance(e, dict)]
+        rows = [{
+            "time": int(e.get("timestamp_ns", time.time_ns())),
+            "event_type": "app-log",
+            "resource_type": "log",
+            "resource_name": str(e.get("service", "")),
+            "description": str(e.get("message", ""))[:1024],
+            "attrs": json.dumps(
+                {k: str(v) for k, v in e.items()
+                 if k not in ("message", "timestamp_ns")},
+                sort_keys=True),
+        } for e in entries]
+        table.append_rows(rows)
+        self.stats["app_logs"] += len(rows)
+        return {"accepted": len(rows)}

@@ -29,6 +29,8 @@ class QuerierAPI:
         self.db = db
         self.stats_provider = stats_provider or (lambda: {})
         self.controller = controller
+        from deepflow_tpu.server.integration import IntegrationAPI
+        self.integration = IntegrationAPI(db)
 
     def query(self, body: dict) -> dict:
         sql_text = body.get("sql", "")
@@ -87,6 +89,54 @@ class QuerierAPI:
             stacks.append(";".join(x for x in (mod, cat or "other", op) if x))
             values.append(int(d))
         return {"result": build_flame_tree(stacks, values).to_dict()}
+
+    def prom_query_range(self, params: dict) -> dict:
+        """GET /prom/api/v1/query_range (reference: querier/app/prometheus,
+        router.go:41)."""
+        from deepflow_tpu.query import promql
+        q = params.get("query", "")
+        try:
+            start = int(float(params.get("start", 0)))
+            end = int(float(params.get("end", 0)))
+            step = max(1, int(float(params.get("step", 15))))
+        except ValueError as e:
+            raise qengine.QueryError(f"bad time param: {e}")
+        try:
+            result = promql.evaluate(self.db, q, start, end, step)
+        except promql.PromqlError as e:
+            return {"status": "error", "errorType": "bad_data",
+                    "error": str(e)}
+        return {"status": "success",
+                "data": {"resultType": "matrix", "result": result}}
+
+    def tempo_trace(self, trace_id: str) -> dict:
+        """GET /api/traces/{id} — Grafana Tempo-compatible shape
+        (reference: querier/tempo)."""
+        from deepflow_tpu.query.tracing import build_trace
+        tree = build_trace(self.db.table("flow_log.l7_flow_log"), trace_id,
+                           tpu_table=self.db.table("profile.tpu_hlo_span"))
+        spans = []
+
+        def walk(node, parent_id=""):
+            spans.append({
+                "traceID": trace_id,
+                "spanID": node["span_id"],
+                "parentSpanID": parent_id,
+                "operationName": node["name"],
+                "serviceName": node["service"],
+                "startTimeUnixNano": str(node["start_ns"]),
+                "durationNano": str(node["duration_ns"]),
+                "tags": [{"key": "l7_protocol",
+                          "value": node["l7_protocol"]},
+                         {"key": "status", "value": node["status"]},
+                         {"key": "kind", "value": node["kind"]}],
+            })
+            for c in node["children"]:
+                walk(c, node["span_id"])
+
+        for root in tree["spans"]:
+            walk(root)
+        return {"batches": [{"spans": spans}]}
 
     def trace(self, body: dict) -> dict:
         """Distributed trace tree by trace_id (reference: tracemap)."""
@@ -157,18 +207,38 @@ class QuerierHTTP:
                 return json.loads(self.rfile.read(n) or b"{}")
 
             def do_GET(self) -> None:
-                path = self.path.rstrip("/")
-                if path in ("/v1/health", "/health"):
-                    self._send(200, api.health())
-                elif path == "/v1/agents":
-                    self._send(200, api.agents())
-                else:
-                    self._send(404, {"error": f"no route {self.path}"})
+                from urllib.parse import parse_qsl, urlparse
+                parsed = urlparse(self.path)
+                path = parsed.path.rstrip("/")
+                params = dict(parse_qsl(parsed.query))
+                try:
+                    if path in ("/v1/health", "/health"):
+                        self._send(200, api.health())
+                    elif path == "/v1/agents":
+                        self._send(200, api.agents())
+                    elif path in ("/prom/api/v1/query_range",
+                                  "/api/v1/query_range"):
+                        self._send(200, api.prom_query_range(params))
+                    elif path.startswith("/api/traces/"):
+                        self._send(200, api.tempo_trace(
+                            path.rsplit("/", 1)[-1]))
+                    else:
+                        self._send(404, {"error": f"no route {self.path}"})
+                except (qengine.QueryError, ValueError) as e:
+                    self._send(400, {"error": str(e)})
 
             def do_POST(self) -> None:
+                from urllib.parse import parse_qsl, urlparse
                 try:
+                    parsed = urlparse(self.path)
+                    if parsed.path.rstrip("/") == "/api/v1/profile/ingest":
+                        n = int(self.headers.get("Content-Length", 0))
+                        raw = self.rfile.read(n) if n else b""
+                        self._send(200, api.integration.ingest_profile(
+                            dict(parse_qsl(parsed.query)), raw))
+                        return
                     body = self._body()
-                    path = self.path.rstrip("/")
+                    path = parsed.path.rstrip("/")
                     if path == "/v1/query":
                         self._send(200, api.query(body))
                     elif path == "/v1/profile/ProfileTracing":
@@ -179,6 +249,11 @@ class QuerierHTTP:
                         self._send(200, api.update_agent_config(body))
                     elif path == "/v1/trace/Tracing":
                         self._send(200, api.trace(body))
+                    elif path == "/api/v1/otlp/traces":
+                        self._send(200,
+                                   api.integration.ingest_otlp_traces(body))
+                    elif path == "/api/v1/log":
+                        self._send(200, api.integration.ingest_app_log(body))
                     else:
                         self._send(404, {"error": f"no route {self.path}"})
                 except (qengine.QueryError, qsql.SqlError, KeyError,

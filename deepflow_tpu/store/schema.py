@@ -124,6 +124,7 @@ _table("flow_log.l4_flow_log", [
 _table("flow_log.l7_flow_log", [
     C("time", "u64"),                   # request start ns
     C("flow_id", "u64"),
+    C("app_service", "str"),            # set for OTLP/app-instrumented spans
     C("ip_src", "str"),
     C("ip_dst", "str"),
     C("port_src", "u16"),

@@ -1,0 +1,209 @@
+"""PromQL subset + Tempo API tests."""
+
+import json
+import urllib.request
+
+import pytest
+
+from deepflow_tpu.query import promql
+from deepflow_tpu.store import Database
+
+
+def make_db():
+    db = Database()
+    t = db.table("flow_metrics.network.1s")
+    rows = []
+    for s in range(0, 120, 10):
+        for host, tx in (("h1", 100), ("h2", 50)):
+            rows.append({"time": 1000 + s, "ip_src": "1.1.1.1",
+                         "ip_dst": "2.2.2.2", "server_port": 80,
+                         "protocol": 1, "byte_tx": tx, "host": host})
+    t.append_rows(rows)
+    return db
+
+
+def test_parse():
+    q = promql.parse('rate(flow_metrics_network_byte_tx{host="h1"}[1m])')
+    assert q.rate_fn == "rate"
+    assert q.selector.range_s == 60
+    assert q.selector.matchers == [("host", "=", "h1")]
+
+    q2 = promql.parse(
+        'sum by (host) (rate(flow_metrics_network_byte_tx[30s])) * 8')
+    assert q2.agg == "sum" and q2.by == ["host"]
+    assert q2.scalar_op == "*" and q2.scalar == 8
+
+    with pytest.raises(promql.PromqlError):
+        promql.parse("rate(foo)")  # needs [range]
+    with pytest.raises(promql.PromqlError):
+        promql.parse("foo{")
+
+
+def test_instant_series_and_matchers():
+    db = make_db()
+    out = promql.evaluate(
+        db, 'flow_metrics_network_byte_tx{host="h1"}', 1000, 1120, 30)
+    assert len(out) == 1
+    assert out[0]["metric"]["host"] == "h1"
+    assert all(v == 100.0 for _, v in out[0]["values"])
+
+    out = promql.evaluate(
+        db, 'flow_metrics_network_byte_tx{host!="h1"}', 1000, 1120, 30)
+    assert len(out) == 1 and out[0]["metric"]["host"] == "h2"
+
+    out = promql.evaluate(
+        db, 'flow_metrics_network_byte_tx{host=~"h.*"}', 1000, 1120, 30)
+    assert len(out) == 2
+
+
+def test_rate_and_sum():
+    db = make_db()
+    # 100 bytes every 10s for h1 -> rate over 1m = 600/60 = 10 B/s
+    out = promql.evaluate(
+        db, 'rate(flow_metrics_network_byte_tx{host="h1"}[1m])',
+        1060, 1120, 60)
+    assert out and out[0]["values"]
+    ts, v = out[0]["values"][0]
+    assert v == pytest.approx(10.0)
+
+    out = promql.evaluate(
+        db, 'sum(rate(flow_metrics_network_byte_tx[1m]))', 1060, 1120, 60)
+    assert out[0]["values"][0][1] == pytest.approx(15.0)  # both hosts
+
+    out = promql.evaluate(
+        db, 'sum by (host) (rate(flow_metrics_network_byte_tx[1m])) * 8',
+        1060, 1120, 60)
+    byhost = {s["metric"]["host"]: s["values"][0][1] for s in out}
+    assert byhost["h1"] == pytest.approx(80.0)  # bits
+    assert byhost["h2"] == pytest.approx(40.0)
+
+
+def test_errors():
+    db = make_db()
+    with pytest.raises(promql.PromqlError):
+        promql.evaluate(db, "unknown_metric_name", 0, 10)
+    with pytest.raises(promql.PromqlError):
+        promql.evaluate(db, "flow_metrics_network_nope", 0, 10)
+
+
+def test_http_endpoints():
+    import time as _time
+    from deepflow_tpu.server import Server
+    from deepflow_tpu.proto import pb
+
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0).start()
+    try:
+        now = int(_time.time())
+        t = server.db.table("flow_metrics.network.1s")
+        t.append_rows([{"time": now - 30 + i, "byte_tx": 10, "host": "h1",
+                        "ip_src": "1.1.1.1", "ip_dst": "2.2.2.2",
+                        "server_port": 80, "protocol": 1}
+                       for i in range(10)])
+        url = (f"http://127.0.0.1:{server.query_port}/prom/api/v1/"
+               f"query_range?query=flow_metrics_network_byte_tx"
+               f"&start={now-60}&end={now}&step=15")
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            out = json.loads(resp.read())
+        assert out["status"] == "success"
+        assert out["data"]["result"]
+
+        # tempo trace endpoint
+        l7 = server.db.table("flow_log.l7_flow_log")
+        l7.append_rows([{"time": 1, "trace_id": "abc", "span_id": "s1",
+                         "request_type": "GET", "endpoint": "/x",
+                         "response_duration": 5, "response_status": 1,
+                         "l7_protocol": 1, "flow_id": 1}])
+        url = f"http://127.0.0.1:{server.query_port}/api/traces/abc"
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            out = json.loads(resp.read())
+        spans = out["batches"][0]["spans"]
+        assert spans[0]["operationName"] == "GET /x"
+        assert spans[0]["traceID"] == "abc"
+    finally:
+        server.stop()
+
+
+def test_integration_ingest():
+    import urllib.request
+    from deepflow_tpu.server import Server
+
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0).start()
+    try:
+        base = f"http://127.0.0.1:{server.query_port}"
+        otlp = {"resourceSpans": [{
+            "resource": {"attributes": [
+                {"key": "service.name", "value": {"stringValue": "shop"}}]},
+            "scopeSpans": [{"spans": [{
+                "traceId": "0af7651916cd43dd8448eb211c80319c",
+                "spanId": "b7ad6b7169203331",
+                "name": "GET /cart",
+                "startTimeUnixNano": "1700000000000000000",
+                "endTimeUnixNano": "1700000000050000000",
+                "attributes": [
+                    {"key": "http.method", "value": {"stringValue": "GET"}},
+                    {"key": "http.status_code", "value": {"intValue": 200}}],
+                "status": {"code": 1}}]}]}]}
+        req = urllib.request.Request(f"{base}/api/v1/otlp/traces",
+                                     data=json.dumps(otlp).encode())
+        out = json.loads(urllib.request.urlopen(req, timeout=5).read())
+        assert out["accepted_spans"] == 1
+
+        # the OTLP span joins the trace view
+        req = urllib.request.Request(
+            f"{base}/v1/trace/Tracing",
+            data=json.dumps(
+                {"trace_id": "0af7651916cd43dd8448eb211c80319c"}).encode())
+        tr = json.loads(urllib.request.urlopen(req, timeout=5).read())
+        assert tr["result"]["span_count"] == 1
+        assert tr["result"]["spans"][0]["service"] == "shop"
+
+        # pyroscope-style folded profile upload
+        folded = "main;работа;hot_loop 25\nmain;io_wait 5\nbadline\n"
+        req = urllib.request.Request(
+            f"{base}/api/v1/profile/ingest?name=ext-app",
+            data=folded.encode())
+        out = json.loads(urllib.request.urlopen(req, timeout=5).read())
+        assert out["accepted_stacks"] == 2
+        req = urllib.request.Request(
+            f"{base}/v1/profile/ProfileTracing",
+            data=json.dumps({"app_service": "ext-app"}).encode())
+        flame = json.loads(urllib.request.urlopen(req, timeout=5).read())
+        assert flame["result"]["total_value"] == 30
+
+        # app log
+        req = urllib.request.Request(
+            f"{base}/api/v1/log",
+            data=json.dumps({"service": "x", "message": "oops",
+                             "level": "error"}).encode())
+        out = json.loads(urllib.request.urlopen(req, timeout=5).read())
+        assert out["accepted"] == 1
+    finally:
+        server.stop()
+
+
+def test_regex_anchoring_and_enum_regex():
+    db = make_db()
+    t = db.table("flow_metrics.network.1s")
+    t.append_rows([{"time": 1000, "byte_tx": 7, "host": "h1-backup",
+                    "ip_src": "1.1.1.1", "ip_dst": "2.2.2.2",
+                    "server_port": 80, "protocol": 2}])
+    # anchored: h1 must NOT match h1-backup
+    out = promql.evaluate(db, 'flow_metrics_network_byte_tx{host=~"h1"}',
+                          1000, 1120, 30)
+    hosts = {s["metric"]["host"] for s in out}
+    assert hosts == {"h1"}
+    # enum regex matcher works
+    out = promql.evaluate(
+        db, 'flow_metrics_network_byte_tx{protocol=~"ud."}', 1000, 1120, 30)
+    assert out and all(s["metric"].get("protocol") == "udp" for s in out)
+
+
+def test_instant_lookback_300s():
+    db = Database()
+    t = db.table("flow_metrics.network.1s")
+    t.append_rows([{"time": 880, "byte_tx": 9, "host": "h1",
+                    "ip_src": "1.1.1.1", "ip_dst": "2.2.2.2",
+                    "server_port": 80, "protocol": 1}])
+    # sample is 120s before start: staleness lookback must still find it
+    out = promql.evaluate(db, "flow_metrics_network_byte_tx", 1000, 1060, 30)
+    assert out and out[0]["values"][0][1] == 9.0
