@@ -34,27 +34,57 @@ class Agent:
         self.sampler: OnCpuSampler | None = None
         self.tpuprobe = None
         self.synchronizer = None
+        self.guard = None
         self._stats_thread: threading.Thread | None = None
         self._stop = threading.Event()
         self._components: list[str] = []
+        # serializes sampler/tpuprobe lifecycle across guard, synchronizer
+        # and stats threads
+        self._profiler_lock = threading.RLock()
 
     # -- lifecycle -----------------------------------------------------------
 
     def start_sampler(self) -> None:
-        self.sampler = OnCpuSampler(
-            self._profile_sink,
-            hz=self.config.profiler.sample_hz,
-            emit_interval_s=self.config.profiler.emit_interval_s,
-            process_name=self.process_name,
-            app_service=self.app_service).start()
+        with self._profiler_lock:
+            if self.sampler is not None:
+                return
+            if self.guard is not None and self.guard.degraded:
+                return  # guard has profiling paused; resume handles restart
+            self.sampler = OnCpuSampler(
+                self._profile_sink,
+                hz=self.config.profiler.sample_hz,
+                emit_interval_s=self.config.profiler.emit_interval_s,
+                process_name=self.process_name,
+                app_service=self.app_service).start()
 
     def start_tpuprobe(self) -> None:
-        try:
-            from deepflow_tpu.tpuprobe.probe import TpuProbe
-        except ImportError:
-            log.debug("tpuprobe unavailable")
-            return
-        self.tpuprobe = TpuProbe(self).start()
+        with self._profiler_lock:
+            if self.tpuprobe is not None:
+                return
+            if self.guard is not None and self.guard.degraded:
+                return
+            try:
+                from deepflow_tpu.tpuprobe.probe import TpuProbe
+            except ImportError:
+                log.debug("tpuprobe unavailable")
+                return
+            self.tpuprobe = TpuProbe(self).start()
+
+    def pause_profilers(self) -> None:
+        with self._profiler_lock:
+            if self.sampler is not None:
+                self.sampler.stop()
+                self.sampler = None
+            if self.tpuprobe is not None:
+                self.tpuprobe.stop()
+                self.tpuprobe = None
+
+    def resume_profilers(self) -> None:
+        with self._profiler_lock:
+            if self.config.profiler.enabled:
+                self.start_sampler()
+            if self.config.tpuprobe.enabled:
+                self.start_tpuprobe()
 
     def start(self) -> "Agent":
         self.sender.start()
@@ -66,6 +96,13 @@ class Agent:
             self.start_tpuprobe()
             if self.tpuprobe is not None:
                 self._components.append("tpuprobe")
+        if self.config.guard.enabled:
+            from deepflow_tpu.agent.guard import Guard
+            g = self.config.guard
+            self.guard = Guard(
+                self, max_cpu_pct=g.max_cpu_pct, max_mem_mb=g.max_mem_mb,
+                check_interval_s=g.check_interval_s).start()
+            self._components.append("guard")
         if self.config.controller:
             from deepflow_tpu.agent.synchronizer import Synchronizer
             self.synchronizer = Synchronizer(
@@ -81,6 +118,8 @@ class Agent:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.guard:
+            self.guard.stop()
         if self.synchronizer:
             self.synchronizer.stop()
         if self.sampler:
@@ -116,7 +155,10 @@ class Agent:
 
     def _stats_loop(self) -> None:
         while not self._stop.wait(self.config.stats_interval_s):
-            self._emit_stats()
+            try:
+                self._emit_stats()
+            except Exception:
+                log.exception("stats emit failed")  # never kill the loop
 
     def _emit_stats(self) -> None:
         batch = pb.StatsBatch()
@@ -131,13 +173,20 @@ class Agent:
                 m.values[k] = float(v)
 
         metric("agent.sender", self.sender.stats)
-        if self.sampler:
-            st = self.sampler.stats
+        sampler, tpuprobe = self.sampler, self.tpuprobe  # racy nulling-safe
+        if sampler is not None:
+            st = sampler.stats
             metric("agent.oncpu_sampler", {
                 "samples": st.samples, "emits": st.emits,
                 "overruns": st.overruns})
-        if self.tpuprobe is not None:
-            metric("agent.tpuprobe", self.tpuprobe.stats)
+        if tpuprobe is not None:
+            metric("agent.tpuprobe", tpuprobe.stats)
+        if self.guard is not None:
+            metric("agent.guard", {
+                "cpu_pct": self.guard.cpu_pct,
+                "rss_mb": self.guard.rss_mb,
+                "degraded": int(self.guard.degraded),
+                **self.guard.stats})
         self.sender.send(MessageType.DFSTATS, batch.SerializeToString())
 
 

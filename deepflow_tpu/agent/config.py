@@ -29,6 +29,14 @@ class TpuProbeConfig:
 
 
 @dataclass
+class GuardConfig:
+    enabled: bool = True
+    max_cpu_pct: float = 50.0
+    max_mem_mb: float = 2048.0
+    check_interval_s: float = 10.0
+
+
+@dataclass
 class SenderConfig:
     servers: list = field(default_factory=lambda: [("127.0.0.1", 20033)])
     queue_size: int = 8192
@@ -43,6 +51,7 @@ class AgentConfig:
     standalone: bool = True
     profiler: ProfilerConfig = field(default_factory=ProfilerConfig)
     tpuprobe: TpuProbeConfig = field(default_factory=TpuProbeConfig)
+    guard: GuardConfig = field(default_factory=GuardConfig)
     sender: SenderConfig = field(default_factory=SenderConfig)
     stats_interval_s: float = 10.0
     sync_interval_s: float = 10.0
@@ -54,6 +63,8 @@ class AgentConfig:
             cfg.profiler = ProfilerConfig(**d["profiler"])
         if isinstance(d.get("tpuprobe"), dict):
             cfg.tpuprobe = TpuProbeConfig(**d["tpuprobe"])
+        if isinstance(d.get("guard"), dict):
+            cfg.guard = GuardConfig(**d["guard"])
         if isinstance(d.get("sender"), dict):
             sd = dict(d["sender"])
             if "servers" in sd:
@@ -62,7 +73,7 @@ class AgentConfig:
                     else _parse_addr(x) for x in sd["servers"]]
             cfg.sender = SenderConfig(**sd)
         for f in dataclasses.fields(cls):
-            if f.name in ("profiler", "tpuprobe", "sender"):
+            if f.name in ("profiler", "tpuprobe", "guard", "sender"):
                 continue
             if f.name in d:
                 setattr(cfg, f.name, d[f.name])
@@ -84,6 +95,9 @@ class AgentConfig:
         num(self.tpuprobe.trace_duration_ms, "tpuprobe.trace_duration_ms", 1)
         num(self.stats_interval_s, "stats_interval_s", 0.1)
         num(self.sync_interval_s, "sync_interval_s", 0.1)
+        num(self.guard.max_cpu_pct, "guard.max_cpu_pct", 1)
+        num(self.guard.max_mem_mb, "guard.max_mem_mb", 16)
+        num(self.guard.check_interval_s, "guard.check_interval_s", 0.1)
         if self.tpuprobe.source not in ("auto", "xplane", "hooks", "sim"):
             raise ValueError(
                 f"tpuprobe.source must be auto|xplane|hooks|sim, "

@@ -68,7 +68,15 @@ class Synchronizer:
         req.agent_id = self.agent.config.agent_id
         req.config_version = self.config_version
         req.platform_version = self.platform_version
-        req.state = pb.RUNNING
+        guard = self.agent.guard
+        if guard is not None and guard.degraded:
+            req.state = pb.DEGRADED
+            req.exception_bitmap = guard.exception_bitmap
+        else:
+            req.state = pb.RUNNING
+        if guard is not None:
+            req.cpu_usage = guard.cpu_pct
+            req.mem_bytes = int(guard.rss_mb * 1024 * 1024)
         req.version = "0.1.0"
         req.agent_group = getattr(self.agent.config, "group", "") or "default"
         # collect topology once, but RE-SEND every sync: a restarted
@@ -117,29 +125,39 @@ class Synchronizer:
         cfg.profiler = new.profiler
         cfg.tpuprobe = new.tpuprobe
         cfg.stats_interval_s = new.stats_interval_s
+        cfg.guard = new.guard
 
-        sampler = self.agent.sampler
-        if new.profiler.enabled and sampler is None:
-            self.agent.start_sampler()
-        elif not new.profiler.enabled and sampler is not None:
-            sampler.stop()
-            self.agent.sampler = None
-        elif sampler is not None:
-            sampler.period_s = 1.0 / new.profiler.sample_hz
-            sampler.period_us = int(1_000_000 / new.profiler.sample_hz)
-            sampler.emit_interval_s = new.profiler.emit_interval_s
+        # guard limits retune live (the controller's knob for hot agents)
+        guard = self.agent.guard
+        if guard is not None:
+            guard.max_cpu_pct = new.guard.max_cpu_pct
+            guard.max_mem_mb = new.guard.max_mem_mb
+            guard.check_interval_s = new.guard.check_interval_s
 
-        probe = self.agent.tpuprobe
-        if new.tpuprobe.enabled and probe is None:
-            self.agent.start_tpuprobe()
-        elif not new.tpuprobe.enabled and probe is not None:
-            probe.stop()
-            self.agent.tpuprobe = None
-        elif probe is not None:
-            for src in probe.sources:
-                if hasattr(src, "interval_s"):
-                    src.interval_s = new.tpuprobe.trace_interval_s
-                    src.duration_ms = new.tpuprobe.trace_duration_ms
+        with self.agent._profiler_lock:
+            sampler = self.agent.sampler
+            if new.profiler.enabled and sampler is None:
+                # no-op while guard-degraded (start_sampler checks)
+                self.agent.start_sampler()
+            elif not new.profiler.enabled and sampler is not None:
+                sampler.stop()
+                self.agent.sampler = None
+            elif sampler is not None:
+                sampler.period_s = 1.0 / new.profiler.sample_hz
+                sampler.period_us = int(1_000_000 / new.profiler.sample_hz)
+                sampler.emit_interval_s = new.profiler.emit_interval_s
+
+            probe = self.agent.tpuprobe
+            if new.tpuprobe.enabled and probe is None:
+                self.agent.start_tpuprobe()
+            elif not new.tpuprobe.enabled and probe is not None:
+                probe.stop()
+                self.agent.tpuprobe = None
+            elif probe is not None:
+                for src in probe.sources:
+                    if hasattr(src, "interval_s"):
+                        src.interval_s = new.tpuprobe.trace_interval_s
+                        src.duration_ms = new.tpuprobe.trace_duration_ms
         log.info("applied pushed config v%d", version)
 
     def gpid_sync(self, entries: list[pb.GpidEntry]) -> pb.GpidSyncResponse:
