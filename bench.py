@@ -103,6 +103,30 @@ def main() -> None:
         chain, params, opt_state, tokens, reps)
     sampler.stop()
 
+    # second headline dimension: HLO spans/sec captured by the TPU probe
+    # (xplane duty cycle) while the loop keeps training
+    span_events = []
+    spans_wall = 0.0
+    try:
+        from deepflow_tpu.tpuprobe.sources import XPlaneSource
+        src = XPlaneSource(span_events.extend, interval_s=999,
+                           duration_ms=1500)
+    except ImportError:
+        src = None
+    if src is not None:
+        t0 = time.perf_counter()
+        import threading
+        cap = threading.Thread(target=src.capture_once, daemon=True)
+        cap.start()
+        while cap.is_alive():
+            params, opt_state, loss = chain(params, opt_state, tokens)
+            jax.device_get(loss)
+        cap.join()
+        spans_wall = time.perf_counter() - t0
+    device_spans = [e for e in span_events if e.hlo_op]
+    hlo_spans_per_s = (len(device_spans) / spans_wall) if spans_wall else 0.0
+    device_time_ns = sum(e.duration_ns for e in device_spans)
+
     base_step = (statistics.median(base) - rtt) / k_steps
     prof_step = (statistics.median(prof) - rtt) / k_steps
     raw_pct = (prof_step - base_step) / base_step * 100.0
@@ -123,6 +147,9 @@ def main() -> None:
             "sampler_hz": 99,
             "samples_collected": sampler.stats.samples,
             "profile_batches": len(sink_batches),
+            "hlo_spans_per_s": round(hlo_spans_per_s, 1),
+            "hlo_spans_captured": len(device_spans),
+            "hlo_device_time_ms": round(device_time_ns / 1e6, 1),
         },
     }
     print(json.dumps(result))

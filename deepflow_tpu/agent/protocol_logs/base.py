@@ -84,8 +84,12 @@ def get_parser(protocol: int) -> L7Parser | None:
 
 # importing the modules populates the registry, in priority order
 from deepflow_tpu.agent.protocol_logs import http  # noqa: E402,F401
+# ping before dns: its port==0 gate is unambiguous (ICMP only), while the
+# DNS header sanity check can collide with ICMP echo layouts
+from deepflow_tpu.agent.protocol_logs import ping  # noqa: E402,F401
 from deepflow_tpu.agent.protocol_logs import dns  # noqa: E402,F401
 from deepflow_tpu.agent.protocol_logs import redis  # noqa: E402,F401
 from deepflow_tpu.agent.protocol_logs import sqldb  # noqa: E402,F401
 from deepflow_tpu.agent.protocol_logs import nosql  # noqa: E402,F401
 from deepflow_tpu.agent.protocol_logs import mq  # noqa: E402,F401
+from deepflow_tpu.agent.protocol_logs import messaging  # noqa: E402,F401

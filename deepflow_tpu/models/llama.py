@@ -130,10 +130,20 @@ def rope_tables(cfg: LlamaConfig, seq: int) -> tuple[jax.Array, jax.Array]:
             jnp.asarray(np.sin(freqs), dtype=jnp.float32))
 
 
-def _attention(q, k, v, cfg: LlamaConfig) -> jax.Array:
-    """Causal GQA attention. q: (B,S,H,hd) k,v: (B,S,KV,hd)."""
+def _attention(q, k, v, cfg: LlamaConfig, mesh=None,
+               sp_axis: str | None = None) -> jax.Array:
+    """Causal GQA attention. q: (B,S,H,hd) k,v: (B,S,KV,hd).
+
+    With mesh+sp_axis, the sequence dim is context-parallel: K/V blocks
+    rotate the ICI ring (parallel/ring_attention) instead of materializing
+    the full S x S score matrix per device.
+    """
     B, S, H, hd = q.shape
     groups = cfg.n_heads // cfg.n_kv_heads
+    if mesh is not None and sp_axis is not None:
+        # unrepeated K/V: the ring rotates KV-head-sized blocks over ICI
+        from deepflow_tpu.parallel.ring_attention import ring_attention
+        return ring_attention(q, k, v, mesh, axis=sp_axis, causal=True)
     k = jnp.repeat(k, groups, axis=2)
     v = jnp.repeat(v, groups, axis=2)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
@@ -143,7 +153,8 @@ def _attention(q, k, v, cfg: LlamaConfig) -> jax.Array:
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def _layer(cfg: LlamaConfig, cos, sin, x, layer_params):
+def _layer(cfg: LlamaConfig, cos, sin, x, layer_params, mesh=None,
+           sp_axis: str | None = None):
     lp = layer_params
     B, S, D = x.shape
     hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
@@ -154,7 +165,8 @@ def _layer(cfg: LlamaConfig, cos, sin, x, layer_params):
     v = (h @ lp["wv"]).reshape(B, S, nkv, hd)
     q = _rope(q, cos, sin)
     k = _rope(k, cos, sin)
-    attn = _attention(q, k, v, cfg).reshape(B, S, nh * hd)
+    attn = _attention(q, k, v, cfg, mesh=mesh,
+                      sp_axis=sp_axis).reshape(B, S, nh * hd)
     x = x + attn @ lp["wo"]
 
     h = _rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
@@ -163,12 +175,17 @@ def _layer(cfg: LlamaConfig, cos, sin, x, layer_params):
     return x, None
 
 
-def forward(cfg: LlamaConfig, params: dict, tokens: jax.Array) -> jax.Array:
-    """tokens (B, S) int32 -> logits (B, S, V) f32."""
+def forward(cfg: LlamaConfig, params: dict, tokens: jax.Array,
+            mesh=None, sp_axis: str | None = None) -> jax.Array:
+    """tokens (B, S) int32 -> logits (B, S, V) f32.
+
+    mesh+sp_axis turn on sequence/context parallelism (long-context mode):
+    activations are sharded along S and attention runs the ICI ring.
+    """
     B, S = tokens.shape
     cos, sin = rope_tables(cfg, S)
     x = params["tok_embed"][tokens]
-    body = partial(_layer, cfg, cos, sin)
+    body = partial(_layer, cfg, cos, sin, mesh=mesh, sp_axis=sp_axis)
     x, _ = jax.lax.scan(
         lambda carry, lp: body(carry, lp), x, params["layers"])
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
@@ -177,18 +194,21 @@ def forward(cfg: LlamaConfig, params: dict, tokens: jax.Array) -> jax.Array:
     return logits.astype(jnp.float32)
 
 
-def loss_fn(cfg: LlamaConfig, params: dict, tokens: jax.Array) -> jax.Array:
+def loss_fn(cfg: LlamaConfig, params: dict, tokens: jax.Array,
+            mesh=None, sp_axis: str | None = None) -> jax.Array:
     """Next-token cross-entropy over tokens[:, :-1] -> tokens[:, 1:]."""
-    logits = forward(cfg, params, tokens[:, :-1])
+    logits = forward(cfg, params, tokens[:, :-1], mesh=mesh, sp_axis=sp_axis)
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return -jnp.mean(ll)
 
 
-def make_train_step(cfg: LlamaConfig, optimizer=None):
+def make_train_step(cfg: LlamaConfig, optimizer=None, mesh=None,
+                    sp_axis: str | None = None):
     """Returns (train_step, init_opt_state). SGD-with-momentum by default to
-    keep opt-state memory light; pass any optax optimizer instead."""
+    keep opt-state memory light; pass any optax optimizer instead. mesh +
+    sp_axis switch attention to the sequence-parallel ring."""
     import optax
     if optimizer is None:
         optimizer = optax.sgd(3e-4, momentum=0.9)
@@ -198,7 +218,8 @@ def make_train_step(cfg: LlamaConfig, optimizer=None):
 
     def train_step(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(
-            lambda p: loss_fn(cfg, p, tokens))(params)
+            lambda p: loss_fn(cfg, p, tokens, mesh=mesh,
+                              sp_axis=sp_axis))(params)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

@@ -1,0 +1,129 @@
+"""ResNet (v1.5-style) in pure JAX — the DP-workload subject of BASELINE
+config 4 (Flax ResNet-50 pmap DP with ICI AllReduce span stitching).
+
+TPU-first: NHWC layout, bf16 conv/matmul, batch-norm folded as
+inference-style scale/offset with running stats updated outside jit (kept
+simple: train step uses batch statistics). DP via jax.pmap (psum grads over
+the ICI ring) — the collective pattern the TPU probe observes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: tuple = (3, 4, 6, 3)       # resnet-50
+    width: int = 64
+    num_classes: int = 1000
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @staticmethod
+    def tiny() -> "ResNetConfig":
+        return ResNetConfig(stage_sizes=(1, 1), width=8, num_classes=10)
+
+
+def _conv_init(key, kh, kw, cin, cout, dtype):
+    fan_in = kh * kw * cin
+    w = jax.random.normal(key, (kh, kw, cin, cout), dtype=jnp.float32)
+    return (w * np.sqrt(2.0 / fan_in)).astype(dtype)
+
+
+def init_params(cfg: ResNetConfig, key: jax.Array) -> dict:
+    keys = iter(jax.random.split(key, 256))
+    params = {"stem": _conv_init(next(keys), 7, 7, 3, cfg.width, cfg.dtype),
+              "stem_scale": jnp.ones(cfg.width, cfg.dtype),
+              "stem_bias": jnp.zeros(cfg.width, cfg.dtype),
+              "stages": []}
+    cin = cfg.width
+    for i, n_blocks in enumerate(cfg.stage_sizes):
+        cout = cfg.width * (2 ** i) * 4
+        mid = cfg.width * (2 ** i)
+        stage = []
+        for b in range(n_blocks):
+            blk = {
+                "conv1": _conv_init(next(keys), 1, 1, cin, mid, cfg.dtype),
+                "conv2": _conv_init(next(keys), 3, 3, mid, mid, cfg.dtype),
+                "conv3": _conv_init(next(keys), 1, 1, mid, cout, cfg.dtype),
+                "scale1": jnp.ones(mid, cfg.dtype),
+                "scale2": jnp.ones(mid, cfg.dtype),
+                "scale3": jnp.ones(cout, cfg.dtype),
+            }
+            if b == 0 and cin != cout:
+                blk["proj"] = _conv_init(next(keys), 1, 1, cin, cout,
+                                         cfg.dtype)
+            stage.append(blk)
+            cin = cout
+        params["stages"].append(stage)
+    params["head"] = (jax.random.normal(
+        next(keys), (cin, cfg.num_classes), dtype=jnp.float32)
+        * 0.01).astype(cfg.dtype)
+    return params
+
+
+def _conv(x, w, stride=1):
+    return jax.lax.conv_general_dilated(
+        x, w, (stride, stride), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _bn_relu(x, scale):
+    # batch-stat normalization (training-mode simplification)
+    mean = jnp.mean(x, axis=(0, 1, 2), keepdims=True)
+    var = jnp.var(x.astype(jnp.float32), axis=(0, 1, 2), keepdims=True)
+    x = (x - mean) * jax.lax.rsqrt(var + 1e-5).astype(x.dtype)
+    return jax.nn.relu(x * scale)
+
+
+def forward(cfg: ResNetConfig, params: dict, images: jax.Array) -> jax.Array:
+    """images (B, H, W, 3) -> logits (B, num_classes) f32."""
+    x = images.astype(cfg.dtype)
+    x = _conv(x, params["stem"], stride=2)
+    x = _bn_relu(x, params["stem_scale"]) + params["stem_bias"]
+    x = jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME")
+    for si, stage in enumerate(params["stages"]):
+        for bi, blk in enumerate(stage):
+            stride = 2 if (bi == 0 and si > 0) else 1
+            residual = x
+            h = _bn_relu(_conv(x, blk["conv1"]), blk["scale1"])
+            h = _bn_relu(_conv(h, blk["conv2"], stride=stride),
+                         blk["scale2"])
+            h = _conv(h, blk["conv3"]) * blk["scale3"]
+            if "proj" in blk:
+                residual = _conv(residual, blk["proj"], stride=stride)
+            elif stride != 1:
+                residual = _conv(
+                    residual,
+                    jnp.eye(x.shape[-1], dtype=x.dtype)[None, None],
+                    stride=stride)
+            x = jax.nn.relu(h + residual)
+    x = jnp.mean(x, axis=(1, 2))
+    return (x @ params["head"]).astype(jnp.float32)
+
+
+def make_pmap_train_step(cfg: ResNetConfig, lr: float = 0.1):
+    """DP train step: pmapped, grads psum'd over the ICI ring — the
+    AllReduce pattern BASELINE config 4 stitches into traces."""
+
+    def loss_fn(params, images, labels):
+        logits = forward(cfg, params, images)
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(
+            jnp.take_along_axis(logp, labels[:, None], axis=1))
+
+    @partial(jax.pmap, axis_name="dp")
+    def train_step(params, images, labels):
+        loss, grads = jax.value_and_grad(loss_fn)(params, images, labels)
+        grads = jax.lax.pmean(grads, axis_name="dp")
+        params = jax.tree.map(lambda p, g: p - lr * g.astype(p.dtype),
+                              params, grads)
+        return params, jax.lax.pmean(loss, axis_name="dp")
+
+    return train_step

@@ -23,13 +23,15 @@ from jax.sharding import Mesh, PartitionSpec as P
 def _ring_attn_local(q, k, v, axis_name: str, causal: bool, scale: float):
     """Per-device body under shard_map.
 
-    q: (B, Sq, H, hd) local query block; k/v: (B, Sk, KV, hd) local block.
-    Assumes H == KV (caller repeats GQA kv heads before sharding).
+    q: (B, Sq, H, hd) local query block; k/v: (B, Sk, KV, hd) local block
+    with H == KV * n_rep (GQA). The UNREPEATED K/V blocks rotate the ring —
+    ppermute ships KV-head-sized payloads; heads expand locally per step.
     """
     n = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
     B, Sq, H, hd = q.shape
-    Sk = k.shape[1]
+    Sk, KV = k.shape[1], k.shape[2]
+    n_rep = H // KV
     q32 = q.astype(jnp.float32)
 
     m0 = jnp.full((B, H, Sq), -jnp.inf, dtype=jnp.float32)
@@ -40,8 +42,10 @@ def _ring_attn_local(q, k, v, axis_name: str, causal: bool, scale: float):
     def loop_body(s, carry):
         m, l, acc, k_cur, v_cur = carry
         src = (my - s) % n  # ring position the current k/v block came from
+        k_rep = (jnp.repeat(k_cur, n_rep, axis=2) if n_rep > 1 else k_cur)
+        v_rep = (jnp.repeat(v_cur, n_rep, axis=2) if n_rep > 1 else v_cur)
         scores = jnp.einsum(
-            "bqhd,bkhd->bhqk", q32, k_cur.astype(jnp.float32)) * scale
+            "bqhd,bkhd->bhqk", q32, k_rep.astype(jnp.float32)) * scale
         if causal:
             q_pos = my * Sq + jnp.arange(Sq)
             k_pos = src * Sk + jnp.arange(Sk)
@@ -57,7 +61,7 @@ def _ring_attn_local(q, k, v, axis_name: str, causal: bool, scale: float):
         l_new = l * corr + jnp.sum(p, axis=-1)
         acc_new = (acc * corr.transpose(0, 2, 1)[..., None]
                    + jnp.einsum("bhqk,bkhd->bqhd", p,
-                                v_cur.astype(jnp.float32)))
+                                v_rep.astype(jnp.float32)))
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
         return m_new, l_new, acc_new, k_nxt, v_nxt
@@ -71,10 +75,11 @@ def _ring_attn_local(q, k, v, axis_name: str, causal: bool, scale: float):
 
 def ring_attention(q, k, v, mesh: Mesh, axis: str = "data",
                    causal: bool = True) -> jax.Array:
-    """Sequence-parallel causal attention.
+    """Sequence-parallel attention.
 
-    q/k/v: (B, S, H, hd) with S sharded over mesh axis `axis`.
-    H must equal KV-heads (repeat GQA groups first).
+    q: (B, S, H, hd), k/v: (B, S, KV, hd) with S sharded over mesh axis
+    `axis` and H a multiple of KV (GQA) — unrepeated K/V rotate the ring,
+    so ppermute payloads stay KV-head-sized.
     """
     scale = 1.0 / (q.shape[-1] ** 0.5)
     spec = P(None, axis, None, None)

@@ -346,3 +346,60 @@ def test_midstream_flow_promoted_to_established():
                         timestamp_ns=T0 + 61_100_000_000))
     fm.tick(T0 + 62_000_000_000)
     assert len(l4) == 1 and l4[0].close_type == "fin"
+
+
+def test_mqtt_nats_amqp_ping_parsers():
+    # MQTT CONNECT + PUBLISH
+    connect = bytes([0x10, 12]) + b"\x00\x04MQTT\x04\x02\x00\x3c"
+    proto, recs = infer_and_parse(connect)
+    assert proto == pb.MQTT and recs[0].request_type == "CONNECT"
+    publish = bytes([0x30, 14]) + struct.pack(">H", 9) + b"tpu/stats" + b"x"
+    proto, recs = infer_and_parse(publish, port_dst=1883)
+    assert proto == pb.MQTT
+    assert recs[0].request_resource == "tpu/stats"
+
+    # NATS
+    proto, recs = infer_and_parse(b"PUB updates.v1 11\r\nhello world\r\n")
+    assert proto == pb.NATS
+    assert recs[0].request_resource == "updates.v1"
+    proto, recs = infer_and_parse(b"-ERR 'Unknown Protocol'\r\n", port_dst=4222)
+    assert proto == pb.NATS and recs[0].response_status == 3
+
+    # AMQP protocol header + method frame
+    proto, recs = infer_and_parse(b"AMQP\x00\x00\x09\x01")
+    assert proto == pb.AMQP
+    frame = (bytes([1]) + struct.pack(">H", 0) + struct.pack(">I", 8)
+             + struct.pack(">HH", 60, 40) + b"\x00" * 4 + b"\xce")
+    proto, recs = infer_and_parse(frame, port_dst=5672)
+    assert proto == pb.AMQP
+    assert recs[0].request_type == "basic.publish"
+
+    # ICMP ping through the flow map (protocol 3 -> PingParser)
+    from deepflow_tpu.agent.packet import MetaPacket
+    import socket as _s
+    l7 = []
+    fm = FlowMap(on_l7_log=l7.append)
+    echo_req = bytes([8, 0, 0, 0]) + struct.pack(">HH", 7, 1) + b"data"
+    echo_rep = bytes([0, 0, 0, 0]) + struct.pack(">HH", 7, 1) + b"data"
+    fm.inject(MetaPacket(timestamp_ns=T0, ip_src=_s.inet_aton("1.1.1.1"),
+                         ip_dst=_s.inet_aton("2.2.2.2"), protocol=3,
+                         payload=echo_req, packet_len=60))
+    fm.inject(MetaPacket(timestamp_ns=T0 + 5_000_000,
+                         ip_src=_s.inet_aton("2.2.2.2"),
+                         ip_dst=_s.inet_aton("1.1.1.1"), protocol=3,
+                         payload=echo_rep, packet_len=60))
+    fm.flush_all()
+    matched = [r for r in l7 if r.request and r.response]
+    assert matched and matched[0].flow.l7_protocol == pb.PING
+    assert (matched[0].end_ns - matched[0].start_ns) == 5_000_000
+
+
+def test_redis_reply_not_misinferred_as_nats():
+    # mid-stream Redis reply on a non-standard port must stay unknown/NATS-free
+    proto, _ = infer_and_parse(b"+OK\r\n", port_dst=7000)
+    assert proto != pb.NATS
+    proto, _ = infer_and_parse(b"-ERR wrong\r\n", port_dst=7000)
+    assert proto != pb.NATS
+    # on the NATS port the reply verbs still parse as NATS
+    proto, _ = infer_and_parse(b"+OK\r\n", port_dst=4222)
+    assert proto == pb.NATS

@@ -104,3 +104,80 @@ def test_ring_attention_non_causal():
     ref = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(scores, -1), v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-5)
+
+
+def test_seq_parallel_forward_matches_dense():
+    """Long-context mode: ring-attention forward == dense forward."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    cfg = LlamaConfig.tiny(n_heads=4, n_kv_heads=4)  # ring needs H == KV
+    params = init_params(cfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (2, 64), 0, cfg.vocab)
+    dense = forward(cfg, params, tokens)
+
+    devs = np.asarray(jax.devices()[:4]).reshape(1, 4)
+    mesh = Mesh(devs, ("data", "sp"))
+    tok_sp = jax.device_put(tokens, NamedSharding(mesh, P("data", "sp")))
+    sp = jax.jit(lambda p, t: forward(cfg, p, t, mesh=mesh, sp_axis="sp"))(
+        params, tok_sp)
+    np.testing.assert_allclose(np.asarray(sp), np.asarray(dense),
+                               rtol=3e-2, atol=3e-2)  # bf16 tolerance
+
+
+def test_seq_parallel_train_step_runs():
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    cfg = LlamaConfig.tiny(n_heads=4, n_kv_heads=4)
+    devs = np.asarray(jax.devices()[:4]).reshape(1, 4)
+    mesh = Mesh(devs, ("data", "sp"))
+    params = init_params(cfg, jax.random.key(0))
+    train_step, init_opt = make_train_step(cfg, mesh=mesh, sp_axis="sp")
+    opt_state = init_opt(params)
+    tokens = jax.device_put(
+        jax.random.randint(jax.random.key(1), (2, 65), 0, cfg.vocab),
+        NamedSharding(mesh, P("data", None)))
+    params, opt_state, loss = jax.jit(train_step)(params, opt_state, tokens)
+    assert np.isfinite(float(loss))
+
+
+def test_resnet_forward_and_pmap_dp():
+    from deepflow_tpu.models import resnet
+    cfg = resnet.ResNetConfig.tiny()
+    params = resnet.init_params(cfg, jax.random.key(0))
+    images = jax.random.normal(jax.random.key(1), (2, 32, 32, 3))
+    logits = resnet.forward(cfg, params, images)
+    assert logits.shape == (2, cfg.num_classes)
+    assert np.isfinite(np.asarray(logits)).all()
+
+    # DP across all 8 virtual devices: pmean over the 'dp' ring
+    n = jax.device_count()
+    step = resnet.make_pmap_train_step(cfg, lr=0.01)
+    rep = jax.device_put_replicated(params, jax.devices())
+    imgs = jax.random.normal(jax.random.key(2), (n, 2, 32, 32, 3))
+    labels = jax.random.randint(jax.random.key(3), (n, 2), 0,
+                                cfg.num_classes)
+    rep, loss = step(rep, imgs, labels)
+    losses = np.asarray(loss)
+    assert np.isfinite(losses).all()
+    # pmean makes every replica agree
+    np.testing.assert_allclose(losses, losses[0], rtol=1e-5)
+
+
+def test_ring_attention_gqa_unrepeated_kv():
+    """GQA ring path: KV-head blocks rotate; result matches dense GQA."""
+    from jax.sharding import Mesh
+    devs = np.asarray(jax.devices()[:4]).reshape(4)
+    mesh = Mesh(devs, ("sp",))
+    B, S, H, KV, hd = 2, 32, 8, 2, 16
+    k1, k2, k3 = jax.random.split(jax.random.key(3), 3)
+    q = jax.random.normal(k1, (B, S, H, hd), dtype=jnp.float32)
+    k = jax.random.normal(k2, (B, S, KV, hd), dtype=jnp.float32)
+    v = jax.random.normal(k3, (B, S, KV, hd), dtype=jnp.float32)
+    out = ring_attention(q, k, v, mesh, axis="sp", causal=True)
+
+    kr = jnp.repeat(k, H // KV, axis=2)
+    vr = jnp.repeat(v, H // KV, axis=2)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, kr) / np.sqrt(hd)
+    mask = jnp.tril(jnp.ones((S, S), dtype=bool))
+    scores = jnp.where(mask[None, None], scores, -1e30)
+    ref = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(scores, -1), vr)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
