@@ -103,8 +103,9 @@ class QuadrupleGenerator:
             st.rrt_sum_us += rrt
             st.rrt_count += 1
             st.rrt_max_us = max(st.rrt_max_us, rrt)
-        elif record.request is not None and record.response is None:
-            st.timeout += 1
+        elif record.request is not None and record.response is None \
+                and not record.request.session_less:
+            st.timeout += 1  # fire-and-forget messages are not timeouts
 
     # -- flush ----------------------------------------------------------------
 

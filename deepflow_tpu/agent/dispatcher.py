@@ -87,7 +87,7 @@ def record_to_l7_pb(r: L7Record) -> pb.L7FlowLog:
         f.captured_response_byte = resp.captured_byte
         if not resp.trace_id == "" and not f.trace_id:
             f.trace_id = resp.trace_id
-    elif req is not None:
+    elif req is not None and not req.session_less:
         f.response_status = 4  # unanswered request -> timeout
     return f
 

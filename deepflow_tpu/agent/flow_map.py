@@ -268,6 +268,10 @@ class FlowMap:
                        ts_ns: int) -> None:
         if rec.msg_type == MSG_REQUEST:
             node.l7_request += 1
+            if rec.session_less:
+                # fire-and-forget message: complete record, no response due
+                self._emit_l7(node, rec, None, ts_ns, ts_ns)
+                return
             pending = PendingRequest(ts_ns, rec)
             if len(node.pending) >= self.MAX_PENDING:
                 old = node.pending.popleft()

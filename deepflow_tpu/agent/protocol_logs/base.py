@@ -28,6 +28,7 @@ class L7ParseResult:
     span_id: str = ""
     x_request_id: str = ""
     captured_byte: int = 0
+    session_less: bool = False  # fire-and-forget: no response expected
     attrs: dict = field(default_factory=dict)
 
 
@@ -93,3 +94,5 @@ from deepflow_tpu.agent.protocol_logs import sqldb  # noqa: E402,F401
 from deepflow_tpu.agent.protocol_logs import nosql  # noqa: E402,F401
 from deepflow_tpu.agent.protocol_logs import mq  # noqa: E402,F401
 from deepflow_tpu.agent.protocol_logs import messaging  # noqa: E402,F401
+from deepflow_tpu.agent.protocol_logs import rpc  # noqa: E402,F401
+from deepflow_tpu.agent.protocol_logs import tls  # noqa: E402,F401

@@ -49,7 +49,18 @@ class DnsParser(L7Parser):
         qd = struct.unpack_from(">H", payload, 4)[0]
         opcode = (flags >> 11) & 0xF
         z = (flags >> 4) & 0x7
-        return qd >= 1 and qd < 16 and opcode in (0, 1, 2) and z == 0
+        if not (1 <= qd < 16 and opcode in (0, 1, 2) and z == 0):
+            return False
+        # off-port: the header heuristic alone misfires on binary protocols
+        # (fastcgi, icmp) — also require a well-formed non-empty qname with
+        # hostname-ish labels and a known qtype
+        name, off = _read_name(payload, 12)
+        if not name or off + 4 > len(payload):
+            return False
+        qtype = struct.unpack_from(">H", payload, off)[0]
+        if qtype not in _QTYPES:
+            return False
+        return all(c.isalnum() or c in "-_." for c in name)
 
     def parse(self, payload: bytes,
               is_request: bool = True) -> list[L7ParseResult]:

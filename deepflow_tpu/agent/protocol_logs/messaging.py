@@ -49,6 +49,9 @@ class MqttParser(L7Parser):
                       else MSG_REQUEST),
             request_type=name, endpoint=name,
             captured_byte=len(payload))
+        if ptype == 3:
+            qos = (payload[0] >> 1) & 0x3
+            res.session_less = qos == 0  # QoS0: fire-and-forget
         if ptype == 3 and i + 2 <= len(payload):  # PUBLISH: topic string
             tlen = struct.unpack_from(">H", payload, i)[0]
             topic = payload[i + 2:i + 2 + tlen]
@@ -94,6 +97,8 @@ class NatsParser(L7Parser):
                       else MSG_REQUEST),
             request_type=verb, endpoint=verb,
             captured_byte=len(payload))
+        if verb in ("PUB", "HPUB"):
+            res.session_less = True
         if verb in ("PUB", "SUB", "HPUB", "MSG", "HMSG") and len(parts) > 1:
             res.request_resource = parts[1].decode("latin1", "replace")
             res.endpoint = res.request_resource

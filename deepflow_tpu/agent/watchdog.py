@@ -1,0 +1,81 @@
+"""Watchdog: parent process that respawns the agent on crash.
+
+Reference analog: agent/src/main.rs:80-88 + agent/src/watchdog.rs (parent
+watchdog fork with respawn). Usage:
+
+    python -m deepflow_tpu.agent.watchdog [watchdog opts] -- [agent args...]
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import subprocess
+import sys
+import time
+
+log = logging.getLogger("df.watchdog")
+
+
+def run(agent_args: list[str], max_restarts: int = 10,
+        backoff_s: float = 1.0, backoff_max_s: float = 60.0,
+        healthy_reset_s: float = 300.0) -> int:
+    """Supervise the agent; restart on abnormal exit with backoff. A child
+    that stays up healthy_reset_s resets the restart budget."""
+    restarts = 0
+    backoff = backoff_s
+    child: subprocess.Popen | None = None
+    stopping = False
+
+    def on_signal(signum, frame):
+        nonlocal stopping
+        stopping = True
+        if child is not None and child.poll() is None:
+            child.terminate()
+
+    signal.signal(signal.SIGTERM, on_signal)
+    signal.signal(signal.SIGINT, on_signal)
+
+    while not stopping:
+        started = time.monotonic()
+        cmd = [sys.executable, "-m", "deepflow_tpu.agent.agent"] + agent_args
+        log.info("watchdog: starting agent (attempt %d)", restarts + 1)
+        child = subprocess.Popen(cmd)
+        code = child.wait()
+        uptime = time.monotonic() - started
+        if stopping or code == 0:
+            return 0
+        if uptime >= healthy_reset_s:
+            restarts = 0
+            backoff = backoff_s
+        restarts += 1
+        if restarts > max_restarts:
+            log.error("watchdog: agent crashed %d times (last code %d); "
+                      "giving up", restarts, code)
+            return 1
+        log.warning("watchdog: agent exited %d after %.1fs; restart in %.1fs",
+                    code, uptime, backoff)
+        time.sleep(backoff)
+        backoff = min(backoff * 2, backoff_max_s)
+    return 0
+
+
+def main() -> int:
+    import argparse
+    parser = argparse.ArgumentParser(prog="deepflow-tpu-watchdog")
+    parser.add_argument("--max-restarts", type=int, default=10)
+    parser.add_argument("--backoff", type=float, default=1.0)
+    parser.add_argument("agent_args", nargs=argparse.REMAINDER,
+                        help="arguments after -- go to the agent")
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    agent_args = args.agent_args
+    if agent_args and agent_args[0] == "--":
+        agent_args = agent_args[1:]
+    return run(agent_args, max_restarts=args.max_restarts,
+               backoff_s=args.backoff)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -37,15 +37,19 @@ _FAMILIES = {
 }
 
 
+# rollup stages: (src interval suffix, dst suffix, bucket seconds)
+_STAGES = [("1s", "1m", 60), ("1m", "1h", 3600)]
+
+
 class RollupJob:
     def __init__(self, db: Database, interval_s: float = 15.0,
                  lateness_s: int = 90) -> None:
         self.db = db
         self.interval_s = interval_s
         self.lateness_s = lateness_s  # wait for flow-timeout stragglers
-        # per family: last fully-rolled minute (epoch s); restart-safe —
-        # initialized from the destination table's max(time)
-        self._watermark: dict[str, int] = {}
+        # per (family, stage): last fully-rolled bucket (epoch s);
+        # restart-safe — initialized from the destination table's max(time)
+        self._watermark: dict[tuple, int] = {}
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.stats = {"rollups": 0, "rows": 0}
@@ -68,56 +72,67 @@ class RollupJob:
             except Exception:
                 log.exception("rollup failed")
 
-    def _initial_watermark(self, dst) -> int:
-        """Resume after restart: already-rolled minutes must not re-roll."""
+    def _initial_watermark(self, dst, bucket: int) -> int:
+        """Resume after restart: already-rolled buckets must not re-roll.
+        The newest dst row marks its whole bucket as done."""
         best = 0
         for ch in dst.snapshot():
             t = ch.get("time")
             if t is not None and len(t):
-                best = max(best, int(t.max()) + 60)
+                best = max(best, (int(t.max()) // bucket) * bucket + bucket)
         return best
 
     def roll(self, now_s: int) -> int:
-        """Aggregate every complete minute older than now - lateness."""
+        """Run every rollup stage: complete buckets older than now-lateness."""
         total = 0
-        # hold back: 1s rows can arrive up to flow-timeout after their
-        # capture minute closes (flow_map flush semantics)
-        horizon = ((now_s - self.lateness_s) // 60) * 60
         for family, (tags, sums, maxes) in _FAMILIES.items():
-            src = self.db.table(f"{family}.1s")
-            dst = self.db.table(f"{family}.1m")
-            if len(src) == 0:
-                continue
-            if family not in self._watermark:
-                self._watermark[family] = self._initial_watermark(dst)
-            wm = self._watermark[family]
-            if horizon <= wm:
-                continue
-            select = ", ".join(
-                ["time(time, 60) AS tmin"] + tags
-                + [f"Sum({c}) AS {c}" for c in sums]
-                + [f"Max({c}) AS {c}" for c in maxes])
-            group = ", ".join(["time(time, 60)"] + tags)
-            sql_text = (f"SELECT {select} FROM t "
-                        f"WHERE time >= {wm} AND time < {horizon} "
-                        f"GROUP BY {group}")
-            res = qengine.execute(src, sql_text)
-            if res.values:
-                cols = {name: [] for name in res.columns}
-                for row in res.values:
-                    for name, v in zip(res.columns, row):
-                        cols[name].append(v)
-                cols["time"] = [int(t) for t in cols.pop("tmin")]
-                for c in sums + maxes:
-                    cols[c] = [int(v) for v in cols[c]]
-                for c in list(cols):
-                    spec = dst.columns[c]
-                    if spec.kind == "enum":  # labels -> indices for append
-                        cols[c] = [spec.enum_of(v) for v in cols[c]]
-                dst.append_columns(cols, n=len(res.values))
-                total += len(res.values)
-            self._watermark[family] = horizon
+            for src_sfx, dst_sfx, bucket in _STAGES:
+                total += self._roll_stage(
+                    now_s, family, src_sfx, dst_sfx, bucket,
+                    tags, sums, maxes)
         if total:
             self.stats["rollups"] += 1
             self.stats["rows"] += total
         return total
+
+    def _roll_stage(self, now_s: int, family: str, src_sfx: str,
+                    dst_sfx: str, bucket: int, tags, sums, maxes) -> int:
+        src = self.db.table(f"{family}.{src_sfx}")
+        dst = self.db.table(f"{family}.{dst_sfx}")
+        if len(src) == 0:
+            return 0
+        # hold back: rows can arrive up to flow-timeout after their capture
+        # bucket closes (flow_map flush semantics)
+        horizon = ((now_s - self.lateness_s) // bucket) * bucket
+        key = (family, dst_sfx)
+        if key not in self._watermark:
+            self._watermark[key] = self._initial_watermark(dst, bucket)
+        wm = self._watermark[key]
+        if horizon <= wm:
+            return 0
+        select = ", ".join(
+            [f"time(time, {bucket}) AS tmin"] + tags
+            + [f"Sum({c}) AS {c}" for c in sums]
+            + [f"Max({c}) AS {c}" for c in maxes])
+        group = ", ".join([f"time(time, {bucket})"] + tags)
+        sql_text = (f"SELECT {select} FROM t "
+                    f"WHERE time >= {wm} AND time < {horizon} "
+                    f"GROUP BY {group}")
+        res = qengine.execute(src, sql_text)
+        n = 0
+        if res.values:
+            cols = {name: [] for name in res.columns}
+            for row in res.values:
+                for name, v in zip(res.columns, row):
+                    cols[name].append(v)
+            cols["time"] = [int(t) for t in cols.pop("tmin")]
+            for c in sums + maxes:
+                cols[c] = [int(v) for v in cols[c]]
+            for c in list(cols):
+                spec = dst.columns[c]
+                if spec.kind == "enum":  # labels -> indices for append
+                    cols[c] = [spec.enum_of(v) for v in cols[c]]
+            dst.append_columns(cols, n=len(res.values))
+            n = len(res.values)
+        self._watermark[key] = horizon
+        return n

@@ -14,7 +14,8 @@ L4_PROTOS = ("unknown", "tcp", "udp", "icmp")
 L7_PROTOS = (
     "unknown", "http1", "http2", "grpc", "dns", "mysql", "redis", "kafka",
     "postgresql", "mongodb", "memcached", "mqtt", "amqp", "nats", "dubbo",
-    "fastcgi", "tls", "ping")
+    "fastcgi", "tls", "ping", "rocketmq", "sofarpc", "zmtp",
+    "openwire", "tars", "brpc")
 RESPONSE_STATUS = ("unknown", "ok", "client_error", "server_error", "timeout")
 PROFILE_EVENT_TYPES = (
     "unknown", "on-cpu", "off-cpu", "mem-alloc", "tpu-device", "tpu-host")
@@ -183,6 +184,7 @@ _NETWORK_COLS = [
 ]
 _table("flow_metrics.network.1s", list(_NETWORK_COLS))
 _table("flow_metrics.network.1m", list(_NETWORK_COLS))
+_table("flow_metrics.network.1h", list(_NETWORK_COLS))
 
 _APP_COLS = [
     C("time", "u32"),
@@ -203,6 +205,7 @@ _APP_COLS = [
 ]
 _table("flow_metrics.application.1s", list(_APP_COLS))
 _table("flow_metrics.application.1m", list(_APP_COLS))
+_table("flow_metrics.application.1h", list(_APP_COLS))
 
 # -- events ----------------------------------------------------------------
 _table("event.event", [

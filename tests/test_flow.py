@@ -403,3 +403,102 @@ def test_redis_reply_not_misinferred_as_nats():
     # on the NATS port the reply verbs still parse as NATS
     proto, _ = infer_and_parse(b"+OK\r\n", port_dst=4222)
     assert proto == pb.NATS
+
+
+def test_dubbo_fastcgi_rocketmq_tls_parsers():
+    # dubbo request
+    body = b"\x05" + b"2.7.8" + b"\x1ecom.example.UserService" + b"\x051.0.0" + b"\x07getUser"
+    dreq = struct.pack(">HBBQI", 0xDABB, 0xC2, 0, 42, len(body)) + body
+    proto, recs = infer_and_parse(dreq)
+    assert proto == pb.DUBBO
+    assert recs[0].request_domain == "com.example.UserService"
+    assert recs[0].request_type == "getUser"
+    # dubbo response, status 20 OK
+    dresp = struct.pack(">HBBQI", 0xDABB, 0x02, 20, 42, 2) + b"\x91\x05"
+    proto, recs = infer_and_parse(dresp)
+    assert recs[0].msg_type == 1 and recs[0].response_status == 1
+
+    # fastcgi BEGIN_REQUEST + PARAMS
+    def fcgi_rec(rtype, rid, body):
+        return struct.pack(">BBHHBB", 1, rtype, rid, len(body), 0, 0) + body
+    def kv(k, v):
+        return bytes([len(k), len(v)]) + k + v
+    params = kv(b"REQUEST_METHOD", b"GET") + kv(b"SCRIPT_NAME", b"/index.php")
+    msg = fcgi_rec(1, 7, b"\x00\x01\x00\x00\x00\x00\x00\x00") + fcgi_rec(4, 7, params)
+    proto, recs = infer_and_parse(msg, port_dst=9000)
+    assert proto == pb.FASTCGI
+    assert recs[0].request_resource == "/index.php"
+
+    # rocketmq SEND_MESSAGE
+    import json as _json
+    hdr = _json.dumps({"code": 10, "flag": 0, "opaque": 99, "language": "JAVA",
+                       "extFields": {"topic": "orders"}}).encode()
+    rmsg = struct.pack(">II", 4 + len(hdr), len(hdr)) + hdr
+    proto, recs = infer_and_parse(rmsg, port_dst=9876)
+    assert proto == pb.ROCKETMQ
+    assert recs[0].request_type == "SEND_MESSAGE"
+    assert recs[0].request_resource == "orders"
+    assert recs[0].request_id == 99
+
+    # TLS ClientHello with SNI + ALPN h2
+    sni = b"api.example.com"
+    sni_ext = struct.pack(">HH", 0, len(sni) + 5) + struct.pack(">HBH", len(sni) + 3, 0, len(sni)) + sni
+    alpn_list = b"\x02h2\x08http/1.1"
+    alpn_ext = struct.pack(">HH", 16, len(alpn_list) + 2) + struct.pack(">H", len(alpn_list)) + alpn_list
+    exts = sni_ext + alpn_ext
+    hello_body = (struct.pack(">H", 0x0303) + b"\x00" * 32 + b"\x00"
+                  + struct.pack(">H", 2) + b"\x13\x01" + b"\x01\x00"
+                  + struct.pack(">H", len(exts)) + exts)
+    hs = b"\x01" + len(hello_body).to_bytes(3, "big") + hello_body
+    rec = b"\x16\x03\x01" + struct.pack(">H", len(hs)) + hs
+    proto, recs = infer_and_parse(rec)
+    assert proto == pb.TLS
+    assert recs[0].request_domain == "api.example.com"
+    assert recs[0].attrs.get("alpn") == "h2,http/1.1"
+
+
+def test_session_less_messages_not_timeout():
+    from deepflow_tpu.agent.dispatcher import record_to_l7_pb
+    l7 = []
+    fm = FlowMap(on_l7_log=l7.append)
+    # NATS PUB: emitted immediately, complete
+    fm.inject(build_tcp("1.1.1.1", "2.2.2.2", 50000, 4222,
+                        TcpFlags.PSH | TcpFlags.ACK,
+                        payload=b"PUB a.b 2\r\nhi\r\n", timestamp_ns=T0))
+    assert len(l7) == 1
+    row = record_to_l7_pb(l7[0])
+    assert row.response_status != 4  # not a timeout
+    # MQTT QoS0 PUBLISH likewise
+    pub = bytes([0x30, 14]) + struct.pack(">H", 9) + b"tpu/stats" + b"xyz"
+    fm2 = FlowMap(on_l7_log=l7.append)
+    fm2.inject(build_tcp("1.1.1.1", "3.3.3.3", 50001, 1883,
+                         TcpFlags.PSH | TcpFlags.ACK, payload=pub,
+                         timestamp_ns=T0))
+    fm2.flush_all()
+    mqtt_rows = [record_to_l7_pb(r) for r in l7[1:]]
+    assert mqtt_rows and all(r.response_status != 4 for r in mqtt_rows)
+
+
+def test_tls_app_data_and_dubbo_continuation_ignored():
+    from deepflow_tpu.agent.protocol_logs.tls import TlsParser
+    from deepflow_tpu.agent.protocol_logs.rpc import DubboParser
+    # TLS application-data record must produce no records
+    app_data = b"\x17\x03\x03\x00\x20" + b"\xaa" * 32
+    assert TlsParser().parse(app_data) == []
+    # dubbo continuation segment (no magic) likewise
+    assert DubboParser().parse(b"\x00" * 40) == []
+
+
+def test_session_less_not_counted_as_app_timeout():
+    from deepflow_tpu.agent.collector import QuadrupleGenerator
+    docs = []
+    gen = QuadrupleGenerator(docs.extend)
+    fm = FlowMap(on_flow_update=gen.add_flow, on_l7_log=gen.add_l7)
+    fm.inject(build_tcp("1.1.1.1", "2.2.2.2", 50002, 4222,
+                        TcpFlags.PSH | TcpFlags.ACK,
+                        payload=b"PUB a 2\r\nhi\r\n", timestamp_ns=T0))
+    fm.flush_all()
+    gen.flush(now_s=100)
+    app = [d for d in docs if d.HasField("app_meter")]
+    assert app and app[0].app_meter.request == 1
+    assert app[0].app_meter.timeout == 0

@@ -156,3 +156,25 @@ def test_device_overlay_attaches_once_and_skips_host_spans():
     devs_root = [c for c in root["children"] if c["kind"] == "device"]
     assert len(devs_inner) == 1 and devs_inner[0]["name"] == "fusion.1"
     assert not devs_root  # attached once, to the tightest leaf
+
+
+def test_rollup_1m_to_1h():
+    db = Database()
+    src = db.table("flow_metrics.network.1m")
+    # two hours of minute rows
+    rows = []
+    for hour in (10, 11):
+        for m in range(0, 60, 15):
+            rows.append({"time": hour * 3600 + m * 60, "ip_src": "1.1.1.1",
+                         "ip_dst": "2.2.2.2", "server_port": 80,
+                         "protocol": 1, "byte_tx": 25, "host": "h"})
+    src.append_rows(rows)
+    job = RollupJob(db, lateness_s=0)
+    n = job.roll(now_s=12 * 3600)
+    assert n == 2  # two 1h rows
+    dst = db.table("flow_metrics.network.1h")
+    from deepflow_tpu.query import execute
+    r = execute(dst, "SELECT time, Sum(byte_tx) AS b FROM t GROUP BY time "
+                     "ORDER BY time")
+    assert r.values == [[36000, 100.0], [39600, 100.0]]
+    assert job.roll(now_s=12 * 3600) == 0  # idempotent

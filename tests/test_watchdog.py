@@ -1,0 +1,47 @@
+import subprocess
+import sys
+import time
+
+from deepflow_tpu.agent import watchdog
+
+
+def test_watchdog_restarts_crashing_child(monkeypatch):
+    calls = []
+
+    class FakeChild:
+        def __init__(self, code):
+            self._code = code
+
+        def wait(self):
+            return self._code
+
+        def poll(self):
+            return self._code
+
+    codes = iter([1, 1, 0])  # crash twice, then clean exit
+
+    def fake_popen(cmd):
+        calls.append(cmd)
+        return FakeChild(next(codes))
+
+    monkeypatch.setattr(watchdog.subprocess, "Popen", fake_popen)
+    monkeypatch.setattr(watchdog.time, "sleep", lambda s: None)
+    rc = watchdog.run(["--standalone"], max_restarts=5, backoff_s=0.01)
+    assert rc == 0
+    assert len(calls) == 3
+    assert calls[0][-1] == "--standalone"
+
+
+def test_watchdog_gives_up(monkeypatch):
+    class FakeChild:
+        def wait(self):
+            return 7
+
+        def poll(self):
+            return 7
+
+    monkeypatch.setattr(watchdog.subprocess, "Popen",
+                        lambda cmd: FakeChild())
+    monkeypatch.setattr(watchdog.time, "sleep", lambda s: None)
+    rc = watchdog.run([], max_restarts=2, backoff_s=0.01)
+    assert rc == 1
