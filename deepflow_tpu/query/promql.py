@@ -189,15 +189,88 @@ def parse(q: str) -> Query:
 # -- evaluation --------------------------------------------------------------
 
 def _resolve_metric(db: Database, name: str):
-    """-> (table, value_column, tag_columns, extra_filter)."""
+    """-> (table, value_column, tag_columns, pre_filter)."""
     for prefix, (tname, tags) in _FAMILIES.items():
         if name.startswith(prefix):
             col = name[len(prefix):]
             table = db.table(tname)
-            if col not in table.columns:
-                raise PromqlError(f"unknown metric column {col!r}")
-            return table, col, tags, None
-    raise PromqlError(f"unknown metric {name!r}")
+            if col in table.columns:
+                return table, col, tags, None
+            break  # fall through: maybe a remote-write metric with a
+            # name that happens to share the family prefix
+    # remote-write samples: any metric name, labels in labels_json
+    table = db.table("prometheus.samples")
+    code = table.dicts["metric_name"].lookup(name)
+    if code is None:
+        raise PromqlError(f"unknown metric {name!r}")
+    return table, "value", ["labels_json"], ("metric_name", code)
+
+
+def _compile(pattern: str):
+    try:
+        return re.compile(pattern)  # PromQL regexes are anchored (fullmatch)
+    except re.error as e:
+        raise PromqlError(f"bad regex {pattern!r}: {e}") from None
+
+
+def _compile_matchers(table, sel, pre_filter):
+    """Precompute chunk-independent matcher state -> per-chunk appliers.
+    Dictionary scans and regex compilation happen ONCE, not per chunk."""
+    appliers = []
+    for lbl, op, val in sel.matchers:
+        negate = op in ("!=", "!~")
+        if pre_filter is not None:
+            # remote-write metric: labels live in labels_json (the table's
+            # universal tag columns would shadow user labels like "host")
+            ids = _labels_json_ids(table, lbl, op, val)
+            appliers.append(("isin", "labels_json", ids, negate))
+            continue
+        if lbl not in table.columns:
+            raise PromqlError(f"unknown label {lbl!r}")
+        spec = table.columns[lbl]
+        if spec.kind == "str":
+            if op in ("=", "!="):
+                code = table.dicts[lbl].lookup(val)
+                appliers.append((
+                    "eq", lbl,
+                    code if code is not None else 0xFFFFFFFF, negate))
+            else:
+                rx = _compile(val)
+                ids = table.dicts[lbl].match_ids(
+                    lambda s: rx.fullmatch(s) is not None)
+                appliers.append(("isin", lbl, ids, negate))
+        elif spec.kind == "enum":
+            if op in ("=~", "!~"):
+                rx = _compile(val)
+                ids = np.asarray(
+                    [i for i, s in enumerate(spec.enum_values)
+                     if rx.fullmatch(s)], dtype=np.uint16)
+                appliers.append(("isin", lbl, ids, negate))
+            else:
+                try:
+                    idx = spec.enum_values.index(val)
+                except ValueError:
+                    idx = 0xFFFF
+                appliers.append(("eq", lbl, idx, negate))
+        else:
+            code = int(val) if val.isdigit() else None
+            appliers.append(("eq", lbl, code, negate))
+    return appliers
+
+
+def _apply_matchers(appliers, ch) -> np.ndarray | None:
+    mask = None
+    for kind, lbl, data, negate in appliers:
+        arr = ch[lbl]
+        if kind == "eq":
+            m = (np.zeros(len(arr), bool) if data is None
+                 else arr == arr.dtype.type(data))
+        else:
+            m = np.isin(arr, data)
+        if negate:
+            m = ~m
+        mask = m if mask is None else (mask & m)
+    return mask
 
 
 def evaluate(db: Database, query: str | Query, start_s: int, end_s: int,
@@ -206,8 +279,9 @@ def evaluate(db: Database, query: str | Query, start_s: int, end_s: int,
     if isinstance(query, str):
         query = parse(query)
     sel = query.selector
-    table, col, tags, _ = _resolve_metric(db, sel.metric)
+    table, col, tags, pre_filter = _resolve_metric(db, sel.metric)
 
+    appliers = _compile_matchers(table, sel, pre_filter)
     chunks = table.snapshot()
     times, values, tag_arrays = [], [], {t: [] for t in tags}
     # prefetch must cover the instant-vector 300s staleness lookback too
@@ -217,41 +291,10 @@ def evaluate(db: Database, query: str | Query, start_s: int, end_s: int,
             continue
         t = ch["time"].astype(np.int64)
         mask = (t >= start_s - window) & (t <= end_s)
-        for lbl, op, val in sel.matchers:
-            if lbl not in table.columns:
-                raise PromqlError(f"unknown label {lbl!r}")
-            spec = table.columns[lbl]
-            arr = ch[lbl]
-            if spec.kind == "str":
-                if op in ("=", "!="):
-                    code = table.dicts[lbl].lookup(val)
-                    m = (arr == (code if code is not None else 0xFFFFFFFF))
-                else:
-                    rx = re.compile(val)  # PromQL regexes are anchored
-                    ids = table.dicts[lbl].match_ids(
-                        lambda s: rx.fullmatch(s) is not None)
-                    m = np.isin(arr, ids)
-                if op in ("!=", "!~"):
-                    m = ~m
-            elif spec.kind == "enum":
-                if op in ("=~", "!~"):
-                    rx = re.compile(val)
-                    ids = [i for i, s in enumerate(spec.enum_values)
-                           if rx.fullmatch(s)]
-                    m = np.isin(arr, ids)
-                else:
-                    try:
-                        idx = spec.enum_values.index(val)
-                    except ValueError:
-                        idx = 0xFFFF
-                    m = (arr == idx)
-                if op in ("!=", "!~"):
-                    m = ~m
-            else:
-                m = (arr == type(arr.dtype.type(0))(int(val))) \
-                    if val.isdigit() else np.zeros(len(arr), bool)
-                if op in ("!=", "!~"):
-                    m = ~m
+        if pre_filter is not None:
+            mask &= ch[pre_filter[0]] == pre_filter[1]
+        m = _apply_matchers(appliers, ch)
+        if m is not None:
             mask &= m
         idx = np.flatnonzero(mask)
         if not len(idx):
@@ -266,9 +309,14 @@ def evaluate(db: Database, query: str | Query, start_s: int, end_s: int,
     v_all = np.concatenate(values)
     tag_all = {lbl: np.concatenate(tag_arrays[lbl]) for lbl in tags}
 
-    # series key: group by (possibly aggregated-away) label set
-    group_labels = query.by if query.agg else tags
-    group_labels = [g for g in group_labels if g in tag_all]
+    # series key: group by (possibly aggregated-away) label set. Remote-write
+    # metrics always group by labels_json (the series identity) — the agg's
+    # `by` labels are re-grouped over the json-expanded labels afterwards.
+    if pre_filter is not None:
+        group_labels = ["labels_json"]
+    else:
+        group_labels = query.by if query.agg else tags
+        group_labels = [g for g in group_labels if g in tag_all]
     if group_labels:
         key = np.zeros(len(t_all), dtype=np.int64)
         for lbl in group_labels:
@@ -289,7 +337,14 @@ def evaluate(db: Database, query: str | Query, start_s: int, end_s: int,
         for lbl in group_labels:
             spec = table.columns[lbl]
             raw = tag_all[lbl][gi]
-            if spec.kind == "str":
+            if lbl == "labels_json" and spec.kind == "str":
+                import json as _json
+                try:
+                    labels.update(_json.loads(
+                        table.dicts[lbl].decode(int(raw)) or "{}"))
+                except ValueError:
+                    pass
+            elif spec.kind == "str":
                 labels[lbl] = table.dicts[lbl].decode(int(raw))
             elif spec.kind == "enum":
                 labels[lbl] = spec.enum_values[int(raw)]
@@ -326,6 +381,25 @@ def evaluate(db: Database, query: str | Query, start_s: int, end_s: int,
                 (t, _scalar(v, query.scalar_op, query.scalar))
                 for t, v in series["values"]]
     return out
+
+
+def _labels_json_ids(table, lbl: str, op: str, val: str) -> np.ndarray:
+    """Matching dictionary ids for a matcher over a json label set.
+    (Negation is applied by the caller.)"""
+    import json as _json
+
+    def get(s: str) -> str:
+        try:
+            return str(_json.loads(s or "{}").get(lbl, ""))
+        except ValueError:
+            return ""
+
+    if op in ("=", "!="):
+        pred = lambda s: get(s) == val  # noqa: E731
+    else:
+        rx = _compile(val)
+        pred = lambda s: rx.fullmatch(get(s)) is not None  # noqa: E731
+    return table.dicts["labels_json"].match_ids(pred)
 
 
 def _scalar(v: float, op: str, s: float) -> float:

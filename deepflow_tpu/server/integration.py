@@ -111,6 +111,38 @@ class IntegrationAPI:
         self.stats["profiles"] += len(rows)
         return {"accepted_stacks": len(rows), "units": units}
 
+    # -- prometheus remote-write ---------------------------------------------
+
+    def ingest_prometheus(self, raw: bytes) -> dict:
+        from deepflow_tpu.utils import snappy
+        from deepflow_tpu.tpuprobe.pbwire import WireError
+        try:
+            data = snappy.decompress(raw)
+        except snappy.SnappyError:
+            data = raw  # tolerate uncompressed senders
+        try:
+            series = _parse_write_request(data)
+        except WireError as e:
+            raise ValueError(f"not a WriteRequest: {e}") from None
+        table = self.db.table("prometheus.samples")
+        rows = []
+        for name, labels, samples in series:
+            labels_json = json.dumps(labels, sort_keys=True)
+            for ts_ms, value in samples:
+                ts_s = int(ts_ms // 1000)
+                if not (0 <= ts_s < 2**32):
+                    continue  # ns-unit senders would overflow the u32 column
+                rows.append({
+                    "time": ts_s,
+                    "metric_name": name,
+                    "labels_json": labels_json,
+                    "value": value,
+                })
+        table.append_rows(rows)
+        self.stats["prom_samples"] = self.stats.get("prom_samples", 0) \
+            + len(rows)
+        return {"accepted_samples": len(rows), "series": len(series)}
+
     # -- app logs (POST /api/v1/log) -----------------------------------------
 
     def ingest_app_log(self, body: dict) -> dict:
@@ -131,3 +163,34 @@ class IntegrationAPI:
         table.append_rows(rows)
         self.stats["app_logs"] += len(rows)
         return {"accepted": len(rows)}
+
+
+# -- prometheus remote-write (POST /api/v1/write) ----------------------------
+# reference: server/ingester/prometheus decoder; body is snappy-compressed
+# prometheus.WriteRequest protobuf (parsed with pbwire — no generated stubs)
+
+def _parse_write_request(data: bytes) -> list[tuple[str, dict, list]]:
+    """-> [(metric_name, labels, [(ts_ms, value), ...]), ...]"""
+    from deepflow_tpu.tpuprobe import pbwire as w
+    out = []
+    for f, _, ts_buf in w.iter_fields(data):
+        if f != 1 or not isinstance(ts_buf, bytes):
+            continue
+        labels: dict[str, str] = {}
+        samples: list[tuple[int, float]] = []
+        for lf, _, lv in w.iter_fields(ts_buf):
+            if lf == 1 and isinstance(lv, bytes):  # Label
+                ld = w.fields_dict(lv)
+                labels[w.as_str(w.first(ld, 1))] = w.as_str(w.first(ld, 2))
+            elif lf == 2 and isinstance(lv, bytes):  # Sample
+                sd = w.fields_dict(lv)
+                raw_v = w.first(sd, 1, 0)
+                value = w.f64(raw_v) if isinstance(raw_v, int) else raw_v
+                ts_ms = w.first(sd, 2, 0)
+                if ts_ms > (1 << 62):  # zigzag not used; guard garbage
+                    continue
+                samples.append((ts_ms, value))
+        name = labels.pop("__name__", "")
+        if name and samples:
+            out.append((name, labels, samples))
+    return out

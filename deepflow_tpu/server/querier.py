@@ -237,6 +237,12 @@ class QuerierHTTP:
                         self._send(200, api.integration.ingest_profile(
                             dict(parse_qsl(parsed.query)), raw))
                         return
+                    if parsed.path.rstrip("/") == "/api/v1/write":
+                        n = int(self.headers.get("Content-Length", 0))
+                        raw = self.rfile.read(n) if n else b""
+                        self._send(200,
+                                   api.integration.ingest_prometheus(raw))
+                        return
                     body = self._body()
                     path = parsed.path.rstrip("/")
                     if path == "/v1/query":

@@ -219,6 +219,17 @@ _table("event.event", [
     *UNIVERSAL_TAGS,
 ])
 
+# -- prometheus remote-write samples ---------------------------------------
+# reference: server/ingester/prometheus (label->ID SmartEncoding); here the
+# label set is dictionary-encoded as one canonical json string per series
+_table("prometheus.samples", [
+    C("time", "u32"),                   # epoch seconds (remote-write ms / 1000)
+    C("metric_name", "str"),
+    C("labels_json", "str"),
+    C("value", "f64"),
+    *UNIVERSAL_TAGS,
+])
+
 # -- self telemetry --------------------------------------------------------
 # reference: deepflow_system DB (agent/src/utils/stats.rs -> ext_metrics)
 _table("deepflow_system.deepflow_system", [

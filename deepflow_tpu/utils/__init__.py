@@ -1,0 +1,1 @@
+"""Shared utilities (reference analog: server/libs misc + agent crates)."""

@@ -1,0 +1,174 @@
+"""Prometheus remote-write ingest: snappy, WriteRequest parse, PromQL."""
+
+import json
+import struct
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from deepflow_tpu.utils import snappy
+
+
+def test_snappy_roundtrip_and_copies():
+    data = b"hello world " * 100 + b"tail"
+    assert snappy.decompress(snappy.compress(data)) == data
+    assert snappy.decompress(snappy.compress(b"")) == b""
+
+    # hand-built stream with a copy element: "abcdabcdabcd"
+    # literal "abcd" (tag len-1=3 -> 0x0C), copy1 len=8 offset=4:
+    # tag: type=01, len-4=4 in bits 2-4, offset high 3 bits=0 -> 0x11, off byte 4
+    stream = bytes([12]) + bytes([0x0C]) + b"abcd" + bytes([0x11, 0x04])
+    assert snappy.decompress(stream) == b"abcdabcdabcd"
+
+    with pytest.raises(snappy.SnappyError):
+        snappy.decompress(b"\x0a\xfc")  # truncated
+    with pytest.raises(snappy.SnappyError):
+        snappy.decompress(bytes([4, 0x11, 0x04]))  # copy before any output
+
+
+def _varint(v: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        out.append(b | (0x80 if v else 0))
+        if not v:
+            return bytes(out)
+
+
+def _label(name: bytes, value: bytes) -> bytes:
+    body = b"\x0a" + _varint(len(name)) + name + \
+           b"\x12" + _varint(len(value)) + value
+    return b"\x0a" + _varint(len(body)) + body
+
+
+def _sample(value: float, ts_ms: int) -> bytes:
+    body = b"\x09" + struct.pack("<d", value) + b"\x10" + _varint(ts_ms)
+    return b"\x12" + _varint(len(body)) + body
+
+
+def make_write_request(series) -> bytes:
+    """series: [(name, labels_dict, [(ts_ms, val)])] -> WriteRequest bytes."""
+    out = b""
+    for name, labels, samples in series:
+        ts_body = _label(b"__name__", name.encode())
+        for k, v in labels.items():
+            ts_body += _label(k.encode(), v.encode())
+        for ts_ms, val in samples:
+            ts_body += _sample(val, ts_ms)
+        out += b"\x0a" + _varint(len(ts_body)) + ts_body
+    return out
+
+
+def test_remote_write_to_promql():
+    from deepflow_tpu.server import Server
+    from deepflow_tpu.query import promql
+
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0).start()
+    try:
+        now = int(time.time())
+        wr = make_write_request([
+            ("train_step_seconds", {"job": "maxtext", "host": "w0"},
+             [((now - 20 + i) * 1000, 0.043) for i in range(10)]),
+            ("train_step_seconds", {"job": "maxtext", "host": "w1"},
+             [((now - 20 + i) * 1000, 0.050) for i in range(10)]),
+        ])
+        body = snappy.compress(wr)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.query_port}/api/v1/write", data=body)
+        out = json.loads(urllib.request.urlopen(req, timeout=5).read())
+        assert out == {"accepted_samples": 20, "series": 2}
+
+        # PromQL over the ingested series, label matcher + grouping
+        url = (f"http://127.0.0.1:{server.query_port}/prom/api/v1/"
+               f"query_range?query="
+               f"train_step_seconds%7Bhost%3D%22w0%22%7D"
+               f"&start={now-10}&end={now}&step=10")
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            res = json.loads(resp.read())
+        assert res["status"] == "success"
+        series = res["data"]["result"]
+        assert len(series) == 1
+        assert series[0]["metric"]["host"] == "w0"
+        assert series[0]["metric"]["job"] == "maxtext"
+        assert series[0]["values"][-1][1] == pytest.approx(0.043)
+
+        # aggregate across series
+        out = promql.evaluate(server.db, "max(train_step_seconds)",
+                              now - 10, now, 10)
+        assert out[0]["values"][-1][1] == pytest.approx(0.050)
+    finally:
+        server.stop()
+
+
+def test_garbage_body_is_400():
+    from deepflow_tpu.server import Server
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0).start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.query_port}/api/v1/write",
+            data=b"complete garbage!!")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=5)
+        assert ei.value.code == 400
+    finally:
+        server.stop()
+
+
+def test_agg_across_remote_write_series():
+    from deepflow_tpu.server.integration import IntegrationAPI
+    from deepflow_tpu.store import Database
+    from deepflow_tpu.query import promql
+    db = Database()
+    api = IntegrationAPI(db)
+    now = int(time.time())
+    wr = make_write_request([
+        ("m1", {"host": "w0"}, [((now - 5) * 1000, 1.0)]),
+        ("m1", {"host": "w1"}, [((now - 5) * 1000, 2.0)]),
+    ])
+    api.ingest_prometheus(snappy.compress(wr))
+    out = promql.evaluate(db, "sum(m1)", now - 5, now, 5)
+    assert out[0]["values"][-1][1] == pytest.approx(3.0)
+    out = promql.evaluate(db, "sum by (host) (m1)", now - 5, now, 5)
+    byhost = {s["metric"]["host"]: s["values"][-1][1] for s in out}
+    assert byhost == {"w0": pytest.approx(1.0), "w1": pytest.approx(2.0)}
+
+
+def test_bad_regex_is_promql_error():
+    from deepflow_tpu.query import promql
+    from deepflow_tpu.store import Database
+    db = Database()
+    with pytest.raises(promql.PromqlError):
+        promql.evaluate(db, 'flow_metrics_network_byte_tx{host=~"["}', 0, 10)
+
+
+def test_ns_timestamp_samples_skipped():
+    from deepflow_tpu.server.integration import IntegrationAPI
+    from deepflow_tpu.store import Database
+    db = Database()
+    api = IntegrationAPI(db)
+    wr = make_write_request([
+        ("m2", {}, [(1_750_000_000_000_000_000, 1.0),   # ns-unit garbage
+                    (1_750_000_000_000, 2.0)])])        # proper ms
+    out = api.ingest_prometheus(snappy.compress(wr))
+    assert out["accepted_samples"] == 1
+    t = db.table("prometheus.samples")
+    assert t.column_concat(["value"])["value"].tolist() == [2.0]
+
+
+def test_family_prefix_falls_through_to_samples():
+    from deepflow_tpu.server.integration import IntegrationAPI
+    from deepflow_tpu.store import Database
+    from deepflow_tpu.query import promql
+    db = Database()
+    api = IntegrationAPI(db)
+    now = int(time.time())
+    wr = make_write_request([
+        ("flow_metrics_network_custom_latency", {"k": "v"},
+         [((now - 5) * 1000, 7.0)])])
+    api.ingest_prometheus(snappy.compress(wr))
+    out = promql.evaluate(db, "flow_metrics_network_custom_latency",
+                          now - 5, now, 5)
+    assert out and out[0]["values"][-1][1] == pytest.approx(7.0)
