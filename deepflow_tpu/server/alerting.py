@@ -1,0 +1,195 @@
+"""Alert engine: periodic DF-SQL/PromQL conditions -> alert events.
+
+Reference analog: message/alert_event.proto + the alert-event family of
+ingester/event (alert_event_writer.go). Rules evaluate on a timer; a firing
+rule writes an event.event row (event_type="alert") and optionally POSTs a
+webhook. Hysteresis: one event per state transition, not per tick.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import urllib.request
+
+from deepflow_tpu.query import engine as qengine
+from deepflow_tpu.store.db import Database
+
+log = logging.getLogger("df.alerting")
+
+_OPS = {
+    ">": lambda a, b: a > b,
+    ">=": lambda a, b: a >= b,
+    "<": lambda a, b: a < b,
+    "<=": lambda a, b: a <= b,
+    "==": lambda a, b: a == b,
+    "!=": lambda a, b: a != b,
+}
+
+
+class AlertRule:
+    def __init__(self, name: str, db_name: str, sql: str, op: str,
+                 threshold: float, severity: str = "warning",
+                 interval_s: float = 15.0, webhook: str = "") -> None:
+        if op not in _OPS:
+            raise ValueError(f"bad op {op!r}; use one of {sorted(_OPS)}")
+        self.name = name
+        self.db_name = db_name
+        self.sql = sql
+        self.op = op
+        self.threshold = float(threshold)
+        self.severity = severity
+        self.interval_s = interval_s
+        self.webhook = webhook
+        self.firing = False
+        self.last_value: float | None = None
+        self.last_eval_ns = 0
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "db": self.db_name, "sql": self.sql,
+                "op": self.op, "threshold": self.threshold,
+                "severity": self.severity, "interval_s": self.interval_s,
+                "firing": self.firing, "last_value": self.last_value}
+
+
+class AlertEngine:
+    def __init__(self, db: Database, api=None) -> None:
+        self.db = db
+        self.api = api  # QuerierAPI for table resolution (optional)
+        self.rules: dict[str, AlertRule] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.stats = {"evals": 0, "fired": 0, "resolved": 0, "errors": 0}
+
+    # -- rule management ------------------------------------------------------
+
+    def upsert(self, spec: dict) -> AlertRule:
+        rule = AlertRule(
+            name=str(spec["name"]),
+            db_name=str(spec.get("db", "")),
+            sql=str(spec["sql"]),
+            op=str(spec.get("op", ">")),
+            threshold=float(spec.get("threshold", 0)),
+            severity=str(spec.get("severity", "warning")),
+            interval_s=float(spec.get("interval_s", 15.0)),
+            webhook=str(spec.get("webhook", "")))
+        # dry-run the query so bad rules are rejected at submit time
+        self._query_value(rule)
+        with self._lock:
+            prev = self.rules.get(rule.name)
+            if prev is not None:
+                # editing a rule must not reset its firing state — a
+                # re-upsert while firing would re-emit the alert event
+                rule.firing = prev.firing
+                rule.last_value = prev.last_value
+                rule.last_eval_ns = prev.last_eval_ns
+            self.rules[rule.name] = rule
+        return rule
+
+    def delete(self, name: str) -> bool:
+        with self._lock:
+            return self.rules.pop(name, None) is not None
+
+    def list(self) -> list[dict]:
+        with self._lock:
+            return [r.to_dict() for r in self.rules.values()]
+
+    # -- evaluation -----------------------------------------------------------
+
+    def _resolve_table(self, rule: AlertRule):
+        from deepflow_tpu.query import sql as qsql
+        select = qsql.parse(rule.sql)
+        candidates = [select.table, f"{select.table}.1s"]
+        if rule.db_name:
+            candidates = [f"{rule.db_name}.{select.table}",
+                          f"{rule.db_name}.{select.table}.1s"] + candidates
+        for cand in candidates:
+            try:
+                return self.db.table(cand), select
+            except KeyError:
+                continue
+        raise qengine.QueryError(f"no such table {select.table!r}")
+
+    def _query_value(self, rule: AlertRule) -> float:
+        table, select = self._resolve_table(rule)
+        res = qengine.execute(table, select)
+        if not res.values or not res.values[0]:
+            return 0.0
+        v = res.values[0][0]
+        if not isinstance(v, (int, float)):
+            raise qengine.QueryError(
+                f"alert query must yield a number, got {v!r}")
+        return float(v)
+
+    def eval_rule(self, rule: AlertRule, now_ns: int | None = None) -> None:
+        now = now_ns if now_ns is not None else time.time_ns()
+        value = self._query_value(rule)
+        rule.last_value = value
+        rule.last_eval_ns = now
+        self.stats["evals"] += 1
+        breach = _OPS[rule.op](value, rule.threshold)
+        if breach and not rule.firing:
+            rule.firing = True
+            self.stats["fired"] += 1
+            self._emit(rule, "alert", value, now)
+        elif not breach and rule.firing:
+            rule.firing = False
+            self.stats["resolved"] += 1
+            self._emit(rule, "alert-resolved", value, now)
+
+    def _emit(self, rule: AlertRule, etype: str, value: float,
+              now_ns: int) -> None:
+        self.db.table("event.event").append_rows([{
+            "time": now_ns,
+            "event_type": etype,
+            "resource_type": "alert-rule",
+            "resource_name": rule.name,
+            "description": (f"{rule.sql} -> {value:.6g} "
+                            f"{rule.op} {rule.threshold:.6g}"),
+            "attrs": json.dumps({"severity": rule.severity,
+                                 "value": value}),
+        }])
+        log.warning("%s: %s (value=%.6g %s %.6g)", etype, rule.name, value,
+                    rule.op, rule.threshold)
+        if rule.webhook:
+            try:
+                req = urllib.request.Request(
+                    rule.webhook,
+                    data=json.dumps({
+                        "rule": rule.name, "type": etype, "value": value,
+                        "severity": rule.severity,
+                        "threshold": rule.threshold}).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=5):
+                    pass
+            except Exception as e:
+                log.debug("webhook failed: %s", e)
+
+    # -- loop -----------------------------------------------------------------
+
+    def start(self) -> "AlertEngine":
+        self._thread = threading.Thread(
+            target=self._run, name="df-alerting", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2.0)
+
+    def _run(self) -> None:
+        while not self._stop.wait(1.0):
+            now = time.time_ns()
+            with self._lock:
+                due = [r for r in self.rules.values()
+                       if now - r.last_eval_ns >= r.interval_s * 1e9]
+            for rule in due:
+                try:
+                    self.eval_rule(rule, now)
+                except Exception:
+                    self.stats["errors"] += 1
+                    log.exception("alert eval failed: %s", rule.name)

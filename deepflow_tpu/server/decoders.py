@@ -29,10 +29,11 @@ class Decoder:
     MSG_TYPE: MessageType
 
     def __init__(self, q: queue.Queue, db: Database,
-                 platform: PlatformInfoTable) -> None:
+                 platform: PlatformInfoTable, exporters=None) -> None:
         self.q = q
         self.db = db
         self.platform = platform
+        self.exporters = exporters
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.stats = {"batches": 0, "rows": 0, "errors": 0}
@@ -66,6 +67,12 @@ class Decoder:
     def handle(self, header: FrameHeader, payload: bytes) -> int:
         raise NotImplementedError
 
+    def write(self, table_name: str, rows: list[dict]) -> None:
+        """Append + feed the re-export pipeline (reference: exporters)."""
+        self.db.table(table_name).append_rows(rows)
+        if self.exporters is not None and rows:
+            self.exporters.feed(table_name, rows)
+
 
 class ProfileDecoder(Decoder):
     """ProfileBatch -> profile.in_process_profile."""
@@ -75,7 +82,6 @@ class ProfileDecoder(Decoder):
     def handle(self, header: FrameHeader, payload: bytes) -> int:
         batch = pb.ProfileBatch.FromString(payload)
         tags = self.platform.tags_for(header.agent_id)
-        table = self.db.table("profile.in_process_profile")
         rows = []
         for p in batch.profiles:
             rows.append({
@@ -92,7 +98,7 @@ class ProfileDecoder(Decoder):
                 "count": p.count,
                 **tags,
             })
-        table.append_rows(rows)
+        self.write("profile.in_process_profile", rows)
         return len(rows)
 
 
@@ -104,7 +110,6 @@ class TpuSpanDecoder(Decoder):
     def handle(self, header: FrameHeader, payload: bytes) -> int:
         batch = pb.TpuSpanBatch.FromString(payload)
         tags = self.platform.tags_for(header.agent_id)
-        table = self.db.table("profile.tpu_hlo_span")
         rows = []
         for s in batch.spans:
             rows.append({
@@ -130,7 +135,7 @@ class TpuSpanDecoder(Decoder):
                 "app_service": s.process_name,
                 **{**tags, "slice_id": s.slice_id or tags.get("slice_id", 0)},
             })
-        table.append_rows(rows)
+        self.write("profile.tpu_hlo_span", rows)
         return len(rows)
 
 
@@ -145,7 +150,6 @@ class FlowLogDecoder(Decoder):
         tags = self.platform.tags_for(header.agent_id)
         n = 0
         if batch.l4:
-            t4 = self.db.table("flow_log.l4_flow_log")
             rows = []
             for f in batch.l4:
                 rows.append({
@@ -172,10 +176,9 @@ class FlowLogDecoder(Decoder):
                     "gprocess_id_0": f.gpid_0, "gprocess_id_1": f.gpid_1,
                     **tags,
                 })
-            t4.append_rows(rows)
+            self.write("flow_log.l4_flow_log", rows)
             n += len(rows)
         if batch.l7:
-            t7 = self.db.table("flow_log.l7_flow_log")
             rows = []
             for f in batch.l7:
                 rows.append({
@@ -212,7 +215,7 @@ class FlowLogDecoder(Decoder):
                     "process_kname_1": f.process_kname_1,
                     **tags,
                 })
-            t7.append_rows(rows)
+            self.write("flow_log.l7_flow_log", rows)
             n += len(rows)
         return n
 
@@ -264,9 +267,9 @@ class MetricsDecoder(Decoder):
                     "timeout": m.timeout,
                 })
         if net_rows:
-            self.db.table("flow_metrics.network.1s").append_rows(net_rows)
+            self.write("flow_metrics.network.1s", net_rows)
         if app_rows:
-            self.db.table("flow_metrics.application.1s").append_rows(app_rows)
+            self.write("flow_metrics.application.1s", app_rows)
         return len(net_rows) + len(app_rows)
 
 
@@ -278,7 +281,6 @@ class StatsDecoder(Decoder):
     def handle(self, header: FrameHeader, payload: bytes) -> int:
         batch = pb.StatsBatch.FromString(payload)
         tags = self.platform.tags_for(header.agent_id)
-        table = self.db.table("deepflow_system.deepflow_system")
         rows = []
         for m in batch.metrics:
             tag_json = json.dumps(dict(m.tags), sort_keys=True)
@@ -291,7 +293,7 @@ class StatsDecoder(Decoder):
                     "value": v,
                     **tags,
                 })
-        table.append_rows(rows)
+        self.write("deepflow_system.deepflow_system", rows)
         return len(rows)
 
 
@@ -303,7 +305,6 @@ class EventDecoder(Decoder):
     def handle(self, header: FrameHeader, payload: bytes) -> int:
         batch = pb.EventBatch.FromString(payload)
         tags = self.platform.tags_for(header.agent_id)
-        table = self.db.table("event.event")
         rows = [{
             "time": e.timestamp_ns,
             "event_type": e.event_type,
@@ -314,7 +315,7 @@ class EventDecoder(Decoder):
             "attrs": json.dumps(dict(e.attrs), sort_keys=True),
             **tags,
         } for e in batch.events]
-        table.append_rows(rows)
+        self.write("event.event", rows)
         return len(rows)
 
 

@@ -1,0 +1,211 @@
+"""Exporters: re-export ingested telemetry to downstream systems.
+
+Reference analog: server/ingester/exporters (kafka / OTLP / prometheus
+remote-write re-export with tag translation). Decoders feed rows after
+ingest; each exporter filters by table, converts, batches, and ships over
+HTTP in a background thread (failures never block ingest).
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import logging
+import queue
+import threading
+import urllib.request
+
+log = logging.getLogger("df.exporters")
+
+
+class BaseExporter:
+    """Background batch shipper; subclasses convert rows to a payload."""
+
+    TABLES: tuple = ()
+
+    def __init__(self, endpoint: str, batch_size: int = 256,
+                 flush_interval_s: float = 2.0,
+                 queue_size: int = 8192) -> None:
+        self.endpoint = endpoint
+        self.batch_size = batch_size
+        self.flush_interval_s = flush_interval_s
+        self._q: queue.Queue = queue.Queue(maxsize=queue_size)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.stats = {"exported": 0, "batches": 0, "dropped": 0, "errors": 0}
+
+    def accepts(self, table: str) -> bool:
+        return not self.TABLES or table in self.TABLES
+
+    def feed(self, table: str, rows: list[dict]) -> None:
+        if not self.accepts(table):
+            return
+        for row in rows:
+            try:
+                self._q.put_nowait((table, row))
+            except queue.Full:
+                self.stats["dropped"] += 1
+
+    def start(self) -> "BaseExporter":
+        self._thread = threading.Thread(
+            target=self._run, name=f"df-exporter-{type(self).__name__}",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=3.0)
+
+    def _run(self) -> None:
+        batch: list = []
+        while not self._stop.is_set() or not self._q.empty():
+            try:
+                batch.append(self._q.get(timeout=self.flush_interval_s))
+            except queue.Empty:
+                pass
+            if batch and (len(batch) >= self.batch_size or self._q.empty()):
+                try:
+                    self._ship(batch)
+                    self.stats["exported"] += len(batch)
+                    self.stats["batches"] += 1
+                except Exception as e:
+                    self.stats["errors"] += 1
+                    log.debug("export failed: %s", e)
+                batch = []
+
+    def _ship(self, batch: list) -> None:
+        raise NotImplementedError
+
+    def _post(self, data: bytes, content_type: str,
+              headers: dict | None = None) -> None:
+        req = urllib.request.Request(
+            self.endpoint, data=data,
+            headers={"Content-Type": content_type, **(headers or {})})
+        with urllib.request.urlopen(req, timeout=10):
+            pass
+
+
+class JsonLinesExporter(BaseExporter):
+    """NDJSON over HTTP (the kafka-topic analog for environments without
+    kafka: any collector that takes line-delimited JSON)."""
+
+    def __init__(self, endpoint: str, tables: tuple = (), **kw) -> None:
+        super().__init__(endpoint, **kw)
+        self.TABLES = tables
+
+    def _ship(self, batch: list) -> None:
+        lines = b"\n".join(
+            json.dumps({"table": t, **row}, default=str).encode()
+            for t, row in batch)
+        self._post(gzip.compress(lines), "application/x-ndjson",
+                   {"Content-Encoding": "gzip"})
+
+
+class OtlpJsonExporter(BaseExporter):
+    """l7_flow_log rows -> OTLP/HTTP JSON traces."""
+
+    TABLES = ("flow_log.l7_flow_log",)
+
+    def _ship(self, batch: list) -> None:
+        spans = []
+        for _, row in batch:
+            start = int(row.get("time", 0))
+            dur = int(row.get("response_duration", 0))
+            spans.append({
+                "traceId": row.get("trace_id", ""),
+                "spanId": row.get("span_id", "") or f"flow-{row.get('flow_id', 0)}",
+                "parentSpanId": row.get("parent_span_id", ""),
+                "name": (f"{row.get('request_type', '')} "
+                         f"{row.get('endpoint', '')}").strip() or "span",
+                "kind": 2,
+                "startTimeUnixNano": str(start),
+                "endTimeUnixNano": str(start + dur),
+                "attributes": [
+                    {"key": "l7.protocol",
+                     "value": {"stringValue": str(row.get("l7_protocol", ""))}},
+                    {"key": "http.status_code",
+                     "value": {"intValue": int(row.get("response_code", 0))}},
+                    {"key": "net.peer.ip",
+                     "value": {"stringValue": row.get("ip_dst", "")}},
+                ],
+                "status": {"code": 1 if row.get("response_status") in (1, "ok")
+                           else 2},
+            })
+        payload = {"resourceSpans": [{
+            "resource": {"attributes": [
+                {"key": "service.name",
+                 "value": {"stringValue": "deepflow-tpu"}}]},
+            "scopeSpans": [{"spans": spans}]}]}
+        self._post(json.dumps(payload).encode(), "application/json")
+
+
+class RemoteWriteExporter(BaseExporter):
+    """flow_metrics rows -> prometheus remote-write (snappy WriteRequest)."""
+
+    TABLES = ("flow_metrics.network.1s", "flow_metrics.application.1s")
+
+    _METERS = {
+        "flow_metrics.network.1s": (
+            "flow_metrics_network_", ("byte_tx", "byte_rx", "packet_tx",
+                                      "packet_rx", "retrans")),
+        "flow_metrics.application.1s": (
+            "flow_metrics_application_", ("request", "response",
+                                          "error_client", "error_server")),
+    }
+    _LABELS = ("ip_src", "ip_dst", "server_port", "host", "app_service")
+
+    def _ship(self, batch: list) -> None:
+        from deepflow_tpu.utils import promwire, snappy
+        series = []
+        for table, row in batch:
+            prefix, meters = self._METERS.get(table, ("", ()))
+            labels = {lbl: str(row[lbl]) for lbl in self._LABELS
+                      if row.get(lbl) not in (None, "", 0)}
+            ts_ms = int(row.get("time", 0)) * 1000
+            for meter in meters:
+                # zeros export too: downstream series must return to 0
+                # after a burst, not go stale inside the staleness window
+                series.append((prefix + meter, labels,
+                               [(ts_ms, float(row.get(meter, 0)))]))
+        if series:
+            self._post(snappy.compress(promwire.write_request(series)),
+                       "application/x-protobuf",
+                       {"Content-Encoding": "snappy",
+                        "X-Prometheus-Remote-Write-Version": "0.1.0"})
+
+
+class ExporterManager:
+    def __init__(self) -> None:
+        self.exporters: list[BaseExporter] = []
+
+    def add(self, exporter: BaseExporter) -> BaseExporter:
+        """Idempotent on (type, endpoint): re-adding returns the existing
+        exporter instead of leaking threads and double-shipping."""
+        for e in self.exporters:
+            if (type(e) is type(exporter)
+                    and e.endpoint == exporter.endpoint):
+                return e
+        self.exporters.append(exporter.start())
+        return exporter
+
+    def remove(self, endpoint: str) -> int:
+        removed = [e for e in self.exporters if e.endpoint == endpoint]
+        self.exporters = [e for e in self.exporters
+                          if e.endpoint != endpoint]
+        for e in removed:
+            e.stop()
+        return len(removed)
+
+    def feed(self, table: str, rows: list[dict]) -> None:
+        for e in self.exporters:
+            e.feed(table, rows)
+
+    def stop(self) -> None:
+        for e in self.exporters:
+            e.stop()
+
+    def stats(self) -> dict:
+        return {f"{type(e).__name__}:{e.endpoint}": dict(e.stats)
+                for e in self.exporters}

@@ -28,14 +28,21 @@ def _attr_map(attrs: list) -> dict:
 
 
 class IntegrationAPI:
-    def __init__(self, db: Database) -> None:
+    def __init__(self, db: Database, exporters=None) -> None:
         self.db = db
+        self.exporters = exporters
         self.stats = {"otlp_spans": 0, "profiles": 0, "app_logs": 0}
+
+    def _write(self, table_name: str, rows: list[dict]) -> None:
+        """HTTP-ingested rows join the re-export pipeline too (same path as
+        agent telemetry — exporters must see a consistent table view)."""
+        self.db.table(table_name).append_rows(rows)
+        if self.exporters is not None and rows:
+            self.exporters.feed(table_name, rows)
 
     # -- OTLP/HTTP JSON traces (POST /api/v1/otlp/traces) --------------------
 
     def ingest_otlp_traces(self, body: dict) -> dict:
-        table = self.db.table("flow_log.l7_flow_log")
         rows = []
         if not isinstance(body, dict):
             raise ValueError("OTLP body must be a JSON object")
@@ -74,7 +81,7 @@ class IntegrationAPI:
                         "span_id": span.get("spanId", ""),
                         "parent_span_id": span.get("parentSpanId", ""),
                     })
-        table.append_rows(rows)
+        self._write("flow_log.l7_flow_log", rows)
         self.stats["otlp_spans"] += len(rows)
         return {"accepted_spans": len(rows)}
 
@@ -86,7 +93,6 @@ class IntegrationAPI:
         name = params.get("name", "external")
         units = params.get("units", "samples")
         now = time.time_ns()
-        table = self.db.table("profile.in_process_profile")
         rows = []
         for line in raw.decode("utf-8", "replace").splitlines():
             line = line.strip()
@@ -107,7 +113,7 @@ class IntegrationAPI:
                 "value": v,
                 "count": 1,
             })
-        table.append_rows(rows)
+        self._write("profile.in_process_profile", rows)
         self.stats["profiles"] += len(rows)
         return {"accepted_stacks": len(rows), "units": units}
 
@@ -124,7 +130,6 @@ class IntegrationAPI:
             series = _parse_write_request(data)
         except WireError as e:
             raise ValueError(f"not a WriteRequest: {e}") from None
-        table = self.db.table("prometheus.samples")
         rows = []
         for name, labels, samples in series:
             labels_json = json.dumps(labels, sort_keys=True)
@@ -138,7 +143,7 @@ class IntegrationAPI:
                     "labels_json": labels_json,
                     "value": value,
                 })
-        table.append_rows(rows)
+        self._write("prometheus.samples", rows)
         self.stats["prom_samples"] = self.stats.get("prom_samples", 0) \
             + len(rows)
         return {"accepted_samples": len(rows), "series": len(series)}
@@ -146,7 +151,6 @@ class IntegrationAPI:
     # -- app logs (POST /api/v1/log) -----------------------------------------
 
     def ingest_app_log(self, body: dict) -> dict:
-        table = self.db.table("event.event")
         entries = body if isinstance(body, list) else [body]
         entries = [e for e in entries if isinstance(e, dict)]
         rows = [{
@@ -160,7 +164,7 @@ class IntegrationAPI:
                  if k not in ("message", "timestamp_ns")},
                 sort_keys=True),
         } for e in entries]
-        table.append_rows(rows)
+        self._write("event.event", rows)
         self.stats["app_logs"] += len(rows)
         return {"accepted": len(rows)}
 

@@ -25,12 +25,54 @@ class QuerierAPI:
     """Route logic, separated from HTTP plumbing for in-process use."""
 
     def __init__(self, db: Database, stats_provider=None,
-                 controller=None) -> None:
+                 controller=None, exporters=None, alerts=None) -> None:
         self.db = db
         self.stats_provider = stats_provider or (lambda: {})
         self.controller = controller
+        self.exporters = exporters
+        self.alerts = alerts
         from deepflow_tpu.server.integration import IntegrationAPI
-        self.integration = IntegrationAPI(db)
+        self.integration = IntegrationAPI(db, exporters=exporters)
+
+    def alerts_api(self, method: str, body: dict) -> dict:
+        if self.alerts is None:
+            raise qengine.QueryError("alerting not running")
+        if method == "list":
+            return {"rules": self.alerts.list()}
+        if method == "upsert":
+            return {"rule": self.alerts.upsert(body).to_dict()}
+        if method == "delete":
+            return {"deleted": self.alerts.delete(str(body.get("name", "")))}
+        raise qengine.QueryError(f"unknown alerts action {method!r}")
+
+    def exporters_api(self, body: dict) -> dict:
+        if self.exporters is None:
+            raise qengine.QueryError("exporters not running")
+        from deepflow_tpu.server.exporters import (
+            JsonLinesExporter, OtlpJsonExporter, RemoteWriteExporter)
+        etype = body.get("type", "")
+        endpoint = body.get("endpoint", "")
+        if not endpoint:
+            raise qengine.QueryError("endpoint required")
+        if etype == "json-lines":
+            exp = JsonLinesExporter(endpoint,
+                                    tables=tuple(body.get("tables", [])))
+        elif etype == "otlp-json":
+            exp = OtlpJsonExporter(endpoint)
+        elif etype == "remote-write":
+            exp = RemoteWriteExporter(endpoint)
+        else:
+            raise qengine.QueryError(
+                "type must be json-lines|otlp-json|remote-write")
+        self.exporters.add(exp)  # idempotent on (type, endpoint)
+        return {"added": etype, "endpoint": endpoint,
+                "exporters": self.exporters.stats()}
+
+    def exporters_delete(self, body: dict) -> dict:
+        if self.exporters is None:
+            raise qengine.QueryError("exporters not running")
+        endpoint = body.get("endpoint", "")
+        return {"removed": self.exporters.remove(endpoint)}
 
     def query(self, body: dict) -> dict:
         sql_text = body.get("sql", "")
@@ -216,6 +258,12 @@ class QuerierHTTP:
                         self._send(200, api.health())
                     elif path == "/v1/agents":
                         self._send(200, api.agents())
+                    elif path == "/v1/alerts":
+                        self._send(200, api.alerts_api("list", {}))
+                    elif path == "/v1/exporters":
+                        self._send(200, {"exporters":
+                                         api.exporters.stats()
+                                         if api.exporters else {}})
                     elif path in ("/prom/api/v1/query_range",
                                   "/api/v1/query_range"):
                         self._send(200, api.prom_query_range(params))
@@ -260,6 +308,14 @@ class QuerierHTTP:
                                    api.integration.ingest_otlp_traces(body))
                     elif path == "/api/v1/log":
                         self._send(200, api.integration.ingest_app_log(body))
+                    elif path == "/v1/alerts":
+                        self._send(200, api.alerts_api("upsert", body))
+                    elif path == "/v1/alerts/delete":
+                        self._send(200, api.alerts_api("delete", body))
+                    elif path == "/v1/exporters":
+                        self._send(200, api.exporters_api(body))
+                    elif path == "/v1/exporters/delete":
+                        self._send(200, api.exporters_delete(body))
                     else:
                         self._send(404, {"error": f"no route {self.path}"})
                 except (qengine.QueryError, qsql.SqlError, KeyError,

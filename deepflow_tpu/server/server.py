@@ -39,8 +39,13 @@ class Server:
             else:
                 self.controller = Controller(
                     self.platform, host=host, port=sync_port)
+        from deepflow_tpu.server.alerting import AlertEngine
+        from deepflow_tpu.server.exporters import ExporterManager
+        self.exporters = ExporterManager()
+        self.alerts = AlertEngine(self.db)
         self.api = QuerierAPI(self.db, stats_provider=self._stats,
-                              controller=self.controller)
+                              controller=self.controller,
+                              exporters=self.exporters, alerts=self.alerts)
         self.http = QuerierHTTP(self.api, host=host, port=query_port)
         from deepflow_tpu.server.datasource import RollupJob
         self.rollup = RollupJob(self.db)
@@ -68,12 +73,13 @@ class Server:
         ]
         for cls, mtype in pairs:
             q = self.receiver.register(mtype)
-            d = cls(q, self.db, self.platform)
+            d = cls(q, self.db, self.platform, exporters=self.exporters)
             d.MSG_TYPE = mtype  # FlowLogDecoder serves two types
             self.decoders.append(d.start())
         self.receiver.start()
         self.http.start()
         self.rollup.start()
+        self.alerts.start()
         if self.controller:
             self.controller.start()
         self._started = True
@@ -89,6 +95,8 @@ class Server:
             d.stop()
         self.http.stop()
         self.rollup.stop()
+        self.alerts.stop()
+        self.exporters.stop()
         if self.controller:
             self.controller.stop()
         try:

@@ -28,38 +28,14 @@ def test_snappy_roundtrip_and_copies():
         snappy.decompress(bytes([4, 0x11, 0x04]))  # copy before any output
 
 
-def _varint(v: int) -> bytes:
-    out = bytearray()
-    while True:
-        b = v & 0x7F
-        v >>= 7
-        out.append(b | (0x80 if v else 0))
-        if not v:
-            return bytes(out)
-
-
-def _label(name: bytes, value: bytes) -> bytes:
-    body = b"\x0a" + _varint(len(name)) + name + \
-           b"\x12" + _varint(len(value)) + value
-    return b"\x0a" + _varint(len(body)) + body
-
-
-def _sample(value: float, ts_ms: int) -> bytes:
-    body = b"\x09" + struct.pack("<d", value) + b"\x10" + _varint(ts_ms)
-    return b"\x12" + _varint(len(body)) + body
-
-
 def make_write_request(series) -> bytes:
-    """series: [(name, labels_dict, [(ts_ms, val)])] -> WriteRequest bytes."""
-    out = b""
-    for name, labels, samples in series:
-        ts_body = _label(b"__name__", name.encode())
-        for k, v in labels.items():
-            ts_body += _label(k.encode(), v.encode())
-        for ts_ms, val in samples:
-            ts_body += _sample(val, ts_ms)
-        out += b"\x0a" + _varint(len(ts_body)) + ts_body
-    return out
+    """series: [(name, labels_dict, [(ts_ms, val)])] -> WriteRequest bytes.
+    Uses the production encoder (utils/promwire) so tests validate the exact
+    bytes the exporter ships."""
+    from deepflow_tpu.utils import promwire
+    return promwire.write_request(
+        [(name, labels, [(ts, v) for ts, v in samples])
+         for name, labels, samples in series])
 
 
 def test_remote_write_to_promql():
