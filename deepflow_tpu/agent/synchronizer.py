@@ -20,6 +20,7 @@ log = logging.getLogger("df.sync")
 
 _SYNC = "/deepflow_tpu.Synchronizer/Sync"
 _GPID = "/deepflow_tpu.Synchronizer/GpidSync"
+_PUSH = "/deepflow_tpu.Synchronizer/Push"
 
 
 class Synchronizer:
@@ -31,9 +32,12 @@ class Synchronizer:
         self._channel: grpc.Channel | None = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        self._push_thread: threading.Thread | None = None
+        self._push_call = None
         self.config_version = 0
         self.platform_version = 0
         self._platform_cache: pb.PlatformData | None = None
+        self._apply_lock = threading.Lock()  # poll + push threads both apply
         self.stats = {"syncs": 0, "errors": 0, "config_updates": 0}
 
     def start(self) -> "Synchronizer":
@@ -41,14 +45,50 @@ class Synchronizer:
         self._thread = threading.Thread(
             target=self._run, name="df-synchronizer", daemon=True)
         self._thread.start()
+        self._push_thread = threading.Thread(
+            target=self._push_loop, name="df-sync-push", daemon=True)
+        self._push_thread.start()
         return self
 
     def stop(self) -> None:
         self._stop.set()
+        call = self._push_call
+        if call is not None:
+            call.cancel()
         if self._thread:
             self._thread.join(timeout=2.0)
+        if self._push_thread:
+            self._push_thread.join(timeout=2.0)
         if self._channel:
             self._channel.close()
+
+    def _push_loop(self) -> None:
+        """Config changes arrive the moment they are saved (reference:
+        trisolaris Push stream), instead of waiting for the next poll."""
+        stream = self._channel.unary_stream(
+            _PUSH,
+            request_serializer=pb.SyncRequest.SerializeToString,
+            response_deserializer=pb.SyncResponse.FromString)
+        while not self._stop.is_set():
+            req = pb.SyncRequest()
+            req.agent_group = getattr(self.agent.config, "group",
+                                      "") or "default"
+            req.agent_id = self.agent.config.agent_id
+            req.config_version = self.config_version  # enables catch-up
+            try:
+                call = stream(req)
+                self._push_call = call
+                for resp in call:
+                    if self._stop.is_set():
+                        return
+                    self.stats["pushes"] = self.stats.get("pushes", 0) + 1
+                    self._on_response(resp)
+            except grpc.RpcError:
+                pass
+            finally:
+                self._push_call = None
+            if self._stop.wait(2.0):
+                return
 
     def _run(self) -> None:
         # first sync immediately, then on the interval
@@ -99,15 +139,21 @@ class Synchronizer:
         return resp
 
     def _on_response(self, resp: pb.SyncResponse) -> None:
-        if resp.agent_id and resp.agent_id != self.agent.config.agent_id:
-            self.agent.config.agent_id = resp.agent_id
-            self.agent.sender.agent_id = resp.agent_id
-        if resp.user_config_yaml and \
-                resp.config_version != self.config_version:
-            self._apply_config(resp.user_config_yaml, resp.config_version)
-            self.config_version = resp.config_version
-            self.stats["config_updates"] += 1
-        self.platform_version = resp.platform_version
+        with self._apply_lock:  # poll + push threads: serialize, and only
+            # ever move FORWARD (a stale in-flight poll response must not
+            # downgrade a newer pushed config)
+            if resp.agent_id and \
+                    resp.agent_id != self.agent.config.agent_id:
+                self.agent.config.agent_id = resp.agent_id
+                self.agent.sender.agent_id = resp.agent_id
+            if resp.user_config_yaml and \
+                    resp.config_version > self.config_version:
+                self._apply_config(resp.user_config_yaml,
+                                   resp.config_version)
+                self.config_version = resp.config_version
+                self.stats["config_updates"] += 1
+            if resp.platform_version:  # push responses leave it unset
+                self.platform_version = resp.platform_version
 
     def _apply_config(self, yaml_bytes: bytes, version: int) -> None:
         """Hot-apply the pushed config (reference: ConfigHandler per-module

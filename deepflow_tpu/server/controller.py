@@ -10,6 +10,7 @@ image has protoc but not grpcio-tools.
 from __future__ import annotations
 
 import logging
+import queue
 import threading
 import time
 from concurrent import futures
@@ -115,6 +116,11 @@ class ConfigStore:
         self._lock = threading.Lock()
         self._configs: dict[str, tuple[bytes, int]] = {
             "default": (DEFAULT_AGENT_CONFIG_YAML, 1)}
+        self._listeners: list = []  # callables(group, yaml, version)
+
+    def subscribe(self, fn) -> None:
+        with self._lock:
+            self._listeners.append(fn)
 
     def get(self, group: str = "default") -> tuple[bytes, int]:
         with self._lock:
@@ -126,7 +132,13 @@ class ConfigStore:
             _, version = self._configs.get(group, (b"", 0))
             version += 1
             self._configs[group] = (yaml_bytes, version)
-            return version
+            listeners = list(self._listeners)
+        for fn in listeners:
+            try:
+                fn(group, yaml_bytes, version)
+            except Exception:
+                log.exception("config listener failed")
+        return version
 
     @staticmethod
     def validate(yaml_bytes: bytes) -> None:
@@ -154,6 +166,10 @@ class Controller:
         self._platform_lock = threading.Lock()
         self._platforms: dict[int, pb.PlatformData] = {}
         self._platform_version = 1
+        # push subscribers: (group, queue) per connected agent stream
+        self._push_lock = threading.Lock()
+        self._push_subs: list[tuple[str, "queue.Queue"]] = []
+        self.configs.subscribe(self._on_config_update)
 
     # -- rpc handlers ---------------------------------------------------------
 
@@ -185,6 +201,61 @@ class Controller:
     def GpidSync(self, request: pb.GpidSyncRequest,
                  context) -> pb.GpidSyncResponse:
         return self.gpids.sync(request)
+
+    MAX_PUSH_STREAMS = 48  # worker pool is sized to keep unary headroom
+
+    def Push(self, request: pb.SyncRequest, context):
+        """Server-streaming: config-change notifications (reference:
+        trisolaris push on version bump, sync_push.go pushmanager).
+        Yields a SyncResponse whenever the agent's group config changes;
+        replays the current config on subscribe when the agent is behind."""
+        group = request.agent_group or "default"
+        q: "queue.Queue" = queue.Queue(maxsize=16)
+        with self._push_lock:
+            if len(self._push_subs) >= self.MAX_PUSH_STREAMS:
+                return  # agent falls back to polling; retries later
+            self._push_subs.append((group, q))
+        try:
+            # catch-up: a reconnecting agent may have missed updates
+            cfg, version = self.configs.get(group)
+            if request.config_version != version:
+                resp = pb.SyncResponse()
+                resp.status = pb.SUCCESS
+                resp.user_config_yaml = cfg
+                resp.config_version = version
+                yield resp
+            while context.is_active():
+                try:
+                    resp = q.get(timeout=1.0)
+                except queue.Empty:
+                    continue
+                yield resp
+        finally:
+            with self._push_lock:
+                try:
+                    self._push_subs.remove((group, q))
+                except ValueError:
+                    pass
+
+    def _on_config_update(self, group: str, yaml_bytes: bytes,
+                          version: int) -> None:
+        resp = pb.SyncResponse()
+        resp.status = pb.SUCCESS
+        resp.user_config_yaml = yaml_bytes
+        resp.config_version = version
+        with self._push_lock:
+            subs = list(self._push_subs)
+        for sub_group, q in subs:
+            if sub_group == group:
+                try:
+                    q.put_nowait(resp)
+                except queue.Full:
+                    # keep the NEWEST config: drop one stale entry and retry
+                    try:
+                        q.get_nowait()
+                        q.put_nowait(resp)
+                    except (queue.Empty, queue.Full):
+                        pass
 
     def _ingest_platform(self, agent_id: int, p: pb.PlatformData) -> None:
         """Genesis upload -> platform snapshot + ingester tag table."""
@@ -224,11 +295,18 @@ class Controller:
                 self.GpidSync,
                 request_deserializer=pb.GpidSyncRequest.FromString,
                 response_serializer=pb.GpidSyncResponse.SerializeToString),
+            "Push": grpc.unary_stream_rpc_method_handler(
+                self.Push,
+                request_deserializer=pb.SyncRequest.FromString,
+                response_serializer=pb.SyncResponse.SerializeToString),
         }
         generic = grpc.method_handlers_generic_handler(
             "deepflow_tpu.Synchronizer", handlers)
+        # each Push stream pins a worker for its lifetime: size the pool so
+        # MAX_PUSH_STREAMS streams still leave unary-RPC headroom
         self._server = grpc.server(
-            futures.ThreadPoolExecutor(max_workers=8))
+            futures.ThreadPoolExecutor(
+                max_workers=self.MAX_PUSH_STREAMS + 16))
         self._server.add_generic_rpc_handlers((generic,))
         self.port = self._server.add_insecure_port(
             f"{self.host}:{self.port}")

@@ -1,0 +1,140 @@
+"""MCP server: expose observability data to LLM agents.
+
+Reference analog: server/mcp (Model-Context-Protocol endpoint exposing
+tracing data, server/mcp/mcp.go). JSON-RPC 2.0 over the querier HTTP port
+(POST /mcp) implementing initialize / tools/list / tools/call.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+
+log = logging.getLogger("df.mcp")
+
+PROTOCOL_VERSION = "2024-11-05"
+
+TOOLS = [
+    {
+        "name": "query",
+        "description": ("Run a DF-SQL query over the observability store. "
+                        "Tables: profile.in_process_profile, "
+                        "profile.tpu_hlo_span, flow_log.l4_flow_log, "
+                        "flow_log.l7_flow_log, flow_metrics.network.1s/1m/1h, "
+                        "flow_metrics.application.1s/1m/1h, event.event, "
+                        "prometheus.samples. Dialect: SELECT/WHERE/GROUP BY/"
+                        "ORDER BY/LIMIT with Sum/Avg/Min/Max/Count/Percentile"
+                        "/time(time, interval)."),
+        "inputSchema": {
+            "type": "object",
+            "properties": {
+                "db": {"type": "string", "description": "database prefix"},
+                "sql": {"type": "string"},
+            },
+            "required": ["sql"],
+        },
+    },
+    {
+        "name": "profile_flame",
+        "description": ("Flame graph (self/total values per frame) from "
+                        "continuous profiling. event_type: on-cpu | off-cpu "
+                        "| tpu-device | tpu-host."),
+        "inputSchema": {
+            "type": "object",
+            "properties": {
+                "app_service": {"type": "string"},
+                "event_type": {"type": "string"},
+            },
+        },
+    },
+    {
+        "name": "tpu_flame",
+        "description": ("TPU device-time flame graph: HLO module -> category "
+                        "-> op with summed device nanoseconds."),
+        "inputSchema": {
+            "type": "object",
+            "properties": {"device_id": {"type": "integer"}},
+        },
+    },
+    {
+        "name": "trace",
+        "description": "Distributed trace tree for a trace_id "
+                       "(network spans + TPU device span overlay).",
+        "inputSchema": {
+            "type": "object",
+            "properties": {"trace_id": {"type": "string"}},
+            "required": ["trace_id"],
+        },
+    },
+    {
+        "name": "list_agents",
+        "description": "List registered deepflow-tpu agents.",
+        "inputSchema": {"type": "object", "properties": {}},
+    },
+    {
+        "name": "health",
+        "description": "Server health: per-table row counts and pipeline "
+                       "statistics.",
+        "inputSchema": {"type": "object", "properties": {}},
+    },
+]
+
+
+class McpServer:
+    def __init__(self, api) -> None:
+        self.api = api  # QuerierAPI
+
+    def handle(self, body: dict) -> dict | None:
+        """One JSON-RPC request -> response dict (None for notifications)."""
+        rpc_id = body.get("id")
+        method = body.get("method", "")
+        params = body.get("params") or {}
+        try:
+            if method == "initialize":
+                result = {
+                    "protocolVersion": PROTOCOL_VERSION,
+                    "capabilities": {"tools": {}},
+                    "serverInfo": {"name": "deepflow-tpu",
+                                   "version": "0.1.0"},
+                }
+            elif method == "notifications/initialized":
+                return None
+            elif method == "tools/list":
+                result = {"tools": TOOLS}
+            elif method == "tools/call":
+                result = self._call_tool(
+                    params.get("name", ""), params.get("arguments") or {})
+            elif method == "ping":
+                result = {}
+            else:
+                return _rpc_error(rpc_id, -32601,
+                                  f"method not found: {method}")
+            return {"jsonrpc": "2.0", "id": rpc_id, "result": result}
+        except Exception as e:
+            log.debug("mcp error: %s", e)
+            return _rpc_error(rpc_id, -32000, f"{type(e).__name__}: {e}")
+
+    def _call_tool(self, name: str, args: dict) -> dict:
+        api = self.api
+        if name == "query":
+            out = api.query({"db": args.get("db", ""),
+                             "sql": args.get("sql", "")})["result"]
+        elif name == "profile_flame":
+            out = api.profile_tracing(args)["result"]
+        elif name == "tpu_flame":
+            out = api.tpu_flame(args)["result"]
+        elif name == "trace":
+            out = api.trace(args)["result"]
+        elif name == "list_agents":
+            out = api.agents()
+        elif name == "health":
+            out = api.health()
+        else:
+            raise ValueError(f"unknown tool {name!r}")
+        return {"content": [{"type": "text",
+                             "text": json.dumps(out, default=str)}]}
+
+
+def _rpc_error(rpc_id, code: int, message: str) -> dict:
+    return {"jsonrpc": "2.0", "id": rpc_id,
+            "error": {"code": code, "message": message}}

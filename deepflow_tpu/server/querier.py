@@ -33,6 +33,8 @@ class QuerierAPI:
         self.alerts = alerts
         from deepflow_tpu.server.integration import IntegrationAPI
         self.integration = IntegrationAPI(db, exporters=exporters)
+        from deepflow_tpu.server.mcp import McpServer
+        self.mcp = McpServer(self)
 
     def alerts_api(self, method: str, body: dict) -> dict:
         if self.alerts is None:
@@ -316,6 +318,10 @@ class QuerierHTTP:
                         self._send(200, api.exporters_api(body))
                     elif path == "/v1/exporters/delete":
                         self._send(200, api.exporters_delete(body))
+                    elif path == "/mcp":
+                        resp = api.mcp.handle(body)
+                        self._send(200 if resp else 202,
+                                   resp or {"accepted": True})
                     else:
                         self._send(404, {"error": f"no route {self.path}"})
                 except (qengine.QueryError, qsql.SqlError, KeyError,

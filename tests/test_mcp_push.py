@@ -1,0 +1,129 @@
+"""MCP server + controller push-stream tests."""
+
+import json
+import time
+import urllib.request
+
+
+from deepflow_tpu.server import Server
+
+
+def _rpc(port, method, params=None, rpc_id=1):
+    body = {"jsonrpc": "2.0", "id": rpc_id, "method": method}
+    if params is not None:
+        body["params"] = params
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/mcp", data=json.dumps(body).encode())
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        return json.loads(resp.read())
+
+
+def test_mcp_initialize_list_call():
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0,
+                    sync_port=0, enable_controller=True).start()
+    try:
+        out = _rpc(server.query_port, "initialize", {})
+        assert out["result"]["serverInfo"]["name"] == "deepflow-tpu"
+
+        out = _rpc(server.query_port, "tools/list")
+        names = {t["name"] for t in out["result"]["tools"]}
+        assert {"query", "profile_flame", "tpu_flame", "trace",
+                "health"} <= names
+
+        # call: health
+        out = _rpc(server.query_port, "tools/call",
+                   {"name": "health", "arguments": {}})
+        payload = json.loads(out["result"]["content"][0]["text"])
+        assert payload["status"] == "ok"
+
+        # call: query over a seeded table
+        server.db.table("event.event").append_rows(
+            [{"time": 1, "event_type": "boot"}])
+        out = _rpc(server.query_port, "tools/call", {
+            "name": "query",
+            "arguments": {"db": "event",
+                          "sql": "SELECT Count(*) AS n FROM event"}})
+        payload = json.loads(out["result"]["content"][0]["text"])
+        assert payload["values"] == [[1.0]]
+
+        # errors: unknown method / unknown tool / bad sql
+        out = _rpc(server.query_port, "nope/nope")
+        assert out["error"]["code"] == -32601
+        out = _rpc(server.query_port, "tools/call",
+                   {"name": "zap", "arguments": {}})
+        assert "error" in out
+        out = _rpc(server.query_port, "tools/call",
+                   {"name": "query", "arguments": {"sql": "SELEKT"}})
+        assert "error" in out
+    finally:
+        server.stop()
+
+
+def test_push_stream_delivers_config_instantly():
+    from deepflow_tpu.agent.agent import Agent
+    from deepflow_tpu.agent.config import AgentConfig
+
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0,
+                    sync_port=0, enable_controller=True).start()
+    agent = None
+    try:
+        cfg = AgentConfig()
+        cfg.sender.servers = [("127.0.0.1", server.ingest_port)]
+        cfg.controller = f"127.0.0.1:{server.controller.port}"
+        cfg.profiler.enabled = False
+        cfg.tpuprobe.enabled = False
+        cfg.sync_interval_s = 3600  # poll effectively disabled after first
+        agent = Agent(cfg).start()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and \
+                agent.synchronizer.stats["syncs"] == 0:
+            time.sleep(0.05)
+        assert agent.synchronizer.config_version == 1
+        time.sleep(0.5)  # let the push stream subscribe
+
+        server.controller.configs.update(
+            "default", b"profiler:\n  sample_hz: 123.0\n")
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and \
+                agent.synchronizer.config_version != 2:
+            time.sleep(0.05)
+        # delivered by push, not the (hour-long) poll
+        assert agent.synchronizer.config_version == 2
+        assert agent.config.profiler.sample_hz == 123.0
+        assert agent.synchronizer.stats.get("pushes", 0) >= 1
+    finally:
+        if agent:
+            agent.stop()
+        server.stop()
+
+
+def test_push_catchup_on_reconnect():
+    """An agent that missed updates gets the current config the moment its
+    push stream (re)connects — no waiting for the poll."""
+    from deepflow_tpu.agent.agent import Agent
+    from deepflow_tpu.agent.config import AgentConfig
+
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0,
+                    sync_port=0, enable_controller=True).start()
+    # config moves BEFORE the agent connects (simulates a missed window)
+    server.controller.configs.update(
+        "default", b"profiler:\n  sample_hz: 77.0\n")
+    agent = None
+    try:
+        cfg = AgentConfig()
+        cfg.sender.servers = [("127.0.0.1", server.ingest_port)]
+        cfg.controller = f"127.0.0.1:{server.controller.port}"
+        cfg.profiler.enabled = False
+        cfg.tpuprobe.enabled = False
+        cfg.sync_interval_s = 3600
+        agent = Agent(cfg).start()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and \
+                agent.synchronizer.config_version != 2:
+            time.sleep(0.05)
+        assert agent.synchronizer.config_version == 2
+        assert agent.config.profiler.sample_hz == 77.0
+    finally:
+        if agent:
+            agent.stop()
+        server.stop()
