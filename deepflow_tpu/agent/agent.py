@@ -32,6 +32,7 @@ class Agent:
             self.config.sender.servers, agent_id=self.config.agent_id,
             queue_size=self.config.sender.queue_size)
         self.sampler: OnCpuSampler | None = None
+        self.memprofiler = None
         self.tpuprobe = None
         self.synchronizer = None
         self.guard = None
@@ -70,11 +71,25 @@ class Agent:
                 return
             self.tpuprobe = TpuProbe(self).start()
 
+    def start_memprofiler(self) -> None:
+        with self._profiler_lock:
+            if self.memprofiler is not None:
+                return
+            if self.guard is not None and self.guard.degraded:
+                return
+            from deepflow_tpu.agent.memprofiler import MemProfiler
+            self.memprofiler = MemProfiler(
+                self._profile_sink,
+                interval_s=self.config.profiler.memory_interval_s).start()
+
     def pause_profilers(self) -> None:
         with self._profiler_lock:
             if self.sampler is not None:
                 self.sampler.stop()
                 self.sampler = None
+            if self.memprofiler is not None:  # tracemalloc costs real CPU
+                self.memprofiler.stop()
+                self.memprofiler = None
             if self.tpuprobe is not None:
                 self.tpuprobe.stop()
                 self.tpuprobe = None
@@ -83,6 +98,8 @@ class Agent:
         with self._profiler_lock:
             if self.config.profiler.enabled:
                 self.start_sampler()
+            if self.config.profiler.memory:
+                self.start_memprofiler()
             if self.config.tpuprobe.enabled:
                 self.start_tpuprobe()
 
@@ -92,6 +109,9 @@ class Agent:
         if self.config.profiler.enabled:
             self.start_sampler()
             self._components.append("oncpu-sampler")
+        if self.config.profiler.memory:
+            self.start_memprofiler()
+            self._components.append("mem-profiler")
         if self.config.tpuprobe.enabled:
             self.start_tpuprobe()
             if self.tpuprobe is not None:
@@ -124,6 +144,8 @@ class Agent:
             self.synchronizer.stop()
         if self.sampler:
             self.sampler.stop()
+        if self.memprofiler:
+            self.memprofiler.stop()
         if self.tpuprobe:
             self.tpuprobe.stop()
         self._emit_stats()  # final stats flush

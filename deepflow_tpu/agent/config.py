@@ -18,6 +18,8 @@ class ProfilerConfig:
     enabled: bool = True
     sample_hz: float = 99.0
     emit_interval_s: float = 1.0
+    memory: bool = False            # tracemalloc allocation flame graphs
+    memory_interval_s: float = 10.0
 
 
 @dataclass
@@ -91,6 +93,7 @@ class AgentConfig:
 
         num(self.profiler.sample_hz, "profiler.sample_hz", 0.1, 10_000)
         num(self.profiler.emit_interval_s, "profiler.emit_interval_s", 0.01)
+        num(self.profiler.memory_interval_s, "profiler.memory_interval_s", 1)
         num(self.tpuprobe.trace_interval_s, "tpuprobe.trace_interval_s", 0.1)
         num(self.tpuprobe.trace_duration_ms, "tpuprobe.trace_duration_ms", 1)
         num(self.stats_interval_s, "stats_interval_s", 0.1)

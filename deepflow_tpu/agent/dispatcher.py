@@ -8,6 +8,7 @@ pipeline runs everywhere — the reference's golden-test stance).
 
 from __future__ import annotations
 
+import json
 import logging
 import threading
 import time
@@ -67,6 +68,9 @@ def record_to_l7_pb(r: L7Record) -> pb.L7FlowLog:
     f.end_time_ns = r.end_ns
     req, resp = r.request, r.response
     if req is not None:
+        if req.attrs:
+            f.attrs_json = json.dumps(req.attrs, sort_keys=True,
+                                      default=str)
         f.version = req.version
         f.request_type = req.request_type
         f.request_domain = req.request_domain

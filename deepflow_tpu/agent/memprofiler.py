@@ -1,0 +1,96 @@
+"""Memory profiler: allocation flame graphs via tracemalloc.
+
+Reference analog: the EE memory profiler (agent/src/ebpf_dispatcher/
+memory_profile.rs — an in-Rust allocation ledger feeding memory flame
+graphs, extended.h MEMORY profiler flag). In-process Python flavor:
+periodic tracemalloc snapshots diffed into per-stack net allocation deltas,
+emitted as MEM_ALLOC profile events (value = bytes).
+"""
+
+from __future__ import annotations
+
+import threading
+import tracemalloc
+
+from deepflow_tpu.agent.profiler import ProfileSample
+
+import time
+
+
+class MemProfiler:
+    """Windowed allocation sampling. value_us carries BYTES for mem-alloc
+    events (the Profile.value field is unit-polymorphic, like the
+    reference's)."""
+
+    def __init__(self, sink, interval_s: float = 10.0, top_n: int = 64,
+                 n_frames: int = 16) -> None:
+        self.sink = sink
+        self.interval_s = interval_s
+        self.top_n = top_n
+        self.n_frames = n_frames
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._started_tracing = False
+        self.stats = {"snapshots": 0, "stacks_emitted": 0}
+        import os
+        self.pid = os.getpid()
+
+    def start(self) -> "MemProfiler":
+        if not tracemalloc.is_tracing():
+            tracemalloc.start(self.n_frames)
+            self._started_tracing = True
+        self._thread = threading.Thread(
+            target=self._run, name="df-mem-profiler", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2.0)
+        if self._started_tracing and tracemalloc.is_tracing():
+            tracemalloc.stop()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.sample_once()
+            except Exception:
+                pass
+
+    def sample_once(self) -> list[ProfileSample]:
+        snap = tracemalloc.take_snapshot()
+        self.stats["snapshots"] += 1
+        # own frames + tracemalloc internals excluded
+        snap = snap.filter_traces([
+            tracemalloc.Filter(False, tracemalloc.__file__),
+            tracemalloc.Filter(False, __file__),
+        ])
+        stats = snap.statistics("traceback")[:self.top_n]
+        ts = time.time_ns()
+        batch = []
+        for st in stats:
+            if st.size <= 0:
+                continue
+            frames = []
+            for fr in reversed(st.traceback):  # root -> leaf
+                frames.append(f"{_modname(fr.filename)}:{fr.lineno}")
+            batch.append(ProfileSample(
+                timestamp_ns=ts, pid=self.pid, tid=0,
+                thread_name="", stack=";".join(frames),
+                count=st.count, value_us=st.size,  # BYTES
+                event_type="mem-alloc", profiler="tracemalloc"))
+        self.stats["stacks_emitted"] += len(batch)
+        if batch:
+            self.sink(batch)
+        return batch
+
+
+def _modname(path: str) -> str:
+    parts = path.replace("\\", "/").split("/")
+    name = parts[-1]
+    if name.endswith(".py"):
+        name = name[:-3]
+    if len(parts) >= 2:
+        return f"{parts[-2]}.{name}"
+    return name

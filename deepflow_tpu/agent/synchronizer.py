@@ -35,6 +35,7 @@ class Synchronizer:
         self._push_thread: threading.Thread | None = None
         self._push_call = None
         self.config_version = 0
+        self.config_epoch = 0
         self.platform_version = 0
         self._platform_cache: pb.PlatformData | None = None
         self._apply_lock = threading.Lock()  # poll + push threads both apply
@@ -83,8 +84,11 @@ class Synchronizer:
                         return
                     self.stats["pushes"] = self.stats.get("pushes", 0) + 1
                     self._on_response(resp)
-            except grpc.RpcError:
-                pass
+            except grpc.RpcError as e:
+                code = getattr(e, "code", lambda: None)()
+                if code == grpc.StatusCode.RESOURCE_EXHAUSTED:
+                    if self._stop.wait(30.0):  # capacity: back off hard
+                        return
             finally:
                 self._push_call = None
             if self._stop.wait(2.0):
@@ -146,11 +150,16 @@ class Synchronizer:
                     resp.agent_id != self.agent.config.agent_id:
                 self.agent.config.agent_id = resp.agent_id
                 self.agent.sender.agent_id = resp.agent_id
-            if resp.user_config_yaml and \
-                    resp.config_version > self.config_version:
+            epoch_changed = (resp.config_epoch
+                             and resp.config_epoch != self.config_epoch)
+            if resp.user_config_yaml and (
+                    epoch_changed
+                    or resp.config_version > self.config_version):
                 self._apply_config(resp.user_config_yaml,
                                    resp.config_version)
                 self.config_version = resp.config_version
+                if resp.config_epoch:
+                    self.config_epoch = resp.config_epoch
                 self.stats["config_updates"] += 1
             if resp.platform_version:  # push responses leave it unset
                 self.platform_version = resp.platform_version
@@ -181,6 +190,14 @@ class Synchronizer:
             guard.check_interval_s = new.guard.check_interval_s
 
         with self.agent._profiler_lock:
+            mem = self.agent.memprofiler
+            if new.profiler.memory and mem is None:
+                self.agent.start_memprofiler()
+            elif not new.profiler.memory and mem is not None:
+                mem.stop()
+                self.agent.memprofiler = None
+            elif mem is not None:
+                mem.interval_s = new.profiler.memory_interval_s
             sampler = self.agent.sampler
             if new.profiler.enabled and sampler is None:
                 # no-op while guard-degraded (start_sampler checks)

@@ -117,6 +117,9 @@ class ConfigStore:
         self._configs: dict[str, tuple[bytes, int]] = {
             "default": (DEFAULT_AGENT_CONFIG_YAML, 1)}
         self._listeners: list = []  # callables(group, yaml, version)
+        # boot nonce: version counters reset with the process; agents use
+        # the epoch to tell "restarted controller" from "stale response"
+        self.epoch = time.time_ns() & 0xFFFFFFFFFFFF
 
     def subscribe(self, fn) -> None:
         with self._lock:
@@ -185,6 +188,7 @@ class Controller:
         if request.config_version != version:
             resp.user_config_yaml = cfg
         resp.config_version = version
+        resp.config_epoch = self.configs.epoch
 
         if request.HasField("platform"):
             self._ingest_platform(agent_id, request.platform)
@@ -213,7 +217,9 @@ class Controller:
         q: "queue.Queue" = queue.Queue(maxsize=16)
         with self._push_lock:
             if len(self._push_subs) >= self.MAX_PUSH_STREAMS:
-                return  # agent falls back to polling; retries later
+                # explicit status so agents back off instead of hammering
+                context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
+                              "push stream capacity reached")
             self._push_subs.append((group, q))
         try:
             # catch-up: a reconnecting agent may have missed updates
@@ -223,6 +229,7 @@ class Controller:
                 resp.status = pb.SUCCESS
                 resp.user_config_yaml = cfg
                 resp.config_version = version
+                resp.config_epoch = self.configs.epoch
                 yield resp
             while context.is_active():
                 try:
@@ -243,6 +250,7 @@ class Controller:
         resp.status = pb.SUCCESS
         resp.user_config_yaml = yaml_bytes
         resp.config_version = version
+        resp.config_epoch = self.configs.epoch
         with self._push_lock:
             subs = list(self._push_subs)
         for sub_group, q in subs:

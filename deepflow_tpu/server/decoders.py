@@ -213,6 +213,7 @@ class FlowLogDecoder(Decoder):
                     "gprocess_id_0": f.gpid_0, "gprocess_id_1": f.gpid_1,
                     "process_kname_0": f.process_kname_0,
                     "process_kname_1": f.process_kname_1,
+                    "attrs": f.attrs_json,
                     **tags,
                 })
             self.write("flow_log.l7_flow_log", rows)

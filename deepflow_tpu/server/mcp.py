@@ -84,8 +84,11 @@ class McpServer:
     def __init__(self, api) -> None:
         self.api = api  # QuerierAPI
 
-    def handle(self, body: dict) -> dict | None:
+    def handle(self, body) -> dict | None:
         """One JSON-RPC request -> response dict (None for notifications)."""
+        if not isinstance(body, dict):
+            # batch arrays / scalars: not supported -> Invalid Request
+            return _rpc_error(None, -32600, "request must be an object")
         rpc_id = body.get("id")
         method = body.get("method", "")
         params = body.get("params") or {}

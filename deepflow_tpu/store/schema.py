@@ -156,6 +156,7 @@ _table("flow_log.l7_flow_log", [
     C("gprocess_id_1", "u32"),
     C("process_kname_0", "str"),
     C("process_kname_1", "str"),
+    C("attrs", "str"),                  # json: parser extras (sql, alpn, ...)
     *UNIVERSAL_TAGS,
 ])
 

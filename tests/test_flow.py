@@ -502,3 +502,19 @@ def test_session_less_not_counted_as_app_timeout():
     app = [d for d in docs if d.HasField("app_meter")]
     assert app and app[0].app_meter.request == 1
     assert app[0].app_meter.timeout == 0
+
+
+def test_parser_attrs_reach_the_wire():
+    from deepflow_tpu.agent.dispatcher import record_to_l7_pb
+    l7 = []
+    fm = FlowMap(on_l7_log=l7.append)
+    sql = b"SELECT * FROM accounts WHERE id=7"
+    packet = (len(sql) + 1).to_bytes(3, "little") + bytes([0, 3]) + sql
+    fm.inject(build_tcp("1.1.1.1", "2.2.2.2", 5123, 3306,
+                        TcpFlags.PSH | TcpFlags.ACK, payload=packet,
+                        seq=1, timestamp_ns=T0))
+    fm.flush_all()
+    row = record_to_l7_pb(l7[0])
+    import json as _json
+    attrs = _json.loads(row.attrs_json)
+    assert "SELECT * FROM accounts" in attrs["sql"]

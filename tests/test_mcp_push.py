@@ -127,3 +127,59 @@ def test_push_catchup_on_reconnect():
         if agent:
             agent.stop()
         server.stop()
+
+
+def test_epoch_change_reconverges_after_controller_restart():
+    """Controller restart resets version counters; the epoch lets agents
+    accept the 'lower' version instead of running stale config forever."""
+    from deepflow_tpu.agent.agent import Agent
+    from deepflow_tpu.agent.config import AgentConfig
+
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0,
+                    sync_port=0, enable_controller=True).start()
+    agent = None
+    try:
+        cfg = AgentConfig()
+        cfg.sender.servers = [("127.0.0.1", server.ingest_port)]
+        cfg.controller = f"127.0.0.1:{server.controller.port}"
+        cfg.profiler.enabled = False
+        cfg.tpuprobe.enabled = False
+        cfg.sync_interval_s = 0.2
+        agent = Agent(cfg).start()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and \
+                agent.synchronizer.config_version != 1:
+            time.sleep(0.05)
+        # pretend the agent had already seen a much later version from a
+        # previous controller incarnation
+        agent.synchronizer.config_version = 99
+        agent.synchronizer.config_epoch = 12345  # stale epoch
+        server.controller.configs.update(
+            "default", b"profiler:\n  sample_hz: 55.0\n")  # -> v2
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and \
+                agent.synchronizer.config_version != 2:
+            time.sleep(0.05)
+        assert agent.synchronizer.config_version == 2  # re-converged DOWN
+        assert agent.config.profiler.sample_hz == 55.0
+    finally:
+        if agent:
+            agent.stop()
+        server.stop()
+
+
+def test_mcp_batch_body_is_invalid_request():
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0).start()
+    try:
+        out = _rpc_raw(server.query_port, [{"jsonrpc": "2.0", "id": 1,
+                                            "method": "ping"}])
+        assert out["error"]["code"] == -32600
+    finally:
+        server.stop()
+
+
+def _rpc_raw(port, body):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/mcp", data=json.dumps(body).encode())
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        return json.loads(resp.read())

@@ -74,3 +74,52 @@ def test_classify_and_agent_thread_exclusion():
     agentish.join()
     samples = [p for b in batches for p in b]
     assert all(not p.thread_name.startswith("df-") for p in samples)
+
+
+def test_mem_profiler_allocation_flame():
+    from deepflow_tpu.agent.memprofiler import MemProfiler
+    batches = []
+    mp = MemProfiler(batches.append, interval_s=999)
+    mp.start()
+    try:
+        hoard = [bytearray(64_000) for _ in range(50)]  # ~3.2MB retained
+        samples = mp.sample_once()
+        assert samples
+        assert all(s.event_type == "mem-alloc" for s in samples)
+        assert all(s.profiler == "tracemalloc" for s in samples)
+        total = sum(s.value_us for s in samples)
+        assert total > 1_000_000  # the hoard shows up in bytes
+        # this test file appears in at least one allocation stack
+        assert any("test_profiler" in s.stack for s in samples)
+        del hoard
+    finally:
+        mp.stop()
+
+
+def test_mem_profiler_e2e_flame_api():
+    import socket as _s
+    from deepflow_tpu.server import Server
+    from deepflow_tpu.agent.agent import Agent
+    from deepflow_tpu.agent.config import AgentConfig
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0).start()
+    try:
+        cfg = AgentConfig()
+        cfg.app_service = "memsvc"
+        cfg.sender.servers = [("127.0.0.1", server.ingest_port)]
+        cfg.profiler.enabled = False
+        cfg.profiler.memory = True
+        cfg.profiler.memory_interval_s = 999
+        cfg.tpuprobe.enabled = False
+        agent = Agent(cfg).start()
+        ballast = [dict(x=i) for i in range(20000)]
+        agent.memprofiler.sample_once()
+        agent.stop()
+        assert server.wait_for_rows("profile.in_process_profile", 1)
+        from deepflow_tpu.query.flamegraph import profile_flame_tree
+        root = profile_flame_tree(
+            server.db.table("profile.in_process_profile"),
+            event_type="mem-alloc")
+        assert root.total_value > 0
+        del ballast
+    finally:
+        server.stop()
