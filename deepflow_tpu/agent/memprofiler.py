@@ -31,6 +31,7 @@ class MemProfiler:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._started_tracing = False
+        self._prev: tracemalloc.Snapshot | None = None
         self.stats = {"snapshots": 0, "stacks_emitted": 0}
         import os
         self.pid = os.getpid()
@@ -59,6 +60,10 @@ class MemProfiler:
                 pass
 
     def sample_once(self) -> list[ProfileSample]:
+        """Emit NET NEW bytes per stack since the previous snapshot.
+        Deltas (not absolutes) keep flame sums meaningful over time: a
+        steady 1GB residency contributes once, not once per window.
+        The first call only establishes the baseline."""
         snap = tracemalloc.take_snapshot()
         self.stats["snapshots"] += 1
         # own frames + tracemalloc internals excluded
@@ -66,11 +71,15 @@ class MemProfiler:
             tracemalloc.Filter(False, tracemalloc.__file__),
             tracemalloc.Filter(False, __file__),
         ])
-        stats = snap.statistics("traceback")[:self.top_n]
+        prev, self._prev = self._prev, snap
+        if prev is None:
+            return []
+        diffs = snap.compare_to(prev, "traceback")
+        diffs.sort(key=lambda d: d.size_diff, reverse=True)
         ts = time.time_ns()
         batch = []
-        for st in stats:
-            if st.size <= 0:
+        for st in diffs[:self.top_n]:
+            if st.size_diff <= 0:
                 continue
             frames = []
             for fr in reversed(st.traceback):  # root -> leaf
@@ -78,7 +87,7 @@ class MemProfiler:
             batch.append(ProfileSample(
                 timestamp_ns=ts, pid=self.pid, tid=0,
                 thread_name="", stack=";".join(frames),
-                count=st.count, value_us=st.size,  # BYTES
+                count=max(1, st.count_diff), value_us=st.size_diff,  # BYTES
                 event_type="mem-alloc", profiler="tracemalloc"))
         self.stats["stacks_emitted"] += len(batch)
         if batch:

@@ -111,6 +111,7 @@ class Synchronizer:
         req.hostname = socket.gethostname()
         req.agent_id = self.agent.config.agent_id
         req.config_version = self.config_version
+        req.config_epoch = self.config_epoch
         req.platform_version = self.platform_version
         guard = self.agent.guard
         if guard is not None and guard.degraded:

@@ -185,7 +185,11 @@ class Controller:
         resp.agent_id = agent_id
 
         cfg, version = self.configs.get(request.agent_group or "default")
-        if request.config_version != version:
+        # resend on version mismatch OR epoch mismatch: after a restart the
+        # new store's version can coincide with the agent's stale one while
+        # the content differs
+        if request.config_version != version or \
+                request.config_epoch != self.configs.epoch:
             resp.user_config_yaml = cfg
         resp.config_version = version
         resp.config_epoch = self.configs.epoch

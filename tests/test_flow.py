@@ -518,3 +518,69 @@ def test_parser_attrs_reach_the_wire():
     import json as _json
     attrs = _json.loads(row.attrs_json)
     assert "SELECT * FROM accounts" in attrs["sql"]
+
+
+def test_sofarpc_brpc_tars_zmtp_openwire_parsers():
+    # sofarpc bolt request with service identity
+    svc = b"com.alipay.test.FacadeService:1.0"
+    sofa = (bytes([1, 1]) + struct.pack(">H", 1) + bytes([1])
+            + struct.pack(">I", 321) + bytes([11, 0])
+            + struct.pack(">H", 0) + b"\x00" * 8 + svc)
+    proto, recs = infer_and_parse(sofa)
+    assert proto == pb.SOFARPC
+    assert recs[0].request_id == 321
+    assert "FacadeService" in recs[0].request_domain
+    # sofarpc response, status 0 = ok
+    sresp = (bytes([1, 0]) + struct.pack(">H", 2) + bytes([1])
+             + struct.pack(">I", 321) + bytes([11])
+             + struct.pack(">H", 0) + b"\x00" * 8)
+    proto, recs = infer_and_parse(sresp)
+    assert recs[0].msg_type == 1 and recs[0].response_status == 1
+
+    # brpc with RpcMeta request
+    from deepflow_tpu.utils.promwire import varint
+    svc_name, meth = b"example.EchoService", b"Echo"
+    req_meta = (b"\x0a" + varint(len(svc_name)) + svc_name
+                + b"\x12" + varint(len(meth)) + meth)
+    meta = b"\x0a" + varint(len(req_meta)) + req_meta + b"\x20" + varint(77)
+    brpc = b"PRPC" + struct.pack(">II", len(meta), len(meta)) + meta
+    proto, recs = infer_and_parse(brpc)
+    assert proto == pb.BRPC
+    assert recs[0].endpoint == "example.EchoService/Echo"
+    assert recs[0].request_id == 77
+
+    # tars request
+    body = (bytes([0x10]) + bytes([1])                      # iVersion=1
+            + bytes([0x20]) + struct.pack(">h", 0)          # cPacketType
+            + bytes([0x32]) + struct.pack(">i", 0)          # iMessageType
+            + bytes([0x42]) + struct.pack(">i", 55)         # iRequestId
+            + bytes([0x56]) + bytes([8]) + b"MyServer"      # sServantName
+            + bytes([0x66]) + bytes([4]) + b"ping")         # sFuncName
+    tars = struct.pack(">I", 4 + len(body)) + body
+    proto, recs = infer_and_parse(tars, port_dst=10015)
+    assert proto == pb.TARS
+    assert recs[0].endpoint == "MyServer/ping"
+    assert recs[0].request_id == 55
+
+    # zmtp greeting
+    zmtp = b"\xff" + b"\x00" * 8 + b"\x7f" + bytes([3, 0]) + b"NULL" + b"\x00" * 16
+    proto, recs = infer_and_parse(zmtp)
+    assert proto == pb.ZMTP
+    assert recs[0].version == "3.0"
+    assert recs[0].request_resource == "NULL"
+
+    # openwire wireformat info
+    ow = struct.pack(">I", 100) + bytes([1]) + b"\x00\x08ActiveMQ" + b"\x00" * 8
+    proto, recs = infer_and_parse(ow, port_dst=61616)
+    assert proto == pb.OPENWIRE
+    assert recs[0].request_type == "WireFormatInfo"
+
+
+def test_sofarpc_service_name_not_truncated():
+    svc = b"com.shop.OrderService:1.0"
+    sofa = (bytes([1, 1]) + struct.pack(">H", 1) + bytes([1])
+            + struct.pack(">I", 9) + bytes([11, 0])
+            + struct.pack(">H", 0) + b"\x00" * 8 + svc)
+    proto, recs = infer_and_parse(sofa)
+    assert proto == pb.SOFARPC
+    assert recs[0].request_domain == "com.shop.OrderService:1.0"

@@ -183,3 +183,27 @@ def _rpc_raw(port, body):
         f"http://127.0.0.1:{port}/mcp", data=json.dumps(body).encode())
     with urllib.request.urlopen(req, timeout=5) as resp:
         return json.loads(resp.read())
+
+
+def test_epoch_resync_when_versions_coincide():
+    """Restarted controller whose version equals the agent's stale one must
+    still resend (content may differ) — epoch mismatch forces it."""
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0,
+                    sync_port=0, enable_controller=True).start()
+    try:
+        from deepflow_tpu.proto import pb
+        req = pb.SyncRequest()
+        req.hostname = "h"
+        req.config_version = 1           # matches server's version...
+        req.config_epoch = 999           # ...but from another incarnation
+        resp = server.controller.Sync(req, None)
+        assert resp.user_config_yaml     # resent despite equal versions
+        # same epoch + same version -> no resend
+        req2 = pb.SyncRequest()
+        req2.hostname = "h"
+        req2.config_version = 1
+        req2.config_epoch = server.controller.configs.epoch
+        resp2 = server.controller.Sync(req2, None)
+        assert not resp2.user_config_yaml
+    finally:
+        server.stop()

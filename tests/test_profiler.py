@@ -82,8 +82,9 @@ def test_mem_profiler_allocation_flame():
     mp = MemProfiler(batches.append, interval_s=999)
     mp.start()
     try:
+        mp.sample_once()  # baseline
         hoard = [bytearray(64_000) for _ in range(50)]  # ~3.2MB retained
-        samples = mp.sample_once()
+        samples = mp.sample_once()  # delta window containing the hoard
         assert samples
         assert all(s.event_type == "mem-alloc" for s in samples)
         assert all(s.profiler == "tracemalloc" for s in samples)
@@ -111,8 +112,9 @@ def test_mem_profiler_e2e_flame_api():
         cfg.profiler.memory_interval_s = 999
         cfg.tpuprobe.enabled = False
         agent = Agent(cfg).start()
+        agent.memprofiler.sample_once()  # baseline
         ballast = [dict(x=i) for i in range(20000)]
-        agent.memprofiler.sample_once()
+        agent.memprofiler.sample_once()  # delta
         agent.stop()
         assert server.wait_for_rows("profile.in_process_profile", 1)
         from deepflow_tpu.query.flamegraph import profile_flame_tree
