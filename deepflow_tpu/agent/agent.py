@@ -36,6 +36,7 @@ class Agent:
         self.tpuprobe = None
         self.synchronizer = None
         self.guard = None
+        self.integration_proxy = None
         self._stats_thread: threading.Thread | None = None
         self._stop = threading.Event()
         self._components: list[str] = []
@@ -116,6 +117,12 @@ class Agent:
             self.start_tpuprobe()
             if self.tpuprobe is not None:
                 self._components.append("tpuprobe")
+        if self.config.integration.enabled:
+            from deepflow_tpu.agent.integration_proxy import IntegrationProxy
+            ic = self.config.integration
+            self.integration_proxy = IntegrationProxy(
+                ic.server_http, host=ic.host, port=ic.port).start()
+            self._components.append("integration-proxy")
         if self.config.guard.enabled:
             from deepflow_tpu.agent.guard import Guard
             g = self.config.guard
@@ -148,6 +155,8 @@ class Agent:
             self.memprofiler.stop()
         if self.tpuprobe:
             self.tpuprobe.stop()
+        if self.integration_proxy:
+            self.integration_proxy.stop()
         self._emit_stats()  # final stats flush
         self.sender.flush_and_stop()
 
@@ -203,6 +212,8 @@ class Agent:
                 "overruns": st.overruns})
         if tpuprobe is not None:
             metric("agent.tpuprobe", tpuprobe.stats)
+        if self.integration_proxy is not None:
+            metric("agent.integration_proxy", self.integration_proxy.stats)
         if self.guard is not None:
             metric("agent.guard", {
                 "cpu_pct": self.guard.cpu_pct,

@@ -31,6 +31,14 @@ class TpuProbeConfig:
 
 
 @dataclass
+class IntegrationConfig:
+    enabled: bool = False
+    host: str = "0.0.0.0"           # pods reach it via the node IP
+    port: int = 38086
+    server_http: str = "127.0.0.1:20416"
+
+
+@dataclass
 class GuardConfig:
     enabled: bool = True
     max_cpu_pct: float = 50.0
@@ -54,6 +62,8 @@ class AgentConfig:
     profiler: ProfilerConfig = field(default_factory=ProfilerConfig)
     tpuprobe: TpuProbeConfig = field(default_factory=TpuProbeConfig)
     guard: GuardConfig = field(default_factory=GuardConfig)
+    integration: IntegrationConfig = field(
+        default_factory=IntegrationConfig)
     sender: SenderConfig = field(default_factory=SenderConfig)
     stats_interval_s: float = 10.0
     sync_interval_s: float = 10.0
@@ -67,6 +77,8 @@ class AgentConfig:
             cfg.tpuprobe = TpuProbeConfig(**d["tpuprobe"])
         if isinstance(d.get("guard"), dict):
             cfg.guard = GuardConfig(**d["guard"])
+        if isinstance(d.get("integration"), dict):
+            cfg.integration = IntegrationConfig(**d["integration"])
         if isinstance(d.get("sender"), dict):
             sd = dict(d["sender"])
             if "servers" in sd:
@@ -75,7 +87,8 @@ class AgentConfig:
                     else _parse_addr(x) for x in sd["servers"]]
             cfg.sender = SenderConfig(**sd)
         for f in dataclasses.fields(cls):
-            if f.name in ("profiler", "tpuprobe", "guard", "sender"):
+            if f.name in ("profiler", "tpuprobe", "guard", "integration",
+                          "sender"):
                 continue
             if f.name in d:
                 setattr(cfg, f.name, d[f.name])

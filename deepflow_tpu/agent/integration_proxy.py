@@ -1,0 +1,84 @@
+"""On-agent integration collector: local ingest endpoint for workloads.
+
+Reference analog: agent/src/integration_collector.rs — an HTTP listener on
+the node (:38086) so pods send OTLP/profiles/logs to localhost and the agent
+forwards them to the server. Keeps workload config trivial (no server
+address) and survives server failover via the agent's own retry.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+log = logging.getLogger("df.integration-proxy")
+
+_FORWARD_PATHS = ("/api/v1/otlp/traces", "/api/v1/profile/ingest",
+                  "/api/v1/log", "/api/v1/write")
+
+
+class IntegrationProxy:
+    def __init__(self, server_http: str, host: str = "0.0.0.0",
+                 port: int = 38086) -> None:
+        self.server_http = server_http  # host:port of the querier HTTP
+        self.host = host
+        self.port = port
+        self._httpd: ThreadingHTTPServer | None = None
+        self.stats = {"forwarded": 0, "errors": 0, "rejected": 0}
+
+    def start(self) -> "IntegrationProxy":
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                path = self.path.split("?", 1)[0].rstrip("/")
+                if path not in _FORWARD_PATHS:
+                    proxy.stats["rejected"] += 1
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n) if n else b""
+                url = f"http://{proxy.server_http}{self.path}"
+                req = urllib.request.Request(
+                    url, data=body,
+                    headers={"Content-Type": self.headers.get(
+                        "Content-Type", "application/octet-stream")})
+                try:
+                    with urllib.request.urlopen(req, timeout=10) as resp:
+                        out = resp.read()
+                        code = resp.status
+                except urllib.error.HTTPError as e:
+                    out = e.read()
+                    code = e.code
+                except urllib.error.URLError as e:
+                    proxy.stats["errors"] += 1
+                    self.send_response(502)
+                    self.end_headers()
+                    self.wfile.write(str(e.reason).encode())
+                    return
+                proxy.stats["forwarded"] += 1
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(out)))
+                self.end_headers()
+                self.wfile.write(out)
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        t = threading.Thread(target=self._httpd.serve_forever,
+                             name="df-integration-proxy", daemon=True)
+        t.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

@@ -8,7 +8,10 @@ Reference analog: cli/ctl/*.go (deepflow-ctl). Subcommands:
     dfctl query "SELECT ..." --db profile
     dfctl flame --service my-svc [--event-type on-cpu]
     dfctl tpu-flame [--device 0]
-    dfctl replay capture.pcap --server host:20033
+    dfctl trace <trace_id>
+    dfctl alert list|set <json>|delete <name>
+    dfctl exporter list|add <json>|delete <endpoint>
+    dfctl replay capture.pcap --ingest host:20033
 """
 
 from __future__ import annotations
@@ -60,6 +63,22 @@ def print_flame(node: dict, depth: int = 0, total: int | None = None,
         print_flame(child, depth + 1, total, max_depth)
 
 
+def _load_json_arg(spec: str) -> dict:
+    if not spec:
+        raise SystemExit("a json spec (inline or @file) is required")
+    if spec.startswith("@"):
+        with open(spec[1:]) as f:
+            return json.load(f)
+    import os
+    if os.path.exists(spec):
+        with open(spec) as f:
+            return json.load(f)
+    try:
+        return json.loads(spec)
+    except json.JSONDecodeError as e:
+        raise SystemExit(f"bad json spec: {e}\n{spec}")
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="dfctl")
     parser.add_argument("--server", default="127.0.0.1:20416",
@@ -90,6 +109,19 @@ def main(argv: list[str] | None = None) -> int:
     p_replay = sub.add_parser("replay")
     p_replay.add_argument("pcap")
     p_replay.add_argument("--ingest", default="127.0.0.1:20033")
+
+    p_trace = sub.add_parser("trace")
+    p_trace.add_argument("trace_id")
+
+    p_alert = sub.add_parser("alert")
+    p_alert.add_argument("action", choices=["list", "set", "delete"])
+    p_alert.add_argument("spec", nargs="?",
+                         help="set: json file or inline json; delete: name")
+
+    p_exp = sub.add_parser("exporter")
+    p_exp.add_argument("action", choices=["list", "add", "delete"])
+    p_exp.add_argument("spec", nargs="?",
+                       help="add: json {type,endpoint,...}; delete: endpoint")
 
     args = parser.parse_args(argv)
 
@@ -124,6 +156,55 @@ def main(argv: list[str] | None = None) -> int:
             body["device_id"] = args.device
         out = _api(args.server, "/v1/profile/TpuFlame", body)
         print_flame(out["result"])
+    elif args.cmd == "trace":
+        out = _api(args.server, "/v1/trace/Tracing",
+                   {"trace_id": args.trace_id})
+        tree = out["result"]
+        print(f"trace {tree['trace_id']}: {tree['span_count']} spans")
+
+        def show(node, depth=0):
+            dur_ms = node["duration_ns"] / 1e6
+            mark = "◆" if node["kind"] == "device" else "●"
+            print(f"{'  ' * depth}{mark} {node['name']}  "
+                  f"[{node['service']}] {dur_ms:.2f}ms {node['status']}")
+            for c in node["children"]:
+                show(c, depth + 1)
+        for root in tree["spans"]:
+            show(root)
+    elif args.cmd == "alert":
+        if args.action == "list":
+            out = _api(args.server, "/v1/alerts")
+            rows = [[r["name"], r["severity"], r["op"], r["threshold"],
+                     r["firing"], r["last_value"]] for r in out["rules"]]
+            print_table(["NAME", "SEVERITY", "OP", "THRESHOLD", "FIRING",
+                         "LAST"], rows)
+        elif args.action == "set":
+            spec = _load_json_arg(args.spec)
+            out = _api(args.server, "/v1/alerts", spec)
+            print(f"rule {out['rule']['name']} saved")
+        else:
+            if not args.spec:
+                raise SystemExit("usage: dfctl alert delete <name>")
+            out = _api(args.server, "/v1/alerts/delete",
+                       {"name": args.spec})
+            print(f"deleted: {out['deleted']}")
+    elif args.cmd == "exporter":
+        if args.action == "list":
+            out = _api(args.server, "/v1/exporters")
+            for name, st in out["exporters"].items():
+                print(name, st)
+            if not out["exporters"]:
+                print("(none)")
+        elif args.action == "add":
+            spec = _load_json_arg(args.spec)
+            out = _api(args.server, "/v1/exporters", spec)
+            print(f"added {out['added']} -> {out['endpoint']}")
+        else:
+            if not args.spec:
+                raise SystemExit("usage: dfctl exporter delete <endpoint>")
+            out = _api(args.server, "/v1/exporters/delete",
+                       {"endpoint": args.spec})
+            print(f"removed: {out['removed']}")
     elif args.cmd == "replay":
         from deepflow_tpu.agent.dispatcher import Dispatcher
         from deepflow_tpu.agent.sender import UniformSender

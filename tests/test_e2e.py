@@ -3,6 +3,7 @@
 import json
 import threading
 import time
+import urllib.error
 import urllib.request
 
 import pytest
@@ -175,3 +176,27 @@ def test_query_dotted_table_with_db_prefix(server):
         "db": "flow_metrics",
         "sql": "SELECT Sum(byte_tx) AS b FROM network.1m"})
     assert out["result"]["values"] == [[5.0]]
+
+
+def test_integration_proxy_forwards(server):
+    from deepflow_tpu.agent.integration_proxy import IntegrationProxy
+    proxy = IntegrationProxy(f"127.0.0.1:{server.query_port}", port=0).start()
+    try:
+        body = json.dumps({"service": "pod-app",
+                           "message": "via-proxy"}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{proxy.port}/api/v1/log", data=body)
+        out = json.loads(urllib.request.urlopen(req, timeout=5).read())
+        assert out["accepted"] == 1
+        assert server.wait_for_rows("event.event", 1)
+        # unknown paths rejected locally, not forwarded
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{proxy.port}/evil", data=b"x")
+        try:
+            urllib.request.urlopen(req, timeout=5)
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+        assert proxy.stats["forwarded"] == 1
+    finally:
+        proxy.stop()
