@@ -232,6 +232,7 @@ _EVENT_TYPES = {
 }
 
 _GLOBAL_AGENT: Agent | None = None
+_ATEXIT_REGISTERED = False
 
 
 def attach(app_service: str = "", servers: list | None = None,
@@ -249,6 +250,14 @@ def attach(app_service: str = "", servers: list | None = None,
     for k, v in overrides.items():
         setattr(cfg, k, v)
     _GLOBAL_AGENT = Agent(cfg).start()
+    # interpreter teardown with a live xplane capture aborts the process
+    # (daemon thread inside jax.profiler during shutdown): detach cleanly.
+    # Registered once per process; detach() is idempotent.
+    global _ATEXIT_REGISTERED
+    if not _ATEXIT_REGISTERED:
+        import atexit
+        atexit.register(detach)
+        _ATEXIT_REGISTERED = True
     return _GLOBAL_AGENT
 
 

@@ -105,6 +105,8 @@ def main(argv: list[str] | None = None) -> int:
 
     p_tpu = sub.add_parser("tpu-flame")
     p_tpu.add_argument("--device", type=int, default=None)
+    p_tpu.add_argument("--include-host", action="store_true",
+                       help="include host compile/runtime spans")
 
     p_replay = sub.add_parser("replay")
     p_replay.add_argument("pcap")
@@ -154,6 +156,8 @@ def main(argv: list[str] | None = None) -> int:
         body = {}
         if args.device is not None:
             body["device_id"] = args.device
+        if args.include_host:
+            body["include_host"] = True
         out = _api(args.server, "/v1/profile/TpuFlame", body)
         print_flame(out["result"])
     elif args.cmd == "trace":

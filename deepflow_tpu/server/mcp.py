@@ -53,7 +53,12 @@ TOOLS = [
                         "-> op with summed device nanoseconds."),
         "inputSchema": {
             "type": "object",
-            "properties": {"device_id": {"type": "integer"}},
+            "properties": {
+                "device_id": {"type": "integer"},
+                "include_host": {
+                    "type": "boolean",
+                    "description": "include host compile/runtime spans"},
+            },
         },
     },
     {

@@ -113,9 +113,16 @@ class QuerierAPI:
         return {"result": tree.to_dict()}
 
     def tpu_flame(self, body: dict) -> dict:
-        """Flame view over HLO device spans: module -> op hierarchy."""
+        """Flame view over HLO device spans: module -> op hierarchy.
+        Device kinds only by default; pass include_host to include
+        host-compile/runtime spans in the same tree."""
         table = self.db.table("profile.tpu_hlo_span")
         where = ["duration_ns > 0"]
+        if not body.get("include_host"):
+            from deepflow_tpu.store.schema import TPU_SPAN_KINDS
+            device_kinds = ", ".join(
+                f"'{k}'" for k in TPU_SPAN_KINDS if k.startswith("device-"))
+            where.append(f"kind IN ({device_kinds})")
         if body.get("time_start"):
             where.append(f"time >= {int(body['time_start'])}")
         if body.get("time_end"):

@@ -1,0 +1,76 @@
+"""End-to-end demo: train a Llama-style model on TPU with deepflow-tpu
+attached (BASELINE config 3 in miniature).
+
+    # terminal 1
+    python -m deepflow_tpu.server.server
+
+    # terminal 2 — zero-code:
+    python -m deepflow_tpu.cli.runner --service llama-train \
+        examples/train_with_observability.py
+    #   ...or run directly (this file attaches itself when asked):
+    python examples/train_with_observability.py --attach
+
+    # then
+    python -m deepflow_tpu.cli.dfctl tpu-flame
+    python -m deepflow_tpu.cli.dfctl flame --service llama-train
+    python -m deepflow_tpu.cli.dfctl query \
+        "SELECT hlo_op, Sum(duration_ns) AS d, Sum(flops) AS f \
+         FROM tpu_hlo_span GROUP BY hlo_op ORDER BY d DESC LIMIT 10" \
+        --db profile
+"""
+
+import argparse
+import time
+
+import jax
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--attach", action="store_true",
+                        help="attach the in-process agent directly")
+    parser.add_argument("--server", default="127.0.0.1:20033")
+    parser.add_argument("--steps", type=int, default=60)
+    parser.add_argument("--d-model", type=int, default=512)
+    parser.add_argument("--layers", type=int, default=6)
+    parser.add_argument("--seq", type=int, default=512)
+    parser.add_argument("--batch", type=int, default=8)
+    args = parser.parse_args()
+
+    if args.attach:
+        from deepflow_tpu.agent.agent import attach
+        from deepflow_tpu.agent.config import TpuProbeConfig
+        attach(app_service="llama-train", servers=[args.server],
+               tpuprobe=TpuProbeConfig(enabled=True, source="xplane",
+                                       trace_interval_s=5.0,
+                                       trace_duration_ms=1000))
+
+    from deepflow_tpu.models.llama import (
+        LlamaConfig, init_params, make_train_step)
+
+    cfg = LlamaConfig(
+        vocab=8192, d_model=args.d_model, n_layers=args.layers,
+        n_heads=8, n_kv_heads=4, d_ff=int(args.d_model * 2.75),
+        max_seq=args.seq * 2)
+    params = init_params(cfg, jax.random.key(0))
+    train_step, init_opt = make_train_step(cfg)
+    opt_state = init_opt(params)
+    step = jax.jit(train_step, donate_argnums=(0, 1))
+    tokens = jax.random.randint(
+        jax.random.key(1), (args.batch, args.seq), 0, cfg.vocab)
+
+    print(f"training: d={args.d_model} L={args.layers} seq={args.seq} "
+          f"batch={args.batch} on {jax.devices()[0].device_kind}")
+    t0 = time.time()
+    for i in range(args.steps):
+        params, opt_state, loss = step(params, opt_state, tokens)
+        if i % 10 == 0:
+            print(f"step {i:4d} loss {float(jax.device_get(loss)):.4f}")
+    loss = float(jax.device_get(loss))
+    dt = time.time() - t0
+    print(f"done: {args.steps} steps in {dt:.1f}s "
+          f"({args.steps / dt:.2f} steps/s), final loss {loss:.4f}")
+
+
+if __name__ == "__main__":
+    main()

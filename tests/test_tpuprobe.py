@@ -107,3 +107,22 @@ def test_probe_to_server_e2e():
         assert r2.values[0][0] == "fusion.1"
     finally:
         server.stop()
+
+
+def test_tpu_flame_excludes_host_spans_by_default():
+    from deepflow_tpu.server import Server
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0).start()
+    try:
+        t = server.db.table("profile.tpu_hlo_span")
+        t.append_rows([
+            {"time": 1, "duration_ns": 100, "kind": 1, "hlo_op": "f.1",
+             "hlo_module": "jit_step", "hlo_category": "fusion"},
+            {"time": 2, "duration_ns": 900_000, "kind": 5,
+             "hlo_module": "/jax/core/compile", "hlo_category": "host"},
+        ])
+        out = server.api.tpu_flame({})
+        assert out["result"]["total_value"] == 100  # compile span excluded
+        out = server.api.tpu_flame({"include_host": True})
+        assert out["result"]["total_value"] == 900_100
+    finally:
+        server.stop()
