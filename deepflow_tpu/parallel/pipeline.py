@@ -1,0 +1,83 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over a 'pp' axis.
+
+Stages hold contiguous layer slices (params sharded on the pp axis);
+activations flow stage-to-stage with lax.ppermute while microbatches fill
+the pipeline. The observability angle: each hop is a ppermute the TPU probe
+attributes as ICI traffic, exactly like the reference observes NCCL
+pipelines (SURVEY.md §2.9).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _pipeline_local(stage_params, micro_in, *, axis_name: str, stage_fn,
+                    n_micro: int):
+    """Per-device body. stage_params: this stage's layer slice (leading
+    layer dim). micro_in: (n_micro, mb, ...) full microbatched input
+    (only stage 0 reads it). Returns (n_micro, mb, ...) outputs (valid on
+    the LAST stage; other stages return zeros)."""
+    n_stages = jax.lax.psum(1, axis_name)
+    stage = jax.lax.axis_index(axis_name)
+    mb_shape = micro_in.shape[1:]
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    out_buf = jnp.zeros_like(micro_in)
+    cur = jnp.zeros(mb_shape, dtype=micro_in.dtype)
+
+    def body(t, carry):
+        cur, out_buf = carry
+        # stage 0 ingests microbatch t (when one remains)
+        feed_idx = jnp.minimum(t, n_micro - 1)
+        cur = jnp.where(jnp.logical_and(stage == 0, t < n_micro),
+                        micro_in[feed_idx], cur)
+        # every stage applies its layers to whatever it holds
+        y = stage_fn(stage_params, cur)
+        # last stage retires microbatch (t - (n_stages-1)) at this tick
+        done_idx = t - (n_stages - 1)
+        store = jnp.logical_and(stage == n_stages - 1,
+                                jnp.logical_and(done_idx >= 0,
+                                                done_idx < n_micro))
+        idx = jnp.clip(done_idx, 0, n_micro - 1)
+        out_buf = jnp.where(
+            store, out_buf.at[idx].set(y), out_buf)
+        # activations advance one stage
+        cur = jax.lax.ppermute(y, axis_name, perm)
+        return cur, out_buf
+
+    total_ticks = n_micro + n_stages - 1
+    _, out_buf = jax.lax.fori_loop(0, total_ticks, body, (cur, out_buf))
+    # only the last stage holds real outputs (zeros elsewhere): psum makes
+    # the result replicated so out_specs=P() is sound
+    return jax.lax.psum(out_buf, axis_name)
+
+
+def pipeline_forward(params, x, stage_fn, mesh: Mesh, axis: str = "pp",
+                     n_micro: int = 4):
+    """Run x through layers pipelined across mesh axis `axis`.
+
+    params: pytree with leading layer dim divisible by the pp axis size;
+    x: (batch, ...) with batch divisible by n_micro;
+    stage_fn(stage_params, mb) applies one stage's layer slice.
+    Returns (batch, ...) outputs.
+    """
+    n_stages = mesh.shape[axis]
+    batch = x.shape[0]
+    assert batch % n_micro == 0, "batch must divide into microbatches"
+    micro = x.reshape(n_micro, batch // n_micro, *x.shape[1:])
+
+    param_specs = jax.tree.map(lambda _: P(axis), params)
+    fn = jax.shard_map(
+        partial(_pipeline_local, axis_name=axis, stage_fn=stage_fn,
+                n_micro=n_micro),
+        mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(),
+        check_vma=False)
+    out = fn(params, micro)
+    return out.reshape(batch, *x.shape[1:])
