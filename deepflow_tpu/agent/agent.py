@@ -37,6 +37,8 @@ class Agent:
         self.synchronizer = None
         self.guard = None
         self.integration_proxy = None
+        self.dispatcher = None
+        self.live_capture = None
         self._stats_thread: threading.Thread | None = None
         self._stop = threading.Event()
         self._components: list[str] = []
@@ -117,6 +119,28 @@ class Agent:
             self.start_tpuprobe()
             if self.tpuprobe is not None:
                 self._components.append("tpuprobe")
+        if self.config.flow.enabled:
+            from deepflow_tpu.agent.dispatcher import Dispatcher
+            from deepflow_tpu.agent.live_capture import LiveCapture
+            self.dispatcher = Dispatcher(
+                sender=self.sender,
+                agent_id=self.config.agent_id).start()
+            # the agent's own telemetry must never be captured (feedback
+            # amplification): union the REAL sender ports into the exclusions
+            exclude = set(self.config.flow.exclude_ports)
+            exclude.update(p for _, p in self.sender.servers)
+            try:
+                self.live_capture = LiveCapture(
+                    self.dispatcher,
+                    interface=self.config.flow.interface,
+                    exclude_ports=tuple(exclude),
+                ).start()
+                self._components.append("live-capture")
+            except (OSError, AttributeError) as e:
+                # PermissionError (no CAP_NET_RAW), ENODEV (bad iface),
+                # AttributeError (no AF_PACKET on this OS): degrade
+                log.warning("live capture unavailable (%s); replay and "
+                            "synthetic sources still work", e)
         if self.config.integration.enabled:
             from deepflow_tpu.agent.integration_proxy import IntegrationProxy
             ic = self.config.integration
@@ -157,6 +181,10 @@ class Agent:
             self.tpuprobe.stop()
         if self.integration_proxy:
             self.integration_proxy.stop()
+        if self.live_capture:
+            self.live_capture.stop()
+        if self.dispatcher:
+            self.dispatcher.stop()
         self._emit_stats()  # final stats flush
         self.sender.flush_and_stop()
 
@@ -214,6 +242,10 @@ class Agent:
             metric("agent.tpuprobe", tpuprobe.stats)
         if self.integration_proxy is not None:
             metric("agent.integration_proxy", self.integration_proxy.stats)
+        if self.live_capture is not None:
+            metric("agent.live_capture", self.live_capture.stats)
+        if self.dispatcher is not None:
+            metric("agent.flow_map", self.dispatcher.flow_map.stats)
         if self.guard is not None:
             metric("agent.guard", {
                 "cpu_pct": self.guard.cpu_pct,

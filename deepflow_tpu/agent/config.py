@@ -31,6 +31,14 @@ class TpuProbeConfig:
 
 
 @dataclass
+class FlowConfig:
+    enabled: bool = False           # needs CAP_NET_RAW
+    interface: str = ""             # "" = all interfaces
+    exclude_ports: list = field(
+        default_factory=lambda: [20033, 20035, 20416])
+
+
+@dataclass
 class IntegrationConfig:
     enabled: bool = False
     host: str = "0.0.0.0"           # pods reach it via the node IP
@@ -62,6 +70,7 @@ class AgentConfig:
     profiler: ProfilerConfig = field(default_factory=ProfilerConfig)
     tpuprobe: TpuProbeConfig = field(default_factory=TpuProbeConfig)
     guard: GuardConfig = field(default_factory=GuardConfig)
+    flow: FlowConfig = field(default_factory=FlowConfig)
     integration: IntegrationConfig = field(
         default_factory=IntegrationConfig)
     sender: SenderConfig = field(default_factory=SenderConfig)
@@ -79,6 +88,8 @@ class AgentConfig:
             cfg.guard = GuardConfig(**d["guard"])
         if isinstance(d.get("integration"), dict):
             cfg.integration = IntegrationConfig(**d["integration"])
+        if isinstance(d.get("flow"), dict):
+            cfg.flow = FlowConfig(**d["flow"])
         if isinstance(d.get("sender"), dict):
             sd = dict(d["sender"])
             if "servers" in sd:
@@ -88,7 +99,7 @@ class AgentConfig:
             cfg.sender = SenderConfig(**sd)
         for f in dataclasses.fields(cls):
             if f.name in ("profiler", "tpuprobe", "guard", "integration",
-                          "sender"):
+                          "flow", "sender"):
                 continue
             if f.name in d:
                 setattr(cfg, f.name, d[f.name])

@@ -1,0 +1,88 @@
+"""Live packet capture: AF_PACKET raw socket -> FlowMap.
+
+Reference analog: agent/src/dispatcher/recv_engine (AF_PACKET TPACKET
+capture). Plain SOCK_RAW recv loop (mmap ring is an optimization for later);
+requires CAP_NET_RAW — the agent degrades to replay/synthetic sources
+without it.
+
+Feedback-loop protection: the agent's own telemetry TCP (to the ingester)
+and the server's ports are excluded, otherwise capturing our own sender
+traffic generates flows that generate more sender traffic.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import struct
+import threading
+import time
+
+from deepflow_tpu.agent.packet import decode_ethernet
+
+log = logging.getLogger("df.live-capture")
+
+ETH_P_ALL = 0x0003
+
+
+class LiveCapture:
+    def __init__(self, dispatcher, interface: str = "",
+                 exclude_ports: tuple = (20033, 20035, 20416),
+                 snaplen: int = 65535) -> None:
+        self.dispatcher = dispatcher
+        self.interface = interface  # "" = all interfaces
+        self.exclude_ports = frozenset(exclude_ports)
+        self.snaplen = snaplen
+        self._sock: socket.socket | None = None
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self.stats = {"frames": 0, "injected": 0, "excluded": 0,
+                      "undecoded": 0}
+
+    def start(self) -> "LiveCapture":
+        s = socket.socket(socket.AF_PACKET, socket.SOCK_RAW,
+                          socket.htons(ETH_P_ALL))
+        if self.interface:
+            s.bind((self.interface, 0))
+        s.settimeout(0.5)
+        self._sock = s
+        self._thread = threading.Thread(
+            target=self._run, name="df-live-capture", daemon=True)
+        self._thread.start()
+        log.info("live capture on %r (excluding ports %s)",
+                 self.interface or "all", sorted(self.exclude_ports))
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2.0)
+        if self._sock:
+            self._sock.close()
+            self._sock = None
+
+    def _run(self) -> None:
+        sock = self._sock
+        while not self._stop.is_set():
+            try:
+                frame, addr = sock.recvfrom(self.snaplen)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            # addr: (iface, proto, pkttype, hatype, hwaddr); pkttype 4 =
+            # outgoing copy — keep both directions but only one copy of
+            # loopback traffic (lo duplicates every frame as in+out)
+            if addr[0] == "lo" and addr[2] == socket.PACKET_OUTGOING:
+                continue
+            self.stats["frames"] += 1
+            mp = decode_ethernet(frame, timestamp_ns=time.time_ns())
+            if mp is None:
+                self.stats["undecoded"] += 1
+                continue
+            if mp.port_src in self.exclude_ports or \
+                    mp.port_dst in self.exclude_ports:
+                self.stats["excluded"] += 1
+                continue
+            self.dispatcher.inject(mp)
+            self.stats["injected"] += 1

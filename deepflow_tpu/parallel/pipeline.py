@@ -69,6 +69,9 @@ def pipeline_forward(params, x, stage_fn, mesh: Mesh, axis: str = "pp",
     n_stages = mesh.shape[axis]
     batch = x.shape[0]
     assert batch % n_micro == 0, "batch must divide into microbatches"
+    n_layers = jax.tree.leaves(params)[0].shape[0]
+    assert n_layers % n_stages == 0, (
+        f"layer dim {n_layers} must divide by pp={n_stages}")
     micro = x.reshape(n_micro, batch // n_micro, *x.shape[1:])
 
     param_specs = jax.tree.map(lambda _: P(axis), params)

@@ -584,3 +584,87 @@ def test_sofarpc_service_name_not_truncated():
     proto, recs = infer_and_parse(sofa)
     assert proto == pb.SOFARPC
     assert recs[0].request_domain == "com.shop.OrderService:1.0"
+
+
+def test_live_capture_e2e():
+    """Real AF_PACKET capture of loopback HTTP -> l7_flow_log (skips
+    without CAP_NET_RAW)."""
+    import socket as _s
+    import threading
+    import time as _time
+    try:
+        probe = _s.socket(_s.AF_PACKET, _s.SOCK_RAW)
+        probe.close()
+    except (PermissionError, AttributeError, OSError):
+        pytest.skip("no CAP_NET_RAW")
+
+    from deepflow_tpu.agent.agent import Agent
+    from deepflow_tpu.agent.config import AgentConfig
+    from deepflow_tpu.server import Server
+
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0).start()
+    # a tiny HTTP server to generate real loopback traffic
+    srv = _s.socket(_s.AF_INET, _s.SOCK_STREAM)
+    srv.setsockopt(_s.SOL_SOCKET, _s.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    http_port = srv.getsockname()[1]
+
+    def serve():
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            conn.recv(4096)
+            conn.sendall(b"HTTP/1.1 200 OK\r\nContent-Length: 2\r\n\r\nok")
+            conn.close()
+
+    threading.Thread(target=serve, daemon=True).start()
+
+    cfg = AgentConfig()
+    cfg.sender.servers = [("127.0.0.1", server.ingest_port)]
+    cfg.profiler.enabled = False
+    cfg.tpuprobe.enabled = False
+    cfg.guard.enabled = False
+    cfg.flow.enabled = True
+    cfg.flow.interface = "lo"
+    cfg.flow.exclude_ports = [server.ingest_port, server.query_port]
+    agent = Agent(cfg).start()
+    try:
+        assert agent.live_capture is not None
+        _time.sleep(0.3)
+        c = _s.create_connection(("127.0.0.1", http_port))
+        c.sendall(b"GET /live-test HTTP/1.1\r\nHost: lo\r\n\r\n")
+        c.recv(4096)
+        c.close()
+        _time.sleep(1.0)
+        agent.dispatcher.flush(force=True)
+        assert server.wait_for_rows("flow_log.l7_flow_log", 1, timeout=10)
+        from deepflow_tpu.query import execute
+        t = server.db.table("flow_log.l7_flow_log")
+        r = execute(t, "SELECT request_resource, response_code FROM t "
+                       "WHERE request_resource = '/live-test'")
+        assert r.values == [["/live-test", 200]]
+    finally:
+        agent.stop()
+        srv.close()
+        server.stop()
+
+
+def test_live_capture_bad_interface_degrades():
+    from deepflow_tpu.agent.agent import Agent
+    from deepflow_tpu.agent.config import AgentConfig
+    cfg = AgentConfig()
+    cfg.sender.servers = [("127.0.0.1", 1)]
+    cfg.profiler.enabled = False
+    cfg.tpuprobe.enabled = False
+    cfg.guard.enabled = False
+    cfg.flow.enabled = True
+    cfg.flow.interface = "does-not-exist-9"
+    agent = Agent(cfg).start()   # must NOT raise
+    try:
+        assert agent.live_capture is None
+        assert agent.dispatcher is not None  # replay path still available
+    finally:
+        agent.stop()

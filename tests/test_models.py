@@ -226,3 +226,14 @@ def test_moe_expert_parallel_matches_dense():
     logits = x @ params["router"]
     shards = np.unique(np.argmax(np.asarray(logits), -1) // 2)
     assert set(shards.tolist()) == {0, 1, 2, 3}
+
+
+def test_pipeline_layer_divisibility_checked():
+    from jax.sharding import Mesh
+    from deepflow_tpu.parallel.pipeline import pipeline_forward
+    devs = np.asarray(jax.devices()[:4]).reshape(4)
+    mesh = Mesh(devs, ("pp",))
+    w = jnp.zeros((7, 4, 4))  # 7 layers on 4 stages: clear error
+    with pytest.raises(AssertionError, match="divide by pp"):
+        pipeline_forward(w, jnp.zeros((4, 4)), lambda p, x: x, mesh,
+                         n_micro=2)
