@@ -188,14 +188,44 @@ def parse(q: str) -> Query:
 
 # -- evaluation --------------------------------------------------------------
 
+def _mangle(s: str) -> str:
+    return "".join(c if c.isalnum() else "_" for c in s)
+
+
 def _resolve_metric(db: Database, name: str):
-    """-> (table, value_column, tag_columns, pre_filter)."""
+    """-> (table, value_column, tag_columns, pre_filters, labels_col).
+
+    pre_filters: [(column, code), ...] row filters identifying the metric;
+    labels_col: json-encoded label column (series identity) or None.
+    """
+    # self-telemetry: deepflow_system_<metric>_<value> with dots mangled,
+    # e.g. deepflow_system_agent_sender_sent_frames
+    if name.startswith("deepflow_system_"):
+        suffix = name[len("deepflow_system_"):]
+        table = db.table("deepflow_system.deepflow_system")
+        mdict, vdict = table.dicts["metric_name"], table.dicts["value_name"]
+        # longest metric-name match first: mangling can make one name a
+        # prefix of another, and first-match would be ingest-order dependent
+        candidates = sorted(enumerate(mdict.snapshot()),
+                            key=lambda kv: -len(kv[1]))
+        for mi, mn in candidates:
+            if not mn or not suffix.startswith(_mangle(mn) + "_"):
+                continue
+            rest = suffix[len(_mangle(mn)) + 1:]
+            for vi, vn in enumerate(vdict.snapshot()):
+                if vn and _mangle(vn) == rest:
+                    # host/agent_id stay real columns: series split per
+                    # agent and matchable alongside the json tags
+                    return (table, "value", ["tag_json", "host", "agent_id"],
+                            [("metric_name", mi), ("value_name", vi)],
+                            "tag_json")
+        # fall through: a remote-write metric may share the prefix
     for prefix, (tname, tags) in _FAMILIES.items():
         if name.startswith(prefix):
             col = name[len(prefix):]
             table = db.table(tname)
             if col in table.columns:
-                return table, col, tags, None
+                return table, col, tags, None, None
             break  # fall through: maybe a remote-write metric with a
             # name that happens to share the family prefix
     # remote-write samples: any metric name, labels in labels_json
@@ -203,7 +233,8 @@ def _resolve_metric(db: Database, name: str):
     code = table.dicts["metric_name"].lookup(name)
     if code is None:
         raise PromqlError(f"unknown metric {name!r}")
-    return table, "value", ["labels_json"], ("metric_name", code)
+    return (table, "value", ["labels_json"], [("metric_name", code)],
+            "labels_json")
 
 
 def _compile(pattern: str):
@@ -213,17 +244,20 @@ def _compile(pattern: str):
         raise PromqlError(f"bad regex {pattern!r}: {e}") from None
 
 
-def _compile_matchers(table, sel, pre_filter):
+def _compile_matchers(table, sel, labels_col):
     """Precompute chunk-independent matcher state -> per-chunk appliers.
     Dictionary scans and regex compilation happen ONCE, not per chunk."""
     appliers = []
     for lbl, op, val in sel.matchers:
         negate = op in ("!=", "!~")
-        if pre_filter is not None:
-            # remote-write metric: labels live in labels_json (the table's
-            # universal tag columns would shadow user labels like "host")
-            ids = _labels_json_ids(table, lbl, op, val)
-            appliers.append(("isin", "labels_json", ids, negate))
+        # json-labeled metrics: remote-write user labels ALWAYS match via
+        # the json column (they'd be shadowed by same-named universal tag
+        # columns); self-telemetry prefers real columns (host/agent_id) and
+        # falls back to the json tags
+        if labels_col is not None and (
+                labels_col == "labels_json" or lbl not in table.columns):
+            ids = _labels_json_ids(table, lbl, op, val, labels_col)
+            appliers.append(("isin", labels_col, ids, negate))
             continue
         if lbl not in table.columns:
             raise PromqlError(f"unknown label {lbl!r}")
@@ -279,9 +313,10 @@ def evaluate(db: Database, query: str | Query, start_s: int, end_s: int,
     if isinstance(query, str):
         query = parse(query)
     sel = query.selector
-    table, col, tags, pre_filter = _resolve_metric(db, sel.metric)
+    table, col, tags, pre_filters, labels_col = _resolve_metric(
+        db, sel.metric)
 
-    appliers = _compile_matchers(table, sel, pre_filter)
+    appliers = _compile_matchers(table, sel, labels_col)
     chunks = table.snapshot()
     times, values, tag_arrays = [], [], {t: [] for t in tags}
     # prefetch must cover the instant-vector 300s staleness lookback too
@@ -290,9 +325,13 @@ def evaluate(db: Database, query: str | Query, start_s: int, end_s: int,
         if not ch or not len(ch["time"]):
             continue
         t = ch["time"].astype(np.int64)
+        # schema convention (same as engine._col_val): u64 time columns are
+        # nanoseconds, u32 are epoch seconds
+        if table.columns["time"].kind == "u64":
+            t = t // 1_000_000_000
         mask = (t >= start_s - window) & (t <= end_s)
-        if pre_filter is not None:
-            mask &= ch[pre_filter[0]] == pre_filter[1]
+        for pf_col, pf_code in (pre_filters or []):
+            mask &= ch[pf_col] == pf_code
         m = _apply_matchers(appliers, ch)
         if m is not None:
             mask &= m
@@ -312,8 +351,10 @@ def evaluate(db: Database, query: str | Query, start_s: int, end_s: int,
     # series key: group by (possibly aggregated-away) label set. Remote-write
     # metrics always group by labels_json (the series identity) — the agg's
     # `by` labels are re-grouped over the json-expanded labels afterwards.
-    if pre_filter is not None:
-        group_labels = ["labels_json"]
+    if labels_col is not None:
+        # series identity: the json label set plus any real tag columns
+        # (host/agent_id split self-telemetry series per agent)
+        group_labels = [g for g in tags if g in tag_all]
     else:
         group_labels = query.by if query.agg else tags
         group_labels = [g for g in group_labels if g in tag_all]
@@ -337,7 +378,7 @@ def evaluate(db: Database, query: str | Query, start_s: int, end_s: int,
         for lbl in group_labels:
             spec = table.columns[lbl]
             raw = tag_all[lbl][gi]
-            if lbl == "labels_json" and spec.kind == "str":
+            if lbl == labels_col and spec.kind == "str":
                 import json as _json
                 try:
                     labels.update(_json.loads(
@@ -383,7 +424,8 @@ def evaluate(db: Database, query: str | Query, start_s: int, end_s: int,
     return out
 
 
-def _labels_json_ids(table, lbl: str, op: str, val: str) -> np.ndarray:
+def _labels_json_ids(table, lbl: str, op: str, val: str,
+                     labels_col: str = "labels_json") -> np.ndarray:
     """Matching dictionary ids for a matcher over a json label set.
     (Negation is applied by the caller.)"""
     import json as _json
@@ -399,7 +441,7 @@ def _labels_json_ids(table, lbl: str, op: str, val: str) -> np.ndarray:
     else:
         rx = _compile(val)
         pred = lambda s: rx.fullmatch(get(s)) is not None  # noqa: E731
-    return table.dicts["labels_json"].match_ids(pred)
+    return table.dicts[labels_col].match_ids(pred)
 
 
 def _scalar(v: float, op: str, s: float) -> float:

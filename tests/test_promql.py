@@ -207,3 +207,86 @@ def test_instant_lookback_300s():
     # sample is 120s before start: staleness lookback must still find it
     out = promql.evaluate(db, "flow_metrics_network_byte_tx", 1000, 1060, 30)
     assert out and out[0]["values"][0][1] == 9.0
+
+
+def test_self_telemetry_promql():
+    """The framework observes itself: dfstats -> deepflow_system -> PromQL."""
+    import time as _time
+    from deepflow_tpu.agent.agent import Agent
+    from deepflow_tpu.agent.config import AgentConfig
+    from deepflow_tpu.server import Server
+
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0).start()
+    try:
+        cfg = AgentConfig()
+        cfg.sender.servers = [("127.0.0.1", server.ingest_port)]
+        cfg.profiler.enabled = False
+        cfg.tpuprobe.enabled = False
+        cfg.guard.enabled = False
+        cfg.stats_interval_s = 0.3
+        agent = Agent(cfg).start()
+        _time.sleep(0.8)
+        agent.stop()
+        assert server.wait_for_rows("deepflow_system.deepflow_system", 1)
+
+        now = int(_time.time())
+        url = (f"http://127.0.0.1:{server.query_port}/prom/api/v1/"
+               f"query_range?query=deepflow_system_agent_sender_sent_frames"
+               f"&start={now-60}&end={now}&step=15")
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            out = json.loads(resp.read())
+        assert out["status"] == "success"
+        series = out["data"]["result"]
+        assert series and series[0]["metric"]["process"]
+        assert series[0]["values"][-1][1] >= 0
+
+        # unknown self metric is a clean error
+        url = (f"http://127.0.0.1:{server.query_port}/prom/api/v1/"
+               f"query_range?query=deepflow_system_nope_nope"
+               f"&start={now-60}&end={now}")
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            out = json.loads(resp.read())
+        assert out["status"] == "error"
+    finally:
+        server.stop()
+
+
+def test_self_telemetry_series_split_per_agent():
+    """Two agents' identical tag_json must stay separate series via the
+    universal tag columns, and host/agent_id matchers must work."""
+    from deepflow_tpu.query import promql
+    from deepflow_tpu.store import Database
+    db = Database()
+    t = db.table("deepflow_system.deepflow_system")
+    now_ns = 1_700_000_000_000_000_000
+    for agent_id, host, v in ((1, "h1", 10.0), (2, "h2", 20.0)):
+        t.append_rows([{
+            "time": now_ns, "metric_name": "agent.sender",
+            "tag_json": '{"process": "python"}',
+            "value_name": "sent_frames", "value": v,
+            "agent_id": agent_id, "host": host}])
+    out = promql.evaluate(db, "deepflow_system_agent_sender_sent_frames",
+                          1_700_000_000 - 30, 1_700_000_000 + 30, 30)
+    assert len(out) == 2  # one series per agent
+    byhost = {s["metric"]["host"]: s["values"][-1][1] for s in out}
+    assert byhost == {"h1": 10.0, "h2": 20.0}
+    out = promql.evaluate(
+        db, 'deepflow_system_agent_sender_sent_frames{host="h2"}',
+        1_700_000_000 - 30, 1_700_000_000 + 30, 30)
+    assert len(out) == 1 and out[0]["values"][-1][1] == 20.0
+
+
+def test_remote_write_shared_prefix_not_shadowed():
+    from deepflow_tpu.query import promql
+    from deepflow_tpu.server.integration import IntegrationAPI
+    from deepflow_tpu.store import Database
+    from deepflow_tpu.utils import snappy
+    from tests.test_remote_write import make_write_request
+    import time as _time
+    db = Database()
+    now = int(_time.time())
+    wr = make_write_request([
+        ("deepflow_system_custom_up", {"k": "v"}, [((now - 5) * 1000, 1.0)])])
+    IntegrationAPI(db).ingest_prometheus(snappy.compress(wr))
+    out = promql.evaluate(db, "deepflow_system_custom_up", now - 10, now, 5)
+    assert out and out[0]["values"][-1][1] == 1.0
