@@ -398,8 +398,20 @@ def evaluate(db: Database, query: str | Query, start_s: int, end_s: int,
                 m = (gt > lo) & (gt <= ts)
                 if not m.any():
                     continue
+                if query.rate_fn == "irate":
+                    # instantaneous: the last two DISTINCT timestamps in
+                    # the window, with co-timestamped rows summed (a series
+                    # can hold several rows per second)
+                    wt, wv = gt[m], gv[m]
+                    uts, inv = np.unique(wt, return_inverse=True)
+                    if len(uts) < 2:
+                        continue
+                    sums = np.bincount(inv, weights=wv)
+                    dt = float(uts[-1] - uts[-2])
+                    samples.append((int(ts), float(sums[-1]) / dt))
+                    continue
                 total = float(gv[m].sum())
-                if query.rate_fn in ("rate", "irate"):
+                if query.rate_fn == "rate":
                     total /= max(sel.range_s, 1e-9)
                 samples.append((int(ts), total))
             else:

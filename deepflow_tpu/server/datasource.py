@@ -38,7 +38,8 @@ _FAMILIES = {
 
 
 # rollup stages: (src interval suffix, dst suffix, bucket seconds)
-_STAGES = [("1s", "1m", 60), ("1m", "1h", 3600)]
+_STAGES = [("1s", "1m", 60), ("1m", "1h", 3600),
+           ("1h", "1d", 86400)]
 
 
 class RollupJob:

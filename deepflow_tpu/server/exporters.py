@@ -13,6 +13,7 @@ import json
 import logging
 import queue
 import threading
+import time
 import urllib.request
 
 log = logging.getLogger("df.exporters")
@@ -25,10 +26,11 @@ class BaseExporter:
 
     def __init__(self, endpoint: str, batch_size: int = 256,
                  flush_interval_s: float = 2.0,
-                 queue_size: int = 8192) -> None:
+                 queue_size: int = 8192, max_retries: int = 2) -> None:
         self.endpoint = endpoint
         self.batch_size = batch_size
         self.flush_interval_s = flush_interval_s
+        self.max_retries = max_retries
         self._q: queue.Queue = queue.Queue(maxsize=queue_size)
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -66,13 +68,22 @@ class BaseExporter:
             except queue.Empty:
                 pass
             if batch and (len(batch) >= self.batch_size or self._q.empty()):
-                try:
-                    self._ship(batch)
-                    self.stats["exported"] += len(batch)
-                    self.stats["batches"] += 1
-                except Exception as e:
-                    self.stats["errors"] += 1
-                    log.debug("export failed: %s", e)
+                shipped = False
+                for attempt in range(1 + self.max_retries):
+                    try:
+                        self._ship(batch)
+                        shipped = True
+                        self.stats["exported"] += len(batch)
+                        self.stats["batches"] += 1
+                        break
+                    except Exception as e:
+                        self.stats["errors"] += 1
+                        log.debug("export failed (try %d): %s", attempt, e)
+                        if self._stop.is_set():
+                            break  # shutdown mid-retry: still a drop
+                        time.sleep(min(0.5 * (attempt + 1), 2.0))
+                if not shipped:
+                    self.stats["dropped"] += len(batch)
                 batch = []
 
     def _ship(self, batch: list) -> None:

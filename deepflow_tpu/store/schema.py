@@ -186,6 +186,7 @@ _NETWORK_COLS = [
 _table("flow_metrics.network.1s", list(_NETWORK_COLS))
 _table("flow_metrics.network.1m", list(_NETWORK_COLS))
 _table("flow_metrics.network.1h", list(_NETWORK_COLS))
+_table("flow_metrics.network.1d", list(_NETWORK_COLS))
 
 _APP_COLS = [
     C("time", "u32"),
@@ -207,6 +208,7 @@ _APP_COLS = [
 _table("flow_metrics.application.1s", list(_APP_COLS))
 _table("flow_metrics.application.1m", list(_APP_COLS))
 _table("flow_metrics.application.1h", list(_APP_COLS))
+_table("flow_metrics.application.1d", list(_APP_COLS))
 
 # -- events ----------------------------------------------------------------
 _table("event.event", [

@@ -290,3 +290,31 @@ def test_remote_write_shared_prefix_not_shadowed():
     IntegrationAPI(db).ingest_prometheus(snappy.compress(wr))
     out = promql.evaluate(db, "deepflow_system_custom_up", now - 10, now, 5)
     assert out and out[0]["values"][-1][1] == 1.0
+
+
+def test_irate_uses_last_two_samples():
+    db = Database()
+    t = db.table("flow_metrics.network.1s")
+    # counter-ish samples: big early value, small recent deltas
+    for ts, v in ((1000, 500), (1050, 500), (1055, 10)):
+        t.append_rows([{"time": ts, "byte_tx": v, "ip_src": "1.1.1.1",
+                        "ip_dst": "2.2.2.2", "server_port": 80,
+                        "protocol": 1, "host": "h"}])
+    out = promql.evaluate(db, "irate(flow_metrics_network_byte_tx[2m])",
+                          1055, 1056, 60)
+    # last sample 10 over dt 5s -> 2/s (rate() over the window would differ)
+    assert out[0]["values"][0][1] == pytest.approx(2.0)
+
+
+def test_irate_cotimestamped_rows_no_spike():
+    db = Database()
+    t = db.table("flow_metrics.network.1s")
+    # two rows in the SAME second for one series, then nothing newer
+    rows = [{"time": ts, "byte_tx": v, "ip_src": "1.1.1.1",
+             "ip_dst": "2.2.2.2", "server_port": 80, "protocol": 1,
+             "host": "h"} for ts, v in ((1000, 5), (1010, 3), (1010, 7))]
+    t.append_rows(rows)
+    out = promql.evaluate(db, "irate(flow_metrics_network_byte_tx[2m])",
+                          1010, 1011, 60)
+    # (3+7) summed at t=1010, dt=10 -> 1.0/s — not a 1e9 spike
+    assert out[0]["values"][0][1] == pytest.approx(1.0)

@@ -178,3 +178,19 @@ def test_rollup_1m_to_1h():
                      "ORDER BY time")
     assert r.values == [[36000, 100.0], [39600, 100.0]]
     assert job.roll(now_s=12 * 3600) == 0  # idempotent
+
+
+def test_rollup_1h_to_1d():
+    db = Database()
+    src = db.table("flow_metrics.network.1h")
+    src.append_rows([{"time": day * 86400 + h * 3600, "ip_src": "1.1.1.1",
+                      "ip_dst": "2.2.2.2", "server_port": 80, "protocol": 1,
+                      "byte_tx": 100, "host": "h"}
+                     for day in (5, 6) for h in range(0, 24, 6)])
+    job = RollupJob(db, lateness_s=0)
+    assert job.roll(now_s=7 * 86400) == 2
+    dst = db.table("flow_metrics.network.1d")
+    from deepflow_tpu.query import execute
+    r = execute(dst, "SELECT time, Sum(byte_tx) AS b FROM t GROUP BY time "
+                     "ORDER BY time")
+    assert r.values == [[5 * 86400, 400.0], [6 * 86400, 400.0]]
