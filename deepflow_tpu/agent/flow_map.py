@@ -8,6 +8,7 @@ matching).
 
 from __future__ import annotations
 
+import heapq
 import ipaddress
 import time
 from collections import deque
@@ -47,7 +48,8 @@ class DirectionStats:
     retrans: int = 0
     zero_window: int = 0
     max_seq: int = 0
-    max_payload_seq: int = 0
+    max_payload_seq: int | None = None  # None = no payload seen; 0 is a
+                                        # legitimate post-wrap value
 
 
 @dataclass
@@ -125,6 +127,10 @@ class FlowMap:
         self.agent_id = agent_id
         self.max_flows = max_flows
         self._next_flow_id = 1
+        # lazy-deletion min-heap of (end_ns, tiebreak, key) for O(log n)
+        # eviction under churn (reference uses time-wheel expiry)
+        self._evict_heap: list[tuple[int, int, tuple]] = []
+        self._heap_seq = 0
         self.stats = {"packets": 0, "flows_created": 0, "flows_closed": 0,
                       "l7_records": 0, "evicted": 0}
 
@@ -162,10 +168,17 @@ class FlowMap:
             if src_is_server:
                 node = self._new_node(p, flipped=True)
                 self.flows[p.reverse_key] = node
+                self._heap_push(p.reverse_key, node)
                 return node, False
         node = self._new_node(p, flipped=False)
         self.flows[p.key] = node
+        self._heap_push(p.key, node)
         return node, True
+
+    def _heap_push(self, key: tuple, node: FlowNode) -> None:
+        self._heap_seq += 1
+        heapq.heappush(self._evict_heap,
+                       (node.end_ns or node.start_ns, self._heap_seq, key))
 
     def _new_node(self, p: MetaPacket, flipped: bool) -> FlowNode:
         fid = self._next_flow_id
@@ -184,11 +197,27 @@ class FlowMap:
             tap_port=p.tap_port)
 
     def _evict_oldest(self) -> None:
-        oldest_key = min(self.flows, key=lambda k: self.flows[k].end_ns)
-        node = self.flows.pop(oldest_key)
-        node.close_type = "forced"
-        self._close(node)
-        self.stats["evicted"] += 1
+        # pop stale heap entries until one matches a live, un-refreshed flow
+        while self._evict_heap:
+            end_ns, _, key = heapq.heappop(self._evict_heap)
+            node = self.flows.get(key)
+            if node is None:
+                continue  # flow already closed; stale entry
+            if node.end_ns > end_ns:
+                self._heap_push(key, node)  # saw traffic since; re-file
+                continue
+            del self.flows[key]
+            node.close_type = "forced"
+            self._close(node)
+            self.stats["evicted"] += 1
+            return
+        # heap exhausted (shouldn't happen) — fall back to linear scan
+        if self.flows:
+            oldest_key = min(self.flows, key=lambda k: self.flows[k].end_ns)
+            node = self.flows.pop(oldest_key)
+            node.close_type = "forced"
+            self._close(node)
+            self.stats["evicted"] += 1
 
     # -- TCP state machine + perf ---------------------------------------------
 
@@ -198,13 +227,20 @@ class FlowMap:
         d.tcp_flags_bits |= flags
         if p.window == 0 and not (flags & TcpFlags.RST):
             d.zero_window += 1
-        # retransmission: repeated seq with payload below the high-water mark
+        # retransmission: payload strictly behind the high-water mark, using
+        # 32-bit serial-number arithmetic so 2^32 seq wraps (~4 GB) don't
+        # produce false-retrans bursts (reference: flow_generator/perf/tcp.rs
+        # seq-window logic)
         if p.payload:
-            if d.max_payload_seq and p.seq < d.max_payload_seq:
-                d.retrans += 1
+            end_seq = (p.seq + len(p.payload)) & 0xFFFFFFFF
+            if d.max_payload_seq is not None:
+                behind = (d.max_payload_seq - p.seq) & 0xFFFFFFFF
+                if 0 < behind < 0x80000000:
+                    d.retrans += 1  # segment starts before the high-water mark
+                else:
+                    d.max_payload_seq = end_seq
             else:
-                d.max_payload_seq = max(d.max_payload_seq,
-                                        p.seq + len(p.payload))
+                d.max_payload_seq = end_seq
         if flags & TcpFlags.RST:
             node.state = FlowState.RST
             node.close_type = "rst"
@@ -325,6 +361,13 @@ class FlowMap:
                 to_close.append(key)
         for key in to_close:
             self._close(self.flows.pop(key))
+        # bound stale heap entries left behind by tick/flush closures
+        if len(self._evict_heap) > 4 * len(self.flows) + 1024:
+            self._evict_heap = [
+                (n.end_ns or n.start_ns, i, k)
+                for i, (k, n) in enumerate(self.flows.items())]
+            heapq.heapify(self._evict_heap)
+            self._heap_seq = len(self._evict_heap)
         # live flow updates for metering
         for node in self.flows.values():
             self.on_flow_update(node, False)

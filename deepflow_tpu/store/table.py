@@ -192,6 +192,7 @@ class ColumnarTable:
                 else:
                     kept.append(ch)
             self._chunks = kept
+            self.rows_written -= dropped  # keep __len__ = live rows
         return dropped
 
     # -- persistence (npz per chunk + dict json) -----------------------------

@@ -114,8 +114,11 @@ class HooksSource:
         self.sink = sink
         self.stats = {"events": 0}
         self._registered = False
+        self._cb = None
 
     def start(self) -> "HooksSource":
+        if self._registered:
+            return self  # re-entry would leak an unremovable listener
         import sys
         jax = sys.modules.get("jax")
         if jax is None:
@@ -140,17 +143,26 @@ class HooksSource:
                 pass
 
         monitoring.register_event_duration_secs_listener(on_duration)
+        self._cb = on_duration
         self._registered = True
         return self
 
     def stop(self) -> None:
-        if not self._registered:
+        """Unregister the listener so a restarted probe never double-reports."""
+        if not self._registered or self._cb is None:
             return
+        self._registered = False
         try:
             from jax._src import monitoring
-            monitoring._unregister_event_duration_listener_by_callback  # noqa: B018
-        except (ImportError, AttributeError):
-            return
+            monitoring.unregister_event_duration_listener(self._cb)
+        except (ImportError, AttributeError, ValueError):
+            # older jax: fall back to removing from the listener list directly
+            try:
+                from jax._src import monitoring
+                monitoring._event_duration_secs_listeners.remove(self._cb)
+            except Exception:
+                pass
+        self._cb = None
 
 
 class SimSource:

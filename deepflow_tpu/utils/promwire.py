@@ -8,6 +8,8 @@ import struct
 
 
 def varint(v: int) -> bytes:
+    if v < 0:
+        raise ValueError(f"varint: negative value {v}")
     out = bytearray()
     while True:
         b = v & 0x7F

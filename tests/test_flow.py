@@ -668,3 +668,74 @@ def test_live_capture_bad_interface_degrades():
         assert agent.dispatcher is not None  # replay path still available
     finally:
         agent.stop()
+
+
+def test_retrans_seq_wrap_no_false_positive():
+    """Crossing the 2^32 sequence boundary must not count as retransmission
+    (serial-number arithmetic), but a genuine retransmit after the wrap must."""
+    l4_logs = []
+    fm = FlowMap(on_l4_log=l4_logs.append)
+    c, s = "10.0.0.1", "10.0.0.9"
+    seq = 0xFFFFFF00  # 256 bytes below the wrap point
+    t = T0
+    # six in-order 100-byte segments straddling the wrap
+    for i in range(6):
+        fm.inject(build_tcp(c, s, 1234, 80, TcpFlags.ACK | TcpFlags.PSH,
+                            payload=b"x" * 100, seq=(seq + i * 100) & 0xFFFFFFFF,
+                            timestamp_ns=t + i))
+    # a true retransmit of the last (post-wrap) segment
+    fm.inject(build_tcp(c, s, 1234, 80, TcpFlags.ACK | TcpFlags.PSH,
+                        payload=b"x" * 100, seq=(seq + 5 * 100) & 0xFFFFFFFF,
+                        timestamp_ns=t + 10))
+    fm.flush_all()
+    assert l4_logs[0].tx.retrans == 1
+
+
+def test_eviction_heap_under_flood():
+    """SYN-flood-like churn: eviction must pick genuinely-oldest flows and
+    stay fast (heap, not O(n) scan)."""
+    closed = []
+    fm = FlowMap(on_l4_log=closed.append, max_flows=256)
+    t = T0
+    for i in range(4096):
+        ip = f"10.{(i >> 16) & 255}.{(i >> 8) & 255}.{i & 255}"
+        fm.inject(build_tcp(ip, "10.9.9.9", 40000 + (i % 20000), 80,
+                            TcpFlags.SYN, timestamp_ns=t + i * 1000))
+    assert len(fm.flows) <= 256
+    assert fm.stats["evicted"] == 4096 - 256
+    # evicted flows are the oldest ones: every surviving flow is newer than
+    # every evicted flow
+    surviving_min = min(n.end_ns for n in fm.flows.values())
+    evicted_max = max(f.end_ns for f in closed)
+    assert evicted_max <= surviving_min
+
+
+def test_eviction_refreshed_flow_survives():
+    """A flow that keeps seeing traffic must not be evicted ahead of idle ones."""
+    fm = FlowMap(max_flows=4)
+    # busy flow created first, then kept fresh
+    fm.inject(build_tcp("10.0.0.1", "10.9.9.9", 1111, 80, TcpFlags.SYN,
+                        timestamp_ns=T0))
+    for i in range(8):
+        ip = f"10.0.1.{i}"
+        fm.inject(build_tcp(ip, "10.9.9.9", 2222, 80, TcpFlags.SYN,
+                            timestamp_ns=T0 + 1000 + i))
+        # refresh the busy flow after each new one
+        fm.inject(build_tcp("10.0.0.1", "10.9.9.9", 1111, 80, TcpFlags.ACK,
+                            timestamp_ns=T0 + 2000 + i))
+    assert any(n.port_src == 1111 for n in fm.flows.values())
+
+
+def test_retrans_at_exact_wrap_boundary():
+    """A segment ending exactly at 2^32 sets high-water 0 — still a valid
+    mark; retransmitting that segment must count."""
+    l4_logs = []
+    fm = FlowMap(on_l4_log=l4_logs.append)
+    c, s = "10.0.0.1", "10.0.0.9"
+    seq = (0x100000000 - 100) & 0xFFFFFFFF  # ends exactly at wrap -> mark 0
+    fm.inject(build_tcp(c, s, 1234, 80, TcpFlags.ACK | TcpFlags.PSH,
+                        payload=b"x" * 100, seq=seq, timestamp_ns=T0))
+    fm.inject(build_tcp(c, s, 1234, 80, TcpFlags.ACK | TcpFlags.PSH,
+                        payload=b"x" * 100, seq=seq, timestamp_ns=T0 + 1))
+    fm.flush_all()
+    assert l4_logs[0].tx.retrans == 1

@@ -103,3 +103,20 @@ def test_seal_poison_drops_window_not_table():
     t.flush()
     assert t.column_concat(["a"])["a"].tolist() == [5, 6]
     assert len(t) == 2
+
+
+def test_trim_before_updates_len():
+    """TTL trims must shrink __len__ (round-1 bug: rows_written never
+    decremented, so stats and rollup early-outs overcounted forever)."""
+    from deepflow_tpu.store.table import ColumnSpec, ColumnarTable
+
+    t = ColumnarTable("trimtest", [
+        ColumnSpec("time", "u64"),
+        ColumnSpec("v", "f64"),
+    ], chunk_rows=4)
+    t.append_columns({"time": [1, 2, 3, 4], "v": [0.0] * 4})   # sealed
+    t.append_columns({"time": [10, 11, 12, 13], "v": [0.0] * 4})  # sealed
+    assert len(t) == 8
+    dropped = t.trim_before("time", 5)
+    assert dropped == 4
+    assert len(t) == 4

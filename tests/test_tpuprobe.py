@@ -126,3 +126,21 @@ def test_tpu_flame_excludes_host_spans_by_default():
         assert out["result"]["total_value"] == 900_100
     finally:
         server.stop()
+
+
+def test_hooks_source_stop_unregisters():
+    """stop() must actually remove the listener so a restarted probe does not
+    double-report (round-1 bug: attribute was evaluated, never called)."""
+    import jax  # noqa: F401  (HooksSource requires jax in sys.modules)
+    from jax._src import monitoring
+
+    from deepflow_tpu.tpuprobe.sources import HooksSource
+
+    before = len(monitoring.get_event_duration_listeners())
+    src = HooksSource(sink=lambda evs: None).start()
+    assert len(monitoring.get_event_duration_listeners()) == before + 1
+    src.stop()
+    assert len(monitoring.get_event_duration_listeners()) == before
+    # idempotent
+    src.stop()
+    assert len(monitoring.get_event_duration_listeners()) == before
