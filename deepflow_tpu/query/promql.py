@@ -317,6 +317,12 @@ def evaluate(db: Database, query: str | Query, start_s: int, end_s: int,
         db, sel.metric)
 
     appliers = _compile_matchers(table, sel, labels_col)
+    # remote-write clients send CUMULATIVE counters (standard Prometheus),
+    # and dfstats self-telemetry snapshots cumulative process counters;
+    # internal flow_metrics tables hold per-interval DELTA samples.
+    # rate()/irate()/increase() must switch semantics accordingly.
+    counter_mode = table.name in ("prometheus.samples",
+                                  "deepflow_system.deepflow_system")
     chunks = table.snapshot()
     times, values, tag_arrays = [], [], {t: [] for t in tags}
     # prefetch must cover the instant-vector 300s staleness lookback too
@@ -348,23 +354,20 @@ def evaluate(db: Database, query: str | Query, start_s: int, end_s: int,
     v_all = np.concatenate(values)
     tag_all = {lbl: np.concatenate(tag_arrays[lbl]) for lbl in tags}
 
-    # series key: group by (possibly aggregated-away) label set. Remote-write
-    # metrics always group by labels_json (the series identity) — the agg's
-    # `by` labels are re-grouped over the json-expanded labels afterwards.
-    if labels_col is not None:
-        # series identity: the json label set plus any real tag columns
-        # (host/agent_id split self-telemetry series per agent)
-        group_labels = [g for g in tags if g in tag_all]
-    else:
-        group_labels = query.by if query.agg else tags
-        group_labels = [g for g in group_labels if g in tag_all]
-    if group_labels:
-        key = np.zeros(len(t_all), dtype=np.int64)
-        for lbl in group_labels:
-            _, inv = np.unique(tag_all[lbl], return_inverse=True)
-            key = key * (int(inv.max(initial=0)) + 1) + inv
-    else:
-        key = np.zeros(len(t_all), dtype=np.int64)
+    # series identity is ALWAYS the full tag set: aggregation happens across
+    # evaluated series in _aggregate_series (grouped by the `by` labels), never
+    # by pre-merging raw samples — pre-merging makes every aggregate except
+    # sum(rate(...)) wrong (e.g. instant sum() would return one sample, count()
+    # would return 1).
+    group_labels = [g for g in tags if g in tag_all]
+    key = np.zeros(len(t_all), dtype=np.int64)
+    for lbl in group_labels:
+        _, inv = np.unique(tag_all[lbl], return_inverse=True)
+        # re-densify after every fold: key stays < n_rows, so the product
+        # is bounded by n_rows^2 and can't overflow int64 even with many
+        # high-cardinality labels
+        _, key = np.unique(key * (int(inv.max(initial=0)) + 1) + inv,
+                           return_inverse=True)
 
     out = []
     steps = np.arange(start_s, end_s + 1, step_s)
@@ -392,17 +395,27 @@ def evaluate(db: Database, query: str | Query, start_s: int, end_s: int,
             else:
                 labels[lbl] = str(int(raw))
         samples = []
+        # gt is sorted: each step's window is a searchsorted slice, O(log n)
+        # per step instead of an O(n) mask (matters now that aggregates
+        # evaluate every series)
         for ts in steps:
             if query.rate_fn:
                 lo = ts - sel.range_s
-                m = (gt > lo) & (gt <= ts)
-                if not m.any():
+                i0 = int(np.searchsorted(gt, lo, side="right"))
+                i1 = int(np.searchsorted(gt, ts, side="right"))
+                if i1 <= i0:
+                    continue
+                if counter_mode:
+                    v = _counter_rate(gt[i0:i1], gv[i0:i1], query.rate_fn,
+                                      sel.range_s, float(lo), float(ts))
+                    if v is not None:
+                        samples.append((int(ts), v))
                     continue
                 if query.rate_fn == "irate":
                     # instantaneous: the last two DISTINCT timestamps in
                     # the window, with co-timestamped rows summed (a series
                     # can hold several rows per second)
-                    wt, wv = gt[m], gv[m]
+                    wt, wv = gt[i0:i1], gv[i0:i1]
                     uts, inv = np.unique(wt, return_inverse=True)
                     if len(uts) < 2:
                         continue
@@ -410,19 +423,18 @@ def evaluate(db: Database, query: str | Query, start_s: int, end_s: int,
                     dt = float(uts[-1] - uts[-2])
                     samples.append((int(ts), float(sums[-1]) / dt))
                     continue
-                total = float(gv[m].sum())
+                total = float(gv[i0:i1].sum())
                 if query.rate_fn == "rate":
                     total /= max(sel.range_s, 1e-9)
                 samples.append((int(ts), total))
             else:
-                m = gt <= ts
-                if not m.any():
+                i1 = int(np.searchsorted(gt, ts, side="right"))
+                if i1 == 0:
                     continue
                 # instant: most recent sample within 5m lookback
-                last_i = np.flatnonzero(m)[-1]
-                if ts - gt[last_i] > 300:
+                if ts - gt[i1 - 1] > 300:
                     continue
-                samples.append((int(ts), float(gv[last_i])))
+                samples.append((int(ts), float(gv[i1 - 1])))
         if samples:
             out.append({"metric": labels, "values": samples})
 
@@ -454,6 +466,56 @@ def _labels_json_ids(table, lbl: str, op: str, val: str,
         rx = _compile(val)
         pred = lambda s: rx.fullmatch(get(s)) is not None  # noqa: E731
     return table.dicts[labels_col].match_ids(pred)
+
+
+def _counter_rate(wt: np.ndarray, wv: np.ndarray, fn: str, range_s: float,
+                  range_lo: float, range_hi: float) -> float | None:
+    """Prometheus counter semantics over one series window: monotonic
+    cumulative values with reset detection (a drop means the counter
+    restarted at ~0, so the post-reset value IS the increase), and the
+    upstream extrapolatedRate window-boundary extrapolation."""
+    if len(wt) < 2:
+        return None
+    if fn == "irate":
+        # dedup to distinct timestamps (remote-write retries re-send batches;
+        # last value wins for a cumulative counter), then take the last pair
+        uts = np.unique(wt)
+        if len(uts) < 2:
+            return None
+        # last row at each of the two last distinct timestamps
+        i_last = int(np.searchsorted(wt, uts[-1], side="right")) - 1
+        i_prev = int(np.searchsorted(wt, uts[-2], side="right")) - 1
+        dv = float(wv[i_last] - wv[i_prev])
+        if dv < 0:  # reset between the two points
+            dv = float(wv[i_last])
+        dt = float(uts[-1] - uts[-2])
+        return dv / dt
+    diffs = np.diff(wv)
+    # increase = sum of positive deltas; at a reset the post-reset value is
+    # the delta (counter restarted from ~0)
+    increase = float(np.where(diffs >= 0, diffs, wv[1:]).sum())
+    # extrapolate to the window bounds (promql/functions.go extrapolatedRate):
+    # extend by up to half the average sample spacing, or to the boundary if
+    # it's closer than 1.1x spacing; never extrapolate past the counter's
+    # implied zero crossing
+    sampled = float(wt[-1] - wt[0])
+    if sampled <= 0:
+        return None
+    avg_spacing = sampled / (len(wt) - 1)
+    threshold = avg_spacing * 1.1
+    to_start = float(wt[0]) - range_lo
+    to_end = range_hi - float(wt[-1])
+    if to_start >= threshold:
+        to_start = avg_spacing / 2
+    if increase > 0 and wv[0] >= 0:
+        to_zero = sampled * (float(wv[0]) / increase)
+        to_start = min(to_start, to_zero)
+    if to_end >= threshold:
+        to_end = avg_spacing / 2
+    increase *= (sampled + to_start + to_end) / sampled
+    if fn == "increase":
+        return increase
+    return increase / max(range_s, 1e-9)
 
 
 def _scalar(v: float, op: str, s: float) -> float:

@@ -318,3 +318,98 @@ def test_irate_cotimestamped_rows_no_spike():
                           1010, 1011, 60)
     # (3+7) summed at t=1010, dt=10 -> 1.0/s — not a 1e9 spike
     assert out[0]["values"][0][1] == pytest.approx(1.0)
+
+
+def test_multi_series_aggregates_correct():
+    """Aggregates evaluate per series FIRST, then combine (round-1 bug:
+    samples were pre-merged, so sum() returned a single sample, count()
+    returned 1, avg(rate) returned the summed rate)."""
+    db = make_db()  # two series: h1 ships 100 B/s, h2 ships 50 B/s
+    # instant sum across series = 150
+    out = promql.evaluate(db, "sum(flow_metrics_network_byte_tx)",
+                          1000, 1120, 30)
+    assert all(v == 150.0 for _, v in out[0]["values"])
+    # count = number of series
+    out = promql.evaluate(db, "count(flow_metrics_network_byte_tx)",
+                          1000, 1120, 30)
+    assert all(v == 2.0 for _, v in out[0]["values"])
+    # avg = 75, min = 50, max = 100
+    for agg, want in (("avg", 75.0), ("min", 50.0), ("max", 100.0)):
+        out = promql.evaluate(db, f"{agg}(flow_metrics_network_byte_tx)",
+                              1000, 1120, 30)
+        assert all(v == want for _, v in out[0]["values"]), (agg, out)
+    # avg(rate): per-series rate is tx/10s -> (10 + 5)/2 = 7.5
+    # (evaluate where the 30s window holds 3 samples per series)
+    out = promql.evaluate(
+        db, "avg(rate(flow_metrics_network_byte_tx[30s]))", 1060, 1090, 30)
+    for _, v in out[0]["values"]:
+        assert v == pytest.approx((100 * 3 / 30 + 50 * 3 / 30) / 2)
+
+
+def test_remote_write_counter_semantics():
+    """rate()/increase()/irate() over prometheus.samples treat values as
+    cumulative counters (with reset detection), not delta samples."""
+    db = Database()
+    t = db.table("prometheus.samples")
+    base = 1_000_000
+    # counter going 1000,1010,1020,... (1/s), then a reset
+    rows = []
+    for i, v in enumerate([1000, 1010, 1020, 1030, 5, 15]):
+        rows.append({"time": base + i * 10, "metric_name": "req_total",
+                     "labels_json": '{"job": "a"}', "value": float(v)})
+    t.append_rows(rows)
+    end = base + 50
+    # window (base, base+50] holds 1010,1020,1030,5,15: raw increase =
+    # 10+10 + 5(reset restart) + 10 = 35 over a 40s sampled span, then
+    # Prometheus extrapolation extends 10s toward the window start:
+    # 35 * 50/40 = 43.75
+    out = promql.evaluate(db, "rate(req_total[50s])", end, end, 15)
+    assert out[0]["values"][0][1] == pytest.approx(43.75 / 50)
+    out = promql.evaluate(db, "increase(req_total[50s])", end, end, 15)
+    assert out[0]["values"][0][1] == pytest.approx(43.75)
+    out = promql.evaluate(db, "irate(req_total[50s])", end, end, 15)
+    assert out[0]["values"][0][1] == pytest.approx(10 / 10)
+    # two-series sum(rate) stays per-series then summed: series b window
+    # holds 120..200 -> increase 80 * 50/40 = 100
+    t.append_rows([{"time": base + i * 10, "metric_name": "req_total",
+                    "labels_json": '{"job": "b"}', "value": float(100 + i * 20)}
+                   for i in range(6)])
+    out = promql.evaluate(db, "sum(rate(req_total[50s]))", end, end, 15)
+    assert out[0]["values"][0][1] == pytest.approx(43.75 / 50 + 100 / 50)
+
+
+def test_dfstats_rate_uses_counter_semantics():
+    """deepflow_system values are cumulative process counters; rate() must
+    diff them, not sum the snapshots."""
+    db = Database()
+    t = db.table("deepflow_system.deepflow_system")
+    base = 2_000_000
+    t.append_rows([
+        {"time": (base + i * 10) * 1_000_000_000,
+         "metric_name": "agent.sender",
+         "value_name": "sent_frames", "tag_json": "{}", "host": "h1",
+         "agent_id": 1, "value": float(1000 + i * 50)}
+        for i in range(6)])
+    end = base + 50
+    out = promql.evaluate(
+        db, "rate(deepflow_system_agent_sender_sent_frames[50s])",
+        end, end, 15)
+    # window (base, base+50]: 1050..1250 -> increase 200 over the 40s
+    # sampled span, extrapolated to 250 over the 50s window
+    assert out[0]["values"][0][1] == pytest.approx(250 / 50)
+
+
+def test_counter_irate_duplicate_timestamps():
+    """Remote-write retries duplicate rows at the same timestamp; irate must
+    step back to the last two DISTINCT timestamps, not return nothing."""
+    db = Database()
+    t = db.table("prometheus.samples")
+    base = 3_000_000
+    rows = [{"time": base + i * 10, "metric_name": "dup_total",
+             "labels_json": "{}", "value": float(100 + i * 10)}
+            for i in range(4)]
+    rows.append(dict(rows[-1]))  # duplicate of the last sample
+    t.append_rows(rows)
+    end = base + 30
+    out = promql.evaluate(db, "irate(dup_total[40s])", end, end, 15)
+    assert out and out[0]["values"][0][1] == pytest.approx(10 / 10)
