@@ -82,6 +82,74 @@ def _time_chains(chain, params, opt_state, tokens, reps: int):
     return params, opt_state, times
 
 
+def _bench_packet_path() -> dict:
+    """Packet hot path: mixed replayed traffic through the native C++ flow
+    map (handshake + data + 10% payload + close per flow). The VERDICT
+    round-1 target is >= 200k pps single-core."""
+    import numpy as np
+
+    from deepflow_tpu.agent.packet import TcpFlags, encode_tcp_frame
+
+    try:
+        from deepflow_tpu.agent.native_flow import NativeFlowMap
+        nfm = NativeFlowMap()
+    except Exception:
+        return {"packets_per_sec": 0, "packet_engine": "unavailable"}
+
+    def build(n_flows: int, net: int):
+        frames = []
+        payload = b"x" * 512
+        for fl in range(n_flows):
+            c = f"{net}.{(fl >> 8) & 255}.{fl & 255}.2"
+            s = f"{net}.9.9.9"
+            sp = 40000 + (fl % 20000)
+            frames.append(encode_tcp_frame(c, s, sp, 8080, TcpFlags.SYN,
+                                           seq=1))
+            frames.append(encode_tcp_frame(
+                s, c, 8080, sp, TcpFlags.SYN | TcpFlags.ACK, seq=1, ack=2))
+            frames.append(encode_tcp_frame(c, s, sp, 8080, TcpFlags.ACK,
+                                           seq=2, ack=2))
+            seq = 2
+            for i in range(94):
+                if i % 10 == 0:
+                    frames.append(encode_tcp_frame(
+                        c, s, sp, 8080, TcpFlags.ACK | TcpFlags.PSH,
+                        payload=payload, seq=seq))
+                    seq += len(payload)
+                else:
+                    frames.append(encode_tcp_frame(
+                        c, s, sp, 8080, TcpFlags.ACK, seq=seq, ack=2))
+            frames.append(encode_tcp_frame(
+                c, s, sp, 8080, TcpFlags.FIN | TcpFlags.ACK, seq=seq))
+            frames.append(encode_tcp_frame(
+                s, c, 8080, sp, TcpFlags.FIN | TcpFlags.ACK, seq=2,
+                ack=seq + 1))
+        n = len(frames)
+        offsets = np.zeros(n + 1, dtype=np.uint32)
+        total = 0
+        for i, f in enumerate(frames):
+            total += len(f)
+            offsets[i + 1] = total
+        T0 = 1_700_000_000_000_000_000
+        return (b"".join(frames), offsets,
+                np.arange(T0, T0 + n, dtype=np.uint64), n)
+
+    # warm on a DISJOINT flow set (interning, code paths) so the timed pass
+    # runs entirely on fresh flows — L7 inference cost included honestly
+    wdata, woff, wts, _ = build(100, net=9)
+    nfm.inject_batch(wdata, woff, wts)
+    data, offsets, ts, n = build(4000, net=10)
+    t0 = time.perf_counter()
+    nfm.inject_batch(data, offsets, ts)
+    dt = time.perf_counter() - t0
+    return {
+        "packets_per_sec": round(n / dt),
+        "packet_engine": "native",
+        "packet_count": n,
+        "flows": 4000,
+    }
+
+
 def main() -> None:
     import jax
 
@@ -150,6 +218,7 @@ def main() -> None:
             "hlo_spans_per_s": round(hlo_spans_per_s, 1),
             "hlo_spans_captured": len(device_spans),
             "hlo_device_time_ms": round(device_time_ns / 1e6, 1),
+            **_bench_packet_path(),
         },
     }
     print(json.dumps(result))

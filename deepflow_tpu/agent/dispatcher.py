@@ -101,7 +101,7 @@ class Dispatcher:
 
     def __init__(self, sender=None, agent_id: int = 0,
                  flush_interval_s: float = 1.0,
-                 batch_size: int = 256) -> None:
+                 batch_size: int = 256, engine: str = "auto") -> None:
         self.sender = sender
         self.batch_size = batch_size
         self.flush_interval_s = flush_interval_s
@@ -111,6 +111,21 @@ class Dispatcher:
         self.flow_map = FlowMap(
             on_l4_log=self._on_l4, on_l7_log=self._on_l7,
             on_flow_update=self.quadruple.add_flow, agent_id=agent_id)
+        # native engine for raw-frame sources (ring capture, raw pcap
+        # replay); MetaPacket injection keeps the Python map — disjoint key
+        # spaces, shared output callbacks
+        self.native_map = None
+        if engine in ("auto", "native"):
+            try:
+                from deepflow_tpu.agent.native_flow import NativeFlowMap
+                self.native_map = NativeFlowMap(
+                    on_l4_log=self._on_l4, on_l7_log=self._on_l7,
+                    on_flow_update=self.quadruple.add_flow,
+                    agent_id=agent_id)
+            except Exception as e:
+                if engine == "native":
+                    raise
+                log.debug("native flow engine unavailable: %s", e)
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._lock = threading.Lock()
@@ -153,6 +168,15 @@ class Dispatcher:
         batch.docs.extend(docs)
         self.sender.send(MessageType.METRICS, batch.SerializeToString())
 
+    @property
+    def stats(self) -> dict:
+        """Merged pipeline stats across the Python and native engines."""
+        s = dict(self.flow_map.stats)
+        if self.native_map is not None:
+            for k, v in self.native_map.stats.items():
+                s[k] = s.get(k, 0) + v
+        return s
+
     # -- feeding ----------------------------------------------------------------
 
     def inject(self, packet: MetaPacket) -> None:
@@ -160,7 +184,20 @@ class Dispatcher:
             self.flow_map.inject(packet)
 
     def replay_pcap(self, path: str, tick: bool = True) -> int:
-        """Replay a pcap through the pipeline (golden tests / dfctl replay)."""
+        """Replay a pcap through the pipeline (golden tests / dfctl replay).
+
+        With the native engine, frames go straight to the C++ flow map as
+        one packed batch; otherwise each frame decodes to a MetaPacket.
+        """
+        if self.native_map is not None:
+            from deepflow_tpu.agent.packet import read_pcap_records
+            raw = read_pcap_records(path)
+            with self._lock:
+                self.native_map.inject_frames(
+                    [(frame, ts_ns) for frame, ts_ns, _ in raw])
+            if tick:
+                self.flush(force=True)
+            return len(raw)
         packets = read_pcap(path)
         for p in packets:
             self.inject(p)
@@ -172,8 +209,12 @@ class Dispatcher:
         with self._lock:
             if force:
                 self.flow_map.flush_all()
+                if self.native_map is not None:
+                    self.native_map.flush_all()
             else:
                 self.flow_map.tick(now_ns)
+                if self.native_map is not None:
+                    self.native_map.tick(now_ns)
             self.quadruple.flush(
                 None if now_ns is None else now_ns // 1_000_000_000)
             self._flush_l4()

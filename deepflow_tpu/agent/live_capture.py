@@ -1,9 +1,11 @@
-"""Live packet capture: AF_PACKET raw socket -> FlowMap.
+"""Live packet capture: TPACKET_V3 mmap ring (native) or AF_PACKET raw
+socket (fallback) -> flow map.
 
 Reference analog: agent/src/dispatcher/recv_engine (AF_PACKET TPACKET
-capture). Plain SOCK_RAW recv loop (mmap ring is an optimization for later);
-requires CAP_NET_RAW — the agent degrades to replay/synthetic sources
-without it.
+capture, recv_engine/mod.rs:40). Preferred path: the C++ TPACKET_V3 ring
+feeds the native flow map directly — packets never become Python objects.
+Fallback: SOCK_RAW recv loop into the Python FlowMap. Both require
+CAP_NET_RAW — the agent degrades to replay/synthetic sources without it.
 
 Feedback-loop protection: the agent's own telemetry TCP (to the ingester)
 and the server's ports are excluded, otherwise capturing our own sender
@@ -34,29 +36,92 @@ class LiveCapture:
         self.exclude_ports = frozenset(exclude_ports)
         self.snaplen = snaplen
         self._sock: socket.socket | None = None
+        self._ring = None
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
+        self.mode = "none"
         self.stats = {"frames": 0, "injected": 0, "excluded": 0,
-                      "undecoded": 0}
+                      "undecoded": 0, "ring_drops": 0}
 
     def start(self) -> "LiveCapture":
+        if self._try_start_ring():
+            return self
         s = socket.socket(socket.AF_PACKET, socket.SOCK_RAW,
                           socket.htons(ETH_P_ALL))
         if self.interface:
             s.bind((self.interface, 0))
         s.settimeout(0.5)
         self._sock = s
+        self.mode = "socket"
         self._thread = threading.Thread(
             target=self._run, name="df-live-capture", daemon=True)
         self._thread.start()
-        log.info("live capture on %r (excluding ports %s)",
+        log.info("live capture (SOCK_RAW) on %r (excluding ports %s)",
                  self.interface or "all", sorted(self.exclude_ports))
         return self
+
+    def _try_start_ring(self) -> bool:
+        nfm = getattr(self.dispatcher, "native_map", None)
+        if nfm is None:
+            return False
+        try:
+            from deepflow_tpu.agent.native_flow import NativeRing
+            self._ring = NativeRing(self.interface)
+        except Exception as e:
+            log.debug("TPACKET ring unavailable (%s); falling back", e)
+            return False
+        for port in self.exclude_ports:
+            nfm.exclude_port(port)
+        self.mode = "ring"
+        self._thread = threading.Thread(
+            target=self._run_ring, name="df-live-capture", daemon=True)
+        self._thread.start()
+        log.info("live capture (TPACKET_V3 ring) on %r (excluding ports %s)",
+                 self.interface or "all", sorted(self.exclude_ports))
+        return True
+
+    def _run_ring(self) -> None:
+        nfm = self.dispatcher.native_map
+        ring = self._ring
+        # the dispatcher's flush loop ticks the same native map — every
+        # map access must hold its lock (C++ side is single-threaded)
+        lock = self.dispatcher._lock
+        prev_excluded = nfm.stats["excluded"]
+        while not self._stop.is_set():
+            try:
+                with lock:
+                    n = nfm.ring_rx(ring, timeout_ms=0)
+                if n == 0:
+                    # poll OUTSIDE the lock so flush never waits on capture
+                    self._stop.wait(0.05)
+                    continue
+                st = nfm.stats
+                excluded = st["excluded"] - prev_excluded
+                prev_excluded = st["excluded"]
+                self.stats["frames"] += n
+                self.stats["injected"] += n - excluded
+                self.stats["excluded"] += excluded
+                self.stats["ring_drops"] += ring.drops()
+            except Exception:
+                log.exception("ring rx failed")
+                return
 
     def stop(self) -> None:
         self._stop.set()
         if self._thread:
-            self._thread.join(timeout=2.0)
+            self._thread.join(timeout=5.0)
+        if self._ring is not None:
+            if self._thread is not None and self._thread.is_alive():
+                # never free the ring under a live rx thread (use-after-free);
+                # leaking it is the safe failure mode
+                log.warning("ring thread did not exit; leaking ring handle")
+            else:
+                self._ring.close()
+            self._ring = None
+        nfm = getattr(self.dispatcher, "native_map", None)
+        if nfm is not None and self.mode == "ring":
+            for port in self.exclude_ports:  # don't bleed into pcap replay
+                nfm.exclude_port(port, on=False)
         if self._sock:
             self._sock.close()
             self._sock = None

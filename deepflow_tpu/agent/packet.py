@@ -139,13 +139,9 @@ PCAP_MAGIC_US_LE = 0xA1B2C3D4
 PCAP_MAGIC_NS_LE = 0xA1B23C4D
 
 
-def read_pcap(path: str, use_native: bool = True) -> list[MetaPacket]:
-    """Own pcap reader — no libpcap dependency. Returns decoded packets.
-
-    When libdfnative.so is available, IPv4 frames decode through the C++
-    batch fast path; v6/vlan/other frames fall back to the Python decoder.
-    """
-    raw: list[tuple[bytes, int, int]] = []  # (frame, ts_ns, orig_len)
+def read_pcap_records(path: str) -> list[tuple[bytes, int, int]]:
+    """Raw pcap records: (frame_bytes, ts_ns, orig_len) — no decoding."""
+    raw: list[tuple[bytes, int, int]] = []
     with open(path, "rb") as f:
         hdr = f.read(24)
         if len(hdr) < 24:
@@ -171,7 +167,16 @@ def read_pcap(path: str, use_native: bool = True) -> list[MetaPacket]:
                 break
             ts_ns = ts_sec * 1_000_000_000 + ts_frac * scale
             raw.append((data, ts_ns, orig))
+    return raw
 
+
+def read_pcap(path: str, use_native: bool = True) -> list[MetaPacket]:
+    """Own pcap reader — no libpcap dependency. Returns decoded packets.
+
+    When libdfnative.so is available, IPv4 frames decode through the C++
+    batch fast path; v6/vlan/other frames fall back to the Python decoder.
+    """
+    raw = read_pcap_records(path)
     out: list[MetaPacket] = []
     if use_native:
         try:
@@ -250,3 +255,25 @@ def build_udp(ip_src: str, ip_dst: str, port_src: int, port_dst: int,
         ip_src=socket.inet_aton(ip_src), ip_dst=socket.inet_aton(ip_dst),
         port_src=port_src, port_dst=port_dst, protocol=2,
         payload=payload, packet_len=42 + len(payload))
+
+
+def encode_tcp_frame(ip_src: str, ip_dst: str, port_src: int, port_dst: int,
+                     flags: int = TcpFlags.ACK, payload: bytes = b"",
+                     seq: int = 0, ack: int = 0,
+                     window: int = 65535) -> bytes:
+    """Raw Ethernet/IPv4/TCP frame bytes (native-pipeline tests + bench)."""
+    total = 20 + 20 + len(payload)
+    ip = struct.pack(">BBHHHBBH4s4s", 0x45, 0, total, 0, 0x4000, 64, 6, 0,
+                     socket.inet_aton(ip_src), socket.inet_aton(ip_dst))
+    tcp = struct.pack(">HHIIBBHHH", port_src, port_dst, seq & 0xFFFFFFFF,
+                      ack & 0xFFFFFFFF, 5 << 4, int(flags), window, 0, 0)
+    return b"\x00" * 12 + b"\x08\x00" + ip + tcp + payload
+
+
+def encode_udp_frame(ip_src: str, ip_dst: str, port_src: int, port_dst: int,
+                     payload: bytes = b"") -> bytes:
+    total = 20 + 8 + len(payload)
+    ip = struct.pack(">BBHHHBBH4s4s", 0x45, 0, total, 0, 0x4000, 64, 17, 0,
+                     socket.inet_aton(ip_src), socket.inet_aton(ip_dst))
+    udp = struct.pack(">HHHH", port_src, port_dst, 8 + len(payload), 0)
+    return b"\x00" * 12 + b"\x08\x00" + ip + udp + payload

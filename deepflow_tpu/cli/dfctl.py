@@ -216,7 +216,7 @@ def main(argv: list[str] | None = None) -> int:
         disp = Dispatcher(sender=sender)
         n = disp.replay_pcap(args.pcap)
         sender.flush_and_stop()
-        print(f"replayed {n} packets: {disp.flow_map.stats}")
+        print(f"replayed {n} packets: {disp.stats}")
     return 0
 
 

@@ -62,8 +62,87 @@ def load():
     lib.df_decode_eth_batch.argtypes = [
         ctypes.c_char_p, np.ctypeslib.ndpointer(np.uint32), ctypes.c_uint32,
         ctypes.c_void_p, np.ctypeslib.ndpointer(np.uint8)]
+    # -- native flow map ----------------------------------------------------
+    lib.df_fm_new.restype = ctypes.c_void_p
+    lib.df_fm_new.argtypes = [ctypes.c_uint32]
+    lib.df_fm_free.argtypes = [ctypes.c_void_p]
+    lib.df_fm_inject_batch.restype = ctypes.c_uint64
+    lib.df_fm_inject_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p,
+        np.ctypeslib.ndpointer(np.uint32),           # offsets
+        np.ctypeslib.ndpointer(np.uint64),           # ts_ns
+        ctypes.c_uint32,
+        ctypes.c_void_p, ctypes.c_uint32,            # l7 buf
+        ctypes.c_void_p, ctypes.c_uint32,            # l7 events
+        ctypes.POINTER(ctypes.c_uint32),             # n_l7
+        np.ctypeslib.ndpointer(np.uint32),           # slow_idx
+        ctypes.c_uint32, ctypes.POINTER(ctypes.c_uint32)]  # n_slow
+    lib.df_fm_set_l7.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint32,
+        ctypes.c_uint16, ctypes.c_uint16, ctypes.c_uint8, ctypes.c_int32]
+    lib.df_fm_tick.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.df_fm_poll_closed.restype = ctypes.c_uint32
+    lib.df_fm_poll_closed.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                      ctypes.c_uint32]
+    lib.df_fm_export_active.restype = ctypes.c_uint32
+    lib.df_fm_export_active.argtypes = lib.df_fm_poll_closed.argtypes
+    lib.df_fm_flush_all.argtypes = [ctypes.c_void_p]
+    lib.df_fm_active_count.restype = ctypes.c_uint32
+    lib.df_fm_active_count.argtypes = [ctypes.c_void_p]
+    lib.df_fm_closed_count.restype = ctypes.c_uint32
+    lib.df_fm_closed_count.argtypes = [ctypes.c_void_p]
+    lib.df_fm_stats.argtypes = [ctypes.c_void_p,
+                                np.ctypeslib.ndpointer(np.uint64)]
+    lib.df_fm_exclude_port.argtypes = [ctypes.c_void_p, ctypes.c_uint16,
+                                       ctypes.c_int32]
+    # -- TPACKET_V3 ring ----------------------------------------------------
+    lib.df_ring_open.restype = ctypes.c_void_p
+    lib.df_ring_open.argtypes = [ctypes.c_char_p, ctypes.c_uint32,
+                                 ctypes.c_uint32,
+                                 ctypes.POINTER(ctypes.c_int32)]
+    lib.df_ring_close.argtypes = [ctypes.c_void_p]
+    lib.df_ring_rx_batch.restype = ctypes.c_int64
+    lib.df_ring_rx_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,
+        ctypes.c_void_p, ctypes.c_uint32,
+        ctypes.c_void_p, ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_uint32), ctypes.c_uint32, ctypes.c_int32,
+        ctypes.c_void_p, ctypes.c_uint32,
+        ctypes.c_void_p, ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_uint32)]
+    lib.df_ring_drops.restype = ctypes.c_uint64
+    lib.df_ring_drops.argtypes = [ctypes.c_void_p]
     _lib = lib
     return lib
+
+
+# must match #pragma pack(1) struct FlowRecord in flowmap.cpp
+FLOW_RECORD_DTYPE = np.dtype([
+    ("flow_id", np.uint64),
+    ("ip_src", np.uint32), ("ip_dst", np.uint32),
+    ("port_src", np.uint16), ("port_dst", np.uint16),
+    ("protocol", np.uint8), ("state", np.uint8),
+    ("close_type", np.uint8), ("closed", np.uint8),
+    ("start_ns", np.uint64), ("end_ns", np.uint64),
+    ("tx_packets", np.uint64), ("rx_packets", np.uint64),
+    ("tx_bytes", np.uint64), ("rx_bytes", np.uint64),
+    ("tx_retrans", np.uint32), ("rx_retrans", np.uint32),
+    ("tx_zero_window", np.uint32), ("rx_zero_window", np.uint32),
+    ("tx_flags_bits", np.uint8), ("rx_flags_bits", np.uint8),
+    ("syn_count", np.uint16), ("synack_count", np.uint16),
+    ("rtt_us", np.uint32)])
+
+# must match #pragma pack(1) struct SlowEvent in flowmap.cpp
+SLOW_EVENT_DTYPE = np.dtype([
+    ("ts_ns", np.uint64), ("off", np.uint32), ("len", np.uint32)])
+
+# must match #pragma pack(1) struct L7Event in flowmap.cpp
+L7_EVENT_DTYPE = np.dtype([
+    ("flow_id", np.uint64), ("ts_ns", np.uint64),
+    ("payload_off", np.uint32), ("payload_len", np.uint32),
+    ("is_tx", np.uint8), ("protocol", np.uint8),
+    ("ip_src", np.uint32), ("ip_dst", np.uint32),
+    ("port_src", np.uint16), ("port_dst", np.uint16)])
 
 
 # packet record layout must match struct DfPacketOut in dfnative.cpp
