@@ -141,6 +141,41 @@ class QuerierAPI:
             values.append(int(d))
         return {"result": build_flame_tree(stacks, values).to_dict()}
 
+    def tpu_collectives(self, body: dict) -> dict:
+        """Cross-device stitched collectives (reference: SURVEY §2.9.5 ICI
+        observation). Each group = one collective instance across all its
+        participant devices, with latency/skew/bandwidth."""
+        rows = self._tpu_span_rows(body, collectives_only=True)
+        from deepflow_tpu.tpuprobe.collectives import stitch
+        return {"result": [g.to_dict() for g in stitch(rows)]}
+
+    def tpu_step_trace(self, body: dict) -> dict:
+        """One training step stitched across devices: per-device span
+        bounds + collective groups + straggler skew."""
+        rows = self._tpu_span_rows(body)
+        from deepflow_tpu.tpuprobe.collectives import step_trace
+        run_id = body.get("run_id")
+        return {"result": step_trace(
+            rows, run_id=None if run_id is None else int(run_id))}
+
+    def _tpu_span_rows(self, body: dict,
+                       collectives_only: bool = False) -> list[dict]:
+        table = self.db.table("profile.tpu_hlo_span")
+        where = ["duration_ns > 0"]
+        if collectives_only:
+            where.append("collective != ''")
+        if body.get("time_start"):
+            where.append(f"time >= {int(body['time_start'])}")
+        if body.get("time_end"):
+            where.append(f"time < {int(body['time_end'])}")
+        sql_text = (
+            "SELECT time, duration_ns, device_id, core_id, hlo_op, "
+            "collective, run_id, bytes_transferred, step FROM t "
+            f"WHERE {' AND '.join(where)}")
+        res = qengine.execute(table, sql_text)
+        cols = res.columns
+        return [dict(zip(cols, row)) for row in res.values]
+
     def prom_query_range(self, params: dict) -> dict:
         """GET /prom/api/v1/query_range (reference: querier/app/prometheus,
         router.go:41)."""
@@ -308,6 +343,10 @@ class QuerierHTTP:
                         self._send(200, api.profile_tracing(body))
                     elif path == "/v1/profile/TpuFlame":
                         self._send(200, api.tpu_flame(body))
+                    elif path == "/v1/profile/TpuCollectives":
+                        self._send(200, api.tpu_collectives(body))
+                    elif path == "/v1/profile/TpuStepTrace":
+                        self._send(200, api.tpu_step_trace(body))
                     elif path == "/v1/agent-group-config":
                         self._send(200, api.update_agent_config(body))
                     elif path == "/v1/trace/Tracing":

@@ -1,0 +1,158 @@
+"""Cross-device collective stitching: the ICI/DCN observation layer.
+
+Reference analog: SURVEY §2.9.5 / the reference's NCCL-span correlation in
+its GPU profiling path (server/libs/grpc/grpc_platformdata.go:147 joins
+per-host data into fleet views). TPU redesign: every device in an SPMD
+program runs the SAME collective HLO with the same run_id, so spans group
+by (run_id, hlo_op). A group's latency is wall-clock from first entry to
+last exit; its skew (last start - first start) is the straggler signal —
+the number a flat per-device view can't show.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class CollectiveGroup:
+    """One collective instance stitched across its participants."""
+    run_id: int
+    hlo_op: str
+    collective: str            # all-reduce | all-gather | ...
+    participants: list = field(default_factory=list)  # device ids
+    start_ns: int = 0          # earliest entry
+    end_ns: int = 0            # latest exit
+    max_start_ns: int = 0      # latest entry
+    min_duration_ns: int = 0
+    max_duration_ns: int = 0
+    bytes_transferred: int = 0  # per participant (same payload in SPMD)
+    step: int = 0
+
+    @property
+    def latency_ns(self) -> int:
+        return self.end_ns - self.start_ns
+
+    @property
+    def skew_ns(self) -> int:
+        """Latest start minus earliest start: the straggler lag."""
+        return self.max_start_ns - self.start_ns
+
+    def algo_bw_gbyte_s(self) -> float:
+        """Algorithmic bandwidth in gigaBYTES/s: payload / group wall time."""
+        lat = self.latency_ns
+        if not lat or not self.bytes_transferred:
+            return 0.0
+        return self.bytes_transferred / lat  # bytes/ns == GB/s
+
+    def to_dict(self) -> dict:
+        return {
+            "run_id": self.run_id,
+            "hlo_op": self.hlo_op,
+            "collective": self.collective,
+            "participants": sorted(self.participants),
+            "n_participants": len(self.participants),
+            "start_ns": self.start_ns,
+            "end_ns": self.end_ns,
+            "latency_ns": self.latency_ns,
+            "skew_ns": self.skew_ns,
+            "min_duration_ns": self.min_duration_ns,
+            "max_duration_ns": self.max_duration_ns,
+            "bytes_transferred": self.bytes_transferred,
+            "algo_bw_gbyte_s": round(self.algo_bw_gbyte_s(), 3),
+            "step": self.step,
+        }
+
+
+def stitch(spans) -> list[CollectiveGroup]:
+    """Group collective TpuSpanEvents (or row dicts) by (run_id, hlo_op).
+
+    Accepts objects with attrs or dicts with keys: run_id, hlo_op,
+    collective, device_id, start_ns/time, duration_ns, bytes_transferred,
+    step. Non-collective spans are ignored.
+    """
+    groups: dict[tuple, CollectiveGroup] = {}
+    seen: dict[tuple, set] = {}  # group key -> {(device, core)} dedup
+    for s in spans:
+        get = s.get if isinstance(s, dict) else lambda k, d=None: getattr(
+            s, k, d)
+        coll = get("collective") or ""
+        if not coll:
+            continue
+        run_id = int(get("run_id") or 0)
+        op = str(get("hlo_op") or "")
+        start = int(get("start_ns") or get("time") or 0)
+        dur = int(get("duration_ns") or 0)
+        dev = int(get("device_id") or 0)
+        core = int(get("core_id") or 0)
+        key = (run_id, op)
+        # each (device, core) participates once — megacore captures emit a
+        # per-core plane per chip; duplicates must not inflate the group
+        part = (dev, core)
+        members = seen.setdefault(key, set())
+        if part in members:
+            continue
+        members.add(part)
+        g = groups.get(key)
+        if g is None:
+            g = groups[key] = CollectiveGroup(
+                run_id=run_id, hlo_op=op, collective=str(coll),
+                start_ns=start, end_ns=start + dur, max_start_ns=start,
+                min_duration_ns=dur, max_duration_ns=dur,
+                bytes_transferred=int(get("bytes_transferred") or 0),
+                step=int(get("step") or 0))
+            g.participants.append(dev)
+            continue
+        g.participants.append(dev)
+        g.start_ns = min(g.start_ns, start)
+        g.max_start_ns = max(g.max_start_ns, start)
+        g.end_ns = max(g.end_ns, start + dur)
+        g.min_duration_ns = min(g.min_duration_ns, dur)
+        g.max_duration_ns = max(g.max_duration_ns, dur)
+    return sorted(groups.values(), key=lambda g: (g.start_ns, g.hlo_op))
+
+
+def step_trace(spans, run_id: int | None = None) -> dict:
+    """One step's cross-device picture: module span bounds per device plus
+    stitched collectives — the 'is my step bound by compute, collectives,
+    or a straggler?' view."""
+    by_run: dict[int, list] = {}
+    for s in spans:
+        get = s.get if isinstance(s, dict) else lambda k, d=None: getattr(
+            s, k, d)
+        rid = int(get("run_id") or 0)
+        if rid:
+            by_run.setdefault(rid, []).append(s)
+    if not by_run:
+        return {"run_id": 0, "devices": {}, "collectives": []}
+    rid = run_id if run_id is not None else max(
+        by_run, key=lambda r: len(by_run[r]))
+    rows = by_run.get(rid, [])
+    devices: dict[int, dict] = {}
+    for s in rows:
+        get = s.get if isinstance(s, dict) else lambda k, d=None: getattr(
+            s, k, d)
+        dev = int(get("device_id") or 0)
+        start = int(get("start_ns") or get("time") or 0)
+        end = start + int(get("duration_ns") or 0)
+        d = devices.setdefault(dev, {
+            "start_ns": start, "end_ns": end, "compute_ns": 0,
+            "collective_ns": 0, "n_spans": 0})
+        d["start_ns"] = min(d["start_ns"], start)
+        d["end_ns"] = max(d["end_ns"], end)
+        d["n_spans"] += 1
+        dur = int(get("duration_ns") or 0)
+        if get("collective"):
+            d["collective_ns"] += dur
+        elif get("hlo_op"):
+            d["compute_ns"] += dur
+    colls = [g.to_dict() for g in stitch(rows)]
+    ends = [d["end_ns"] for d in devices.values()]
+    starts = [d["start_ns"] for d in devices.values()]
+    return {
+        "run_id": rid,
+        "devices": devices,
+        "collectives": colls,
+        "step_latency_ns": (max(ends) - min(starts)) if devices else 0,
+        "device_skew_ns": (max(ends) - min(ends)) if devices else 0,
+    }

@@ -28,7 +28,13 @@ from dataclasses import dataclass, field
 from deepflow_tpu.tpuprobe import pbwire as w
 from deepflow_tpu.tpuprobe.events import TpuSpanEvent, classify, split_program_id
 
-_DEVICE_RE = re.compile(r"^/device:TPU:(\d+)$")
+# plane-name layouts across TPU generations:
+#   /device:TPU:3                      v5e (1 core/chip; observed here)
+#   /device:TPU:3 (core 1)             megacore-style per-core planes
+#   /device:TPU:3 Core 1               alternate core spelling
+_DEVICE_RE = re.compile(
+    r"^/device:TPU:(\d+)(?:\s*(?:\(core\s*(\d+)\)|Core\s*(\d+)))?$",
+    re.IGNORECASE)
 
 
 @dataclass
@@ -147,6 +153,7 @@ def extract_device_spans(planes: list[XPlaneView],
         if not m:
             continue
         device_id = int(m.group(1))
+        core_id = int(m.group(2) or m.group(3) or 0)
         # module launches: (start_ps, end_ps, run_id, module, program_id)
         modules = []
         for line in plane.lines:
@@ -182,7 +189,7 @@ def extract_device_spans(planes: list[XPlaneView],
                     duration_ns=max(1, dur_ps // 1000),
                     device_id=device_id,
                     chip_id=device_id,  # 1 core/chip on v5e; refined by topology
-                    core_id=0,
+                    core_id=core_id,
                     hlo_module=mod_name,
                     hlo_op=ev.name,
                     hlo_category=category,
