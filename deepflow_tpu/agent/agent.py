@@ -8,6 +8,7 @@ sync plane lands.
 
 from __future__ import annotations
 
+import functools
 import logging
 import os
 import sys
@@ -33,6 +34,7 @@ class Agent:
             queue_size=self.config.sender.queue_size)
         self.sampler: OnCpuSampler | None = None
         self.memprofiler = None
+        self.extprofilers: list = []
         self.tpuprobe = None
         self.synchronizer = None
         self.guard = None
@@ -85,6 +87,35 @@ class Agent:
                 self._profile_sink,
                 interval_s=self.config.profiler.memory_interval_s).start()
 
+    def start_extprofilers(self) -> None:
+        with self._profiler_lock:
+            if self.extprofilers:
+                return
+            if self.guard is not None and self.guard.degraded:
+                return
+            for pid in self.config.profiler.external_pids:
+                try:
+                    from deepflow_tpu.agent.extprofiler import \
+                        ExternalProfiler
+                    ep = ExternalProfiler(
+                        None, pid=int(pid),
+                        hz=self.config.profiler.sample_hz,
+                        window_s=self.config.profiler.emit_interval_s)
+                    # samples carry the TARGET's identity, captured at
+                    # attach time (the target may exit before the last emit)
+                    ep.sink = functools.partial(
+                        self._profile_sink, process_name=ep.process_name,
+                        app_service=ep.app_service)
+                    ep.start()
+                    self.extprofilers.append(ep)
+                    self._components.append(f"extprof-{pid}")
+                except (OSError, RuntimeError, ImportError,
+                        AttributeError) as e:
+                    # AttributeError: stale libdfnative.so without the
+                    # df_prof_* symbols — degrade, don't abort startup
+                    log.warning("external profiler for pid %s unavailable:"
+                                " %s", pid, e)
+
     def pause_profilers(self) -> None:
         with self._profiler_lock:
             if self.sampler is not None:
@@ -93,6 +124,9 @@ class Agent:
             if self.memprofiler is not None:  # tracemalloc costs real CPU
                 self.memprofiler.stop()
                 self.memprofiler = None
+            for ep in self.extprofilers:  # drain+symbolize burns agent CPU
+                ep.stop()
+            self.extprofilers = []
             if self.tpuprobe is not None:
                 self.tpuprobe.stop()
                 self.tpuprobe = None
@@ -105,6 +139,7 @@ class Agent:
                 self.start_memprofiler()
             if self.config.tpuprobe.enabled:
                 self.start_tpuprobe()
+        self.start_extprofilers()
 
     def start(self) -> "Agent":
         self.sender.start()
@@ -115,6 +150,7 @@ class Agent:
         if self.config.profiler.memory:
             self.start_memprofiler()
             self._components.append("mem-profiler")
+        self.start_extprofilers()
         if self.config.tpuprobe.enabled:
             self.start_tpuprobe()
             if self.tpuprobe is not None:
@@ -177,6 +213,9 @@ class Agent:
             self.sampler.stop()
         if self.memprofiler:
             self.memprofiler.stop()
+        for ep in self.extprofilers:
+            ep.stop()
+        self.extprofilers = []
         if self.tpuprobe:
             self.tpuprobe.stop()
         if self.integration_proxy:
@@ -190,12 +229,14 @@ class Agent:
 
     # -- sinks ---------------------------------------------------------------
 
-    def _profile_sink(self, batch: list[ProfileSample]) -> None:
+    def _profile_sink(self, batch: list[ProfileSample],
+                      process_name: str | None = None,
+                      app_service: str | None = None) -> None:
         out = pb.ProfileBatch()
         for s in batch:
             p = out.profiles.add()
-            p.process_name = self.process_name
-            p.app_service = self.app_service
+            p.process_name = process_name or self.process_name
+            p.app_service = app_service or self.app_service
             p.pid = s.pid
             p.tid = s.tid & 0xFFFFFFFF
             p.thread_name = s.thread_name

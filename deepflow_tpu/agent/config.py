@@ -20,6 +20,9 @@ class ProfilerConfig:
     emit_interval_s: float = 1.0
     memory: bool = False            # tracemalloc allocation flame graphs
     memory_interval_s: float = 10.0
+    # out-of-process perf_event_open targets (ANY pid, not just Python);
+    # needs CAP_PERFMON or perf_event_paranoid <= 2 with same-user targets
+    external_pids: list = field(default_factory=list)
 
 
 @dataclass

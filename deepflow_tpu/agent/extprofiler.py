@@ -1,0 +1,378 @@
+"""Out-of-process OnCPU profiler: perf_event_open sampling of ARBITRARY
+pids, with /proc/pid/maps + ELF symbolization to folded stacks.
+
+Reference analog: agent/src/ebpf/kernel/perf_profiler.bpf.c:688 (the eBPF
+99Hz profiler works on any process) + user/profile/stringifier.c:696
+(address -> folded-stack stringification). Split of labor here: the native
+sampler (native/perfprof.cpp) owns perf rings and address-chain
+aggregation; this module owns the cold path — symbol resolution at window
+close — and emits the same ProfileSample batches as the in-process sampler,
+so the whole downstream (sender, decoder, flame APIs) is shared.
+
+Known gap vs the reference: no DWARF unwinder — frame-pointer-omitted
+binaries produce shallow chains (the leaf frame is always correct).
+"""
+
+from __future__ import annotations
+
+import bisect
+import ctypes
+import logging
+import os
+import struct
+import threading
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from deepflow_tpu import native
+from deepflow_tpu.agent.profiler import ProfileSample, SamplerStats
+
+log = logging.getLogger("df.extprofiler")
+
+_PT_LOAD = 1
+_SHT_SYMTAB, _SHT_DYNSYM = 2, 11
+_STT_FUNC = 2
+
+
+@dataclass
+class _Map:
+    start: int
+    end: int
+    offset: int
+    path: str
+    bias: int = 0  # runtime addr - file vaddr
+
+
+_SYM_DTYPE = np.dtype([  # Elf64_Sym
+    ("name", "<u4"), ("info", "u1"), ("other", "u1"), ("shndx", "<u2"),
+    ("value", "<u8"), ("size", "<u8")])
+
+
+class ElfSymbols:
+    """Minimal ELF64 symbol table: vectorized parse (a large libpython
+    symtab has 100k+ entries — per-entry struct.unpack costs ~0.5s CPU,
+    which would dominate the profiler's observer budget), names decoded
+    lazily on first hit."""
+
+    def __init__(self, path: str) -> None:
+        self.addrs = np.empty(0, dtype=np.uint64)
+        self.sizes = np.empty(0, dtype=np.uint64)
+        self._name_offs = np.empty(0, dtype=np.uint32)
+        self._strtab_idx = np.empty(0, dtype=np.uint8)
+        self._strtabs: list[bytes] = []
+        self._names: dict[int, str] = {}
+        self.load_segments: list[tuple[int, int, int]] = []  # off, vaddr, sz
+        self.et_dyn = False
+        try:
+            self._parse(path)
+        except (OSError, ValueError, struct.error):
+            pass
+
+    def _parse(self, path: str) -> None:
+        import mmap as _mmap
+
+        # mmap, don't read(): a large runtime .so (libjax_common is
+        # hundreds of MB) must not be copied wholesale — only the section
+        # headers and symtab pages get touched
+        with open(path, "rb") as f:
+            try:
+                data = _mmap.mmap(f.fileno(), 0, prot=_mmap.PROT_READ)
+            except (ValueError, OSError):
+                data = f.read()
+        if data[:4] != b"\x7fELF" or data[4] != 2:  # ELF64 only
+            return
+        (e_type, _, _, _, e_phoff, e_shoff, _, _, e_phentsize, e_phnum,
+         e_shentsize, e_shnum, _) = struct.unpack_from("<HHIQQQIHHHHHH",
+                                                       data, 16)
+        self.et_dyn = e_type == 3
+        for i in range(e_phnum):
+            off = e_phoff + i * e_phentsize
+            p_type, _, p_offset, p_vaddr = struct.unpack_from(
+                "<IIQQ", data, off)
+            if p_type == _PT_LOAD:
+                p_filesz = struct.unpack_from("<Q", data, off + 32)[0]
+                self.load_segments.append((p_offset, p_vaddr, p_filesz))
+        sections = []
+        for i in range(e_shnum):
+            off = e_shoff + i * e_shentsize
+            (_, sh_type, _, _, sh_offset, sh_size, sh_link) = \
+                struct.unpack_from("<IIQQQQI", data, off)
+            sections.append((sh_type, sh_offset, sh_size, sh_link))
+        parts = []
+        for sh_type, sh_offset, sh_size, sh_link in sections:
+            if sh_type not in (_SHT_SYMTAB, _SHT_DYNSYM):
+                continue
+            if sh_link >= len(sections):
+                continue
+            _, str_off, str_size, _ = sections[sh_link]
+            n = sh_size // _SYM_DTYPE.itemsize
+            syms = np.frombuffer(data, dtype=_SYM_DTYPE, count=n,
+                                 offset=sh_offset)
+            keep = ((syms["info"] & 0xF) == _STT_FUNC) & (syms["value"] != 0)
+            syms = syms[keep]
+            if len(syms):
+                parts.append((syms, len(self._strtabs)))
+                # lazy strtab view (no copy: a big .so's strtab is tens
+                # of MB; names are sliced out on first lookup hit)
+                self._strtabs.append((data, str_off, str_size))
+        if not parts:
+            return
+        values = np.concatenate([s["value"] for s, _ in parts])
+        sizes = np.concatenate([s["size"] for s, _ in parts])
+        name_offs = np.concatenate([s["name"] for s, _ in parts])
+        tab_idx = np.concatenate([
+            np.full(len(s), idx, dtype=np.uint8) for s, idx in parts])
+        # dedup by value (symtab shadows dynsym), sort by address
+        order = np.argsort(values, kind="stable")
+        values, sizes = values[order], sizes[order]
+        name_offs, tab_idx = name_offs[order], tab_idx[order]
+        uniq = np.ones(len(values), dtype=bool)
+        uniq[1:] = values[1:] != values[:-1]
+        self.addrs = values[uniq]
+        self.sizes = sizes[uniq]
+        self._name_offs = name_offs[uniq]
+        self._strtab_idx = tab_idx[uniq]
+
+    def _name_at(self, i: int) -> str:
+        name = self._names.get(i)
+        if name is None:
+            buf, base, size = self._strtabs[int(self._strtab_idx[i])]
+            start = base + int(self._name_offs[i])
+            end = buf.find(b"\0", start, base + size)
+            if end < 0:
+                end = base + size
+            name = bytes(buf[start:end]).decode("utf-8", "replace")
+            self._names[i] = name
+        return name
+
+    def bias_for(self, m: _Map) -> int:
+        """Runtime bias for a mapped region of this file: map.start maps
+        file offset map.offset, which lives at some PT_LOAD vaddr."""
+        if not self.et_dyn:
+            return 0
+        for p_offset, p_vaddr, p_filesz in self.load_segments:
+            if p_offset <= m.offset < p_offset + max(p_filesz, 1):
+                return m.start - (p_vaddr + (m.offset - p_offset))
+        return m.start - m.offset
+
+    def lookup(self, vaddr: int) -> str | None:
+        i = int(np.searchsorted(self.addrs, vaddr, side="right")) - 1
+        if i < 0:
+            return None
+        v, size = int(self.addrs[i]), int(self.sizes[i])
+        if size and vaddr >= v + size:
+            return None
+        if not size and vaddr - v > 1 << 20:  # unsized symbol sanity cap
+            return None
+        name = self._name_at(i)
+        return name or None
+
+
+class Symbolizer:
+    """Address -> 'binary`function' via /proc/pid/maps + ELF symtabs."""
+
+    def __init__(self, pid: int) -> None:
+        self.pid = pid
+        self.maps: list[_Map] = []
+        self._starts: list[int] = []
+        self._elfs: dict[str, ElfSymbols] = {}
+        self._cache: dict[int, str] = {}  # addr -> resolved (hot: the same
+        # interpreter/runtime frames repeat across most chains)
+        self.refresh()
+
+    def refresh(self) -> None:
+        maps = []
+        try:
+            with open(f"/proc/{self.pid}/maps") as f:
+                for line in f:
+                    parts = line.split()
+                    if len(parts) < 6 or "x" not in parts[1]:
+                        continue
+                    start_s, end_s = parts[0].split("-")
+                    m = _Map(start=int(start_s, 16), end=int(end_s, 16),
+                             offset=int(parts[2], 16), path=parts[5])
+                    maps.append(m)
+        except OSError:
+            pass
+        maps = sorted(maps, key=lambda m: m.start)
+        if [(m.start, m.end, m.path) for m in maps] != \
+                [(m.start, m.end, m.path) for m in self.maps]:
+            self._cache.clear()  # mappings changed; cached addrs stale
+            self.maps = maps
+            self._starts = [m.start for m in self.maps]
+
+    def _elf(self, path: str) -> ElfSymbols:
+        e = self._elfs.get(path)
+        if e is None:
+            e = self._elfs[path] = ElfSymbols(path)
+        return e
+
+    def resolve(self, addr: int) -> str:
+        hit = self._cache.get(addr)
+        if hit is not None:
+            return hit
+        out = self._resolve_uncached(addr)
+        self._cache[addr] = out
+        return out
+
+    def _resolve_uncached(self, addr: int) -> str:
+        i = bisect.bisect_right(self._starts, addr) - 1
+        if i < 0 or addr >= self.maps[i].end:
+            return f"[{addr:#x}]"
+        m = self.maps[i]
+        if not m.path.startswith("/"):
+            return m.path or f"[{addr:#x}]"  # [vdso], [stack], anon
+        e = self._elf(m.path)
+        if m.bias == 0 and e.et_dyn:
+            m.bias = e.bias_for(m)
+        name = e.lookup(addr - m.bias)
+        base = os.path.basename(m.path)
+        if name:
+            return f"{base}`{name}"
+        return f"{base}+{addr - m.bias:#x}"
+
+
+class ExternalProfiler:
+    """Continuous out-of-process OnCPU profiler for one target pid."""
+
+    ADDR_CAP = 1 << 18
+    STACK_CAP = 8192
+
+    def __init__(self, sink, pid: int, hz: float = 99.0,
+                 window_s: float = 1.0, process_name: str = "",
+                 app_service: str = "") -> None:
+        lib = native.load()
+        if lib is None:
+            raise RuntimeError("libdfnative.so unavailable")
+        self._bind(lib)
+        self._lib = lib
+        self.sink = sink
+        self.pid = pid
+        self.hz = hz
+        self.window_s = window_s
+        self.process_name = process_name or self._comm(pid)
+        self.app_service = app_service or self.process_name
+        self.stats = SamplerStats()
+        self.lost = 0
+        self.export_dropped = 0
+        self._h = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._sym = Symbolizer(pid)
+        self._addrs = np.zeros(self.ADDR_CAP, dtype=np.uint64)
+        self._lens = np.zeros(self.STACK_CAP, dtype=np.uint16)
+        self._tids = np.zeros(self.STACK_CAP, dtype=np.uint32)
+        self._counts = np.zeros(self.STACK_CAP, dtype=np.uint32)
+
+    @staticmethod
+    def _bind(lib) -> None:
+        if getattr(lib, "_df_prof_bound", False):
+            return
+        lib.df_prof_open.restype = ctypes.c_void_p
+        lib.df_prof_open.argtypes = [ctypes.c_int32, ctypes.c_uint32,
+                                     ctypes.c_uint32,
+                                     ctypes.POINTER(ctypes.c_int32)]
+        lib.df_prof_close.argtypes = [ctypes.c_void_p]
+        lib.df_prof_poll.restype = ctypes.c_uint64
+        lib.df_prof_poll.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.df_prof_export.restype = ctypes.c_uint32
+        lib.df_prof_export.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint32,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_uint32]
+        lib.df_prof_stats.argtypes = [ctypes.c_void_p,
+                                      np.ctypeslib.ndpointer(np.uint64)]
+        lib._df_prof_bound = True
+
+    @staticmethod
+    def _comm(pid: int) -> str:
+        try:
+            with open(f"/proc/{pid}/comm") as f:
+                return f.read().strip()
+        except OSError:
+            return str(pid)
+
+    def start(self) -> "ExternalProfiler":
+        err = ctypes.c_int32(0)
+        self._h = self._lib.df_prof_open(self.pid, int(self.hz), 64,
+                                         ctypes.byref(err))
+        if not self._h:
+            raise OSError(err.value, os.strerror(err.value),
+                          f"perf_event_open pid={self.pid}")
+        self._thread = threading.Thread(
+            target=self._run, name=f"df-extprof-{self.pid}", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=3.0)
+            if self._thread.is_alive():
+                # never touch/free native state under a live worker
+                # (use-after-free); leaking the handle is the safe mode
+                log.warning("extprofiler worker did not exit; leaking "
+                            "perf handle for pid %d", self.pid)
+                return
+        self._emit()  # final window
+        if self._h:
+            self._lib.df_prof_close(self._h)
+            self._h = None
+
+    def _run(self) -> None:
+        next_emit = time.monotonic() + self.window_s
+        while not self._stop.is_set():
+            try:
+                self._lib.df_prof_poll(self._h, 200)
+            except Exception:
+                log.exception("perf poll failed")
+                return
+            if time.monotonic() >= next_emit:
+                next_emit = time.monotonic() + self.window_s
+                try:
+                    self._emit()
+                except Exception:
+                    log.exception("extprofiler emit failed")
+
+    def _emit(self) -> None:
+        if not self._h:
+            return
+        self._lib.df_prof_poll(self._h, 0)
+        n = self._lib.df_prof_export(
+            self._h, self._addrs.ctypes.data_as(ctypes.c_void_p),
+            self.ADDR_CAP, self._lens.ctypes.data_as(ctypes.c_void_p),
+            self._tids.ctypes.data_as(ctypes.c_void_p),
+            self._counts.ctypes.data_as(ctypes.c_void_p), self.STACK_CAP)
+        if n == 0:
+            return
+        self._sym.refresh()  # mappings change (dlopen etc.)
+        ts = time.time_ns()
+        period_us = int(1e6 / self.hz)
+        batch = []
+        off = 0
+        for i in range(n):
+            ln = int(self._lens[i])
+            chain = self._addrs[off:off + ln]
+            off += ln
+            # chains arrive leaf-first; folded stacks are root-first
+            frames = [self._sym.resolve(int(a)) for a in chain[::-1]]
+            count = int(self._counts[i])
+            batch.append(ProfileSample(
+                timestamp_ns=ts, pid=self.pid, tid=int(self._tids[i]),
+                thread_name=str(int(self._tids[i])),
+                stack=";".join(frames), count=count,
+                value_us=count * period_us,
+                event_type="on-cpu", profiler="perf"))
+            self.stats.samples += count
+        self.stats.emits += 1
+        self.stats.last_emit_stacks = len(batch)
+        st = np.zeros(4, dtype=np.uint64)
+        self._lib.df_prof_stats(self._h, st)
+        self.lost = int(st[1])
+        self.export_dropped = int(st[3])
+        try:
+            self.sink(batch)
+        except Exception:
+            pass  # a failing sink must never kill the profiler

@@ -1,0 +1,274 @@
+// Out-of-process OnCPU sampler: perf_event_open + mmap rings.
+//
+// Reference analog: agent/src/ebpf/kernel/perf_profiler.bpf.c:688 (99Hz
+// perf_event sampling) + user/profile/profile_common.c (aggregation, A/B
+// swap). Redesign: no BPF — per-CPU inherited perf events on the target
+// pid, frame-pointer callchains from PERF_SAMPLE_CALLCHAIN, address-level
+// aggregation here, symbolization in Python (cold path, /proc/pid/maps +
+// ELF symtab there).
+//
+// The DWARF unwinder gap is acknowledged: FP-omitted binaries yield
+// shallow chains (leaf IP still samples correctly).
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <vector>
+
+#include <dirent.h>
+
+#include <linux/perf_event.h>
+#include <poll.h>
+#include <sys/ioctl.h>
+#include <sys/mman.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+extern "C" {
+
+namespace {
+
+constexpr uint32_t kRingPages = 64;  // data pages per cpu (256KB)
+constexpr uint64_t kContextMask = 0xFFFFFFFFFFFFF000ULL;  // PERF_CONTEXT_*
+
+struct CpuRing {
+    int fd = -1;
+    uint8_t* map = nullptr;
+    size_t map_len = 0;
+    std::vector<int> extra_fds;  // per-tid events redirected into this ring
+};
+
+// Existing tids of a process (inherit=1 only follows FUTURE children, so
+// threads alive at attach time each need their own event, perf-record
+// style).
+std::vector<int> list_tids(int pid) {
+    std::vector<int> tids;
+    char path[64];
+    snprintf(path, sizeof(path), "/proc/%d/task", pid);
+    DIR* d = opendir(path);
+    if (!d) {
+        tids.push_back(pid);
+        return tids;
+    }
+    while (dirent* e = readdir(d)) {
+        int tid = atoi(e->d_name);
+        if (tid > 0) tids.push_back(tid);
+    }
+    closedir(d);
+    if (tids.empty()) tids.push_back(pid);
+    return tids;
+}
+
+}  // namespace
+
+struct DfProf {
+    std::vector<CpuRing> rings;
+    // aggregation: callchain (leaf..root addresses + tid tail) -> count
+    std::map<std::vector<uint64_t>, uint64_t> agg;
+    uint64_t n_samples = 0, n_lost = 0, n_export_dropped = 0;
+    uint32_t max_stack;
+    int target_pid;
+};
+
+static long pe_open(perf_event_attr* attr, pid_t pid, int cpu) {
+    return syscall(SYS_perf_event_open, attr, pid, cpu, -1,
+                   PERF_FLAG_FD_CLOEXEC);
+}
+
+// Attach to `pid` (all threads via inherit) at `freq` Hz across all CPUs.
+// Returns nullptr with errno-like code in *err on failure.
+DfProf* df_prof_open(int32_t pid, uint32_t freq, uint32_t max_stack,
+                     int32_t* err) {
+    *err = 0;
+    perf_event_attr attr;
+    memset(&attr, 0, sizeof(attr));
+    attr.size = sizeof(attr);
+    attr.type = PERF_TYPE_SOFTWARE;
+    attr.config = PERF_COUNT_SW_CPU_CLOCK;
+    attr.sample_freq = freq ? freq : 99;
+    attr.freq = 1;
+    attr.sample_type = PERF_SAMPLE_IP | PERF_SAMPLE_TID |
+                       PERF_SAMPLE_CALLCHAIN;
+    attr.exclude_kernel = 1;
+    attr.exclude_hv = 1;
+    attr.inherit = 1;          // follow the target's threads
+    attr.disabled = 1;
+    attr.wakeup_events = 128;  // don't wake the poller per sample; the
+                               // window timeout drains the tail
+
+    auto* p = new DfProf();
+    p->max_stack = max_stack ? max_stack : 64;
+    p->target_pid = pid;
+    auto cleanup = [&]() {
+        for (auto& q : p->rings) {
+            for (int efd : q.extra_fds) close(efd);
+            if (q.map) munmap(q.map, q.map_len);
+            if (q.fd >= 0) close(q.fd);
+        }
+        delete p;
+    };
+    // one event per (existing tid, cpu): the leader's event owns the cpu's
+    // ring; the other tids' events redirect into it (SET_OUTPUT), and
+    // inherit picks up any threads spawned later
+    std::vector<int> tids = list_tids(pid);
+    long ncpu = sysconf(_SC_NPROCESSORS_ONLN);
+    for (int cpu = 0; cpu < ncpu; cpu++) {
+        CpuRing r;
+        r.fd = (int)pe_open(&attr, tids[0], cpu);
+        if (r.fd < 0) {
+            if (errno == ENODEV) continue;  // offline cpu
+            *err = errno;
+            cleanup();
+            return nullptr;
+        }
+        r.map_len = (kRingPages + 1) * (size_t)getpagesize();
+        r.map = (uint8_t*)mmap(nullptr, r.map_len, PROT_READ | PROT_WRITE,
+                               MAP_SHARED, r.fd, 0);
+        if (r.map == MAP_FAILED) {
+            *err = errno;
+            close(r.fd);
+            cleanup();
+            return nullptr;
+        }
+        ioctl(r.fd, PERF_EVENT_IOC_ENABLE, 0);
+        for (size_t t = 1; t < tids.size(); t++) {
+            int efd = (int)pe_open(&attr, tids[t], cpu);
+            if (efd < 0) continue;  // tid exited since listing: fine
+            if (ioctl(efd, PERF_EVENT_IOC_SET_OUTPUT, r.fd) < 0) {
+                close(efd);
+                continue;
+            }
+            ioctl(efd, PERF_EVENT_IOC_ENABLE, 0);
+            r.extra_fds.push_back(efd);
+        }
+        p->rings.push_back(r);
+    }
+    if (p->rings.empty()) {
+        *err = ENODEV;
+        delete p;
+        return nullptr;
+    }
+    return p;
+}
+
+void df_prof_close(DfProf* p) {
+    if (!p) return;
+    for (auto& r : p->rings) {
+        for (int efd : r.extra_fds) {
+            ioctl(efd, PERF_EVENT_IOC_DISABLE, 0);
+            close(efd);
+        }
+        if (r.fd >= 0) ioctl(r.fd, PERF_EVENT_IOC_DISABLE, 0);
+        if (r.map) munmap(r.map, r.map_len);
+        if (r.fd >= 0) close(r.fd);
+    }
+    delete p;
+}
+
+static void drain_ring(DfProf* p, CpuRing& r) {
+    auto* meta = (perf_event_mmap_page*)r.map;
+    uint64_t head = __atomic_load_n(&meta->data_head, __ATOMIC_ACQUIRE);
+    uint64_t tail = meta->data_tail;
+    size_t data_size = kRingPages * (size_t)getpagesize();
+    uint8_t* data = r.map + getpagesize();
+    std::vector<uint8_t> rec;
+    std::vector<uint64_t> chain;
+    while (tail < head) {
+        auto* hdr = (perf_event_header*)(data + (tail % data_size));
+        uint16_t size = hdr->size;
+        if (size == 0) break;  // corrupt; bail
+        // record may wrap the ring edge: copy out
+        rec.resize(size);
+        size_t off = tail % data_size;
+        size_t first = data_size - off < size ? data_size - off : size;
+        memcpy(rec.data(), data + off, first);
+        if (first < size) memcpy(rec.data() + first, data, size - first);
+        auto* h = (perf_event_header*)rec.data();
+        if (h->type == PERF_RECORD_SAMPLE) {
+            // layout per sample_type: ip u64, pid u32, tid u32,
+            // nr u64, ips[nr] u64
+            const uint8_t* q = rec.data() + sizeof(perf_event_header);
+            uint64_t ip;
+            memcpy(&ip, q, 8);
+            q += 8;
+            uint32_t spid, tid;
+            memcpy(&spid, q, 4);
+            memcpy(&tid, q + 4, 4);
+            q += 8;
+            uint64_t nr;
+            memcpy(&nr, q, 8);
+            q += 8;
+            const uint8_t* end = rec.data() + size;
+            chain.clear();
+            for (uint64_t i = 0; i < nr && q + 8 <= end; i++, q += 8) {
+                uint64_t a;
+                memcpy(&a, q, 8);
+                if (a >= kContextMask) continue;  // context marker
+                chain.push_back(a);
+                if (chain.size() >= p->max_stack) break;
+            }
+            if (chain.empty() && ip < kContextMask) chain.push_back(ip);
+            if (!chain.empty()) {
+                chain.push_back((uint64_t)tid);  // tid tail distinguishes
+                p->agg[chain]++;
+                p->n_samples++;
+            }
+        } else if (h->type == PERF_RECORD_LOST) {
+            uint64_t lost;
+            memcpy(&lost, rec.data() + sizeof(perf_event_header) + 8, 8);
+            p->n_lost += lost;
+        }
+        tail += size;
+    }
+    __atomic_store_n(&meta->data_tail, tail, __ATOMIC_RELEASE);
+}
+
+// Drain all rings (non-blocking unless timeout_ms > 0 and nothing ready).
+// Returns samples aggregated so far in this window.
+uint64_t df_prof_poll(DfProf* p, int32_t timeout_ms) {
+    if (timeout_ms > 0) {
+        std::vector<pollfd> fds;
+        for (auto& r : p->rings) fds.push_back({r.fd, POLLIN, 0});
+        poll(fds.data(), fds.size(), timeout_ms);
+    }
+    for (auto& r : p->rings) drain_ring(p, r);
+    return p->n_samples;
+}
+
+// Export the window's unique chains and RESET (A/B swap).
+// addrs: concatenated chains (leaf..root, NO tid); lens[i] = chain length;
+// tids[i], counts[i] per chain. Returns number of chains written.
+uint32_t df_prof_export(DfProf* p, uint64_t* addrs, uint32_t addr_cap,
+                        uint16_t* lens, uint32_t* tids, uint32_t* counts,
+                        uint32_t stack_cap) {
+    uint32_t n = 0, used = 0;
+    for (auto& kv : p->agg) {
+        if (n >= stack_cap || used + (kv.first.size() - 1) > addr_cap) {
+            p->n_export_dropped++;  // overflow is counted, never silent
+            continue;
+        }
+        const auto& chain = kv.first;
+        uint32_t clen = (uint32_t)chain.size() - 1;  // drop tid tail
+        memcpy(addrs + used, chain.data(), (size_t)clen * 8);
+        lens[n] = (uint16_t)clen;
+        tids[n] = (uint32_t)chain.back();
+        counts[n] = (uint32_t)kv.second;
+        used += clen;
+        n++;
+    }
+    p->agg.clear();
+    return n;
+}
+
+// stats: [samples_total, lost_total, rings, export_dropped_chains]
+void df_prof_stats(DfProf* p, uint64_t* out4) {
+    out4[0] = p->n_samples;
+    out4[1] = p->n_lost;
+    out4[2] = p->rings.size();
+    out4[3] = p->n_export_dropped;
+}
+
+}  // extern "C"

@@ -28,6 +28,7 @@ class CollectiveGroup:
     max_duration_ns: int = 0
     bytes_transferred: int = 0  # per participant (same payload in SPMD)
     step: int = 0
+    n_spans: int = 0  # > n_participants when the op repeats within a run
 
     @property
     def latency_ns(self) -> int:
@@ -61,6 +62,7 @@ class CollectiveGroup:
             "bytes_transferred": self.bytes_transferred,
             "algo_bw_gbyte_s": round(self.algo_bw_gbyte_s(), 3),
             "step": self.step,
+            "n_spans": self.n_spans,
         }
 
 
@@ -72,7 +74,8 @@ def stitch(spans) -> list[CollectiveGroup]:
     step. Non-collective spans are ignored.
     """
     groups: dict[tuple, CollectiveGroup] = {}
-    seen: dict[tuple, set] = {}  # group key -> {(device, core)} dedup
+    seen: dict[tuple, set] = {}       # group key -> exact-row dedup
+    parts: dict[tuple, set] = {}      # group key -> {(device, core)}
     for s in spans:
         get = s.get if isinstance(s, dict) else lambda k, d=None: getattr(
             s, k, d)
@@ -86,13 +89,17 @@ def stitch(spans) -> list[CollectiveGroup]:
         dev = int(get("device_id") or 0)
         core = int(get("core_id") or 0)
         key = (run_id, op)
-        # each (device, core) participates once — megacore captures emit a
-        # per-core plane per chip; duplicates must not inflate the group
-        part = (dev, core)
-        members = seen.setdefault(key, set())
-        if part in members:
+        # drop only EXACT duplicate rows (re-ingested data); repeated
+        # executions inside one run (lax.scan / grad accumulation) have
+        # distinct starts and must all count
+        row = (dev, core, start, dur)
+        rows_seen = seen.setdefault(key, set())
+        if row in rows_seen:
             continue
-        members.add(part)
+        rows_seen.add(row)
+        members = parts.setdefault(key, set())
+        fresh = (dev, core) not in members
+        members.add((dev, core))
         g = groups.get(key)
         if g is None:
             g = groups[key] = CollectiveGroup(
@@ -102,8 +109,11 @@ def stitch(spans) -> list[CollectiveGroup]:
                 bytes_transferred=int(get("bytes_transferred") or 0),
                 step=int(get("step") or 0))
             g.participants.append(dev)
+            g.n_spans = 1
             continue
-        g.participants.append(dev)
+        if fresh:
+            g.participants.append(dev)
+        g.n_spans += 1
         g.start_ns = min(g.start_ns, start)
         g.max_start_ns = max(g.max_start_ns, start)
         g.end_ns = max(g.end_ns, start + dur)

@@ -92,8 +92,27 @@ def test_stitch_dedups_duplicate_device_core():
     assert len(groups) == 1
     g = groups[0]
     assert len(g.participants) == 3  # (0,0), (0,1), (1,0)
+    assert g.n_spans == 3            # exact duplicate dropped
     assert g.skew_ns == 40           # 130 - 90, order-independent
     assert g.start_ns == 90
+
+
+def test_stitch_keeps_repeated_executions():
+    """lax.scan-style repeats of the same collective within one run have
+    distinct starts — all must count (only exact duplicates are dropped)."""
+    rows = []
+    for rep in range(3):
+        for dev in range(2):
+            rows.append({"run_id": 7, "hlo_op": "all-reduce.2",
+                         "collective": "all-reduce", "device_id": dev,
+                         "core_id": 0, "time": 1000 + rep * 100 + dev,
+                         "duration_ns": 50})
+    groups = stitch(rows)
+    assert len(groups) == 1
+    g = groups[0]
+    assert len(g.participants) == 2
+    assert g.n_spans == 6
+    assert g.end_ns == 1000 + 200 + 1 + 50
 
 
 def test_querier_collective_endpoints():
