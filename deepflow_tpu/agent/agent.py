@@ -41,6 +41,7 @@ class Agent:
         self.integration_proxy = None
         self.dispatcher = None
         self.live_capture = None
+        self.sslprobe = None
         self._stats_thread: threading.Thread | None = None
         self._stop = threading.Event()
         self._components: list[str] = []
@@ -108,7 +109,8 @@ class Agent:
                         app_service=ep.app_service)
                     ep.start()
                     self.extprofilers.append(ep)
-                    self._components.append(f"extprof-{pid}")
+                    if f"extprof-{pid}" not in self._components:
+                        self._components.append(f"extprof-{pid}")
                 except (OSError, RuntimeError, ImportError,
                         AttributeError) as e:
                     # AttributeError: stale libdfnative.so without the
@@ -155,12 +157,18 @@ class Agent:
             self.start_tpuprobe()
             if self.tpuprobe is not None:
                 self._components.append("tpuprobe")
-        if self.config.flow.enabled:
+        if self.config.flow.enabled or self.config.sslprobe_sock:
             from deepflow_tpu.agent.dispatcher import Dispatcher
-            from deepflow_tpu.agent.live_capture import LiveCapture
             self.dispatcher = Dispatcher(
                 sender=self.sender,
                 agent_id=self.config.agent_id).start()
+        if self.config.sslprobe_sock:
+            from deepflow_tpu.agent.sslprobe import SslProbeListener
+            self.sslprobe = SslProbeListener(
+                self.dispatcher, self.config.sslprobe_sock).start()
+            self._components.append("ssl-probe")
+        if self.config.flow.enabled:
+            from deepflow_tpu.agent.live_capture import LiveCapture
             # the agent's own telemetry must never be captured (feedback
             # amplification): union the REAL sender ports into the exclusions
             exclude = set(self.config.flow.exclude_ports)
@@ -220,6 +228,8 @@ class Agent:
             self.tpuprobe.stop()
         if self.integration_proxy:
             self.integration_proxy.stop()
+        if self.sslprobe:
+            self.sslprobe.stop()
         if self.live_capture:
             self.live_capture.stop()
         if self.dispatcher:

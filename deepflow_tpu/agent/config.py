@@ -67,6 +67,9 @@ class SenderConfig:
 class AgentConfig:
     agent_id: int = 0
     app_service: str = ""
+    # AF_UNIX path for the LD_PRELOAD ssl/syscall probe (pre-encryption L7
+    # visibility); "" = disabled
+    sslprobe_sock: str = ""
     group: str = "default"        # agent-group for config routing
     controller: str = ""          # host:port; empty = standalone mode
     standalone: bool = True

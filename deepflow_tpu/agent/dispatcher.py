@@ -83,6 +83,10 @@ def record_to_l7_pb(r: L7Record) -> pb.L7FlowLog:
         f.captured_request_byte = req.captured_byte
         if req.l7_protocol:
             f.l7_protocol = req.l7_protocol
+    f.syscall_trace_id_request = r.syscall_trace_id_request
+    f.syscall_trace_id_response = r.syscall_trace_id_response
+    f.syscall_thread_0 = r.syscall_thread_0
+    f.syscall_thread_1 = r.syscall_thread_1
     if resp is not None:
         f.response_status = resp.response_status
         f.response_code = resp.response_code

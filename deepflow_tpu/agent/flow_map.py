@@ -56,6 +56,8 @@ class DirectionStats:
 class PendingRequest:
     timestamp_ns: int
     record: L7ParseResult
+    syscall_trace_id: int = 0   # thread chain id of the carrying packet
+    tid: int = 0
 
 
 @dataclass
@@ -103,6 +105,12 @@ class L7Record:
     response: L7ParseResult | None
     start_ns: int
     end_ns: int
+    # uprobe-source chaining (sslprobe): links this record to others the
+    # same thread produced, without W3C headers
+    syscall_trace_id_request: int = 0
+    syscall_trace_id_response: int = 0
+    syscall_thread_0: int = 0   # request-side tid
+    syscall_thread_1: int = 0   # response-side tid
 
 
 class FlowMap:
@@ -298,21 +306,27 @@ class FlowMap:
             except Exception:
                 return
         for rec in records:
-            self._session_match(node, rec, p.timestamp_ns)
+            self._session_match(node, rec, p.timestamp_ns,
+                                getattr(p, "syscall_trace_id", 0),
+                                getattr(p, "tid", 0))
 
     def _session_match(self, node: FlowNode, rec: L7ParseResult,
-                       ts_ns: int) -> None:
+                       ts_ns: int, trace_id: int = 0,
+                       tid: int = 0) -> None:
         if rec.msg_type == MSG_REQUEST:
             node.l7_request += 1
             if rec.session_less:
                 # fire-and-forget message: complete record, no response due
-                self._emit_l7(node, rec, None, ts_ns, ts_ns)
+                self._emit_l7(node, rec, None, ts_ns, ts_ns,
+                              req_trace=trace_id, req_tid=tid)
                 return
-            pending = PendingRequest(ts_ns, rec)
+            pending = PendingRequest(ts_ns, rec, trace_id, tid)
             if len(node.pending) >= self.MAX_PENDING:
                 old = node.pending.popleft()
                 node.pending_by_id.pop(old.record.request_id, None)
-                self._emit_l7(node, old.record, None, old.timestamp_ns, 0)
+                self._emit_l7(node, old.record, None, old.timestamp_ns, 0,
+                              req_trace=old.syscall_trace_id,
+                              req_tid=old.tid)
             node.pending.append(pending)
             if rec.request_id:
                 node.pending_by_id[rec.request_id] = pending
@@ -333,17 +347,24 @@ class FlowMap:
                 node.art_sum_us += art_us
                 node.art_count += 1
                 self._emit_l7(node, match.record, rec, match.timestamp_ns,
-                              ts_ns)
+                              ts_ns, req_trace=match.syscall_trace_id,
+                              req_tid=match.tid, resp_trace=trace_id,
+                              resp_tid=tid)
             else:
-                self._emit_l7(node, None, rec, ts_ns, ts_ns)
+                self._emit_l7(node, None, rec, ts_ns, ts_ns,
+                              resp_trace=trace_id, resp_tid=tid)
 
     def _emit_l7(self, node: FlowNode, req: L7ParseResult | None,
                  resp: L7ParseResult | None, start_ns: int,
-                 end_ns: int) -> None:
+                 end_ns: int, req_trace: int = 0, req_tid: int = 0,
+                 resp_trace: int = 0, resp_tid: int = 0) -> None:
         self.stats["l7_records"] += 1
         self.on_l7_log(L7Record(
             flow=node, request=req, response=resp,
-            start_ns=start_ns, end_ns=end_ns or start_ns))
+            start_ns=start_ns, end_ns=end_ns or start_ns,
+            syscall_trace_id_request=req_trace,
+            syscall_trace_id_response=resp_trace,
+            syscall_thread_0=req_tid, syscall_thread_1=resp_tid))
 
     # -- flush / close ---------------------------------------------------------
 
@@ -384,7 +405,8 @@ class FlowMap:
         # flush unanswered requests
         while node.pending:
             old = node.pending.popleft()
-            self._emit_l7(node, old.record, None, old.timestamp_ns, 0)
+            self._emit_l7(node, old.record, None, old.timestamp_ns, 0,
+                          req_trace=old.syscall_trace_id, req_tid=old.tid)
         node.pending_by_id.clear()
         self.on_flow_update(node, True)
         self.on_l4_log(node)

@@ -40,6 +40,9 @@ class MetaPacket:
     payload: bytes = b""
     packet_len: int = 0          # on-wire length
     tap_port: int = 0
+    # uprobe-source extras (sslprobe): thread-scoped chain id + tid
+    syscall_trace_id: int = 0
+    tid: int = 0
 
     @property
     def key(self) -> tuple:

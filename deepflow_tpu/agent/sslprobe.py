@@ -1,0 +1,158 @@
+"""Agent-side receiver for the LD_PRELOAD ssl/syscall probe
+(native/sslprobe.cpp): probe events -> MetaPackets -> the flow pipeline.
+
+Reference analog: agent/src/ebpf/user/ssl_tracer.c (user-side of the
+SSL uprobes) + the socket-tracer event pump. Each probed process connects
+over an AF_UNIX SEQPACKET socket and streams {header, payload} messages
+for every socket read/write — TLS events carry PLAINTEXT (captured before
+encryption / after decryption) and supersede the ciphertext syscall events
+for the same connection.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import struct
+import threading
+
+from deepflow_tpu.agent.packet import MetaPacket
+
+log = logging.getLogger("df.sslprobe")
+
+# must match #pragma pack(1) struct ProbeEvent in native/sslprobe.cpp
+HDR = struct.Struct("<IIiBBHHBB16s16sQQI")
+
+DIR_INGRESS, DIR_EGRESS = 0, 1
+SRC_PLAIN, SRC_TLS = 0, 1
+
+
+class SslProbeListener:
+    """SEQPACKET listener feeding probe events into a dispatcher."""
+
+    def __init__(self, dispatcher, sock_path: str) -> None:
+        self.dispatcher = dispatcher
+        self.sock_path = sock_path
+        self._lst: socket.socket | None = None
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        # (pid, fd) -> "plain" | "tls"; and per-direction byte counters so
+        # synthetic seq numbers keep the retrans detector quiet
+        self._conn_mode: dict[tuple, str] = {}
+        self._seq: dict[tuple, int] = {}
+        self.stats = {"events": 0, "tls_events": 0, "dropped_plain": 0,
+                      "connections": 0}
+
+    def start(self) -> "SslProbeListener":
+        try:
+            os.unlink(self.sock_path)
+        except OSError:
+            pass
+        lst = socket.socket(socket.AF_UNIX, socket.SOCK_SEQPACKET)
+        lst.bind(self.sock_path)
+        lst.listen(16)
+        lst.settimeout(0.5)
+        self._lst = lst
+        t = threading.Thread(target=self._accept_loop,
+                             name="df-sslprobe-accept", daemon=True)
+        t.start()
+        self._threads.append(t)
+        log.info("ssl probe listening on %s", self.sock_path)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2.0)
+        if self._lst is not None:
+            self._lst.close()
+            self._lst = None
+        try:
+            os.unlink(self.sock_path)
+        except OSError:
+            pass
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._lst.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            self.stats["connections"] += 1
+            t = threading.Thread(target=self._reader, args=(conn,),
+                                 name="df-sslprobe-conn", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _reader(self, conn: socket.socket) -> None:
+        conn.settimeout(0.5)
+        try:
+            while not self._stop.is_set():
+                try:
+                    msg = conn.recv(1 << 14)
+                except socket.timeout:
+                    continue
+                except OSError:
+                    return
+                if not msg:
+                    return
+                try:
+                    self._handle(msg)
+                except Exception:
+                    log.exception("probe event failed")
+        finally:
+            conn.close()
+
+    def _handle(self, msg: bytes) -> None:
+        if len(msg) < HDR.size:
+            return
+        (pid, tid, fd, direction, source, lport, pport, family, _pad,
+         laddr, paddr, ts_ns, trace_id, dlen) = HDR.unpack_from(msg)
+        payload = msg[HDR.size:HDR.size + dlen]
+        self.stats["events"] += 1
+        conn_key = (pid, fd)
+        mode = self._conn_mode.get(conn_key)
+        if source == SRC_TLS:
+            self.stats["tls_events"] += 1
+            if mode != "tls":
+                # promotion: the connection is TLS — the flow so far only
+                # held ciphertext handshake records; drop that state so the
+                # plaintext stream re-infers its real protocol
+                self._conn_mode[conn_key] = "tls"
+                self._drop_flow(family, laddr, paddr, lport, pport)
+        elif mode == "tls":
+            self.stats["dropped_plain"] += 1  # ciphertext for a TLS conn
+            return
+        alen = 4 if family == 4 else 16
+        local, peer = laddr[:alen], paddr[:alen]
+        if direction == DIR_EGRESS:
+            src_ip, dst_ip, sport, dport = local, peer, lport, pport
+        else:
+            src_ip, dst_ip, sport, dport = peer, local, pport, lport
+        seq_key = (pid, fd, direction)
+        seq = self._seq.get(seq_key, 1)
+        self._seq[seq_key] = seq + len(payload)
+        mp = MetaPacket(
+            timestamp_ns=ts_ns, ip_src=src_ip, ip_dst=dst_ip,
+            port_src=sport, port_dst=dport, protocol=1,
+            tcp_flags=0x18,  # PSH|ACK
+            seq=seq & 0xFFFFFFFF, payload=payload,
+            packet_len=len(payload) + 54, tap_port=63,  # uprobe tap
+            syscall_trace_id=trace_id, tid=tid)
+        self.dispatcher.inject(mp)
+
+    def _drop_flow(self, family, laddr, paddr, lport, pport) -> None:
+        alen = 4 if family == 4 else 16
+        local, peer = laddr[:alen], paddr[:alen]
+        fm = self.dispatcher.flow_map
+        with self.dispatcher._lock:  # flush thread iterates fm.flows
+            for key in ((local, peer, lport, pport, 1),
+                        (peer, local, pport, lport, 1)):
+                node = fm.flows.pop(key, None)
+                if node is not None:
+                    # silently discard: it held only undecryptable records
+                    node.pending.clear()
+                    node.pending_by_id.clear()

@@ -24,13 +24,36 @@ def main() -> int:
     parser.add_argument("--service", default="")
     parser.add_argument("-m", dest="module", action="store_true",
                         help="run target as a module (python -m style)")
+    parser.add_argument("--ssl-probe", action="store_true",
+                        help="pre-encryption L7 visibility: LD_PRELOAD the "
+                             "ssl/syscall interposer into CHILD processes "
+                             "this workload spawns (and configure the "
+                             "in-process agent to receive its events)")
     parser.add_argument("target")
     parser.add_argument("args", nargs=argparse.REMAINDER)
     opts = parser.parse_args()
 
+    sslprobe_sock = ""
+    if opts.ssl_probe:
+        import os
+        import tempfile
+        so = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "native", "libdfsslprobe.so")
+        if os.path.exists(so):
+            # private 0700 dir: a predictable /tmp name could be squatted
+            sslprobe_sock = os.path.join(
+                tempfile.mkdtemp(prefix="dfprobe-"), "probe.sock")
+            prior = os.environ.get("LD_PRELOAD", "")
+            os.environ["LD_PRELOAD"] = f"{so}:{prior}" if prior else so
+            os.environ["DF_SSLPROBE_SOCK"] = sslprobe_sock
+        else:
+            print("deepflow-run: libdfsslprobe.so not built; "
+                  "--ssl-probe disabled", file=sys.stderr)
+
     from deepflow_tpu.agent.agent import attach, detach
     attach(app_service=opts.service or opts.target,
-           servers=[opts.server], controller=opts.controller)
+           servers=[opts.server], controller=opts.controller,
+           sslprobe_sock=sslprobe_sock)
 
     sys.argv = [opts.target] + opts.args
     try:

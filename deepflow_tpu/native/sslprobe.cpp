@@ -1,0 +1,325 @@
+// LD_PRELOAD interposer: pre-encryption L7 visibility + syscall trace
+// chaining for deepflow-run-launched processes.
+//
+// Reference analog: agent/src/ebpf/user/ssl_tracer.c (uprobes on
+// SSL_read/SSL_write expose plaintext before encryption) and
+// kernel/socket_trace.bpf.c:1291 (thread-scoped syscall_trace_id chains
+// ingress reads to the egress writes they cause, linking request->response
+// and request->downstream-call without W3C headers). Redesign: no kernel
+// programs — symbol interposition in the target's own address space, with
+// events shipped over an AF_UNIX datagram socket to the agent.
+//
+// Build: part of `make -C deepflow_tpu/native` -> libdfsslprobe.so.
+// Activate: LD_PRELOAD=libdfsslprobe.so DF_SSLPROBE_SOCK=/path cmd...
+
+#define _GNU_SOURCE 1
+
+#include <cerrno>
+#include <cstdarg>
+#include <cstdint>
+#include <initializer_list>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+#include <dlfcn.h>
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/syscall.h>
+#include <sys/types.h>
+#include <sys/un.h>
+#include <netinet/in.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint32_t kMaxPayload = 3800;  // fits one unix dgram with header
+
+enum : uint8_t { DIR_INGRESS = 0, DIR_EGRESS = 1 };
+enum : uint8_t { SRC_PLAIN = 0, SRC_TLS = 1 };
+
+#pragma pack(push, 1)
+struct ProbeEvent {             // must match SSL_EVENT_DTYPE (sslprobe.py)
+    uint32_t pid;
+    uint32_t tid;
+    int32_t fd;
+    uint8_t direction;          // 0 ingress (read), 1 egress (write)
+    uint8_t source;             // 0 plain syscall, 1 TLS (decrypted)
+    uint16_t local_port;
+    uint16_t peer_port;
+    uint8_t family;             // 4 or 6
+    uint8_t _pad;
+    uint8_t local_addr[16];
+    uint8_t peer_addr[16];
+    uint64_t ts_ns;
+    uint64_t syscall_trace_id;  // thread-scoped chain id
+    uint32_t data_len;          // bytes following this header
+};
+#pragma pack(pop)
+
+using ssl_io_fn = int (*)(void*, void*, int);
+using ssl_io_ex_fn = int (*)(void*, void*, size_t, size_t*);
+using ssl_get_fd_fn = int (*)(const void*);
+using rw_fn = ssize_t (*)(int, void*, size_t);
+using send_fn = ssize_t (*)(int, const void*, size_t, int);
+
+ssl_io_fn real_ssl_read = nullptr;
+ssl_io_fn real_ssl_write = nullptr;
+ssl_io_ex_fn real_ssl_read_ex = nullptr;
+ssl_io_ex_fn real_ssl_write_ex = nullptr;
+ssl_get_fd_fn real_ssl_get_fd = nullptr;
+rw_fn real_read = nullptr;
+rw_fn real_write = nullptr;
+send_fn real_send = nullptr;
+send_fn real_recv = nullptr;
+
+int emit_fd = -1;
+sockaddr_un emit_addr{};
+bool enabled = false;
+bool debug = false;            // cached: getenv is a linear environ scan,
+                               // far too slow for the per-syscall hot path
+uint64_t trace_epoch = 0;      // high bits of trace ids (per process)
+
+// thread-local chain state + re-entrancy guard (our own emit writes must
+// never be traced)
+thread_local uint64_t tls_trace_id = 0;
+thread_local uint64_t tls_counter = 0;
+thread_local bool tls_in_probe = false;
+
+uint64_t now_ns() {
+    timespec ts;
+    clock_gettime(CLOCK_REALTIME, &ts);
+    return (uint64_t)ts.tv_sec * 1'000'000'000ULL + ts.tv_nsec;
+}
+
+void init_once() {
+    static pthread_once_t once = PTHREAD_ONCE_INIT;
+    pthread_once(&once, [] {
+        real_read = (rw_fn)dlsym(RTLD_NEXT, "read");
+        real_write = (rw_fn)dlsym(RTLD_NEXT, "write");
+        real_send = (send_fn)dlsym(RTLD_NEXT, "send");
+        real_recv = (send_fn)dlsym(RTLD_NEXT, "recv");
+        // SSL_* are NOT resolved here: libssl is typically dlopen'd later
+        // (python imports _ssl long after the first read()); they resolve
+        // lazily at first SSL call
+        debug = getenv("DF_SSLPROBE_DEBUG") != nullptr;
+        const char* path = getenv("DF_SSLPROBE_SOCK");
+        if (!path || !path[0]) return;
+        // SEQPACKET, not DGRAM: unix dgram queues are capped by
+        // net.unix.max_dgram_qlen (10 on this kernel) — a single request
+        // overflows it; seqpacket keeps message boundaries with normal
+        // socket buffering
+        emit_fd = socket(AF_UNIX, SOCK_SEQPACKET | SOCK_CLOEXEC, 0);
+        if (emit_fd < 0) return;
+        emit_addr.sun_family = AF_UNIX;
+        strncpy(emit_addr.sun_path, path, sizeof(emit_addr.sun_path) - 1);
+        if (connect(emit_fd, (sockaddr*)&emit_addr,
+                    sizeof(emit_addr)) != 0) {
+            close(emit_fd);
+            emit_fd = -1;
+            return;
+        }
+        int snd = 4 << 20;
+        setsockopt(emit_fd, SOL_SOCKET, SO_SNDBUF, &snd, sizeof(snd));
+        enabled = true;
+        trace_epoch = ((uint64_t)getpid() << 40) ^ now_ns();
+    });
+}
+
+bool is_inet_socket(int fd, ProbeEvent* ev) {
+    struct stat st;
+    if (fstat(fd, &st) != 0 || !S_ISSOCK(st.st_mode)) return false;
+    sockaddr_storage peer{}, local{};
+    socklen_t plen = sizeof(peer), llen = sizeof(local);
+    if (getpeername(fd, (sockaddr*)&peer, &plen) != 0) return false;
+    if (peer.ss_family != AF_INET && peer.ss_family != AF_INET6)
+        return false;
+    getsockname(fd, (sockaddr*)&local, &llen);
+    if (peer.ss_family == AF_INET) {
+        auto* p = (sockaddr_in*)&peer;
+        auto* l = (sockaddr_in*)&local;
+        ev->family = 4;
+        memcpy(ev->peer_addr, &p->sin_addr, 4);
+        memcpy(ev->local_addr, &l->sin_addr, 4);
+        ev->peer_port = ntohs(p->sin_port);
+        ev->local_port = ntohs(l->sin_port);
+    } else {
+        auto* p = (sockaddr_in6*)&peer;
+        auto* l = (sockaddr_in6*)&local;
+        ev->family = 6;
+        memcpy(ev->peer_addr, &p->sin6_addr, 16);
+        memcpy(ev->local_addr, &l->sin6_addr, 16);
+        ev->peer_port = ntohs(p->sin6_port);
+        ev->local_port = ntohs(l->sin6_port);
+    }
+    return true;
+}
+
+void emit(int fd, uint8_t direction, uint8_t source, const void* data,
+          size_t len) {
+    if (!enabled || tls_in_probe || len == 0) {
+        if (debug && source == SRC_TLS)
+            fprintf(stderr, "dfsslprobe: emit early-out enabled=%d "
+                            "in_probe=%d len=%zu\n", enabled, tls_in_probe,
+                    len);
+        return;
+    }
+    tls_in_probe = true;
+    ProbeEvent ev{};
+    if (!is_inet_socket(fd, &ev)) {
+        if (debug && source == SRC_TLS)
+            fprintf(stderr, "dfsslprobe: emit not-inet fd=%d\n", fd);
+        tls_in_probe = false;
+        return;
+    }
+    // thread-scoped chaining (socket_trace.bpf.c:1291 semantics): an
+    // ingress starts a new chain; every egress the thread performs before
+    // its next ingress inherits it
+    if (direction == DIR_INGRESS) {
+        tls_trace_id = trace_epoch + (++tls_counter) +
+                       ((uint64_t)syscall(SYS_gettid) << 20);
+    }
+    ev.pid = (uint32_t)getpid();
+    ev.tid = (uint32_t)syscall(SYS_gettid);
+    ev.fd = fd;
+    ev.direction = direction;
+    ev.source = source;
+    ev.ts_ns = now_ns();
+    ev.syscall_trace_id = tls_trace_id;
+    ev.data_len = len > kMaxPayload ? kMaxPayload : (uint32_t)len;
+    char buf[sizeof(ProbeEvent) + kMaxPayload];
+    memcpy(buf, &ev, sizeof(ev));
+    memcpy(buf + sizeof(ev), data, ev.data_len);
+    ssize_t sent = real_send(emit_fd, buf, sizeof(ev) + ev.data_len,
+                             MSG_DONTWAIT | MSG_NOSIGNAL);
+    if (sent < 0 && debug)
+        fprintf(stderr, "dfsslprobe: emit send failed errno=%d\n", errno);
+    tls_in_probe = false;
+}
+
+}  // namespace
+
+extern "C" {
+
+ssize_t read(int fd, void* buf, size_t count) {
+    init_once();
+    ssize_t n = real_read(fd, buf, count);
+    if (n > 0) emit(fd, DIR_INGRESS, SRC_PLAIN, buf, (size_t)n);
+    return n;
+}
+
+ssize_t write(int fd, const void* buf, size_t count) {
+    init_once();
+    ssize_t n = real_write(fd, (void*)buf, count);
+    if (n > 0) emit(fd, DIR_EGRESS, SRC_PLAIN, buf, (size_t)n);
+    return n;
+}
+
+ssize_t recv(int fd, void* buf, size_t count, int flags) {
+    init_once();
+    ssize_t n = real_recv(fd, buf, count, flags);
+    if (n > 0 && !(flags & MSG_PEEK))
+        emit(fd, DIR_INGRESS, SRC_PLAIN, buf, (size_t)n);
+    return n;
+}
+
+ssize_t send(int fd, const void* buf, size_t count, int flags) {
+    init_once();
+    ssize_t n = real_send(fd, (void*)buf, count, flags);
+    if (n > 0) emit(fd, DIR_EGRESS, SRC_PLAIN, buf, (size_t)n);
+    return n;
+}
+
+// TLS: plaintext BEFORE encryption / AFTER decryption. The fd used for
+// flow identity comes from SSL_get_fd, and the event is marked SRC_TLS so
+// the agent drops the overlapping ciphertext syscall events for that fd.
+static void resolve_ssl() {
+    if (real_ssl_get_fd) return;
+    // RTLD_NEXT only sees the GLOBAL scope; when libssl arrives as an
+    // RTLD_LOCAL dependency of a dlopen'd extension (python's _ssl.so),
+    // the interposed symbols still bind to us, but the real ones must be
+    // found via a NOLOAD handle to the already-mapped libssl
+    void* h = RTLD_NEXT;
+    if (!dlsym(RTLD_NEXT, "SSL_get_fd")) {
+        for (const char* name : {"libssl.so.3", "libssl.so.1.1",
+                                 "libssl.so"}) {
+            void* lh = dlopen(name, RTLD_LAZY | RTLD_NOLOAD);
+            if (lh) {
+                h = lh;
+                break;
+            }
+        }
+        if (h == RTLD_NEXT) return;  // libssl not loaded yet
+    }
+    real_ssl_read = (ssl_io_fn)dlsym(h, "SSL_read");
+    real_ssl_write = (ssl_io_fn)dlsym(h, "SSL_write");
+    real_ssl_read_ex = (ssl_io_ex_fn)dlsym(h, "SSL_read_ex");
+    real_ssl_write_ex = (ssl_io_ex_fn)dlsym(h, "SSL_write_ex");
+    real_ssl_get_fd = (ssl_get_fd_fn)dlsym(h, "SSL_get_fd");
+    if (debug) {
+        fprintf(stderr, "dfsslprobe: resolve h=%p read=%p read_ex=%p "
+                        "get_fd=%p\n", h, (void*)real_ssl_read,
+                (void*)real_ssl_read_ex, (void*)real_ssl_get_fd);
+    }
+}
+
+int SSL_read(void* ssl, void* buf, int num) {
+    init_once();
+    resolve_ssl();
+    if (!real_ssl_read) return -1;
+    tls_in_probe = true;  // suppress the underlying read() of ciphertext
+    int n = real_ssl_read(ssl, buf, num);
+    tls_in_probe = false;
+    if (n > 0 && real_ssl_get_fd)
+        emit(real_ssl_get_fd(ssl), DIR_INGRESS, SRC_TLS, buf, (size_t)n);
+    return n;
+}
+
+int SSL_write(void* ssl, void* buf, int num) {
+    init_once();
+    resolve_ssl();
+    if (!real_ssl_write) return -1;
+    tls_in_probe = true;  // suppress the underlying write() of ciphertext
+    int n = real_ssl_write(ssl, buf, num);
+    tls_in_probe = false;
+    // emit AFTER, with the accepted byte count: WANT_WRITE retries and
+    // partial writes must not produce phantom/duplicate plaintext events
+    if (n > 0 && real_ssl_get_fd)
+        emit(real_ssl_get_fd(ssl), DIR_EGRESS, SRC_TLS, buf, (size_t)n);
+    return n;
+}
+
+// OpenSSL 1.1.1+ _ex API — what CPython 3.12's _ssl actually calls.
+// (Intra-libssl calls don't cross the PLT, so SSL_read interposition alone
+// never sees them.)
+int SSL_read_ex(void* ssl, void* buf, size_t num, size_t* readbytes) {
+    init_once();
+    resolve_ssl();
+    if (!real_ssl_read_ex) return 0;
+    tls_in_probe = true;
+    int ok = real_ssl_read_ex(ssl, buf, num, readbytes);
+    tls_in_probe = false;
+    if (debug)
+        fprintf(stderr, "dfsslprobe: SSL_read_ex ok=%d n=%zu fd=%d\n", ok,
+                readbytes ? *readbytes : 0,
+                real_ssl_get_fd ? real_ssl_get_fd(ssl) : -1);
+    if (ok > 0 && readbytes && *readbytes > 0 && real_ssl_get_fd)
+        emit(real_ssl_get_fd(ssl), DIR_INGRESS, SRC_TLS, buf, *readbytes);
+    return ok;
+}
+
+int SSL_write_ex(void* ssl, void* buf, size_t num, size_t* written) {
+    init_once();
+    resolve_ssl();
+    if (!real_ssl_write_ex) return 0;
+    tls_in_probe = true;
+    int ok = real_ssl_write_ex(ssl, buf, num, written);
+    tls_in_probe = false;
+    if (ok > 0 && written && *written > 0 && real_ssl_get_fd)
+        emit(real_ssl_get_fd(ssl), DIR_EGRESS, SRC_TLS, buf, *written);
+    return ok;
+}
+
+}  // extern "C"

@@ -179,3 +179,87 @@ def build_trace(l7_table: ColumnarTable, trace_id: str,
         "spans": [s.to_dict() for s in
                   sorted(roots, key=lambda s: s.start_ns)],
     }
+
+
+def build_syscall_trace(l7_table: ColumnarTable, syscall_trace_id: int,
+                        max_hops: int = 16) -> dict:
+    """Trace assembly WITHOUT W3C headers: follow thread-scoped syscall
+    chain ids (reference socket_trace.bpf.c:1291) hop by hop.
+
+    An ingress request assigns a chain id T to its thread; every egress the
+    thread performs before its next ingress (the downstream calls the
+    request caused) carries T. So rows sharing a syscall_trace_id_request
+    or _response belong to one causal chain; each hop's response-side id
+    chains to the next window of work.
+    """
+    seen_ids: set[int] = set()
+    frontier = {int(syscall_trace_id)}
+    rows: dict[tuple, dict] = {}
+    for _ in range(max_hops):
+        frontier = {t for t in frontier if t and t not in seen_ids}
+        if not frontier:
+            break
+        seen_ids.update(frontier)
+        ids = list(frontier)
+
+        def match(ch, ids=ids):
+            import numpy as np
+            m = np.isin(ch["syscall_trace_id_request"], ids)
+            m |= np.isin(ch["syscall_trace_id_response"], ids)
+            return m
+
+        frontier = set()
+        for r in _rows(l7_table, match):
+            key = (r["flow_id"], r["time"], r["request_id"])
+            if key in rows:
+                continue
+            rows[key] = r
+            frontier.add(int(r["syscall_trace_id_request"]))
+            frontier.add(int(r["syscall_trace_id_response"]))
+
+    spans = []
+    for r in rows.values():
+        name = r["endpoint"] or r["request_resource"] or r["request_type"]
+        spans.append(TraceSpan(
+            span_id=f"flow-{r['flow_id']}-{r['time']}",
+            parent_span_id="",
+            name=f"{r['request_type']} {name}".strip(),
+            service=r.get("app_service") or r.get("host", ""),
+            l7_protocol=r["l7_protocol"],
+            start_ns=r["time"],
+            end_ns=r["time"] + max(r["response_duration"], 1),
+            status=r["response_status"],
+            response_code=r["response_code"],
+            ip_src=r["ip_src"], ip_dst=r["ip_dst"],
+            attrs={
+                "syscall_trace_id_request":
+                    int(r["syscall_trace_id_request"]),
+                "syscall_trace_id_response":
+                    int(r["syscall_trace_id_response"]),
+            }))
+    spans.sort(key=lambda s: s.start_ns)
+    # parenting: a span is the child of the span whose REQUEST chain id it
+    # shares and which started earlier (the ingress that caused it);
+    # fallback to time containment
+    roots: list[TraceSpan] = []
+    for i, s in enumerate(spans):
+        parent = None
+        for cand in spans[:i]:
+            if cand.attrs["syscall_trace_id_request"] and \
+                    cand.attrs["syscall_trace_id_request"] == \
+                    s.attrs["syscall_trace_id_request"]:
+                parent = cand
+        if parent is None:
+            for cand in spans[:i]:
+                if cand.start_ns <= s.start_ns and \
+                        s.end_ns <= cand.end_ns:
+                    parent = cand
+        if parent is not None:
+            parent.children.append(s)
+        else:
+            roots.append(s)
+    return {
+        "syscall_trace_id": int(syscall_trace_id),
+        "span_count": len(spans),
+        "spans": [s.to_dict() for s in roots],
+    }

@@ -225,10 +225,21 @@ class QuerierAPI:
         return {"batches": [{"spans": spans}]}
 
     def trace(self, body: dict) -> dict:
-        """Distributed trace tree by trace_id (reference: tracemap)."""
+        """Distributed trace tree by trace_id (reference: tracemap), or by
+        syscall chain id for uprobe-sourced flows without W3C headers."""
         trace_id = body.get("trace_id", "")
+        syscall_id = body.get("syscall_trace_id")
+        if syscall_id is not None:
+            try:
+                syscall_id = int(syscall_id)
+            except (TypeError, ValueError):
+                raise qengine.QueryError(
+                    f"bad syscall_trace_id {syscall_id!r}") from None
+            from deepflow_tpu.query.tracing import build_syscall_trace
+            return {"result": build_syscall_trace(
+                self.db.table("flow_log.l7_flow_log"), syscall_id)}
         if not trace_id:
-            raise qengine.QueryError("trace_id required")
+            raise qengine.QueryError("trace_id or syscall_trace_id required")
         from deepflow_tpu.query.tracing import build_trace
         return {"result": build_trace(
             self.db.table("flow_log.l7_flow_log"), trace_id,
