@@ -1,0 +1,313 @@
+"""Golden capture corpus: one pcap per L7 protocol + expected parse result.
+
+Reference analog: agent/resources/test/ (per-protocol .pcap + .result files,
+exercised by flow_map.rs:3413). Each case is a REAL session shape — TCP
+handshake, request/response payload segments with correct seqs, close — so
+replay exercises the full FlowMap path (FSM, direction, session matching),
+not just the parser function.
+
+Regenerate fixtures:  python tests/golden_corpus.py
+(then review the diff — the .result files are the contract)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+
+FIXTURE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "fixtures", "pcaps")
+
+ETH = b"\x02" * 6 + b"\x04" * 6 + struct.pack(">H", 0x0800)
+
+
+def tcp_frame(src, dst, sport, dport, flags, payload=b"", seq=0, ack=0):
+    # one frame encoder for the whole project: agent/packet.py
+    from deepflow_tpu.agent.packet import encode_tcp_frame
+    return encode_tcp_frame(src, dst, sport, dport, flags, payload=payload,
+                            seq=seq, ack=ack)
+
+
+def udp_frame(src, dst, sport, dport, payload=b""):
+    from deepflow_tpu.agent.packet import encode_udp_frame
+    return encode_udp_frame(src, dst, sport, dport, payload=payload)
+
+
+def icmp_frame(src, dst, icmp_type, ident=7, seqn=1, data=b"data"):
+    import socket
+    body = bytes([icmp_type, 0, 0, 0]) + struct.pack(">HH", ident, seqn) \
+        + data
+    ip = struct.pack(">BBHHHBBH4s4s", 0x45, 0, 20 + len(body), 1, 0, 64, 1,
+                     0, socket.inet_aton(src), socket.inet_aton(dst))
+    return ETH + ip + body
+
+
+SYN, SYNACK, ACK, PSHACK, FINACK = 0x02, 0x12, 0x10, 0x18, 0x11
+
+
+def tcp_session(port, request, response=b"", sport=43210,
+                client="10.5.0.1", server="10.5.0.2"):
+    """Full handshake + request (+response) + close."""
+    frames = [
+        tcp_frame(client, server, sport, port, SYN, seq=100),
+        tcp_frame(server, client, port, sport, SYNACK, seq=300, ack=101),
+        tcp_frame(client, server, sport, port, ACK, seq=101, ack=301),
+        tcp_frame(client, server, sport, port, PSHACK, payload=request,
+                  seq=101),
+    ]
+    if response:
+        frames.append(tcp_frame(server, client, port, sport, PSHACK,
+                                payload=response, seq=301))
+    frames.append(tcp_frame(client, server, sport, port, FINACK,
+                            seq=101 + len(request)))
+    frames.append(tcp_frame(server, client, port, sport, FINACK,
+                            seq=301 + len(response),
+                            ack=102 + len(request)))
+    return frames
+
+
+def _pb():
+    from deepflow_tpu.proto import pb
+    return pb
+
+
+def build_cases() -> list[dict]:
+    pb = _pb()
+    from deepflow_tpu.utils.promwire import varint
+    cases = []
+
+    def case(name, proto, frames, expect):
+        expect["l7_protocol"] = int(proto)
+        cases.append({"name": name, "frames": frames, "expect": expect})
+
+    # -- HTTP/1.1 -------------------------------------------------------------
+    case("http1", pb.HTTP1, tcp_session(
+        80,
+        b"GET /api/users?id=7 HTTP/1.1\r\nHost: api.example.com\r\n\r\n",
+        b"HTTP/1.1 200 OK\r\nContent-Length: 2\r\n\r\nok"),
+        {"request_type": "GET", "request_domain": "api.example.com",
+         "endpoint": "/api/users", "response_code": 200, "records": 1})
+
+    # -- HTTP/2: preface + SETTINGS + HEADERS with literal HPACK -------------
+    def h2_literal(name: bytes, value: bytes) -> bytes:
+        return (b"\x00" + bytes([len(name)]) + name
+                + bytes([len(value)]) + value)
+
+    h2_block = (h2_literal(b":method", b"GET")
+                + h2_literal(b":path", b"/h2/endpoint")
+                + h2_literal(b":authority", b"h2.example"))
+    h2_headers = (len(h2_block).to_bytes(3, "big") + bytes([1, 0x05])
+                  + (1).to_bytes(4, "big") + h2_block)
+    case("http2", pb.HTTP2, tcp_session(
+        8443,
+        b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
+        b"\x00\x00\x00\x04\x00\x00\x00\x00\x00" + h2_headers),
+        {"request_type": "GET", "endpoint": "/h2/endpoint",
+         "request_domain": "h2.example", "records": 1})
+
+    # -- DNS over UDP ---------------------------------------------------------
+    q = (struct.pack(">HHHHHH", 0x1234, 0x0100, 1, 0, 0, 0)
+         + b"\x07example\x03com\x00" + struct.pack(">HH", 1, 1))
+    r = (struct.pack(">HHHHHH", 0x1234, 0x8180, 1, 1, 0, 0)
+         + b"\x07example\x03com\x00" + struct.pack(">HH", 1, 1)
+         + b"\xc0\x0c" + struct.pack(">HHIH", 1, 1, 60, 4)
+         + bytes([93, 184, 216, 34]))
+    case("dns", pb.DNS, [
+        udp_frame("10.5.0.1", "10.5.0.9", 53333, 53, q),
+        udp_frame("10.5.0.9", "10.5.0.1", 53, 53333, r)],
+        {"request_type": "A", "request_resource": "example.com",
+         "response_result": "93.184.216.34", "records": 1})
+
+    # -- MySQL ----------------------------------------------------------------
+    sql = b"SELECT * FROM users WHERE id=1"
+    mysql = (len(sql) + 1).to_bytes(3, "little") + bytes([0, 3]) + sql
+    case("mysql", pb.MYSQL, tcp_session(3306, mysql),
+         {"request_type": "SELECT", "request_resource": "users",
+          "records": 1})
+
+    # -- PostgreSQL -----------------------------------------------------------
+    psql = b"INSERT INTO orders VALUES (1)\x00"
+    case("postgresql", pb.POSTGRESQL, tcp_session(
+        5432, b"Q" + struct.pack(">I", 4 + len(psql)) + psql),
+        {"request_type": "INSERT", "request_resource": "orders",
+         "records": 1})
+
+    # -- Redis ----------------------------------------------------------------
+    case("redis", pb.REDIS, tcp_session(
+        6379, b"*3\r\n$3\r\nSET\r\n$5\r\nmykey\r\n$5\r\nhello\r\n",
+        b"+OK\r\n"),
+        {"request_type": "SET", "request_resource": "mykey", "records": 1})
+
+    # -- Kafka ----------------------------------------------------------------
+    kmsg = struct.pack(">ihhih", 20, 3, 4, 7, 6) + b"my-app" + b"\x00\x00"
+    case("kafka", pb.KAFKA, tcp_session(9092, kmsg),
+         {"request_type": "Metadata", "request_id": "7", "records": 1})
+
+    # -- MongoDB --------------------------------------------------------------
+    bson = b"\x00\x00\x00\x00\x02find\x00\x06\x00\x00\x00users\x00\x00"
+    body = struct.pack("<I", 0) + b"\x00" + bson
+    mongo = struct.pack("<IIII", 16 + len(body), 42, 0, 2013) + body
+    case("mongodb", pb.MONGODB, tcp_session(27017, mongo),
+         {"request_type": "find", "request_resource": "users",
+          "records": 1})
+
+    # -- Memcached ------------------------------------------------------------
+    case("memcached", pb.MEMCACHED, tcp_session(
+        11211, b"get session:abc\r\n"),
+        {"request_type": "GET", "records": 1})
+
+    # -- MQTT (CONNECT then QoS0 PUBLISH in its own segment) -----------------
+    connect = bytes([0x10, 12]) + b"\x00\x04MQTT\x04\x02\x00\x3c"
+    publish = bytes([0x30, 14]) + struct.pack(">H", 9) + b"tpu/stats" + b"x"
+    # PUBLISH rides its own segment; the session's FIN seqs must account
+    # for BOTH payloads
+    mqtt_frames = tcp_session(1883, connect + publish)
+    mqtt_frames[3] = tcp_frame("10.5.0.1", "10.5.0.2", 43210, 1883,
+                               PSHACK, payload=connect, seq=101)
+    mqtt_frames.insert(4, tcp_frame("10.5.0.1", "10.5.0.2", 43210, 1883,
+                                    PSHACK, payload=publish,
+                                    seq=101 + len(connect)))
+    case("mqtt", pb.MQTT, mqtt_frames,
+         {"request_types": ["CONNECT", "PUBLISH"], "records": 2})
+
+    # -- AMQP -----------------------------------------------------------------
+    method = (bytes([1]) + struct.pack(">H", 0) + struct.pack(">I", 8)
+              + struct.pack(">HH", 60, 40) + b"\x00" * 4 + b"\xce")
+    case("amqp", pb.AMQP, tcp_session(
+        5672, b"AMQP\x00\x00\x09\x01" + method),
+        {"records": 1})
+
+    # -- NATS -----------------------------------------------------------------
+    case("nats", pb.NATS, tcp_session(
+        4222, b"PUB updates.v1 11\r\nhello world\r\n"),
+        {"request_resource": "updates.v1", "records": 1})
+
+    # -- Dubbo ----------------------------------------------------------------
+    dbody = (b"\x05" + b"2.7.8" + b"\x1ecom.example.UserService"
+             + b"\x051.0.0" + b"\x07getUser")
+    dreq = struct.pack(">HBBQI", 0xDABB, 0xC2, 0, 42, len(dbody)) + dbody
+    dresp = struct.pack(">HBBQI", 0xDABB, 0x02, 20, 42, 2) + b"\x91\x05"
+    case("dubbo", pb.DUBBO, tcp_session(20880, dreq, dresp),
+         {"request_type": "getUser",
+          "request_domain": "com.example.UserService",
+          "response_status": 1, "records": 1})
+
+    # -- FastCGI --------------------------------------------------------------
+    def fcgi_rec(rtype, rid, body):
+        return struct.pack(">BBHHBB", 1, rtype, rid, len(body), 0, 0) + body
+
+    def kv(k, v):
+        return bytes([len(k), len(v)]) + k + v
+
+    params = (kv(b"REQUEST_METHOD", b"GET")
+              + kv(b"SCRIPT_NAME", b"/index.php"))
+    fcgi = (fcgi_rec(1, 7, b"\x00\x01\x00\x00\x00\x00\x00\x00")
+            + fcgi_rec(4, 7, params))
+    case("fastcgi", pb.FASTCGI, tcp_session(9000, fcgi),
+         {"request_resource": "/index.php", "records": 1})
+
+    # -- TLS ClientHello (SNI + ALPN) ----------------------------------------
+    sni = b"api.example.com"
+    sni_ext = (struct.pack(">HH", 0, len(sni) + 5)
+               + struct.pack(">HBH", len(sni) + 3, 0, len(sni)) + sni)
+    alpn_list = b"\x02h2\x08http/1.1"
+    alpn_ext = (struct.pack(">HH", 16, len(alpn_list) + 2)
+                + struct.pack(">H", len(alpn_list)) + alpn_list)
+    exts = sni_ext + alpn_ext
+    hello = (struct.pack(">H", 0x0303) + b"\x00" * 32 + b"\x00"
+             + struct.pack(">H", 2) + b"\x13\x01" + b"\x01\x00"
+             + struct.pack(">H", len(exts)) + exts)
+    hs = b"\x01" + len(hello).to_bytes(3, "big") + hello
+    rec = b"\x16\x03\x01" + struct.pack(">H", len(hs)) + hs
+    case("tls", pb.TLS, tcp_session(443, rec),
+         {"request_domain": "api.example.com", "records": 1})
+
+    # -- ICMP ping ------------------------------------------------------------
+    case("ping", pb.PING, [
+        icmp_frame("10.5.0.1", "10.5.0.9", 8),
+        icmp_frame("10.5.0.9", "10.5.0.1", 0)],
+        {"records": 1})
+
+    # -- RocketMQ -------------------------------------------------------------
+    hdr = json.dumps({"code": 10, "flag": 0, "opaque": 99,
+                      "language": "JAVA",
+                      "extFields": {"topic": "orders"}}).encode()
+    rmsg = struct.pack(">II", 4 + len(hdr), len(hdr)) + hdr
+    case("rocketmq", pb.ROCKETMQ, tcp_session(9876, rmsg),
+         {"request_type": "SEND_MESSAGE", "request_resource": "orders",
+          "records": 1})
+
+    # -- SOFARPC --------------------------------------------------------------
+    svc = b"com.alipay.test.FacadeService:1.0"
+    sofa = (bytes([1, 1]) + struct.pack(">H", 1) + bytes([1])
+            + struct.pack(">I", 321) + bytes([11, 0])
+            + struct.pack(">H", 0) + b"\x00" * 8 + svc)
+    sresp = (bytes([1, 0]) + struct.pack(">H", 2) + bytes([1])
+             + struct.pack(">I", 321) + bytes([11])
+             + struct.pack(">H", 0) + b"\x00" * 8)
+    case("sofarpc", pb.SOFARPC, tcp_session(12200, sofa, sresp),
+         {"request_id": "321", "response_status": 1, "records": 1})
+
+    # -- bRPC -----------------------------------------------------------------
+    svc_name, meth = b"example.EchoService", b"Echo"
+    req_meta = (b"\x0a" + varint(len(svc_name)) + svc_name
+                + b"\x12" + varint(len(meth)) + meth)
+    meta = (b"\x0a" + varint(len(req_meta)) + req_meta
+            + b"\x20" + varint(77))
+    brpc = b"PRPC" + struct.pack(">II", len(meta), len(meta)) + meta
+    case("brpc", pb.BRPC, tcp_session(8002, brpc),
+         {"endpoint": "example.EchoService/Echo", "request_id": "77",
+          "records": 1})
+
+    # -- Tars -----------------------------------------------------------------
+    tbody = (bytes([0x10]) + bytes([1])
+             + bytes([0x20]) + struct.pack(">h", 0)
+             + bytes([0x32]) + struct.pack(">i", 0)
+             + bytes([0x42]) + struct.pack(">i", 55)
+             + bytes([0x56]) + bytes([8]) + b"MyServer"
+             + bytes([0x66]) + bytes([4]) + b"ping")
+    tars = struct.pack(">I", 4 + len(tbody)) + tbody
+    case("tars", pb.TARS, tcp_session(10015, tars),
+         {"endpoint": "MyServer/ping", "request_id": "55", "records": 1})
+
+    # -- ZMTP -----------------------------------------------------------------
+    zmtp = (b"\xff" + b"\x00" * 8 + b"\x7f" + bytes([3, 0]) + b"NULL"
+            + b"\x00" * 16)
+    case("zmtp", pb.ZMTP, tcp_session(5555, zmtp),
+         {"version": "3.0", "request_resource": "NULL", "records": 1})
+
+    # -- OpenWire -------------------------------------------------------------
+    ow = (struct.pack(">I", 100) + bytes([1]) + b"\x00\x08ActiveMQ"
+          + b"\x00" * 8)
+    case("openwire", pb.OPENWIRE, tcp_session(61616, ow),
+         {"request_type": "WireFormatInfo", "records": 1})
+
+    return cases
+
+
+def write_pcap(path: str, frames, ts_base=1_700_000_000) -> None:
+    with open(path, "wb") as f:
+        f.write(struct.pack("<IHHiIII", 0xA1B2C3D4, 2, 4, 0, 0, 65535, 1))
+        for i, frame in enumerate(frames):
+            f.write(struct.pack("<IIII", ts_base + i, i * 1000, len(frame),
+                                len(frame)))
+            f.write(frame)
+
+
+def main() -> None:
+    os.makedirs(FIXTURE_DIR, exist_ok=True)
+    for c in build_cases():
+        write_pcap(os.path.join(FIXTURE_DIR, f"{c['name']}.pcap"),
+                   c["frames"])
+        with open(os.path.join(FIXTURE_DIR, f"{c['name']}.result"),
+                  "w") as f:
+            json.dump(c["expect"], f, indent=1, sort_keys=True)
+    print(f"wrote {len(build_cases())} cases to {FIXTURE_DIR}")
+
+
+if __name__ == "__main__":
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    main()
