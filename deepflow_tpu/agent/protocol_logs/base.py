@@ -96,4 +96,5 @@ from deepflow_tpu.agent.protocol_logs import mq  # noqa: E402,F401
 from deepflow_tpu.agent.protocol_logs import messaging  # noqa: E402,F401
 from deepflow_tpu.agent.protocol_logs import rpc  # noqa: E402,F401
 from deepflow_tpu.agent.protocol_logs import rpc2  # noqa: E402,F401
+from deepflow_tpu.agent.protocol_logs import enterprise  # noqa: E402,F401
 from deepflow_tpu.agent.protocol_logs import tls  # noqa: E402,F401

@@ -9,6 +9,7 @@ from __future__ import annotations
 import struct
 
 from deepflow_tpu.proto import pb
+from deepflow_tpu.agent.protocol_logs.hpack_huffman import huffman_decode
 from deepflow_tpu.agent.protocol_logs.base import (
     L7Parser, L7ParseResult, MSG_REQUEST, MSG_RESPONSE, register,
     status_from_code)
@@ -118,8 +119,8 @@ _HPACK_STATIC = {
 
 @register
 class Http2Parser(L7Parser):
-    """HTTP/2 frames; headers parsed for non-Huffman literal HPACK (enough
-    for gRPC's :path = /package.Service/Method in common stacks)."""
+    """HTTP/2 frames; HPACK headers decoded including Huffman strings
+    (RFC 7541 Appendix B) — covers gRPC's :path and typical stacks."""
 
     PROTOCOL = pb.HTTP2
     NAME = "http2"
@@ -185,30 +186,30 @@ class Http2Parser(L7Parser):
 
 
 def _hpack_literal_headers(frame: bytes) -> dict[str, str]:
-    """Best-effort HPACK: indexed static entries + literal (non-Huffman)."""
+    """Best-effort HPACK: static-index entries + literals, Huffman included."""
     headers: dict[str, str] = {}
     i = 0
     n = len(frame)
     while i < n:
         b = frame[i]
         if b & 0x80:  # indexed field
-            idx = b & 0x7F
+            idx, i = _hpack_int(frame, i, 7)
             if idx in _HPACK_STATIC:
                 k, v = _HPACK_STATIC[idx]
                 if v:
                     headers[k] = v
-            i += 1
             continue
         # literal with/without indexing
         if b & 0x40:
-            prefix = 0x3F
+            prefix_bits = 6
         elif b & 0x20:  # dynamic table size update
-            i += 1
+            _, i = _hpack_int(frame, i, 5)
             continue
         else:
-            prefix = 0x0F
-        idx = b & prefix
-        i += 1
+            prefix_bits = 4
+        idx, i = _hpack_int(frame, i, prefix_bits)
+        if idx is None:
+            return headers
         if idx:
             name = _HPACK_STATIC.get(idx, (str(idx), ""))[0]
         else:
@@ -222,14 +223,36 @@ def _hpack_literal_headers(frame: bytes) -> dict[str, str]:
     return headers
 
 
+def _hpack_int(frame: bytes, i: int, prefix_bits: int):
+    """HPACK prefix integer (RFC 7541 §5.1) -> (value, next_index)."""
+    mask = (1 << prefix_bits) - 1
+    v = frame[i] & mask
+    i += 1
+    if v < mask:
+        return v, i
+    shift = 0
+    while i < len(frame):
+        b = frame[i]
+        i += 1
+        v += (b & 0x7F) << shift
+        shift += 7
+        if not (b & 0x80):
+            return v, i
+    return None, i
+
+
 def _hpack_string(frame: bytes, i: int):
     if i >= len(frame):
         return None, i
     huffman = bool(frame[i] & 0x80)
-    ln = frame[i] & 0x7F
-    i += 1
+    ln, i = _hpack_int(frame, i, 7)
+    if ln is None or i + ln > len(frame):
+        return None, i
     raw = frame[i:i + ln]
     i += ln
     if huffman:
-        return "<huffman>", i  # not decoded (kept honest)
+        decoded = huffman_decode(raw)
+        if decoded is None:
+            return None, i
+        return decoded.decode("latin1", "replace"), i
     return raw.decode("latin1", "replace"), i

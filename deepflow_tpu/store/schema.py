@@ -15,7 +15,8 @@ L7_PROTOS = (
     "unknown", "http1", "http2", "grpc", "dns", "mysql", "redis", "kafka",
     "postgresql", "mongodb", "memcached", "mqtt", "amqp", "nats", "dubbo",
     "fastcgi", "tls", "ping", "rocketmq", "sofarpc", "zmtp",
-    "openwire", "tars", "brpc")
+    "openwire", "tars", "brpc", "oracle", "dameng", "iso8583", "netsign",
+    "websphere_mq", "someip")
 RESPONSE_STATUS = ("unknown", "ok", "client_error", "server_error", "timeout")
 PROFILE_EVENT_TYPES = (
     "unknown", "on-cpu", "off-cpu", "mem-alloc", "tpu-device", "tpu-host")

@@ -283,6 +283,52 @@ def build_cases() -> list[dict]:
     case("openwire", pb.OPENWIRE, tcp_session(61616, ow),
          {"request_type": "WireFormatInfo", "records": 1})
 
+    # -- Oracle TNS (sql/oracle.rs) ------------------------------------------
+    tns_body = (b"\x01\x38\x01\x2c" + b"\x00" * 24
+                + b"(DESCRIPTION=(CONNECT_DATA=(SERVICE_NAME=ORCL))"
+                  b"(ADDRESS=(PROTOCOL=TCP)(HOST=db1)(PORT=1521)))")
+    tns = struct.pack(">HHBBH", 8 + len(tns_body), 0, 1, 0, 0) + tns_body
+    accept = struct.pack(">HHBBH", 12, 0, 2, 0, 0) + b"\x01\x38\x00\x00"
+    case("oracle", pb.ORACLE, tcp_session(1521, tns, accept),
+         {"request_type": "CONNECT", "request_domain": "ORCL",
+          "response_status": 1, "records": 1})
+
+    # -- WebSphere MQ TSH (mq/web_sphere_mq.rs) -------------------------------
+    tsh = (b"TSH " + struct.pack(">I", 28) + bytes([1, 0x86, 0, 0])
+           + b"\x00" * 16)
+    tsh_reply = (b"TSH " + struct.pack(">I", 28) + bytes([1, 0x96, 0, 0])
+                 + b"\x00" * 16)
+    case("websphere_mq", pb.WEBSPHEREMQ, tcp_session(1414, tsh, tsh_reply),
+         {"request_type": "MQPUT", "response_status": 1, "records": 1})
+
+    # -- ISO8583 (rpc/iso8583.rs) ---------------------------------------------
+    iso_req = b"0200" + struct.pack(">Q", 0x7234054128C28805)
+    iso_resp = b"0210" + struct.pack(">Q", 0x7234054128C28805)
+    case("iso8583", pb.ISO8583, tcp_session(8583, iso_req, iso_resp),
+         {"request_type": "0200", "response_status": 1, "records": 1})
+
+    # -- SOME/IP (rpc/some_ip.rs) ---------------------------------------------
+    def someip(mtype, rc=0):
+        return (struct.pack(">HH", 0x1234, 0x0421) + struct.pack(">I", 8)
+                + struct.pack(">HH", 1, 9) + bytes([1, 1, mtype, rc]))
+    case("someip", pb.SOMEIP, tcp_session(30509, someip(0x00),
+                                          someip(0x80)),
+         {"request_type": "REQUEST", "endpoint": "0x1234/0x0421",
+          "response_status": 1, "records": 1})
+
+    # -- Dameng (sql/dameng.rs: closed crate upstream; minimal here) ---------
+    dm = (b"\x15\x00\x00\x00" + bytes([1]) + b"\x00" * 3
+          + struct.pack("<I", 64) + b"\x00" * 20
+          + b"SELECT id FROM t_user\x00" + b"\x00" * 42)
+    case("dameng", pb.DAMENG, tcp_session(5236, dm),
+         {"request_type": "SELECT", "records": 1})
+
+    # -- NetSign (rpc/net_sign.rs: closed crate upstream; minimal here) ------
+    ns = (struct.pack(">I", 40) + b"\x00" * 4 + b"<op>sign</op>"
+          + b"\x00" * 20)
+    case("netsign", pb.NETSIGN, tcp_session(9989, ns),
+         {"request_type": "sign", "records": 1})
+
     return cases
 
 

@@ -1,0 +1,170 @@
+"""Parser tail: Oracle TNS, WebSphere MQ, ISO8583, SOME/IP, Dameng,
+NetSign — plus Huffman HPACK in HTTP/2.
+
+Reference analogs: sql/oracle.rs, mq/web_sphere_mq.rs, rpc/iso8583.rs,
+rpc/some_ip.rs (sql/dameng.rs and rpc/net_sign.rs delegate to closed
+crates; ours are minimal public-spec parsers).
+"""
+
+import struct
+
+from deepflow_tpu.agent.protocol_logs.base import infer_and_parse
+from deepflow_tpu.proto import pb
+
+
+def tns_packet(ptype: int, body: bytes) -> bytes:
+    return struct.pack(">HHBBH", 8 + len(body), 0, ptype, 0, 0) + body
+
+
+def test_oracle_tns_connect_and_sql():
+    conn = tns_packet(1, b"\x01\x38\x01\x2c" + b"\x00" * 24 +
+                      b"(DESCRIPTION=(CONNECT_DATA=(SERVICE_NAME=ORCL)"
+                      b"(CID=(PROGRAM=sqlplus)))"
+                      b"(ADDRESS=(PROTOCOL=TCP)(HOST=db1)(PORT=1521)))")
+    proto, recs = infer_and_parse(conn)
+    assert proto == pb.ORACLE
+    assert recs[0].request_type == "CONNECT"
+    assert recs[0].request_domain == "ORCL"
+
+    accept = tns_packet(2, b"\x01\x38\x00\x00")
+    proto, recs = infer_and_parse(accept, port_dst=1521)
+    assert proto == pb.ORACLE
+    assert recs[0].msg_type == 1 and recs[0].response_status == 1
+
+    data = tns_packet(6, b"\x00\x00\x03SELECT owner FROM dba_tables\x00")
+    proto, recs = infer_and_parse(data, port_dst=1521)
+    assert proto == pb.ORACLE
+    assert recs[0].request_type == "SELECT"
+    assert "dba_tables" in recs[0].attrs["sql"]
+
+
+def test_websphere_mq_tsh():
+    tsh = (b"TSH " + struct.pack(">I", 28) + bytes([1, 0x86, 0, 0])
+           + b"\x00" * 8 + struct.pack(">I", 273) + b"\x00" * 4)
+    proto, recs = infer_and_parse(tsh, port_dst=1414)
+    assert proto == pb.WEBSPHEREMQ
+    assert recs[0].request_type == "MQPUT"
+
+    reply = (b"TSH " + struct.pack(">I", 28) + bytes([1, 0x96, 0, 0])
+             + b"\x00" * 16)
+    proto, recs = infer_and_parse(reply, port_dst=1414)
+    assert recs[0].msg_type == 1 and recs[0].response_status == 1
+
+
+def test_iso8583_mti():
+    # 0200 financial request with a primary bitmap
+    msg = b"0200" + struct.pack(">Q", 0x7234054128C28805)
+    proto, recs = infer_and_parse(msg, port_dst=8583)
+    assert proto == pb.ISO8583
+    assert recs[0].request_type == "0200"
+    assert recs[0].attrs["mti"] == "0200"
+    # 0210 response, behind a 2-byte length prefix
+    body = b"0210" + struct.pack(">Q", 0x7234054128C28805)
+    msg = struct.pack(">H", len(body)) + body
+    proto, recs = infer_and_parse(msg, port_dst=8583)
+    assert proto == pb.ISO8583
+    assert recs[0].msg_type == 1 and recs[0].response_status == 1
+
+
+def someip_msg(mtype: int, return_code: int = 0, session: int = 9) -> bytes:
+    return (struct.pack(">HH", 0x1234, 0x0421)
+            + struct.pack(">I", 8)
+            + struct.pack(">HH", 0x0001, session)
+            + bytes([1, 1, mtype, return_code]))
+
+
+def test_someip_request_response():
+    proto, recs = infer_and_parse(someip_msg(0x00))
+    assert proto == pb.SOMEIP
+    assert recs[0].request_type == "REQUEST"
+    assert recs[0].endpoint == "0x1234/0x0421"
+    assert recs[0].request_id == 9
+    assert not recs[0].session_less
+
+    proto, recs = infer_and_parse(someip_msg(0x80, return_code=0))
+    assert recs[0].msg_type == 1 and recs[0].response_status == 1
+    # unknown-method error -> client error (some_ip.rs set_status)
+    proto, recs = infer_and_parse(someip_msg(0x81, return_code=3))
+    assert recs[0].response_status == 2
+    # generic error -> server error
+    proto, recs = infer_and_parse(someip_msg(0x81, return_code=11))
+    assert recs[0].response_status == 3
+    # fire-and-forget notification
+    proto, recs = infer_and_parse(someip_msg(0x02))
+    assert recs[0].session_less
+
+
+def test_someip_batched_segment():
+    """Back-to-back SOME/IP messages in one TCP segment all parse
+    (notification bursts coalesce)."""
+    batch = someip_msg(0x02, session=1) + someip_msg(0x02, session=2) \
+        + someip_msg(0x02, session=3)
+    proto, recs = infer_and_parse(batch)
+    assert proto == pb.SOMEIP
+    assert len(recs) == 3
+    assert [r.request_id for r in recs] == [1, 2, 3]
+
+
+def test_iso8583_requires_known_port():
+    """Digit-prefixed payloads on arbitrary ports must NOT pin ISO8583."""
+    msg = b"2100 OK metrics stream v1\r\n"
+    proto, _ = infer_and_parse(msg, port_dst=7777)
+    assert proto != pb.ISO8583
+
+
+def test_dameng_and_netsign_minimal():
+    dm = b"\x15\x00\x00\x00" + bytes([1]) + b"\x00" * 3 \
+        + struct.pack("<I", 64) + b"\x00" * 20 \
+        + b"SELECT id FROM t_user\x00" + b"\x00" * 42
+    proto, recs = infer_and_parse(dm, port_dst=5236)
+    assert proto == pb.DAMENG
+    assert recs[0].request_type == "SELECT"
+
+    ns = struct.pack(">I", 40) + b"\x00" * 4 + b"<op>sign</op>" + b"\x00" * 20
+    proto, recs = infer_and_parse(ns, port_dst=9989)
+    assert proto == pb.NETSIGN
+    assert recs[0].request_type == "sign"
+
+
+def test_http2_huffman_headers():
+    """Huffman-coded HPACK strings now decode (round-1 gap http.py:121)."""
+    from deepflow_tpu.agent.protocol_logs.http import Http2Parser
+
+    # literal header, huffman name ("custom-key") + huffman value
+    name = bytes.fromhex("25a849e95ba97d7f")
+    value = bytes.fromhex("25a849e95bb8e8b4bf")
+    block = (b"\x00" + bytes([0x80 | len(name)]) + name
+             + bytes([0x80 | len(value)]) + value)
+    # plus :method GET via static index 2
+    block = b"\x82" + block
+    frame = (len(block).to_bytes(3, "big") + bytes([1, 0x05])
+             + (1).to_bytes(4, "big") + block)
+    recs = Http2Parser().parse(frame)
+    assert recs and recs[0].request_type == "GET"
+    # huffman :path via literal with static name index 4 (:path)
+    path = bytes.fromhex("9d29ad171863c78f0b97c8e9ae82ae43d3")  # https://www.example.com
+    block2 = b"\x82" + b"\x44" + bytes([0x80 | len(path)]) + path
+    frame2 = (len(block2).to_bytes(3, "big") + bytes([1, 0x05])
+              + (1).to_bytes(4, "big") + block2)
+    recs = Http2Parser().parse(frame2)
+    assert recs and recs[0].endpoint == "https://www.example.com"
+
+
+def test_hpack_huffman_rfc_vectors():
+    from deepflow_tpu.agent.protocol_logs.hpack_huffman import huffman_decode
+    vectors = {
+        "f1e3c2e5f23a6ba0ab90f4ff": b"www.example.com",
+        "a8eb10649cbf": b"no-cache",
+        "25a849e95ba97d7f": b"custom-key",
+        "25a849e95bb8e8b4bf": b"custom-value",
+        "6402": b"302",
+        "aec3771a4b": b"private",
+        "d07abe941054d444a8200595040b8166e082a62d1bff":
+            b"Mon, 21 Oct 2013 20:13:21 GMT",
+        "9d29ad171863c78f0b97c8e9ae82ae43d3": b"https://www.example.com",
+        "640eff": b"307",
+    }
+    for hx, want in vectors.items():
+        assert huffman_decode(bytes.fromhex(hx)) == want
+    # corrupt: EOS mid-string must fail
+    assert huffman_decode(b"\xff\xff\xff\xff\xff") is None
