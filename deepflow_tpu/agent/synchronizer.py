@@ -76,6 +76,8 @@ class Synchronizer:
                                       "") or "default"
             req.agent_id = self.agent.config.agent_id
             req.config_version = self.config_version  # enables catch-up
+            req.config_epoch = self.config_epoch  # else every (re)connect
+            # looks epoch-stale and gets a spurious full-config replay
             try:
                 call = stream(req)
                 self._push_call = call

@@ -5,15 +5,19 @@ Sync — per-agent SyncResponse with versioned config + platform data) and
 trisolaris/services/grpc/agentsynchronize/process_info.go (GPID allocation).
 gRPC service methods are hand-registered (generic handlers) because the
 image has protoc but not grpcio-tools.
+
+Fleet scale: the server runs on grpc.aio — every Push stream is a coroutine
+awaiting a per-group condition, not a pinned thread, so thousands of agents
+hold push streams concurrently (the reference's pushmanager serves its
+fleet the same way; round 1's thread-pool design capped at 48).
 """
 
 from __future__ import annotations
 
+import asyncio
 import logging
-import queue
 import threading
 import time
-from concurrent import futures
 
 import grpc
 
@@ -45,7 +49,8 @@ class AgentRegistry:
         self._by_key: dict[tuple, dict] = {}
         self._next_id = 1
 
-    def register(self, ctrl_ip: str, hostname: str, agent_id: int) -> dict:
+    def register(self, ctrl_ip: str, hostname: str, agent_id: int,
+                 request: "pb.SyncRequest | None" = None) -> dict:
         key = (ctrl_ip, hostname)
         with self._lock:
             entry = self._by_key.get(key)
@@ -55,6 +60,7 @@ class AgentRegistry:
                     "ctrl_ip": ctrl_ip,
                     "hostname": hostname,
                     "first_seen_ns": time.time_ns(),
+                    "syncs": 0,
                 }
                 if not agent_id:
                     self._next_id += 1
@@ -62,6 +68,18 @@ class AgentRegistry:
                     self._next_id = max(self._next_id, agent_id + 1)
                 self._by_key[key] = entry
             entry["last_seen_ns"] = time.time_ns()
+            entry["syncs"] = entry.get("syncs", 0) + 1
+            if request is not None:
+                # health view for /v1/agents (reference: vtap list,
+                # cli/ctl/agent.go:49 — the primary fleet ops surface)
+                entry["state"] = int(request.state)
+                entry["exception_bitmap"] = int(request.exception_bitmap)
+                entry["degraded"] = bool(request.exception_bitmap)
+                entry["version"] = request.version
+                entry["cpu_usage"] = round(float(request.cpu_usage), 2)
+                entry["mem_bytes"] = int(request.mem_bytes)
+                entry["agent_group"] = request.agent_group or "default"
+                entry["config_version"] = int(request.config_version)
             return entry
 
     def list(self) -> list[dict]:
@@ -164,21 +182,27 @@ class Controller:
         self.configs = ConfigStore()
         self.host = host
         self.port = port
-        self._server: grpc.Server | None = None
+        self._aio_server = None
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._loop_thread: threading.Thread | None = None
+        self._loop_ready = threading.Event()
+        self._stop_evt: asyncio.Event | None = None
         # cluster-wide platform snapshot (genesis -> recorder analog)
         self._platform_lock = threading.Lock()
         self._platforms: dict[int, pb.PlatformData] = {}
         self._platform_version = 1
-        # push subscribers: (group, queue) per connected agent stream
-        self._push_lock = threading.Lock()
-        self._push_subs: list[tuple[str, "queue.Queue"]] = []
+        # push wake: per-group asyncio.Condition, owned by the loop thread;
+        # streams track their own last-sent version (newest-wins, no queues)
+        self._push_conds: dict[str, asyncio.Condition] = {}
+        self.push_streams = 0
         self.configs.subscribe(self._on_config_update)
 
     # -- rpc handlers ---------------------------------------------------------
 
     def Sync(self, request: pb.SyncRequest, context) -> pb.SyncResponse:
         entry = self.registry.register(
-            request.ctrl_ip, request.hostname, request.agent_id)
+            request.ctrl_ip, request.hostname, request.agent_id,
+            request=request)
         agent_id = entry["agent_id"]
         resp = pb.SyncResponse()
         resp.status = pb.SUCCESS
@@ -210,64 +234,64 @@ class Controller:
                  context) -> pb.GpidSyncResponse:
         return self.gpids.sync(request)
 
-    MAX_PUSH_STREAMS = 48  # worker pool is sized to keep unary headroom
+    def _push_cond(self, group: str) -> asyncio.Condition:
+        """Loop-thread only."""
+        cond = self._push_conds.get(group)
+        if cond is None:
+            cond = self._push_conds[group] = asyncio.Condition()
+        return cond
 
-    def Push(self, request: pb.SyncRequest, context):
+    async def Push(self, request: pb.SyncRequest, context):
         """Server-streaming: config-change notifications (reference:
         trisolaris push on version bump, sync_push.go pushmanager).
-        Yields a SyncResponse whenever the agent's group config changes;
-        replays the current config on subscribe when the agent is behind."""
+
+        Coroutine per stream, not thread per stream: each stream compares
+        its last-sent version against the store and awaits a shared
+        per-group condition — no stream cap, no per-stream queues to
+        overflow, newest-wins by construction."""
         group = request.agent_group or "default"
-        q: "queue.Queue" = queue.Queue(maxsize=16)
-        with self._push_lock:
-            if len(self._push_subs) >= self.MAX_PUSH_STREAMS:
-                # explicit status so agents back off instead of hammering
-                context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
-                              "push stream capacity reached")
-            self._push_subs.append((group, q))
+        sent_version = int(request.config_version)
+        sent_epoch = int(request.config_epoch)
+        cond = self._push_cond(group)
+        self.push_streams += 1
         try:
-            # catch-up: a reconnecting agent may have missed updates
-            cfg, version = self.configs.get(group)
-            if request.config_version != version:
-                resp = pb.SyncResponse()
-                resp.status = pb.SUCCESS
-                resp.user_config_yaml = cfg
-                resp.config_version = version
-                resp.config_epoch = self.configs.epoch
-                yield resp
-            while context.is_active():
-                try:
-                    resp = q.get(timeout=1.0)
-                except queue.Empty:
-                    continue
-                yield resp
+            while True:
+                cfg, version = self.configs.get(group)
+                if version != sent_version or \
+                        sent_epoch != self.configs.epoch:
+                    resp = pb.SyncResponse()
+                    resp.status = pb.SUCCESS
+                    resp.user_config_yaml = cfg
+                    resp.config_version = version
+                    resp.config_epoch = self.configs.epoch
+                    yield resp
+                    sent_version = version
+                    sent_epoch = self.configs.epoch
+                async with cond:
+                    try:
+                        await asyncio.wait_for(cond.wait(), timeout=5.0)
+                    except asyncio.TimeoutError:
+                        pass  # periodic re-check also covers missed wakes
         finally:
-            with self._push_lock:
-                try:
-                    self._push_subs.remove((group, q))
-                except ValueError:
-                    pass
+            self.push_streams -= 1
 
     def _on_config_update(self, group: str, yaml_bytes: bytes,
                           version: int) -> None:
-        resp = pb.SyncResponse()
-        resp.status = pb.SUCCESS
-        resp.user_config_yaml = yaml_bytes
-        resp.config_version = version
-        resp.config_epoch = self.configs.epoch
-        with self._push_lock:
-            subs = list(self._push_subs)
-        for sub_group, q in subs:
-            if sub_group == group:
-                try:
-                    q.put_nowait(resp)
-                except queue.Full:
-                    # keep the NEWEST config: drop one stale entry and retry
-                    try:
-                        q.get_nowait()
-                        q.put_nowait(resp)
-                    except (queue.Empty, queue.Full):
-                        pass
+        """Called from arbitrary threads (HTTP API); wake the loop."""
+        loop = self._loop
+        if loop is None or not loop.is_running():
+            return
+
+        def _notify() -> None:
+            cond = self._push_cond(group)
+
+            async def _do() -> None:
+                async with cond:
+                    cond.notify_all()
+
+            asyncio.ensure_future(_do())
+
+        loop.call_soon_threadsafe(_notify)
 
     def _ingest_platform(self, agent_id: int, p: pb.PlatformData) -> None:
         """Genesis upload -> platform snapshot + ingester tag table."""
@@ -298,13 +322,36 @@ class Controller:
     # -- server lifecycle -----------------------------------------------------
 
     def start(self) -> "Controller":
+        self._loop_thread = threading.Thread(
+            target=self._run_loop, name="df-controller-aio", daemon=True)
+        self._loop_thread.start()
+        if not self._loop_ready.wait(timeout=10):
+            raise RuntimeError("controller event loop failed to start")
+        log.info("controller sync up on :%d (aio)", self.port)
+        return self
+
+    def _run_loop(self) -> None:
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        try:
+            loop.run_until_complete(self._serve())
+        finally:
+            loop.close()
+
+    async def _serve(self) -> None:
+        async def sync_h(request, context):
+            return self.Sync(request, context)
+
+        async def gpid_h(request, context):
+            return self.GpidSync(request, context)
+
         handlers = {
             "Sync": grpc.unary_unary_rpc_method_handler(
-                self.Sync,
+                sync_h,
                 request_deserializer=pb.SyncRequest.FromString,
                 response_serializer=pb.SyncResponse.SerializeToString),
             "GpidSync": grpc.unary_unary_rpc_method_handler(
-                self.GpidSync,
+                gpid_h,
                 request_deserializer=pb.GpidSyncRequest.FromString,
                 response_serializer=pb.GpidSyncResponse.SerializeToString),
             "Push": grpc.unary_stream_rpc_method_handler(
@@ -314,19 +361,26 @@ class Controller:
         }
         generic = grpc.method_handlers_generic_handler(
             "deepflow_tpu.Synchronizer", handlers)
-        # each Push stream pins a worker for its lifetime: size the pool so
-        # MAX_PUSH_STREAMS streams still leave unary-RPC headroom
-        self._server = grpc.server(
-            futures.ThreadPoolExecutor(
-                max_workers=self.MAX_PUSH_STREAMS + 16))
-        self._server.add_generic_rpc_handlers((generic,))
-        self.port = self._server.add_insecure_port(
-            f"{self.host}:{self.port}")
-        self._server.start()
-        log.info("controller sync up on :%d", self.port)
-        return self
+        server = grpc.aio.server()
+        server.add_generic_rpc_handlers((generic,))
+        self.port = server.add_insecure_port(f"{self.host}:{self.port}")
+        await server.start()
+        self._aio_server = server
+        self._loop = asyncio.get_running_loop()
+        self._stop_evt = asyncio.Event()
+        self._loop_ready.set()
+        await self._stop_evt.wait()
+        await server.stop(grace=0.5)
 
     def stop(self) -> None:
-        if self._server:
-            self._server.stop(grace=0.5)
-            self._server = None
+        loop = self._loop
+        if loop is not None and loop.is_running() and \
+                self._stop_evt is not None:
+            loop.call_soon_threadsafe(self._stop_evt.set)
+        if self._loop_thread is not None:
+            self._loop_thread.join(timeout=5.0)
+            self._loop_thread = None
+        self._aio_server = None
+        self._loop = None
+        self._push_conds.clear()  # Conditions are bound to the dead loop
+        self._loop_ready.clear()

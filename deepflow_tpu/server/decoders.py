@@ -29,11 +29,13 @@ class Decoder:
     MSG_TYPE: MessageType
 
     def __init__(self, q: queue.Queue, db: Database,
-                 platform: PlatformInfoTable, exporters=None) -> None:
+                 platform: PlatformInfoTable, exporters=None,
+                 pod_index=None) -> None:
         self.q = q
         self.db = db
         self.platform = platform
         self.exporters = exporters
+        self.pod_index = pod_index  # K8s genesis IP->pod (optional)
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.stats = {"batches": 0, "rows": 0, "errors": 0}
@@ -145,6 +147,12 @@ class FlowLogDecoder(Decoder):
 
     MSG_TYPE = MessageType.L4_LOG
 
+    def _pod_of(self, ip_str: str) -> str:
+        if self.pod_index is None:
+            return ""
+        pod = self.pod_index.lookup(ip_str)
+        return pod.name if pod is not None else ""
+
     def handle(self, header: FrameHeader, payload: bytes) -> int:
         batch = pb.FlowLogBatch.FromString(payload)
         tags = self.platform.tags_for(header.agent_id)
@@ -152,11 +160,12 @@ class FlowLogDecoder(Decoder):
         if batch.l4:
             rows = []
             for f in batch.l4:
+                src_s, dst_s = _ip_str(f.key.ip_src), _ip_str(f.key.ip_dst)
                 rows.append({
                     "time": f.end_time_ns,
                     "flow_id": f.flow_id,
-                    "ip_src": _ip_str(f.key.ip_src),
-                    "ip_dst": _ip_str(f.key.ip_dst),
+                    "ip_src": src_s,
+                    "ip_dst": dst_s,
                     "ip4_src": _ip4_u32(f.key.ip_src),
                     "ip4_dst": _ip4_u32(f.key.ip_dst),
                     "port_src": f.key.port_src,
@@ -174,6 +183,8 @@ class FlowLogDecoder(Decoder):
                     "close_type": _close_type_idx(f.close_type),
                     "syn_count": f.syn_count, "synack_count": f.synack_count,
                     "gprocess_id_0": f.gpid_0, "gprocess_id_1": f.gpid_1,
+                    "pod_0": self._pod_of(src_s),
+                    "pod_1": self._pod_of(dst_s),
                     **tags,
                 })
             self.write("flow_log.l4_flow_log", rows)
@@ -181,11 +192,12 @@ class FlowLogDecoder(Decoder):
         if batch.l7:
             rows = []
             for f in batch.l7:
+                src_s, dst_s = _ip_str(f.key.ip_src), _ip_str(f.key.ip_dst)
                 rows.append({
                     "time": f.start_time_ns,
                     "flow_id": f.flow_id,
-                    "ip_src": _ip_str(f.key.ip_src),
-                    "ip_dst": _ip_str(f.key.ip_dst),
+                    "ip_src": src_s,
+                    "ip_dst": dst_s,
                     "port_src": f.key.port_src,
                     "port_dst": f.key.port_dst,
                     "l7_protocol": int(f.l7_protocol),
@@ -211,6 +223,8 @@ class FlowLogDecoder(Decoder):
                     "captured_request_byte": f.captured_request_byte,
                     "captured_response_byte": f.captured_response_byte,
                     "gprocess_id_0": f.gpid_0, "gprocess_id_1": f.gpid_1,
+                    "pod_0": self._pod_of(src_s),
+                    "pod_1": self._pod_of(dst_s),
                     "process_kname_0": f.process_kname_0,
                     "process_kname_1": f.process_kname_1,
                     "attrs": f.attrs_json,

@@ -1,0 +1,210 @@
+"""K8s genesis: list-watch the cluster resource model into the platform
+tables.
+
+Reference analog: agent/src/platform/kubernetes/api_watcher.rs (pod/node
+list-watch) + server/controller/genesis/genesis.go:54 (resource ingestion).
+Redesign: the watcher lives server-side (one watcher per cluster, not one
+per agent) and feeds the PodIpIndex used by the ingest decoders to tag both
+sides of every flow by IP. No kubernetes client library — raw HTTP against
+the apiserver with the in-cluster service-account token, list + watch with
+resourceVersion resume and bounded backoff.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import ssl
+import threading
+import urllib.request
+
+from deepflow_tpu.server.platform_info import PodInfo, PodIpIndex
+
+log = logging.getLogger("df.genesis")
+
+_SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+def in_cluster_config() -> tuple[str, str, str] | None:
+    """(api_base, token, ca_path) from the pod environment, or None."""
+    host = os.environ.get("KUBERNETES_SERVICE_HOST")
+    port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+    token_path = os.path.join(_SA_DIR, "token")
+    if not host or not os.path.exists(token_path):
+        return None
+    with open(token_path) as f:
+        token = f.read().strip()
+    ca = os.path.join(_SA_DIR, "ca.crt")
+    return (f"https://{host}:{port}", token,
+            ca if os.path.exists(ca) else "")
+
+
+class K8sGenesis:
+    """Pod list-watch -> PodIpIndex."""
+
+    def __init__(self, pod_index: PodIpIndex, api_base: str | None = None,
+                 token: str = "", ca_path: str = "",
+                 watch_timeout_s: int = 300,
+                 insecure_skip_verify: bool = False) -> None:
+        if api_base is None:
+            cfg = in_cluster_config()
+            if cfg is None:
+                raise RuntimeError("not in a cluster and no api_base given")
+            api_base, token, ca_path = cfg
+        self.api_base = api_base.rstrip("/")
+        self.token = token
+        self.watch_timeout_s = watch_timeout_s
+        self.pod_index = pod_index
+        self._ctx = None
+        if api_base.startswith("https"):
+            if ca_path:
+                self._ctx = ssl.create_default_context(cafile=ca_path)
+            elif insecure_skip_verify:
+                # explicit opt-in only: an unverified TLS channel carries
+                # the bearer token
+                log.warning("k8s genesis: TLS verification DISABLED "
+                            "(insecure_skip_verify)")
+                self._ctx = ssl._create_unverified_context()
+            else:
+                raise ValueError(
+                    "https api_base needs ca_path (or explicit "
+                    "insecure_skip_verify=True)")
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.resource_version = ""
+        self.stats = {"pods": 0, "events": 0, "relists": 0, "errors": 0}
+
+    # -- http -----------------------------------------------------------------
+
+    def _open(self, path: str, timeout: float):
+        req = urllib.request.Request(self.api_base + path)
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        return urllib.request.urlopen(req, timeout=timeout,
+                                      context=self._ctx)
+
+    # -- resource handling -----------------------------------------------------
+
+    @staticmethod
+    def _workload_of(pod: dict) -> str:
+        for ref in pod.get("metadata", {}).get("ownerReferences", []):
+            name = ref.get("name", "")
+            if ref.get("kind") == "ReplicaSet":
+                # strip the replicaset hash -> deployment name
+                return name.rsplit("-", 1)[0] if "-" in name else name
+            if ref.get("kind") in ("StatefulSet", "DaemonSet", "Job"):
+                return name
+        return ""
+
+    def _apply(self, event_type: str, pod: dict) -> None:
+        meta = pod.get("metadata", {})
+        status = pod.get("status", {})
+        ips = [e.get("ip") for e in status.get("podIPs", [])
+               if e.get("ip")]
+        if not ips and status.get("podIP"):
+            ips = [status["podIP"]]
+        info = PodInfo(
+            name=meta.get("name", ""),
+            namespace=meta.get("namespace", ""),
+            node=pod.get("spec", {}).get("nodeName", ""),
+            workload=self._workload_of(pod),
+            labels=meta.get("labels", {}) or {},
+        )
+        if event_type == "DELETED":
+            for ip in ips:
+                self.pod_index.remove_ip(ip)
+        else:  # ADDED | MODIFIED
+            for ip in ips:
+                self.pod_index.upsert(ip, info)
+
+    # -- list + watch ----------------------------------------------------------
+
+    def list_once(self) -> int:
+        """Full pod list; returns pod count. Sets the watch resume point
+        and RECONCILES: IPs whose pods vanished during a watch gap are
+        evicted (a relist is authoritative, not additive)."""
+        n = 0
+        cont = ""
+        seen_ips: set[str] = set()
+        while True:
+            path = "/api/v1/pods?limit=500"
+            if cont:
+                path += f"&continue={cont}"
+            with self._open(path, timeout=30) as r:
+                data = json.load(r)
+            for pod in data.get("items", []):
+                self._apply("ADDED", pod)
+                status = pod.get("status", {})
+                for e in status.get("podIPs", []):
+                    if e.get("ip"):
+                        seen_ips.add(e["ip"])
+                if status.get("podIP"):
+                    seen_ips.add(status["podIP"])
+                n += 1
+            meta = data.get("metadata", {})
+            self.resource_version = meta.get("resourceVersion",
+                                             self.resource_version)
+            cont = meta.get("continue", "")
+            if not cont:
+                break
+        self.pod_index.retain_ips(seen_ips)
+        self.stats["pods"] = n
+        return n
+
+    def watch_once(self) -> None:
+        """One watch connection; applies events until it ends."""
+        path = (f"/api/v1/pods?watch=1&allowWatchBookmarks=true"
+                f"&timeoutSeconds={self.watch_timeout_s}")
+        if self.resource_version:
+            path += f"&resourceVersion={self.resource_version}"
+        with self._open(path, timeout=self.watch_timeout_s + 30) as r:
+            for line in r:
+                if self._stop.is_set():
+                    return
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue
+                etype = ev.get("type", "")
+                obj = ev.get("object", {})
+                rv = obj.get("metadata", {}).get("resourceVersion")
+                if rv:
+                    self.resource_version = rv
+                if etype == "BOOKMARK":
+                    continue
+                if etype == "ERROR":
+                    # expired resourceVersion: force a relist
+                    self.resource_version = ""
+                    return
+                self._apply(etype, obj)
+                self.stats["events"] += 1
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> "K8sGenesis":
+        self._thread = threading.Thread(
+            target=self._run, name="df-k8s-genesis", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=3.0)
+
+    def _run(self) -> None:
+        backoff = 1.0
+        while not self._stop.is_set():
+            try:
+                if not self.resource_version:
+                    self.list_once()
+                    self.stats["relists"] += 1
+                self.watch_once()
+                backoff = 1.0
+            except Exception as e:
+                self.stats["errors"] += 1
+                log.debug("genesis watch error: %s", e)
+                if self._stop.wait(backoff):
+                    return
+                backoff = min(backoff * 2, 30.0)

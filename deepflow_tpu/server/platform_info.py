@@ -71,3 +71,55 @@ class PlatformInfoTable:
         if info is _EMPTY:
             return {"agent_id": agent_id}
         return info.tags()
+
+
+@dataclass
+class PodInfo:
+    """One K8s workload endpoint (genesis resource model entry)."""
+    name: str
+    namespace: str = ""
+    node: str = ""
+    workload: str = ""  # owning deployment/statefulset/daemonset name
+    labels: dict = field(default_factory=dict)
+
+
+class PodIpIndex:
+    """IP -> pod resource map fed by K8s genesis; queried per flow row to
+    tag BOTH sides of a connection (reference: genesis -> recorder ->
+    grpc_platformdata IP lookups)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._by_ip: dict[str, PodInfo] = {}
+        self.version = 0
+
+    def upsert(self, ip: str, pod: PodInfo) -> None:
+        if not ip:
+            return
+        with self._lock:
+            self._by_ip[ip] = pod
+            self.version += 1
+
+    def remove_ip(self, ip: str) -> None:
+        with self._lock:
+            if self._by_ip.pop(ip, None) is not None:
+                self.version += 1
+
+    def retain_ips(self, ips: set) -> int:
+        """Evict entries outside `ips` (relist reconciliation). Returns
+        the number removed."""
+        with self._lock:
+            dead = [ip for ip in self._by_ip if ip not in ips]
+            for ip in dead:
+                del self._by_ip[ip]
+            if dead:
+                self.version += 1
+            return len(dead)
+
+    def lookup(self, ip: str) -> PodInfo | None:
+        with self._lock:
+            return self._by_ip.get(ip)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._by_ip)

@@ -246,15 +246,33 @@ class QuerierAPI:
             tpu_table=self.db.table("profile.tpu_hlo_span"))}
 
     def agents(self) -> dict:
-        """Agent fleet listing (reference: deepflow-ctl agent list)."""
+        """Agent fleet listing with health (reference: deepflow-ctl agent
+        list / cli/ctl/agent.go:49 — staleness, exception bitmap, degraded
+        state are the primary ops signals)."""
         if self.controller is None:
             return {"agents": []}
-        out = [{
-            "agent_id": a["agent_id"],
-            "hostname": a["hostname"],
-            "ctrl_ip": a["ctrl_ip"],
-            "last_seen_ns": a.get("last_seen_ns", 0),
-        } for a in self.controller.registry.list()]
+        import time as _time
+        now = _time.time_ns()
+        out = []
+        for a in self.controller.registry.list():
+            staleness_s = (now - a.get("last_seen_ns", now)) / 1e9
+            out.append({
+                "agent_id": a["agent_id"],
+                "hostname": a["hostname"],
+                "ctrl_ip": a["ctrl_ip"],
+                "last_seen_ns": a.get("last_seen_ns", 0),
+                "staleness_s": round(staleness_s, 1),
+                "stale": staleness_s > 60.0,
+                "state": a.get("state", 0),
+                "exception_bitmap": a.get("exception_bitmap", 0),
+                "degraded": a.get("degraded", False),
+                "version": a.get("version", ""),
+                "cpu_usage": a.get("cpu_usage", 0.0),
+                "mem_bytes": a.get("mem_bytes", 0),
+                "agent_group": a.get("agent_group", "default"),
+                "config_version": a.get("config_version", 0),
+                "syncs": a.get("syncs", 0),
+            })
         return {"agents": out}
 
     def update_agent_config(self, body: dict) -> dict:

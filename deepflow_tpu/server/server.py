@@ -28,6 +28,9 @@ class Server:
                  ) -> None:
         self.db = Database(data_dir=data_dir)
         self.platform = PlatformInfoTable()
+        from deepflow_tpu.server.platform_info import PodIpIndex
+        self.pod_index = PodIpIndex()  # K8s genesis resource model
+        self.genesis = None            # started via start_genesis()
         self.receiver = Receiver(host=host, port=ingest_port)
         self.decoders = []
         self.controller = None
@@ -51,6 +54,19 @@ class Server:
         self.rollup = RollupJob(self.db)
         self._started = False
 
+    def start_genesis(self, api_base: str | None = None, token: str = "",
+                      ca_path: str = "") -> bool:
+        """Attach the K8s list-watch (in-cluster auto-config when args are
+        empty). Returns False when no cluster is reachable."""
+        from deepflow_tpu.server.genesis import K8sGenesis
+        try:
+            self.genesis = K8sGenesis(self.pod_index, api_base=api_base,
+                                      token=token, ca_path=ca_path).start()
+            return True
+        except RuntimeError as e:
+            log.info("k8s genesis not started: %s", e)
+            return False
+
     def _stats(self) -> dict:
         return {
             "receiver": dict(self.receiver.stats),
@@ -73,13 +89,17 @@ class Server:
         ]
         for cls, mtype in pairs:
             q = self.receiver.register(mtype)
-            d = cls(q, self.db, self.platform, exporters=self.exporters)
+            d = cls(q, self.db, self.platform, exporters=self.exporters,
+                    pod_index=self.pod_index)
             d.MSG_TYPE = mtype  # FlowLogDecoder serves two types
             self.decoders.append(d.start())
         self.receiver.start()
         self.http.start()
         self.rollup.start()
         self.alerts.start()
+        import os as _os
+        if _os.environ.get("KUBERNETES_SERVICE_HOST"):
+            self.start_genesis()  # in-cluster: watch automatically
         if self.controller:
             self.controller.start()
         self._started = True
@@ -88,6 +108,9 @@ class Server:
         return self
 
     def stop(self) -> None:
+        if self.genesis is not None:
+            self.genesis.stop()
+            self.genesis = None
         if not self._started:
             return
         self.receiver.stop()

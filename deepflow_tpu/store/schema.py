@@ -119,6 +119,8 @@ _table("flow_log.l4_flow_log", [
     C("synack_count", "u32"),
     C("gprocess_id_0", "u32"),
     C("gprocess_id_1", "u32"),
+    C("pod_0", "str"),              # K8s genesis: resource at ip_src
+    C("pod_1", "str"),              # K8s genesis: resource at ip_dst
     *UNIVERSAL_TAGS,
 ])
 
@@ -151,6 +153,8 @@ _table("flow_log.l7_flow_log", [
     C("syscall_trace_id_response", "u64"),
     C("syscall_thread_0", "u32"),
     C("syscall_thread_1", "u32"),
+    C("pod_0", "str"),              # K8s genesis: resource at ip_src
+    C("pod_1", "str"),              # K8s genesis: resource at ip_dst
     C("captured_request_byte", "u64"),
     C("captured_response_byte", "u64"),
     C("gprocess_id_0", "u32"),

@@ -214,7 +214,17 @@ class ColumnarTable:
             for fn in sorted(os.listdir(dirpath)):
                 if fn.startswith("chunk_") and fn.endswith(".npz"):
                     z = np.load(os.path.join(dirpath, fn))
-                    self._chunks.append({k: z[k] for k in z.files})
+                    ch = {k: z[k] for k in z.files}
+                    # additive schema compat: chunks persisted before a
+                    # column existed get the column's default (else any
+                    # query touching the new column KeyErrors)
+                    if ch:
+                        n = len(next(iter(ch.values())))
+                        for name, spec in self.columns.items():
+                            if name not in ch:
+                                ch[name] = np.full(n, spec.default,
+                                                   dtype=spec.np_dtype)
+                    self._chunks.append(ch)
             for name in self.dicts:
                 p = os.path.join(dirpath, f"dict_{name}.json")
                 if os.path.exists(p):

@@ -1,0 +1,255 @@
+"""Fleet-scale control plane: 1000 agents sync + hold push streams; K8s
+genesis list-watch feeds the pod IP index.
+
+Reference analogs: trisolaris sync_push.go:166 (pushmanager fan-out),
+agent/src/platform/kubernetes/api_watcher.rs + controller/genesis.
+"""
+
+import json
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+grpc = pytest.importorskip("grpc")
+
+from deepflow_tpu.proto import pb  # noqa: E402
+from deepflow_tpu.server.platform_info import PlatformInfoTable, \
+    PodIpIndex  # noqa: E402
+
+
+def _start_controller():
+    from deepflow_tpu.server.controller import Controller
+    return Controller(PlatformInfoTable(), host="127.0.0.1",
+                      port=0).start()
+
+
+def _sync_stub(channel):
+    return channel.unary_unary(
+        "/deepflow_tpu.Synchronizer/Sync",
+        request_serializer=pb.SyncRequest.SerializeToString,
+        response_deserializer=pb.SyncResponse.FromString)
+
+
+def _push_stub(channel):
+    return channel.unary_stream(
+        "/deepflow_tpu.Synchronizer/Push",
+        request_serializer=pb.SyncRequest.SerializeToString,
+        response_deserializer=pb.SyncResponse.FromString)
+
+
+def test_thousand_agents_sync_and_push():
+    """1000 simulated agents: all sync, all hold push streams (no 48 cap),
+    and all receive a config push."""
+    ctrl = _start_controller()
+    n_agents = 1000
+    channels, streams = [], []
+    try:
+        t0 = time.monotonic()
+        # 10 channels x 100 HTTP/2 streams
+        for c in range(10):
+            ch = grpc.insecure_channel(f"127.0.0.1:{ctrl.port}")
+            channels.append(ch)
+            sync = _sync_stub(ch)
+            push = _push_stub(ch)
+            for i in range(100):
+                agent_no = c * 100 + i
+                req = pb.SyncRequest(
+                    ctrl_ip=f"10.{agent_no >> 8}.{agent_no & 255}.1",
+                    hostname=f"sim-{agent_no}", version="2.0",
+                    cpu_usage=1.5, mem_bytes=1 << 20)
+                resp = sync(req, timeout=10)
+                assert resp.status == pb.SUCCESS
+                preq = pb.SyncRequest(
+                    ctrl_ip=req.ctrl_ip, hostname=req.hostname,
+                    config_version=resp.config_version,
+                    config_epoch=resp.config_epoch)
+                streams.append(push(preq, timeout=60))
+        sync_wall = time.monotonic() - t0
+        assert len(ctrl.registry.list()) == n_agents
+        # streams register lazily; poke until all are connected
+        deadline = time.monotonic() + 15
+        while ctrl.push_streams < n_agents and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert ctrl.push_streams == n_agents, ctrl.push_streams
+
+        # one config bump must reach every stream
+        ctrl.configs.update("default",
+                            b"profiler:\n  enabled: false\n")
+        t0 = time.monotonic()
+        got = 0
+        for s in streams:
+            msg = next(iter(s))
+            assert b"enabled: false" in msg.user_config_yaml
+            got += 1
+        push_wall = time.monotonic() - t0
+        assert got == n_agents
+        # bounds: the whole fan-out finishes promptly
+        assert sync_wall < 60 and push_wall < 60, (sync_wall, push_wall)
+    finally:
+        for s in streams:
+            s.cancel()
+        for ch in channels:
+            ch.close()
+        ctrl.stop()
+
+
+def test_agents_health_fields():
+    """/v1/agents exposes staleness, exception bitmap, degraded state."""
+    from deepflow_tpu.server.querier import QuerierAPI
+    from deepflow_tpu.store import Database
+    ctrl = _start_controller()
+    try:
+        ch = grpc.insecure_channel(f"127.0.0.1:{ctrl.port}")
+        sync = _sync_stub(ch)
+        sync(pb.SyncRequest(ctrl_ip="10.0.0.1", hostname="healthy",
+                            version="2.0", cpu_usage=2.5), timeout=5)
+        sync(pb.SyncRequest(ctrl_ip="10.0.0.2", hostname="sick",
+                            exception_bitmap=3, state=pb.AGENT_DEGRADED
+                            if hasattr(pb, "AGENT_DEGRADED") else 2),
+             timeout=5)
+        api = QuerierAPI(Database(), controller=ctrl)
+        agents = {a["hostname"]: a for a in api.agents()["agents"]}
+        assert agents["healthy"]["degraded"] is False
+        assert agents["healthy"]["cpu_usage"] == 2.5
+        assert agents["healthy"]["staleness_s"] < 5
+        assert agents["healthy"]["stale"] is False
+        assert agents["sick"]["exception_bitmap"] == 3
+        assert agents["sick"]["degraded"] is True
+        ch.close()
+    finally:
+        ctrl.stop()
+
+
+class _FakeK8s(BaseHTTPRequestHandler):
+    pods = []
+    watch_events = []
+
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        if "watch=1" in self.path:
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            for ev in self.watch_events:
+                self.wfile.write((json.dumps(ev) + "\n").encode())
+                self.wfile.flush()
+            # leave the stream open briefly, then close (client reconnects)
+            time.sleep(0.3)
+            return
+        body = json.dumps({
+            "kind": "PodList",
+            "metadata": {"resourceVersion": "100"},
+            "items": self.pods}).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def _pod(name, ns, ip, node="node-1", owner=None):
+    meta = {"name": name, "namespace": ns,
+            "resourceVersion": "101", "labels": {"app": name}}
+    if owner:
+        meta["ownerReferences"] = [owner]
+    return {"metadata": meta, "spec": {"nodeName": node},
+            "status": {"podIP": ip, "podIPs": [{"ip": ip}]}}
+
+
+def test_k8s_genesis_list_watch():
+    from deepflow_tpu.server.genesis import K8sGenesis
+    _FakeK8s.pods = [
+        _pod("web-6b7f9c-abc", "prod", "10.244.1.5",
+             owner={"kind": "ReplicaSet", "name": "web-6b7f9c"}),
+        _pod("db-0", "prod", "10.244.1.6",
+             owner={"kind": "StatefulSet", "name": "db"}),
+    ]
+    _FakeK8s.watch_events = [
+        {"type": "ADDED", "object": _pod("cache-1", "prod", "10.244.1.7")},
+        {"type": "DELETED", "object": _pod("db-0", "prod", "10.244.1.6")},
+    ]
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _FakeK8s)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    idx = PodIpIndex()
+    gen = K8sGenesis(idx, api_base=f"http://127.0.0.1:{srv.server_port}",
+                     watch_timeout_s=1).start()
+    try:
+        deadline = time.time() + 5
+        while time.time() < deadline and (
+                idx.lookup("10.244.1.7") is None
+                or idx.lookup("10.244.1.6") is not None):
+            time.sleep(0.05)
+        web = idx.lookup("10.244.1.5")
+        assert web is not None and web.name == "web-6b7f9c-abc"
+        assert web.workload == "web"       # replicaset hash stripped
+        assert web.namespace == "prod" and web.node == "node-1"
+        assert idx.lookup("10.244.1.7").name == "cache-1"  # watch ADDED
+        assert idx.lookup("10.244.1.6") is None            # watch DELETED
+        assert gen.stats["pods"] == 2
+    finally:
+        gen.stop()
+        srv.shutdown()
+
+
+def test_pod_tags_injected_into_flow_rows():
+    """Genesis resources tag BOTH flow sides by IP at ingest time."""
+    from deepflow_tpu.server import Server
+    from deepflow_tpu.agent.sender import UniformSender
+    from deepflow_tpu.agent.dispatcher import Dispatcher
+    from deepflow_tpu.agent.packet import TcpFlags, build_tcp
+
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0).start()
+    from deepflow_tpu.server.platform_info import PodInfo
+    server.pod_index.upsert("10.244.1.5", PodInfo("web-abc", "prod"))
+    server.pod_index.upsert("10.244.1.9", PodInfo("api-xyz", "prod"))
+    sender = UniformSender(
+        servers=[("127.0.0.1", server.ingest_port)]).start()
+    disp = Dispatcher(sender=sender, engine="python")
+    try:
+        disp.inject(build_tcp("10.244.1.5", "10.244.1.9", 40000, 80,
+                              TcpFlags.SYN, timestamp_ns=time.time_ns()))
+        disp.flush(force=True)
+        assert server.wait_for_rows("flow_log.l4_flow_log", 1, timeout=10)
+        from deepflow_tpu.query import execute
+        t = server.db.table("flow_log.l4_flow_log")
+        r = execute(t, "SELECT pod_0, pod_1 FROM t")
+        assert r.values[0] == ["web-abc", "api-xyz"]
+    finally:
+        sender.flush_and_stop()
+        server.stop()
+
+
+def test_genesis_relist_reconciles_deletions():
+    """A relist evicts IPs whose pods vanished during a watch gap."""
+    from deepflow_tpu.server.platform_info import PodInfo
+    idx = PodIpIndex()
+    idx.upsert("10.0.0.1", PodInfo("alive", "ns"))
+    idx.upsert("10.0.0.2", PodInfo("dead", "ns"))
+    removed = idx.retain_ips({"10.0.0.1"})
+    assert removed == 1
+    assert idx.lookup("10.0.0.1") is not None
+    assert idx.lookup("10.0.0.2") is None
+
+
+def test_old_chunks_survive_new_columns(tmp_path):
+    """Chunks persisted before a column existed load with defaults
+    (additive schema compat — pre-pod_0 data must not KeyError)."""
+    from deepflow_tpu.store.table import ColumnSpec, ColumnarTable
+    old = ColumnarTable("compat", [ColumnSpec("time", "u64"),
+                                   ColumnSpec("v", "f64")], chunk_rows=2)
+    old.append_columns({"time": [1, 2], "v": [1.0, 2.0]})
+    old.flush()
+    old.save(str(tmp_path))
+    new = ColumnarTable("compat", [ColumnSpec("time", "u64"),
+                                   ColumnSpec("v", "f64"),
+                                   ColumnSpec("added", "str")],
+                        chunk_rows=2)
+    new.load(str(tmp_path))
+    out = new.column_concat(["time", "added"])
+    assert out["time"].tolist() == [1, 2]
+    assert out["added"].tolist() == [0, 0]  # dict code 0 == ""
