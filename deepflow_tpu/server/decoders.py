@@ -147,15 +147,17 @@ class FlowLogDecoder(Decoder):
 
     MSG_TYPE = MessageType.L4_LOG
 
-    def _pod_of(self, ip_str: str) -> str:
-        if self.pod_index is None:
-            return ""
-        pod = self.pod_index.lookup(ip_str)
-        return pod.name if pod is not None else ""
-
     def handle(self, header: FrameHeader, payload: bytes) -> int:
         batch = pb.FlowLogBatch.FromString(payload)
         tags = self.platform.tags_for(header.agent_id)
+        # one snapshot per batch, not two lock round-trips per row
+        pods = (self.pod_index.snapshot()
+                if self.pod_index is not None else {})
+
+        def pod_of(ip_str: str) -> str:
+            pod = pods.get(ip_str)
+            return pod.name if pod is not None else ""
+
         n = 0
         if batch.l4:
             rows = []
@@ -183,8 +185,8 @@ class FlowLogDecoder(Decoder):
                     "close_type": _close_type_idx(f.close_type),
                     "syn_count": f.syn_count, "synack_count": f.synack_count,
                     "gprocess_id_0": f.gpid_0, "gprocess_id_1": f.gpid_1,
-                    "pod_0": self._pod_of(src_s),
-                    "pod_1": self._pod_of(dst_s),
+                    "pod_0": pod_of(src_s),
+                    "pod_1": pod_of(dst_s),
                     **tags,
                 })
             self.write("flow_log.l4_flow_log", rows)
@@ -223,8 +225,8 @@ class FlowLogDecoder(Decoder):
                     "captured_request_byte": f.captured_request_byte,
                     "captured_response_byte": f.captured_response_byte,
                     "gprocess_id_0": f.gpid_0, "gprocess_id_1": f.gpid_1,
-                    "pod_0": self._pod_of(src_s),
-                    "pod_1": self._pod_of(dst_s),
+                    "pod_0": pod_of(src_s),
+                    "pod_1": pod_of(dst_s),
                     "process_kname_0": f.process_kname_0,
                     "process_kname_1": f.process_kname_1,
                     "attrs": f.attrs_json,

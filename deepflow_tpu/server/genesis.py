@@ -204,7 +204,14 @@ class K8sGenesis:
                 backoff = 1.0
             except Exception as e:
                 self.stats["errors"] += 1
-                log.debug("genesis watch error: %s", e)
+                # first failure (and every 50th) at WARNING: an RBAC/token
+                # problem must be operator-visible, not debug-only
+                if self.stats["errors"] == 1 or \
+                        self.stats["errors"] % 50 == 0:
+                    log.warning("genesis watch error (#%d): %s",
+                                self.stats["errors"], e)
+                else:
+                    log.debug("genesis watch error: %s", e)
                 if self._stop.wait(backoff):
                     return
                 backoff = min(backoff * 2, 30.0)

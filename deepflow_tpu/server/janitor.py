@@ -1,0 +1,92 @@
+"""Retention janitor: periodic TTL enforcement over the embedded store.
+
+Reference analog: the ingester's ClickHouse TTLs (per-table retention set
+at DDL time) plus the flow_metrics datasource retention config. Embedded
+redesign: one thread walks the tables on an interval and drops whole
+sealed chunks older than each table's TTL (trim_before — CK partition
+drops, not row deletes). Trim counts surface in dfstats so drops are
+visible, never silent.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+log = logging.getLogger("df.janitor")
+
+# seconds; tables absent here are never trimmed (dictionaries, rollups
+# carry their own watermarks)
+DEFAULT_TTL_S = {
+    "flow_log.l4_flow_log": 3 * 86400,
+    "flow_log.l7_flow_log": 3 * 86400,
+    "profile.in_process_profile": 3 * 86400,
+    "profile.tpu_hlo_span": 3 * 86400,
+    "flow_metrics.network.1s": 1 * 86400,
+    "flow_metrics.application.1s": 1 * 86400,
+    "flow_metrics.network.1m": 7 * 86400,
+    "flow_metrics.application.1m": 7 * 86400,
+    "flow_metrics.network.1h": 30 * 86400,
+    "flow_metrics.application.1h": 30 * 86400,
+    "prometheus.samples": 7 * 86400,
+    "deepflow_system.deepflow_system": 7 * 86400,
+    "event.event": 7 * 86400,
+}
+
+
+class Janitor:
+    def __init__(self, db, ttl_s: dict | None = None,
+                 interval_s: float = 300.0) -> None:
+        self.db = db
+        self.ttl_s = dict(DEFAULT_TTL_S)
+        if ttl_s:
+            self.ttl_s.update(ttl_s)
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.stats = {"sweeps": 0, "rows_trimmed": 0}
+
+    def start(self) -> "Janitor":
+        self._thread = threading.Thread(
+            target=self._run, name="df-janitor", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2.0)
+
+    def sweep(self, now_s: float | None = None) -> int:
+        """One pass; returns rows trimmed."""
+        now = now_s if now_s is not None else time.time()
+        trimmed = 0
+        for name, ttl in self.ttl_s.items():
+            try:
+                table = self.db.table(name)
+            except KeyError:
+                # a typo'd TTL entry must be visible, not silently skipped
+                log.warning("janitor: no such table %r in TTL config", name)
+                continue
+            if "time" not in table.columns:
+                continue
+            # schema convention: u64 time = ns, u32 = epoch seconds
+            if table.columns["time"].kind == "u64":
+                cutoff = int((now - ttl) * 1e9)
+            else:
+                cutoff = int(now - ttl)
+            n = table.trim_before("time", cutoff)
+            if n:
+                log.info("janitor: trimmed %d rows from %s", n, name)
+            trimmed += n
+        self.stats["sweeps"] += 1
+        self.stats["rows_trimmed"] += trimmed
+        return trimmed
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.sweep()
+            except Exception:
+                log.exception("janitor sweep failed")

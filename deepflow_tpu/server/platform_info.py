@@ -120,6 +120,12 @@ class PodIpIndex:
         with self._lock:
             return self._by_ip.get(ip)
 
+    def snapshot(self) -> dict:
+        """Reference to the current mapping for batch reads (callers must
+        not mutate; dict reads are GIL-atomic, writers always REPLACE
+        values rather than mutating them in place)."""
+        return self._by_ip
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._by_ip)

@@ -51,7 +51,9 @@ class Server:
                               exporters=self.exporters, alerts=self.alerts)
         self.http = QuerierHTTP(self.api, host=host, port=query_port)
         from deepflow_tpu.server.datasource import RollupJob
+        from deepflow_tpu.server.janitor import Janitor
         self.rollup = RollupJob(self.db)
+        self.janitor = Janitor(self.db)
         self._started = False
 
     def start_genesis(self, api_base: str | None = None, token: str = "",
@@ -63,8 +65,10 @@ class Server:
             self.genesis = K8sGenesis(self.pod_index, api_base=api_base,
                                       token=token, ca_path=ca_path).start()
             return True
-        except RuntimeError as e:
-            log.info("k8s genesis not started: %s", e)
+        except (RuntimeError, ValueError) as e:
+            # ValueError: https without ca (e.g. serviceaccount ca.crt
+            # missing) — degrade to untagged flows, never abort server boot
+            log.warning("k8s genesis not started: %s", e)
             return False
 
     def _stats(self) -> dict:
@@ -72,6 +76,9 @@ class Server:
             "receiver": dict(self.receiver.stats),
             "decoders": {d.MSG_TYPE.name: dict(d.stats)
                          for d in self.decoders},
+            "janitor": dict(self.janitor.stats),
+            "genesis": (dict(self.genesis.stats)
+                        if self.genesis is not None else None),
         }
 
     def start(self) -> "Server":
@@ -96,6 +103,7 @@ class Server:
         self.receiver.start()
         self.http.start()
         self.rollup.start()
+        self.janitor.start()
         self.alerts.start()
         import os as _os
         if _os.environ.get("KUBERNETES_SERVICE_HOST"):
@@ -118,6 +126,7 @@ class Server:
             d.stop()
         self.http.stop()
         self.rollup.stop()
+        self.janitor.stop()
         self.alerts.stop()
         self.exporters.stop()
         if self.controller:

@@ -54,13 +54,18 @@ class Database:
     def save(self) -> None:
         if not self.data_dir:
             return
+        from deepflow_tpu.store import migration
         for name, t in self._tables.items():
             t.save(os.path.join(self.data_dir, name.replace(".", "/")))
+        migration.write_manifest(self.data_dir)
 
     def load(self) -> None:
-        if not self.data_dir:
+        if not self.data_dir or not os.path.isdir(self.data_dir):
             return
+        from deepflow_tpu.store import migration
+        migration.validate_loadable(self.data_dir)
+        version = migration.read_manifest_version(self.data_dir)
         for name, t in self._tables.items():
             d = os.path.join(self.data_dir, name.replace(".", "/"))
-            if os.path.isdir(d):
-                t.load(d)
+            if os.path.isdir(d) or os.path.isdir(d + ".old"):
+                t.load(d, from_version=version)

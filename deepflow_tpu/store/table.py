@@ -198,23 +198,68 @@ class ColumnarTable:
     # -- persistence (npz per chunk + dict json) -----------------------------
 
     def save(self, dirpath: str) -> None:
-        os.makedirs(dirpath, exist_ok=True)
-        for fn in os.listdir(dirpath):  # stale chunks must not resurrect
-            if fn.startswith("chunk_") and fn.endswith(".npz"):
-                os.unlink(os.path.join(dirpath, fn))
+        """Crash-safe: write everything into a staging dir, swap it into
+        place, keep the previous dir as .old until the swap completes — a
+        kill at ANY point leaves either the old or the new state loadable
+        (ckissu-style upgrade safety for the embedded store)."""
+        import shutil
+        staging = dirpath + ".staging"
+        old = dirpath + ".old"
+        shutil.rmtree(staging, ignore_errors=True)
+        os.makedirs(staging)
         chunks = self.snapshot()
         for i, ch in enumerate(chunks):
-            np.savez_compressed(os.path.join(dirpath, f"chunk_{i:06d}.npz"), **ch)
+            np.savez_compressed(
+                os.path.join(staging, f"chunk_{i:06d}.npz"), **ch)
         for name, d in self.dicts.items():
-            d.dump(os.path.join(dirpath, f"dict_{name}.json"))
+            d.dump(os.path.join(staging, f"dict_{name}.json"))
+        with open(os.path.join(staging, "_complete"), "w") as f:
+            f.write("1")
+        shutil.rmtree(old, ignore_errors=True)
+        if os.path.isdir(dirpath):
+            os.rename(dirpath, old)
+        os.rename(staging, dirpath)
+        shutil.rmtree(old, ignore_errors=True)
 
-    def load(self, dirpath: str) -> None:
+    @staticmethod
+    def recover_dir(dirpath: str) -> str | None:
+        """Pick the loadable directory after a possible mid-save crash.
+        Returns the path to load from, or None when nothing exists."""
+        import shutil
+        old = dirpath + ".old"
+        staging = dirpath + ".staging"
+        shutil.rmtree(staging, ignore_errors=True)  # never trust staging
+        have_dir = os.path.isdir(dirpath)
+        dir_complete = have_dir and (
+            os.path.exists(os.path.join(dirpath, "_complete"))
+            # legacy (round-1) saves predate the marker: complete iff no
+            # .old sibling suggests an interrupted swap
+            or not os.path.isdir(old))
+        if dir_complete:
+            shutil.rmtree(old, ignore_errors=True)
+            return dirpath
+        if os.path.isdir(old):
+            shutil.rmtree(dirpath, ignore_errors=True)
+            os.rename(old, dirpath)
+            return dirpath
+        return dirpath if have_dir else None
+
+    def load(self, dirpath: str, from_version: int | None = None) -> None:
+        from deepflow_tpu.store import migration
+        loadable = self.recover_dir(dirpath)
+        if loadable is None:
+            return
+        dirpath = loadable
         with self._lock:
             self._chunks = []
             for fn in sorted(os.listdir(dirpath)):
                 if fn.startswith("chunk_") and fn.endswith(".npz"):
                     z = np.load(os.path.join(dirpath, fn))
                     ch = {k: z[k] for k in z.files}
+                    if from_version is not None and \
+                            from_version < migration.SCHEMA_VERSION:
+                        ch = migration.migrate_chunk(self.name, ch,
+                                                     from_version)
                     # additive schema compat: chunks persisted before a
                     # column existed get the column's default (else any
                     # query touching the new column KeyErrors)

@@ -1,0 +1,137 @@
+"""Store schema migration, crash-safe save, TTL janitor.
+
+Reference analog: ingester/ckissu/ckissu.go:433 (versioned boot-time DDL
+upgrades) + ClickHouse table TTLs. VERDICT round-1 missing #7.
+"""
+
+import json
+import os
+import shutil
+import time
+
+import numpy as np
+import pytest
+
+from deepflow_tpu.store import migration
+from deepflow_tpu.store.db import Database
+from deepflow_tpu.store.table import ColumnarTable, ColumnSpec
+
+
+def _mk_table(cols):
+    return ColumnarTable("flow_log.l4_flow_log", cols, chunk_rows=4)
+
+
+def test_v1_dir_loads_into_v2_schema(tmp_path, monkeypatch):
+    """A v1-format dir (renamed + retyped + missing columns) loads into the
+    v2 schema through the migration chain."""
+    # v1 table: column 'latency' (u32) that v2 calls 'rtt' (u64)
+    v1 = ColumnarTable("t.demo", [ColumnSpec("time", "u64"),
+                                  ColumnSpec("latency", "u32")],
+                       chunk_rows=4)
+    v1.append_columns({"time": [1, 2], "latency": [10, 20]})
+    v1.flush()
+    d = str(tmp_path / "t" / "demo")
+    v1.save(d)
+    # no manifest -> read as v1
+    assert migration.read_manifest_version(str(tmp_path)) == 1
+
+    monkeypatch.setitem(migration.MIGRATIONS, 1, [
+        migration.Rename("t.demo", "latency", "rtt"),
+        migration.Retype("t.demo", "rtt", np.uint64),
+    ])
+    v2 = ColumnarTable("t.demo", [ColumnSpec("time", "u64"),
+                                  ColumnSpec("rtt", "u64"),
+                                  ColumnSpec("added", "str")],
+                       chunk_rows=4)
+    v2.load(d, from_version=1)
+    out = v2.column_concat(["time", "rtt", "added"])
+    assert out["rtt"].tolist() == [10, 20]
+    assert out["rtt"].dtype == np.uint64
+    assert out["added"].tolist() == [0, 0]  # additive backfill
+
+
+def test_manifest_written_and_version_gate(tmp_path):
+    db = Database(data_dir=str(tmp_path))
+    db.table("flow_log.l4_flow_log").append_rows(
+        [{"time": 1, "flow_id": 7}])
+    db.flush()
+    db.save()
+    mf = json.load(open(tmp_path / "MANIFEST.json"))
+    assert mf["schema_version"] == migration.SCHEMA_VERSION
+
+    # a FUTURE version must refuse to load (downgrade-unsafe)
+    json.dump({"schema_version": migration.SCHEMA_VERSION + 5},
+              open(tmp_path / "MANIFEST.json", "w"))
+    db2 = Database(data_dir=str(tmp_path))
+    with pytest.raises(RuntimeError):
+        db2.load()
+
+
+def test_crash_during_save_keeps_old_state(tmp_path):
+    """A kill mid-save leaves either old or new state loadable — never a
+    half-written directory."""
+    cols = [ColumnSpec("time", "u64"), ColumnSpec("v", "u32")]
+    d = str(tmp_path / "t")
+    t = ColumnarTable("t", cols, chunk_rows=2)
+    t.append_columns({"time": [1, 2], "v": [1, 2]})
+    t.flush()
+    t.save(d)
+
+    # crash scenario A: staging half-written, swap never happened
+    staging = d + ".staging"
+    os.makedirs(staging)
+    open(os.path.join(staging, "chunk_000000.npz"), "wb").write(b"garbage")
+    t2 = ColumnarTable("t", cols, chunk_rows=2)
+    t2.load(d)
+    assert t2.column_concat(["time"])["time"].tolist() == [1, 2]
+    assert not os.path.isdir(staging)  # staging never trusted, removed
+
+    # crash scenario B: old renamed away, new dir never moved in
+    t.save(d)  # healthy state again
+    os.rename(d, d + ".old")
+    t3 = ColumnarTable("t", cols, chunk_rows=2)
+    t3.load(d)
+    assert t3.column_concat(["time"])["time"].tolist() == [1, 2]
+    assert os.path.isdir(d) and not os.path.isdir(d + ".old")
+
+    # crash scenario C: new dir moved in but .old not yet removed
+    t.save(d)
+    shutil.copytree(d, d + ".old")
+    # dir has the _complete marker -> it wins, .old cleaned
+    t4 = ColumnarTable("t", cols, chunk_rows=2)
+    t4.load(d)
+    assert t4.column_concat(["time"])["time"].tolist() == [1, 2]
+    assert not os.path.isdir(d + ".old")
+
+
+def test_save_load_roundtrip_through_database(tmp_path):
+    db = Database(data_dir=str(tmp_path))
+    db.table("flow_log.l4_flow_log").append_rows(
+        [{"time": 5, "flow_id": 9, "ip_src": "1.2.3.4"}])
+    db.flush()
+    db.save()
+    db2 = Database(data_dir=str(tmp_path))
+    db2.load()
+    t = db2.table("flow_log.l4_flow_log")
+    out = t.column_concat(["flow_id"])
+    assert out["flow_id"].tolist() == [9]
+
+
+def test_janitor_trims_by_ttl():
+    from deepflow_tpu.server.janitor import Janitor
+    db = Database()
+    t = db.table("flow_log.l4_flow_log")
+    now = time.time()
+    old_ns = int((now - 10 * 86400) * 1e9)
+    new_ns = int(now * 1e9)
+    t.append_rows([{"time": old_ns, "flow_id": 1}] * 4)
+    t.flush()  # sealed chunk of old rows
+    t.append_rows([{"time": new_ns, "flow_id": 2}] * 2)
+    t.flush()
+    j = Janitor(db)
+    trimmed = j.sweep(now_s=now)
+    assert trimmed == 4
+    assert len(t) == 2
+    assert j.stats["rows_trimmed"] == 4
+    # drops are visible, not silent
+    assert j.stats["sweeps"] == 1
