@@ -179,6 +179,8 @@ class Controller:
         self.platform_table = platform_table
         self.registry = AgentRegistry()
         self.gpids = GpidAllocator()
+        from deepflow_tpu.server.prom_encoder import PromEncoder
+        self.prom_encoder = PromEncoder()
         self.configs = ConfigStore()
         self.host = host
         self.port = port
@@ -345,6 +347,9 @@ class Controller:
         async def gpid_h(request, context):
             return self.GpidSync(request, context)
 
+        async def prom_h(request, context):
+            return self.prom_encoder.handle(request)
+
         handlers = {
             "Sync": grpc.unary_unary_rpc_method_handler(
                 sync_h,
@@ -354,6 +359,10 @@ class Controller:
                 gpid_h,
                 request_deserializer=pb.GpidSyncRequest.FromString,
                 response_serializer=pb.GpidSyncResponse.SerializeToString),
+            "PromEncode": grpc.unary_unary_rpc_method_handler(
+                prom_h,
+                request_deserializer=pb.PromEncodeRequest.FromString,
+                response_serializer=pb.PromEncodeResponse.SerializeToString),
             "Push": grpc.unary_stream_rpc_method_handler(
                 self.Push,
                 request_deserializer=pb.SyncRequest.FromString,

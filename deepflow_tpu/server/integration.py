@@ -28,9 +28,20 @@ def _attr_map(attrs: list) -> dict:
 
 
 class IntegrationAPI:
-    def __init__(self, db: Database, exporters=None) -> None:
+    def __init__(self, db: Database, exporters=None,
+                 prom_encoder=None) -> None:
         self.db = db
         self.exporters = exporters
+        # SmartEncoding allocator: the controller's PromEncoder in a
+        # combined binary, a GrpcPromEncoderClient on remote ingest nodes,
+        # or a process-local PromEncoder standalone (ids still stable
+        # within the node)
+        if prom_encoder is None:
+            from deepflow_tpu.server.prom_encoder import PromEncoder
+            prom_encoder = PromEncoder()
+        self.prom_encoder = prom_encoder
+        self._known_set_ids: set[int] = set()
+        self._seeded = False
         self.stats = {"otlp_spans": 0, "profiles": 0, "app_logs": 0}
 
     def _write(self, table_name: str, rows: list[dict]) -> None:
@@ -130,9 +141,25 @@ class IntegrationAPI:
             series = _parse_write_request(data)
         except WireError as e:
             raise ValueError(f"not a WriteRequest: {e}") from None
+        if not self._seeded:
+            self.seed_from_store()
+        names = [name for name, _, _ in series]
+        sets_json = [json.dumps(labels, sort_keys=True)
+                     for _, labels, _ in series]
+        # SERIES identity = metric + labels: two metrics sharing a label
+        # set are different series and must not share a label_set_id
+        set_keys = [f"{n}|{ls}" for n, ls in zip(names, sets_json)]
+        metric_ids, set_ids = self.prom_encoder.encode(names, set_keys)
         rows = []
-        for name, labels, samples in series:
-            labels_json = json.dumps(labels, sort_keys=True)
+        dict_rows = []
+        now_s = int(time.time())
+        for (name, labels, samples), labels_json, mid, sid in zip(
+                series, sets_json, metric_ids, set_ids):
+            if sid not in self._known_set_ids:
+                self._known_set_ids.add(sid)
+                dict_rows.append({
+                    "time": now_s, "label_set_id": sid, "metric_id": mid,
+                    "metric_name": name, "labels_json": labels_json})
             for ts_ms, value in samples:
                 ts_s = int(ts_ms // 1000)
                 if not (0 <= ts_s < 2**32):
@@ -141,12 +168,44 @@ class IntegrationAPI:
                     "time": ts_s,
                     "metric_name": name,
                     "labels_json": labels_json,
+                    "metric_id": mid,
+                    "label_set_id": sid,
                     "value": value,
                 })
+        if dict_rows:
+            self.db.table("prometheus.label_sets").append_rows(dict_rows)
         self._write("prometheus.samples", rows)
         self.stats["prom_samples"] = self.stats.get("prom_samples", 0) \
             + len(rows)
         return {"accepted_samples": len(rows), "series": len(series)}
+
+    def seed_from_store(self) -> None:
+        """Restore encoder + dedup state from the persisted label_sets
+        table (idempotent; runs lazily on first ingest so it sees the
+        post-load table even though this object is built before load)."""
+        self._seeded = True
+        try:
+            t = self.db.table("prometheus.label_sets")
+        except KeyError:
+            return
+        if not len(t):
+            return
+        cols = t.column_concat(["label_set_id", "metric_id",
+                                "metric_name", "labels_json"])
+        metric_ids: dict[str, int] = {}
+        set_ids: dict[str, int] = {}
+        for sid, mid, mn, lj in zip(cols["label_set_id"],
+                                    cols["metric_id"],
+                                    cols["metric_name"],
+                                    cols["labels_json"]):
+            name = t.dicts["metric_name"].decode(int(mn))
+            labels = t.dicts["labels_json"].decode(int(lj))
+            metric_ids[name] = int(mid)
+            set_ids[f"{name}|{labels}"] = int(sid)
+            self._known_set_ids.add(int(sid))
+        seed = getattr(self.prom_encoder, "seed", None)
+        if seed is not None:  # grpc client view has no allocator to seed
+            seed(metric_ids, set_ids)
 
     # -- app logs (POST /api/v1/log) -----------------------------------------
 

@@ -1,0 +1,115 @@
+"""Prometheus SmartEncoding: cluster-wide metric/label-set id allocation.
+
+Reference analog: server/controller/prometheus/ (the label/metric id
+allocator served to agents+ingesters via message/trident.proto:11
+GetPrometheusLabelIDs). Redesign around the embedded store: the unit of
+encoding is the SERIES label set (one canonical json string), not each
+label name/value pair — our columnar dictionaries already dedup strings
+node-locally; what the control plane adds is that every ingest node gets
+the SAME id for the same series, so rows from different nodes join.
+
+Three pieces:
+- PromEncoder: the authoritative allocator (lives in the controller).
+- GrpcPromEncoderClient: remote ingest nodes' view, with a local cache so
+  steady-state ingest makes no RPCs.
+- Both expose encode(metric_names, label_sets) -> (metric_ids, set_ids).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from deepflow_tpu.proto import pb
+
+log = logging.getLogger("df.prom-encoder")
+
+
+class PromEncoder:
+    """Authoritative id allocator (controller-side)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metric_ids: dict[str, int] = {}
+        self._set_ids: dict[str, int] = {}
+        self._next_metric = 1
+        self._next_set = 1
+
+    def encode(self, metric_names: list[str],
+               label_sets: list[str]) -> tuple[list[int], list[int]]:
+        with self._lock:
+            mids = []
+            for name in metric_names:
+                mid = self._metric_ids.get(name)
+                if mid is None:
+                    mid = self._metric_ids[name] = self._next_metric
+                    self._next_metric += 1
+                mids.append(mid)
+            sids = []
+            for ls in label_sets:
+                sid = self._set_ids.get(ls)
+                if sid is None:
+                    sid = self._set_ids[ls] = self._next_set
+                    self._next_set += 1
+                sids.append(sid)
+            return mids, sids
+
+    def seed(self, metric_ids: dict[str, int],
+             set_ids: dict[str, int]) -> None:
+        """Restore allocator state from persisted tables at boot — the ids
+        on disk are forever; a restart must never re-allocate them."""
+        with self._lock:
+            self._metric_ids.update(metric_ids)
+            self._set_ids.update(set_ids)
+            if self._metric_ids:
+                self._next_metric = max(self._next_metric,
+                                        max(self._metric_ids.values()) + 1)
+            if self._set_ids:
+                self._next_set = max(self._next_set,
+                                     max(self._set_ids.values()) + 1)
+
+    # gRPC handler body (wired by the controller)
+    def handle(self, request: pb.PromEncodeRequest) -> pb.PromEncodeResponse:
+        mids, sids = self.encode(list(request.metric_names),
+                                 list(request.label_sets))
+        resp = pb.PromEncodeResponse()
+        resp.metric_ids.extend(mids)
+        resp.label_set_ids.extend(sids)
+        return resp
+
+
+class GrpcPromEncoderClient:
+    """Ingest-node view of the controller allocator, with a local cache
+    (ids are immutable once assigned, so the cache never invalidates)."""
+
+    METHOD = "/deepflow_tpu.Synchronizer/PromEncode"
+
+    def __init__(self, channel) -> None:
+        self._stub = channel.unary_unary(
+            self.METHOD,
+            request_serializer=pb.PromEncodeRequest.SerializeToString,
+            response_deserializer=pb.PromEncodeResponse.FromString)
+        self._lock = threading.Lock()
+        self._metric_cache: dict[str, int] = {}
+        self._set_cache: dict[str, int] = {}
+
+    def encode(self, metric_names: list[str],
+               label_sets: list[str]) -> tuple[list[int], list[int]]:
+        with self._lock:
+            miss_names = [n for n in set(metric_names)
+                          if n not in self._metric_cache]
+            miss_sets = [s for s in set(label_sets)
+                         if s not in self._set_cache]
+        if miss_names or miss_sets:
+            req = pb.PromEncodeRequest()
+            req.metric_names.extend(miss_names)
+            req.label_sets.extend(miss_sets)
+            resp = self._stub(req, timeout=10)
+            with self._lock:
+                for n, i in zip(miss_names, resp.metric_ids):
+                    self._metric_cache[n] = i
+                for s, i in zip(miss_sets, resp.label_set_ids):
+                    self._set_cache[s] = i
+        with self._lock:
+            return ([self._metric_cache[n] for n in metric_names],
+                    [self._set_cache[s] for s in label_sets])

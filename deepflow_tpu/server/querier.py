@@ -32,7 +32,11 @@ class QuerierAPI:
         self.exporters = exporters
         self.alerts = alerts
         from deepflow_tpu.server.integration import IntegrationAPI
-        self.integration = IntegrationAPI(db, exporters=exporters)
+        # combined binary: ingest shares the controller's authoritative
+        # SmartEncoding allocator; standalone: process-local allocator
+        self.integration = IntegrationAPI(
+            db, exporters=exporters,
+            prom_encoder=getattr(controller, "prom_encoder", None))
         from deepflow_tpu.server.mcp import McpServer
         self.mcp = McpServer(self)
 

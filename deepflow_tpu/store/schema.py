@@ -234,8 +234,19 @@ _table("prometheus.samples", [
     C("time", "u32"),                   # epoch seconds (remote-write ms / 1000)
     C("metric_name", "str"),
     C("labels_json", "str"),
+    C("metric_id", "u32"),              # SmartEncoding: cluster-wide id
+    C("label_set_id", "u32"),           # cluster-wide series id
     C("value", "f64"),
     *UNIVERSAL_TAGS,
+])
+
+# the id -> label-set join table (reference: controller/prometheus dicts)
+_table("prometheus.label_sets", [
+    C("time", "u32"),                   # first-seen epoch seconds
+    C("label_set_id", "u32"),
+    C("metric_id", "u32"),
+    C("metric_name", "str"),
+    C("labels_json", "str"),
 ])
 
 # -- self telemetry --------------------------------------------------------
