@@ -212,29 +212,41 @@ def main() -> None:
         chain, params, opt_state, tokens, reps)
     sampler.stop()
 
-    # second headline dimension: HLO spans/sec captured by the TPU probe
-    # (xplane duty cycle) while the loop keeps training
+    # second headline dimension: step-adaptive continuous capture — the
+    # probe sizes its own windows from the observed step cadence targeting
+    # 50% step coverage; we report achieved coverage AND the overhead it
+    # adds to the training loop
     span_events = []
     spans_wall = 0.0
+    adaptive = None
     try:
         from deepflow_tpu.tpuprobe.sources import XPlaneSource
-        src = XPlaneSource(span_events.extend, interval_s=999,
-                           duration_ms=1500)
+        adaptive = XPlaneSource(span_events.extend, interval_s=2.0,
+                                duration_ms=1000, target_coverage=0.5,
+                                steps_per_capture=10)
     except ImportError:
-        src = None
-    if src is not None:
+        pass
+    cov_times: list[float] = []
+    if adaptive is not None:
+        adaptive.start()
         t0 = time.perf_counter()
-        import threading
-        cap = threading.Thread(target=src.capture_once, daemon=True)
-        cap.start()
-        while cap.is_alive():
+        # train through several adaptive windows; on fast loops keep going
+        # until at least one capture has actually covered the workload
+        reps = 0
+        while reps < 12 or (adaptive.stats["captures"] == 0
+                            and time.perf_counter() - t0 < 30):
+            t1 = time.perf_counter()
             params, opt_state, loss = chain(params, opt_state, tokens)
             jax.device_get(loss)
-        cap.join()
+            cov_times.append(time.perf_counter() - t1)
+            reps += 1
         spans_wall = time.perf_counter() - t0
+        adaptive.stop()
     device_spans = [e for e in span_events if e.hlo_op]
     hlo_spans_per_s = (len(device_spans) / spans_wall) if spans_wall else 0.0
     device_time_ns = sum(e.duration_ns for e in device_spans)
+    covered_step = ((statistics.median(cov_times) - rtt) / k_steps
+                    if cov_times else 0.0)
 
     base_step = (statistics.median(base) - rtt) / k_steps
     prof_step = (statistics.median(prof) - rtt) / k_steps
@@ -259,6 +271,17 @@ def main() -> None:
             "hlo_spans_per_s": round(hlo_spans_per_s, 1),
             "hlo_spans_captured": len(device_spans),
             "hlo_device_time_ms": round(device_time_ns / 1e6, 1),
+            "xplane_coverage_pct": (adaptive.stats["coverage_pct"]
+                                    if adaptive else 0.0),
+            "xplane_captures": (adaptive.stats["captures"]
+                                if adaptive else 0),
+            "xplane_contended": (adaptive.stats["contended"]
+                                 if adaptive else 0),
+            "xplane_est_step_ms": (adaptive.stats["est_step_ms"]
+                                   if adaptive else 0.0),
+            "xplane_overhead_pct": (
+                round(max(0.0, (covered_step - base_step) / base_step
+                          * 100.0), 3) if cov_times else 0.0),
             **_bench_packet_path(),
             **_bench_extprofiler(),
         },

@@ -29,8 +29,12 @@ class ProfilerConfig:
 class TpuProbeConfig:
     enabled: bool = True
     source: str = "auto"          # auto | xplane | hooks | sim
-    trace_interval_s: float = 10.0  # xplane capture cadence
+    trace_interval_s: float = 10.0  # fallback cadence before steps observed
     trace_duration_ms: int = 1000
+    # step-adaptive duty cycle: windows sized to whole steps, gaps sized so
+    # this fraction of ALL steps is captured
+    target_coverage: float = 0.5
+    steps_per_capture: int = 20
 
 
 @dataclass
@@ -126,6 +130,10 @@ class AgentConfig:
         num(self.profiler.memory_interval_s, "profiler.memory_interval_s", 1)
         num(self.tpuprobe.trace_interval_s, "tpuprobe.trace_interval_s", 0.1)
         num(self.tpuprobe.trace_duration_ms, "tpuprobe.trace_duration_ms", 1)
+        num(self.tpuprobe.target_coverage, "tpuprobe.target_coverage",
+            0.01, 0.95)
+        num(self.tpuprobe.steps_per_capture, "tpuprobe.steps_per_capture",
+            1, 10_000)
         num(self.stats_interval_s, "stats_interval_s", 0.1)
         num(self.sync_interval_s, "sync_interval_s", 0.1)
         num(self.guard.max_cpu_pct, "guard.max_cpu_pct", 1)

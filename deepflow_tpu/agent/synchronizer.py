@@ -224,6 +224,12 @@ class Synchronizer:
                     if hasattr(src, "interval_s"):
                         src.interval_s = new.tpuprobe.trace_interval_s
                         src.duration_ms = new.tpuprobe.trace_duration_ms
+                    if hasattr(src, "target_coverage"):
+                        # the adaptive cadence's operator throttle
+                        src.target_coverage = min(max(
+                            new.tpuprobe.target_coverage, 0.05), 0.95)
+                        src.steps_per_capture = \
+                            new.tpuprobe.steps_per_capture
         log.info("applied pushed config v%d", version)
 
     def gpid_sync(self, entries: list[pb.GpidEntry]) -> pb.GpidSyncResponse:

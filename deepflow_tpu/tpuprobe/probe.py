@@ -35,7 +35,9 @@ class TpuProbe:
             self.sources.append(XPlaneSource(
                 self._sink,
                 interval_s=self.cfg.trace_interval_s,
-                duration_ms=self.cfg.trace_duration_ms).start())
+                duration_ms=self.cfg.trace_duration_ms,
+                target_coverage=self.cfg.target_coverage,
+                steps_per_capture=self.cfg.steps_per_capture).start())
             self.sources.append(HooksSource(self._sink).start())
         elif mode == "hooks":
             self.sources.append(HooksSource(self._sink).start())

@@ -25,22 +25,53 @@ from deepflow_tpu.tpuprobe.xplane import parse_xplane_file
 log = logging.getLogger("df.tpuprobe")
 
 
-class XPlaneSource:
-    """Periodic jax.profiler trace capture from inside the workload process.
+# jax.profiler's trace session is a process-global singleton: our own
+# capture must never collide with a second source in this process, and a
+# session started by USER code must make us skip, not crash
+_PROFILER_SESSION_LOCK = threading.Lock()
 
-    Zero-code stance mirrors the reference's continuous profiler: attach,
-    sample on a duty cycle, ship folded results. Only activates when the
-    process has already imported jax (never steals the TPU from others).
+
+class XPlaneSource:
+    """Step-adaptive jax.profiler trace capture from inside the workload.
+
+    Zero-code stance mirrors the reference's continuous profiler (attach,
+    sample, ship) — but where round 1 used a fixed 1s-per-10s wall-clock
+    duty cycle (10% of the device timeline, stalls between windows
+    invisible), this version sizes itself from the workload: each capture
+    measures the step cadence from its own XLA-module spans, the next
+    window is sized to cover `steps_per_capture` whole steps, and the gap
+    is set so `target_coverage` of ALL steps are captured (default 50%).
+    No per-step jax.monitoring event exists for cached executions, so the
+    cadence estimate comes from the trace itself.
+
+    Contention guard: jax.profiler's session is a process-global singleton
+    — a window that collides with user profiling (or another source) is
+    skipped and counted, never raised.
     """
 
     def __init__(self, sink, interval_s: float = 10.0,
-                 duration_ms: int = 1000) -> None:
+                 duration_ms: int = 1000,
+                 target_coverage: float = 0.5,
+                 steps_per_capture: int = 20,
+                 min_duration_ms: int = 200,
+                 max_duration_ms: int = 4000,
+                 min_gap_ms: int = 200) -> None:
         self.sink = sink
-        self.interval_s = interval_s
+        self.interval_s = interval_s        # fallback cadence (no steps yet)
         self.duration_ms = duration_ms
+        self.target_coverage = min(max(target_coverage, 0.05), 0.95)
+        self.steps_per_capture = steps_per_capture
+        self.min_duration_ms = min_duration_ms
+        self.max_duration_ms = max_duration_ms
+        self.min_gap_ms = min_gap_ms
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
-        self.stats = {"captures": 0, "events": 0, "errors": 0, "skipped": 0}
+        self._step_time_s = 0.0             # estimated from module spans
+        self._captured_s = 0.0
+        self._started_monotonic = time.monotonic()
+        self.stats = {"captures": 0, "events": 0, "errors": 0, "skipped": 0,
+                      "contended": 0, "steps_seen": 0,
+                      "coverage_pct": 0.0, "est_step_ms": 0.0}
 
     def available(self) -> bool:
         import sys
@@ -62,10 +93,12 @@ class XPlaneSource:
     def stop(self) -> None:
         self._stop.set()
         if self._thread:
-            self._thread.join(timeout=max(2.0, self.duration_ms / 1000 + 2))
+            # adaptive windows run up to max_duration_ms, plus parse+sink
+            self._thread.join(timeout=max(2.0, self.duration_ms / 1000 + 2,
+                                          self.max_duration_ms / 1000 + 4))
 
     def _run(self) -> None:
-        # first capture soon after attach, then on the interval
+        # first capture soon after attach, then on the adaptive cadence
         if self._stop.wait(1.0):
             return
         while not self._stop.is_set():
@@ -77,29 +110,95 @@ class XPlaneSource:
                     log.exception("xplane capture failed")
             else:
                 self.stats["skipped"] += 1
-            if self._stop.wait(self.interval_s):
+            if self._stop.wait(self._next_gap_s()):
                 return
+
+    def _next_duration_s(self) -> float:
+        """Window sized to cover `steps_per_capture` whole steps."""
+        if self._step_time_s <= 0:
+            return self.duration_ms / 1000.0
+        want = self._step_time_s * self.steps_per_capture
+        return min(max(want, self.min_duration_ms / 1000.0),
+                   self.max_duration_ms / 1000.0)
+
+    def _next_gap_s(self) -> float:
+        """Gap between windows for the target step coverage:
+        coverage = duration / (duration + gap)."""
+        if self._step_time_s <= 0:
+            return self.interval_s  # cadence unknown: conservative fallback
+        dur = self._next_duration_s()
+        gap = dur * (1.0 / self.target_coverage - 1.0)
+        return max(gap, self.min_gap_ms / 1000.0)
+
+    def _observe(self, events: list, wall_s: float) -> None:
+        """Update the step-cadence estimate from a capture's module spans."""
+        steps = {(e.hlo_module, e.run_id) for e in events
+                 if e.run_id and not e.hlo_op}
+        n = len(steps)
+        self.stats["steps_seen"] += n
+        if n >= 2 and wall_s > 0:
+            est = wall_s / n
+            # EWMA: workloads change phase (compile, eval, checkpoints)
+            self._step_time_s = (est if self._step_time_s <= 0 else
+                                 0.5 * self._step_time_s + 0.5 * est)
+            self.stats["est_step_ms"] = round(self._step_time_s * 1000, 2)
+        elapsed = time.monotonic() - self._started_monotonic
+        if elapsed > 0:
+            self.stats["coverage_pct"] = round(
+                100.0 * self._captured_s / elapsed, 1)
 
     def capture_once(self) -> list[TpuSpanEvent]:
         import jax
 
+        if not _PROFILER_SESSION_LOCK.acquire(blocking=False):
+            self.stats["contended"] += 1
+            return []
         tmpdir = tempfile.mkdtemp(prefix="dftpu-xplane-")
         t0_ns = time.time_ns()
+        t0 = time.monotonic()
         try:
-            jax.profiler.start_trace(tmpdir)
+            try:
+                # device planes are all we parse: host/python tracers only
+                # add overhead to the workload while the window is open
+                opts = None
+                try:
+                    opts = jax.profiler.ProfileOptions()
+                    opts.host_tracer_level = 0
+                    opts.python_tracer_level = 0
+                    opts.enable_hlo_proto = False
+                except (AttributeError, ImportError):
+                    pass  # older jax: default options
+                if opts is not None:
+                    jax.profiler.start_trace(tmpdir, profiler_options=opts)
+                else:
+                    jax.profiler.start_trace(tmpdir)
+            except Exception as e:
+                # only a genuinely-busy singleton counts as contention;
+                # a broken profiler must stay loud (errors + log)
+                if "already" in str(e).lower() or \
+                        "in progress" in str(e).lower():
+                    self.stats["contended"] += 1
+                else:
+                    self.stats["errors"] += 1
+                    log.exception("xplane start_trace failed")
+                return []
             # sleep through the window; workload threads keep running
-            self._stop.wait(self.duration_ms / 1000.0)
+            self._stop.wait(self._next_duration_s())
             jax.profiler.stop_trace()
+            wall_s = time.monotonic() - t0
+            self._captured_s += wall_s
             events: list[TpuSpanEvent] = []
             for path in glob.glob(
                     os.path.join(tmpdir, "plugins/profile/*/*.xplane.pb")):
                 events.extend(parse_xplane_file(path, capture_start_ns=t0_ns))
             self.stats["captures"] += 1
             self.stats["events"] += len(events)
+            self._observe(events, wall_s)
             if events:
                 self.sink(events)
             return events
         finally:
+            _PROFILER_SESSION_LOCK.release()
             shutil.rmtree(tmpdir, ignore_errors=True)
 
 

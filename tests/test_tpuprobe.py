@@ -144,3 +144,41 @@ def test_hooks_source_stop_unregisters():
     # idempotent
     src.stop()
     assert len(monitoring.get_event_duration_listeners()) == before
+
+
+def test_xplane_adaptive_duty_cycle():
+    """Windows size to whole steps; gaps target the coverage fraction."""
+    from deepflow_tpu.tpuprobe.events import TpuSpanEvent
+    from deepflow_tpu.tpuprobe.sources import XPlaneSource
+
+    src = XPlaneSource(lambda e: None, target_coverage=0.5,
+                       steps_per_capture=10)
+    # before any steps observed: fallback cadence
+    assert src._next_gap_s() == src.interval_s
+    # observe a capture with 20 module launches over 1s -> 50ms steps
+    evs = [TpuSpanEvent(start_ns=i, duration_ns=1, hlo_module="jit_step",
+                        run_id=100 + i) for i in range(20)]
+    src._observe(evs, wall_s=1.0)
+    assert src.stats["est_step_ms"] == 50.0
+    # duration covers 10 whole steps, gap gives 50% coverage
+    assert abs(src._next_duration_s() - 0.5) < 1e-6
+    assert abs(src._next_gap_s() - 0.5) < 1e-6
+    # 10% coverage -> gap is 9x the window
+    src.target_coverage = 0.1
+    assert abs(src._next_gap_s() - 4.5) < 1e-6
+
+
+def test_xplane_contention_guard():
+    """A second source (or user profiling) never collides — the window is
+    skipped and counted."""
+    from deepflow_tpu.tpuprobe import sources as S
+
+    src = S.XPlaneSource(lambda e: None)
+    assert S._PROFILER_SESSION_LOCK.acquire(blocking=False)
+    try:
+        out = src.capture_once()
+        assert out == []
+        assert src.stats["contended"] == 1
+        assert src.stats["captures"] == 0
+    finally:
+        S._PROFILER_SESSION_LOCK.release()
