@@ -42,6 +42,13 @@ class Agent:
         self.dispatcher = None
         self.live_capture = None
         self.sslprobe = None
+        from deepflow_tpu.agent.labeler import AclRule, Labeler
+        self.labeler = Labeler()
+        self.labeler.load_acls([
+            AclRule(cidr=a.get("cidr", ""), port=int(a.get("port", 0)),
+                    protocol=int(a.get("protocol", 0)),
+                    action=a.get("action", "trace"))
+            for a in getattr(self.config, "acls", [])])
         self._stats_thread: threading.Thread | None = None
         self._stop = threading.Event()
         self._components: list[str] = []
@@ -161,7 +168,8 @@ class Agent:
             from deepflow_tpu.agent.dispatcher import Dispatcher
             self.dispatcher = Dispatcher(
                 sender=self.sender,
-                agent_id=self.config.agent_id).start()
+                agent_id=self.config.agent_id,
+                labeler=self.labeler).start()
         if self.config.sslprobe_sock:
             from deepflow_tpu.agent.sslprobe import SslProbeListener
             self.sslprobe = SslProbeListener(

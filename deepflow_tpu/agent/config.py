@@ -74,6 +74,9 @@ class AgentConfig:
     # AF_UNIX path for the LD_PRELOAD ssl/syscall probe (pre-encryption L7
     # visibility); "" = disabled
     sslprobe_sock: str = ""
+    # agent-side ACLs (reference: policy first_path rules): list of dicts
+    # {cidr, port, protocol, action: trace|ignore}
+    acls: list = field(default_factory=list)
     group: str = "default"        # agent-group for config routing
     controller: str = ""          # host:port; empty = standalone mode
     standalone: bool = True
@@ -139,6 +142,19 @@ class AgentConfig:
         num(self.guard.max_cpu_pct, "guard.max_cpu_pct", 1)
         num(self.guard.max_mem_mb, "guard.max_mem_mb", 16)
         num(self.guard.check_interval_s, "guard.check_interval_s", 0.1)
+        import ipaddress as _ipaddr
+        for i, a in enumerate(self.acls):
+            if not isinstance(a, dict):
+                raise ValueError(f"acls[{i}] must be a mapping, got {a!r}")
+            if a.get("action", "trace") not in ("trace", "ignore"):
+                raise ValueError(f"acls[{i}].action must be trace|ignore")
+            if a.get("cidr"):
+                try:
+                    _ipaddr.ip_network(a["cidr"], strict=False)
+                except ValueError as e:
+                    raise ValueError(f"acls[{i}].cidr invalid: {e}") from None
+            num(a.get("port", 0), f"acls[{i}].port", 0, 65535)
+            num(a.get("protocol", 0), f"acls[{i}].protocol", 0, 3)
         if self.tpuprobe.source not in ("auto", "xplane", "hooks", "sim"):
             raise ValueError(
                 f"tpuprobe.source must be auto|xplane|hooks|sim, "

@@ -105,8 +105,10 @@ class Dispatcher:
 
     def __init__(self, sender=None, agent_id: int = 0,
                  flush_interval_s: float = 1.0,
-                 batch_size: int = 256, engine: str = "auto") -> None:
+                 batch_size: int = 256, engine: str = "auto",
+                 labeler=None) -> None:
         self.sender = sender
+        self.labeler = labeler  # agent-side policy/labeler (optional)
         self.batch_size = batch_size
         self.flush_interval_s = flush_interval_s
         self._l4_buf: list[pb.L4FlowLog] = []
@@ -114,7 +116,7 @@ class Dispatcher:
         self.quadruple = QuadrupleGenerator(self._emit_docs)
         self.flow_map = FlowMap(
             on_l4_log=self._on_l4, on_l7_log=self._on_l7,
-            on_flow_update=self.quadruple.add_flow, agent_id=agent_id)
+            on_flow_update=self._on_flow_update, agent_id=agent_id)
         # native engine for raw-frame sources (ring capture, raw pcap
         # replay); MetaPacket injection keeps the Python map — disjoint key
         # spaces, shared output callbacks
@@ -124,7 +126,7 @@ class Dispatcher:
                 from deepflow_tpu.agent.native_flow import NativeFlowMap
                 self.native_map = NativeFlowMap(
                     on_l4_log=self._on_l4, on_l7_log=self._on_l7,
-                    on_flow_update=self.quadruple.add_flow,
+                    on_flow_update=self._on_flow_update,
                     agent_id=agent_id)
             except Exception as e:
                 if engine == "native":
@@ -136,14 +138,46 @@ class Dispatcher:
 
     # -- pipeline callbacks ----------------------------------------------------
 
+    def _label(self, node: FlowNode):
+        """-> (src_label, dst_label, action) or (None, None, 'trace')."""
+        if self.labeler is None:
+            return None, None, "trace"
+        return self.labeler.label_flow(node.ip_src, node.ip_dst,
+                                       node.port_src, node.port_dst,
+                                       node.protocol)
+
+    def _on_flow_update(self, node: FlowNode, closed: bool) -> None:
+        # ACL-ignored traffic is invisible EVERYWHERE: logs AND metrics
+        if self._label(node)[2] == "ignore":
+            return
+        self.quadruple.add_flow(node, closed)
+
     def _on_l4(self, node: FlowNode) -> None:
-        self._l4_buf.append(flow_to_l4_pb(node))
+        src, dst, action = self._label(node)
+        if action == "ignore":
+            self.labeler.stats["ignored_flows"] += 1
+            return
+        f = flow_to_l4_pb(node)
+        if src is not None:
+            f.pod_0 = src.pod
+        if dst is not None:
+            f.pod_1 = dst.pod
+        self._l4_buf.append(f)
         if len(self._l4_buf) >= self.batch_size:
             self._flush_l4()
 
     def _on_l7(self, record: L7Record) -> None:
+        src, dst, action = self._label(record.flow)
+        if action == "ignore":
+            self.labeler.stats["ignored_flows"] += 1
+            return
         self.quadruple.add_l7(record)
-        self._l7_buf.append(record_to_l7_pb(record))
+        f = record_to_l7_pb(record)
+        if src is not None:
+            f.pod_0 = src.pod
+        if dst is not None:
+            f.pod_1 = dst.pod
+        self._l7_buf.append(f)
         if len(self._l7_buf) >= self.batch_size:
             self._flush_l7()
 

@@ -1,0 +1,160 @@
+"""Agent-side policy/labeler: packet/flow -> resource labels + ACL actions.
+
+Reference analog: agent/src/policy/first_path.rs (trie + interval matching
+building a policy from platform data and ACLs) and fast_path.rs (per-tuple
+LRU so the second packet of a flow never pays the trie walk). TPU
+redesign: labeling runs at FLOW granularity (the fleet's hot path is flows
+and HLO spans, not per-packet NPB), sourced from the controller's cluster
+resource model (K8s genesis) — which is what makes fleet-scale tag
+injection cheap: every agent labels its own flows, the ingester only fills
+gaps.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class ResourceLabel:
+    pod: str = ""
+    namespace: str = ""
+    workload: str = ""
+    node: str = ""
+
+
+@dataclass
+class AclRule:
+    """First-path rule. Empty fields match anything."""
+    cidr: str = ""               # matches either endpoint
+    port: int = 0                # matches either port
+    protocol: int = 0            # 1 tcp / 2 udp / 3 icmp
+    action: str = "trace"        # trace | ignore
+    _net: object = field(default=None, repr=False)
+
+    def net(self):
+        if self._net is None and self.cidr:
+            self._net = ipaddress.ip_network(self.cidr, strict=False)
+        return self._net
+
+
+class IpTrie:
+    """Longest-prefix match for v4 (bit trie) + exact-host table for v6."""
+
+    def __init__(self) -> None:
+        self._root: list = [None, None, None]  # [child0, child1, value]
+        self._v6: dict[bytes, object] = {}
+
+    def insert(self, cidr: str, value) -> None:
+        net = ipaddress.ip_network(cidr, strict=False)
+        if net.version == 6:
+            # fleet v6 is host-addressed; prefix support can follow need
+            self._v6[net.network_address.packed] = value
+            return
+        bits = int(net.network_address)
+        node = self._root
+        for i in range(net.prefixlen):
+            b = (bits >> (31 - i)) & 1
+            if node[b] is None:
+                node[b] = [None, None, None]
+            node = node[b]
+        node[2] = value
+
+    def lookup(self, ip: bytes):
+        """Longest-prefix value for a packed address, or None."""
+        if len(ip) == 16:
+            return self._v6.get(ip)
+        if len(ip) != 4:
+            return None
+        bits = int.from_bytes(ip, "big")
+        node = self._root
+        best = node[2]
+        for i in range(32):
+            node = node[(bits >> (31 - i)) & 1]
+            if node is None:
+                break
+            if node[2] is not None:
+                best = node[2]
+        return best
+
+
+class Labeler:
+    """first_path (trie + ACL scan) with a fast_path LRU over flow tuples."""
+
+    FAST_PATH_CAP = 1 << 16
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._trie = IpTrie()
+        self._acls: list[AclRule] = []
+        self._fast: OrderedDict[tuple, tuple] = OrderedDict()
+        self.version = 0
+        self.stats = {"first_path": 0, "fast_path": 0, "resources": 0,
+                      "ignored_flows": 0}
+
+    # -- feed (platform push / config) ----------------------------------------
+
+    def load_resources(self, entries, version: int = 0) -> None:
+        """entries: iterable of (cidr, ResourceLabel). Replaces the trie."""
+        trie = IpTrie()
+        n = 0
+        for cidr, label in entries:
+            trie.insert(cidr, label)
+            n += 1
+        with self._lock:
+            self._trie = trie
+            self._fast.clear()  # labels changed: cached verdicts are stale
+            self.version = version
+            self.stats["resources"] = n
+
+    def load_acls(self, rules: list[AclRule]) -> None:
+        with self._lock:
+            self._acls = list(rules)
+            self._fast.clear()
+
+    # -- lookup ----------------------------------------------------------------
+
+    def label_flow(self, ip_src: bytes, ip_dst: bytes, port_src: int,
+                   port_dst: int, protocol: int
+                   ) -> tuple[ResourceLabel | None, ResourceLabel | None,
+                              str]:
+        """-> (src_label, dst_label, action)."""
+        key = (ip_src, ip_dst, port_src, port_dst, protocol)
+        with self._lock:
+            hit = self._fast.get(key)
+            if hit is not None:
+                self._fast.move_to_end(key)
+                self.stats["fast_path"] += 1
+                return hit
+            self.stats["first_path"] += 1
+            src = self._trie.lookup(ip_src)
+            dst = self._trie.lookup(ip_dst)
+            action = self._acl_action_locked(ip_src, ip_dst, port_src,
+                                             port_dst, protocol)
+            verdict = (src, dst, action)
+            self._fast[key] = verdict
+            if len(self._fast) > self.FAST_PATH_CAP:
+                self._fast.popitem(last=False)
+            return verdict
+
+    def _acl_action_locked(self, ip_src, ip_dst, port_src, port_dst,
+                           protocol) -> str:
+        for rule in self._acls:
+            if rule.protocol and rule.protocol != protocol:
+                continue
+            if rule.port and rule.port not in (port_src, port_dst):
+                continue
+            if rule.cidr:
+                net = rule.net()
+                try:
+                    a = ipaddress.ip_address(ip_src)
+                    b = ipaddress.ip_address(ip_dst)
+                except ValueError:
+                    continue
+                if a not in net and b not in net:
+                    continue
+            return rule.action
+        return "trace"

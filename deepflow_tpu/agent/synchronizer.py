@@ -21,6 +21,7 @@ log = logging.getLogger("df.sync")
 _SYNC = "/deepflow_tpu.Synchronizer/Sync"
 _GPID = "/deepflow_tpu.Synchronizer/GpidSync"
 _PUSH = "/deepflow_tpu.Synchronizer/Push"
+_PODMAP = "/deepflow_tpu.Synchronizer/PodMap"
 
 
 class Synchronizer:
@@ -143,7 +144,37 @@ class Synchronizer:
         resp = call(req, timeout=5.0)
         self.stats["syncs"] += 1
         self._on_response(resp)
+        try:
+            self._sync_pod_map()
+        except Exception as e:
+            # optional feature (older controller / no genesis): a PodMap
+            # failure must not poison an otherwise-successful sync
+            log.debug("pod map fetch failed: %s", e)
         return resp
+
+    def _sync_pod_map(self) -> None:
+        """Labeler feed: fetch the cluster resource model when stale
+        (reference: platform data push building first_path)."""
+        labeler = getattr(self.agent, "labeler", None)
+        if labeler is None:
+            return
+        req = pb.PodMapRequest()
+        req.version = labeler.version
+        call = self._channel.unary_unary(
+            _PODMAP,
+            request_serializer=pb.PodMapRequest.SerializeToString,
+            response_deserializer=pb.PodMapResponse.FromString)
+        resp = call(req, timeout=5.0)
+        if resp.version == labeler.version:
+            return  # an empty-but-NEWER map still applies (pods gone)
+        from deepflow_tpu.agent.labeler import ResourceLabel
+        labeler.load_resources(
+            ((e.cidr, ResourceLabel(pod=e.pod, namespace=e.namespace,
+                                    workload=e.workload, node=e.node))
+             for e in resp.entries),
+            version=resp.version)
+        self.stats["podmap_updates"] = \
+            self.stats.get("podmap_updates", 0) + 1
 
     def _on_response(self, resp: pb.SyncResponse) -> None:
         with self._apply_lock:  # poll + push threads: serialize, and only

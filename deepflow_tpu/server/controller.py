@@ -175,8 +175,10 @@ class Controller:
     """The gRPC Synchronizer service + shared state."""
 
     def __init__(self, platform_table: PlatformInfoTable,
-                 host: str = "127.0.0.1", port: int = 20035) -> None:
+                 host: str = "127.0.0.1", port: int = 20035,
+                 pod_index=None) -> None:
         self.platform_table = platform_table
+        self.pod_index = pod_index  # K8s genesis resource model (server's)
         self.registry = AgentRegistry()
         self.gpids = GpidAllocator()
         from deepflow_tpu.server.prom_encoder import PromEncoder
@@ -235,6 +237,25 @@ class Controller:
     def GpidSync(self, request: pb.GpidSyncRequest,
                  context) -> pb.GpidSyncResponse:
         return self.gpids.sync(request)
+
+    def PodMap(self, request: pb.PodMapRequest,
+               context) -> pb.PodMapResponse:
+        """Cluster resource model -> agents (labeler feed). Entries only
+        when the agent's version is stale (steady-state syncs are tiny)."""
+        resp = pb.PodMapResponse()
+        if self.pod_index is None:
+            return resp
+        resp.version = self.pod_index.version
+        if request.version == resp.version:
+            return resp
+        for ip, pod in self.pod_index.items_copy():
+            e = resp.entries.add()
+            e.cidr = f"{ip}/32" if ":" not in ip else f"{ip}/128"
+            e.pod = pod.name
+            e.namespace = pod.namespace
+            e.workload = pod.workload
+            e.node = pod.node
+        return resp
 
     def _push_cond(self, group: str) -> asyncio.Condition:
         """Loop-thread only."""
@@ -350,6 +371,9 @@ class Controller:
         async def prom_h(request, context):
             return self.prom_encoder.handle(request)
 
+        async def podmap_h(request, context):
+            return self.PodMap(request, context)
+
         handlers = {
             "Sync": grpc.unary_unary_rpc_method_handler(
                 sync_h,
@@ -363,6 +387,10 @@ class Controller:
                 prom_h,
                 request_deserializer=pb.PromEncodeRequest.FromString,
                 response_serializer=pb.PromEncodeResponse.SerializeToString),
+            "PodMap": grpc.unary_unary_rpc_method_handler(
+                podmap_h,
+                request_deserializer=pb.PodMapRequest.FromString,
+                response_serializer=pb.PodMapResponse.SerializeToString),
             "Push": grpc.unary_stream_rpc_method_handler(
                 self.Push,
                 request_deserializer=pb.SyncRequest.FromString,

@@ -185,8 +185,8 @@ class FlowLogDecoder(Decoder):
                     "close_type": _close_type_idx(f.close_type),
                     "syn_count": f.syn_count, "synack_count": f.synack_count,
                     "gprocess_id_0": f.gpid_0, "gprocess_id_1": f.gpid_1,
-                    "pod_0": pod_of(src_s),
-                    "pod_1": pod_of(dst_s),
+                    "pod_0": f.pod_0 or pod_of(src_s),
+                    "pod_1": f.pod_1 or pod_of(dst_s),
                     **tags,
                 })
             self.write("flow_log.l4_flow_log", rows)
@@ -225,8 +225,8 @@ class FlowLogDecoder(Decoder):
                     "captured_request_byte": f.captured_request_byte,
                     "captured_response_byte": f.captured_response_byte,
                     "gprocess_id_0": f.gpid_0, "gprocess_id_1": f.gpid_1,
-                    "pod_0": pod_of(src_s),
-                    "pod_1": pod_of(dst_s),
+                    "pod_0": f.pod_0 or pod_of(src_s),
+                    "pod_1": f.pod_1 or pod_of(dst_s),
                     "process_kname_0": f.process_kname_0,
                     "process_kname_1": f.process_kname_1,
                     "attrs": f.attrs_json,

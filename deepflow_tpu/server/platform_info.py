@@ -121,10 +121,15 @@ class PodIpIndex:
             return self._by_ip.get(ip)
 
     def snapshot(self) -> dict:
-        """Reference to the current mapping for batch reads (callers must
-        not mutate; dict reads are GIL-atomic, writers always REPLACE
-        values rather than mutating them in place)."""
+        """Reference to the current mapping for batch POINT reads (.get)
+        only — iteration over this dict races writers; use items_copy()
+        to iterate. Writers always replace values, never mutate them."""
         return self._by_ip
+
+    def items_copy(self) -> list:
+        """Locked copy for safe iteration (PodMap serving etc.)."""
+        with self._lock:
+            return list(self._by_ip.items())
 
     def __len__(self) -> int:
         with self._lock:

@@ -41,7 +41,8 @@ class Server:
                 log.warning("controller disabled (%s)", e)
             else:
                 self.controller = Controller(
-                    self.platform, host=host, port=sync_port)
+                    self.platform, host=host, port=sync_port,
+                    pod_index=self.pod_index)
         from deepflow_tpu.server.alerting import AlertEngine
         from deepflow_tpu.server.exporters import ExporterManager
         self.exporters = ExporterManager()

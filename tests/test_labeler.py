@@ -1,0 +1,188 @@
+"""Agent-side policy/labeler: LPM trie, fast-path LRU, ACLs, and the
+controller pod-map feed.
+
+Reference analog: agent/src/policy/first_path.rs + fast_path.rs.
+VERDICT round-1 missing #3.
+"""
+
+import socket
+import time
+
+import pytest
+
+from deepflow_tpu.agent.labeler import AclRule, IpTrie, Labeler, \
+    ResourceLabel
+
+
+def ip(s):
+    return socket.inet_aton(s)
+
+
+def test_trie_longest_prefix_match():
+    t = IpTrie()
+    t.insert("10.0.0.0/8", "net")
+    t.insert("10.244.0.0/16", "cluster")
+    t.insert("10.244.1.5/32", "pod-a")
+    assert t.lookup(ip("10.244.1.5")) == "pod-a"
+    assert t.lookup(ip("10.244.9.9")) == "cluster"
+    assert t.lookup(ip("10.9.9.9")) == "net"
+    assert t.lookup(ip("192.168.0.1")) is None
+    # v6 exact-host
+    t.insert("2001:db8::1/128", "v6pod")
+    v6 = socket.inet_pton(socket.AF_INET6, "2001:db8::1")
+    assert t.lookup(v6) == "v6pod"
+
+
+def test_labeler_fast_path_lru():
+    lab = Labeler()
+    lab.load_resources([("10.244.1.5/32", ResourceLabel(pod="web"))],
+                       version=1)
+    for _ in range(3):
+        src, dst, action = lab.label_flow(
+            ip("10.244.1.5"), ip("10.244.1.9"), 1000, 80, 1)
+    assert src.pod == "web" and dst is None and action == "trace"
+    assert lab.stats["first_path"] == 1
+    assert lab.stats["fast_path"] == 2
+    # reload invalidates the cache
+    lab.load_resources([("10.244.1.9/32", ResourceLabel(pod="api"))],
+                       version=2)
+    src, dst, _ = lab.label_flow(
+        ip("10.244.1.5"), ip("10.244.1.9"), 1000, 80, 1)
+    assert src is None and dst.pod == "api"
+    assert lab.stats["first_path"] == 2
+
+
+def test_acl_rules_match_and_order():
+    lab = Labeler()
+    lab.load_acls([
+        AclRule(cidr="10.99.0.0/16", action="ignore"),
+        AclRule(port=22, action="ignore"),
+    ])
+    _, _, a = lab.label_flow(ip("10.99.1.1"), ip("1.1.1.1"), 5, 80, 1)
+    assert a == "ignore"
+    _, _, a = lab.label_flow(ip("1.1.1.1"), ip("2.2.2.2"), 5000, 22, 1)
+    assert a == "ignore"
+    _, _, a = lab.label_flow(ip("1.1.1.1"), ip("2.2.2.2"), 5000, 80, 1)
+    assert a == "trace"
+
+
+def test_dispatcher_labels_and_acl_suppression():
+    """Flows get agent-side pod labels; ignored flows emit nothing."""
+    from deepflow_tpu.agent.dispatcher import Dispatcher
+    from deepflow_tpu.agent.packet import TcpFlags, build_tcp
+    from deepflow_tpu.codec import MessageType
+    from deepflow_tpu.proto import pb
+
+    lab = Labeler()
+    lab.load_resources([
+        ("10.244.1.5/32", ResourceLabel(pod="web-abc")),
+        ("10.244.1.9/32", ResourceLabel(pod="api-xyz"))], version=1)
+    lab.load_acls([AclRule(cidr="10.66.0.0/16", action="ignore")])
+    sent = []
+
+    class FakeSender:
+        def send(self, mt, payload):
+            sent.append((mt, payload))
+            return True
+
+    disp = Dispatcher(sender=FakeSender(), engine="python", labeler=lab)
+    t0 = time.time_ns()
+    disp.inject(build_tcp("10.244.1.5", "10.244.1.9", 40000, 80,
+                          TcpFlags.SYN, timestamp_ns=t0))
+    disp.inject(build_tcp("10.66.0.2", "1.1.1.1", 40001, 80,
+                          TcpFlags.SYN, timestamp_ns=t0))  # ACL-ignored
+    disp.flush(force=True)
+    l4 = []
+    for mt, payload in sent:
+        if mt == MessageType.L4_LOG:
+            l4.extend(pb.FlowLogBatch.FromString(payload).l4)
+    assert len(l4) == 1
+    assert l4[0].pod_0 == "web-abc" and l4[0].pod_1 == "api-xyz"
+    assert lab.stats["ignored_flows"] == 1
+
+
+def test_pod_map_feed_from_controller():
+    """Controller serves the genesis resource model to agents; the
+    synchronizer feeds the labeler; steady-state fetches are empty."""
+    grpc = pytest.importorskip("grpc")
+    from deepflow_tpu.proto import pb
+    from deepflow_tpu.server.controller import Controller
+    from deepflow_tpu.server.platform_info import PlatformInfoTable, \
+        PodIpIndex, PodInfo
+
+    idx = PodIpIndex()
+    idx.upsert("10.244.1.5", PodInfo("web-abc", "prod", workload="web"))
+    ctrl = Controller(PlatformInfoTable(), host="127.0.0.1", port=0,
+                      pod_index=idx).start()
+    try:
+        ch = grpc.insecure_channel(f"127.0.0.1:{ctrl.port}")
+        stub = ch.unary_unary(
+            "/deepflow_tpu.Synchronizer/PodMap",
+            request_serializer=pb.PodMapRequest.SerializeToString,
+            response_deserializer=pb.PodMapResponse.FromString)
+        resp = stub(pb.PodMapRequest(version=0), timeout=5)
+        assert len(resp.entries) == 1
+        e = resp.entries[0]
+        assert e.cidr == "10.244.1.5/32" and e.pod == "web-abc"
+        assert e.workload == "web"
+        # steady state: same version -> no entries shipped
+        resp2 = stub(pb.PodMapRequest(version=resp.version), timeout=5)
+        assert len(resp2.entries) == 0
+        assert resp2.version == resp.version
+        ch.close()
+    finally:
+        ctrl.stop()
+
+
+def test_acl_ignore_suppresses_metrics_too():
+    """Ignored traffic is invisible in flow METRICS as well as logs."""
+    from deepflow_tpu.agent.dispatcher import Dispatcher
+    from deepflow_tpu.agent.packet import TcpFlags, build_tcp
+    from deepflow_tpu.codec import MessageType
+    from deepflow_tpu.proto import pb
+
+    lab = Labeler()
+    lab.load_acls([AclRule(cidr="10.66.0.0/16", action="ignore")])
+    sent = []
+
+    class FakeSender:
+        def send(self, mt, payload):
+            sent.append((mt, payload))
+            return True
+
+    disp = Dispatcher(sender=FakeSender(), engine="python", labeler=lab)
+    t0 = time.time_ns()
+    disp.inject(build_tcp("10.66.0.2", "1.1.1.1", 40001, 80,
+                          TcpFlags.SYN, timestamp_ns=t0))
+    disp.flush(force=True)
+    docs = []
+    for mt, payload in sent:
+        if mt == MessageType.METRICS:
+            docs.extend(pb.DocumentBatch.FromString(payload).docs)
+    assert not docs, "ignored flow leaked into metrics"
+
+
+def test_empty_newer_pod_map_applies():
+    """All pods deleted -> empty map with a NEWER version must clear the
+    agent's labels (not be skipped)."""
+    lab = Labeler()
+    lab.load_resources([("10.1.1.1/32", ResourceLabel(pod="dead"))],
+                       version=5)
+    lab.load_resources([], version=6)
+    src, _, _ = lab.label_flow(ip("10.1.1.1"), ip("2.2.2.2"), 1, 2, 1)
+    assert src is None
+    assert lab.version == 6
+
+
+def test_acl_config_validation():
+    from deepflow_tpu.agent.config import AgentConfig
+    import pytest as _pytest
+    cfg = AgentConfig()
+    cfg.acls = [{"cidr": "10.0.0/33", "action": "ignore"}]
+    with _pytest.raises(ValueError):
+        cfg.validate()
+    cfg.acls = [{"action": "reject"}]
+    with _pytest.raises(ValueError):
+        cfg.validate()
+    cfg.acls = [{"cidr": "10.0.0.0/8", "port": 22, "action": "ignore"}]
+    cfg.validate()
