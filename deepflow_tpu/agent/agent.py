@@ -151,6 +151,10 @@ class Agent:
         self.start_extprofilers()
 
     def start(self) -> "Agent":
+        plugins = getattr(self.config, "plugins", [])
+        if plugins:
+            from deepflow_tpu.agent.ops import load_plugins
+            load_plugins(plugins)
         self.sender.start()
         self._components.append("sender")
         if self.config.profiler.enabled:

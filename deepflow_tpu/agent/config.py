@@ -77,6 +77,9 @@ class AgentConfig:
     # agent-side ACLs (reference: policy first_path rules): list of dicts
     # {cidr, port, protocol, action: trace|ignore}
     acls: list = field(default_factory=list)
+    # parser plugin modules (reference: wasm plugin hooks): each exports
+    # PARSERS = [L7Parser subclasses], registered ahead of builtins
+    plugins: list = field(default_factory=list)
     group: str = "default"        # agent-group for config routing
     controller: str = ""          # host:port; empty = standalone mode
     standalone: bool = True

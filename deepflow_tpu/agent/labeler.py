@@ -92,6 +92,7 @@ class Labeler:
         self._acls: list[AclRule] = []
         self._fast: OrderedDict[tuple, tuple] = OrderedDict()
         self.version = 0
+        self.epoch = 0
         self.stats = {"first_path": 0, "fast_path": 0, "resources": 0,
                       "ignored_flows": 0}
 
@@ -111,8 +112,19 @@ class Labeler:
             self.stats["resources"] = n
 
     def load_acls(self, rules: list[AclRule]) -> None:
+        ok = []
+        for r in rules:
+            try:
+                r.net()  # pre-parse: a bad cidr must never reach the
+                # flow hot path (the agent main() path skips validate())
+            except ValueError as e:
+                import logging
+                logging.getLogger("df.labeler").warning(
+                    "dropping ACL with bad cidr %r: %s", r.cidr, e)
+                continue
+            ok.append(r)
         with self._lock:
-            self._acls = list(rules)
+            self._acls = ok
             self._fast.clear()
 
     # -- lookup ----------------------------------------------------------------

@@ -1,0 +1,157 @@
+"""Agent ops surface: remote-exec command registry, debug queue taps,
+restart-based upgrade, and the L7 parser plugin loader.
+
+Reference analogs: message/agent.proto:18 RemoteExecRequest (a REGISTRY of
+predefined commands, never arbitrary shell), agent.proto:9 UpgradeRequest
+(binary swap + restart; here re-exec picks up updated code from disk —
+K8s rollouts replace the pod the same way), debug/debugger.rs:111 (queue
+taps sampling live queues), plugin/wasm/mod.rs:17 (custom protocol hooks;
+here plugins are python modules exporting PARSERS).
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import logging
+import os
+import sys
+import threading
+
+log = logging.getLogger("df.ops")
+
+MAX_OUTPUT = 64 * 1024
+
+
+class CommandRegistry:
+    """Named introspection commands; nothing here shells out."""
+
+    def __init__(self, agent) -> None:
+        self.agent = agent
+        self._commands = {
+            "help": self._help,
+            "status": self._status,
+            "config": self._config,
+            "queues": self._queues,
+            "queue-tap": self._queue_tap,
+            "flows": self._flows,
+            "profilers": self._profilers,
+            "upgrade": self._upgrade,
+        }
+
+    def names(self) -> list[str]:
+        return sorted(self._commands)
+
+    def run(self, cmd: str, args: list[str]) -> tuple[int, str]:
+        fn = self._commands.get(cmd)
+        if fn is None:
+            return 127, f"unknown command {cmd!r}; try: " + \
+                ", ".join(self.names())
+        try:
+            out = fn(args)
+        except Exception as e:
+            return 1, f"{type(e).__name__}: {e}"
+        if isinstance(out, (dict, list)):
+            out = json.dumps(out, default=str, sort_keys=True)
+        return 0, str(out)[:MAX_OUTPUT]
+
+    # -- commands --------------------------------------------------------------
+
+    def _help(self, args):
+        return {"commands": self.names()}
+
+    def _status(self, args):
+        a = self.agent
+        return {
+            "components": list(a._components),
+            "pid": os.getpid(),
+            "degraded": bool(a.guard is not None and a.guard.degraded),
+            "sender": dict(a.sender.stats),
+        }
+
+    def _config(self, args):
+        from dataclasses import asdict
+        return asdict(self.agent.config)
+
+    def _queues(self, args):
+        """Queue depths across the agent (debugger.rs queue list analog)."""
+        a = self.agent
+        out = {"sender_queue": a.sender.queue_depth()}
+        if a.dispatcher is not None:
+            out["l4_buffer"] = len(a.dispatcher._l4_buf)
+            out["l7_buffer"] = len(a.dispatcher._l7_buf)
+        return out
+
+    def _queue_tap(self, args):
+        """Sample up to N live entries from a queue without consuming them
+        (debugger.rs:111 queue tap)."""
+        n = int(args[0]) if args else 8
+        which = args[1] if len(args) > 1 else "sender"
+        a = self.agent
+        if which == "sender":
+            return {"queue": "sender",
+                    "entries": a.sender.peek(n)}
+        if which == "l7" and a.dispatcher is not None:
+            return {"queue": "l7",
+                    "entries": [str(x)[:200]
+                                for x in a.dispatcher._l7_buf[:n]]}
+        return {"error": f"no such queue {which!r}"}
+
+    def _flows(self, args):
+        a = self.agent
+        if a.dispatcher is None:
+            return {"error": "flow pipeline not running"}
+        return dict(a.dispatcher.stats)
+
+    def _profilers(self, args):
+        a = self.agent
+        out = {}
+        if a.sampler is not None:
+            st = a.sampler.stats
+            out["oncpu"] = {"samples": st.samples, "emits": st.emits}
+        if a.tpuprobe is not None:
+            out["tpuprobe"] = dict(a.tpuprobe.stats)
+        for ep in a.extprofilers:
+            out[f"extprof-{ep.pid}"] = {"samples": ep.stats.samples,
+                                        "lost": ep.lost}
+        return out
+
+    def _upgrade(self, args):
+        """OTA analog: drain and re-exec, picking up updated code from disk
+        (reference swaps the binary then restarts, agent.proto:9)."""
+        if "dry-run" in args:
+            return {"upgrading": False, "dry_run": True, "argv": sys.argv}
+
+        def _reexec():
+            log.warning("upgrade: re-exec %s", sys.argv)
+            try:
+                self.agent.stop()
+            except Exception:
+                pass
+            self._execv(sys.executable, [sys.executable] + sys.argv)
+
+        threading.Timer(0.5, _reexec).start()
+        return {"upgrading": True, "argv": sys.argv}
+
+    # test seam: replaced in tests so an 'upgrade' never re-execs pytest
+    _execv = staticmethod(os.execv)
+
+
+def load_plugins(module_paths: list[str]) -> list[str]:
+    """Import parser plugins: each module exports PARSERS (L7Parser
+    subclasses), registered ahead of the builtins so plugins can override
+    (reference: wasm hooks run before native parsers)."""
+    from deepflow_tpu.agent.protocol_logs.base import REGISTRY
+    loaded = []
+    for path in module_paths:
+        try:
+            mod = importlib.import_module(path)
+            parsers = getattr(mod, "PARSERS", [])
+            for cls in parsers:
+                REGISTRY.insert(0, cls())
+                loaded.append(f"{path}.{cls.__name__}")
+        except Exception as e:
+            log.warning("plugin %s failed to load: %s", path, e)
+    if loaded:
+        log.info("plugins loaded: %s", ", ".join(loaded))
+    return loaded

@@ -50,6 +50,16 @@ class UniformSender:
         self._thread.start()
         return self
 
+    def queue_depth(self) -> int:
+        return self._q.qsize()
+
+    def peek(self, n: int = 8) -> list:
+        """Non-consuming sample of queued frames (debug queue tap)."""
+        with self._q.mutex:
+            items = list(self._q.queue)[:n]
+        return [{"type": getattr(mt, "name", str(mt)), "bytes": len(p)}
+                for mt, p in items]
+
     def send(self, msg_type: MessageType, payload: bytes) -> bool:
         try:
             self._q.put_nowait((msg_type, payload))

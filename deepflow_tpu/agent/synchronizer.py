@@ -39,6 +39,9 @@ class Synchronizer:
         self.config_epoch = 0
         self.platform_version = 0
         self._platform_cache: pb.PlatformData | None = None
+        self._pending_results: list = []
+        from deepflow_tpu.agent.ops import CommandRegistry
+        self._ops = CommandRegistry(agent)
         self._apply_lock = threading.Lock()  # poll + push threads both apply
         self.stats = {"syncs": 0, "errors": 0, "config_updates": 0}
 
@@ -127,6 +130,9 @@ class Synchronizer:
             req.mem_bytes = int(guard.rss_mb * 1024 * 1024)
         req.version = "0.1.0"
         req.agent_group = getattr(self.agent.config, "group", "") or "default"
+        sent_results = list(self._pending_results)
+        for r in sent_results:
+            req.command_results.append(r)
         # collect topology once, but RE-SEND every sync: a restarted
         # controller must be able to rebuild its platform/gpid state from
         # long-lived agents (the request is tiny)
@@ -142,6 +148,10 @@ class Synchronizer:
             request_serializer=pb.SyncRequest.SerializeToString,
             response_deserializer=pb.SyncResponse.FromString)
         resp = call(req, timeout=5.0)
+        # results are only dropped once the controller HAS them: a failed
+        # RPC keeps them queued for the next sync
+        if sent_results:
+            self._pending_results = self._pending_results[len(sent_results):]
         self.stats["syncs"] += 1
         self._on_response(resp)
         try:
@@ -160,12 +170,13 @@ class Synchronizer:
             return
         req = pb.PodMapRequest()
         req.version = labeler.version
+        req.epoch = labeler.epoch
         call = self._channel.unary_unary(
             _PODMAP,
             request_serializer=pb.PodMapRequest.SerializeToString,
             response_deserializer=pb.PodMapResponse.FromString)
         resp = call(req, timeout=5.0)
-        if resp.version == labeler.version:
+        if resp.version == labeler.version and resp.epoch == labeler.epoch:
             return  # an empty-but-NEWER map still applies (pods gone)
         from deepflow_tpu.agent.labeler import ResourceLabel
         labeler.load_resources(
@@ -173,6 +184,7 @@ class Synchronizer:
                                     workload=e.workload, node=e.node))
              for e in resp.entries),
             version=resp.version)
+        labeler.epoch = resp.epoch
         self.stats["podmap_updates"] = \
             self.stats.get("podmap_updates", 0) + 1
 
@@ -197,6 +209,11 @@ class Synchronizer:
                 self.stats["config_updates"] += 1
             if resp.platform_version:  # push responses leave it unset
                 self.platform_version = resp.platform_version
+        for rc in resp.commands:
+            code, out = self._ops.run(rc.cmd, list(rc.args))
+            self._pending_results.append(pb.CommandResult(
+                id=rc.id, exit_code=code, output=out))
+            self.stats["commands"] = self.stats.get("commands", 0) + 1
 
     def _apply_config(self, yaml_bytes: bytes, version: int) -> None:
         """Hot-apply the pushed config (reference: ConfigHandler per-module
@@ -215,6 +232,16 @@ class Synchronizer:
         cfg.tpuprobe = new.tpuprobe
         cfg.stats_interval_s = new.stats_interval_s
         cfg.guard = new.guard
+        cfg.acls = new.acls
+        labeler = getattr(self.agent, "labeler", None)
+        if labeler is not None:  # pushed ACLs take effect live
+            from deepflow_tpu.agent.labeler import AclRule
+            labeler.load_acls([
+                AclRule(cidr=a.get("cidr", ""),
+                        port=int(a.get("port", 0)),
+                        protocol=int(a.get("protocol", 0)),
+                        action=a.get("action", "trace"))
+                for a in new.acls])
 
         # guard limits retune live (the controller's knob for hot agents)
         guard = self.agent.guard

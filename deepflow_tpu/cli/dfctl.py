@@ -120,6 +120,15 @@ def main(argv: list[str] | None = None) -> int:
     p_alert.add_argument("spec", nargs="?",
                          help="set: json file or inline json; delete: name")
 
+    p_exec = sub.add_parser("exec",
+                            help="remote-exec a registry command on an "
+                                 "agent (help|status|config|queues|"
+                                 "queue-tap|flows|profilers|upgrade)")
+    p_exec.add_argument("agent_id", type=int)
+    p_exec.add_argument("command")
+    p_exec.add_argument("cargs", nargs="*")
+    p_exec.add_argument("--timeout", type=float, default=30.0)
+
     p_exp = sub.add_parser("exporter")
     p_exp.add_argument("action", choices=["list", "add", "delete"])
     p_exp.add_argument("spec", nargs="?",
@@ -133,8 +142,27 @@ def main(argv: list[str] | None = None) -> int:
     elif args.cmd == "agent":
         out = _api(args.server, "/v1/agents")
         rows = [[a["agent_id"], a["hostname"], a["ctrl_ip"],
-                 a["last_seen_ns"]] for a in out["agents"]]
-        print_table(["ID", "HOSTNAME", "CTRL_IP", "LAST_SEEN_NS"], rows)
+                 a.get("staleness_s", ""), a.get("degraded", ""),
+                 a.get("exception_bitmap", 0), a.get("version", "")]
+                for a in out["agents"]]
+        print_table(["ID", "HOSTNAME", "CTRL_IP", "STALE_S", "DEGRADED",
+                     "EXC", "VERSION"], rows)
+    elif args.cmd == "exec":
+        import time as _time
+        out = _api(args.server, "/v1/agents/exec",
+                   {"agent_id": args.agent_id, "cmd": args.command,
+                    "args": args.cargs})
+        rid = out["result_id"]
+        deadline = _time.time() + args.timeout
+        while _time.time() < deadline:
+            r = _api(args.server, "/v1/agents/exec",
+                     {"result_id": rid})["result"]
+            if r["state"] == "done":
+                print(r.get("output", ""))
+                return 0 if r.get("exit_code", 1) == 0 else 1
+            _time.sleep(0.5)
+        print("timed out waiting for result", rid)
+        return 2
     elif args.cmd == "agent-group-config":
         with open(args.file) as f:
             yaml_text = f.read()

@@ -171,6 +171,55 @@ class ConfigStore:
         AgentConfig.from_dict(data).validate()
 
 
+class CommandQueue:
+    """Per-agent remote-exec queue + result store (agent.proto:18 analog:
+    controller queues registry commands, agents pick them up on sync)."""
+
+    MAX_RESULTS = 1024       # oldest evicted; dfctl polls promptly
+    MAX_PENDING_PER_AGENT = 64
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._pending: dict[int, list] = {}    # agent_id -> [RemoteCommand]
+        self._results: dict[int, dict] = {}    # cmd_id -> result dict
+        self._next_id = 1
+
+    def submit(self, agent_id: int, cmd: str, args: list[str]) -> int:
+        with self._lock:
+            q = self._pending.setdefault(agent_id, [])
+            if len(q) >= self.MAX_PENDING_PER_AGENT:
+                raise ValueError(
+                    f"agent {agent_id} has {len(q)} undelivered commands "
+                    "(is it syncing?)")
+            cid = self._next_id
+            self._next_id += 1
+            rc = pb.RemoteCommand(id=cid, cmd=cmd)
+            rc.args.extend(args)
+            q.append(rc)
+            self._results[cid] = {"id": cid, "agent_id": agent_id,
+                                  "cmd": cmd, "state": "pending"}
+            while len(self._results) > self.MAX_RESULTS:
+                self._results.pop(next(iter(self._results)))
+            return cid
+
+    def take_pending(self, agent_id: int) -> list:
+        with self._lock:
+            return self._pending.pop(agent_id, [])
+
+    def deliver_results(self, results) -> None:
+        with self._lock:
+            for r in results:
+                entry = self._results.get(r.id)
+                if entry is not None:
+                    entry.update(state="done", exit_code=r.exit_code,
+                                 output=r.output)
+
+    def result(self, cmd_id: int) -> dict | None:
+        with self._lock:
+            r = self._results.get(cmd_id)
+            return dict(r) if r else None
+
+
 class Controller:
     """The gRPC Synchronizer service + shared state."""
 
@@ -183,6 +232,7 @@ class Controller:
         self.gpids = GpidAllocator()
         from deepflow_tpu.server.prom_encoder import PromEncoder
         self.prom_encoder = PromEncoder()
+        self.commands = CommandQueue()
         self.configs = ConfigStore()
         self.host = host
         self.port = port
@@ -232,6 +282,10 @@ class Controller:
             # policy/labeler consumer for it (reference pushes full
             # platform data because its agents label packets with it)
             resp.platform_version = self._platform_version
+        if request.command_results:
+            self.commands.deliver_results(request.command_results)
+        for rc in self.commands.take_pending(agent_id):
+            resp.commands.append(rc)
         return resp
 
     def GpidSync(self, request: pb.GpidSyncRequest,
@@ -246,7 +300,9 @@ class Controller:
         if self.pod_index is None:
             return resp
         resp.version = self.pod_index.version
-        if request.version == resp.version:
+        resp.epoch = self.configs.epoch  # restart-coincidence guard
+        if request.version == resp.version and \
+                request.epoch == resp.epoch:
             return resp
         for ip, pod in self.pod_index.items_copy():
             e = resp.entries.add()

@@ -249,6 +249,23 @@ class QuerierAPI:
             self.db.table("flow_log.l7_flow_log"), trace_id,
             tpu_table=self.db.table("profile.tpu_hlo_span"))}
 
+    def agent_exec(self, body: dict) -> dict:
+        """Queue a registry command for an agent; poll with result_id."""
+        if self.controller is None:
+            raise qengine.QueryError("no controller")
+        if "result_id" in body:
+            r = self.controller.commands.result(int(body["result_id"]))
+            if r is None:
+                raise qengine.QueryError("unknown result_id")
+            return {"result": r}
+        agent_id = int(body.get("agent_id", 0))
+        cmd = str(body.get("cmd", ""))
+        if not agent_id or not cmd:
+            raise qengine.QueryError("agent_id and cmd required")
+        cid = self.controller.commands.submit(
+            agent_id, cmd, [str(a) for a in body.get("args", [])])
+        return {"result_id": cid}
+
     def agents(self) -> dict:
         """Agent fleet listing with health (reference: deepflow-ctl agent
         list / cli/ctl/agent.go:49 — staleness, exception bitmap, degraded
@@ -380,6 +397,8 @@ class QuerierHTTP:
                         self._send(200, api.tpu_collectives(body))
                     elif path == "/v1/profile/TpuStepTrace":
                         self._send(200, api.tpu_step_trace(body))
+                    elif path == "/v1/agents/exec":
+                        self._send(200, api.agent_exec(body))
                     elif path == "/v1/agent-group-config":
                         self._send(200, api.update_agent_config(body))
                     elif path == "/v1/trace/Tracing":

@@ -125,10 +125,15 @@ def test_pod_map_feed_from_controller():
         e = resp.entries[0]
         assert e.cidr == "10.244.1.5/32" and e.pod == "web-abc"
         assert e.workload == "web"
-        # steady state: same version -> no entries shipped
-        resp2 = stub(pb.PodMapRequest(version=resp.version), timeout=5)
+        # steady state: same (version, epoch) -> no entries shipped
+        resp2 = stub(pb.PodMapRequest(version=resp.version,
+                                      epoch=resp.epoch), timeout=5)
         assert len(resp2.entries) == 0
         assert resp2.version == resp.version
+        # restart coincidence: same version but UNKNOWN epoch re-ships
+        resp3 = stub(pb.PodMapRequest(version=resp.version, epoch=1),
+                     timeout=5)
+        assert len(resp3.entries) == 1
         ch.close()
     finally:
         ctrl.stop()

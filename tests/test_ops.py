@@ -1,0 +1,156 @@
+"""Agent ops surface: remote-exec registry, debug queue taps, upgrade,
+plugin API.
+
+Reference analogs: message/agent.proto:18 (remote exec over the sync
+plane), agent.proto:9 (upgrade), debug/debugger.rs:111 (queue taps),
+plugin/wasm/mod.rs:17 (custom parser hooks). VERDICT round-1 missing #10.
+"""
+
+import sys
+import time
+import types
+
+import pytest
+
+from deepflow_tpu.agent.agent import Agent
+from deepflow_tpu.agent.config import AgentConfig
+from deepflow_tpu.agent.ops import CommandRegistry, load_plugins
+
+
+def _local_agent():
+    cfg = AgentConfig()
+    cfg.sender.servers = [("127.0.0.1", 1)]
+    cfg.profiler.enabled = False
+    cfg.tpuprobe.enabled = False
+    cfg.guard.enabled = False
+    return Agent(cfg)
+
+
+def test_registry_commands_and_unknown():
+    agent = _local_agent()
+    reg = CommandRegistry(agent)
+    code, out = reg.run("help", [])
+    assert code == 0 and "queues" in out and "upgrade" in out
+    code, out = reg.run("status", [])
+    assert code == 0 and "pid" in out
+    code, out = reg.run("rm", ["-rf", "/"])  # NOT a shell
+    assert code == 127 and "unknown command" in out
+    code, out = reg.run("config", [])
+    assert code == 0 and "profiler" in out
+
+
+def test_queue_tap_samples_without_consuming():
+    agent = _local_agent()
+    from deepflow_tpu.codec import MessageType
+    agent.sender.send(MessageType.DFSTATS, b"x" * 100)
+    agent.sender.send(MessageType.PROFILE, b"y" * 50)
+    reg = CommandRegistry(agent)
+    code, out = reg.run("queue-tap", ["5", "sender"])
+    assert code == 0
+    assert "DFSTATS" in out and "PROFILE" in out
+    # tap did not consume
+    assert agent.sender.queue_depth() == 2
+    code, out = reg.run("queues", [])
+    assert code == 0 and '"sender_queue": 2' in out
+
+
+def test_upgrade_reexecs_via_seam():
+    agent = _local_agent()
+    reg = CommandRegistry(agent)
+    code, out = reg.run("upgrade", ["dry-run"])
+    assert code == 0 and "dry_run" in out
+    called = []
+    reg._execv = lambda exe, argv: called.append((exe, argv))
+    code, out = reg.run("upgrade", [])
+    assert code == 0 and "upgrading" in out
+    deadline = time.time() + 12   # stop() drains the sender first
+    while time.time() < deadline and not called:
+        time.sleep(0.05)
+    assert called and called[0][0] == sys.executable
+
+
+def test_remote_exec_end_to_end():
+    """Controller queues a command; a real syncing agent executes it and
+    the result returns over the sync plane to the HTTP API."""
+    grpc = pytest.importorskip("grpc")  # noqa: F841
+    from deepflow_tpu.server import Server
+
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0,
+                    sync_port=0, enable_controller=True).start()
+    cfg = AgentConfig()
+    cfg.sender.servers = [("127.0.0.1", server.ingest_port)]
+    cfg.controller = f"127.0.0.1:{server.controller.port}"
+    cfg.sync_interval_s = 0.3
+    cfg.profiler.enabled = False
+    cfg.tpuprobe.enabled = False
+    cfg.guard.enabled = False
+    agent = Agent(cfg).start()
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline and \
+                not server.controller.registry.list():
+            time.sleep(0.1)
+        agents = server.controller.registry.list()
+        assert agents
+        agent_id = agents[0]["agent_id"]
+        cid = server.controller.commands.submit(agent_id, "queues", [])
+        deadline = time.time() + 10
+        result = None
+        while time.time() < deadline:
+            result = server.controller.commands.result(cid)
+            if result and result["state"] == "done":
+                break
+            time.sleep(0.1)
+        assert result and result["state"] == "done", result
+        assert result["exit_code"] == 0
+        assert "sender_queue" in result["output"]
+        # the HTTP surface wraps the same queue
+        from deepflow_tpu.server.querier import QuerierAPI  # noqa: F401
+        out = server.api.agent_exec({"agent_id": agent_id, "cmd": "status"})
+        cid2 = out["result_id"]
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            r = server.api.agent_exec({"result_id": cid2})["result"]
+            if r["state"] == "done":
+                break
+            time.sleep(0.1)
+        assert r["state"] == "done" and "components" in r["output"]
+    finally:
+        agent.stop()
+        server.stop()
+
+
+def test_parser_plugin_loads_and_wins():
+    """A plugin module's parser registers ahead of builtins and parses a
+    custom protocol through the normal flow path."""
+    from deepflow_tpu.agent.protocol_logs.base import (
+        MSG_REQUEST, REGISTRY, L7ParseResult, L7Parser, infer_and_parse)
+    from deepflow_tpu.proto import pb
+
+    mod = types.ModuleType("df_test_plugin")
+
+    class ToyParser(L7Parser):
+        PROTOCOL = pb.HTTP1  # piggyback an id; plugins may reuse or extend
+        NAME = "toy"
+
+        def check(self, payload, port_dst=0):
+            return payload.startswith(b"TOY/")
+
+        def parse(self, payload, is_request=True):
+            return [L7ParseResult(
+                l7_protocol=self.PROTOCOL, msg_type=MSG_REQUEST,
+                request_type="TOY",
+                request_resource=payload[4:12].decode("latin1"))]
+
+    mod.PARSERS = [ToyParser]
+    sys.modules["df_test_plugin"] = mod
+    before = len(REGISTRY)
+    try:
+        loaded = load_plugins(["df_test_plugin"])
+        assert loaded == ["df_test_plugin.ToyParser"]
+        proto, recs = infer_and_parse(b"TOY/widgets")
+        assert recs and recs[0].request_type == "TOY"
+        assert recs[0].request_resource == "widgets"
+    finally:
+        del sys.modules["df_test_plugin"]
+        del REGISTRY[0: len(REGISTRY) - before]
