@@ -124,6 +124,12 @@ class CommandRegistry:
 
         def _reexec():
             log.warning("upgrade: re-exec %s", sys.argv)
+            sync = getattr(self.agent, "synchronizer", None)
+            if sync is not None:
+                try:
+                    sync.sync_once()  # ship the upgrade's own result first
+                except Exception:
+                    pass
             try:
                 self.agent.stop()
             except Exception:

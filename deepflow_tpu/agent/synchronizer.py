@@ -39,6 +39,7 @@ class Synchronizer:
         self.config_epoch = 0
         self.platform_version = 0
         self._platform_cache: pb.PlatformData | None = None
+        self._configured_servers = list(agent.sender.servers)  # for revert
         self._pending_results: list = []
         from deepflow_tpu.agent.ops import CommandRegistry
         self._ops = CommandRegistry(agent)
@@ -209,11 +210,32 @@ class Synchronizer:
                 self.stats["config_updates"] += 1
             if resp.platform_version:  # push responses leave it unset
                 self.platform_version = resp.platform_version
+            if resp.analyzer_assignment:
+                self._apply_analyzers(list(resp.analyzer_addrs))
         for rc in resp.commands:
             code, out = self._ops.run(rc.cmd, list(rc.args))
             self._pending_results.append(pb.CommandResult(
                 id=rc.id, exit_code=code, output=out))
             self.stats["commands"] = self.stats.get("commands", 0) + 1
+
+    def _apply_analyzers(self, addrs: list[str]) -> None:
+        """Rebalance: adopt the controller's ingest-node preference order
+        (the sender fails over down this list)."""
+        from deepflow_tpu.agent.config import _parse_addr
+        try:
+            parsed = [_parse_addr(a) for a in addrs]
+        except ValueError as e:
+            log.warning("bad analyzer list %r: %s", addrs, e)
+            return
+        if not parsed:
+            # assignment cleared: fall back to the configured servers
+            parsed = list(self._configured_servers)
+        sender = self.agent.sender
+        if parsed and parsed != sender.servers:
+            sender.servers = parsed
+            sender.stats["rebalances"] = \
+                sender.stats.get("rebalances", 0) + 1
+            log.info("analyzer assignment: %s", parsed)
 
     def _apply_config(self, yaml_bytes: bytes, version: int) -> None:
         """Hot-apply the pushed config (reference: ConfigHandler per-module

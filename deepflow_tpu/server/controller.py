@@ -177,10 +177,12 @@ class CommandQueue:
 
     MAX_RESULTS = 1024       # oldest evicted; dfctl polls promptly
     MAX_PENDING_PER_AGENT = 64
+    INFLIGHT_TTL_S = 30.0    # redeliver if no result (at-least-once)
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._pending: dict[int, list] = {}    # agent_id -> [RemoteCommand]
+        self._inflight: dict[int, tuple] = {}  # cmd_id -> (agent, rc, ts)
         self._results: dict[int, dict] = {}    # cmd_id -> result dict
         self._next_id = 1
 
@@ -203,12 +205,23 @@ class CommandQueue:
             return cid
 
     def take_pending(self, agent_id: int) -> list:
+        """Delivery is AT-LEAST-ONCE: commands stay in-flight until a
+        result arrives; a lost Sync response redelivers after a TTL."""
+        now = time.monotonic()
         with self._lock:
-            return self._pending.pop(agent_id, [])
+            out = self._pending.pop(agent_id, [])
+            for cid, (aid, rc, ts) in list(self._inflight.items()):
+                if aid == agent_id and now - ts > self.INFLIGHT_TTL_S:
+                    out.append(rc)
+                    del self._inflight[cid]
+            for rc in out:
+                self._inflight[rc.id] = (agent_id, rc, now)
+            return out
 
     def deliver_results(self, results) -> None:
         with self._lock:
             for r in results:
+                self._inflight.pop(r.id, None)
                 entry = self._results.get(r.id)
                 if entry is not None:
                     entry.update(state="done", exit_code=r.exit_code,
@@ -233,6 +246,12 @@ class Controller:
         from deepflow_tpu.server.prom_encoder import PromEncoder
         self.prom_encoder = PromEncoder()
         self.commands = CommandQueue()
+        # analyzer (ingest node) list for agent rebalance; never-set =
+        # agents keep their configured servers; set-then-cleared = agents
+        # REVERT to them
+        self._analyzers: list[str] = []
+        self._analyzers_managed = False
+        self._analyzer_lock = threading.Lock()
         self.configs = ConfigStore()
         self.host = host
         self.port = port
@@ -286,7 +305,35 @@ class Controller:
             self.commands.deliver_results(request.command_results)
         for rc in self.commands.take_pending(agent_id):
             resp.commands.append(rc)
+        with self._analyzer_lock:
+            resp.analyzer_assignment = self._analyzers_managed
+        for addr in self.assign_analyzers(agent_id):
+            resp.analyzer_addrs.append(addr)
         return resp
+
+    def set_analyzers(self, addrs: list[str]) -> None:
+        with self._analyzer_lock:
+            self._analyzers = list(dict.fromkeys(addrs))
+            self._analyzers_managed = True
+
+    def analyzers(self) -> list[str]:
+        with self._analyzer_lock:
+            return list(self._analyzers)
+
+    def assign_analyzers(self, agent_id: int) -> list[str]:
+        """Rendezvous hashing: per-agent preference order over analyzers —
+        even spread, minimal churn when the node set changes (reference:
+        controller/monitor analyzer rebalance)."""
+        import hashlib
+        with self._analyzer_lock:
+            addrs = list(self._analyzers)
+        if not addrs:
+            return []
+        def weight(addr: str) -> int:
+            h = hashlib.blake2s(f"{agent_id}|{addr}".encode(),
+                                digest_size=8)
+            return int.from_bytes(h.digest(), "big")
+        return sorted(addrs, key=weight, reverse=True)
 
     def GpidSync(self, request: pb.GpidSyncRequest,
                  context) -> pb.GpidSyncResponse:
@@ -399,6 +446,10 @@ class Controller:
         return merged
 
     # -- server lifecycle -----------------------------------------------------
+
+    def running(self) -> bool:
+        return self._loop_thread is not None and \
+            self._loop_thread.is_alive()
 
     def start(self) -> "Controller":
         self._loop_thread = threading.Thread(

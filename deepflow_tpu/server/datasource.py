@@ -56,15 +56,23 @@ class RollupJob:
         self.stats = {"rollups": 0, "rows": 0}
 
     def start(self) -> "RollupJob":
+        if self.running():
+            return self
+        self._stop.clear()  # restartable (HA leader churn)
         self._thread = threading.Thread(
             target=self._run, name="df-rollup", daemon=True)
         self._thread.start()
         return self
 
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
     def stop(self) -> None:
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=2.0)
+            if not self._thread.is_alive():
+                self._thread = None
 
     def _run(self) -> None:
         while not self._stop.wait(self.interval_s):

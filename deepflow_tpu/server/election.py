@@ -1,0 +1,142 @@
+"""Leader election for multi-server deployments.
+
+Reference analog: controller/election/election.go:175 (K8s-Lease-backed
+single-leader election; the leader runs the controller singletons —
+rollups, janitor, command queue — while followers serve ingest+query).
+
+Embedded redesign: an exclusive flock(2) on a lease file. Unlike a
+TTL-stamped lease (whose write/verify window can elect two leaders for a
+tick), flock gives KERNEL-enforced mutual exclusion: exactly one open file
+description holds LOCK_EX at any instant, and a crashed leader's lock
+releases the moment its fd closes — no expiry heuristics, no fencing
+races. A fencing token still increments under the lock (in the lease file
+body) so downstream systems can reject writes from a deposed leader that
+hasn't noticed yet. Works wherever flock does (local fs, NFSv4); K8s Lease
+objects can layer on via the genesis HTTP client where no shared volume
+exists.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import json
+import logging
+import os
+import socket
+import threading
+import time
+
+log = logging.getLogger("df.election")
+
+
+class LeaderElection:
+    def __init__(self, lease_path: str, holder: str | None = None,
+                 ttl_s: float = 10.0, renew_interval_s: float = 3.0,
+                 on_elected=None, on_deposed=None) -> None:
+        self.lease_path = lease_path
+        if holder is None:
+            import uuid
+            # instance-unique: two candidates in ONE process (tests,
+            # embedded multi-server) must never share an identity
+            holder = (f"{socket.gethostname()}-{os.getpid()}-"
+                      f"{uuid.uuid4().hex[:8]}")
+        self.holder = holder
+        self.ttl_s = ttl_s  # kept for API compat; flock needs no TTL
+        self.renew_interval_s = renew_interval_s
+        self.on_elected = on_elected or (lambda: None)
+        self.on_deposed = on_deposed or (lambda: None)
+        self.is_leader = False
+        self.token = 0          # fencing token of OUR leadership
+        self._fd: int | None = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.stats = {"elections": 0, "renewals": 0, "depositions": 0}
+
+    # -- protocol --------------------------------------------------------------
+
+    def try_acquire(self) -> bool:
+        """One acquire attempt; returns current leadership. Holding the
+        flock IS leadership — renewal is a no-op heartbeat."""
+        if self.is_leader and self._fd is not None:
+            self.stats["renewals"] += 1
+            return True
+        try:
+            fd = os.open(self.lease_path, os.O_RDWR | os.O_CREAT, 0o644)
+        except OSError as e:
+            log.warning("lease open failed: %s", e)
+            return self._set_leader(False)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            os.close(fd)
+            return self._set_leader(False)
+        # we own the lock: bump the fencing token and record identity
+        try:
+            raw = os.pread(fd, 4096, 0)
+            prev = json.loads(raw) if raw.strip() else {}
+        except (OSError, ValueError):
+            prev = {}
+        self.token = int(prev.get("token", 0)) + 1
+        body = json.dumps({"holder": self.holder, "token": self.token,
+                           "acquired_ns": time.time_ns()}).encode()
+        try:
+            os.ftruncate(fd, 0)
+            os.pwrite(fd, body, 0)
+            os.fsync(fd)
+        except OSError as e:
+            log.warning("lease write failed: %s", e)
+        self._fd = fd
+        return self._set_leader(True)
+
+    def _set_leader(self, leader: bool) -> bool:
+        if leader and not self.is_leader:
+            self.is_leader = True
+            self.stats["elections"] += 1
+            log.info("elected leader (%s, token=%d)", self.holder,
+                     self.token)
+            try:
+                self.on_elected()
+            except Exception:
+                log.exception("on_elected failed")
+        elif not leader and self.is_leader:
+            self.is_leader = False
+            self.stats["depositions"] += 1
+            log.warning("leadership lost (%s)", self.holder)
+            try:
+                self.on_deposed()
+            except Exception:
+                log.exception("on_deposed failed")
+        return self.is_leader
+
+    def resign(self) -> None:
+        """Graceful handoff: release the lock so a follower wins at once."""
+        fd, self._fd = self._fd, None
+        if fd is not None:
+            try:
+                fcntl.flock(fd, fcntl.LOCK_UN)
+            except OSError:
+                pass
+            os.close(fd)
+        self._set_leader(False)
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> "LeaderElection":
+        self.try_acquire()
+        self._thread = threading.Thread(
+            target=self._run, name="df-election", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2.0)
+        self.resign()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.renew_interval_s):
+            try:
+                self.try_acquire()
+            except Exception:
+                log.exception("election tick failed")

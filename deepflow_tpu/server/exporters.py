@@ -24,17 +24,27 @@ class BaseExporter:
 
     TABLES: tuple = ()
 
+    SPOOL_MAX_FILES = 256     # ~bounded disk: oldest dropped beyond this
+
     def __init__(self, endpoint: str, batch_size: int = 256,
                  flush_interval_s: float = 2.0,
-                 queue_size: int = 8192, max_retries: int = 2) -> None:
+                 queue_size: int = 8192, max_retries: int = 2,
+                 spool_dir: str | None = None) -> None:
         self.endpoint = endpoint
         self.batch_size = batch_size
         self.flush_interval_s = flush_interval_s
         self.max_retries = max_retries
+        # durability: exhausted retries land in a disk spool and replay
+        # when the destination recovers (reference exporters buffer to
+        # kafka; embedded design spools locally). None = legacy drop.
+        self.spool_dir = spool_dir
+        self._spool_seq = 0
         self._q: queue.Queue = queue.Queue(maxsize=queue_size)
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
-        self.stats = {"exported": 0, "batches": 0, "dropped": 0, "errors": 0}
+        self.stats = {"exported": 0, "batches": 0, "dropped": 0,
+                      "errors": 0, "spooled": 0, "replayed": 0,
+                      "spool_dropped": 0}
 
     def accepts(self, table: str) -> bool:
         return not self.TABLES or table in self.TABLES
@@ -83,8 +93,77 @@ class BaseExporter:
                             break  # shutdown mid-retry: still a drop
                         time.sleep(min(0.5 * (attempt + 1), 2.0))
                 if not shipped:
-                    self.stats["dropped"] += len(batch)
+                    if self._spool(batch):
+                        self.stats["spooled"] += len(batch)
+                    else:
+                        self.stats["dropped"] += len(batch)
                 batch = []
+            # disk-driven replay: runs whether the spool predates this
+            # process or filled this run, throttled between attempts
+            self._maybe_replay_spool()
+
+    def _spool(self, batch: list) -> bool:
+        if not self.spool_dir:
+            return False
+        import os
+        import pickle
+        try:
+            os.makedirs(self.spool_dir, exist_ok=True)
+            files = sorted(f for f in os.listdir(self.spool_dir)
+                           if f.endswith(".spool"))
+            while len(files) >= self.SPOOL_MAX_FILES:
+                victim = files.pop(0)  # oldest out; drops stay VISIBLE
+                try:
+                    import pickle as _p
+                    with open(os.path.join(self.spool_dir, victim),
+                              "rb") as f:
+                        self.stats["spool_dropped"] += len(_p.load(f))
+                except Exception:
+                    pass
+                os.unlink(os.path.join(self.spool_dir, victim))
+            self._spool_seq += 1
+            path = os.path.join(
+                self.spool_dir,
+                f"{time.time_ns():020d}_{self._spool_seq:06d}.spool")
+            with open(path + ".tmp", "wb") as f:
+                pickle.dump(batch, f)
+            os.replace(path + ".tmp", path)
+            return True
+        except OSError as e:
+            log.warning("spool write failed: %s", e)
+            return False
+
+    def _maybe_replay_spool(self) -> None:
+        if not self.spool_dir:
+            return
+        now = time.monotonic()
+        if now < getattr(self, "_next_replay", 0):
+            return
+        self._next_replay = now + 5.0
+        self._replay_spool()
+
+    def _replay_spool(self, max_files: int = 8) -> None:
+        """Drain spooled batches oldest-first (including batches spooled
+        by a PREVIOUS process run)."""
+        import os
+        import pickle
+        try:
+            files = sorted(f for f in os.listdir(self.spool_dir)
+                           if f.endswith(".spool"))
+        except OSError:
+            return
+        for fn in files[:max_files]:
+            path = os.path.join(self.spool_dir, fn)
+            try:
+                with open(path, "rb") as f:
+                    batch = pickle.load(f)
+                self._ship(batch)
+                os.unlink(path)
+                self.stats["replayed"] += len(batch)
+                self.stats["exported"] += len(batch)
+            except Exception as e:
+                log.debug("spool replay stopped at %s: %s", fn, e)
+                return  # destination flapped again; keep the file
 
     def _ship(self, batch: list) -> None:
         raise NotImplementedError

@@ -48,15 +48,25 @@ class Janitor:
         self.stats = {"sweeps": 0, "rows_trimmed": 0}
 
     def start(self) -> "Janitor":
+        if self.running():
+            return self
+        self._stop.clear()  # restartable (HA leader churn)
         self._thread = threading.Thread(
             target=self._run, name="df-janitor", daemon=True)
         self._thread.start()
         return self
 
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
     def stop(self) -> None:
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=2.0)
+            if not self._thread.is_alive():
+                self._thread = None
+            # else: keep the reference — running() stays True and start()
+            # won't spawn a second loop over a cleared stop event
 
     def sweep(self, now_s: float | None = None) -> int:
         """One pass; returns rows trimmed."""

@@ -249,6 +249,14 @@ class QuerierAPI:
             self.db.table("flow_log.l7_flow_log"), trace_id,
             tpu_table=self.db.table("profile.tpu_hlo_span"))}
 
+    def analyzers_api(self, body: dict | None = None) -> dict:
+        if self.controller is None:
+            raise qengine.QueryError("no controller")
+        if body and "addrs" in body:
+            addrs = [str(a) for a in body["addrs"]]
+            self.controller.set_analyzers(addrs)
+        return {"analyzers": self.controller.analyzers()}
+
     def agent_exec(self, body: dict) -> dict:
         """Queue a registry command for an agent; poll with result_id."""
         if self.controller is None:
@@ -397,6 +405,8 @@ class QuerierHTTP:
                         self._send(200, api.tpu_collectives(body))
                     elif path == "/v1/profile/TpuStepTrace":
                         self._send(200, api.tpu_step_trace(body))
+                    elif path == "/v1/analyzers":
+                        self._send(200, api.analyzers_api(body))
                     elif path == "/v1/agents/exec":
                         self._send(200, api.agent_exec(body))
                     elif path == "/v1/agent-group-config":

@@ -25,7 +25,12 @@ class Server:
     def __init__(self, host: str = "127.0.0.1", ingest_port: int = 20033,
                  query_port: int = 20416, data_dir: str | None = None,
                  sync_port: int = 20035, enable_controller: bool = False,
-                 ) -> None:
+                 ha_lease_path: str | None = None) -> None:
+        # HA: with a lease path, cluster SINGLETONS (controller, rollups,
+        # janitor) run only on the elected leader; every node serves
+        # ingest + query (reference: election.go:175 + monitor rebalance)
+        self.ha_lease_path = ha_lease_path
+        self.election = None
         self.db = Database(data_dir=data_dir)
         self.platform = PlatformInfoTable()
         from deepflow_tpu.server.platform_info import PodIpIndex
@@ -103,20 +108,42 @@ class Server:
             self.decoders.append(d.start())
         self.receiver.start()
         self.http.start()
-        self.rollup.start()
-        self.janitor.start()
         self.alerts.start()
+        if self.ha_lease_path:
+            from deepflow_tpu.server.election import LeaderElection
+            self.election = LeaderElection(
+                self.ha_lease_path,
+                on_elected=self._start_singletons,
+                on_deposed=self._stop_singletons).start()
+        else:
+            self._start_singletons()
         import os as _os
         if _os.environ.get("KUBERNETES_SERVICE_HOST"):
             self.start_genesis()  # in-cluster: watch automatically
-        if self.controller:
-            self.controller.start()
         self._started = True
         log.info("server up: ingest :%d query :%d",
                  self.receiver.port, self.http.port)
         return self
 
+    def _start_singletons(self) -> None:
+        """Leader-only components (no-op when already running)."""
+        if not self.rollup.running():
+            self.rollup.start()
+        if not self.janitor.running():
+            self.janitor.start()
+        if self.controller and not self.controller.running():
+            self.controller.start()
+
+    def _stop_singletons(self) -> None:
+        self.rollup.stop()
+        self.janitor.stop()
+        if self.controller:
+            self.controller.stop()
+
     def stop(self) -> None:
+        if self.election is not None:
+            self.election.stop()
+            self.election = None
         if self.genesis is not None:
             self.genesis.stop()
             self.genesis = None
@@ -126,12 +153,9 @@ class Server:
         for d in self.decoders:
             d.stop()
         self.http.stop()
-        self.rollup.stop()
-        self.janitor.stop()
+        self._stop_singletons()
         self.alerts.stop()
         self.exporters.stop()
-        if self.controller:
-            self.controller.stop()
         try:
             for err in self.db.flush():
                 log.error("flush: %s", err)

@@ -1,0 +1,260 @@
+"""HA / horizontal scale-out: leader election, analyzer rebalance,
+exporter disk spool.
+
+Reference analogs: controller/election/election.go:175, controller/monitor
+(analyzer rebalance), ingester exporter durability. VERDICT round-1
+missing #6 + weak #9.
+"""
+
+import http.server
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from deepflow_tpu.server.election import LeaderElection
+
+
+def test_single_candidate_wins_and_renews(tmp_path):
+    lease = str(tmp_path / "lease")
+    el = LeaderElection(lease, holder="a")
+    assert el.try_acquire() is True
+    assert el.is_leader and el.token == 1
+    assert el.try_acquire() is True       # renewal keeps the token
+    assert el.token == 1
+    assert el.stats["renewals"] == 1
+
+
+def test_second_candidate_defers_then_takes_over(tmp_path):
+    lease = str(tmp_path / "lease")
+    a = LeaderElection(lease, holder="a")
+    b = LeaderElection(lease, holder="b")
+    assert a.try_acquire() is True
+    assert b.try_acquire() is False       # kernel lock held by a
+    a.resign()                             # a dies / releases
+    assert b.try_acquire() is True
+    assert b.token == 2                    # fencing token advanced
+    # a comes back: lock is held, steps down stays down
+    assert a.try_acquire() is False
+    assert a.is_leader is False
+    # exactly one leader at every instant (flock is kernel-enforced)
+    assert b.is_leader
+
+
+def test_graceful_resign_hands_over(tmp_path):
+    lease = str(tmp_path / "lease")
+    a = LeaderElection(lease, holder="a", ttl_s=30.0)
+    b = LeaderElection(lease, holder="b", ttl_s=30.0)
+    assert a.try_acquire() is True
+    a.resign()
+    assert b.try_acquire() is True        # no TTL wait needed
+
+
+def test_server_singletons_follow_leadership(tmp_path):
+    """Two servers, one lease: exactly one runs the singletons; the
+    follower takes over when the leader resigns."""
+    from deepflow_tpu.server import Server
+    lease = str(tmp_path / "lease")
+    s1 = Server(host="127.0.0.1", ingest_port=0, query_port=0,
+                sync_port=0, enable_controller=True,
+                ha_lease_path=lease).start()
+    # make s1's election fast to observe
+    s2 = Server(host="127.0.0.1", ingest_port=0, query_port=0,
+                sync_port=0, enable_controller=True,
+                ha_lease_path=lease).start()
+    try:
+        leaders = [s.election.is_leader for s in (s1, s2)]
+        assert sorted(leaders) == [False, True]
+        leader, follower = (s1, s2) if s1.election.is_leader else (s2, s1)
+        assert leader.rollup.running() and leader.janitor.running()
+        assert leader.controller.running()
+        assert not follower.rollup.running()
+        assert not follower.controller.running()
+        # failover
+        leader.election.renew_interval_s = 0.2
+        follower.election.renew_interval_s = 0.2
+        leader.election.resign()
+        deadline = time.time() + 10
+        while time.time() < deadline and not follower.election.is_leader:
+            follower.election.try_acquire()
+            time.sleep(0.1)
+        assert follower.election.is_leader
+        deadline = time.time() + 5
+        while time.time() < deadline and not follower.rollup.running():
+            time.sleep(0.05)
+        assert follower.rollup.running() and follower.controller.running()
+    finally:
+        s1.stop()
+        s2.stop()
+
+
+def test_analyzer_rendezvous_assignment():
+    """Per-agent preference orders spread the fleet and stay mostly stable
+    when a node joins."""
+    from deepflow_tpu.server.controller import Controller
+    from deepflow_tpu.server.platform_info import PlatformInfoTable
+    ctrl = Controller(PlatformInfoTable())
+    ctrl.set_analyzers(["10.0.0.1:20033", "10.0.0.2:20033",
+                        "10.0.0.3:20033"])
+    first = {}
+    counts = {}
+    for agent_id in range(300):
+        order = ctrl.assign_analyzers(agent_id)
+        assert sorted(order) == sorted(ctrl.analyzers())
+        first[agent_id] = order[0]
+        counts[order[0]] = counts.get(order[0], 0) + 1
+    # spread: no analyzer owns everything
+    assert all(40 <= c <= 160 for c in counts.values()), counts
+    # minimal churn: adding a node moves only the agents it claims
+    ctrl.set_analyzers(["10.0.0.1:20033", "10.0.0.2:20033",
+                        "10.0.0.3:20033", "10.0.0.4:20033"])
+    moved = sum(1 for a in range(300)
+                if ctrl.assign_analyzers(a)[0] != first[a])
+    assert moved < 150  # rendezvous: ~1/4 expected, never a full reshuffle
+    for a in range(300):
+        new_first = ctrl.assign_analyzers(a)[0]
+        if new_first != first[a]:
+            assert new_first == "10.0.0.4:20033"
+
+
+def test_exporter_spool_and_replay(tmp_path):
+    """Exhausted retries spool to disk and replay when the destination
+    recovers; nothing silently drops."""
+    from deepflow_tpu.server.exporters import JsonLinesExporter
+
+    received = []
+    fail = {"on": True}
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(n)
+            if fail["on"]:
+                self.send_response(503)
+                self.end_headers()
+                return
+            received.append(body)
+            self.send_response(200)
+            self.end_headers()
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    exp = JsonLinesExporter(
+        f"http://127.0.0.1:{srv.server_port}/ingest",
+        spool_dir=str(tmp_path / "spool"))
+    exp.flush_interval_s = 0.2
+    exp.max_retries = 0
+    exp.start()
+    try:
+        exp.feed("flow_log.l4_flow_log", [{"flow_id": 1}, {"flow_id": 2}])
+        deadline = time.time() + 10
+        while time.time() < deadline and exp.stats["spooled"] < 2:
+            time.sleep(0.05)
+        assert exp.stats["spooled"] == 2
+        assert exp.stats["dropped"] == 0
+        assert os.listdir(tmp_path / "spool")
+        # destination recovers: next successful ship triggers replay
+        fail["on"] = False
+        exp.feed("flow_log.l4_flow_log", [{"flow_id": 3}])
+        deadline = time.time() + 10
+        while time.time() < deadline and exp.stats["replayed"] < 2:
+            time.sleep(0.05)
+        assert exp.stats["replayed"] == 2
+        assert not [f for f in os.listdir(tmp_path / "spool")
+                    if f.endswith(".spool")]
+        import gzip
+        flows = set()
+        for body in received:
+            for line in gzip.decompress(body).decode().splitlines():
+                flows.add(json.loads(line).get("flow_id"))
+        assert flows == {1, 2, 3}
+    finally:
+        exp.stop()
+        srv.shutdown()
+
+
+def test_analyzer_assignment_revert(tmp_path):
+    """Clearing the analyzer list reverts agents to configured servers."""
+    grpc = pytest.importorskip("grpc")  # noqa: F841
+    from deepflow_tpu.server import Server
+    from deepflow_tpu.agent.agent import Agent
+    from deepflow_tpu.agent.config import AgentConfig
+
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0,
+                    sync_port=0, enable_controller=True).start()
+    cfg = AgentConfig()
+    cfg.sender.servers = [("127.0.0.1", server.ingest_port)]
+    cfg.controller = f"127.0.0.1:{server.controller.port}"
+    cfg.sync_interval_s = 0.2
+    cfg.profiler.enabled = False
+    cfg.tpuprobe.enabled = False
+    cfg.guard.enabled = False
+    agent = Agent(cfg).start()
+    try:
+        configured = list(agent.sender.servers)
+        server.controller.set_analyzers(["10.9.9.9:20033"])
+        deadline = time.time() + 10
+        while time.time() < deadline and \
+                agent.sender.servers == configured:
+            time.sleep(0.1)
+        assert agent.sender.servers == [("10.9.9.9", 20033)]
+        server.controller.set_analyzers([])   # decommission the tier
+        deadline = time.time() + 10
+        while time.time() < deadline and \
+                agent.sender.servers != configured:
+            time.sleep(0.1)
+        assert agent.sender.servers == configured
+    finally:
+        agent.stop()
+        server.stop()
+
+
+def test_spool_survives_restart(tmp_path):
+    """Batches spooled by a previous process replay after restart."""
+    from deepflow_tpu.server.exporters import JsonLinesExporter
+    spool = str(tmp_path / "spool")
+    # process 1: destination down, batch lands in the spool
+    e1 = JsonLinesExporter("http://127.0.0.1:9/none", spool_dir=spool)
+    e1.flush_interval_s = 0.1
+    e1.max_retries = 0
+    e1.start()
+    e1.feed("flow_log.l4_flow_log", [{"flow_id": 77}])
+    deadline = time.time() + 10
+    while time.time() < deadline and e1.stats["spooled"] < 1:
+        time.sleep(0.05)
+    e1.stop()
+    assert [f for f in os.listdir(spool) if f.endswith(".spool")]
+
+    # process 2 (fresh exporter, healthy destination): replays the spool
+    received = []
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            received.append(self.rfile.read(n))
+            self.send_response(200)
+            self.end_headers()
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    e2 = JsonLinesExporter(f"http://127.0.0.1:{srv.server_port}/i",
+                           spool_dir=spool)
+    e2.flush_interval_s = 0.1
+    e2.start()
+    try:
+        deadline = time.time() + 15
+        while time.time() < deadline and e2.stats["replayed"] < 1:
+            time.sleep(0.05)
+        assert e2.stats["replayed"] == 1
+        assert not [f for f in os.listdir(spool) if f.endswith(".spool")]
+    finally:
+        e2.stop()
+        srv.shutdown()
