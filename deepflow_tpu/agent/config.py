@@ -182,6 +182,62 @@ class AgentConfig:
 DEFAULT_INGEST_PORT = 20033
 
 
+_TEMPLATE_DOCS = {
+    "agent_id": "0 = controller-assigned",
+    "app_service": "logical service name (defaults to process name)",
+    "group": "agent-group for config routing",
+    "controller": "host:port; empty = standalone mode",
+    "sslprobe_sock": "AF_UNIX path for the LD_PRELOAD ssl probe; empty=off",
+    "acls": "policy rules: [{cidr, port, protocol, action: trace|ignore}]",
+    "plugins": "parser plugin modules exporting PARSERS",
+    "profiler.sample_hz": "OnCPU sampling rate",
+    "profiler.external_pids": "out-of-process perf targets (any pid)",
+    "tpuprobe.source": "auto | xplane | hooks | sim",
+    "tpuprobe.target_coverage": "fraction of steps captured (0.01-0.95)",
+    "tpuprobe.steps_per_capture": "whole steps per capture window",
+    "flow.interface": "capture interface; empty = all",
+    "flow.exclude_ports": "never capture these ports (feedback guard)",
+    "sender.servers": "ingest endpoints, failover order",
+}
+
+
+def render_template() -> str:
+    """Documented YAML template generated FROM the dataclasses (reference:
+    the 6535-line template.yaml that validates agent-group configs —
+    here the dataclass is the single source of truth, so template and
+    validation can't drift)."""
+    import dataclasses
+    lines = ["# deepflow-tpu agent configuration template",
+             "# generated from AgentConfig (single source of truth);",
+             "# every value shows its default — uncomment to override.",
+             ""]
+
+    def emit(obj, prefix: str, indent: str) -> None:
+        for f in dataclasses.fields(obj):
+            val = getattr(obj, f.name)
+            key = f"{prefix}{f.name}" if prefix else f.name
+            doc = _TEMPLATE_DOCS.get(key)
+            if dataclasses.is_dataclass(val):
+                lines.append(f"{indent}{f.name}:")
+                emit(val, f"{key}.", indent + "  ")
+                continue
+            if doc:
+                lines.append(f"{indent}# {doc}")
+            if isinstance(val, (list, tuple)):
+                import json as _j
+                shown = _j.dumps([list(v) if isinstance(v, tuple) else v
+                                  for v in val])
+            elif isinstance(val, bool):
+                shown = "true" if val else "false"
+            else:
+                shown = repr(val) if isinstance(val, str) else str(val)
+            lines.append(f"{indent}{f.name}: {shown}")
+        lines.append("")
+
+    emit(AgentConfig(), "", "")
+    return "\n".join(lines)
+
+
 def _parse_addr(s: str) -> tuple[str, int]:
     host, sep, port = s.rpartition(":")
     if not sep:

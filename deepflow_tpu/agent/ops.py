@@ -37,6 +37,7 @@ class CommandRegistry:
             "flows": self._flows,
             "profilers": self._profilers,
             "upgrade": self._upgrade,
+            "pcap-capture": self._pcap_capture,
         }
 
     def names(self) -> list[str]:
@@ -115,6 +116,58 @@ class CommandRegistry:
             out[f"extprof-{ep.pid}"] = {"samples": ep.stats.samples,
                                         "lost": ep.lost}
         return out
+
+    def _pcap_capture(self, args):
+        """On-demand raw capture shipped to the server (reference: pcap
+        policy -> ingester pcap store). args: [seconds] [iface]
+        [max_packets]. Runs inline on the sync thread (bounded seconds)."""
+        import gzip
+        import socket as _s
+        import struct
+        import time as _t
+
+        seconds = min(float(args[0]) if args else 2.0, 30.0)
+        iface = args[1] if len(args) > 1 else ""
+        max_packets = int(args[2]) if len(args) > 2 else 2000
+        try:
+            sock = _s.socket(_s.AF_PACKET, _s.SOCK_RAW, _s.htons(0x0003))
+        except (PermissionError, AttributeError, OSError) as e:
+            return {"error": f"raw capture unavailable: {e}"}
+        if iface:
+            sock.bind((iface, 0))
+        sock.settimeout(0.2)
+        frames = []
+        start_ns = _t.time_ns()
+        deadline = _t.monotonic() + seconds
+        try:
+            while _t.monotonic() < deadline and len(frames) < max_packets:
+                try:
+                    frame, addr = sock.recvfrom(65535)
+                except _s.timeout:
+                    continue
+                if addr[0] == "lo" and addr[2] == _s.PACKET_OUTGOING:
+                    continue
+                frames.append((frame, _t.time_ns()))
+        finally:
+            sock.close()
+        buf = bytearray(struct.pack("<IHHiIII", 0xA1B2C3D4, 2, 4, 0, 0,
+                                    65535, 1))
+        for frame, ts in frames:
+            buf += struct.pack("<IIII", ts // 1_000_000_000,
+                               (ts % 1_000_000_000) // 1000,
+                               len(frame), len(frame))
+            buf += frame
+        from deepflow_tpu.proto import pb as _pb
+        up = _pb.PcapUpload()
+        up.name = f"cap-{start_ns}"
+        up.agent_id = self.agent.config.agent_id
+        up.start_ns = start_ns
+        up.packet_count = len(frames)
+        up.pcap_gz = gzip.compress(bytes(buf))
+        from deepflow_tpu.codec import MessageType
+        self.agent.sender.send(MessageType.PCAP, up.SerializeToString())
+        return {"name": up.name, "packets": len(frames),
+                "bytes_gz": len(up.pcap_gz)}
 
     def _upgrade(self, args):
         """OTA analog: drain and re-exec, picking up updated code from disk

@@ -41,6 +41,7 @@ class Synchronizer:
         self._platform_cache: pb.PlatformData | None = None
         self._configured_servers = list(agent.sender.servers)  # for revert
         self._pending_results: list = []
+        self._results_lock = threading.Lock()  # sync loop + upgrade timer
         from deepflow_tpu.agent.ops import CommandRegistry
         self._ops = CommandRegistry(agent)
         self._apply_lock = threading.Lock()  # poll + push threads both apply
@@ -131,7 +132,8 @@ class Synchronizer:
             req.mem_bytes = int(guard.rss_mb * 1024 * 1024)
         req.version = "0.1.0"
         req.agent_group = getattr(self.agent.config, "group", "") or "default"
-        sent_results = list(self._pending_results)
+        with self._results_lock:
+            sent_results = list(self._pending_results)
         for r in sent_results:
             req.command_results.append(r)
         # collect topology once, but RE-SEND every sync: a restarted
@@ -150,9 +152,13 @@ class Synchronizer:
             response_deserializer=pb.SyncResponse.FromString)
         resp = call(req, timeout=5.0)
         # results are only dropped once the controller HAS them: a failed
-        # RPC keeps them queued for the next sync
+        # RPC keeps them queued for the next sync (identity-based removal:
+        # a concurrent sync from the upgrade timer must not over-trim)
         if sent_results:
-            self._pending_results = self._pending_results[len(sent_results):]
+            with self._results_lock:
+                self._pending_results = [
+                    r for r in self._pending_results
+                    if not any(r is s for s in sent_results)]
         self.stats["syncs"] += 1
         self._on_response(resp)
         try:
@@ -214,8 +220,9 @@ class Synchronizer:
                 self._apply_analyzers(list(resp.analyzer_addrs))
         for rc in resp.commands:
             code, out = self._ops.run(rc.cmd, list(rc.args))
-            self._pending_results.append(pb.CommandResult(
-                id=rc.id, exit_code=code, output=out))
+            with self._results_lock:
+                self._pending_results.append(pb.CommandResult(
+                    id=rc.id, exit_code=code, output=out))
             self.stats["commands"] = self.stats.get("commands", 0) + 1
 
     def _apply_analyzers(self, addrs: list[str]) -> None:

@@ -42,6 +42,7 @@ class MessageType(IntEnum):
     OTEL = 8             # OTLP passthrough (integration collector)
     PROMETHEUS = 9       # remote-write passthrough
     APP_LOG = 10
+    PCAP = 11            # on-demand capture uploads (pcap policy)
 
 
 @dataclass(frozen=True)

@@ -125,6 +125,17 @@ class GpidAllocator:
         self._gpids[(agent_id, pid)] = g
         return g
 
+    def lookup(self, ip: bytes, port: int, proto: int) -> int:
+        """Ingest-side join (reference grpc_platformdata.go:2047): map a
+        flow endpoint to its global process id; tries server role (exact
+        listen tuple) then client role."""
+        with self._lock:
+            for role in (1, 0):
+                e = self._entries.get((ip, port, proto, role))
+                if e is not None:
+                    return e.gpid
+        return 0
+
 
 class ConfigStore:
     """Versioned agent-group configs (reference: agent-group config YAML
@@ -312,6 +323,9 @@ class Controller:
         return resp
 
     def set_analyzers(self, addrs: list[str]) -> None:
+        from deepflow_tpu.agent.config import _parse_addr
+        for a in addrs:  # reject bad addresses HERE, not per-agent later
+            _parse_addr(a)  # raises ValueError
         with self._analyzer_lock:
             self._analyzers = list(dict.fromkeys(addrs))
             self._analyzers_managed = True

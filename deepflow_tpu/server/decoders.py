@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import queue
 import threading
 
@@ -30,12 +31,13 @@ class Decoder:
 
     def __init__(self, q: queue.Queue, db: Database,
                  platform: PlatformInfoTable, exporters=None,
-                 pod_index=None) -> None:
+                 pod_index=None, gpid_table=None) -> None:
         self.q = q
         self.db = db
         self.platform = platform
         self.exporters = exporters
         self.pod_index = pod_index  # K8s genesis IP->pod (optional)
+        self.gpid_table = gpid_table  # controller GpidAllocator (optional)
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.stats = {"batches": 0, "rows": 0, "errors": 0}
@@ -141,11 +143,62 @@ class TpuSpanDecoder(Decoder):
         return len(rows)
 
 
+class PcapDecoder(Decoder):
+    """PcapUpload -> data_dir/pcaps/<name>.pcap.gz (or memory when no
+    data_dir). Reference: ingester pcap module."""
+
+    MSG_TYPE = MessageType.PCAP
+    MAX_MEMORY = 64
+
+    @staticmethod
+    def _safe_name(name: str) -> str:
+        """Wire-controlled names must never traverse paths."""
+        import re
+        cleaned = re.sub(r"[^A-Za-z0-9._-]", "_", os.path.basename(name))
+        return cleaned.lstrip(".") or "unnamed"
+
+    def handle(self, header: FrameHeader, payload: bytes) -> int:
+        up = pb.PcapUpload.FromString(payload)
+        safe = self._safe_name(up.name)
+        entry = {"name": safe, "agent_id": up.agent_id or
+                 header.agent_id, "start_ns": up.start_ns,
+                 "packet_count": up.packet_count,
+                 "bytes_gz": len(up.pcap_gz)}
+        store = getattr(self.db, "pcap_store", None)
+        if store is None:
+            store = self.db.pcap_store = {"dir": None, "entries": []}
+            if self.db.data_dir:
+                store["dir"] = os.path.join(self.db.data_dir, "pcaps")
+                os.makedirs(store["dir"], exist_ok=True)
+        if store["dir"]:
+            path = os.path.join(store["dir"], f"{safe}.pcap.gz")
+            with open(path, "wb") as f:
+                f.write(up.pcap_gz)
+            entry["path"] = path
+        else:
+            entry["data"] = up.pcap_gz
+        store["entries"].append(entry)
+        for old in store["entries"][:-self.MAX_MEMORY]:
+            p = old.get("path")  # evicted captures must not leak disk
+            if p:
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+        del store["entries"][:-self.MAX_MEMORY]
+        return 1
+
+
 class FlowLogDecoder(Decoder):
     """FlowLogBatch -> flow_log.l4_flow_log / l7_flow_log. Registered for
     both L4_LOG and L7_LOG message types."""
 
     MSG_TYPE = MessageType.L4_LOG
+
+    def _gpid(self, ip: bytes, port: int, proto: int) -> int:
+        if self.gpid_table is None:
+            return 0
+        return self.gpid_table.lookup(bytes(ip), port, proto)
 
     def handle(self, header: FrameHeader, payload: bytes) -> int:
         batch = pb.FlowLogBatch.FromString(payload)
@@ -184,7 +237,10 @@ class FlowLogDecoder(Decoder):
                     "zero_win_tx": f.zero_win_tx, "zero_win_rx": f.zero_win_rx,
                     "close_type": _close_type_idx(f.close_type),
                     "syn_count": f.syn_count, "synack_count": f.synack_count,
-                    "gprocess_id_0": f.gpid_0, "gprocess_id_1": f.gpid_1,
+                    "gprocess_id_0": f.gpid_0 or self._gpid(
+                        f.key.ip_src, f.key.port_src, int(f.key.proto)),
+                    "gprocess_id_1": f.gpid_1 or self._gpid(
+                        f.key.ip_dst, f.key.port_dst, int(f.key.proto)),
                     "pod_0": f.pod_0 or pod_of(src_s),
                     "pod_1": f.pod_1 or pod_of(dst_s),
                     **tags,
@@ -224,7 +280,10 @@ class FlowLogDecoder(Decoder):
                     "syscall_thread_1": f.syscall_thread_1,
                     "captured_request_byte": f.captured_request_byte,
                     "captured_response_byte": f.captured_response_byte,
-                    "gprocess_id_0": f.gpid_0, "gprocess_id_1": f.gpid_1,
+                    "gprocess_id_0": f.gpid_0 or self._gpid(
+                        f.key.ip_src, f.key.port_src, int(f.key.proto)),
+                    "gprocess_id_1": f.gpid_1 or self._gpid(
+                        f.key.ip_dst, f.key.port_dst, int(f.key.proto)),
                     "pod_0": f.pod_0 or pod_of(src_s),
                     "pod_1": f.pod_1 or pod_of(dst_s),
                     "process_kname_0": f.process_kname_0,

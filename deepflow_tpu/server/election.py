@@ -48,6 +48,7 @@ class LeaderElection:
         self.is_leader = False
         self.token = 0          # fencing token of OUR leadership
         self._fd: int | None = None
+        self._acquire_lock = threading.Lock()  # ticks + manual calls race
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.stats = {"elections": 0, "renewals": 0, "depositions": 0}
@@ -56,7 +57,12 @@ class LeaderElection:
 
     def try_acquire(self) -> bool:
         """One acquire attempt; returns current leadership. Holding the
-        flock IS leadership — renewal is a no-op heartbeat."""
+        flock IS leadership — renewal is a no-op heartbeat. Serialized:
+        a concurrent losing attempt must never depose a winning one."""
+        with self._acquire_lock:
+            return self._try_acquire_locked()
+
+    def _try_acquire_locked(self) -> bool:
         if self.is_leader and self._fd is not None:
             self.stats["renewals"] += 1
             return True
@@ -110,6 +116,10 @@ class LeaderElection:
 
     def resign(self) -> None:
         """Graceful handoff: release the lock so a follower wins at once."""
+        with self._acquire_lock:
+            self._resign_locked()
+
+    def _resign_locked(self) -> None:
         fd, self._fd = self._fd, None
         if fd is not None:
             try:

@@ -152,6 +152,9 @@ class BaseExporter:
                            if f.endswith(".spool"))
         except OSError:
             return
+        attempts = getattr(self, "_replay_attempts", None)
+        if attempts is None:
+            attempts = self._replay_attempts = {}
         for fn in files[:max_files]:
             path = os.path.join(self.spool_dir, fn)
             try:
@@ -159,9 +162,29 @@ class BaseExporter:
                     batch = pickle.load(f)
                 self._ship(batch)
                 os.unlink(path)
+                attempts.pop(fn, None)
                 self.stats["replayed"] += len(batch)
                 self.stats["exported"] += len(batch)
             except Exception as e:
+                # a file the destination deterministically rejects must not
+                # block everything behind it forever: quarantine after 5
+                # tries (visible in spool_dropped + the .bad file on disk)
+                attempts[fn] = attempts.get(fn, 0) + 1
+                if attempts.get(fn, 0) >= 5:
+                    try:
+                        n = 0
+                        try:
+                            with open(path, "rb") as f:
+                                n = len(pickle.load(f))
+                        except Exception:
+                            pass
+                        os.replace(path, path + ".bad")
+                        self.stats["spool_dropped"] += n
+                        attempts.pop(fn, None)
+                        log.warning("quarantined poison spool file %s", fn)
+                        continue
+                    except OSError:
+                        pass
                 log.debug("spool replay stopped at %s: %s", fn, e)
                 return  # destination flapped again; keep the file
 

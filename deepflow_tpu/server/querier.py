@@ -249,12 +249,38 @@ class QuerierAPI:
             self.db.table("flow_log.l7_flow_log"), trace_id,
             tpu_table=self.db.table("profile.tpu_hlo_span"))}
 
+    def pcaps(self, body: dict | None = None) -> dict:
+        store = getattr(self.db, "pcap_store", None)
+        entries = list(store["entries"]) if store else []
+        if body and body.get("name"):
+            import base64
+            import os
+            for e in entries:
+                if e["name"] == body["name"]:
+                    data = e.get("data")
+                    if data is None and e.get("path") and \
+                            os.path.exists(e["path"]):
+                        with open(e["path"], "rb") as f:
+                            data = f.read()
+                    if data is None:
+                        raise qengine.QueryError("capture data gone")
+                    return {"name": e["name"],
+                            "pcap_gz_b64":
+                                base64.b64encode(data).decode()}
+            raise qengine.QueryError(f"no capture {body['name']!r}")
+        return {"pcaps": [{k: v for k, v in e.items()
+                           if k not in ("data",)} for e in entries]}
+
     def analyzers_api(self, body: dict | None = None) -> dict:
         if self.controller is None:
             raise qengine.QueryError("no controller")
         if body and "addrs" in body:
             addrs = [str(a) for a in body["addrs"]]
-            self.controller.set_analyzers(addrs)
+            try:
+                self.controller.set_analyzers(addrs)
+            except ValueError as e:
+                raise qengine.QueryError(f"bad analyzer address: {e}") \
+                    from None
         return {"analyzers": self.controller.analyzers()}
 
     def agent_exec(self, body: dict) -> dict:
@@ -405,6 +431,8 @@ class QuerierHTTP:
                         self._send(200, api.tpu_collectives(body))
                     elif path == "/v1/profile/TpuStepTrace":
                         self._send(200, api.tpu_step_trace(body))
+                    elif path == "/v1/pcaps":
+                        self._send(200, api.pcaps(body))
                     elif path == "/v1/analyzers":
                         self._send(200, api.analyzers_api(body))
                     elif path == "/v1/agents/exec":

@@ -91,7 +91,9 @@ class Server:
         if self.db.data_dir:
             self.db.load()  # resume persisted tables
         # register all queues BEFORE listening: no drop window on restart
+        from deepflow_tpu.server.decoders import PcapDecoder
         pairs = [
+            (PcapDecoder, MessageType.PCAP),
             (ProfileDecoder, MessageType.PROFILE),
             (TpuSpanDecoder, MessageType.TPU_SPAN),
             (FlowLogDecoder, MessageType.L4_LOG),
@@ -103,7 +105,9 @@ class Server:
         for cls, mtype in pairs:
             q = self.receiver.register(mtype)
             d = cls(q, self.db, self.platform, exporters=self.exporters,
-                    pod_index=self.pod_index)
+                    pod_index=self.pod_index,
+                    gpid_table=(self.controller.gpids
+                                if self.controller else None))
             d.MSG_TYPE = mtype  # FlowLogDecoder serves two types
             self.decoders.append(d.start())
         self.receiver.start()

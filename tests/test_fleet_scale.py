@@ -253,3 +253,44 @@ def test_old_chunks_survive_new_columns(tmp_path):
     out = new.column_concat(["time", "added"])
     assert out["time"].tolist() == [1, 2]
     assert out["added"].tolist() == [0, 0]  # dict code 0 == ""
+
+
+def test_gpid_ingest_side_join():
+    """Flows ingested without agent-side gpids get them joined from the
+    controller's 5-tuple table (grpc_platformdata.go:2047 analog)."""
+    import socket as _s
+    from deepflow_tpu.server import Server
+    from deepflow_tpu.proto import pb
+    from deepflow_tpu.agent.sender import UniformSender
+    from deepflow_tpu.agent.dispatcher import Dispatcher
+    from deepflow_tpu.agent.packet import TcpFlags, build_tcp
+
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0,
+                    sync_port=0, enable_controller=True).start()
+    try:
+        # a process registers its listen tuple via GpidSync
+        req = pb.GpidSyncRequest(agent_id=1)
+        e = req.entries.add()
+        e.agent_id = 1
+        e.pid = 4242
+        e.ip = _s.inet_aton("10.244.1.9")
+        e.port = 80
+        e.proto = 1
+        e.role = 1  # server/listen
+        server.controller.gpids.sync(req)
+        expected_gpid = server.controller.gpids.gpid_for(1, 4242)
+
+        sender = UniformSender(
+            servers=[("127.0.0.1", server.ingest_port)]).start()
+        disp = Dispatcher(sender=sender, engine="python")
+        disp.inject(build_tcp("10.244.1.5", "10.244.1.9", 40000, 80,
+                              TcpFlags.SYN, timestamp_ns=time.time_ns()))
+        disp.flush(force=True)
+        assert server.wait_for_rows("flow_log.l4_flow_log", 1, timeout=10)
+        sender.flush_and_stop()
+        from deepflow_tpu.query import execute
+        t = server.db.table("flow_log.l4_flow_log")
+        r = execute(t, "SELECT gprocess_id_0, gprocess_id_1 FROM t")
+        assert r.values[0][1] == expected_gpid  # dst side joined
+    finally:
+        server.stop()

@@ -154,3 +154,83 @@ def test_parser_plugin_loads_and_wins():
     finally:
         del sys.modules["df_test_plugin"]
         del REGISTRY[0: len(REGISTRY) - before]
+
+
+def test_pcap_capture_ships_to_server():
+    """On-demand pcap capture (reference: ingester pcap module): the
+    command captures live frames, ships them, the server stores and
+    serves them for download."""
+    import base64
+    import gzip
+    import socket as _s
+    import threading
+    try:
+        probe = _s.socket(_s.AF_PACKET, _s.SOCK_RAW)
+        probe.close()
+    except (PermissionError, AttributeError, OSError):
+        pytest.skip("no CAP_NET_RAW")
+    from deepflow_tpu.server import Server
+    from deepflow_tpu.agent.packet import read_pcap_records
+
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0).start()
+    cfg = AgentConfig()
+    cfg.sender.servers = [("127.0.0.1", server.ingest_port)]
+    cfg.profiler.enabled = False
+    cfg.tpuprobe.enabled = False
+    cfg.guard.enabled = False
+    agent = Agent(cfg).start()
+    reg = CommandRegistry(agent)
+    try:
+        # traffic generator during the capture window
+        stopgen = threading.Event()
+
+        def gen():
+            while not stopgen.is_set():
+                s = _s.socket(_s.AF_INET, _s.SOCK_DGRAM)
+                s.sendto(b"ping", ("127.0.0.1", 19999))
+                s.close()
+                time.sleep(0.02)
+
+        threading.Thread(target=gen, daemon=True).start()
+        code, out = reg.run("pcap-capture", ["1.5", "lo"])
+        stopgen.set()
+        assert code == 0, out
+        import json as _json
+        meta = _json.loads(out)
+        assert meta["packets"] > 0
+        deadline = time.time() + 10
+        while time.time() < deadline and \
+                not getattr(server.db, "pcap_store", {"entries": []}
+                            )["entries"]:
+            time.sleep(0.1)
+        listing = server.api.pcaps()["pcaps"]
+        assert listing and listing[0]["name"] == meta["name"]
+        dl = server.api.pcaps({"name": meta["name"]})
+        raw = gzip.decompress(base64.b64decode(dl["pcap_gz_b64"]))
+        import tempfile
+        with tempfile.NamedTemporaryFile(suffix=".pcap") as f:
+            f.write(raw)
+            f.flush()
+            recs = read_pcap_records(f.name)
+        assert len(recs) == meta["packets"]
+    finally:
+        agent.stop()
+        server.stop()
+
+
+def test_config_template_roundtrip():
+    """The generated template parses, validates, and matches defaults
+    (the dataclass is the single source of truth — no drift possible)."""
+    import yaml
+    from dataclasses import asdict
+    from deepflow_tpu.agent.config import render_template
+    text = render_template()
+    data = yaml.safe_load(text)
+    cfg = AgentConfig.from_dict(data).validate()
+    assert asdict(cfg) == asdict(AgentConfig())
+    # checked-in copy stays current
+    import os
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "agent-template.yaml")
+    assert open(path).read() == text, \
+        "regenerate docs/agent-template.yaml (render_template changed)"
