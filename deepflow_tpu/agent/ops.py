@@ -128,7 +128,8 @@ class CommandRegistry:
 
         seconds = min(float(args[0]) if args else 2.0, 30.0)
         iface = args[1] if len(args) > 1 else ""
-        max_packets = int(args[2]) if len(args) > 2 else 2000
+        max_packets = min(int(args[2]) if len(args) > 2 else 2000,
+                          100_000)  # bound agent memory
         try:
             sock = _s.socket(_s.AF_PACKET, _s.SOCK_RAW, _s.htons(0x0003))
         except (PermissionError, AttributeError, OSError) as e:

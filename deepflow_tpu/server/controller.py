@@ -129,11 +129,12 @@ class GpidAllocator:
         """Ingest-side join (reference grpc_platformdata.go:2047): map a
         flow endpoint to its global process id; tries server role (exact
         listen tuple) then client role."""
-        with self._lock:
-            for role in (1, 0):
-                e = self._entries.get((ip, port, proto, role))
-                if e is not None:
-                    return e.gpid
+        entries = self._entries  # GIL-atomic point reads; values are
+        # replaced per sync, never mutated after insertion
+        for role in (1, 0):
+            e = entries.get((ip, port, proto, role))
+            if e is not None:
+                return e.gpid
         return 0
 
 

@@ -46,7 +46,11 @@ class K8sGenesis:
     def __init__(self, pod_index: PodIpIndex, api_base: str | None = None,
                  token: str = "", ca_path: str = "",
                  watch_timeout_s: int = 300,
-                 insecure_skip_verify: bool = False) -> None:
+                 insecure_skip_verify: bool = False,
+                 event_sink=None) -> None:
+        # event_sink(rows) receives resource-change events (reference:
+        # controller/recorder resource diffs -> event tables)
+        self.event_sink = event_sink
         if api_base is None:
             cfg = in_cluster_config()
             if cfg is None:
@@ -97,7 +101,8 @@ class K8sGenesis:
                 return name
         return ""
 
-    def _apply(self, event_type: str, pod: dict) -> None:
+    def _apply(self, event_type: str, pod: dict,
+               emit_events: bool = True) -> None:
         meta = pod.get("metadata", {})
         status = pod.get("status", {})
         ips = [e.get("ip") for e in status.get("podIPs", [])
@@ -117,6 +122,21 @@ class K8sGenesis:
         else:  # ADDED | MODIFIED
             for ip in ips:
                 self.pod_index.upsert(ip, info)
+        if emit_events and self.event_sink is not None and \
+                event_type in ("ADDED", "DELETED"):
+            import time as _t
+            try:
+                self.event_sink([{
+                    "time": _t.time_ns(),
+                    "event_type": f"pod-{event_type.lower()}",
+                    "resource_type": "pod",
+                    "resource_name": f"{info.namespace}/{info.name}",
+                    "description": f"node={info.node} "
+                                   f"workload={info.workload} "
+                                   f"ips={','.join(ips)}",
+                }])
+            except Exception:
+                log.debug("event sink failed", exc_info=True)
 
     # -- list + watch ----------------------------------------------------------
 
@@ -134,7 +154,9 @@ class K8sGenesis:
             with self._open(path, timeout=30) as r:
                 data = json.load(r)
             for pod in data.get("items", []):
-                self._apply("ADDED", pod)
+                # relist reconciles STATE; it must not re-emit pod-added
+                # for pods that merely survived a watch gap
+                self._apply("ADDED", pod, emit_events=False)
                 status = pod.get("status", {})
                 for e in status.get("podIPs", []):
                     if e.get("ip"):

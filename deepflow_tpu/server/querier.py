@@ -39,6 +39,8 @@ class QuerierAPI:
             prom_encoder=getattr(controller, "prom_encoder", None))
         from deepflow_tpu.server.mcp import McpServer
         self.mcp = McpServer(self)
+        from deepflow_tpu.query.tracing_adapter import AdapterRegistry
+        self.trace_adapters = AdapterRegistry()
 
     def alerts_api(self, method: str, body: dict) -> dict:
         if self.alerts is None:
@@ -245,9 +247,25 @@ class QuerierAPI:
         if not trace_id:
             raise qengine.QueryError("trace_id or syscall_trace_id required")
         from deepflow_tpu.query.tracing import build_trace
-        return {"result": build_trace(
+        tree = build_trace(
             self.db.table("flow_log.l7_flow_log"), trace_id,
-            tpu_table=self.db.table("profile.tpu_hlo_span"))}
+            tpu_table=self.db.table("profile.tpu_hlo_span"))
+        # tracing adapter: splice spans from configured EXTERNAL backends
+        tree = self.trace_adapters.merge_into(tree, trace_id)
+        return {"result": tree}
+
+    def tracing_adapters_api(self, body: dict | None = None) -> dict:
+        if body and body.get("remove"):
+            return {"removed": self.trace_adapters.remove(
+                str(body["remove"])),
+                "adapters": self.trace_adapters.list()}
+        if body and body.get("kind"):
+            try:
+                self.trace_adapters.add(str(body["kind"]),
+                                        str(body.get("base_url", "")))
+            except ValueError as e:
+                raise qengine.QueryError(str(e)) from None
+        return {"adapters": self.trace_adapters.list()}
 
     def pcaps(self, body: dict | None = None) -> dict:
         store = getattr(self.db, "pcap_store", None)
@@ -431,6 +449,8 @@ class QuerierHTTP:
                         self._send(200, api.tpu_collectives(body))
                     elif path == "/v1/profile/TpuStepTrace":
                         self._send(200, api.tpu_step_trace(body))
+                    elif path == "/v1/tracing-adapters":
+                        self._send(200, api.tracing_adapters_api(body))
                     elif path == "/v1/pcaps":
                         self._send(200, api.pcaps(body))
                     elif path == "/v1/analyzers":

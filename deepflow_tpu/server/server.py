@@ -68,8 +68,12 @@ class Server:
         empty). Returns False when no cluster is reachable."""
         from deepflow_tpu.server.genesis import K8sGenesis
         try:
+            def _events(rows):
+                self.db.table("event.event").append_rows(rows)
+
             self.genesis = K8sGenesis(self.pod_index, api_base=api_base,
-                                      token=token, ca_path=ca_path).start()
+                                      token=token, ca_path=ca_path,
+                                      event_sink=_events).start()
             return True
         except (RuntimeError, ValueError) as e:
             # ValueError: https without ca (e.g. serviceaccount ca.crt

@@ -1,0 +1,194 @@
+"""Tracing adapter: external APM spans spliced into the trace view.
+
+Reference analog: server/querier/app/tracing-adapter (SkyWalking et al).
+VERDICT round-1 §2.5 "Tracing adapter: no".
+"""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from deepflow_tpu.query.tracing_adapter import (
+    AdapterRegistry, JaegerAdapter, OtlpJsonAdapter)
+
+TRACE_ID = "4bf92f3577b34da6a3ce929d0e0e4736"
+
+
+class _FakeJaeger(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        body = json.dumps({"data": [{
+            "processes": {"p1": {"serviceName": "checkout"}},
+            "spans": [
+                {"spanID": "aaa1", "operationName": "charge-card",
+                 "processID": "p1", "startTime": 1_000_100,
+                 "duration": 400,
+                 "references": [{"refType": "CHILD_OF",
+                                 "spanID": "flowspan1"}]},
+                {"spanID": "aaa2", "operationName": "emit-receipt",
+                 "processID": "p1", "startTime": 1_000_600,
+                 "duration": 100, "references": [
+                     {"refType": "CHILD_OF", "spanID": "aaa1"}]},
+            ]}]}).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def test_jaeger_adapter_fetch():
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _FakeJaeger)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        spans = JaegerAdapter(
+            f"http://127.0.0.1:{srv.server_port}").fetch(TRACE_ID)
+        assert len(spans) == 2
+        by_id = {s.span_id: s for s in spans}
+        assert by_id["aaa1"].service == "checkout"
+        assert by_id["aaa1"].parent_span_id == "flowspan1"
+        assert by_id["aaa2"].parent_span_id == "aaa1"
+        assert by_id["aaa1"].start_ns == 1_000_100_000
+    finally:
+        srv.shutdown()
+
+
+def test_otlp_adapter_fetch():
+    class H(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            body = json.dumps({"resourceSpans": [{
+                "resource": {"attributes": [
+                    {"key": "service.name",
+                     "value": {"stringValue": "payments"}}]},
+                "scopeSpans": [{"spans": [
+                    {"spanId": "bbb1", "parentSpanId": "",
+                     "name": "POST /pay",
+                     "startTimeUnixNano": "1000",
+                     "endTimeUnixNano": "2000"}]}]}]}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        spans = OtlpJsonAdapter(
+            f"http://127.0.0.1:{srv.server_port}").fetch(TRACE_ID)
+        assert len(spans) == 1
+        assert spans[0].service == "payments"
+        assert spans[0].name == "POST /pay"
+    finally:
+        srv.shutdown()
+
+
+def test_adapter_merges_into_flow_trace():
+    """External spans splice under the flow span they reference; the trace
+    endpoint serves the merged tree."""
+    from deepflow_tpu.server import Server
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _FakeJaeger)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0).start()
+    try:
+        t = server.db.table("flow_log.l7_flow_log")
+        t.append_rows([{
+            "time": 1_000_000_000, "flow_id": 1,
+            "request_type": "POST", "endpoint": "/checkout",
+            "response_duration": 2_000_000,
+            "trace_id": TRACE_ID, "span_id": "flowspan1",
+            "response_status": 1, "response_code": 200,
+        }])
+        server.api.trace_adapters.add(
+            "jaeger", f"http://127.0.0.1:{srv.server_port}")
+        out = server.api.trace({"trace_id": TRACE_ID})["result"]
+        assert out["span_count"] == 3
+        assert out["external_spans"] == 2
+        root = out["spans"][0]
+        assert root["span_id"] == "flowspan1"
+        child_names = {c["name"] for c in root["children"]}
+        assert "charge-card" in child_names
+        charge = [c for c in root["children"]
+                  if c["name"] == "charge-card"][0]
+        assert charge["children"][0]["name"] == "emit-receipt"
+        assert charge["kind"] == "external"
+    finally:
+        server.stop()
+        srv.shutdown()
+
+
+def test_genesis_events_recorded():
+    """Pod ADDED/DELETED from the watch land in event.event (recorder
+    resource-diff analog)."""
+    from deepflow_tpu.server.genesis import K8sGenesis
+    from deepflow_tpu.server.platform_info import PodIpIndex
+    rows = []
+    gen = K8sGenesis(PodIpIndex(), api_base="http://127.0.0.1:1",
+                     event_sink=lambda r: rows.extend(r))
+    pod = {"metadata": {"name": "web-1", "namespace": "prod"},
+           "spec": {"nodeName": "n1"},
+           "status": {"podIP": "10.244.1.5",
+                      "podIPs": [{"ip": "10.244.1.5"}]}}
+    gen._apply("ADDED", pod)
+    gen._apply("MODIFIED", pod)   # not an event
+    gen._apply("DELETED", pod)
+    assert [r["event_type"] for r in rows] == ["pod-added", "pod-deleted"]
+    assert rows[0]["resource_name"] == "prod/web-1"
+    assert "10.244.1.5" in rows[0]["description"]
+
+
+def test_adapter_add_idempotent_and_remove():
+    reg = AdapterRegistry()
+    reg.add("jaeger", "http://x:1/")
+    reg.add("jaeger", "http://x:1")     # dedup (trailing slash too)
+    assert len(reg.list()) == 1
+    assert reg.remove("http://x:1") is True
+    assert reg.list() == []
+
+
+def test_merge_survives_mutually_referencing_spans():
+    """External spans forming a parent cycle fall back to containment —
+    the merged tree must stay acyclic (json-serializable)."""
+    from deepflow_tpu.query.tracing import TraceSpan
+    reg = AdapterRegistry()
+
+    class Fake:
+        name = "fake"
+        base = "x"
+
+        def fetch(self, trace_id):
+            return [
+                TraceSpan(span_id="c1", parent_span_id="c2", name="a",
+                          service="s", l7_protocol="app", start_ns=10,
+                          end_ns=20, status="ok", response_code=0),
+                TraceSpan(span_id="c2", parent_span_id="c1", name="b",
+                          service="s", l7_protocol="app", start_ns=12,
+                          end_ns=18, status="ok", response_code=0),
+            ]
+
+    reg._adapters.append(Fake())
+    tree = {"trace_id": "t", "span_count": 1, "spans": [{
+        "span_id": "flow1", "name": "root", "start_ns": 0, "end_ns": 100,
+        "children": []}]}
+    merged = reg.merge_into(tree, "t")
+    json.dumps(merged)  # acyclic or this raises
+    assert merged["external_spans"] == 2
+
+
+def test_relist_does_not_reemit_added_events():
+    from deepflow_tpu.server.genesis import K8sGenesis
+    from deepflow_tpu.server.platform_info import PodIpIndex
+    rows = []
+    gen = K8sGenesis(PodIpIndex(), api_base="http://127.0.0.1:1",
+                     event_sink=lambda r: rows.extend(r))
+    pod = {"metadata": {"name": "w", "namespace": "p"},
+           "spec": {"nodeName": "n"},
+           "status": {"podIP": "10.0.0.1", "podIPs": [{"ip": "10.0.0.1"}]}}
+    gen._apply("ADDED", pod, emit_events=False)  # what list_once does
+    assert rows == []
+    gen._apply("ADDED", pod)                     # real watch event
+    assert len(rows) == 1
