@@ -108,6 +108,16 @@ def main(argv: list[str] | None = None) -> int:
     p_tpu.add_argument("--include-host", action="store_true",
                        help="include host compile/runtime spans")
 
+    p_coll = sub.add_parser("collectives",
+                            help="cross-device collective groups "
+                                 "(latency/skew/bandwidth)")
+    p_coll.add_argument("--start", type=int, default=None)
+    p_coll.add_argument("--end", type=int, default=None)
+
+    p_step = sub.add_parser("step-trace",
+                            help="one training step stitched across devices")
+    p_step.add_argument("--run-id", type=int, default=None)
+
     p_replay = sub.add_parser("replay")
     p_replay.add_argument("pcap")
     p_replay.add_argument("--ingest", default="127.0.0.1:20033")
@@ -163,6 +173,37 @@ def main(argv: list[str] | None = None) -> int:
             _time.sleep(0.5)
         print("timed out waiting for result", rid)
         return 2
+    elif args.cmd == "collectives":
+        body = {}
+        if args.start:
+            body["time_start"] = args.start
+        if args.end:
+            body["time_end"] = args.end
+        out = _api(args.server, "/v1/profile/TpuCollectives", body)
+        rows = [[g["collective"], g["hlo_op"], g["run_id"],
+                 g["n_participants"], g["latency_ns"], g["skew_ns"],
+                 g["algo_bw_gbyte_s"]] for g in out["result"]]
+        print_table(["COLLECTIVE", "OP", "RUN", "DEVS", "LATENCY_NS",
+                     "SKEW_NS", "GB/S"], rows)
+    elif args.cmd == "step-trace":
+        body = {}
+        if args.run_id is not None:
+            body["run_id"] = args.run_id
+        tr = _api(args.server, "/v1/profile/TpuStepTrace", body)["result"]
+        if not tr["devices"]:
+            print("(no TPU span data)")
+            return 0
+        print(f"run {tr['run_id']}: step {tr['step_latency_ns']:,}ns, "
+              f"device skew {tr['device_skew_ns']:,}ns")
+        rows = [[d, v["compute_ns"], v["collective_ns"], v["n_spans"]]
+                for d, v in sorted(tr["devices"].items(),
+                                   key=lambda kv: int(kv[0]))]
+        print_table(["DEVICE", "COMPUTE_NS", "COLLECTIVE_NS", "SPANS"],
+                    rows)
+        for g in tr["collectives"]:
+            print(f"  {g['collective']} {g['hlo_op']}: "
+                  f"{g['latency_ns']:,}ns across "
+                  f"{g['n_participants']} devices (skew {g['skew_ns']}ns)")
     elif args.cmd == "agent-group-config":
         with open(args.file) as f:
             yaml_text = f.read()

@@ -120,6 +120,9 @@ class AdapterRegistry:
             raise ValueError(
                 f"unknown adapter {kind!r}; known: {sorted(_ADAPTERS)}")
         base_url = base_url.rstrip("/")
+        if not base_url.startswith(("http://", "https://")):
+            raise ValueError(
+                f"base_url must be an http(s) URL, got {base_url!r}")
         for a in self._adapters:  # idempotent: reconcile loops re-POST
             if a.name == kind and a.base == base_url:
                 return
@@ -141,11 +144,30 @@ class AdapterRegistry:
         (parent links by span id when the app propagated W3C context,
         time containment otherwise)."""
         external: list[TraceSpan] = []
-        for a in self._adapters:
-            try:
-                external.extend(a.fetch(trace_id))
-            except Exception as e:
-                log.debug("adapter %s fetch failed: %s", a.name, e)
+        if not self._adapters:
+            return tree
+        # concurrent fetches: one dead backend must not serialize a 5s
+        # stall per adapter into every trace query
+        import concurrent.futures as _fut
+        import time as _time
+        with _fut.ThreadPoolExecutor(
+                max_workers=min(4, len(self._adapters))) as pool:
+            futs = {pool.submit(a.fetch, trace_id): a
+                    for a in self._adapters}
+            for f in _fut.as_completed(futs):
+                a = futs[f]
+                try:
+                    external.extend(f.result())
+                except Exception as e:
+                    # visible, but throttled to one warning/min per adapter
+                    now = _time.monotonic()
+                    last = getattr(a, "_last_warn", 0)
+                    if now - last > 60:
+                        a._last_warn = now
+                        log.warning("tracing adapter %s (%s) failed: %s",
+                                    a.name, a.base, e)
+                    else:
+                        log.debug("adapter %s fetch failed: %s", a.name, e)
         if not external:
             return tree
 

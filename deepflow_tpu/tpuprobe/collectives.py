@@ -134,7 +134,8 @@ def step_trace(spans, run_id: int | None = None) -> dict:
         if rid:
             by_run.setdefault(rid, []).append(s)
     if not by_run:
-        return {"run_id": 0, "devices": {}, "collectives": []}
+        return {"run_id": 0, "devices": {}, "collectives": [],
+                "step_latency_ns": 0, "device_skew_ns": 0}
     rid = run_id if run_id is not None else max(
         by_run, key=lambda r: len(by_run[r]))
     rows = by_run.get(rid, [])

@@ -192,3 +192,18 @@ def test_relist_does_not_reemit_added_events():
     assert rows == []
     gen._apply("ADDED", pod)                     # real watch event
     assert len(rows) == 1
+
+
+def test_adapter_rejects_empty_base_url():
+    import pytest as _pytest
+    reg = AdapterRegistry()
+    with _pytest.raises(ValueError):
+        reg.add("jaeger", "")
+    with _pytest.raises(ValueError):
+        reg.add("jaeger", "not-a-url")
+
+
+def test_step_trace_empty_is_complete():
+    from deepflow_tpu.tpuprobe.collectives import step_trace
+    tr = step_trace([])
+    assert tr["step_latency_ns"] == 0 and tr["device_skew_ns"] == 0
