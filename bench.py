@@ -150,6 +150,51 @@ def _bench_packet_path() -> dict:
     }
 
 
+def _bench_ingest() -> dict:
+    """Ingest path: serialized FlowLogBatches through the real receiver ->
+    decoder -> columnar store, in the DEFAULT single-worker configuration
+    (measured: extra workers don't pay — row building is GIL-bound even
+    though upb parses outside the GIL; see Decoder.WORKERS)."""
+    import socket
+
+    from deepflow_tpu.codec import FrameHeader, MessageType, encode_frame
+    from deepflow_tpu.proto import pb
+    from deepflow_tpu.server import Server
+
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0)
+    server.start()
+    try:
+        batch = pb.FlowLogBatch()
+        for i in range(256):
+            f = batch.l4.add()
+            f.flow_id = i
+            f.key.ip_src = bytes([10, 0, i >> 8 & 255, i & 255])
+            f.key.ip_dst = bytes([10, 9, 9, 9])
+            f.key.port_src = 40000 + i
+            f.key.port_dst = 443
+            f.key.proto = 1
+            f.end_time_ns = 1_700_000_000_000_000_000 + i
+            f.packet_tx = 10
+            f.byte_tx = 1000
+        frame = encode_frame(FrameHeader(MessageType.L4_LOG, agent_id=1),
+                             batch.SerializeToString())
+        n_batches = 400
+        sock = socket.create_connection(("127.0.0.1", server.ingest_port))
+        t0 = time.perf_counter()
+        for _ in range(n_batches):
+            sock.sendall(frame)
+        total = n_batches * 256
+        table = server.db.table("flow_log.l4_flow_log")
+        while len(table) < total and time.perf_counter() - t0 < 60:
+            time.sleep(0.01)
+        dt = time.perf_counter() - t0
+        sock.close()
+        return {"ingest_rows_per_sec": round(len(table) / dt),
+                "ingest_rows": len(table)}
+    finally:
+        server.stop()
+
+
 def _bench_extprofiler() -> dict:
     """Out-of-process profiler: observer-side CPU cost while sampling a
     busy non-cooperating process at 99 Hz (VERDICT target: <1%)."""
@@ -283,6 +328,7 @@ def main() -> None:
                 round(max(0.0, (covered_step - base_step) / base_step
                           * 100.0), 3) if cov_times else 0.0),
             **_bench_packet_path(),
+            **_bench_ingest(),
             **_bench_extprofiler(),
         },
     }
