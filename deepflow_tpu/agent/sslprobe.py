@@ -22,10 +22,10 @@ from deepflow_tpu.agent.packet import MetaPacket
 log = logging.getLogger("df.sslprobe")
 
 # must match #pragma pack(1) struct ProbeEvent in native/sslprobe.cpp
-HDR = struct.Struct("<IIiBBHHBB16s16sQQI")
+HDR = struct.Struct("<IIiBBHHBB16s16sQQQQI")
 
 DIR_INGRESS, DIR_EGRESS = 0, 1
-SRC_PLAIN, SRC_TLS = 0, 1
+SRC_PLAIN, SRC_TLS, SRC_FILEIO = 0, 1, 2
 
 
 class SslProbeListener:
@@ -43,6 +43,11 @@ class SslProbeListener:
         self._seq: dict[tuple, int] = {}
         self.stats = {"events": 0, "tls_events": 0, "dropped_plain": 0,
                       "connections": 0}
+        # file-io events batch (a 10ms threshold on slow storage can fire
+        # thousands/s; per-event frames would crowd the sender queue)
+        self._io_buf: list = []
+        self._io_lock = threading.Lock()
+        self._io_last_flush = 0.0
 
     def start(self) -> "SslProbeListener":
         try:
@@ -63,6 +68,7 @@ class SslProbeListener:
 
     def stop(self) -> None:
         self._stop.set()
+        self.flush_file_io()
         for t in self._threads:
             t.join(timeout=2.0)
         if self._lst is not None:
@@ -94,6 +100,7 @@ class SslProbeListener:
                 try:
                     msg = conn.recv(1 << 14)
                 except socket.timeout:
+                    self._flush_file_io_if_stale()
                     continue
                 except OSError:
                     return
@@ -110,9 +117,14 @@ class SslProbeListener:
         if len(msg) < HDR.size:
             return
         (pid, tid, fd, direction, source, lport, pport, family, _pad,
-         laddr, paddr, ts_ns, trace_id, dlen) = HDR.unpack_from(msg)
+         laddr, paddr, ts_ns, trace_id, latency_ns, io_bytes,
+         dlen) = HDR.unpack_from(msg)
         payload = msg[HDR.size:HDR.size + dlen]
         self.stats["events"] += 1
+        if source == SRC_FILEIO:
+            self._handle_file_io(pid, tid, direction, ts_ns, trace_id,
+                                 latency_ns, io_bytes, payload)
+            return
         conn_key = (pid, fd)
         mode = self._conn_mode.get(conn_key)
         if source == SRC_TLS:
@@ -143,6 +155,57 @@ class SslProbeListener:
             packet_len=len(payload) + 54, tap_port=63,  # uprobe tap
             syscall_trace_id=trace_id, tid=tid)
         self.dispatcher.inject(mp)
+
+    def _handle_file_io(self, pid, tid, direction, ts_ns, trace_id,
+                        latency_ns, io_bytes, path_bytes) -> None:
+        """Slow file read/write -> event.event (reference: files_rw.bpf.c
+        io events with latency + filename)."""
+        from deepflow_tpu.codec import MessageType
+        from deepflow_tpu.proto import pb
+        import time as _t
+        self.stats["file_io_events"] = \
+            self.stats.get("file_io_events", 0) + 1
+        e = pb.Event()
+        e.timestamp_ns = ts_ns
+        e.event_type = ("file-io-read" if direction == DIR_INGRESS
+                        else "file-io-write")
+        e.resource_type = "file"
+        e.resource_name = path_bytes.decode("utf-8", "replace")
+        e.pid = pid
+        e.description = (f"latency={latency_ns}ns bytes={io_bytes} "
+                         f"tid={tid}")
+        e.attrs["latency_ns"] = str(latency_ns)
+        e.attrs["bytes"] = str(io_bytes)
+        e.attrs["syscall_trace_id"] = str(trace_id)
+        with self._io_lock:
+            self._io_buf.append(e)
+            full = len(self._io_buf) >= 64
+            stale = _t.monotonic() - self._io_last_flush > 1.0
+        if full or stale:
+            self.flush_file_io()
+
+    def _flush_file_io_if_stale(self) -> None:
+        import time as _t
+        with self._io_lock:
+            pending = bool(self._io_buf)
+            stale = _t.monotonic() - self._io_last_flush > 1.0
+        if pending and stale:
+            self.flush_file_io()
+
+    def flush_file_io(self) -> None:
+        from deepflow_tpu.codec import MessageType
+        from deepflow_tpu.proto import pb
+        import time as _t
+        with self._io_lock:
+            if not self._io_buf:
+                return
+            events, self._io_buf = self._io_buf, []
+            self._io_last_flush = _t.monotonic()
+        batch = pb.EventBatch()
+        batch.events.extend(events)
+        sender = getattr(self.dispatcher, "sender", None)
+        if sender is not None:
+            sender.send(MessageType.EVENT, batch.SerializeToString())
 
     def _drop_flow(self, family, laddr, paddr, lport, pport) -> None:
         alen = 4 if family == 4 else 16

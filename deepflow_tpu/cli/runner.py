@@ -24,6 +24,9 @@ def main() -> int:
     parser.add_argument("--service", default="")
     parser.add_argument("-m", dest="module", action="store_true",
                         help="run target as a module (python -m style)")
+    parser.add_argument("--io-probe-ms", type=float, default=0.0,
+                        help="with --ssl-probe: report file reads/writes "
+                             "slower than this many ms as events")
     parser.add_argument("--ssl-probe", action="store_true",
                         help="pre-encryption L7 visibility: LD_PRELOAD the "
                              "ssl/syscall interposer into CHILD processes "
@@ -46,6 +49,9 @@ def main() -> int:
             prior = os.environ.get("LD_PRELOAD", "")
             os.environ["LD_PRELOAD"] = f"{so}:{prior}" if prior else so
             os.environ["DF_SSLPROBE_SOCK"] = sslprobe_sock
+            if opts.io_probe_ms > 0:
+                os.environ["DF_IOPROBE_NS"] = str(
+                    int(opts.io_probe_ms * 1e6))
         else:
             print("deepflow-run: libdfsslprobe.so not built; "
                   "--ssl-probe disabled", file=sys.stderr)

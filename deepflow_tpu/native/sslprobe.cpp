@@ -38,7 +38,7 @@ namespace {
 constexpr uint32_t kMaxPayload = 3800;  // fits one unix dgram with header
 
 enum : uint8_t { DIR_INGRESS = 0, DIR_EGRESS = 1 };
-enum : uint8_t { SRC_PLAIN = 0, SRC_TLS = 1 };
+enum : uint8_t { SRC_PLAIN = 0, SRC_TLS = 1, SRC_FILEIO = 2 };
 
 #pragma pack(push, 1)
 struct ProbeEvent {             // must match SSL_EVENT_DTYPE (sslprobe.py)
@@ -55,6 +55,8 @@ struct ProbeEvent {             // must match SSL_EVENT_DTYPE (sslprobe.py)
     uint8_t peer_addr[16];
     uint64_t ts_ns;
     uint64_t syscall_trace_id;  // thread-scoped chain id
+    uint64_t latency_ns;        // SRC_FILEIO: operation latency
+    uint64_t io_bytes;          // SRC_FILEIO: bytes read/written
     uint32_t data_len;          // bytes following this header
 };
 #pragma pack(pop)
@@ -78,6 +80,8 @@ send_fn real_recv = nullptr;
 int emit_fd = -1;
 sockaddr_un emit_addr{};
 bool enabled = false;
+uint64_t io_threshold_ns = 0;  // DF_IOPROBE_NS: emit file IO slower than
+                               // this (0 = file tracing off)
 bool debug = false;            // cached: getenv is a linear environ scan,
                                // far too slow for the per-syscall hot path
 uint64_t trace_epoch = 0;      // high bits of trace ids (per process)
@@ -105,6 +109,8 @@ void init_once() {
         // (python imports _ssl long after the first read()); they resolve
         // lazily at first SSL call
         debug = getenv("DF_SSLPROBE_DEBUG") != nullptr;
+        const char* th = getenv("DF_IOPROBE_NS");
+        if (th) io_threshold_ns = strtoull(th, nullptr, 10);
         const char* path = getenv("DF_SSLPROBE_SOCK");
         if (!path || !path[0]) return;
         // SEQPACKET, not DGRAM: unix dgram queues are capped by
@@ -199,20 +205,73 @@ void emit(int fd, uint8_t direction, uint8_t source, const void* data,
     tls_in_probe = false;
 }
 
+// Slow file IO (reference: kernel/files_rw.bpf.c — per-op latency +
+// filename for reads/writes over a threshold). Only the SLOW path pays
+// fstat/readlink; the hot path adds two clock reads when enabled.
+void emit_file_io(int fd, uint8_t direction, uint64_t latency_ns,
+                  size_t nbytes) {
+    if (!enabled || tls_in_probe) return;
+    struct stat st;
+    if (fstat(fd, &st) != 0 || !S_ISREG(st.st_mode)) return;
+    tls_in_probe = true;
+    char linkpath[64];
+    char path[512];
+    snprintf(linkpath, sizeof(linkpath), "/proc/self/fd/%d", fd);
+    ssize_t plen = readlink(linkpath, path, sizeof(path) - 1);
+    if (plen <= 0) {
+        tls_in_probe = false;
+        return;
+    }
+    ProbeEvent ev{};
+    ev.pid = (uint32_t)getpid();
+    ev.tid = (uint32_t)syscall(SYS_gettid);
+    ev.fd = fd;
+    ev.direction = direction;
+    ev.source = SRC_FILEIO;
+    ev.ts_ns = now_ns();
+    ev.syscall_trace_id = tls_trace_id;  // chains file IO to the request
+    ev.latency_ns = latency_ns;
+    ev.io_bytes = nbytes;
+    ev.data_len = (uint32_t)plen;
+    char buf[sizeof(ProbeEvent) + sizeof(path)];
+    memcpy(buf, &ev, sizeof(ev));
+    memcpy(buf + sizeof(ev), path, plen);
+    real_send(emit_fd, buf, sizeof(ev) + plen, MSG_DONTWAIT | MSG_NOSIGNAL);
+    tls_in_probe = false;
+}
+
+uint64_t mono_ns() {
+    timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (uint64_t)ts.tv_sec * 1'000'000'000ULL + ts.tv_nsec;
+}
+
 }  // namespace
 
 extern "C" {
 
 ssize_t read(int fd, void* buf, size_t count) {
     init_once();
+    uint64_t t0 = io_threshold_ns ? mono_ns() : 0;
     ssize_t n = real_read(fd, buf, count);
+    if (io_threshold_ns && n > 0) {
+        uint64_t lat = mono_ns() - t0;
+        if (lat >= io_threshold_ns)
+            emit_file_io(fd, DIR_INGRESS, lat, (size_t)n);
+    }
     if (n > 0) emit(fd, DIR_INGRESS, SRC_PLAIN, buf, (size_t)n);
     return n;
 }
 
 ssize_t write(int fd, const void* buf, size_t count) {
     init_once();
+    uint64_t t0 = io_threshold_ns ? mono_ns() : 0;
     ssize_t n = real_write(fd, (void*)buf, count);
+    if (io_threshold_ns && n > 0) {
+        uint64_t lat = mono_ns() - t0;
+        if (lat >= io_threshold_ns)
+            emit_file_io(fd, DIR_EGRESS, lat, (size_t)n);
+    }
     if (n > 0) emit(fd, DIR_EGRESS, SRC_PLAIN, buf, (size_t)n);
     return n;
 }

@@ -193,3 +193,47 @@ def test_syscall_chain_links_hops(tmp_path):
         agent.stop()
         backend.close()
         server.stop()
+
+
+def test_slow_file_io_becomes_event(tmp_path):
+    """File reads/writes over the latency threshold surface as events with
+    path, latency, bytes (files_rw.bpf.c analog)."""
+    from deepflow_tpu.server import Server
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0).start()
+    agent = _agent_with_probe(tmp_path, server)
+    try:
+        target = tmp_path / "data.bin"
+        code = textwrap.dedent(f"""
+            import os, time
+            # threshold is 1ns so every file op qualifies
+            with open({str(target)!r}, "wb") as f:
+                f.write(b"x" * 4096)
+            with open({str(target)!r}, "rb") as f:
+                f.read()
+        """)
+        env = _probe_env(agent.config.sslprobe_sock)
+        env["DF_IOPROBE_NS"] = "1"
+        out = subprocess.run([sys.executable, "-c", code], env=env,
+                             capture_output=True, text=True, timeout=20)
+        assert out.returncode == 0, out.stderr
+        from deepflow_tpu.query import execute
+        t = server.db.table("event.event")
+        r = None
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            agent.sslprobe.flush_file_io()  # drain the event batch buffer
+            r = execute(t, "SELECT event_type, resource_name, description "
+                           "FROM t WHERE resource_type = 'file'")
+            if any(row[0] == "file-io-write" and "data.bin" in row[1]
+                   for row in r.values):
+                break
+            time.sleep(0.2)
+        assert r is not None and r.values, "no file-io events"
+        types = {row[0] for row in r.values}
+        assert "file-io-write" in types and "file-io-read" in types
+        assert any("data.bin" in row[1] for row in r.values)
+        assert any("latency=" in row[2] and "bytes=4096" in row[2]
+                   for row in r.values)
+    finally:
+        agent.stop()
+        server.stop()
