@@ -190,7 +190,9 @@ def _bench_ingest() -> dict:
         dt = time.perf_counter() - t0
         sock.close()
         return {"ingest_rows_per_sec": round(len(table) / dt),
-                "ingest_rows": len(table)}
+                "ingest_rows": len(table),
+                "ingest_rows_expected": total,
+                "ingest_timed_out": len(table) < total}
     finally:
         server.stop()
 

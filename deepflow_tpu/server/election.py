@@ -150,3 +150,208 @@ class LeaderElection:
                 self.try_acquire()
             except Exception:
                 log.exception("election tick failed")
+
+
+class K8sLeaseElection:
+    """Leader election over coordination.k8s.io/v1 Lease objects — for
+    deployments with no shared volume (the flock path needs one). Exactly
+    the reference's mechanism (election.go:175): GET the Lease, acquire if
+    absent/expired/ours, renew by updating renewTime, with the apiserver's
+    optimistic concurrency (resourceVersion) arbitrating races —
+    a conflicting update loses with a 409, never yielding two leaders.
+
+    Same callback/flag surface as LeaderElection so Server can use either.
+    """
+
+    def __init__(self, name: str, namespace: str = "default",
+                 api_base: str | None = None, token: str = "",
+                 ca_path: str = "", holder: str | None = None,
+                 ttl_s: float = 15.0, renew_interval_s: float = 5.0,
+                 on_elected=None, on_deposed=None,
+                 insecure_skip_verify: bool = False) -> None:
+        from deepflow_tpu.server.genesis import build_api_context, \
+            in_cluster_config
+        if api_base is None:
+            cfg = in_cluster_config()
+            if cfg is None:
+                raise RuntimeError("not in a cluster and no api_base given")
+            api_base, token, ca_path = cfg
+        self.api_base = api_base.rstrip("/")
+        self._bearer = token        # NEVER in .token: that's the fencing
+        # int (shared _set_leader logs it with %d)
+        self._ctx = build_api_context(self.api_base, ca_path,
+                                      insecure_skip_verify)
+        self.name = name
+        self.namespace = namespace
+        if holder is None:
+            import uuid
+            holder = (f"{socket.gethostname()}-{os.getpid()}-"
+                      f"{uuid.uuid4().hex[:8]}")
+        self.holder = holder
+        self.ttl_s = ttl_s
+        self.renew_interval_s = renew_interval_s
+        self.on_elected = on_elected or (lambda: None)
+        self.on_deposed = on_deposed or (lambda: None)
+        self.is_leader = False
+        self.token = 0           # fencing (leaseTransitions), like flock's
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._acquire_lock = threading.Lock()
+        # clock-skew-safe expiry (client-go style): time the lease from
+        # when WE first observed its renewTime, by our monotonic clock
+        self._observed_renew = ("", 0.0)   # (renewTime str, seen_monotonic)
+        self._last_ok = 0.0
+        self.stats = {"elections": 0, "renewals": 0, "depositions": 0,
+                      "conflicts": 0, "errors": 0}
+
+    @property
+    def token_fencing(self) -> int:  # back-compat alias
+        return self.token
+
+    # -- k8s api ---------------------------------------------------------------
+
+    def _url(self) -> str:
+        return (f"{self.api_base}/apis/coordination.k8s.io/v1/namespaces/"
+                f"{self.namespace}/leases/{self.name}")
+
+    def _req(self, method: str, body: dict | None = None):
+        import urllib.request
+        data = json.dumps(body).encode() if body is not None else None
+        url = self._url() if method != "POST" else (
+            f"{self.api_base}/apis/coordination.k8s.io/v1/namespaces/"
+            f"{self.namespace}/leases")
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Content-Type", "application/json")
+        if self._bearer:
+            req.add_header("Authorization", f"Bearer {self._bearer}")
+        with urllib.request.urlopen(req, timeout=5,
+                                    context=self._ctx) as r:
+            return json.load(r)
+
+    @staticmethod
+    def _now_rfc3339() -> str:
+        import datetime
+        return datetime.datetime.now(datetime.timezone.utc).strftime(
+            "%Y-%m-%dT%H:%M:%S.%f") + "Z"
+
+    @staticmethod
+    def _parse_time(s: str) -> float:
+        import datetime
+        try:
+            return datetime.datetime.fromisoformat(
+                s.replace("Z", "+00:00")).timestamp()
+        except ValueError:
+            return 0.0
+
+    # -- protocol --------------------------------------------------------------
+
+    def try_acquire(self) -> bool:
+        with self._acquire_lock:
+            try:
+                out = self._try_acquire_locked()
+                self._last_ok = time.monotonic()
+                return out
+            except Exception as e:
+                self.stats["errors"] += 1
+                # a transient apiserver blip must not flap the singletons:
+                # the lease is still validly OURS until its ttl passes, so
+                # keep leading within that grace window (client-go retries
+                # inside the renew deadline the same way)
+                if self.is_leader and \
+                        time.monotonic() - self._last_ok < self.ttl_s:
+                    log.warning("k8s lease renew error (still within "
+                                "ttl grace): %s", e)
+                    return True
+                log.warning("k8s lease election error: %s", e)
+                return self._set_leader(False)
+
+    def _try_acquire_locked(self) -> bool:
+        import urllib.error
+        try:
+            lease = self._req("GET")
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                raise
+            # no lease yet: CREATE arbitrates the race (409 loses)
+            try:
+                self._req("POST", self._body(transitions=1))
+                self.token = 1
+                return self._set_leader(True)
+            except urllib.error.HTTPError as ce:
+                if ce.code == 409:
+                    self.stats["conflicts"] += 1
+                    return self._set_leader(False)
+                raise
+        spec = lease.get("spec", {})
+        holder = spec.get("holderIdentity", "")
+        renew_str = spec.get("renewTime", "") or ""
+        ttl = float(spec.get("leaseDurationSeconds", self.ttl_s))
+        # skew-safe: expire ttl after WE first saw this renewTime value
+        # (remote clocks may disagree with ours by more than the ttl)
+        now_mono = time.monotonic()
+        if renew_str != self._observed_renew[0]:
+            self._observed_renew = (renew_str, now_mono)
+        expired = (now_mono - self._observed_renew[1]) > ttl or \
+            not renew_str
+        if holder != self.holder and not expired:
+            return self._set_leader(False)
+        transitions = int(spec.get("leaseTransitions", 0))
+        if holder != self.holder:
+            transitions += 1
+        body = self._body(transitions=transitions)
+        body["metadata"]["resourceVersion"] = \
+            lease["metadata"].get("resourceVersion", "")
+        try:
+            self._req("PUT", body)
+        except urllib.error.HTTPError as ce:
+            if ce.code == 409:  # another candidate won the update race
+                self.stats["conflicts"] += 1
+                return self._set_leader(False)
+            raise
+        self.token = transitions
+        if holder == self.holder:
+            self.stats["renewals"] += 1
+        return self._set_leader(True)
+
+    def _body(self, transitions: int) -> dict:
+        return {
+            "apiVersion": "coordination.k8s.io/v1",
+            "kind": "Lease",
+            "metadata": {"name": self.name, "namespace": self.namespace},
+            "spec": {
+                "holderIdentity": self.holder,
+                "leaseDurationSeconds": int(self.ttl_s),
+                "renewTime": self._now_rfc3339(),
+                "leaseTransitions": transitions,
+            },
+        }
+
+    _set_leader = LeaderElection._set_leader
+
+    def resign(self) -> None:
+        with self._acquire_lock:
+            if self.is_leader:
+                try:
+                    lease = self._req("GET")
+                    spec = lease.get("spec", {})
+                    if spec.get("holderIdentity") == self.holder:
+                        body = self._body(
+                            transitions=int(
+                                spec.get("leaseTransitions", 0)))
+                        body["spec"]["renewTime"] = \
+                            "1970-01-01T00:00:00.000000Z"  # expire now
+                        body["metadata"]["resourceVersion"] = \
+                            lease["metadata"].get("resourceVersion", "")
+                        self._req("PUT", body)
+                except Exception as e:
+                    # failed expiry-PUT delays failover by up to ttl_s:
+                    # that must be diagnosable
+                    log.warning("lease resign write failed (followers "
+                                "wait out the ttl): %s", e)
+            self._set_leader(False)
+
+    # -- lifecycle (same shape as LeaderElection) ------------------------------
+
+    start = LeaderElection.start
+    stop = LeaderElection.stop
+    _run = LeaderElection._run

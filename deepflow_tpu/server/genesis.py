@@ -40,6 +40,23 @@ def in_cluster_config() -> tuple[str, str, str] | None:
             ca if os.path.exists(ca) else "")
 
 
+def build_api_context(api_base: str, ca_path: str = "",
+                      insecure_skip_verify: bool = False):
+    """Shared apiserver TLS context policy (genesis + lease election):
+    verified CA, or EXPLICIT opt-out with a loud warning — never silent
+    unverified TLS under a bearer token."""
+    if not api_base.startswith("https"):
+        return None
+    if ca_path:
+        return ssl.create_default_context(cafile=ca_path)
+    if insecure_skip_verify:
+        log.warning("k8s api: TLS verification DISABLED "
+                    "(insecure_skip_verify)")
+        return ssl._create_unverified_context()
+    raise ValueError("https api_base needs ca_path "
+                     "(or explicit insecure_skip_verify=True)")
+
+
 class K8sGenesis:
     """Pod list-watch -> PodIpIndex."""
 
@@ -60,20 +77,8 @@ class K8sGenesis:
         self.token = token
         self.watch_timeout_s = watch_timeout_s
         self.pod_index = pod_index
-        self._ctx = None
-        if api_base.startswith("https"):
-            if ca_path:
-                self._ctx = ssl.create_default_context(cafile=ca_path)
-            elif insecure_skip_verify:
-                # explicit opt-in only: an unverified TLS channel carries
-                # the bearer token
-                log.warning("k8s genesis: TLS verification DISABLED "
-                            "(insecure_skip_verify)")
-                self._ctx = ssl._create_unverified_context()
-            else:
-                raise ValueError(
-                    "https api_base needs ca_path (or explicit "
-                    "insecure_skip_verify=True)")
+        self._ctx = build_api_context(self.api_base, ca_path,
+                                      insecure_skip_verify)
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.resource_version = ""

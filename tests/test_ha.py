@@ -310,3 +310,89 @@ def test_spool_poison_file_quarantined(tmp_path):
     finally:
         exp.stop()
         srv.shutdown()
+
+
+class _FakeLeaseApi(http.server.BaseHTTPRequestHandler):
+    """coordination.k8s.io/v1 Lease with resourceVersion CAS."""
+    state = {"lease": None, "rv": 0}
+
+    def log_message(self, *a):
+        pass
+
+    def _send(self, code, obj=None):
+        body = json.dumps(obj or {}).encode()
+        self.send_response(code)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        st = self.state
+        if st["lease"] is None:
+            self._send(404, {"reason": "NotFound"})
+        else:
+            self._send(200, st["lease"])
+
+    def do_POST(self):
+        st = self.state
+        n = int(self.headers.get("Content-Length", 0))
+        body = json.loads(self.rfile.read(n))
+        if st["lease"] is not None:
+            self._send(409, {"reason": "AlreadyExists"})
+            return
+        st["rv"] += 1
+        body.setdefault("metadata", {})["resourceVersion"] = str(st["rv"])
+        st["lease"] = body
+        self._send(201, body)
+
+    def do_PUT(self):
+        st = self.state
+        n = int(self.headers.get("Content-Length", 0))
+        body = json.loads(self.rfile.read(n))
+        want = body.get("metadata", {}).get("resourceVersion", "")
+        have = (st["lease"] or {}).get("metadata", {}).get(
+            "resourceVersion", "")
+        if st["lease"] is None or want != have:
+            self._send(409, {"reason": "Conflict"})
+            return
+        st["rv"] += 1
+        body["metadata"]["resourceVersion"] = str(st["rv"])
+        st["lease"] = body
+        self._send(200, body)
+
+
+def test_k8s_lease_election_single_leader_and_takeover():
+    """Lease-object election: CAS arbitration, expiry takeover, fencing
+    transitions — against a faithful fake apiserver."""
+    from deepflow_tpu.server.election import K8sLeaseElection
+    _FakeLeaseApi.state = {"lease": None, "rv": 0}
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _FakeLeaseApi)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{srv.server_port}"
+    try:
+        a = K8sLeaseElection("df-leader", api_base=base, holder="a",
+                             ttl_s=1.0)
+        b = K8sLeaseElection("df-leader", api_base=base, holder="b",
+                             ttl_s=1.0)
+        assert a.try_acquire() is True        # CREATE wins
+        assert b.try_acquire() is False       # fresh lease held by a
+        assert a.try_acquire() is True        # renewal
+        assert a.stats["renewals"] == 1
+        # a stops renewing; b must observe the renewTime STABLE for a
+        # full ttl by its own clock before takeover (skew-safe expiry)
+        deadline = time.time() + 5
+        while time.time() < deadline and not b.try_acquire():
+            time.sleep(0.2)
+        assert b.is_leader is True            # expiry takeover via CAS PUT
+        assert b.token_fencing == 2            # transitions advanced
+        assert a.try_acquire() is False       # a steps down
+        assert a.stats["depositions"] == 1
+        # graceful resign: b expires its lease; a wins after observing it
+        b.resign()
+        deadline = time.time() + 5
+        while time.time() < deadline and not a.try_acquire():
+            time.sleep(0.2)
+        assert a.is_leader is True
+        assert a.token_fencing == 3
+    finally:
+        srv.shutdown()
