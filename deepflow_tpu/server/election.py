@@ -234,15 +234,6 @@ class K8sLeaseElection:
         return datetime.datetime.now(datetime.timezone.utc).strftime(
             "%Y-%m-%dT%H:%M:%S.%f") + "Z"
 
-    @staticmethod
-    def _parse_time(s: str) -> float:
-        import datetime
-        try:
-            return datetime.datetime.fromisoformat(
-                s.replace("Z", "+00:00")).timestamp()
-        except ValueError:
-            return 0.0
-
     # -- protocol --------------------------------------------------------------
 
     def try_acquire(self) -> bool:
@@ -338,8 +329,10 @@ class K8sLeaseElection:
                         body = self._body(
                             transitions=int(
                                 spec.get("leaseTransitions", 0)))
-                        body["spec"]["renewTime"] = \
-                            "1970-01-01T00:00:00.000000Z"  # expire now
+                        del body["spec"]["renewTime"]  # absent renewTime
+                        # == expired NOW (the skew-safe observer ignores
+                        # timestamp VALUES, but treats a missing one as
+                        # immediately expired)
                         body["metadata"]["resourceVersion"] = \
                             lease["metadata"].get("resourceVersion", "")
                         self._req("PUT", body)

@@ -25,11 +25,14 @@ class Server:
     def __init__(self, host: str = "127.0.0.1", ingest_port: int = 20033,
                  query_port: int = 20416, data_dir: str | None = None,
                  sync_port: int = 20035, enable_controller: bool = False,
-                 ha_lease_path: str | None = None) -> None:
-        # HA: with a lease path, cluster SINGLETONS (controller, rollups,
-        # janitor) run only on the elected leader; every node serves
-        # ingest + query (reference: election.go:175 + monitor rebalance)
+                 ha_lease_path: str | None = None,
+                 ha_k8s_lease: str | None = None) -> None:
+        # HA: with a lease (file path on a shared volume, OR a K8s Lease
+        # object name for clusters without one), cluster SINGLETONS
+        # (controller, rollups, janitor) run only on the elected leader;
+        # every node serves ingest + query (reference: election.go:175)
         self.ha_lease_path = ha_lease_path
+        self.ha_k8s_lease = ha_k8s_lease
         self.election = None
         self.db = Database(data_dir=data_dir)
         self.platform = PlatformInfoTable()
@@ -117,7 +120,21 @@ class Server:
         self.receiver.start()
         self.http.start()
         self.alerts.start()
-        if self.ha_lease_path:
+        if self.ha_k8s_lease:
+            import os as _os_e
+            from deepflow_tpu.server.election import K8sLeaseElection
+            try:
+                self.election = K8sLeaseElection(
+                    self.ha_k8s_lease,
+                    namespace=_os_e.environ.get("POD_NAMESPACE",
+                                                "default"),
+                    on_elected=self._start_singletons,
+                    on_deposed=self._stop_singletons).start()
+            except (RuntimeError, ValueError) as e:
+                log.warning("k8s lease election unavailable (%s); "
+                            "running singletons locally", e)
+                self._start_singletons()
+        elif self.ha_lease_path:
             from deepflow_tpu.server.election import LeaderElection
             self.election = LeaderElection(
                 self.ha_lease_path,
@@ -199,6 +216,11 @@ def main() -> None:
     parser.add_argument("--query-port", type=int, default=20416)
     parser.add_argument("--sync-port", type=int, default=20035)
     parser.add_argument("--data-dir", default=None)
+    parser.add_argument("--ha-lease", default=None,
+                        help="shared-volume lease FILE for leader election")
+    parser.add_argument("--ha-k8s-lease", default=None,
+                        help="K8s Lease object name for leader election "
+                             "(no shared volume needed)")
     parser.add_argument("--no-controller", action="store_true")
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args()
@@ -208,6 +230,8 @@ def main() -> None:
     server = Server(host=args.host, ingest_port=args.ingest_port,
                     query_port=args.query_port, sync_port=args.sync_port,
                     data_dir=args.data_dir,
+                    ha_lease_path=args.ha_lease,
+                    ha_k8s_lease=args.ha_k8s_lease,
                     enable_controller=not args.no_controller).start()
     try:
         while True:

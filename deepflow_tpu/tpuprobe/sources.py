@@ -71,7 +71,8 @@ class XPlaneSource:
         self._started_monotonic = time.monotonic()
         self.stats = {"captures": 0, "events": 0, "errors": 0, "skipped": 0,
                       "contended": 0, "steps_seen": 0,
-                      "coverage_pct": 0.0, "est_step_ms": 0.0}
+                      "coverage_pct": 0.0, "est_step_ms": 0.0,
+                      "captured_s": 0.0}
 
     def available(self) -> bool:
         import sys
@@ -142,6 +143,7 @@ class XPlaneSource:
             self._step_time_s = (est if self._step_time_s <= 0 else
                                  0.5 * self._step_time_s + 0.5 * est)
             self.stats["est_step_ms"] = round(self._step_time_s * 1000, 2)
+        self.stats["captured_s"] = round(self._captured_s, 3)
         elapsed = time.monotonic() - self._started_monotonic
         if elapsed > 0:
             self.stats["coverage_pct"] = round(
