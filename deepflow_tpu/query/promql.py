@@ -1,23 +1,39 @@
-"""PromQL subset over the metric tables.
+"""PromQL engine over the metric tables.
 
-Reference analog: server/querier/app/prometheus (full upstream promql engine
-over DeepFlow storage). Embedded subset with the shapes Grafana panels
-actually send:
+Reference analog: server/querier/app/prometheus (the reference embeds the
+full upstream promql engine over DeepFlow storage,
+querier/app/prometheus/router/router.go:40-41). This is a from-scratch
+engine with the upstream language surface Grafana panels and alert rules
+actually use:
 
-    metric
-    metric{label="v", label2!="w"}
-    rate(metric[5m])            (also irate, increase)
-    sum(expr) / avg / min / max / count
-    sum by (label, ...) (expr)
-    expr / expr  (scalar arithmetic between aggregates is NOT supported;
-                  binary ops are vector-scalar only: expr * 8, expr / 60)
+- selectors with =, !=, =~, !~ matchers, [range], offset
+- binary ops between vectors with vector matching: on/ignoring,
+  group_left/group_right, bool modifier; and/or/unless set ops;
+  arithmetic + - * / % ^ and comparisons == != > < >= <=
+- aggregations: sum avg min max count group stddev stdvar topk bottomk
+  quantile count_values, with by/without
+- range functions: rate irate increase delta idelta deriv predict_linear
+  changes resets absent_over_time and the *_over_time family
+  (avg/min/max/sum/count/last/present/stddev/stdvar/quantile)
+- instant functions: histogram_quantile, clamp*, abs/ceil/floor/round,
+  exp/ln/log2/log10/sqrt/sgn, scalar/vector/time/timestamp, absent,
+  label_replace/label_join, sort/sort_desc
+- subqueries expr[range:step]
 
-Metric naming: <family>_<column>, e.g. flow_metrics_network_byte_tx or
-flow_metrics_application_request. Labels are the table's tag columns.
+Counter semantics are storage-aware: remote-write `prometheus.samples` and
+`deepflow_system` snapshots hold CUMULATIVE counters (Prometheus-style
+extrapolated rate with reset detection), while the internal flow_metrics
+tables hold per-interval DELTA samples (rate = sum/range). Subquery results
+feed rate() with cumulative semantics, matching upstream.
+
+Metric naming: <family>_<column>, e.g. flow_metrics_network_byte_tx, plus
+any remote-write metric name and deepflow_system self-telemetry.
 """
 
 from __future__ import annotations
 
+import json as _json
+import math
 import re
 from dataclasses import dataclass, field
 
@@ -25,11 +41,12 @@ import numpy as np
 
 from deepflow_tpu.store.db import Database
 
-_DUR_RE = re.compile(r"^(\d+)(ms|s|m|h|d)$")
-_DUR_S = {"ms": 0.001, "s": 1, "m": 60, "h": 3600, "d": 86400}
+_DUR_PART = re.compile(r"(\d+)(ms|s|m|h|d|w|y)")
+_DUR_FULL = re.compile(r"^(?:\d+(?:ms|s|m|h|d|w|y))+$")
+_DUR_S = {"ms": 0.001, "s": 1, "m": 60, "h": 3600, "d": 86400,
+          "w": 604800, "y": 31536000}
 
-_AGGS = ("sum", "avg", "min", "max", "count")
-_RATES = ("rate", "irate", "increase")
+_LOOKBACK_S = 300  # Prometheus staleness lookback
 
 # metric prefix -> (table, tag label columns)
 _NETWORK_TAGS = ["ip_src", "ip_dst", "server_port", "protocol", "host",
@@ -48,37 +65,122 @@ class PromqlError(Exception):
 
 
 def parse_duration_s(s: str) -> float:
-    m = _DUR_RE.match(s)
-    if not m:
+    if not _DUR_FULL.match(s):
         raise PromqlError(f"bad duration {s!r}")
-    return int(m.group(1)) * _DUR_S[m.group(2)]
+    return sum(int(n) * _DUR_S[u] for n, u in _DUR_PART.findall(s))
 
+
+# -- AST ---------------------------------------------------------------------
 
 @dataclass
-class Selector:
+class VectorSelector:
     metric: str
     matchers: list = field(default_factory=list)  # (label, op, value)
-    range_s: float = 0.0
+    offset_s: float = 0.0
 
 
 @dataclass
-class Query:
-    selector: Selector
-    rate_fn: str = ""          # rate | irate | increase | ""
-    agg: str = ""              # sum | avg | ...
-    by: list = field(default_factory=list)
-    scalar_op: str = ""        # * / + -
-    scalar: float = 0.0
+class MatrixSelector:
+    vs: VectorSelector
+    range_s: float
 
+
+@dataclass
+class Subquery:
+    expr: object
+    range_s: float
+    step_s: float  # 0 -> default resolution
+    offset_s: float = 0.0
+
+
+@dataclass
+class Num:
+    value: float
+
+
+@dataclass
+class Str:
+    value: str
+
+
+@dataclass
+class Call:
+    fn: str
+    args: list
+
+
+@dataclass
+class Agg:
+    op: str
+    expr: object
+    grouping: list = field(default_factory=list)
+    without: bool = False
+    param: object = None
+
+
+@dataclass
+class VectorMatching:
+    on: bool = False
+    labels: list = field(default_factory=list)
+    card: str = "one-to-one"  # one-to-one | many-to-one | one-to-many
+    include: list = field(default_factory=list)
+
+
+@dataclass
+class BinOp:
+    op: str
+    lhs: object
+    rhs: object
+    bool_mod: bool = False
+    matching: VectorMatching | None = None
+
+
+@dataclass
+class Unary:
+    op: str
+    expr: object
+
+
+_AGG_OPS = {"sum", "avg", "min", "max", "count", "group", "stddev", "stdvar",
+            "topk", "bottomk", "quantile", "count_values"}
+_PARAM_AGGS = {"topk", "bottomk", "quantile", "count_values"}
+
+_RANGE_FNS = {
+    "rate", "irate", "increase", "delta", "idelta", "deriv",
+    "predict_linear", "changes", "resets", "absent_over_time",
+    "avg_over_time", "min_over_time", "max_over_time", "sum_over_time",
+    "count_over_time", "last_over_time", "present_over_time",
+    "stddev_over_time", "stdvar_over_time", "quantile_over_time",
+}
+_MATH_FNS = {"abs": np.abs, "ceil": np.ceil, "floor": np.floor,
+             "exp": np.exp, "ln": np.log, "log2": np.log2,
+             "log10": np.log10, "sqrt": np.sqrt, "sgn": np.sign}
+_INSTANT_FNS = _MATH_FNS.keys() | {
+    "round", "clamp", "clamp_min", "clamp_max", "histogram_quantile",
+    "scalar", "vector", "time", "timestamp", "absent", "label_replace",
+    "label_join", "sort", "sort_desc"}
+_FNS = _RANGE_FNS | _INSTANT_FNS
+
+_CMP_OPS = {"==", "!=", ">", "<", ">=", "<="}
+_SET_OPS = {"and", "or", "unless"}
+
+# precedence (binding power), upstream promql/parser
+_PRECEDENCE = {"or": 1, "and": 2, "unless": 2,
+               "==": 3, "!=": 3, "<=": 3, "<": 3, ">=": 3, ">": 3,
+               "+": 4, "-": 4, "*": 5, "/": 5, "%": 5, "^": 6}
+_RIGHT_ASSOC = {"^"}
+
+
+# -- lexer -------------------------------------------------------------------
 
 _TOKEN = re.compile(r"""
     (?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)
   | (?P<lbrace>\{) | (?P<rbrace>\})
   | (?P<lparen>\() | (?P<rparen>\))
   | (?P<lbrack>\[) | (?P<rbrack>\])
-  | (?P<str>"(?:[^"\\]|\\.)*")
-  | (?P<num>\d+\.\d+|\d+)
-  | (?P<op>!=|=~|!~|=|,|\*|/|\+|-)
+  | (?P<str>"(?:[^"\\]|\\.)*"|'(?:[^'\\]|\\.)*')
+  | (?P<num>\d+\.\d+(?:[eE][+-]?\d+)?|\d+[eE][+-]?\d+|\.\d+|\d+)
+  | (?P<op>==|!=|<=|>=|=~|!~|<|>|=|,|\*|/|%|\^|\+|-|:|@)
   | (?P<ws>\s+)
 """, re.VERBOSE)
 
@@ -95,98 +197,301 @@ def _tokens(q: str):
     return out
 
 
-def parse(q: str) -> Query:
-    toks = _tokens(q)
-    pos = [0]
+# -- parser ------------------------------------------------------------------
 
-    def peek():
-        return toks[pos[0]] if pos[0] < len(toks) else ("eof", "")
+class _Parser:
+    def __init__(self, q: str):
+        self.toks = _tokens(q)
+        self.pos = 0
 
-    def next_():
-        t = peek()
-        pos[0] += 1
+    def peek(self, k: int = 0):
+        i = self.pos + k
+        return self.toks[i] if i < len(self.toks) else ("eof", "")
+
+    def next_(self):
+        t = self.peek()
+        self.pos += 1
         return t
 
-    def expect(kind):
-        t = next_()
-        if t[0] != kind:
-            raise PromqlError(f"expected {kind}, got {t[1]!r}")
+    def expect(self, kind: str, text: str | None = None):
+        t = self.next_()
+        if t[0] != kind or (text is not None and t[1] != text):
+            raise PromqlError(f"expected {text or kind}, got {t[1]!r}")
         return t
 
-    def parse_selector() -> Selector:
-        name = expect("name")[1]
-        sel = Selector(metric=name)
-        if peek()[0] == "lbrace":
-            next_()
-            while peek()[0] != "rbrace":
-                lbl = expect("name")[1]
-                op = expect("op")[1]
-                if op not in ("=", "!=", "=~", "!~"):
-                    raise PromqlError(f"bad matcher op {op}")
-                val = expect("str")[1][1:-1]
-                sel.matchers.append((lbl, op, val))
-                if peek()[0] == "op" and peek()[1] == ",":
-                    next_()
-            expect("rbrace")
-        if peek()[0] == "lbrack":
-            next_()
-            parts = []  # "5m" lexes as num "5" + name "m": join tokens
-            while peek()[0] not in ("rbrack", "eof"):
-                parts.append(next_()[1])
-            sel.range_s = parse_duration_s("".join(parts))
-            expect("rbrack")
+    def at_name(self, *names: str) -> bool:
+        t = self.peek()
+        return t[0] == "name" and t[1] in names
+
+    def _split_colon_names(self) -> None:
+        """Metric names may contain ':' (recording rules), so the lexer
+        folds ':' into name tokens — but inside [range:step] the ':' is a
+        separator. Re-split name tokens containing ':' up to the next ']'."""
+        i = self.pos
+        while i < len(self.toks) and self.toks[i][0] != "rbrack":
+            kind, text = self.toks[i]
+            if kind == "name" and ":" in text:
+                repl = []
+                for j, part in enumerate(text.split(":")):
+                    if j:
+                        repl.append(("op", ":"))
+                    if part:
+                        repl.extend(_tokens(part))
+                self.toks[i:i + 1] = repl
+                i += len(repl)
+            else:
+                i += 1
+
+    # duration: "5m" lexes as num+name, "1h30m" as num+name("h30m");
+    # join adjacent tokens while the concatenation is a valid duration
+    def parse_duration(self) -> float:
+        parts = [self.expect("num")[1]]
+        while True:
+            t = self.peek()
+            cand = "".join(parts) + t[1]
+            if t[0] in ("name", "num") and (
+                    _DUR_FULL.match(cand)
+                    or (t[0] == "num" and _DUR_FULL.match(cand + "s"))):
+                parts.append(self.next_()[1])
+                if t[0] == "num":
+                    continue
+                if _DUR_FULL.match("".join(parts)) and not (
+                        self.peek()[0] == "num"):
+                    break
+            else:
+                break
+        return parse_duration_s("".join(parts))
+
+    def parse_label_list(self) -> list[str]:
+        self.expect("lparen")
+        out = []
+        while self.peek()[0] != "rparen":
+            out.append(self.expect("name")[1])
+            if self.peek() == ("op", ","):
+                self.next_()
+        self.expect("rparen")
+        return out
+
+    def parse_matchers(self) -> list:
+        matchers = []
+        self.expect("lbrace")
+        while self.peek()[0] != "rbrace":
+            lbl = self.expect("name")[1]
+            op = self.expect("op")[1]
+            if op == "==":  # tolerate common typo? no: strict
+                raise PromqlError("bad matcher op ==")
+            if op not in ("=", "!=", "=~", "!~"):
+                raise PromqlError(f"bad matcher op {op}")
+            val = self.expect("str")[1][1:-1]
+            matchers.append((lbl, op, val))
+            if self.peek() == ("op", ","):
+                self.next_()
+        self.expect("rbrace")
+        return matchers
+
+    def parse_expr(self, min_prec: int = 0):
+        lhs = self.parse_unary()
+        while True:
+            t = self.peek()
+            op = None
+            if t[0] == "op" and t[1] in _PRECEDENCE:
+                op = t[1]
+            elif t[0] == "name" and t[1] in _SET_OPS:
+                op = t[1]
+            if op is None:
+                break
+            prec = _PRECEDENCE[op]
+            if prec < min_prec:
+                break
+            self.next_()
+            bool_mod = False
+            if self.at_name("bool"):
+                self.next_()
+                bool_mod = True
+                if op not in _CMP_OPS:
+                    raise PromqlError("bool modifier on non-comparison")
+            matching = None
+            if self.at_name("on", "ignoring"):
+                on = self.next_()[1] == "on"
+                matching = VectorMatching(on=on, labels=self.parse_label_list())
+                if self.at_name("group_left", "group_right"):
+                    gl = self.next_()[1] == "group_left"
+                    matching.card = "many-to-one" if gl else "one-to-many"
+                    if self.peek()[0] == "lparen":
+                        matching.include = self.parse_label_list()
+            next_min = prec + (0 if op in _RIGHT_ASSOC else 1)
+            rhs = self.parse_expr(next_min)
+            lhs = BinOp(op=op, lhs=lhs, rhs=rhs, bool_mod=bool_mod,
+                        matching=matching)
+        return lhs
+
+    def parse_unary(self):
+        t = self.peek()
+        # ^ binds tighter than unary: -2^2 == -(2^2), per upstream
+        if t == ("op", "-"):
+            self.next_()
+            return Unary("-", self.parse_expr(_PRECEDENCE["^"]))
+        if t == ("op", "+"):
+            self.next_()
+            return self.parse_expr(_PRECEDENCE["^"])
+        return self.parse_postfix(self.parse_atom())
+
+    def parse_postfix(self, expr):
+        while True:
+            t = self.peek()
+            if t[0] == "lbrack":
+                self.next_()
+                self._split_colon_names()
+                range_s = self.parse_duration()
+                if self.peek() == ("op", ":"):
+                    self.next_()
+                    step_s = 0.0
+                    if self.peek()[0] != "rbrack":
+                        step_s = self.parse_duration()
+                    self.expect("rbrack")
+                    expr = Subquery(expr=expr, range_s=range_s, step_s=step_s)
+                else:
+                    self.expect("rbrack")
+                    if not isinstance(expr, VectorSelector):
+                        raise PromqlError(
+                            "[range] is only valid on a selector "
+                            "(use [range:step] for subqueries)")
+                    expr = MatrixSelector(vs=expr, range_s=range_s)
+            elif self.at_name("offset"):
+                self.next_()
+                neg = False
+                if self.peek() == ("op", "-"):
+                    self.next_()
+                    neg = True
+                off = self.parse_duration() * (-1 if neg else 1)
+                if isinstance(expr, VectorSelector):
+                    expr.offset_s = off
+                elif isinstance(expr, MatrixSelector):
+                    expr.vs.offset_s = off
+                elif isinstance(expr, Subquery):
+                    expr.offset_s = off
+                else:
+                    raise PromqlError("offset on non-selector")
+            else:
+                break
+        return expr
+
+    def parse_atom(self):
+        t = self.peek()
+        if t[0] == "lparen":
+            self.next_()
+            inner = self.parse_expr()
+            self.expect("rparen")
+            return inner
+        if t[0] == "num":
+            self.next_()
+            return Num(float(t[1]))
+        if t[0] == "str":
+            self.next_()
+            return Str(t[1][1:-1])
+        if t[0] == "op" and t[1] in ("+", "-"):
+            return self.parse_unary()
+        if t[0] != "name":
+            raise PromqlError(f"unexpected {t[1]!r}")
+        name = t[1]
+        if name in ("Inf", "inf", "+Inf"):
+            self.next_()
+            return Num(math.inf)
+        if name in ("NaN", "nan"):
+            self.next_()
+            return Num(math.nan)
+        if name in _AGG_OPS and self.peek(1)[0] in ("lparen", "name"):
+            return self.parse_agg()
+        if name in _FNS and self.peek(1)[0] == "lparen":
+            self.next_()
+            self.expect("lparen")
+            args = []
+            while self.peek()[0] != "rparen":
+                args.append(self.parse_expr())
+                if self.peek() == ("op", ","):
+                    self.next_()
+            self.expect("rparen")
+            return Call(fn=name, args=args)
+        # plain selector
+        self.next_()
+        sel = VectorSelector(metric=name)
+        if self.peek()[0] == "lbrace":
+            sel.matchers = self.parse_matchers()
         return sel
 
-    def parse_expr() -> Query:
-        t = peek()
-        if t[0] == "name" and t[1] in _AGGS:
-            agg = next_()[1]
-            by = []
-            if peek()[0] == "name" and peek()[1] == "by":
-                next_()
-                expect("lparen")
-                while peek()[0] != "rparen":
-                    by.append(expect("name")[1])
-                    if peek()[1] == ",":
-                        next_()
-                expect("rparen")
-            expect("lparen")
-            inner = parse_expr()
-            expect("rparen")
-            if peek()[0] == "name" and peek()[1] == "by":
-                next_()
-                expect("lparen")
-                while peek()[0] != "rparen":
-                    by.append(expect("name")[1])
-                    if peek()[1] == ",":
-                        next_()
-                expect("rparen")
-            inner.agg = agg
-            inner.by = by
-            return inner
-        if t[0] == "name" and t[1] in _RATES:
-            fn = next_()[1]
-            expect("lparen")
-            sel = parse_selector()
-            expect("rparen")
-            if not sel.range_s:
-                raise PromqlError(f"{fn}() needs a [range]")
-            return Query(selector=sel, rate_fn=fn)
-        return Query(selector=parse_selector())
-
-    q_ast = parse_expr()
-    t = peek()
-    if t[0] == "op" and t[1] in "*/+-":
-        op = next_()[1]
-        num = expect("num")[1]
-        q_ast.scalar_op = op
-        q_ast.scalar = float(num)
-    if peek()[0] != "eof":
-        raise PromqlError(f"trailing input: {peek()[1]!r}")
-    return q_ast
+    def parse_agg(self):
+        op = self.next_()[1]
+        grouping, without = [], False
+        if self.at_name("by", "without"):
+            without = self.next_()[1] == "without"
+            grouping = self.parse_label_list()
+        self.expect("lparen")
+        first = self.parse_expr()
+        param = None
+        if self.peek() == ("op", ","):
+            self.next_()
+            param = first
+            first = self.parse_expr()
+        self.expect("rparen")
+        if param is None and op in _PARAM_AGGS:
+            raise PromqlError(f"{op}() needs a parameter")
+        if self.at_name("by", "without"):
+            without = self.next_()[1] == "without"
+            grouping = self.parse_label_list()
+        return Agg(op=op, expr=first, grouping=grouping, without=without,
+                   param=param)
 
 
-# -- evaluation --------------------------------------------------------------
+# fn -> (min_args, max_args); None max = unbounded
+_ARITY = {"histogram_quantile": (2, 2), "label_replace": (5, 5),
+          "clamp": (3, 3), "clamp_min": (2, 2), "clamp_max": (2, 2),
+          "quantile_over_time": (2, 2), "predict_linear": (2, 2),
+          "vector": (1, 1), "scalar": (1, 1), "time": (0, 0),
+          "round": (1, 2), "label_join": (3, None)}
+_DEFAULT_ARITY = (1, 1)
+
+
+def _validate(node) -> None:
+    if isinstance(node, Call):
+        lo, hi = _ARITY.get(node.fn, _DEFAULT_ARITY)
+        if len(node.args) < lo or (hi is not None and len(node.args) > hi):
+            raise PromqlError(
+                f"{node.fn}() takes "
+                f"{lo if lo == hi else f'{lo}+' if hi is None else f'{lo}-{hi}'}"
+                f" argument(s), got {len(node.args)}")
+        if node.fn in _RANGE_FNS:
+            idx = 1 if node.fn == "quantile_over_time" else 0
+            if idx >= len(node.args):
+                raise PromqlError(f"{node.fn}() needs a range argument")
+            arg = node.args[idx]
+            if not isinstance(arg, (MatrixSelector, Subquery)):
+                raise PromqlError(
+                    f"{node.fn}() needs a [range] selector or subquery")
+        for a in node.args:
+            _validate(a)
+    elif isinstance(node, Agg):
+        _validate(node.expr)
+        if node.param is not None:
+            _validate(node.param)
+    elif isinstance(node, BinOp):
+        _validate(node.lhs)
+        _validate(node.rhs)
+    elif isinstance(node, Unary):
+        _validate(node.expr)
+    elif isinstance(node, Subquery):
+        _validate(node.expr)
+
+
+def parse(q: str):
+    p = _Parser(q)
+    ast = p.parse_expr()
+    if p.peek()[0] != "eof":
+        raise PromqlError(f"trailing input: {p.peek()[1]!r}")
+    _validate(ast)
+    return ast
+
+
+# -- storage layer -----------------------------------------------------------
 
 def _mangle(s: str) -> str:
     return "".join(c if c.isalnum() else "_" for c in s)
@@ -244,11 +549,11 @@ def _compile(pattern: str):
         raise PromqlError(f"bad regex {pattern!r}: {e}") from None
 
 
-def _compile_matchers(table, sel, labels_col):
+def _compile_matchers(table, matchers, labels_col):
     """Precompute chunk-independent matcher state -> per-chunk appliers.
     Dictionary scans and regex compilation happen ONCE, not per chunk."""
     appliers = []
-    for lbl, op, val in sel.matchers:
+    for lbl, op, val in matchers:
         negate = op in ("!=", "!~")
         # json-labeled metrics: remote-write user labels ALWAYS match via
         # the json column (they'd be shadowed by same-named universal tag
@@ -307,26 +612,48 @@ def _apply_matchers(appliers, ch) -> np.ndarray | None:
     return mask
 
 
-def evaluate(db: Database, query: str | Query, start_s: int, end_s: int,
-             step_s: int = 15) -> list[dict]:
-    """Range evaluation -> prometheus matrix result."""
-    if isinstance(query, str):
-        query = parse(query)
-    sel = query.selector
-    table, col, tags, pre_filters, labels_col = _resolve_metric(
-        db, sel.metric)
+def _labels_json_ids(table, lbl: str, op: str, val: str,
+                     labels_col: str = "labels_json") -> np.ndarray:
+    """Matching dictionary ids for a matcher over a json label set.
+    (Negation is applied by the caller.)"""
 
-    appliers = _compile_matchers(table, sel, labels_col)
+    def get(s: str) -> str:
+        try:
+            return str(_json.loads(s or "{}").get(lbl, ""))
+        except ValueError:
+            return ""
+
+    if op in ("=", "!="):
+        pred = lambda s: get(s) == val  # noqa: E731
+    else:
+        rx = _compile(val)
+        pred = lambda s: rx.fullmatch(get(s)) is not None  # noqa: E731
+    return table.dicts[labels_col].match_ids(pred)
+
+
+@dataclass
+class RawSeries:
+    """One series' raw samples: sorted times (s) and float values."""
+    labels: dict
+    t: np.ndarray
+    v: np.ndarray
+    counter: bool  # cumulative counter vs per-interval delta samples
+
+
+def fetch_raw(db: Database, vs: VectorSelector, lo_s: float,
+              hi_s: float) -> list[RawSeries]:
+    """All samples in [lo_s, hi_s] for the selector, split into series by
+    the full tag set (series identity is always the full tag set; any
+    grouping happens later across evaluated series)."""
+    table, col, tags, pre_filters, labels_col = _resolve_metric(db, vs.metric)
+    appliers = _compile_matchers(table, vs.matchers, labels_col)
     # remote-write clients send CUMULATIVE counters (standard Prometheus),
     # and dfstats self-telemetry snapshots cumulative process counters;
     # internal flow_metrics tables hold per-interval DELTA samples.
-    # rate()/irate()/increase() must switch semantics accordingly.
     counter_mode = table.name in ("prometheus.samples",
                                   "deepflow_system.deepflow_system")
     chunks = table.snapshot()
     times, values, tag_arrays = [], [], {t: [] for t in tags}
-    # prefetch must cover the instant-vector 300s staleness lookback too
-    window = max(sel.range_s or 0, 300)
     for ch in chunks:
         if not ch or not len(ch["time"]):
             continue
@@ -335,7 +662,7 @@ def evaluate(db: Database, query: str | Query, start_s: int, end_s: int,
         # nanoseconds, u32 are epoch seconds
         if table.columns["time"].kind == "u64":
             t = t // 1_000_000_000
-        mask = (t >= start_s - window) & (t <= end_s)
+        mask = (t >= lo_s) & (t <= hi_s)
         for pf_col, pf_code in (pre_filters or []):
             mask &= ch[pf_col] == pf_code
         m = _apply_matchers(appliers, ch)
@@ -354,11 +681,6 @@ def evaluate(db: Database, query: str | Query, start_s: int, end_s: int,
     v_all = np.concatenate(values)
     tag_all = {lbl: np.concatenate(tag_arrays[lbl]) for lbl in tags}
 
-    # series identity is ALWAYS the full tag set: aggregation happens across
-    # evaluated series in _aggregate_series (grouped by the `by` labels), never
-    # by pre-merging raw samples — pre-merging makes every aggregate except
-    # sum(rate(...)) wrong (e.g. instant sum() would return one sample, count()
-    # would return 1).
     group_labels = [g for g in tags if g in tag_all]
     key = np.zeros(len(t_all), dtype=np.int64)
     for lbl in group_labels:
@@ -370,19 +692,17 @@ def evaluate(db: Database, query: str | Query, start_s: int, end_s: int,
                            return_inverse=True)
 
     out = []
-    steps = np.arange(start_s, end_s + 1, step_s)
     for gk in np.unique(key):
         gmask = key == gk
         gt, gv = t_all[gmask], v_all[gmask]
         order = np.argsort(gt, kind="stable")
         gt, gv = gt[order], gv[order]
-        labels = {"__name__": sel.metric}
+        labels = {"__name__": vs.metric}
         gi = np.flatnonzero(gmask)[0]
         for lbl in group_labels:
             spec = table.columns[lbl]
             raw = tag_all[lbl][gi]
             if lbl == labels_col and spec.kind == "str":
-                import json as _json
                 try:
                     labels.update(_json.loads(
                         table.dicts[lbl].decode(int(raw)) or "{}"))
@@ -394,78 +714,42 @@ def evaluate(db: Database, query: str | Query, start_s: int, end_s: int,
                 labels[lbl] = spec.enum_values[int(raw)]
             else:
                 labels[lbl] = str(int(raw))
-        samples = []
-        # gt is sorted: each step's window is a searchsorted slice, O(log n)
-        # per step instead of an O(n) mask (matters now that aggregates
-        # evaluate every series)
-        for ts in steps:
-            if query.rate_fn:
-                lo = ts - sel.range_s
-                i0 = int(np.searchsorted(gt, lo, side="right"))
-                i1 = int(np.searchsorted(gt, ts, side="right"))
-                if i1 <= i0:
-                    continue
-                if counter_mode:
-                    v = _counter_rate(gt[i0:i1], gv[i0:i1], query.rate_fn,
-                                      sel.range_s, float(lo), float(ts))
-                    if v is not None:
-                        samples.append((int(ts), v))
-                    continue
-                if query.rate_fn == "irate":
-                    # instantaneous: the last two DISTINCT timestamps in
-                    # the window, with co-timestamped rows summed (a series
-                    # can hold several rows per second)
-                    wt, wv = gt[i0:i1], gv[i0:i1]
-                    uts, inv = np.unique(wt, return_inverse=True)
-                    if len(uts) < 2:
-                        continue
-                    sums = np.bincount(inv, weights=wv)
-                    dt = float(uts[-1] - uts[-2])
-                    samples.append((int(ts), float(sums[-1]) / dt))
-                    continue
-                total = float(gv[i0:i1].sum())
-                if query.rate_fn == "rate":
-                    total /= max(sel.range_s, 1e-9)
-                samples.append((int(ts), total))
-            else:
-                i1 = int(np.searchsorted(gt, ts, side="right"))
-                if i1 == 0:
-                    continue
-                # instant: most recent sample within 5m lookback
-                if ts - gt[i1 - 1] > 300:
-                    continue
-                samples.append((int(ts), float(gv[i1 - 1])))
-        if samples:
-            out.append({"metric": labels, "values": samples})
-
-    if query.agg:
-        out = _aggregate_series(out, query.agg, query.by)
-    if query.scalar_op:
-        for series in out:
-            series["values"] = [
-                (t, _scalar(v, query.scalar_op, query.scalar))
-                for t, v in series["values"]]
+        out.append(RawSeries(labels=labels, t=gt, v=gv,
+                             counter=counter_mode))
     return out
 
 
-def _labels_json_ids(table, lbl: str, op: str, val: str,
-                     labels_col: str = "labels_json") -> np.ndarray:
-    """Matching dictionary ids for a matcher over a json label set.
-    (Negation is applied by the caller.)"""
-    import json as _json
+# -- evaluation --------------------------------------------------------------
 
-    def get(s: str) -> str:
-        try:
-            return str(_json.loads(s or "{}").get(lbl, ""))
-        except ValueError:
-            return ""
+@dataclass
+class Series:
+    """An evaluated series: one value per step (NaN = no sample)."""
+    labels: dict
+    vals: np.ndarray
 
-    if op in ("=", "!="):
-        pred = lambda s: get(s) == val  # noqa: E731
+
+def _sig(labels: dict, matching: VectorMatching | None) -> tuple:
+    if matching is None:
+        keep = sorted(k for k in labels if k != "__name__")
+    elif matching.on:
+        keep = sorted(matching.labels)
     else:
-        rx = _compile(val)
-        pred = lambda s: rx.fullmatch(get(s)) is not None  # noqa: E731
-    return table.dicts[labels_col].match_ids(pred)
+        drop = set(matching.labels) | {"__name__"}
+        keep = sorted(k for k in labels if k not in drop)
+    return tuple((k, labels.get(k, "")) for k in keep)
+
+
+def _drop_name(labels: dict) -> dict:
+    return {k: v for k, v in labels.items() if k != "__name__"}
+
+
+def _group_key(labels: dict, grouping: list[str], without: bool) -> tuple:
+    """Aggregation group signature for by(...)/without(...)."""
+    if without:
+        drop = set(grouping) | {"__name__"}
+        return tuple(sorted((k, v) for k, v in labels.items()
+                            if k not in drop))
+    return tuple((k, labels.get(k, "")) for k in grouping)
 
 
 def _counter_rate(wt: np.ndarray, wv: np.ndarray, fn: str, range_s: float,
@@ -518,41 +802,781 @@ def _counter_rate(wt: np.ndarray, wv: np.ndarray, fn: str, range_s: float,
     return increase / max(range_s, 1e-9)
 
 
-def _scalar(v: float, op: str, s: float) -> float:
-    if op == "*":
-        return v * s
-    if op == "/":
-        return v / s if s else 0.0
-    if op == "+":
-        return v + s
-    return v - s
+def _delta_rate(wt: np.ndarray, wv: np.ndarray, fn: str,
+                range_s: float) -> float | None:
+    """Delta-sample semantics for the internal flow_metrics tables: each row
+    already holds the increase over its interval."""
+    if not len(wt):
+        return None
+    if fn == "irate":
+        # instantaneous: the last two DISTINCT timestamps in the window,
+        # with co-timestamped rows summed (a series can hold several rows
+        # per second)
+        uts, inv = np.unique(wt, return_inverse=True)
+        if len(uts) < 2:
+            return None
+        sums = np.bincount(inv, weights=wv)
+        dt = float(uts[-1] - uts[-2])
+        return float(sums[-1]) / dt
+    total = float(wv.sum())
+    if fn == "rate":
+        return total / max(range_s, 1e-9)
+    return total  # increase
 
 
-def _aggregate_series(series: list[dict], agg: str,
-                      by: list[str]) -> list[dict]:
-    groups: dict[tuple, list] = {}
-    for s in series:
-        key = tuple((lbl, s["metric"].get(lbl, "")) for lbl in by)
-        groups.setdefault(key, []).append(s)
+def _range_fn_value(fn: str, wt: np.ndarray, wv: np.ndarray, counter: bool,
+                    range_s: float, lo: float, hi: float,
+                    phi: float = 0.0, horizon: float = 0.0) -> float | None:
+    """Apply a range function to one series' window (lo, hi]."""
+    n = len(wt)
+    if fn in ("rate", "irate", "increase"):
+        if counter:
+            return _counter_rate(wt, wv, fn, range_s, lo, hi)
+        return _delta_rate(wt, wv, fn, range_s)
+    if fn in ("absent_over_time", "present_over_time"):
+        raise AssertionError("handled by caller")
+    if n == 0:
+        return None
+    if fn == "avg_over_time":
+        return float(wv.mean())
+    if fn == "min_over_time":
+        return float(wv.min())
+    if fn == "max_over_time":
+        return float(wv.max())
+    if fn == "sum_over_time":
+        return float(wv.sum())
+    if fn == "count_over_time":
+        return float(n)
+    if fn == "last_over_time":
+        return float(wv[-1])
+    if fn == "stddev_over_time":
+        return float(wv.std())
+    if fn == "stdvar_over_time":
+        return float(wv.var())
+    if fn == "quantile_over_time":
+        return float(np.quantile(wv, min(max(phi, 0.0), 1.0)))
+    if fn == "changes":
+        return float(np.count_nonzero(np.diff(wv))) if n > 1 else 0.0
+    if fn == "resets":
+        return float(np.count_nonzero(np.diff(wv) < 0)) if n > 1 else 0.0
+    if fn == "idelta":
+        uts = np.unique(wt)
+        if len(uts) < 2:
+            return None
+        i_last = int(np.searchsorted(wt, uts[-1], side="right")) - 1
+        i_prev = int(np.searchsorted(wt, uts[-2], side="right")) - 1
+        return float(wv[i_last] - wv[i_prev])
+    if fn in ("delta", "deriv", "predict_linear"):
+        if n < 2:
+            return None
+        sampled = float(wt[-1] - wt[0])
+        if sampled <= 0:
+            return None
+        if fn == "delta":
+            # gauge delta with the same boundary extrapolation as rate
+            d = float(wv[-1] - wv[0])
+            avg_spacing = sampled / (n - 1)
+            threshold = avg_spacing * 1.1
+            to_start = float(wt[0]) - lo
+            to_end = hi - float(wt[-1])
+            if to_start >= threshold:
+                to_start = avg_spacing / 2
+            if to_end >= threshold:
+                to_end = avg_spacing / 2
+            return d * (sampled + to_start + to_end) / sampled
+        # least-squares slope (upstream uses simple linear regression
+        # anchored at the window's first timestamp for stability)
+        x = (wt - wt[0]).astype(np.float64)
+        xm, ym = x.mean(), wv.mean()
+        denom = float(((x - xm) ** 2).sum())
+        if denom == 0:
+            return None
+        slope = float(((x - xm) * (wv - ym)).sum()) / denom
+        if fn == "deriv":
+            return slope
+        # predict_linear: value at hi + horizon
+        intercept = ym - slope * xm
+        return intercept + slope * (hi - float(wt[0]) + horizon)
+    raise PromqlError(f"unsupported range function {fn}()")
+
+
+class _Evaluator:
+    def __init__(self, db: Database, steps: np.ndarray,
+                 default_res_s: float = 15.0):
+        self.db = db
+        self.steps = steps.astype(np.float64)
+        self.default_res_s = default_res_s
+
+    # -- selector eval -----------------------------------------------------
+
+    def instant_vector(self, vs: VectorSelector) -> list[Series]:
+        off = vs.offset_s
+        lo = float(self.steps[0]) - off - _LOOKBACK_S
+        hi = float(self.steps[-1]) - off
+        out = []
+        for rs in fetch_raw(self.db, vs, lo, hi):
+            q = self.steps - off
+            idx = np.searchsorted(rs.t, q, side="right") - 1
+            valid = idx >= 0
+            safe = np.where(valid, idx, 0)
+            age = q - rs.t[safe]
+            valid &= age <= _LOOKBACK_S
+            vals = np.where(valid, rs.v[safe], np.nan)
+            if np.isnan(vals).all():
+                continue
+            out.append(Series(labels=rs.labels, vals=vals))
+        return out
+
+    def range_series(self, node) -> tuple[list[RawSeries], float, float]:
+        """-> (raw series, range_s, offset_s) for a matrix selector or
+        subquery argument of a range function."""
+        if isinstance(node, MatrixSelector):
+            off = node.vs.offset_s
+            lo = float(self.steps[0]) - off - node.range_s
+            hi = float(self.steps[-1]) - off
+            return fetch_raw(self.db, node.vs, lo, hi), node.range_s, off
+        if isinstance(node, Subquery):
+            return (self.eval_subquery(node), node.range_s, node.offset_s)
+        raise PromqlError("expected a range expression (selector[d] or "
+                          "subquery[d:s])")
+
+    def eval_subquery(self, sq: Subquery) -> list[RawSeries]:
+        res = sq.step_s or self.default_res_s
+        off = sq.offset_s
+        lo = float(self.steps[0]) - off - sq.range_s
+        hi = float(self.steps[-1]) - off
+        # subquery steps align to absolute multiples of the resolution
+        first = math.ceil(lo / res) * res
+        sub_steps = np.arange(first, hi + res / 2, res)
+        if not len(sub_steps):
+            return []
+        sub = _Evaluator(self.db, sub_steps, self.default_res_s)
+        vec = sub.eval_vector(sq.expr, "subquery")
+        out = []
+        for s in vec:
+            keep = ~np.isnan(s.vals)
+            if not keep.any():
+                continue
+            # subquery output samples are treated as cumulative by the
+            # counter-aware range functions, matching upstream rate() over
+            # subqueries
+            out.append(RawSeries(labels=s.labels, t=sub_steps[keep],
+                                 v=s.vals[keep], counter=True))
+        return out
+
+    # -- generic eval ------------------------------------------------------
+
+    def eval(self, node):
+        """-> Series list (vector) or np.ndarray (scalar-per-step)."""
+        if isinstance(node, Num):
+            return np.full(len(self.steps), node.value)
+        if isinstance(node, Str):
+            return node
+        if isinstance(node, VectorSelector):
+            return self.instant_vector(node)
+        if isinstance(node, (MatrixSelector, Subquery)):
+            raise PromqlError("range expression must be wrapped in a "
+                              "range function like rate()")
+        if isinstance(node, Unary):
+            val = self.eval(node.expr)
+            if isinstance(val, Str):
+                raise PromqlError("cannot negate a string")
+            if isinstance(val, np.ndarray):
+                return -val
+            return [Series(labels=_drop_name(s.labels), vals=-s.vals)
+                    for s in val]
+        if isinstance(node, Call):
+            return self.eval_call(node)
+        if isinstance(node, Agg):
+            return self.eval_agg(node)
+        if isinstance(node, BinOp):
+            return self.eval_binop(node)
+        raise PromqlError(f"cannot evaluate {type(node).__name__}")
+
+    def eval_vector(self, node, ctx: str) -> list[Series]:
+        v = self.eval(node)
+        if isinstance(v, np.ndarray):
+            raise PromqlError(f"{ctx} expects an instant vector, got scalar")
+        if isinstance(v, Str):
+            raise PromqlError(f"{ctx} expects an instant vector, got string")
+        return v
+
+    def eval_scalar(self, node, ctx: str) -> np.ndarray:
+        v = self.eval(node)
+        if not isinstance(v, np.ndarray):
+            raise PromqlError(f"{ctx} expects a scalar")
+        return v
+
+    # -- functions ---------------------------------------------------------
+
+    def eval_call(self, node: Call):
+        fn = node.fn
+        if fn in _RANGE_FNS:
+            return self.eval_range_fn(node)
+        if fn == "time":
+            return self.steps.copy()
+        if fn == "scalar":
+            vec = self.eval_vector(node.args[0], "scalar()")
+            out = np.full(len(self.steps), np.nan)
+            if len(vec) == 1:
+                out = vec[0].vals.copy()
+            return out
+        if fn == "vector":
+            s = self.eval_scalar(node.args[0], "vector()")
+            return [Series(labels={}, vals=s)]
+        if fn == "absent":
+            vec = self.eval(node.args[0]) if not isinstance(
+                node.args[0], VectorSelector) else None
+            labels = {}
+            if isinstance(node.args[0], VectorSelector):
+                try:
+                    vec = self.instant_vector(node.args[0])
+                except PromqlError:
+                    vec = []  # unknown metric is definitionally absent
+                labels = {lbl: val for lbl, op, val
+                          in node.args[0].matchers if op == "="}
+            if isinstance(vec, np.ndarray):
+                raise PromqlError("absent() expects an instant vector")
+            present = np.zeros(len(self.steps), dtype=bool)
+            for s in (vec or []):
+                present |= ~np.isnan(s.vals)
+            vals = np.where(present, np.nan, 1.0)
+            if np.isnan(vals).all():
+                return []
+            return [Series(labels=labels, vals=vals)]
+        if fn in _MATH_FNS:
+            vec = self.eval(node.args[0])
+            op = _MATH_FNS[fn]
+            with np.errstate(all="ignore"):
+                if isinstance(vec, np.ndarray):
+                    return op(vec)
+                return [Series(labels=_drop_name(s.labels),
+                               vals=op(s.vals)) for s in vec]
+        if fn == "round":
+            vec = self.eval_vector(node.args[0], "round()")
+            to = 1.0
+            if len(node.args) > 1:
+                to_arr = self.eval_scalar(node.args[1], "round()")
+                to = float(to_arr[0]) if len(to_arr) else 1.0
+            if to <= 0:
+                raise PromqlError("round() nearest must be positive")
+            # Prometheus rounds half toward +Inf, not half-to-even
+            return [Series(labels=_drop_name(s.labels),
+                           vals=np.floor(s.vals / to + 0.5) * to)
+                    for s in vec]
+        if fn in ("clamp", "clamp_min", "clamp_max"):
+            vec = self.eval_vector(node.args[0], fn)
+            if fn == "clamp":
+                lo = self.eval_scalar(node.args[1], fn)
+                hi = self.eval_scalar(node.args[2], fn)
+                return [Series(labels=_drop_name(s.labels),
+                               vals=np.clip(s.vals, lo, hi)) for s in vec]
+            bound = self.eval_scalar(node.args[1], fn)
+            f = np.maximum if fn == "clamp_min" else np.minimum
+            return [Series(labels=_drop_name(s.labels),
+                           vals=f(s.vals, bound)) for s in vec]
+        if fn == "timestamp":
+            vec = self.eval_vector(node.args[0], fn)
+            return [Series(labels=_drop_name(s.labels),
+                           vals=np.where(np.isnan(s.vals), np.nan,
+                                         self.steps)) for s in vec]
+        if fn == "histogram_quantile":
+            phi_arr = self.eval_scalar(node.args[0], fn)
+            vec = self.eval_vector(node.args[1], fn)
+            return self._histogram_quantile(phi_arr, vec)
+        if fn == "label_replace":
+            vec = self.eval_vector(node.args[0], fn)
+            dst, repl, src, regex = (self._str_arg(a) for a in node.args[1:5])
+            rx = _compile(regex)
+            out = []
+            for s in vec:
+                labels = dict(s.labels)
+                m = rx.fullmatch(labels.get(src, ""))
+                if m:
+                    val = m.expand(re.sub(r"\$(\d+)", r"\\\1", repl))
+                    if val:
+                        labels[dst] = val
+                    else:
+                        labels.pop(dst, None)
+                out.append(Series(labels=labels, vals=s.vals))
+            return out
+        if fn == "label_join":
+            vec = self.eval_vector(node.args[0], fn)
+            dst = self._str_arg(node.args[1])
+            sep = self._str_arg(node.args[2])
+            srcs = [self._str_arg(a) for a in node.args[3:]]
+            out = []
+            for s in vec:
+                labels = dict(s.labels)
+                labels[dst] = sep.join(labels.get(k, "") for k in srcs)
+                out.append(Series(labels=labels, vals=s.vals))
+            return out
+        if fn in ("sort", "sort_desc"):
+            vec = self.eval_vector(node.args[0], fn)
+            def last_val(s):
+                ok = s.vals[~np.isnan(s.vals)]
+                return float(ok[-1]) if len(ok) else -math.inf
+            return sorted(vec, key=last_val, reverse=(fn == "sort_desc"))
+        raise PromqlError(f"unsupported function {fn}()")
+
+    def _str_arg(self, node) -> str:
+        if not isinstance(node, Str):
+            raise PromqlError("expected a string literal argument")
+        return node.value
+
+    def eval_range_fn(self, node: Call) -> list[Series]:
+        fn = node.fn
+        phi_arr = None
+        horizon = 0.0
+        if fn == "quantile_over_time":
+            phi_arr = self.eval_scalar(node.args[0], fn)
+            range_arg = node.args[1]
+        elif fn == "predict_linear":
+            h = self.eval_scalar(node.args[1], fn)
+            horizon = float(h[0]) if len(h) else 0.0
+            range_arg = node.args[0]
+        else:
+            if len(node.args) != 1:
+                raise PromqlError(f"{fn}() takes one range argument")
+            range_arg = node.args[0]
+        raw, range_s, off = self.range_series(range_arg)
+        if fn in ("rate", "irate", "increase") and isinstance(
+                range_arg, MatrixSelector) and range_s <= 0:
+            raise PromqlError(f"{fn}() needs a [range]")
+        steps = self.steps
+        if fn == "absent_over_time":
+            present = np.zeros(len(steps), dtype=bool)
+            for rs in raw:
+                for i, ts in enumerate(steps):
+                    hi = float(ts) - off
+                    lo = hi - range_s
+                    i0 = int(np.searchsorted(rs.t, lo, side="right"))
+                    i1 = int(np.searchsorted(rs.t, hi, side="right"))
+                    if i1 > i0:
+                        present[i] = True
+            vals = np.where(present, np.nan, 1.0)
+            if np.isnan(vals).all():
+                return []
+            labels = {}
+            if isinstance(range_arg, MatrixSelector):
+                labels = {lbl: val for lbl, op, val
+                          in range_arg.vs.matchers if op == "="}
+            return [Series(labels=labels, vals=vals)]
+        out = []
+        for rs in raw:
+            vals = np.full(len(steps), np.nan)
+            for i, ts in enumerate(steps):
+                hi = float(ts) - off
+                lo = hi - range_s
+                i0 = int(np.searchsorted(rs.t, lo, side="right"))
+                i1 = int(np.searchsorted(rs.t, hi, side="right"))
+                if fn == "present_over_time":
+                    if i1 > i0:
+                        vals[i] = 1.0
+                    continue
+                phi = (float(phi_arr[i]) if phi_arr is not None else 0.0)
+                v = _range_fn_value(fn, rs.t[i0:i1], rs.v[i0:i1], rs.counter,
+                                    range_s, lo, hi, phi=phi,
+                                    horizon=horizon)
+                if v is not None:
+                    vals[i] = v
+            if np.isnan(vals).all():
+                continue
+            out.append(Series(labels=_drop_name(rs.labels), vals=vals))
+        return out
+
+    def _histogram_quantile(self, phi_arr: np.ndarray,
+                            vec: list[Series]) -> list[Series]:
+        groups: dict[tuple, list[tuple[float, Series]]] = {}
+        for s in vec:
+            le = s.labels.get("le")
+            if le is None:
+                continue
+            try:
+                bound = float(le)
+            except ValueError:
+                continue
+            key = tuple(sorted((k, v) for k, v in s.labels.items()
+                               if k not in ("le", "__name__")))
+            groups.setdefault(key, []).append((bound, s))
+        out = []
+        for key, buckets in groups.items():
+            buckets.sort(key=lambda bs: bs[0])
+            bounds = np.array([b for b, _ in buckets])
+            mat = np.vstack([s.vals for _, s in buckets])
+            vals = np.full(len(self.steps), np.nan)
+            for i in range(len(self.steps)):
+                col = mat[:, i]
+                ok = ~np.isnan(col)
+                if not ok.any():
+                    continue
+                b = bounds[ok]
+                c = np.maximum.accumulate(col[ok])  # enforce monotonicity
+                if len(b) < 2 or not math.isinf(b[-1]):
+                    continue  # need an +Inf bucket to anchor the total
+                total = c[-1]
+                if total <= 0:
+                    continue
+                phi = float(phi_arr[i])
+                if phi < 0:
+                    vals[i] = -math.inf
+                    continue
+                if phi > 1:
+                    vals[i] = math.inf
+                    continue
+                rank = phi * total
+                j = int(np.searchsorted(c, rank, side="left"))
+                j = min(j, len(b) - 1)
+                if j == len(b) - 1:  # falls in the +Inf bucket
+                    vals[i] = float(b[-2])
+                    continue
+                lo_bound = float(b[j - 1]) if j > 0 else 0.0
+                if j == 0 and b[0] <= 0:
+                    lo_bound = float(b[0])
+                lo_count = float(c[j - 1]) if j > 0 else 0.0
+                span = float(c[j]) - lo_count
+                if span <= 0:
+                    vals[i] = float(b[j])
+                    continue
+                vals[i] = lo_bound + (float(b[j]) - lo_bound) * (
+                    (rank - lo_count) / span)
+            if np.isnan(vals).all():
+                continue
+            out.append(Series(labels=dict(key), vals=vals))
+        return out
+
+    # -- aggregation -------------------------------------------------------
+
+    def eval_agg(self, node: Agg) -> list[Series]:
+        vec = self.eval_vector(node.expr, node.op)
+        if node.op == "count_values":
+            return self._count_values(node, vec)
+        param = None
+        if node.param is not None:
+            param = self.eval_scalar(node.param, node.op)
+
+        groups: dict[tuple, list[Series]] = {}
+        for s in vec:
+            groups.setdefault(
+                _group_key(s.labels, node.grouping, node.without),
+                []).append(s)
+        out = []
+        for key, members in groups.items():
+            mat = np.vstack([s.vals for s in members])
+            valid = ~np.isnan(mat)
+            any_valid = valid.any(axis=0)
+            with np.errstate(all="ignore"):
+                if node.op == "sum":
+                    vals = np.nansum(mat, axis=0)
+                elif node.op == "avg":
+                    vals = np.nanmean(mat, axis=0)
+                elif node.op == "min":
+                    vals = np.nanmin(
+                        np.where(valid, mat, np.inf), axis=0)
+                elif node.op == "max":
+                    vals = np.nanmax(
+                        np.where(valid, mat, -np.inf), axis=0)
+                elif node.op == "count":
+                    vals = valid.sum(axis=0).astype(np.float64)
+                elif node.op == "group":
+                    vals = np.ones(mat.shape[1])
+                elif node.op == "stddev":
+                    vals = np.nanstd(mat, axis=0)
+                elif node.op == "stdvar":
+                    vals = np.nanvar(mat, axis=0)
+                elif node.op == "quantile":
+                    phi = np.clip(param, 0.0, 1.0)
+                    vals = np.full(mat.shape[1], np.nan)
+                    for i in range(mat.shape[1]):
+                        col = mat[:, i][valid[:, i]]
+                        if len(col):
+                            vals[i] = float(np.quantile(col, float(phi[i])))
+                elif node.op in ("topk", "bottomk"):
+                    # per-step selection: members keep their own labels
+                    k_arr = param
+                    keep = np.zeros_like(mat, dtype=bool)
+                    sign = -1.0 if node.op == "topk" else 1.0
+                    for i in range(mat.shape[1]):
+                        k = int(k_arr[i]) if not math.isnan(k_arr[i]) else 0
+                        if k <= 0:
+                            continue
+                        col = np.where(valid[:, i], sign * mat[:, i], np.inf)
+                        order = np.argsort(col, kind="stable")
+                        chosen = [j for j in order[:k] if valid[j, i]]
+                        keep[chosen, i] = True
+                    for j, s in enumerate(members):
+                        vals_j = np.where(keep[j], mat[j], np.nan)
+                        if not np.isnan(vals_j).all():
+                            # topk/bottomk keep the member's own labels
+                            out.append(Series(labels=dict(s.labels),
+                                              vals=vals_j))
+                    continue
+                else:
+                    raise PromqlError(f"unsupported aggregate {node.op}")
+            vals = np.where(any_valid, vals, np.nan)
+            if np.isnan(vals).all():
+                continue
+            out.append(Series(labels=dict(key), vals=vals))
+        return out
+
+    def _count_values(self, node: Agg, vec: list[Series]) -> list[Series]:
+        if not isinstance(node.param, Str):
+            raise PromqlError("count_values() needs a string label")
+        dst = node.param.value
+        counts: dict[tuple, np.ndarray] = {}
+        for s in vec:
+            base = _group_key(s.labels, node.grouping, node.without)
+            for i, v in enumerate(s.vals):
+                if math.isnan(v):
+                    continue
+                sval = (_fmt_num(v) if not math.isfinite(v)
+                        else repr(v) if v != int(v) else str(int(v)))
+                key = base + ((dst, sval),)
+                if key not in counts:
+                    counts[key] = np.full(len(self.steps), np.nan)
+                cur = counts[key][i]
+                counts[key][i] = 1.0 if math.isnan(cur) else cur + 1.0
+        return [Series(labels=dict(key), vals=vals)
+                for key, vals in counts.items()]
+
+    # -- binary operators --------------------------------------------------
+
+    def eval_binop(self, node: BinOp):
+        lhs = self.eval(node.lhs)
+        rhs = self.eval(node.rhs)
+        l_scalar = isinstance(lhs, np.ndarray)
+        r_scalar = isinstance(rhs, np.ndarray)
+        op = node.op
+        if op in _SET_OPS:
+            if l_scalar or r_scalar:
+                raise PromqlError(f"{op} requires vectors on both sides")
+            return self._set_op(op, lhs, rhs, node.matching)
+        if l_scalar and r_scalar:
+            if op in _CMP_OPS and not node.bool_mod:
+                raise PromqlError(
+                    "comparison between scalars needs the bool modifier")
+            with np.errstate(all="ignore"):
+                return self._apply_op(op, lhs, rhs, bool_mod=True)
+        if l_scalar or r_scalar:
+            vec, sc, flip = ((rhs, lhs, True) if l_scalar
+                             else (lhs, rhs, False))
+            out = []
+            for s in vec:
+                a, b = (sc, s.vals) if flip else (s.vals, sc)
+                with np.errstate(all="ignore"):
+                    vals = self._apply_op(op, a, b, bool_mod=node.bool_mod)
+                if op in _CMP_OPS and not node.bool_mod:
+                    # filter: keep the vector's own value where true
+                    vals = np.where(np.isnan(vals), np.nan, s.vals)
+                if np.isnan(vals).all():
+                    continue
+                labels = (_drop_name(s.labels)
+                          if (op not in _CMP_OPS or node.bool_mod)
+                          else dict(s.labels))
+                out.append(Series(labels=labels, vals=vals))
+            return out
+        return self._vector_binop(node, lhs, rhs)
+
+    def _apply_op(self, op: str, a, b, bool_mod: bool) -> np.ndarray:
+        if op == "+":
+            return a + b
+        if op == "-":
+            return a - b
+        if op == "*":
+            return a * b
+        if op == "/":
+            return np.where(b == 0, np.where(
+                np.isnan(np.asarray(a, dtype=float)), np.nan,
+                np.sign(a) * np.inf), a / np.where(b == 0, 1, b))
+        if op == "%":
+            return np.where(b == 0, np.nan, np.fmod(a, np.where(b == 0, 1, b)))
+        if op == "^":
+            return np.power(a, b)
+        cmp = {"==": np.equal, "!=": np.not_equal, ">": np.greater,
+               "<": np.less, ">=": np.greater_equal,
+               "<=": np.less_equal}[op](a, b)
+        # NaN on either side -> no result
+        nan = np.isnan(np.asarray(a, dtype=float)) | np.isnan(
+            np.asarray(b, dtype=float))
+        if bool_mod:
+            return np.where(nan, np.nan, cmp.astype(np.float64))
+        return np.where(nan | ~cmp, np.nan, 1.0)
+
+    def _set_op(self, op: str, lhs: list[Series], rhs: list[Series],
+                matching: VectorMatching | None) -> list[Series]:
+        # per-step presence matters: `and` keeps lhs points whose signature
+        # has a present rhs point at that step
+        rsig: dict[tuple, np.ndarray] = {}
+        for s in rhs:
+            sig = _sig(s.labels, matching)
+            present = ~np.isnan(s.vals)
+            rsig[sig] = rsig.get(sig, np.zeros(len(self.steps),
+                                               dtype=bool)) | present
+        out = []
+        if op in ("and", "unless"):
+            for s in lhs:
+                mask = rsig.get(_sig(s.labels, matching),
+                                np.zeros(len(self.steps), dtype=bool))
+                if op == "unless":
+                    mask = ~mask
+                vals = np.where(mask, s.vals, np.nan)
+                if not np.isnan(vals).all():
+                    out.append(Series(labels=s.labels, vals=vals))
+            return out
+        # or: all of lhs, plus rhs points whose signature has no lhs point
+        lsig: dict[tuple, np.ndarray] = {}
+        for s in lhs:
+            sig = _sig(s.labels, matching)
+            present = ~np.isnan(s.vals)
+            lsig[sig] = lsig.get(sig, np.zeros(len(self.steps),
+                                               dtype=bool)) | present
+            out.append(s)
+        for s in rhs:
+            lmask = lsig.get(_sig(s.labels, matching),
+                             np.zeros(len(self.steps), dtype=bool))
+            vals = np.where(lmask, np.nan, s.vals)
+            if not np.isnan(vals).all():
+                out.append(Series(labels=s.labels, vals=vals))
+        return out
+
+    def _vector_binop(self, node: BinOp, lhs: list[Series],
+                      rhs: list[Series]) -> list[Series]:
+        matching = node.matching or VectorMatching()
+        card = matching.card
+        if card == "one-to-many":  # normalize: swap sides
+            flip_ops = {">": "<", "<": ">", ">=": "<=", "<=": ">="}
+            op = flip_ops.get(node.op, node.op)
+            swapped = BinOp(op=op, lhs=node.rhs, rhs=node.lhs,
+                            bool_mod=node.bool_mod,
+                            matching=VectorMatching(
+                                on=matching.on, labels=matching.labels,
+                                card="many-to-one",
+                                include=matching.include))
+            if node.op in ("-", "/", "%", "^"):
+                # non-commutative: keep operand order, just treat rhs as
+                # the "many" side by matching manually below
+                pass
+            else:
+                return self._vector_binop(swapped, rhs, lhs)
+        many, one = lhs, rhs
+        swapped_order = False
+        if card == "one-to-many":
+            many, one = rhs, lhs
+            swapped_order = True
+        one_by_sig: dict[tuple, Series] = {}
+        for s in one:
+            sig = _sig(s.labels, matching)
+            if sig in one_by_sig:
+                raise PromqlError(
+                    "many-to-many matching: duplicate series on the "
+                    f"{'left' if swapped_order else 'right'} side "
+                    f"for signature {dict(sig)!r}")
+            one_by_sig[sig] = s
+        if card == "one-to-one":
+            seen: set[tuple] = set()
+            for s in many:
+                sig = _sig(s.labels, matching)
+                if sig in seen:
+                    raise PromqlError(
+                        "many-to-many matching: duplicate series on the "
+                        f"left side for signature {dict(sig)!r}")
+                seen.add(sig)
+        out = []
+        for s in many:
+            other = one_by_sig.get(_sig(s.labels, matching))
+            if other is None:
+                continue
+            a, b = s.vals, other.vals
+            if swapped_order:
+                a, b = b, a
+            with np.errstate(all="ignore"):
+                vals = self._apply_op(node.op, a, b,
+                                      bool_mod=node.bool_mod)
+            if node.op in _CMP_OPS and not node.bool_mod:
+                vals = np.where(np.isnan(vals), np.nan, s.vals)
+            if np.isnan(vals).all():
+                continue
+            # result labels
+            if card == "one-to-one":
+                if matching.on:
+                    labels = dict(_sig(s.labels, matching))
+                else:
+                    labels = _drop_name(s.labels)
+                if node.op in _CMP_OPS and not node.bool_mod:
+                    labels = (dict(s.labels) if not matching.on
+                              else labels)
+            else:
+                labels = _drop_name(dict(s.labels))
+                for lbl in matching.include:
+                    if lbl in other.labels:
+                        labels[lbl] = other.labels[lbl]
+                    else:
+                        labels.pop(lbl, None)
+            out.append(Series(labels=labels, vals=vals))
+        return out
+
+
+# -- public API --------------------------------------------------------------
+
+def evaluate(db: Database, query, start_s: int, end_s: int,
+             step_s: int = 15) -> list[dict]:
+    """Range evaluation -> prometheus matrix result
+    [{"metric": labels, "values": [(ts, value), ...]}, ...]."""
+    if isinstance(query, str):
+        query = parse(query)
+    steps = np.arange(start_s, end_s + 1, step_s, dtype=np.int64)
+    if not len(steps):
+        return []
+    ev = _Evaluator(db, steps, default_res_s=float(step_s))
+    result = ev.eval(query)
+    if isinstance(result, Str):
+        raise PromqlError("query evaluates to a string, not a vector")
+    if isinstance(result, np.ndarray):
+        vals = [(int(t), _json_num(v)) for t, v in zip(steps, result)
+                if not math.isnan(v)]
+        return [{"metric": {}, "values": vals}] if vals else []
     out = []
-    for key, members in groups.items():
-        merged: dict[int, list[float]] = {}
-        for s in members:
-            for t, v in s["values"]:
-                merged.setdefault(t, []).append(v)
-        labels = dict(key)
-        vals = []
-        for t in sorted(merged):
-            vs = merged[t]
-            if agg == "sum":
-                vals.append((t, float(sum(vs))))
-            elif agg == "avg":
-                vals.append((t, float(sum(vs) / len(vs))))
-            elif agg == "min":
-                vals.append((t, float(min(vs))))
-            elif agg == "max":
-                vals.append((t, float(max(vs))))
-            else:  # count
-                vals.append((t, float(len(vs))))
-        out.append({"metric": labels, "values": vals})
+    for s in result:
+        vals = [(int(t), _json_num(v)) for t, v in zip(steps, s.vals)
+                if not math.isnan(v)]
+        if vals:
+            out.append({"metric": s.labels, "values": vals})
     return out
+
+
+def _json_num(v: float):
+    """Finite floats stay numbers; +/-Inf must not reach json.dumps (it
+    emits the invalid-JSON token Infinity), so they go out as the
+    prometheus string spelling."""
+    v = float(v)
+    return v if math.isfinite(v) else _fmt_num(v)
+
+
+def evaluate_instant(db: Database, query, time_s: int) -> dict:
+    """Instant evaluation -> {"resultType": "vector"|"scalar", "result": ...}
+    in the prometheus HTTP API shape."""
+    if isinstance(query, str):
+        query = parse(query)
+    steps = np.asarray([time_s], dtype=np.int64)
+    ev = _Evaluator(db, steps)
+    result = ev.eval(query)
+    if isinstance(result, Str):
+        return {"resultType": "string", "result": [time_s, result.value]}
+    if isinstance(result, np.ndarray):
+        v = float(result[0])
+        return {"resultType": "scalar", "result": [time_s, _fmt_num(v)]}
+    vec = []
+    for s in result:
+        v = float(s.vals[0])
+        if math.isnan(v):
+            continue
+        vec.append({"metric": s.labels, "value": [time_s, _fmt_num(v)]})
+    return {"resultType": "vector", "result": vec}
+
+
+def _fmt_num(v: float) -> str:
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if math.isnan(v):
+        return "NaN"
+    return repr(v)

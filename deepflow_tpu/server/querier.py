@@ -201,6 +201,24 @@ class QuerierAPI:
         return {"status": "success",
                 "data": {"resultType": "matrix", "result": result}}
 
+    def prom_query(self, params: dict) -> dict:
+        """GET /prom/api/v1/query — instant queries (reference:
+        querier/app/prometheus/router/router.go:40)."""
+        import time as _time
+
+        from deepflow_tpu.query import promql
+        q = params.get("query", "")
+        try:
+            t = int(float(params.get("time", _time.time())))
+        except ValueError as e:
+            raise qengine.QueryError(f"bad time param: {e}")
+        try:
+            data = promql.evaluate_instant(self.db, q, t)
+        except promql.PromqlError as e:
+            return {"status": "error", "errorType": "bad_data",
+                    "error": str(e)}
+        return {"status": "success", "data": data}
+
     def tempo_trace(self, trace_id: str) -> dict:
         """GET /api/traces/{id} — Grafana Tempo-compatible shape
         (reference: querier/tempo)."""
@@ -413,6 +431,8 @@ class QuerierHTTP:
                     elif path in ("/prom/api/v1/query_range",
                                   "/api/v1/query_range"):
                         self._send(200, api.prom_query_range(params))
+                    elif path in ("/prom/api/v1/query", "/api/v1/query"):
+                        self._send(200, api.prom_query(params))
                     elif path.startswith("/api/traces/"):
                         self._send(200, api.tempo_trace(
                             path.rsplit("/", 1)[-1]))

@@ -24,14 +24,17 @@ def make_db():
 
 def test_parse():
     q = promql.parse('rate(flow_metrics_network_byte_tx{host="h1"}[1m])')
-    assert q.rate_fn == "rate"
-    assert q.selector.range_s == 60
-    assert q.selector.matchers == [("host", "=", "h1")]
+    assert isinstance(q, promql.Call) and q.fn == "rate"
+    m = q.args[0]
+    assert isinstance(m, promql.MatrixSelector) and m.range_s == 60
+    assert m.vs.matchers == [("host", "=", "h1")]
 
     q2 = promql.parse(
         'sum by (host) (rate(flow_metrics_network_byte_tx[30s])) * 8')
-    assert q2.agg == "sum" and q2.by == ["host"]
-    assert q2.scalar_op == "*" and q2.scalar == 8
+    assert isinstance(q2, promql.BinOp) and q2.op == "*"
+    assert isinstance(q2.lhs, promql.Agg)
+    assert q2.lhs.op == "sum" and q2.lhs.grouping == ["host"]
+    assert isinstance(q2.rhs, promql.Num) and q2.rhs.value == 8
 
     with pytest.raises(promql.PromqlError):
         promql.parse("rate(foo)")  # needs [range]

@@ -1,0 +1,458 @@
+"""Full-engine PromQL conformance tests.
+
+Table-driven in the spirit of the reference's PromQL conformance fixtures
+(server/querier/app/prometheus/promql-prom-metrics-tests.yaml): load a known
+sample set, run queries, pin the results.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from deepflow_tpu.query import promql
+from deepflow_tpu.store import Database
+
+T0 = 1_000_000  # base epoch for remote-write style series
+
+
+def make_db():
+    """Remote-write style samples (cumulative counters + gauges) plus
+    internal flow metrics."""
+    db = Database()
+    t = db.table("prometheus.samples")
+    rows = []
+    # two http_requests_total counters, 1/s and 2/s, sampled every 10s
+    for i in range(13):
+        ts = T0 + i * 10
+        rows.append({"time": ts, "metric_name": "http_requests_total",
+                     "labels_json": '{"job": "api", "instance": "a"}',
+                     "value": float(100 + i * 10)})
+        rows.append({"time": ts, "metric_name": "http_requests_total",
+                     "labels_json": '{"job": "api", "instance": "b"}',
+                     "value": float(200 + i * 20)})
+    # a gauge
+    for i in range(13):
+        ts = T0 + i * 10
+        rows.append({"time": ts, "metric_name": "queue_depth",
+                     "labels_json": '{"job": "api", "instance": "a"}',
+                     "value": float([3, 5, 2, 8, 1, 9, 4, 7, 6, 2, 5, 3, 8][i])})
+    # histogram buckets: latency ~ uniform over (0, 0.1] 60%, (0.1, 0.5] 30%,
+    # rest 10%
+    for i in range(13):
+        ts = T0 + i * 10
+        n = (i + 1) * 100
+        for le, frac in (("0.1", 0.6), ("0.5", 0.9), ("+Inf", 1.0)):
+            rows.append({"time": ts,
+                         "metric_name": "req_latency_bucket",
+                         "labels_json": f'{{"job": "api", "le": "{le}"}}',
+                         "value": float(n * frac)})
+    # limit metric for vector matching tests (one point per instance);
+    # carries a `zone` label the request series lack (group_left fodder)
+    for inst, lim, zone in (("a", 5.0, "z1"), ("b", 100.0, "z2")):
+        rows.append({"time": T0, "metric_name": "conn_limit",
+                     "labels_json":
+                     f'{{"instance": "{inst}", "zone": "{zone}"}}',
+                     "value": lim})
+    t.append_rows(rows)
+    return db
+
+
+def ev(db, q, at=None, step=15):
+    at = at if at is not None else T0 + 120
+    return promql.evaluate(db, q, at, at, step)
+
+
+def one_value(out):
+    assert len(out) == 1, out
+    return out[0]["values"][0][1]
+
+
+# -- functions ---------------------------------------------------------------
+
+def test_over_time_family():
+    db = make_db()
+    # gauge samples in (T0+20, T0+120]: indices 3..12
+    window = [8, 1, 9, 4, 7, 6, 2, 5, 3, 8]
+    cases = {
+        "avg_over_time(queue_depth[100s])": np.mean(window),
+        "min_over_time(queue_depth[100s])": 1.0,
+        "max_over_time(queue_depth[100s])": 9.0,
+        "sum_over_time(queue_depth[100s])": float(sum(window)),
+        "count_over_time(queue_depth[100s])": 10.0,
+        "last_over_time(queue_depth[100s])": 8.0,
+        "stddev_over_time(queue_depth[100s])": float(np.std(window)),
+        "stdvar_over_time(queue_depth[100s])": float(np.var(window)),
+        "quantile_over_time(0.5, queue_depth[100s])":
+            float(np.quantile(window, 0.5)),
+        "present_over_time(queue_depth[100s])": 1.0,
+        "changes(queue_depth[100s])": 9.0,
+    }
+    for q, want in cases.items():
+        assert one_value(ev(db, q)) == pytest.approx(want), q
+
+
+def test_delta_idelta_deriv_predict():
+    db = make_db()
+    # counter a increases 10 per 10s -> deriv = 1/s
+    assert one_value(ev(
+        db, 'deriv(http_requests_total{instance="a"}[100s])')
+    ) == pytest.approx(1.0)
+    # predict_linear 60s ahead from the window end
+    v_now = 100 + 12 * 10  # value at T0+120
+    assert one_value(ev(
+        db, 'predict_linear(http_requests_total{instance="a"}[100s], 60)')
+    ) == pytest.approx(v_now + 60, abs=1e-6)
+    # delta of the gauge, window exactly covered -> extrapolated last-first
+    out = ev(db, "delta(queue_depth[100s])")
+    # samples span 90s of the 100s window; delta = (8-8)=0 extrapolated -> 0
+    assert one_value(out) == pytest.approx(0.0)
+    # idelta: last two samples 3 -> 8
+    assert one_value(ev(db, "idelta(queue_depth[100s])")) == 5.0
+
+
+def test_resets_counter():
+    db = Database()
+    t = db.table("prometheus.samples")
+    vals = [10, 20, 5, 15, 3, 9]
+    t.append_rows([{"time": T0 + i * 10, "metric_name": "r_total",
+                    "labels_json": "{}", "value": float(v)}
+                   for i, v in enumerate(vals)])
+    assert one_value(ev(db, "resets(r_total[100s])", at=T0 + 50)) == 2.0
+
+
+def test_math_and_clamp():
+    db = make_db()
+    assert one_value(ev(db, "abs(queue_depth - 100)")) == pytest.approx(92.0)
+    assert one_value(ev(db, "sqrt(queue_depth)")) == pytest.approx(
+        math.sqrt(8))
+    assert one_value(ev(db, "clamp(queue_depth, 2, 5)")) == 5.0
+    assert one_value(ev(db, "clamp_max(queue_depth, 3)")) == 3.0
+    assert one_value(ev(db, "clamp_min(queue_depth, 50)")) == 50.0
+    assert one_value(ev(db, "ln(exp(queue_depth))")) == pytest.approx(8.0)
+    assert one_value(ev(db, "round(queue_depth / 3)")) == 3.0
+    assert one_value(ev(db, "round(queue_depth / 3, 0.5)")) == 2.5
+    assert one_value(ev(db, "sgn(queue_depth - 100)")) == -1.0
+    assert one_value(ev(db, "queue_depth ^ 2")) == 64.0
+    assert one_value(ev(db, "queue_depth % 3")) == 2.0
+
+
+def test_scalar_vector_time():
+    db = make_db()
+    out = ev(db, "scalar(queue_depth) * 2")
+    assert one_value(out) == 16.0
+    out = ev(db, "vector(7)")
+    assert out[0]["metric"] == {} and one_value(out) == 7.0
+    out = ev(db, "time()", at=T0)
+    assert one_value(out) == float(T0)
+    out = ev(db, "timestamp(queue_depth)")
+    assert one_value(out) == float(T0 + 120)
+    # scalar() of a multi-series vector -> NaN -> empty result
+    assert ev(db, "scalar(http_requests_total)") == []
+
+
+def test_absent():
+    db = make_db()
+    assert ev(db, "absent(queue_depth)") == []
+    out = ev(db, 'absent(queue_depth{instance="zzz"})')
+    assert out[0]["metric"] == {"instance": "zzz"}
+    assert one_value(out) == 1.0
+    # unknown metric entirely -> absent fires with its matcher labels
+    out = ev(db, 'absent(never_seen_metric{job="x"})')
+    assert one_value(out) == 1.0
+    out = ev(db, "absent_over_time(queue_depth[1m])")
+    assert out == []
+    out = ev(db, 'absent_over_time(queue_depth{instance="zzz"}[1m])')
+    assert one_value(out) == 1.0
+
+
+def test_label_replace_and_join():
+    db = make_db()
+    out = ev(db, 'label_replace(queue_depth, "node", "$1", "instance", '
+                 '"(.*)")')
+    assert out[0]["metric"]["node"] == "a"
+    out = ev(db, 'label_join(queue_depth, "combo", "-", "job", "instance")')
+    assert out[0]["metric"]["combo"] == "api-a"
+
+
+def test_histogram_quantile():
+    db = make_db()
+    # p50 falls in the (0, 0.1] bucket: rank 0.5/0.6 through it
+    v = one_value(ev(
+        db, "histogram_quantile(0.5, rate(req_latency_bucket[2m]))"))
+    assert v == pytest.approx(0.1 * (0.5 / 0.6), rel=1e-3)
+    # p95: rank (0.95-0.9)/0.1 into (0.5, +Inf) -> capped at highest finite
+    v = one_value(ev(
+        db, "histogram_quantile(0.95, rate(req_latency_bucket[2m]))"))
+    assert v == pytest.approx(0.5, rel=1e-3)
+    # p80 interpolates inside (0.1, 0.5]
+    v = one_value(ev(
+        db, "histogram_quantile(0.8, rate(req_latency_bucket[2m]))"))
+    assert v == pytest.approx(0.1 + (0.5 - 0.1) * ((0.8 - 0.6) / 0.3),
+                              rel=1e-3)
+    # phi out of range -> +Inf, serialized as the prometheus string
+    # spelling (raw Infinity would be invalid JSON)
+    assert one_value(ev(
+        db, "histogram_quantile(1.5, rate(req_latency_bucket[2m]))")) \
+        == "+Inf"
+    # works on instant bucket values too (cumulative counts)
+    v = one_value(ev(db, "histogram_quantile(0.5, req_latency_bucket)"))
+    assert v == pytest.approx(0.1 * (0.5 / 0.6), rel=1e-3)
+
+
+# -- aggregations ------------------------------------------------------------
+
+def test_agg_extended():
+    db = make_db()
+    assert one_value(ev(db, "group(http_requests_total)")) == 1.0
+    assert one_value(ev(db, "stddev(http_requests_total)")) == pytest.approx(
+        float(np.std([220, 440])))
+    assert one_value(ev(db, "stdvar(http_requests_total)")) == pytest.approx(
+        float(np.var([220, 440])))
+    assert one_value(ev(db, "quantile(0.5, http_requests_total)")) == \
+        pytest.approx(330.0)
+
+
+def test_agg_without():
+    db = make_db()
+    out = ev(db, "sum without (instance) (http_requests_total)")
+    assert len(out) == 1
+    assert out[0]["metric"] == {"job": "api"}
+    assert one_value(out) == 660.0
+
+
+def test_topk_bottomk():
+    db = make_db()
+    out = ev(db, "topk(1, http_requests_total)")
+    assert len(out) == 1
+    assert out[0]["metric"]["instance"] == "b"
+    assert one_value(out) == 440.0
+    out = ev(db, "bottomk(1, http_requests_total)")
+    assert out[0]["metric"]["instance"] == "a"
+    assert one_value(out) == 220.0
+    # k larger than series count -> all series
+    out = ev(db, "topk(10, http_requests_total)")
+    assert len(out) == 2
+
+
+def test_count_values():
+    db = make_db()
+    out = ev(db, 'count_values("v", sgn(http_requests_total))')
+    assert len(out) == 1
+    assert out[0]["metric"] == {"v": "1"}
+    assert one_value(out) == 2.0
+
+
+# -- binary operators --------------------------------------------------------
+
+def test_vector_arithmetic_one_to_one():
+    db = make_db()
+    # requests per unit of limit: matches on all shared labels (instance)
+    out = ev(db, "http_requests_total / on (instance) conn_limit")
+    byinst = {s["metric"]["instance"]: s["values"][0][1] for s in out}
+    assert byinst == {"a": pytest.approx(220 / 5), "b": pytest.approx(4.4)}
+    # ignoring the labels unique to either side matches the same pairs
+    out = ev(db, "http_requests_total - ignoring (job, zone) conn_limit")
+    byinst = {s["metric"]["instance"]: s["values"][0][1] for s in out}
+    assert byinst == {"a": 215.0, "b": 340.0}
+    # same metric +: full-label one-to-one
+    out = ev(db, "queue_depth + queue_depth")
+    assert one_value(out) == 16.0
+
+
+def test_vector_cmp_filter_and_bool():
+    db = make_db()
+    out = ev(db, "http_requests_total > 300")
+    assert len(out) == 1 and out[0]["metric"]["instance"] == "b"
+    assert one_value(out) == 440.0  # filter keeps the original value
+    out = ev(db, "http_requests_total > bool 300")
+    vals = {s["metric"]["instance"]: s["values"][0][1] for s in out}
+    assert vals == {"a": 0.0, "b": 1.0}
+
+
+def test_group_left():
+    db = make_db()
+    # many (requests) to one (limit); the one side's zone label is copied
+    out = ev(db, "http_requests_total / on (instance) group_left (zone) "
+                 "conn_limit")
+    assert len(out) == 2
+    zones = {s["metric"]["instance"]: s["metric"]["zone"] for s in out}
+    assert zones == {"a": "z1", "b": "z2"}
+    for s in out:
+        assert s["metric"]["job"] == "api"  # many-side labels survive
+    out = ev(db, "conn_limit * on (instance) group_right "
+                 "http_requests_total")
+    byinst = {s["metric"]["instance"]: s["values"][0][1] for s in out}
+    assert byinst["a"] == pytest.approx(5 * 220)
+
+
+def test_many_to_many_errors():
+    db = make_db()
+    with pytest.raises(promql.PromqlError):
+        ev(db, "http_requests_total + on (job) http_requests_total")
+
+
+def test_set_ops():
+    db = make_db()
+    # label sets differ (zone) -> bare `and` matches nothing
+    out = ev(db, "http_requests_total and conn_limit")
+    assert out == []
+    out = ev(db, "http_requests_total and on (instance) conn_limit")
+    assert len(out) == 2
+    out = ev(db, 'http_requests_total and on (instance) '
+                 'conn_limit{instance="a"}')
+    assert len(out) == 1 and out[0]["metric"]["instance"] == "a"
+    out = ev(db, 'http_requests_total unless on (instance) '
+                 'conn_limit{instance="a"}')
+    assert len(out) == 1 and out[0]["metric"]["instance"] == "b"
+    # signature ignores __name__: queue_depth{a} shadows http{a}
+    out = ev(db, "queue_depth or http_requests_total")
+    assert len(out) == 2
+    # or prefers lhs when signatures collide
+    out = ev(db, "queue_depth or queue_depth * 100")
+    assert len(out) >= 1
+    assert one_value([s for s in out
+                      if s["metric"].get("__name__")][0:1]) == 8.0
+
+
+def test_scalar_scalar():
+    db = make_db()
+    assert one_value(ev(db, "2 + 3 * 4")) == 14.0  # precedence
+    assert one_value(ev(db, "(2 + 3) * 4")) == 20.0
+    assert one_value(ev(db, "2 ^ 3 ^ 2")) == 512.0  # right-assoc
+    assert one_value(ev(db, "7 % 4")) == 3.0
+    assert one_value(ev(db, "4 > bool 3")) == 1.0
+    with pytest.raises(promql.PromqlError):
+        ev(db, "4 > 3")  # scalar cmp needs bool
+    assert one_value(ev(db, "-3 + 5")) == 2.0
+
+
+# -- offsets and subqueries --------------------------------------------------
+
+def test_offset():
+    db = make_db()
+    # 60s ago the counter was at 100 + 6*10
+    out = ev(db, "http_requests_total offset 1m")
+    byinst = {s["metric"]["instance"]: s["values"][0][1] for s in out}
+    assert byinst["a"] == 160.0
+    # offset on a range function
+    v = one_value(ev(
+        db, 'increase(http_requests_total{instance="a"}[1m] offset 1m)'))
+    assert v == pytest.approx(60.0, rel=0.2)
+
+
+def test_subquery():
+    db = make_db()
+    # max of the 10s-resolution rate over the last 2m
+    v = one_value(ev(
+        db, 'max_over_time(rate(http_requests_total{instance="a"}'
+            '[30s])[2m:10s])'))
+    assert v == pytest.approx(1.0, rel=0.15)
+    # subquery over a computed vector expression
+    v = one_value(ev(
+        db, "avg_over_time(vector(scalar(queue_depth))[1m:10s])"))
+    assert 1.0 <= v <= 9.0
+    # subqueries are vector-only, like upstream
+    with pytest.raises(promql.PromqlError):
+        ev(db, "avg_over_time(scalar(queue_depth)[1m:10s])")
+
+
+def test_rate_over_subquery_uses_counter_semantics():
+    db = make_db()
+    # max_over_time(http[..]) samples the cumulative counter; rate over the
+    # subquery must diff, not sum
+    v = one_value(ev(
+        db, 'rate(max_over_time(http_requests_total{instance="a"}'
+            '[20s:10s])[1m:10s])'))
+    assert v == pytest.approx(1.0, rel=0.3)
+
+
+# -- instant API -------------------------------------------------------------
+
+def test_evaluate_instant():
+    db = make_db()
+    out = promql.evaluate_instant(db, "queue_depth", T0 + 120)
+    assert out["resultType"] == "vector"
+    assert out["result"][0]["value"][1] == "8.0"
+    out = promql.evaluate_instant(db, "1 + 2", T0)
+    assert out["resultType"] == "scalar" and out["result"][1] == "3.0"
+    out = promql.evaluate_instant(db, "sum(http_requests_total)", T0 + 120)
+    assert out["result"][0]["value"][1] == "660.0"
+
+
+def test_instant_http_endpoint():
+    import json
+    import time as _time
+    import urllib.request
+
+    from deepflow_tpu.server import Server
+
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0).start()
+    try:
+        now = int(_time.time())
+        t = server.db.table("prometheus.samples")
+        t.append_rows([{"time": now - 5, "metric_name": "up",
+                        "labels_json": '{"job": "api"}', "value": 1.0}])
+        url = (f"http://127.0.0.1:{server.query_port}/prom/api/v1/query"
+               f"?query=up&time={now}")
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            out = json.loads(resp.read())
+        assert out["status"] == "success"
+        assert out["data"]["resultType"] == "vector"
+        assert out["data"]["result"][0]["value"][1] == "1.0"
+        # scalar instant query
+        url = (f"http://127.0.0.1:{server.query_port}/prom/api/v1/query"
+               f"?query=1%2B2&time={now}")
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            out = json.loads(resp.read())
+        assert out["data"]["resultType"] == "scalar"
+        assert out["data"]["result"][1] == "3.0"
+    finally:
+        server.stop()
+
+
+def test_sort():
+    db = make_db()
+    out = ev(db, "sort_desc(http_requests_total)")
+    assert [s["metric"]["instance"] for s in out] == ["b", "a"]
+    out = ev(db, "sort(http_requests_total)")
+    assert [s["metric"]["instance"] for s in out] == ["a", "b"]
+
+
+def test_parse_errors():
+    for bad in ("rate(foo)", "histogram_quantile(0.5)", "foo[5m",
+                "sum(", "topk(foo)", "clamp(x, 1)", "x offset",
+                "label_replace(x, \"a\")", "foo and 3"):
+        with pytest.raises(promql.PromqlError):
+            db = Database()
+            db.table("prometheus.samples")
+            promql.evaluate(db, bad, 0, 10)
+
+
+def test_compound_duration():
+    assert promql.parse_duration_s("1h30m") == 5400
+    assert promql.parse_duration_s("90s") == 90
+    q = promql.parse("rate(x[1h30m])")
+    assert q.args[0].range_s == 5400
+
+
+def test_deepflow_internal_tables_still_delta():
+    """flow_metrics rate() keeps delta semantics alongside the new engine."""
+    db = Database()
+    t = db.table("flow_metrics.network.1s")
+    rows = [{"time": 1000 + s, "byte_tx": 100, "ip_src": "1.1.1.1",
+             "ip_dst": "2.2.2.2", "server_port": 80, "protocol": 1,
+             "host": "h1"} for s in range(0, 60, 10)]
+    t.append_rows(rows)
+    # window (1000, 1060] holds the 5 samples at 1010..1050 (lo exclusive)
+    out = promql.evaluate(db, "rate(flow_metrics_network_byte_tx[1m])",
+                          1060, 1060, 15)
+    assert out[0]["values"][0][1] == pytest.approx(500 / 60)
+    # and they can binop against remote-write metrics via on()
+    t2 = db.table("prometheus.samples")
+    t2.append_rows([{"time": 1055, "metric_name": "link_capacity",
+                     "labels_json": '{"host": "h1"}', "value": 1000.0}])
+    out = promql.evaluate(
+        db, "sum by (host) (rate(flow_metrics_network_byte_tx[1m])) "
+            "/ on (host) link_capacity", 1060, 1060, 15)
+    assert out[0]["values"][0][1] == pytest.approx(500 / 60 / 1000)
