@@ -70,6 +70,50 @@ def parse_duration_s(s: str) -> float:
     return sum(int(n) * _DUR_S[u] for n, u in _DUR_PART.findall(s))
 
 
+_ESCAPES = {"n": "\n", "t": "\t", "r": "\r", "\\": "\\", '"': '"',
+            "'": "'", "a": "\a", "b": "\b", "f": "\f", "v": "\v", "0": "\0"}
+
+
+def _unquote(raw: str) -> str:
+    """Strip quotes and process Go-style escape sequences (\\n, \\\",
+    \\xHH, \\uHHHH) — Grafana emits escaped regexes like "ns\\\\.svc"
+    routinely."""
+    body = raw[1:-1]
+    if "\\" not in body:
+        return body
+    out, i = [], 0
+    while i < len(body):
+        c = body[i]
+        if c != "\\" or i + 1 >= len(body):
+            out.append(c)
+            i += 1
+            continue
+        e = body[i + 1]
+        if e in _ESCAPES:
+            out.append(_ESCAPES[e])
+            i += 2
+        elif e == "x" and i + 3 < len(body):
+            try:
+                out.append(chr(int(body[i + 2:i + 4], 16)))
+                i += 4
+            except ValueError:
+                raise PromqlError(f"bad escape in string: \\x"
+                                  f"{body[i + 2:i + 4]!r}") from None
+        elif e == "u" and i + 5 < len(body):
+            try:
+                out.append(chr(int(body[i + 2:i + 6], 16)))
+                i += 6
+            except ValueError:
+                raise PromqlError(f"bad escape in string: \\u"
+                                  f"{body[i + 2:i + 6]!r}") from None
+        else:
+            # unknown escape: keep verbatim (lenient where upstream errors)
+            out.append(c)
+            out.append(e)
+            i += 2
+    return "".join(out)
+
+
 # -- AST ---------------------------------------------------------------------
 
 @dataclass
@@ -282,7 +326,7 @@ class _Parser:
                 raise PromqlError("bad matcher op ==")
             if op not in ("=", "!=", "=~", "!~"):
                 raise PromqlError(f"bad matcher op {op}")
-            val = self.expect("str")[1][1:-1]
+            val = _unquote(self.expect("str")[1])
             matchers.append((lbl, op, val))
             if self.peek() == ("op", ","):
                 self.next_()
@@ -388,7 +432,7 @@ class _Parser:
             return Num(float(t[1]))
         if t[0] == "str":
             self.next_()
-            return Str(t[1][1:-1])
+            return Str(_unquote(t[1]))
         if t[0] == "op" and t[1] in ("+", "-"):
             return self.parse_unary()
         if t[0] != "name":
@@ -1035,7 +1079,7 @@ class _Evaluator:
                     vec = []  # unknown metric is definitionally absent
                 labels = {lbl: val for lbl, op, val
                           in node.args[0].matchers if op == "="}
-            if isinstance(vec, np.ndarray):
+            if isinstance(vec, (np.ndarray, Str)):
                 raise PromqlError("absent() expects an instant vector")
             present = np.zeros(len(self.steps), dtype=bool)
             for s in (vec or []):
@@ -1054,13 +1098,13 @@ class _Evaluator:
                                vals=op(s.vals)) for s in vec]
         if fn == "round":
             vec = self.eval_vector(node.args[0], "round()")
-            to = 1.0
+            to = np.ones(len(self.steps))
             if len(node.args) > 1:
-                to_arr = self.eval_scalar(node.args[1], "round()")
-                to = float(to_arr[0]) if len(to_arr) else 1.0
-            if to <= 0:
+                to = self.eval_scalar(node.args[1], "round()")
+            if np.any(to <= 0):
                 raise PromqlError("round() nearest must be positive")
-            # Prometheus rounds half toward +Inf, not half-to-even
+            # Prometheus rounds half toward +Inf, not half-to-even;
+            # `to` applies per step (it can be a varying scalar expr)
             return [Series(labels=_drop_name(s.labels),
                            vals=np.floor(s.vals / to + 0.5) * to)
                     for s in vec]
@@ -1127,13 +1171,12 @@ class _Evaluator:
     def eval_range_fn(self, node: Call) -> list[Series]:
         fn = node.fn
         phi_arr = None
-        horizon = 0.0
+        horizon_arr = None
         if fn == "quantile_over_time":
             phi_arr = self.eval_scalar(node.args[0], fn)
             range_arg = node.args[1]
         elif fn == "predict_linear":
-            h = self.eval_scalar(node.args[1], fn)
-            horizon = float(h[0]) if len(h) else 0.0
+            horizon_arr = self.eval_scalar(node.args[1], fn)
             range_arg = node.args[0]
         else:
             if len(node.args) != 1:
@@ -1175,6 +1218,9 @@ class _Evaluator:
                         vals[i] = 1.0
                     continue
                 phi = (float(phi_arr[i]) if phi_arr is not None else 0.0)
+                # the horizon scalar applies per step (it can vary)
+                horizon = (float(horizon_arr[i])
+                           if horizon_arr is not None else 0.0)
                 v = _range_fn_value(fn, rs.t[i0:i1], rs.v[i0:i1], rs.counter,
                                     range_s, lo, hi, phi=phi,
                                     horizon=horizon)
@@ -1443,33 +1489,19 @@ class _Evaluator:
                       rhs: list[Series]) -> list[Series]:
         matching = node.matching or VectorMatching()
         card = matching.card
-        if card == "one-to-many":  # normalize: swap sides
-            flip_ops = {">": "<", "<": ">", ">=": "<=", "<=": ">="}
-            op = flip_ops.get(node.op, node.op)
-            swapped = BinOp(op=op, lhs=node.rhs, rhs=node.lhs,
-                            bool_mod=node.bool_mod,
-                            matching=VectorMatching(
-                                on=matching.on, labels=matching.labels,
-                                card="many-to-one",
-                                include=matching.include))
-            if node.op in ("-", "/", "%", "^"):
-                # non-commutative: keep operand order, just treat rhs as
-                # the "many" side by matching manually below
-                pass
-            else:
-                return self._vector_binop(swapped, rhs, lhs)
-        many, one = lhs, rhs
-        swapped_order = False
+        # normalize to a uniform (many, one) walk; lhs_is_many records which
+        # operand order a matched pair evaluates in
         if card == "one-to-many":
-            many, one = rhs, lhs
-            swapped_order = True
+            many, one, lhs_is_many = rhs, lhs, False
+        else:
+            many, one, lhs_is_many = lhs, rhs, True
         one_by_sig: dict[tuple, Series] = {}
         for s in one:
             sig = _sig(s.labels, matching)
             if sig in one_by_sig:
                 raise PromqlError(
                     "many-to-many matching: duplicate series on the "
-                    f"{'left' if swapped_order else 'right'} side "
+                    f"{'left' if lhs_is_many else 'right'} side "
                     f"for signature {dict(sig)!r}")
             one_by_sig[sig] = s
         if card == "one-to-one":
@@ -1486,25 +1518,29 @@ class _Evaluator:
             other = one_by_sig.get(_sig(s.labels, matching))
             if other is None:
                 continue
-            a, b = s.vals, other.vals
-            if swapped_order:
-                a, b = b, a
+            a, b = ((s.vals, other.vals) if lhs_is_many
+                    else (other.vals, s.vals))
             with np.errstate(all="ignore"):
                 vals = self._apply_op(node.op, a, b,
                                       bool_mod=node.bool_mod)
             if node.op in _CMP_OPS and not node.bool_mod:
-                vals = np.where(np.isnan(vals), np.nan, s.vals)
+                # filter comparisons keep the LEFT operand's value
+                vals = np.where(np.isnan(vals), np.nan, a)
             if np.isnan(vals).all():
                 continue
             # result labels
             if card == "one-to-one":
-                if matching.on:
+                if node.op in _CMP_OPS and not node.bool_mod:
+                    # filter keeps the lhs series labels as-is
+                    labels = dict(s.labels)
+                elif matching.on:
                     labels = dict(_sig(s.labels, matching))
                 else:
-                    labels = _drop_name(s.labels)
-                if node.op in _CMP_OPS and not node.bool_mod:
-                    labels = (dict(s.labels) if not matching.on
-                              else labels)
+                    # drop __name__ AND the ignored labels (upstream
+                    # resultMetric deletes ignoring(...) labels)
+                    drop = set(matching.labels) | {"__name__"}
+                    labels = {k: v for k, v in s.labels.items()
+                              if k not in drop}
             else:
                 labels = _drop_name(dict(s.labels))
                 for lbl in matching.include:

@@ -289,6 +289,128 @@ class RemoteWriteExporter(BaseExporter):
                         "X-Prometheus-Remote-Write-Version": "0.1.0"})
 
 
+class KafkaExporter(BaseExporter):
+    """Rows -> Kafka topic as JSON messages over the raw wire protocol
+    (reference: ingester/exporters/kafka_exporter; no client library in
+    this image, so deepflow_tpu.utils.kafkawire speaks the protocol).
+
+    Endpoint form: kafka://host:port/topic. Partition-leader discovery via
+    Metadata v0, messages partitioned round-robin, acks=1; broker errors
+    raise so the Base retry/spool machinery engages."""
+
+    def __init__(self, endpoint: str, tables: tuple = (), **kw) -> None:
+        super().__init__(endpoint, **kw)
+        self.TABLES = tables
+        from urllib.parse import urlparse
+        u = urlparse(endpoint)
+        if u.scheme != "kafka" or not u.hostname or not u.path.strip("/"):
+            raise ValueError(
+                f"kafka endpoint must be kafka://host:port/topic, "
+                f"got {endpoint!r}")
+        self.bootstrap = (u.hostname, u.port or 9092)
+        self.topic = u.path.strip("/")
+        self._corr = 0
+        self._rr = 0
+        self._conns: dict = {}       # (host, port) -> socket
+        self._leaders: dict = {}     # partition -> (host, port)
+
+    def stop(self) -> None:
+        super().stop()
+        for sock in self._conns.values():
+            try:
+                sock.close()
+            except OSError:
+                pass
+        self._conns.clear()
+
+    def _next_corr(self) -> int:
+        self._corr += 1
+        return self._corr
+
+    def _connect(self, addr: tuple):
+        import socket
+        sock = self._conns.get(addr)
+        if sock is not None:
+            return sock
+        sock = socket.create_connection(addr, timeout=10)
+        sock.settimeout(10)
+        self._conns[addr] = sock
+        return sock
+
+    def _drop_conn(self, addr: tuple) -> None:
+        sock = self._conns.pop(addr, None)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _refresh_metadata(self) -> None:
+        from deepflow_tpu.utils import kafkawire as kw
+        corr = self._next_corr()
+        sock = self._connect(self.bootstrap)
+        try:
+            sock.sendall(kw.metadata_request([self.topic], corr))
+            got_corr, body = kw.read_response(sock)
+        except OSError:
+            self._drop_conn(self.bootstrap)
+            raise
+        if got_corr != corr:
+            self._drop_conn(self.bootstrap)
+            raise kw.KafkaWireError(
+                f"correlation mismatch {got_corr} != {corr}")
+        md = kw.parse_metadata_response(body, self.topic)
+        if md.topic_error not in (0, 5):  # 5: leader election in progress
+            raise kw.KafkaWireError(
+                f"topic {self.topic!r}: {kw.error_name(md.topic_error)}")
+        self._leaders = {
+            pid: md.brokers[leader]
+            for pid, leader in md.partition_leaders.items()
+            if leader in md.brokers}
+        if not self._leaders:
+            raise kw.KafkaWireError(
+                f"no leaders for topic {self.topic!r}")
+
+    def _ship(self, batch: list) -> None:
+        import time as _time
+
+        from deepflow_tpu.utils import kafkawire as kw
+        if not self._leaders:
+            self._refresh_metadata()
+        parts = sorted(self._leaders)
+        partition = parts[self._rr % len(parts)]
+        self._rr += 1
+        now_ms = int(_time.time() * 1000)
+        msgs = [(None, json.dumps({"table": t, **row},
+                                  default=str).encode(), now_ms)
+                for t, row in batch]
+        corr = self._next_corr()
+        req = kw.produce_request(self.topic, partition,
+                                 kw.message_set(msgs), corr)
+        addr = self._leaders[partition]
+        try:
+            sock = self._connect(addr)
+            sock.sendall(req)
+            got_corr, body = kw.read_response(sock)
+        except OSError:
+            # connect failures too: a dead leader must invalidate the
+            # cached topology or failover never recovers
+            self._drop_conn(addr)
+            self._leaders = {}
+            raise
+        if got_corr != corr:
+            self._drop_conn(addr)
+            raise kw.KafkaWireError(
+                f"correlation mismatch {got_corr} != {corr}")
+        res = kw.parse_produce_response(body)
+        if res.error_code != 0:
+            if res.error_code in kw.RETRIABLE_ERRORS:
+                self._leaders = {}  # re-discover on next attempt
+            raise kw.KafkaWireError(
+                f"produce to {self.topic}[{partition}]: "
+                f"{kw.error_name(res.error_code)}")
+
+
 class ExporterManager:
     def __init__(self) -> None:
         self.exporters: list[BaseExporter] = []

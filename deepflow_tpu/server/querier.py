@@ -57,7 +57,8 @@ class QuerierAPI:
         if self.exporters is None:
             raise qengine.QueryError("exporters not running")
         from deepflow_tpu.server.exporters import (
-            JsonLinesExporter, OtlpJsonExporter, RemoteWriteExporter)
+            JsonLinesExporter, KafkaExporter, OtlpJsonExporter,
+            RemoteWriteExporter)
         etype = body.get("type", "")
         endpoint = body.get("endpoint", "")
         if not endpoint:
@@ -69,9 +70,15 @@ class QuerierAPI:
             exp = OtlpJsonExporter(endpoint)
         elif etype == "remote-write":
             exp = RemoteWriteExporter(endpoint)
+        elif etype == "kafka":
+            try:
+                exp = KafkaExporter(endpoint,
+                                    tables=tuple(body.get("tables", [])))
+            except ValueError as e:
+                raise qengine.QueryError(str(e))
         else:
             raise qengine.QueryError(
-                "type must be json-lines|otlp-json|remote-write")
+                "type must be json-lines|otlp-json|remote-write|kafka")
         self.exporters.add(exp)  # idempotent on (type, endpoint)
         return {"added": etype, "endpoint": endpoint,
                 "exporters": self.exporters.stats()}

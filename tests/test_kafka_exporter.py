@@ -1,0 +1,271 @@
+"""Kafka exporter tests against an in-process stub broker.
+
+The stub decodes requests with its own struct unpacking (independent of
+deepflow_tpu.utils.kafkawire's builders), verifies message CRCs, and
+answers Metadata v0 / Produce v2 like a single-node broker would.
+"""
+
+import json
+import socket
+import struct
+import threading
+import time
+import zlib
+
+import pytest
+
+from deepflow_tpu.server.exporters import KafkaExporter
+from deepflow_tpu.utils import kafkawire as kw
+
+
+class StubBroker(threading.Thread):
+    def __init__(self, n_partitions: int = 2, produce_errors=None):
+        super().__init__(daemon=True)
+        self.sock = socket.socket()
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(8)
+        self.port = self.sock.getsockname()[1]
+        self.n_partitions = n_partitions
+        self.produce_errors = list(produce_errors or [])
+        self.messages: dict[int, list[bytes]] = {}
+        self.crc_failures = 0
+        self.api_versions_seen: list[tuple[int, int]] = []
+        self._stop = False
+
+    def run(self) -> None:
+        while not self._stop:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def stop(self) -> None:
+        self._stop = True
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def _recv_exact(self, conn, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def _handle(self, conn) -> None:
+        try:
+            while True:
+                hdr = self._recv_exact(conn, 4)
+                if hdr is None:
+                    return
+                size = struct.unpack(">i", hdr)[0]
+                data = self._recv_exact(conn, size)
+                if data is None:
+                    return
+                api_key, api_ver, corr = struct.unpack(">hhi", data[:8])
+                self.api_versions_seen.append((api_key, api_ver))
+                pos = 8
+                cid_len = struct.unpack(">h", data[pos:pos + 2])[0]
+                pos += 2 + max(cid_len, 0)
+                if api_key == 3:
+                    conn.sendall(self._metadata_response(corr, data[pos:]))
+                elif api_key == 0:
+                    conn.sendall(self._produce_response(corr, data[pos:]))
+                else:
+                    return
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    def _metadata_response(self, corr: int, body: bytes) -> bytes:
+        (n_topics,) = struct.unpack(">i", body[:4])
+        pos = 4
+        topics = []
+        for _ in range(n_topics):
+            tlen = struct.unpack(">h", body[pos:pos + 2])[0]
+            topics.append(body[pos + 2:pos + 2 + tlen].decode())
+            pos += 2 + tlen
+        out = struct.pack(">i", 1)  # one broker: us
+        out += struct.pack(">i", 0)
+        host = b"127.0.0.1"
+        out += struct.pack(">h", len(host)) + host
+        out += struct.pack(">i", self.port)
+        out += struct.pack(">i", len(topics))
+        for t in topics:
+            out += struct.pack(">h", 0)  # topic error
+            tb = t.encode()
+            out += struct.pack(">h", len(tb)) + tb
+            out += struct.pack(">i", self.n_partitions)
+            for pid in range(self.n_partitions):
+                out += struct.pack(">hiii", 0, pid, 0, 1)  # leader=0
+                out += struct.pack(">i", 0)                # replicas[0]
+                out += struct.pack(">i", 1)                # isr count
+                out += struct.pack(">i", 0)
+        payload = struct.pack(">i", corr) + out
+        return struct.pack(">i", len(payload)) + payload
+
+    def _produce_response(self, corr: int, body: bytes) -> bytes:
+        acks, timeout_ms, n_topics = struct.unpack(">hii", body[:10])
+        assert acks == 1 and n_topics == 1
+        pos = 10
+        tlen = struct.unpack(">h", body[pos:pos + 2])[0]
+        topic = body[pos + 2:pos + 2 + tlen].decode()
+        pos += 2 + tlen
+        (n_parts,) = struct.unpack(">i", body[pos:pos + 4])
+        assert n_parts == 1
+        pos += 4
+        partition, set_size = struct.unpack(">ii", body[pos:pos + 8])
+        pos += 8
+        msg_set = body[pos:pos + set_size]
+        # walk the message set: offset i64, size i32, crc u32, magic, attrs,
+        # timestamp i64, key bytes, value bytes
+        mpos = 0
+        base = len(self.messages.get(partition, []))
+        while mpos < len(msg_set):
+            _, msize = struct.unpack(">qi", msg_set[mpos:mpos + 12])
+            msg = msg_set[mpos + 12:mpos + 12 + msize]
+            (crc,) = struct.unpack(">I", msg[:4])
+            if zlib.crc32(msg[4:]) & 0xFFFFFFFF != crc:
+                self.crc_failures += 1
+            magic, attrs = struct.unpack(">bb", msg[4:6])
+            assert magic == 1 and attrs == 0
+            p = 6 + 8  # skip timestamp
+            (klen,) = struct.unpack(">i", msg[p:p + 4])
+            p += 4 + max(klen, 0)
+            (vlen,) = struct.unpack(">i", msg[p:p + 4])
+            value = msg[p + 4:p + 4 + vlen]
+            self.messages.setdefault(partition, []).append(value)
+            mpos += 12 + msize
+        err = self.produce_errors.pop(0) if self.produce_errors else 0
+        tb = topic.encode()
+        out = struct.pack(">i", 1)
+        out += struct.pack(">h", len(tb)) + tb
+        out += struct.pack(">i", 1)
+        out += struct.pack(">ihqq", partition, err, base, -1)
+        out += struct.pack(">i", 0)  # throttle_time_ms
+        payload = struct.pack(">i", corr) + out
+        return struct.pack(">i", len(payload)) + payload
+
+
+def wait_for(pred, timeout=5.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_wire_message_set_roundtrip():
+    msgs = [(None, b'{"a": 1}', 123), (b"key", b'{"b": 2}', 456)]
+    data = kw.message_set(msgs)
+    # decode independently
+    pos, seen = 0, []
+    while pos < len(data):
+        _, msize = struct.unpack(">qi", data[pos:pos + 12])
+        msg = data[pos + 12:pos + 12 + msize]
+        (crc,) = struct.unpack(">I", msg[:4])
+        assert zlib.crc32(msg[4:]) & 0xFFFFFFFF == crc
+        p = 6 + 8
+        (klen,) = struct.unpack(">i", msg[p:p + 4])
+        key = msg[p + 4:p + 4 + klen] if klen >= 0 else None
+        p += 4 + max(klen, 0)
+        (vlen,) = struct.unpack(">i", msg[p:p + 4])
+        seen.append((key, msg[p + 4:p + 4 + vlen]))
+        pos += 12 + msize
+    assert seen == [(None, b'{"a": 1}'), (b"key", b'{"b": 2}')]
+
+
+def test_exporter_ships_to_stub_broker():
+    broker = StubBroker(n_partitions=2)
+    broker.start()
+    try:
+        exp = KafkaExporter(f"kafka://127.0.0.1:{broker.port}/flows",
+                            batch_size=4, flush_interval_s=0.1).start()
+        try:
+            rows = [{"flow_id": i, "byte_tx": i * 100} for i in range(8)]
+            exp.feed("flow_log.l4_flow_log", rows[:4])
+            assert wait_for(lambda: sum(
+                len(v) for v in broker.messages.values()) >= 4)
+            exp.feed("flow_log.l4_flow_log", rows[4:])
+            assert wait_for(lambda: sum(
+                len(v) for v in broker.messages.values()) >= 8)
+        finally:
+            exp.stop()
+        assert broker.crc_failures == 0
+        got = [json.loads(v) for vs in broker.messages.values() for v in vs]
+        assert {g["flow_id"] for g in got} == set(range(8))
+        assert all(g["table"] == "flow_log.l4_flow_log" for g in got)
+        # round-robin used both partitions
+        assert len(broker.messages) == 2
+        assert exp.stats["exported"] == 8 and exp.stats["errors"] == 0
+        # protocol versions: metadata v0, produce v2
+        assert (3, 0) in broker.api_versions_seen
+        assert (0, 2) in broker.api_versions_seen
+    finally:
+        broker.stop()
+
+
+def test_exporter_retries_retriable_broker_error():
+    # first produce gets NOT_LEADER_FOR_PARTITION; retry must re-discover
+    # metadata and succeed
+    broker = StubBroker(n_partitions=1, produce_errors=[6])
+    broker.start()
+    try:
+        exp = KafkaExporter(f"kafka://127.0.0.1:{broker.port}/flows",
+                            batch_size=2, flush_interval_s=0.1,
+                            max_retries=2).start()
+        try:
+            exp.feed("t", [{"x": 1}, {"x": 2}])
+            assert wait_for(lambda: len(broker.messages.get(0, [])) >= 2)
+        finally:
+            exp.stop()
+        assert exp.stats["errors"] == 1
+        assert exp.stats["exported"] == 2
+    finally:
+        broker.stop()
+
+
+def test_endpoint_validation():
+    with pytest.raises(ValueError):
+        KafkaExporter("http://host:9092/topic")
+    with pytest.raises(ValueError):
+        KafkaExporter("kafka://host:9092")  # no topic
+
+
+def test_exporters_api_kafka():
+    import urllib.request
+
+    from deepflow_tpu.server import Server
+    broker = StubBroker()
+    broker.start()
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0).start()
+    try:
+        base = f"http://127.0.0.1:{server.query_port}"
+        req = urllib.request.Request(
+            f"{base}/v1/exporters",
+            data=json.dumps({
+                "type": "kafka",
+                "endpoint": f"kafka://127.0.0.1:{broker.port}/telemetry",
+            }).encode())
+        out = json.loads(urllib.request.urlopen(req, timeout=5).read())
+        assert out["added"] == "kafka"
+        assert any("KafkaExporter" in k for k in out["exporters"])
+        # bad endpoint is a clean 400
+        req = urllib.request.Request(
+            f"{base}/v1/exporters",
+            data=json.dumps({"type": "kafka",
+                             "endpoint": "kafka://x"}).encode())
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=5)
+        assert ei.value.code == 400
+    finally:
+        server.stop()
+        broker.stop()

@@ -429,6 +429,51 @@ def test_parse_errors():
             promql.evaluate(db, bad, 0, 10)
 
 
+def test_string_escapes():
+    # Grafana-style escaped regex: \\. must become a literal-dot regex
+    db = Database()
+    t = db.table("prometheus.samples")
+    t.append_rows([
+        {"time": T0, "metric_name": "m",
+         "labels_json": '{"svc": "ns.api"}', "value": 1.0},
+        {"time": T0, "metric_name": "m",
+         "labels_json": '{"svc": "nsxapi"}', "value": 2.0}])
+    out = ev(db, 'm{svc=~"ns\\\\.api"}', at=T0)
+    assert len(out) == 1 and out[0]["metric"]["svc"] == "ns.api"
+    # escaped quote inside an equality matcher
+    t.append_rows([{"time": T0, "metric_name": "m",
+                    "labels_json": '{"svc": "a\\"b"}', "value": 3.0}])
+    out = ev(db, 'm{svc="a\\"b"}', at=T0)
+    assert len(out) == 1 and out[0]["values"][0][1] == 3.0
+    assert promql._unquote('"a\\nb"') == "a\nb"
+    assert promql._unquote('"\\x41\\u0042"') == "AB"
+
+
+def test_cmp_filter_keeps_lhs_value_with_group_right():
+    db = make_db()
+    # one (conn_limit) > many (requests): filter keeps the LHS value
+    out = ev(db, "conn_limit > on (instance) group_right "
+                 "http_requests_total * 0")
+    vals = {s["metric"]["instance"]: s["values"][0][1] for s in out}
+    assert vals == {"a": 5.0, "b": 100.0}  # conn_limit's values, not 0
+
+
+def test_ignoring_drops_ignored_labels():
+    db = make_db()
+    out = ev(db, 'http_requests_total{instance="a"} '
+                 '+ ignoring (job, zone) conn_limit{instance="a"}')
+    assert len(out) == 1
+    assert "job" not in out[0]["metric"] and "zone" not in out[0]["metric"]
+    assert out[0]["metric"]["instance"] == "a"  # non-ignored label survives
+
+
+def test_absent_on_string_is_clean_error():
+    db = Database()
+    db.table("prometheus.samples")
+    with pytest.raises(promql.PromqlError):
+        promql.evaluate(db, 'absent("foo")', 0, 10)
+
+
 def test_compound_duration():
     assert promql.parse_duration_s("1h30m") == 5400
     assert promql.parse_duration_s("90s") == 90
