@@ -219,7 +219,12 @@ def _bench_extprofiler() -> dict:
         time.sleep(0.2)
         prof = ExternalProfiler(lambda b: None, pid=child.pid, hz=99,
                                 window_s=0.5).start()
-        time.sleep(1.2)  # warm: first window pays the one-time ELF parse
+        # warm: wait out the one-time unwind-table builds (disk-cached
+        # across runs) so the steady state is what's actually measured
+        t_settle = time.perf_counter()
+        while prof.builder_busy() and time.perf_counter() - t_settle < 60:
+            time.sleep(0.2)
+        time.sleep(1.2)
         t0 = os.times()
         w0 = time.perf_counter()
         time.sleep(3.0)  # steady state (what continuous profiling costs)
@@ -231,6 +236,9 @@ def _bench_extprofiler() -> dict:
             "extprof_observer_pct": round(observer_cpu / wall * 100, 3),
             "extprof_samples": prof.stats.samples,
             "extprof_lost": prof.lost,
+            "extprof_dwarf_samples": prof.dwarf_samples,
+            "extprof_fp_samples": prof.fp_samples,
+            "extprof_unwind_tables": prof.unwind_tables,
         }
     except OSError:
         return {"extprof": "no-perf-events"}

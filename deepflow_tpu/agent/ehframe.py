@@ -1,0 +1,562 @@
+""".eh_frame -> compact unwind tables for the out-of-process profiler.
+
+Reference analog: agent/crates/trace-utils/src/unwind/dwarf.rs (parses
+.eh_frame into shard tables the BPF unwinder walks) and
+kernel/perf_profiler.bpf.c:1015 PROGPE(dwarf_unwind). Same split here:
+this module is the cold path — parse once per binary, emit flat arrays
+sorted by pc — and the native sampler (native/perfprof.cpp) walks them per
+sample against PERF_SAMPLE_REGS_USER + PERF_SAMPLE_STACK_USER.
+
+x86-64 only. Tracked register rules: CFA (must be rsp/rbp + offset), RBP,
+and RA(16). Rows whose CFA comes from a DWARF expression are marked
+invalid — the walker stops there and falls back to the frame-pointer
+chain, the same degradation the reference accepts for odd frames.
+
+Row encoding (one row covers [pc, next row's pc)):
+  pc      u64   file vaddr
+  cfa_reg u8    0 = rsp, 1 = rbp, 2 = invalid (expression/unsupported)
+  cfa_off i32   CFA = reg + cfa_off
+  rbp_off i32   saved rbp at CFA + rbp_off; INT32_MIN = no rule (keep)
+  ra_off  i32   return address at CFA + ra_off; INT32_MIN = invalid
+"""
+
+from __future__ import annotations
+
+import logging
+import struct
+from dataclasses import dataclass
+
+import numpy as np
+
+log = logging.getLogger("df.ehframe")
+
+RSP, RBP, RA = 7, 6, 16
+CFA_RSP, CFA_RBP, CFA_BAD = 0, 1, 2
+NO_RULE = -(1 << 31)  # INT32_MIN sentinel
+
+# DW_EH_PE pointer encodings
+_PE_omit = 0xFF
+_PE_FMT = 0x0F
+_PE_APP = 0x70
+_PE_pcrel = 0x10
+_PE_datarel = 0x30
+_PE_indirect = 0x80
+
+_DEFAULT_EHFRAME_CAP = 16 << 20  # parse cost guard for giant runtimes
+
+
+class EhFrameError(Exception):
+    pass
+
+
+def _uleb(data: bytes, p: int) -> tuple[int, int]:
+    out = shift = 0
+    while True:
+        b = data[p]
+        p += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, p
+        shift += 7
+
+
+def _sleb(data: bytes, p: int) -> tuple[int, int]:
+    out = shift = 0
+    while True:
+        b = data[p]
+        p += 1
+        out |= (b & 0x7F) << shift
+        shift += 7
+        if not b & 0x80:
+            if b & 0x40:
+                out -= 1 << shift
+            return out, p
+
+
+def _read_encoded(data: bytes, p: int, enc: int, sec_vaddr: int) -> \
+        tuple[int, int]:
+    """Decode a DW_EH_PE-encoded pointer at section offset p -> (value,
+    new_p). pcrel values resolve against the section vaddr (file-relative;
+    the runtime bias is applied at registration time)."""
+    if enc == _PE_omit:
+        return 0, p
+    base = 0
+    if enc & _PE_APP == _PE_pcrel:
+        base = sec_vaddr + p
+    fmt = enc & _PE_FMT
+    if fmt == 0x00:  # absptr
+        v = struct.unpack_from("<Q", data, p)[0]
+        p += 8
+    elif fmt == 0x01:  # uleb128
+        v, p = _uleb(data, p)
+    elif fmt == 0x02:  # udata2
+        v = struct.unpack_from("<H", data, p)[0]
+        p += 2
+    elif fmt == 0x03:  # udata4
+        v = struct.unpack_from("<I", data, p)[0]
+        p += 4
+    elif fmt == 0x04:  # udata8
+        v = struct.unpack_from("<Q", data, p)[0]
+        p += 8
+    elif fmt == 0x09:  # sleb128
+        v, p = _sleb(data, p)
+    elif fmt == 0x0A:  # sdata2
+        v = struct.unpack_from("<h", data, p)[0]
+        p += 2
+    elif fmt == 0x0B:  # sdata4
+        v = struct.unpack_from("<i", data, p)[0]
+        p += 4
+    elif fmt == 0x0C:  # sdata8
+        v = struct.unpack_from("<q", data, p)[0]
+        p += 8
+    else:
+        raise EhFrameError(f"unsupported pointer encoding {enc:#x}")
+    return (base + v) & 0xFFFFFFFFFFFFFFFF, p
+
+
+def _skip_encoded(data: bytes, p: int, enc: int) -> int:
+    if enc == _PE_omit:
+        return p
+    fmt = enc & _PE_FMT
+    if fmt in (0x01, 0x09):
+        _, p = _uleb(data, p)
+        return p
+    return p + {0x00: 8, 0x02: 2, 0x03: 4, 0x04: 8,
+                0x0A: 2, 0x0B: 4, 0x0C: 8}[fmt]
+
+
+@dataclass
+class _Cie:
+    code_align: int = 1
+    data_align: int = -8
+    ra_reg: int = RA
+    fde_enc: int = 0x1B  # pcrel | sdata4, the common default
+    aug_has_z: bool = False
+    # initial state after CIE instructions: (cfa_reg_dw, cfa_off, rbp, ra)
+    # where rbp/ra are CFA-relative offsets or NO_RULE
+    initial: tuple = (-1, 0, NO_RULE, NO_RULE)
+
+
+class _Rows:
+    """Row accumulator -> flat arrays. Consecutive identical states are
+    deduped (emit is the parse hot path: a big runtime emits 500k+ rows)."""
+
+    def __init__(self) -> None:
+        self.pc: list[int] = []
+        self.cfa_reg: list[int] = []
+        self.cfa_off: list[int] = []
+        self.rbp_off: list[int] = []
+        self.ra_off: list[int] = []
+        self._last = None
+
+    def emit(self, loc: int, cfa_reg_dw: int, cfa_off: int, rbp: int,
+             ra: int) -> None:
+        if cfa_reg_dw == RSP:
+            creg = CFA_RSP
+        elif cfa_reg_dw == RBP:
+            creg = CFA_RBP
+        else:
+            creg = CFA_BAD
+        if not -1073741824 < cfa_off < 1073741824:
+            creg = CFA_BAD
+        if not -1073741824 < rbp < 1073741824:
+            rbp = NO_RULE
+        if not -1073741824 < ra < 1073741824:
+            ra = NO_RULE
+        state = (creg, cfa_off, rbp, ra)
+        if state == self._last:
+            return  # extends the previous row
+        self._last = state
+        self.pc.append(loc)
+        self.cfa_reg.append(creg)
+        self.cfa_off.append(cfa_off)
+        self.rbp_off.append(rbp)
+        self.ra_off.append(ra)
+
+    def sentinel(self, loc: int) -> None:
+        self._last = None
+        self.pc.append(loc)
+        self.cfa_reg.append(CFA_BAD)
+        self.cfa_off.append(0)
+        self.rbp_off.append(NO_RULE)
+        self.ra_off.append(NO_RULE)
+
+
+def _run_cfi(data: bytes, p: int, end: int, cie: _Cie, state: tuple,
+             loc: int, sec_vaddr: int, rows: _Rows | None) -> tuple:
+    """Execute call-frame instructions from `state` = (cfa_reg_dw,
+    cfa_off, rbp, ra). With rows=None this computes the CIE's initial
+    state; otherwise emits a row per location range. State is scalar
+    locals, not dicts — this loop runs ~10 ops x 50k FDEs per big binary.
+    Rules for registers other than rbp/ra are parsed and skipped."""
+    cfa_reg, cfa_off, rbp, ra = state
+    init_cfa_reg, init_cfa_off, init_rbp, init_ra = cie.initial
+    code_align, data_align, ra_reg = (cie.code_align, cie.data_align,
+                                      cie.ra_reg)
+    stack: list[tuple] = []
+    emit = rows.emit if rows is not None else None
+    while p < end:
+        op = data[p]
+        p += 1
+        high = op & 0xC0
+        if high == 0x40:  # advance_loc
+            if emit is not None:
+                emit(loc, cfa_reg, cfa_off, rbp, ra)
+            loc += (op & 0x3F) * code_align
+        elif high == 0x80:  # offset reg, uleb
+            reg = op & 0x3F
+            off, p = _uleb(data, p)
+            if reg == RBP:
+                rbp = off * data_align
+            elif reg == ra_reg:
+                ra = off * data_align
+        elif high == 0xC0:  # restore reg
+            reg = op & 0x3F
+            if reg == RBP:
+                rbp = init_rbp
+            elif reg == ra_reg:
+                ra = init_ra
+        elif op == 0x00:  # nop
+            pass
+        elif op == 0x02:  # advance_loc1
+            if emit is not None:
+                emit(loc, cfa_reg, cfa_off, rbp, ra)
+            loc += data[p] * code_align
+            p += 1
+        elif op == 0x03:  # advance_loc2
+            if emit is not None:
+                emit(loc, cfa_reg, cfa_off, rbp, ra)
+            loc += (data[p] | data[p + 1] << 8) * code_align
+            p += 2
+        elif op == 0x04:  # advance_loc4
+            if emit is not None:
+                emit(loc, cfa_reg, cfa_off, rbp, ra)
+            loc += struct.unpack_from("<I", data, p)[0] * code_align
+            p += 4
+        elif op == 0x0C:  # def_cfa
+            cfa_reg, p = _uleb(data, p)
+            cfa_off, p = _uleb(data, p)
+        elif op == 0x0D:  # def_cfa_register
+            cfa_reg, p = _uleb(data, p)
+        elif op == 0x0E:  # def_cfa_offset
+            cfa_off, p = _uleb(data, p)
+        elif op == 0x0A:  # remember_state
+            stack.append((cfa_reg, cfa_off, rbp, ra))
+        elif op == 0x0B:  # restore_state
+            if stack:
+                cfa_reg, cfa_off, rbp, ra = stack.pop()
+        elif op == 0x01:  # set_loc
+            if emit is not None:
+                emit(loc, cfa_reg, cfa_off, rbp, ra)
+            loc, p = _read_encoded(data, p, cie.fde_enc, sec_vaddr)
+        elif op == 0x05:  # offset_extended
+            reg, p = _uleb(data, p)
+            off, p = _uleb(data, p)
+            if reg == RBP:
+                rbp = off * data_align
+            elif reg == ra_reg:
+                ra = off * data_align
+        elif op == 0x06:  # restore_extended
+            reg, p = _uleb(data, p)
+            if reg == RBP:
+                rbp = init_rbp
+            elif reg == ra_reg:
+                ra = init_ra
+        elif op in (0x07, 0x08):  # undefined / same_value
+            reg, p = _uleb(data, p)
+            if reg == RBP:
+                rbp = NO_RULE
+            elif reg == ra_reg:
+                ra = NO_RULE
+        elif op == 0x09:  # register (reg-in-reg: not walkable from stack)
+            reg, p = _uleb(data, p)
+            _, p = _uleb(data, p)
+            if reg == RBP:
+                rbp = NO_RULE
+            elif reg == ra_reg:
+                ra = NO_RULE
+        elif op == 0x0F:  # def_cfa_expression
+            n, p = _uleb(data, p)
+            p += n
+            cfa_reg = -1  # expression: invalid for our walker
+        elif op == 0x10 or op == 0x16:  # expression / val_expression
+            reg, p = _uleb(data, p)
+            n, p = _uleb(data, p)
+            p += n
+            if reg == RBP:
+                rbp = NO_RULE
+            elif reg == ra_reg:
+                ra = NO_RULE
+        elif op == 0x11:  # offset_extended_sf
+            reg, p = _uleb(data, p)
+            off, p = _sleb(data, p)
+            if reg == RBP:
+                rbp = off * data_align
+            elif reg == ra_reg:
+                ra = off * data_align
+        elif op == 0x12:  # def_cfa_sf
+            cfa_reg, p = _uleb(data, p)
+            off, p = _sleb(data, p)
+            cfa_off = off * data_align
+        elif op == 0x13:  # def_cfa_offset_sf
+            off, p = _sleb(data, p)
+            cfa_off = off * data_align
+        elif op in (0x14, 0x15):  # val_offset(_sf)
+            reg, p = _uleb(data, p)
+            if op == 0x14:
+                _, p = _uleb(data, p)
+            else:
+                _, p = _sleb(data, p)
+            if reg == RBP:
+                rbp = NO_RULE
+            elif reg == ra_reg:
+                ra = NO_RULE
+        elif op == 0x2E:  # DW_CFA_GNU_args_size
+            _, p = _uleb(data, p)
+        elif op == 0x2D or op == 0x2F:  # GNU_window_save / negative_offset_ext
+            if op == 0x2F:
+                _, p = _uleb(data, p)
+                _, p = _uleb(data, p)
+        else:
+            raise EhFrameError(f"unknown CFA op {op:#x}")
+    if emit is not None:
+        emit(loc, cfa_reg, cfa_off, rbp, ra)
+    return cfa_reg, cfa_off, rbp, ra
+
+
+def _parse_cie(data: bytes, start: int, body_start: int, end: int,
+               sec_vaddr: int) -> _Cie:
+    cie = _Cie()
+    p = body_start
+    version = data[p]
+    p += 1
+    if version not in (1, 3, 4):
+        raise EhFrameError(f"CIE version {version}")
+    aug_end = data.index(b"\0", p)
+    aug = data[p:aug_end].decode("ascii", "replace")
+    p = aug_end + 1
+    if version == 4:
+        p += 2  # address_size, segment_size
+    cie.code_align, p = _uleb(data, p)
+    cie.data_align, p = _sleb(data, p)
+    if version == 1:
+        cie.ra_reg = data[p]
+        p += 1
+    else:
+        cie.ra_reg, p = _uleb(data, p)
+    if aug.startswith("z"):
+        cie.aug_has_z = True
+        aug_len, p = _uleb(data, p)
+        aug_data_end = p + aug_len
+        for ch in aug[1:]:
+            if ch == "R":
+                cie.fde_enc = data[p]
+                p += 1
+            elif ch == "L":
+                p += 1
+            elif ch == "P":
+                enc = data[p]
+                p = _skip_encoded(data, p + 1, enc)
+            elif ch == "S":
+                pass  # signal frame
+            else:
+                break  # unknown char: skip the rest via aug_len
+        p = aug_data_end
+    cie.initial = _run_cfi(data, p, end, cie,
+                           (-1, 0, NO_RULE, NO_RULE), 0, sec_vaddr, None)
+    return cie
+
+
+@dataclass
+class UnwindTable:
+    """Flat unwind rows for one binary, sorted by file vaddr."""
+    pc: np.ndarray       # u64
+    cfa_reg: np.ndarray  # u8
+    cfa_off: np.ndarray  # i32
+    rbp_off: np.ndarray  # i32
+    ra_off: np.ndarray   # i32
+    n_fdes: int = 0
+
+    def __len__(self) -> int:
+        return len(self.pc)
+
+
+class ParseInterrupted(Exception):
+    pass
+
+
+def _cache_dir() -> str:
+    import os
+    d = os.environ.get("DF_UNWIND_CACHE")
+    if not d:
+        base = os.environ.get("XDG_CACHE_HOME",
+                              os.path.expanduser("~/.cache"))
+        d = os.path.join(base, "deepflow-tpu", "unwind")
+    return d
+
+
+def _cache_key(path: str) -> str | None:
+    import hashlib
+    import os
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    return hashlib.sha1(
+        f"{path}:{st.st_mtime_ns}:{st.st_size}".encode()).hexdigest()
+
+
+def load_unwind_table_cached(path: str,
+                             max_bytes: int = _DEFAULT_EHFRAME_CAP,
+                             should_stop=None) -> UnwindTable | None:
+    """load_unwind_table with a disk cache (parse a given binary once per
+    machine, ever — the reference persists its unwind shards the same
+    way). Key: path + mtime + size. Corrupt/missing cache -> re-parse."""
+    import os
+    key = _cache_key(path)
+    cache_path = (os.path.join(_cache_dir(), key + ".npz")
+                  if key else None)
+    if cache_path and os.path.exists(cache_path):
+        try:
+            with np.load(cache_path) as z:
+                if int(z["version"]) == 1:
+                    return UnwindTable(
+                        pc=z["pc"], cfa_reg=z["cfa_reg"],
+                        cfa_off=z["cfa_off"], rbp_off=z["rbp_off"],
+                        ra_off=z["ra_off"], n_fdes=int(z["n_fdes"]))
+        except Exception:
+            pass  # corrupt cache: fall through to re-parse
+    table = load_unwind_table(path, max_bytes, should_stop)
+    if table is not None and len(table) and cache_path:
+        try:
+            os.makedirs(_cache_dir(), exist_ok=True)
+            # name must end in .npz or np.savez appends it
+            tmp = cache_path + f".{os.getpid()}.tmp.npz"
+            np.savez(tmp, version=1, n_fdes=table.n_fdes, pc=table.pc,
+                     cfa_reg=table.cfa_reg, cfa_off=table.cfa_off,
+                     rbp_off=table.rbp_off, ra_off=table.ra_off)
+            os.replace(tmp, cache_path)
+        except OSError:
+            pass
+    return table
+
+
+def parse_eh_frame(data, sec_vaddr: int, should_stop=None) -> UnwindTable:
+    """Parse one .eh_frame section blob (file vaddr sec_vaddr).
+    should_stop() is polled periodically; True raises ParseInterrupted
+    (a profiler shutting down must not wait out a giant runtime)."""
+    rows = _Rows()
+    cies: dict[int, _Cie] = {}
+    p = 0
+    n = len(data)
+    n_fdes = 0
+    n_entries = 0
+    while p + 4 <= n:
+        n_entries += 1
+        if should_stop is not None and n_entries % 1024 == 0 \
+                and should_stop():
+            raise ParseInterrupted()
+        start = p
+        length = struct.unpack_from("<I", data, p)[0]
+        p += 4
+        if length == 0:
+            continue  # terminator; some sections pad with several
+        if length == 0xFFFFFFFF:
+            length = struct.unpack_from("<Q", data, p)[0]
+            p += 8
+        entry_end = p + length
+        if entry_end > n:
+            break  # truncated
+        id_off = p
+        cie_id = struct.unpack_from("<I", data, p)[0]
+        p += 4
+        try:
+            if cie_id == 0:
+                cies[start] = _parse_cie(data, start, p, entry_end,
+                                         sec_vaddr)
+            else:
+                cie = cies.get(id_off - cie_id)
+                if cie is None:
+                    raise EhFrameError("FDE references unknown CIE")
+                pc_begin, p2 = _read_encoded(data, p, cie.fde_enc,
+                                             sec_vaddr)
+                pc_range, p2 = _read_encoded(
+                    data, p2, cie.fde_enc & _PE_FMT, sec_vaddr)
+                if cie.aug_has_z:
+                    aug_len, p2 = _uleb(data, p2)
+                    p2 += aug_len
+                _run_cfi(data, p2, entry_end, cie, cie.initial, pc_begin,
+                         sec_vaddr, rows)
+                rows.sentinel(pc_begin + pc_range)
+                n_fdes += 1
+        except (EhFrameError, IndexError, struct.error, KeyError) as e:
+            log.debug("eh_frame entry at %#x skipped: %s", start, e)
+        p = entry_end
+    if not rows.pc:
+        return UnwindTable(pc=np.empty(0, np.uint64),
+                           cfa_reg=np.empty(0, np.uint8),
+                           cfa_off=np.empty(0, np.int32),
+                           rbp_off=np.empty(0, np.int32),
+                           ra_off=np.empty(0, np.int32))
+    pc = np.asarray(rows.pc, dtype=np.uint64)
+    cfa_reg = np.asarray(rows.cfa_reg, dtype=np.uint8)
+    cfa_off = np.asarray(rows.cfa_off, dtype=np.int32)
+    rbp_off = np.asarray(rows.rbp_off, dtype=np.int32)
+    ra_off = np.asarray(rows.ra_off, dtype=np.int32)
+    # sort by pc; FDE-end sentinels sort BEFORE a real row at the same pc
+    # (stable sort + emit order handles adjacent functions: the next FDE's
+    # first row is emitted after the previous FDE's sentinel, and with
+    # kind="stable" the real row wins the searchsorted right-1 lookup)
+    order = np.argsort(pc, kind="stable")
+    return UnwindTable(pc=pc[order], cfa_reg=cfa_reg[order],
+                       cfa_off=cfa_off[order], rbp_off=rbp_off[order],
+                       ra_off=ra_off[order], n_fdes=n_fdes)
+
+
+def load_unwind_table(path: str,
+                      max_bytes: int = _DEFAULT_EHFRAME_CAP,
+                      should_stop=None) -> UnwindTable | None:
+    """Parse an ELF's .eh_frame -> UnwindTable (file vaddrs). None when the
+    binary has no .eh_frame, is not ELF64, or exceeds the parse-cost cap.
+    Raises ParseInterrupted when should_stop() fires mid-parse."""
+    import mmap as _mmap
+    try:
+        with open(path, "rb") as f:
+            try:
+                data = _mmap.mmap(f.fileno(), 0, prot=_mmap.PROT_READ)
+            except (ValueError, OSError):
+                data = f.read()
+    except OSError:
+        return None
+    try:
+        if data[:4] != b"\x7fELF" or data[4] != 2:
+            return None
+        (_, _, _, _, _, e_shoff, _, _, _, _, e_shentsize, e_shnum,
+         e_shstrndx) = struct.unpack_from("<HHIQQQIHHHHHH", data, 16)
+        if not e_shnum or e_shstrndx >= e_shnum:
+            return None
+        # section name string table
+        off = e_shoff + e_shstrndx * e_shentsize
+        _, _, _, _, str_off, str_size = struct.unpack_from(
+            "<IIQQQQ", data, off)
+        for i in range(e_shnum):
+            off = e_shoff + i * e_shentsize
+            sh_name, _, _, sh_addr, sh_offset, sh_size = \
+                struct.unpack_from("<IIQQQQ", data, off)
+            name_end = data.find(b"\0", str_off + sh_name,
+                                 str_off + str_size)
+            name = bytes(data[str_off + sh_name:name_end])
+            if name == b".eh_frame":
+                if sh_size > max_bytes:
+                    log.info("%s: .eh_frame %d bytes exceeds cap %d; "
+                             "frame-pointer fallback", path, sh_size,
+                             max_bytes)
+                    return None
+                blob = bytes(data[sh_offset:sh_offset + sh_size])
+                return parse_eh_frame(blob, sh_addr, should_stop)
+        return None
+    except (ValueError, struct.error, IndexError):
+        return None
+    finally:
+        if isinstance(data, _mmap.mmap):
+            data.close()

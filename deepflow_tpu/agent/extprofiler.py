@@ -9,8 +9,12 @@ aggregation; this module owns the cold path — symbol resolution at window
 close — and emits the same ProfileSample batches as the in-process sampler,
 so the whole downstream (sender, decoder, flame APIs) is shared.
 
-Known gap vs the reference: no DWARF unwinder — frame-pointer-omitted
-binaries produce shallow chains (the leaf frame is always correct).
+DWARF unwinding: agent/ehframe.py parses each mapped binary's .eh_frame
+into flat tables (reference: trace-utils/src/unwind/dwarf.rs) registered
+into the native sampler, which walks them over PERF_SAMPLE_REGS_USER +
+PERF_SAMPLE_STACK_USER dumps; per sample the longer of the DWARF and
+frame-pointer chains wins, covering FP-omitted binaries wherever a table
+exists (giant runtimes beyond the parse-cost cap fall back to FP).
 """
 
 from __future__ import annotations
@@ -19,6 +23,7 @@ import bisect
 import ctypes
 import logging
 import os
+import queue
 import struct
 import threading
 import time
@@ -182,7 +187,8 @@ class Symbolizer:
         # interpreter/runtime frames repeat across most chains)
         self.refresh()
 
-    def refresh(self) -> None:
+    def refresh(self) -> bool:
+        """Re-read maps; returns True when the mappings changed."""
         maps = []
         try:
             with open(f"/proc/{self.pid}/maps") as f:
@@ -202,6 +208,8 @@ class Symbolizer:
             self._cache.clear()  # mappings changed; cached addrs stale
             self.maps = maps
             self._starts = [m.start for m in self.maps]
+            return True
+        return False
 
     def _elf(self, path: str) -> ElfSymbols:
         e = self._elfs.get(path)
@@ -234,6 +242,36 @@ class Symbolizer:
         return f"{base}+{addr - m.bias:#x}"
 
 
+_TABLE_CACHE: dict = {}  # path -> UnwindTable | None (immutable, shared)
+_TABLE_LOCK = threading.Lock()
+
+
+def _unwind_table_cached(path: str, should_stop=None):
+    """Process-wide (then machine-wide, via the ehframe disk cache) unwind
+    table lookup. Returns None for no-table binaries; raises
+    ParseInterrupted when should_stop fires mid-parse (result NOT cached,
+    so the next attach retries)."""
+    with _TABLE_LOCK:
+        if path in _TABLE_CACHE:
+            return _TABLE_CACHE[path]
+    from deepflow_tpu.agent import ehframe
+    t0 = time.monotonic()
+    try:
+        table = ehframe.load_unwind_table_cached(path,
+                                                 should_stop=should_stop)
+    except ehframe.ParseInterrupted:
+        raise
+    except Exception:
+        log.exception("eh_frame parse failed for %s", path)
+        table = None
+    if table is not None and len(table):
+        log.debug("unwind table %s: %d rows / %d FDEs in %.2fs", path,
+                  len(table), table.n_fdes, time.monotonic() - t0)
+    with _TABLE_LOCK:
+        _TABLE_CACHE[path] = table
+    return table
+
+
 class ExternalProfiler:
     """Continuous out-of-process OnCPU profiler for one target pid."""
 
@@ -242,7 +280,8 @@ class ExternalProfiler:
 
     def __init__(self, sink, pid: int, hz: float = 99.0,
                  window_s: float = 1.0, process_name: str = "",
-                 app_service: str = "") -> None:
+                 app_service: str = "", dwarf: bool = True,
+                 stack_dump: int = 8192) -> None:
         lib = native.load()
         if lib is None:
             raise RuntimeError("libdfnative.so unavailable")
@@ -252,15 +291,27 @@ class ExternalProfiler:
         self.pid = pid
         self.hz = hz
         self.window_s = window_s
+        self.dwarf = dwarf
+        self.stack_dump = stack_dump
         self.process_name = process_name or self._comm(pid)
         self.app_service = app_service or self.process_name
         self.stats = SamplerStats()
         self.lost = 0
         self.export_dropped = 0
+        self.dwarf_samples = 0
+        self.fp_samples = 0
+        self.unwind_tables = 0
         self._h = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        self._builder: threading.Thread | None = None
         self._sym = Symbolizer(pid)
+        self._requested: set = set()   # (path, map_start) sent to builder
+        self._build_q: "queue.Queue" = queue.Queue()   # (gen, map) to parse
+        self._ready_q: "queue.Queue" = queue.Queue()   # (gen, ...) tables
+        self._gen = 0          # bumped on clear: drops in-flight stale work
+        self._pending = 0      # queued-but-unregistered table builds
+        self._pending_lock = threading.Lock()
         self._addrs = np.zeros(self.ADDR_CAP, dtype=np.uint64)
         self._lens = np.zeros(self.STACK_CAP, dtype=np.uint16)
         self._tids = np.zeros(self.STACK_CAP, dtype=np.uint32)
@@ -274,6 +325,10 @@ class ExternalProfiler:
         lib.df_prof_open.argtypes = [ctypes.c_int32, ctypes.c_uint32,
                                      ctypes.c_uint32,
                                      ctypes.POINTER(ctypes.c_int32)]
+        lib.df_prof_open_ex.restype = ctypes.c_void_p
+        lib.df_prof_open_ex.argtypes = [
+            ctypes.c_int32, ctypes.c_uint32, ctypes.c_uint32,
+            ctypes.c_int32, ctypes.c_uint32, ctypes.POINTER(ctypes.c_int32)]
         lib.df_prof_close.argtypes = [ctypes.c_void_p]
         lib.df_prof_poll.restype = ctypes.c_uint64
         lib.df_prof_poll.argtypes = [ctypes.c_void_p, ctypes.c_int32]
@@ -284,6 +339,15 @@ class ExternalProfiler:
             ctypes.c_uint32]
         lib.df_prof_stats.argtypes = [ctypes.c_void_p,
                                       np.ctypeslib.ndpointer(np.uint64)]
+        lib.df_prof_stats2.argtypes = lib.df_prof_stats.argtypes
+        lib.df_prof_add_table.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.c_uint64, np.ctypeslib.ndpointer(np.uint64),
+            np.ctypeslib.ndpointer(np.uint8),
+            np.ctypeslib.ndpointer(np.int32),
+            np.ctypeslib.ndpointer(np.int32),
+            np.ctypeslib.ndpointer(np.int32), ctypes.c_uint32]
+        lib.df_prof_clear_tables.argtypes = [ctypes.c_void_p]
         lib._df_prof_bound = True
 
     @staticmethod
@@ -296,18 +360,103 @@ class ExternalProfiler:
 
     def start(self) -> "ExternalProfiler":
         err = ctypes.c_int32(0)
-        self._h = self._lib.df_prof_open(self.pid, int(self.hz), 64,
-                                         ctypes.byref(err))
+        self._h = self._lib.df_prof_open_ex(
+            self.pid, int(self.hz), 64, 1 if self.dwarf else 0,
+            self.stack_dump, ctypes.byref(err))
         if not self._h:
             raise OSError(err.value, os.strerror(err.value),
                           f"perf_event_open pid={self.pid}")
+        if self.dwarf:
+            # table builds are EXPENSIVE (a big runtime .so parses for
+            # seconds): a background builder parses and queues; the worker
+            # thread registers finished tables between polls (df_prof_add_
+            # table must not race df_prof_poll). Until a table lands, its
+            # samples use the FP chain — same degradation as the reference
+            # while its shard cache warms.
+            self._request_tables()
+            self._builder = threading.Thread(
+                target=self._build_tables,
+                name=f"df-unwind-build-{self.pid}", daemon=True)
+            self._builder.start()
         self._thread = threading.Thread(
             target=self._run, name=f"df-extprof-{self.pid}", daemon=True)
         self._thread.start()
         return self
 
+    def _request_tables(self) -> None:
+        """Queue every executable file-backed mapping for table build."""
+        for m in self._sym.maps:
+            key = (m.path, m.start)
+            if key in self._requested or not m.path.startswith("/"):
+                continue
+            self._requested.add(key)
+            with self._pending_lock:
+                self._pending += 1
+            self._build_q.put((self._gen, m))
+
+    def _done_one(self) -> None:
+        with self._pending_lock:
+            self._pending -= 1
+
+    def _build_tables(self) -> None:
+        """Builder thread: parse .eh_frame (pure Python + disk cache; no
+        native calls — registration stays on the poll thread)."""
+        from deepflow_tpu.agent import ehframe
+        while not self._stop.is_set():
+            try:
+                gen, m = self._build_q.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            try:
+                table = _unwind_table_cached(
+                    m.path, should_stop=self._stop.is_set)
+            except ehframe.ParseInterrupted:
+                self._done_one()
+                return
+            except Exception:
+                log.exception("unwind table build failed for %s", m.path)
+                self._done_one()
+                continue
+            if table is None or not len(table):
+                self._done_one()
+                continue
+            try:
+                e = self._sym._elf(m.path)
+                bias = e.bias_for(m) if e.et_dyn else 0
+            except Exception:
+                bias = 0
+            self._ready_q.put((gen, m.start, m.end, bias, table))
+
+    def builder_busy(self) -> bool:
+        """True while unwind tables are still being parsed/registered
+        (benchmarks should wait this out before timing steady state).
+        Counter-based: queue emptiness alone has a false-idle window
+        between dequeue and parse."""
+        with self._pending_lock:
+            return self._pending > 0
+
+    def _drain_ready_tables(self) -> None:
+        """Register finished tables (worker thread only: add_table must
+        not race the poll loop). Items from a previous generation (built
+        before a maps-change cleared the tables) are dropped — a stale
+        table re-registered at a reused range would shadow the fresh one."""
+        while True:
+            try:
+                gen, start, end, bias, table = self._ready_q.get_nowait()
+            except queue.Empty:
+                return
+            self._done_one()
+            if gen != self._gen:
+                continue
+            self._lib.df_prof_add_table(
+                self._h, start, end, bias, table.pc, table.cfa_reg,
+                table.cfa_off, table.rbp_off, table.ra_off, len(table))
+            self.unwind_tables += 1
+
     def stop(self) -> None:
         self._stop.set()
+        if self._builder:
+            self._builder.join(timeout=3.0)
         if self._thread:
             self._thread.join(timeout=3.0)
             if self._thread.is_alive():
@@ -329,6 +478,13 @@ class ExternalProfiler:
             except Exception:
                 log.exception("perf poll failed")
                 return
+            if self.dwarf:
+                # register tables the builder finished (this thread owns
+                # the native handle, so add_table can't race the poll)
+                try:
+                    self._drain_ready_tables()
+                except Exception:
+                    log.exception("table registration failed")
             if time.monotonic() >= next_emit:
                 next_emit = time.monotonic() + self.window_s
                 try:
@@ -347,7 +503,23 @@ class ExternalProfiler:
             self._counts.ctypes.data_as(ctypes.c_void_p), self.STACK_CAP)
         if n == 0:
             return
-        self._sym.refresh()  # mappings change (dlopen etc.)
+        changed = self._sym.refresh()  # mappings change (dlopen etc.)
+        if self.dwarf:
+            try:
+                if changed:
+                    # a dlclose/dlopen can land a new binary at a stale
+                    # module's range, and the stale table would shadow it:
+                    # drop everything and re-register (cheap — tables are
+                    # memory-cached per path)
+                    self._lib.df_prof_clear_tables(self._h)
+                    self.unwind_tables = 0
+                    self._requested.clear()
+                    self._gen += 1
+                # new mappings feed the builder; finished tables register
+                self._request_tables()
+                self._drain_ready_tables()
+            except Exception:
+                log.exception("unwind table registration failed")
         ts = time.time_ns()
         period_us = int(1e6 / self.hz)
         batch = []
@@ -368,10 +540,12 @@ class ExternalProfiler:
             self.stats.samples += count
         self.stats.emits += 1
         self.stats.last_emit_stacks = len(batch)
-        st = np.zeros(4, dtype=np.uint64)
-        self._lib.df_prof_stats(self._h, st)
+        st = np.zeros(7, dtype=np.uint64)
+        self._lib.df_prof_stats2(self._h, st)
         self.lost = int(st[1])
         self.export_dropped = int(st[3])
+        self.dwarf_samples = int(st[4])
+        self.fp_samples = int(st[5])
         try:
             self.sink(batch)
         except Exception:

@@ -2,14 +2,17 @@
 //
 // Reference analog: agent/src/ebpf/kernel/perf_profiler.bpf.c:688 (99Hz
 // perf_event sampling) + user/profile/profile_common.c (aggregation, A/B
-// swap). Redesign: no BPF — per-CPU inherited perf events on the target
-// pid, frame-pointer callchains from PERF_SAMPLE_CALLCHAIN, address-level
-// aggregation here, symbolization in Python (cold path, /proc/pid/maps +
-// ELF symtab there).
-//
-// The DWARF unwinder gap is acknowledged: FP-omitted binaries yield
-// shallow chains (leaf IP still samples correctly).
+// swap) + kernel/perf_profiler.bpf.c:1015 PROGPE(dwarf_unwind). Redesign:
+// no BPF — per-CPU inherited perf events on the target pid, frame-pointer
+// callchains from PERF_SAMPLE_CALLCHAIN, and a DWARF unwinder over
+// PERF_SAMPLE_REGS_USER + PERF_SAMPLE_STACK_USER walking .eh_frame tables
+// (built by agent/ehframe.py, registered via df_prof_add_table — the
+// trace-utils/src/unwind/dwarf.rs split). Address-level aggregation here;
+// symbolization in Python (cold path, /proc/pid/maps + ELF symtab there).
+// Per sample the longer of the two chains wins, so FP-omitted binaries
+// get full stacks wherever a table covers the IP.
 
+#include <algorithm>
 #include <cerrno>
 #include <cstdint>
 #include <cstdio>
@@ -64,13 +67,31 @@ std::vector<int> list_tids(int pid) {
 
 }  // namespace
 
+// One binary's unwind rows (file vaddrs; bias maps to runtime addrs).
+// Row encoding must match agent/ehframe.py: cfa_reg 0=rsp 1=rbp 2=invalid,
+// INT32_MIN offsets = no rule.
+struct UnwindModule {
+    uint64_t start, end;  // runtime [start, end) this table covers
+    uint64_t bias;        // runtime addr - file vaddr
+    std::vector<uint64_t> pc;
+    std::vector<uint8_t> cfa_reg;
+    std::vector<int32_t> cfa_off, rbp_off, ra_off;
+};
+
+constexpr int32_t kNoRule = INT32_MIN;
+
 struct DfProf {
     std::vector<CpuRing> rings;
     // aggregation: callchain (leaf..root addresses + tid tail) -> count
     std::map<std::vector<uint64_t>, uint64_t> agg;
     uint64_t n_samples = 0, n_lost = 0, n_export_dropped = 0;
+    uint64_t n_dwarf = 0, n_fp = 0;  // which unwinder won, per sample
     uint32_t max_stack;
     int target_pid;
+    bool dwarf = false;
+    uint32_t stack_dump = 0;
+    uint32_t ring_pages = kRingPages;
+    std::vector<UnwindModule> modules;  // sorted by start
 };
 
 static long pe_open(perf_event_attr* attr, pid_t pid, int cpu) {
@@ -79,9 +100,11 @@ static long pe_open(perf_event_attr* attr, pid_t pid, int cpu) {
 }
 
 // Attach to `pid` (all threads via inherit) at `freq` Hz across all CPUs.
-// Returns nullptr with errno-like code in *err on failure.
-DfProf* df_prof_open(int32_t pid, uint32_t freq, uint32_t max_stack,
-                     int32_t* err) {
+// dwarf != 0 additionally samples user regs (bp/sp/ip) + a stack dump of
+// stack_dump bytes for the .eh_frame unwinder. Returns nullptr with
+// errno-like code in *err on failure.
+DfProf* df_prof_open_ex(int32_t pid, uint32_t freq, uint32_t max_stack,
+                        int32_t dwarf, uint32_t stack_dump, int32_t* err) {
     *err = 0;
     perf_event_attr attr;
     memset(&attr, 0, sizeof(attr));
@@ -92,6 +115,13 @@ DfProf* df_prof_open(int32_t pid, uint32_t freq, uint32_t max_stack,
     attr.freq = 1;
     attr.sample_type = PERF_SAMPLE_IP | PERF_SAMPLE_TID |
                        PERF_SAMPLE_CALLCHAIN;
+    if (dwarf) {
+        attr.sample_type |= PERF_SAMPLE_REGS_USER | PERF_SAMPLE_STACK_USER;
+        // x86-64 perf reg indices: BP=6, SP=7, IP=8
+        attr.sample_regs_user = (1ULL << 6) | (1ULL << 7) | (1ULL << 8);
+        if (stack_dump == 0) stack_dump = 8192;
+        attr.sample_stack_user = stack_dump & ~7u;  // must be 8-aligned
+    }
     attr.exclude_kernel = 1;
     attr.exclude_hv = 1;
     attr.inherit = 1;          // follow the target's threads
@@ -102,6 +132,11 @@ DfProf* df_prof_open(int32_t pid, uint32_t freq, uint32_t max_stack,
     auto* p = new DfProf();
     p->max_stack = max_stack ? max_stack : 64;
     p->target_pid = pid;
+    p->dwarf = dwarf != 0;
+    p->stack_dump = attr.sample_stack_user;
+    // stack dumps inflate records ~8KB each: give dwarf mode 1MB rings
+    // (power of two pages) so a 200ms poll interval can't overflow them
+    p->ring_pages = dwarf ? 256 : kRingPages;
     auto cleanup = [&]() {
         for (auto& q : p->rings) {
             for (int efd : q.extra_fds) close(efd);
@@ -124,7 +159,7 @@ DfProf* df_prof_open(int32_t pid, uint32_t freq, uint32_t max_stack,
             cleanup();
             return nullptr;
         }
-        r.map_len = (kRingPages + 1) * (size_t)getpagesize();
+        r.map_len = (p->ring_pages + 1) * (size_t)getpagesize();
         r.map = (uint8_t*)mmap(nullptr, r.map_len, PROT_READ | PROT_WRITE,
                                MAP_SHARED, r.fd, 0);
         if (r.map == MAP_FAILED) {
@@ -154,6 +189,109 @@ DfProf* df_prof_open(int32_t pid, uint32_t freq, uint32_t max_stack,
     return p;
 }
 
+// Back-compat entry point: FP-only sampling.
+DfProf* df_prof_open(int32_t pid, uint32_t freq, uint32_t max_stack,
+                     int32_t* err) {
+    return df_prof_open_ex(pid, freq, max_stack, 0, 0, err);
+}
+
+// Register one binary's unwind table (from agent/ehframe.py) covering the
+// runtime range [start, end) with file-vaddr rows biased by `bias`.
+// NOT thread-safe against df_prof_poll: call before the poll loop starts
+// or from the same thread that polls.
+void df_prof_add_table(DfProf* p, uint64_t start, uint64_t end,
+                       uint64_t bias, const uint64_t* pc,
+                       const uint8_t* cfa_reg, const int32_t* cfa_off,
+                       const int32_t* rbp_off, const int32_t* ra_off,
+                       uint32_t n) {
+    if (!p || !n) return;
+    UnwindModule m;
+    m.start = start;
+    m.end = end;
+    m.bias = bias;
+    m.pc.assign(pc, pc + n);
+    m.cfa_reg.assign(cfa_reg, cfa_reg + n);
+    m.cfa_off.assign(cfa_off, cfa_off + n);
+    m.rbp_off.assign(rbp_off, rbp_off + n);
+    m.ra_off.assign(ra_off, ra_off + n);
+    auto it = std::lower_bound(
+        p->modules.begin(), p->modules.end(), m,
+        [](const UnwindModule& a, const UnwindModule& b) {
+            return a.start < b.start;
+        });
+    p->modules.insert(it, std::move(m));
+}
+
+void df_prof_clear_tables(DfProf* p) {
+    if (p) p->modules.clear();
+}
+
+namespace {
+
+const UnwindModule* find_module(const DfProf* p, uint64_t ip) {
+    // modules sorted by start; find last start <= ip
+    int lo = 0, hi = (int)p->modules.size() - 1, best = -1;
+    while (lo <= hi) {
+        int mid = (lo + hi) / 2;
+        if (p->modules[mid].start <= ip) {
+            best = mid;
+            lo = mid + 1;
+        } else {
+            hi = mid - 1;
+        }
+    }
+    if (best < 0 || ip >= p->modules[best].end) return nullptr;
+    return &p->modules[best];
+}
+
+// Walk the .eh_frame rows: ip/sp/bp from sampled user regs, memory reads
+// answered from the stack dump [sp_base, sp_base + stack_len).
+void dwarf_walk(const DfProf* p, uint64_t ip, uint64_t sp, uint64_t bp,
+                const uint8_t* stack, uint64_t sp_base, uint64_t stack_len,
+                std::vector<uint64_t>& out) {
+    out.clear();
+    auto read_u64 = [&](uint64_t addr, uint64_t* v) -> bool {
+        // overflow-safe: addr can be wild (rbp is scratch in FP-omitted
+        // code), and `addr + 8` may wrap past 2^64
+        if (addr < sp_base) return false;
+        uint64_t off = addr - sp_base;
+        if (off > stack_len || stack_len - off < 8) return false;
+        memcpy(v, stack + off, 8);
+        return true;
+    };
+    uint64_t cur = ip;
+    while (out.size() < p->max_stack) {
+        out.push_back(cur);
+        // after the first frame `cur` is a return address: look up the
+        // call site (ra - 1) so a call ending a function resolves right
+        uint64_t lookup = out.size() == 1 ? cur : cur - 1;
+        const UnwindModule* m = find_module(p, lookup);
+        if (!m) return;
+        uint64_t fpc = lookup - m->bias;
+        // last row with pc <= fpc
+        const auto& pcs = m->pc;
+        size_t idx = std::upper_bound(pcs.begin(), pcs.end(), fpc) -
+                     pcs.begin();
+        if (idx == 0) return;
+        idx--;
+        uint8_t creg = m->cfa_reg[idx];
+        int32_t ra_off = m->ra_off[idx];
+        if (creg > 1 || ra_off == kNoRule) return;
+        uint64_t cfa = (creg == 0 ? sp : bp) + (int64_t)m->cfa_off[idx];
+        uint64_t ra = 0;
+        if (!read_u64(cfa + (int64_t)ra_off, &ra)) return;
+        if (m->rbp_off[idx] != kNoRule) {
+            uint64_t nbp;
+            if (read_u64(cfa + (int64_t)m->rbp_off[idx], &nbp)) bp = nbp;
+        }
+        if (ra == 0 || cfa <= sp) return;  // no progress: corrupt frame
+        sp = cfa;
+        cur = ra;
+    }
+}
+
+}  // namespace
+
 void df_prof_close(DfProf* p) {
     if (!p) return;
     for (auto& r : p->rings) {
@@ -172,10 +310,10 @@ static void drain_ring(DfProf* p, CpuRing& r) {
     auto* meta = (perf_event_mmap_page*)r.map;
     uint64_t head = __atomic_load_n(&meta->data_head, __ATOMIC_ACQUIRE);
     uint64_t tail = meta->data_tail;
-    size_t data_size = kRingPages * (size_t)getpagesize();
+    size_t data_size = p->ring_pages * (size_t)getpagesize();
     uint8_t* data = r.map + getpagesize();
     std::vector<uint8_t> rec;
-    std::vector<uint64_t> chain;
+    std::vector<uint64_t> chain, dchain;
     while (tail < head) {
         auto* hdr = (perf_event_header*)(data + (tail % data_size));
         uint16_t size = hdr->size;
@@ -188,9 +326,11 @@ static void drain_ring(DfProf* p, CpuRing& r) {
         if (first < size) memcpy(rec.data() + first, data, size - first);
         auto* h = (perf_event_header*)rec.data();
         if (h->type == PERF_RECORD_SAMPLE) {
-            // layout per sample_type: ip u64, pid u32, tid u32,
-            // nr u64, ips[nr] u64
+            // layout per sample_type order: ip u64, pid u32, tid u32,
+            // nr u64 + ips[nr], then (dwarf mode) regs_user: abi u64 +
+            // bp/sp/ip u64, stack_user: size u64 + data + dyn_size u64
             const uint8_t* q = rec.data() + sizeof(perf_event_header);
+            const uint8_t* end = rec.data() + size;
             uint64_t ip;
             memcpy(&ip, q, 8);
             q += 8;
@@ -201,14 +341,50 @@ static void drain_ring(DfProf* p, CpuRing& r) {
             uint64_t nr;
             memcpy(&nr, q, 8);
             q += 8;
-            const uint8_t* end = rec.data() + size;
             chain.clear();
             for (uint64_t i = 0; i < nr && q + 8 <= end; i++, q += 8) {
                 uint64_t a;
                 memcpy(&a, q, 8);
                 if (a >= kContextMask) continue;  // context marker
-                chain.push_back(a);
-                if (chain.size() >= p->max_stack) break;
+                if (chain.size() < p->max_stack) chain.push_back(a);
+            }
+            if (p->dwarf && q + 8 <= end) {
+                uint64_t abi;
+                memcpy(&abi, q, 8);
+                q += 8;
+                uint64_t bp = 0, sp = 0, uip = 0;
+                if (abi != 0 && q + 24 <= end) {
+                    memcpy(&bp, q, 8);       // ascending bit order:
+                    memcpy(&sp, q + 8, 8);   // BP(6), SP(7), IP(8)
+                    memcpy(&uip, q + 16, 8);
+                    q += 24;
+                }
+                if (q + 8 <= end) {
+                    uint64_t ssize;
+                    memcpy(&ssize, q, 8);
+                    q += 8;
+                    const uint8_t* sdata = q;
+                    uint64_t dyn = 0;
+                    if (ssize && q + ssize + 8 <= end) {
+                        memcpy(&dyn, q + ssize, 8);
+                        if (dyn > ssize) dyn = ssize;
+                    }
+                    if (abi != 0 && dyn >= 16 && sp &&
+                        !p->modules.empty()) {
+                        dwarf_walk(p, uip ? uip : ip, sp, bp, sdata, sp,
+                                   dyn, dchain);
+                        // the longer unwind wins (FP chains are truncated
+                        // exactly where tables help, and vice versa)
+                        if (dchain.size() > chain.size()) {
+                            chain = dchain;
+                            p->n_dwarf++;
+                        } else if (!chain.empty()) {
+                            p->n_fp++;
+                        }
+                    } else if (!chain.empty()) {
+                        p->n_fp++;
+                    }
+                }
             }
             if (chain.empty() && ip < kContextMask) chain.push_back(ip);
             if (!chain.empty()) {
@@ -269,6 +445,15 @@ void df_prof_stats(DfProf* p, uint64_t* out4) {
     out4[1] = p->n_lost;
     out4[2] = p->rings.size();
     out4[3] = p->n_export_dropped;
+}
+
+// extended stats: adds [4] dwarf-unwound samples, [5] fp-fallback samples,
+// [6] registered unwind tables
+void df_prof_stats2(DfProf* p, uint64_t* out7) {
+    df_prof_stats(p, out7);
+    out7[4] = p->n_dwarf;
+    out7[5] = p->n_fp;
+    out7[6] = p->modules.size();
 }
 
 }  // extern "C"
