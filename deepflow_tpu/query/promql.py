@@ -64,6 +64,11 @@ class PromqlError(Exception):
     pass
 
 
+class UnknownMetricError(PromqlError):
+    """A selector naming a metric nothing has ingested — matches nothing
+    (metadata endpoints treat this as empty, not as a bad request)."""
+
+
 def parse_duration_s(s: str) -> float:
     if not _DUR_FULL.match(s):
         raise PromqlError(f"bad duration {s!r}")
@@ -327,6 +332,8 @@ class _Parser:
             if op not in ("=", "!=", "=~", "!~"):
                 raise PromqlError(f"bad matcher op {op}")
             val = _unquote(self.expect("str")[1])
+            if op in ("=~", "!~"):
+                _compile(val)  # bad regex fails at parse time (upstream)
             matchers.append((lbl, op, val))
             if self.peek() == ("op", ","):
                 self.next_()
@@ -581,7 +588,7 @@ def _resolve_metric(db: Database, name: str):
     table = db.table("prometheus.samples")
     code = table.dicts["metric_name"].lookup(name)
     if code is None:
-        raise PromqlError(f"unknown metric {name!r}")
+        raise UnknownMetricError(f"unknown metric {name!r}")
     return (table, "value", ["labels_json"], [("metric_name", code)],
             "labels_json")
 
@@ -1075,7 +1082,7 @@ class _Evaluator:
             if isinstance(node.args[0], VectorSelector):
                 try:
                     vec = self.instant_vector(node.args[0])
-                except PromqlError:
+                except UnknownMetricError:
                     vec = []  # unknown metric is definitionally absent
                 labels = {lbl: val for lbl, op, val
                           in node.args[0].matchers if op == "="}
@@ -1616,3 +1623,198 @@ def _fmt_num(v: float) -> str:
     if math.isnan(v):
         return "NaN"
     return repr(v)
+
+
+# -- metadata API (Grafana variable queries) ---------------------------------
+
+_JSON_LABEL_SCAN_CAP = 50_000  # labels_json dict entries parsed per table
+
+
+def _codes_in_range(table, col: str, lo_s: float, hi_s: float) -> set[int]:
+    """Distinct dictionary/enum codes of `col` among rows in the time
+    range. Chunk-scanned, NOT dictionary-snapshotted: dictionaries retain
+    every string ever encoded, so a snapshot would resurrect TTL-trimmed
+    values and ignore the range."""
+    codes: set[int] = set()
+    ns = table.columns["time"].kind == "u64"
+    for ch in table.snapshot():
+        if not ch or not len(ch.get(col, ())):
+            continue
+        t = ch["time"].astype(np.int64)
+        if ns:
+            t = t // 1_000_000_000
+        mask = (t >= lo_s) & (t <= hi_s)
+        if not mask.any():
+            continue
+        codes.update(int(c) for c in np.unique(ch[col][mask]))
+    return codes
+
+
+def metric_names(db: Database) -> list[str]:
+    """Every queryable metric name (the /prom/api/v1/label/__name__/values
+    answer): <family>_<meter> for the flow tables, observed
+    deepflow_system metric/value pairs, and all remote-write names."""
+    out: set[str] = set()
+    for prefix, (tname, _tags) in _FAMILIES.items():
+        try:
+            table = db.table(tname)
+        except KeyError:
+            continue
+        for col, spec in table.columns.items():
+            if spec.kind == "u64":  # meters are u64; tags are str/enum/ints
+                out.add(prefix + col)
+    try:
+        table = db.table("deepflow_system.deepflow_system")
+        chunks = table.snapshot()
+        pairs: set[tuple[int, int]] = set()
+        for ch in chunks:
+            if not ch or not len(ch.get("metric_name", ())):
+                continue
+            for mi, vi in zip(*np.unique(np.stack(
+                    [ch["metric_name"], ch["value_name"]]), axis=1)):
+                pairs.add((int(mi), int(vi)))
+        mdict, vdict = table.dicts["metric_name"], table.dicts["value_name"]
+        for mi, vi in pairs:
+            mn, vn = mdict.decode(mi), vdict.decode(vi)
+            if mn and vn:
+                out.add(f"deepflow_system_{_mangle(mn)}_{_mangle(vn)}")
+    except (KeyError, IndexError):
+        pass
+    try:
+        for name in db.table("prometheus.samples").dicts[
+                "metric_name"].snapshot():
+            if name:
+                out.add(name)
+    except KeyError:
+        pass
+    return sorted(out)
+
+
+def series(db: Database, matches: list[str], start_s: int,
+           end_s: int) -> list[dict]:
+    """GET /prom/api/v1/series: label sets of series matching any of the
+    match[] selectors in the time range."""
+    seen: set[tuple] = set()
+    out: list[dict] = []
+    for m in matches:
+        ast = parse(m)
+        if not isinstance(ast, VectorSelector):
+            raise PromqlError("series match[] must be a plain selector")
+        try:
+            raw = fetch_raw(db, ast, start_s, end_s)
+        except UnknownMetricError:
+            continue  # never-ingested metric matches nothing; any OTHER
+            # PromqlError (bad regex, unknown label) propagates as 400
+        for rs in raw:
+            key = tuple(sorted(rs.labels.items()))
+            if key not in seen:
+                seen.add(key)
+                out.append(rs.labels)
+    return out
+
+
+def _all_label_names(db: Database, start_s: int, end_s: int) -> set[str]:
+    names = {"__name__"}
+    for _prefix, (tname, tags) in _FAMILIES.items():
+        try:
+            db.table(tname)
+        except KeyError:
+            continue
+        names.update(tags)
+    for tname, json_col in (("prometheus.samples", "labels_json"),
+                            ("deepflow_system.deepflow_system", "tag_json")):
+        try:
+            table = db.table(tname)
+        except KeyError:
+            continue
+        d = table.dicts[json_col]
+        for i, code in enumerate(_codes_in_range(table, json_col,
+                                                 start_s, end_s)):
+            if i > _JSON_LABEL_SCAN_CAP:
+                break
+            try:
+                names.update(_json.loads(d.decode(code) or "{}").keys())
+            except (ValueError, IndexError):
+                pass
+    names.update(("host", "agent_id"))
+    return names
+
+
+def label_names(db: Database, matches: list[str], start_s: int,
+                end_s: int) -> list[str]:
+    """GET /prom/api/v1/labels."""
+    if matches:
+        names: set[str] = set()
+        for s in series(db, matches, start_s, end_s):
+            names.update(s.keys())
+        return sorted(names)
+    return sorted(_all_label_names(db, start_s, end_s))
+
+
+def label_values(db: Database, label: str, matches: list[str],
+                 start_s: int, end_s: int) -> list[str]:
+    """GET /prom/api/v1/label/<name>/values. Values come from rows in the
+    time range (chunk scan), not dictionary snapshots — retention-trimmed
+    values must not haunt Grafana dropdowns."""
+    if label == "__name__":
+        if matches:
+            return sorted({s.get("__name__", "")
+                           for s in series(db, matches, start_s, end_s)}
+                          - {""})
+        return metric_names(db)
+    if matches:
+        return sorted({s[label] for s in series(db, matches, start_s, end_s)
+                       if label in s})
+    values: set[str] = set()
+    for _prefix, (tname, tags) in _FAMILIES.items():
+        if label not in tags:
+            continue
+        try:
+            table = db.table(tname)
+        except KeyError:
+            continue
+        spec = table.columns.get(label)
+        if spec is None:
+            continue
+        codes = _codes_in_range(table, label, start_s, end_s)
+        if spec.kind == "str":
+            d = table.dicts[label]
+            for c in codes:
+                try:
+                    s = d.decode(c)
+                except IndexError:
+                    continue
+                if s:
+                    values.add(s)
+        elif spec.kind == "enum":
+            for c in codes:
+                if 0 <= c < len(spec.enum_values) and spec.enum_values[c]:
+                    values.add(spec.enum_values[c])
+    for tname, json_col in (("prometheus.samples", "labels_json"),
+                            ("deepflow_system.deepflow_system", "tag_json")):
+        try:
+            table = db.table(tname)
+        except KeyError:
+            continue
+        if label in table.columns and table.columns[label].kind == "str":
+            d = table.dicts[label]
+            for c in _codes_in_range(table, label, start_s, end_s):
+                try:
+                    s = d.decode(c)
+                except IndexError:
+                    continue
+                if s:
+                    values.add(s)
+            continue
+        d = table.dicts[json_col]
+        for i, code in enumerate(_codes_in_range(table, json_col,
+                                                 start_s, end_s)):
+            if i > _JSON_LABEL_SCAN_CAP:
+                break
+            try:
+                v = _json.loads(d.decode(code) or "{}").get(label)
+            except (ValueError, IndexError):
+                continue
+            if v is not None and str(v):
+                values.add(str(v))
+    return sorted(values)

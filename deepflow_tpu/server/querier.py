@@ -226,6 +226,183 @@ class QuerierAPI:
                     "error": str(e)}
         return {"status": "success", "data": data}
 
+    def _prom_meta_args(self, params: dict) -> tuple:
+        """params is a parse_qs dict (every value a list — match[] can
+        repeat). Defaults: the last hour."""
+        import time as _time
+        matches = params.get("match[]", [])
+        now = int(_time.time())
+        try:
+            start = int(float(params.get("start", [now - 3600])[0]))
+            end = int(float(params.get("end", [now])[0]))
+        except (ValueError, IndexError) as e:
+            raise qengine.QueryError(f"bad time param: {e}")
+        return matches, start, end
+
+    def prom_series(self, params: dict) -> dict:
+        """GET /prom/api/v1/series (reference: querier/app/prometheus
+        series API — Grafana variable queries)."""
+        from deepflow_tpu.query import promql
+        matches, start, end = self._prom_meta_args(params)
+        if not matches:
+            return {"status": "error", "errorType": "bad_data",
+                    "error": "no match[] parameter"}
+        try:
+            return {"status": "success",
+                    "data": promql.series(self.db, matches, start, end)}
+        except promql.PromqlError as e:
+            return {"status": "error", "errorType": "bad_data",
+                    "error": str(e)}
+
+    def prom_labels(self, params: dict) -> dict:
+        from deepflow_tpu.query import promql
+        matches, start, end = self._prom_meta_args(params)
+        try:
+            return {"status": "success",
+                    "data": promql.label_names(self.db, matches, start,
+                                               end)}
+        except promql.PromqlError as e:
+            return {"status": "error", "errorType": "bad_data",
+                    "error": str(e)}
+
+    def prom_label_values(self, label: str, params: dict) -> dict:
+        from deepflow_tpu.query import promql
+        matches, start, end = self._prom_meta_args(params)
+        try:
+            return {"status": "success",
+                    "data": promql.label_values(self.db, label, matches,
+                                                start, end)}
+        except promql.PromqlError as e:
+            return {"status": "error", "errorType": "bad_data",
+                    "error": str(e)}
+
+    _TEMPO_DUR = {"ns": 1, "us": 1e3, "µs": 1e3, "ms": 1e6, "s": 1e9,
+                  "m": 60e9, "h": 3600e9}
+
+    @classmethod
+    def _tempo_duration_ns(cls, s: str) -> int:
+        import re as _re
+        m = _re.match(r"^([\d.]+)(ns|us|µs|ms|s|m|h)$", s.strip())
+        if not m:
+            raise qengine.QueryError(f"bad duration {s!r}")
+        return int(float(m.group(1)) * cls._TEMPO_DUR[m.group(2)])
+
+    _TEMPO_TAGS = ("service.name", "endpoint", "l7.protocol",
+                   "http.status_code")
+
+    def tempo_search(self, params: dict) -> dict:
+        """GET /api/search — Tempo search API (reference: querier/tempo):
+        logfmt tags filter, min/maxDuration, time range, limit.
+
+        Tempo semantics: tags select traces (any single span matching ALL
+        tags qualifies the trace), but root/start/duration report the
+        WHOLE trace — so the scan keeps every span of the window and
+        filters at the trace level."""
+        import re as _re
+        import time as _time
+        limit = max(1, min(int(params.get("limit", 20)), 500))
+        tags = {}
+        for k, v_quoted, v_plain in _re.findall(
+                r'([\w.]+)=(?:"([^"]*)"|(\S+))', params.get("tags", "")):
+            tags[k] = v_quoted or v_plain
+        for k in tags:
+            if k not in self._TEMPO_TAGS:
+                raise qengine.QueryError(
+                    f"unsupported search tag {k!r}; known: "
+                    f"{sorted(self._TEMPO_TAGS)}")
+        min_ns = (self._tempo_duration_ns(params["minDuration"])
+                  if params.get("minDuration") else 0)
+        max_ns = (self._tempo_duration_ns(params["maxDuration"])
+                  if params.get("maxDuration") else 0)
+        where = ["trace_id != ''"]
+        if not params.get("start") and not params.get("end"):
+            # a bare search must not scan all history: recent-hour default
+            where.append(
+                f"time >= {(int(_time.time()) - 3600) * 1_000_000_000}")
+        if params.get("start"):
+            where.append(
+                f"time >= {int(float(params['start'])) * 1_000_000_000}")
+        if params.get("end"):
+            where.append(
+                f"time < {int(float(params['end'])) * 1_000_000_000}")
+        table = self.db.table("flow_log.l7_flow_log")
+        res = qengine.execute(
+            table,
+            "SELECT time, trace_id, app_service, request_type, endpoint, "
+            "response_duration, l7_protocol, response_code FROM t "
+            "WHERE " + " AND ".join(where))
+        traces: dict[str, dict] = {}
+        for t, tid, svc, rtype, ep, dur, proto, code in res.values:
+            t, dur = int(t), int(dur)
+            span_tags = {"service.name": svc or "", "endpoint": ep or "",
+                         "l7.protocol": str(proto),
+                         "http.status_code": str(int(code))}
+            matched = all(span_tags.get(k) == v for k, v in tags.items())
+            tr = traces.get(tid)
+            if tr is None:
+                tr = traces[tid] = {
+                    "traceID": tid, "start": t, "end": t + dur,
+                    "rootServiceName": svc or "",
+                    "rootTraceName": f"{rtype} {ep}".strip() or tid,
+                    "_root_t": t, "_matched": matched}
+            else:
+                tr["start"] = min(tr["start"], t)
+                tr["end"] = max(tr["end"], t + dur)
+                tr["_matched"] = tr["_matched"] or matched
+                if t < tr["_root_t"]:
+                    tr["_root_t"] = t
+                    tr["rootServiceName"] = svc or ""
+                    tr["rootTraceName"] = f"{rtype} {ep}".strip() or tid
+        out = []
+        for tr in traces.values():
+            if not tr["_matched"]:
+                continue
+            dur_ns = tr["end"] - tr["start"]
+            if min_ns and dur_ns < min_ns:
+                continue
+            if max_ns and dur_ns > max_ns:
+                continue
+            out.append({"traceID": tr["traceID"],
+                        "rootServiceName": tr["rootServiceName"],
+                        "rootTraceName": tr["rootTraceName"],
+                        "startTimeUnixNano": str(tr["start"]),
+                        "durationMs": dur_ns // 1_000_000})
+        out.sort(key=lambda tr: -int(tr["startTimeUnixNano"]))
+        return {"traces": out[:limit], "metrics": {
+            "inspectedTraces": len(traces)}}
+
+    def tempo_search_tags(self) -> dict:
+        return {"tagNames": list(self._TEMPO_TAGS)}
+
+    def tempo_search_tag_values(self, name: str) -> dict:
+        """Values come from live rows (chunk scan), not dictionary
+        snapshots: retention-trimmed services must not keep appearing."""
+        from deepflow_tpu.query.promql import _codes_in_range
+        table = self.db.table("flow_log.l7_flow_log")
+        lo, hi = 0, 1 << 62
+        if name in ("service.name", "endpoint"):
+            col = "app_service" if name == "service.name" else "endpoint"
+            d = table.dicts[col]
+            vals = []
+            for c in _codes_in_range(table, col, lo, hi):
+                try:
+                    s = d.decode(c)
+                except IndexError:
+                    continue
+                if s:
+                    vals.append(s)
+        elif name == "l7.protocol":
+            enum = table.columns["l7_protocol"].enum_values
+            vals = [enum[c] for c in _codes_in_range(
+                table, "l7_protocol", lo, hi)
+                if 0 <= c < len(enum) and enum[c]]
+        elif name == "http.status_code":
+            vals = [str(c) for c in sorted(_codes_in_range(
+                table, "response_code", lo, hi)) if c]
+        else:
+            vals = []
+        return {"tagValues": sorted(vals)}
+
     def tempo_trace(self, trace_id: str) -> dict:
         """GET /api/traces/{id} — Grafana Tempo-compatible shape
         (reference: querier/tempo)."""
@@ -440,9 +617,34 @@ class QuerierHTTP:
                         self._send(200, api.prom_query_range(params))
                     elif path in ("/prom/api/v1/query", "/api/v1/query"):
                         self._send(200, api.prom_query(params))
+                    elif path in ("/prom/api/v1/series", "/api/v1/series"):
+                        from urllib.parse import parse_qs
+                        self._send(200, api.prom_series(
+                            parse_qs(parsed.query)))
+                    elif path in ("/prom/api/v1/labels", "/api/v1/labels"):
+                        from urllib.parse import parse_qs
+                        self._send(200, api.prom_labels(
+                            parse_qs(parsed.query)))
+                    elif (path.startswith(("/prom/api/v1/label/",
+                                           "/api/v1/label/"))
+                          and path.endswith("/values")):
+                        from urllib.parse import parse_qs
+                        label = path.rsplit("/label/", 1)[1][:-len("/values")]
+                        self._send(200, api.prom_label_values(
+                            label, parse_qs(parsed.query)))
                     elif path.startswith("/api/traces/"):
                         self._send(200, api.tempo_trace(
                             path.rsplit("/", 1)[-1]))
+                    elif path == "/api/echo":  # Tempo datasource health
+                        self._send(200, {"status": "echo"})
+                    elif path == "/api/search":
+                        self._send(200, api.tempo_search(params))
+                    elif path == "/api/search/tags":
+                        self._send(200, api.tempo_search_tags())
+                    elif (path.startswith("/api/search/tag/")
+                          and path.endswith("/values")):
+                        name = path[len("/api/search/tag/"):-len("/values")]
+                        self._send(200, api.tempo_search_tag_values(name))
                     else:
                         self._send(404, {"error": f"no route {self.path}"})
                 except (qengine.QueryError, ValueError) as e:

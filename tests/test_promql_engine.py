@@ -481,6 +481,85 @@ def test_compound_duration():
     assert q.args[0].range_s == 5400
 
 
+def test_metadata_api():
+    db = make_db()
+    names = promql.metric_names(db)
+    assert "http_requests_total" in names and "queue_depth" in names
+    assert "flow_metrics_network_byte_tx" in names
+    assert "flow_metrics_application_request" in names
+
+    out = promql.series(db, ['http_requests_total{instance="a"}'],
+                        T0, T0 + 120)
+    assert len(out) == 1
+    assert out[0]["__name__"] == "http_requests_total"
+    assert out[0]["job"] == "api"
+    # unknown metric matches nothing, cleanly
+    assert promql.series(db, ["nope_nope"], T0, T0 + 120) == []
+    # non-selector match is an error
+    with pytest.raises(promql.PromqlError):
+        promql.series(db, ["rate(x[5m])"], T0, T0 + 120)
+    # a BAD selector is an error, not an empty dropdown: bad regex and
+    # unknown label on a flow table both surface (only never-ingested
+    # metric names are silently empty)
+    with pytest.raises(promql.PromqlError):
+        promql.series(db, ['up{job=~"(("}'], T0, T0 + 120)
+    with pytest.raises(promql.PromqlError):
+        promql.series(db, ['flow_metrics_network_byte_tx{nope="x"}'],
+                      T0, T0 + 120)
+
+    labels = promql.label_names(db, [], T0, T0 + 120)
+    assert {"__name__", "job", "instance", "host"} <= set(labels)
+    labels = promql.label_names(db, ["http_requests_total"], T0, T0 + 120)
+    assert set(labels) == {"__name__", "job", "instance"}
+
+    vals = promql.label_values(db, "instance", [], T0, T0 + 120)
+    assert {"a", "b"} <= set(vals)
+    vals = promql.label_values(db, "le", [], T0, T0 + 120)
+    assert {"0.1", "0.5", "+Inf"} <= set(vals)
+    vals = promql.label_values(db, "__name__", [], T0, T0 + 120)
+    assert "queue_depth" in vals
+    vals = promql.label_values(
+        db, "instance", ['conn_limit{zone="z1"}'], T0, T0 + 120)
+    assert vals == ["a"]
+
+
+def test_metadata_http_endpoints():
+    import json
+    import time as _time
+    import urllib.request
+    from urllib.parse import quote
+
+    from deepflow_tpu.server import Server
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0).start()
+    try:
+        now = int(_time.time())
+        t = server.db.table("prometheus.samples")
+        t.append_rows([
+            {"time": now - 5, "metric_name": "up",
+             "labels_json": '{"job": "api"}', "value": 1.0},
+            {"time": now - 5, "metric_name": "up",
+             "labels_json": '{"job": "db"}', "value": 0.0}])
+        base = f"http://127.0.0.1:{server.query_port}"
+
+        def get(url):
+            with urllib.request.urlopen(base + url, timeout=5) as r:
+                return json.loads(r.read())
+        out = get(f"/prom/api/v1/series?match[]={quote('up')}"
+                  f"&start={now-60}&end={now}")
+        assert out["status"] == "success" and len(out["data"]) == 2
+        out = get("/prom/api/v1/labels")
+        assert "job" in out["data"] and "__name__" in out["data"]
+        out = get("/prom/api/v1/label/job/values")
+        assert set(out["data"]) >= {"api", "db"}
+        out = get("/prom/api/v1/label/__name__/values")
+        assert "up" in out["data"]
+        # series without match[] is a clean error
+        out = get("/prom/api/v1/series")
+        assert out["status"] == "error"
+    finally:
+        server.stop()
+
+
 def test_deepflow_internal_tables_still_delta():
     """flow_metrics rate() keeps delta semantics alongside the new engine."""
     db = Database()
