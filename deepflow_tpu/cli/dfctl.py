@@ -9,6 +9,8 @@ Reference analog: cli/ctl/*.go (deepflow-ctl). Subcommands:
     dfctl flame --service my-svc [--event-type on-cpu]
     dfctl tpu-flame [--device 0]
     dfctl trace <trace_id>
+    dfctl trace-search --tags "service.name=shop" --min-duration 100ms
+    dfctl promql 'histogram_quantile(0.95, rate(lat_bucket[5m]))'
     dfctl alert list|set <json>|delete <name>
     dfctl exporter list|add <json>|delete <endpoint>
     dfctl replay capture.pcap --ingest host:20033
@@ -125,6 +127,25 @@ def main(argv: list[str] | None = None) -> int:
     p_trace = sub.add_parser("trace")
     p_trace.add_argument("trace_id")
 
+    p_promql = sub.add_parser(
+        "promql", help="evaluate a PromQL expression (instant by default; "
+                       "--start/--end for a range)")
+    p_promql.add_argument("expr")
+    p_promql.add_argument("--time", type=int, default=None)
+    p_promql.add_argument("--start", type=int, default=None)
+    p_promql.add_argument("--end", type=int, default=None)
+    p_promql.add_argument("--step", type=int, default=15)
+
+    p_ts = sub.add_parser(
+        "trace-search", help="search traces by tags/duration "
+                             "(tags is logfmt: service.name=x ...)")
+    p_ts.add_argument("--tags", default="")
+    p_ts.add_argument("--min-duration", default=None)
+    p_ts.add_argument("--max-duration", default=None)
+    p_ts.add_argument("--start", type=int, default=None)
+    p_ts.add_argument("--end", type=int, default=None)
+    p_ts.add_argument("--limit", type=int, default=20)
+
     p_alert = sub.add_parser("alert")
     p_alert.add_argument("action", choices=["list", "set", "delete"])
     p_alert.add_argument("spec", nargs="?",
@@ -229,6 +250,54 @@ def main(argv: list[str] | None = None) -> int:
             body["include_host"] = True
         out = _api(args.server, "/v1/profile/TpuFlame", body)
         print_flame(out["result"])
+    elif args.cmd == "promql":
+        from urllib.parse import quote
+        import time as _time
+        if (args.start is None) != (args.end is None):
+            raise SystemExit(
+                "promql: --start and --end must be given together "
+                "(a range query needs both bounds)")
+        if args.start is not None and args.end is not None:
+            url = (f"/prom/api/v1/query_range?query={quote(args.expr)}"
+                   f"&start={args.start}&end={args.end}&step={args.step}")
+            out = _api(args.server, url)
+            if out.get("status") != "success":
+                raise SystemExit(f"promql: {out.get('error')}")
+            for s in out["data"]["result"]:
+                print(json.dumps(s["metric"]))
+                for t, v in s["values"]:
+                    print(f"  {t}  {v}")
+        else:
+            t = args.time if args.time is not None else int(_time.time())
+            url = f"/prom/api/v1/query?query={quote(args.expr)}&time={t}"
+            out = _api(args.server, url)
+            if out.get("status") != "success":
+                raise SystemExit(f"promql: {out.get('error')}")
+            data = out["data"]
+            if data["resultType"] == "scalar":
+                print(data["result"][1])
+            else:
+                rows = [[json.dumps(s["metric"]), s["value"][1]]
+                        for s in data["result"]]
+                print_table(["SERIES", "VALUE"], rows)
+    elif args.cmd == "trace-search":
+        from urllib.parse import urlencode
+        q = {"limit": args.limit}
+        if args.tags:
+            q["tags"] = args.tags
+        if args.min_duration:
+            q["minDuration"] = args.min_duration
+        if args.max_duration:
+            q["maxDuration"] = args.max_duration
+        if args.start is not None:
+            q["start"] = args.start
+        if args.end is not None:
+            q["end"] = args.end
+        out = _api(args.server, f"/api/search?{urlencode(q)}")
+        rows = [[t["traceID"], t["rootServiceName"], t["rootTraceName"],
+                 t["durationMs"], t["startTimeUnixNano"]]
+                for t in out["traces"]]
+        print_table(["TRACE_ID", "SERVICE", "NAME", "MS", "START_NS"], rows)
     elif args.cmd == "trace":
         out = _api(args.server, "/v1/trace/Tracing",
                    {"trace_id": args.trace_id})

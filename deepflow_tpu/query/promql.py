@@ -1650,10 +1650,13 @@ def _codes_in_range(table, col: str, lo_s: float, hi_s: float) -> set[int]:
     return codes
 
 
-def metric_names(db: Database) -> list[str]:
+def metric_names(db: Database, start_s: float = 0,
+                 end_s: float = 1 << 62) -> list[str]:
     """Every queryable metric name (the /prom/api/v1/label/__name__/values
-    answer): <family>_<meter> for the flow tables, observed
-    deepflow_system metric/value pairs, and all remote-write names."""
+    answer): <family>_<meter> for the flow tables (schema-derived),
+    observed deepflow_system metric/value pairs, and remote-write names —
+    the observed sets chunk-scanned within [start_s, end_s] so
+    retention-trimmed metrics don't linger."""
     out: set[str] = set()
     for prefix, (tname, _tags) in _FAMILIES.items():
         try:
@@ -1665,13 +1668,17 @@ def metric_names(db: Database) -> list[str]:
                 out.add(prefix + col)
     try:
         table = db.table("deepflow_system.deepflow_system")
-        chunks = table.snapshot()
         pairs: set[tuple[int, int]] = set()
-        for ch in chunks:
+        for ch in table.snapshot():
             if not ch or not len(ch.get("metric_name", ())):
                 continue
+            t = ch["time"].astype(np.int64) // 1_000_000_000
+            mask = (t >= start_s) & (t <= end_s)
+            if not mask.any():
+                continue
             for mi, vi in zip(*np.unique(np.stack(
-                    [ch["metric_name"], ch["value_name"]]), axis=1)):
+                    [ch["metric_name"][mask], ch["value_name"][mask]]),
+                    axis=1)):
                 pairs.add((int(mi), int(vi)))
         mdict, vdict = table.dicts["metric_name"], table.dicts["value_name"]
         for mi, vi in pairs:
@@ -1681,8 +1688,13 @@ def metric_names(db: Database) -> list[str]:
     except (KeyError, IndexError):
         pass
     try:
-        for name in db.table("prometheus.samples").dicts[
-                "metric_name"].snapshot():
+        table = db.table("prometheus.samples")
+        d = table.dicts["metric_name"]
+        for c in _codes_in_range(table, "metric_name", start_s, end_s):
+            try:
+                name = d.decode(c)
+            except IndexError:
+                continue
             if name:
                 out.add(name)
     except KeyError:
@@ -1761,7 +1773,7 @@ def label_values(db: Database, label: str, matches: list[str],
             return sorted({s.get("__name__", "")
                            for s in series(db, matches, start_s, end_s)}
                           - {""})
-        return metric_names(db)
+        return metric_names(db, start_s, end_s)
     if matches:
         return sorted({s[label] for s in series(db, matches, start_s, end_s)
                        if label in s})
@@ -1790,6 +1802,10 @@ def label_values(db: Database, label: str, matches: list[str],
             for c in codes:
                 if 0 <= c < len(spec.enum_values) and spec.enum_values[c]:
                     values.add(spec.enum_values[c])
+        else:
+            # numeric tags (server_port, agent_id, ...) render the same
+            # way series() does: str(int)
+            values.update(str(c) for c in codes)
     for tname, json_col in (("prometheus.samples", "labels_json"),
                             ("deepflow_system.deepflow_system", "tag_json")):
         try:

@@ -72,6 +72,52 @@ TOOLS = [
         },
     },
     {
+        "name": "promql",
+        "description": ("Evaluate a PromQL expression (full engine: "
+                        "rate/histogram_quantile/aggregations/binary ops/"
+                        "subqueries). Instant query at `time`, or a range "
+                        "query when start+end are given. Metrics: "
+                        "flow_metrics_network_*, flow_metrics_application_*, "
+                        "deepflow_system_*, plus any remote-write name."),
+        "inputSchema": {
+            "type": "object",
+            "properties": {
+                "query": {"type": "string"},
+                "time": {"type": "integer",
+                         "description": "instant eval time (epoch s)"},
+                "start": {"type": "integer"},
+                "end": {"type": "integer"},
+                "step": {"type": "integer"},
+            },
+            "required": ["query"],
+        },
+    },
+    {
+        "name": "search_traces",
+        "description": ("Search distributed traces: tags is logfmt (keys: "
+                        "service.name, endpoint, l7.protocol, "
+                        "http.status_code), plus minDuration/maxDuration "
+                        "(e.g. 100ms) and start/end epoch seconds. Returns "
+                        "trace IDs with root span and duration; follow up "
+                        "with the `trace` tool."),
+        "inputSchema": {
+            "type": "object",
+            "properties": {
+                "tags": {"type": "string"},
+                "minDuration": {"type": "string"},
+                "maxDuration": {"type": "string"},
+                "start": {"type": "integer"},
+                "end": {"type": "integer"},
+                "limit": {"type": "integer"},
+            },
+        },
+    },
+    {
+        "name": "list_metrics",
+        "description": "Every queryable PromQL metric name.",
+        "inputSchema": {"type": "object", "properties": {}},
+    },
+    {
         "name": "list_agents",
         "description": "List registered deepflow-tpu agents.",
         "inputSchema": {"type": "object", "properties": {}},
@@ -133,6 +179,26 @@ class McpServer:
             out = api.tpu_flame(args)["result"]
         elif name == "trace":
             out = api.trace(args)["result"]
+        elif name == "promql":
+            if (args.get("start") is None) != (args.get("end") is None):
+                raise ValueError(
+                    "promql: start and end must be given together")
+            if args.get("start") is not None and args.get("end") is not None:
+                out = api.prom_query_range({
+                    "query": args.get("query", ""),
+                    "start": args["start"], "end": args["end"],
+                    "step": args.get("step", 15)})
+            else:
+                p = {"query": args.get("query", "")}
+                if args.get("time") is not None:
+                    p["time"] = args["time"]
+                out = api.prom_query(p)
+        elif name == "search_traces":
+            out = api.tempo_search(
+                {k: str(v) for k, v in args.items() if v is not None})
+        elif name == "list_metrics":
+            from deepflow_tpu.query import promql as _promql
+            out = {"metrics": _promql.metric_names(api.db)}
         elif name == "list_agents":
             out = api.agents()
         elif name == "health":

@@ -315,13 +315,16 @@ class QuerierAPI:
         max_ns = (self._tempo_duration_ns(params["maxDuration"])
                   if params.get("maxDuration") else 0)
         where = ["trace_id != ''"]
-        if not params.get("start") and not params.get("end"):
-            # a bare search must not scan all history: recent-hour default
-            where.append(
-                f"time >= {(int(_time.time()) - 3600) * 1_000_000_000}")
+        # a search must ALWAYS have a lower bound (a bare or end-only
+        # request must not scan all history): default start is one hour
+        # before end (or before now)
         if params.get("start"):
-            where.append(
-                f"time >= {int(float(params['start'])) * 1_000_000_000}")
+            start_ts = int(float(params["start"]))
+        else:
+            ref = (int(float(params["end"])) if params.get("end")
+                   else int(_time.time()))
+            start_ts = ref - 3600
+        where.append(f"time >= {start_ts * 1_000_000_000}")
         if params.get("end"):
             where.append(
                 f"time < {int(float(params['end'])) * 1_000_000_000}")

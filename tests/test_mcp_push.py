@@ -46,6 +46,39 @@ def test_mcp_initialize_list_call():
         payload = json.loads(out["result"]["content"][0]["text"])
         assert payload["values"] == [[1.0]]
 
+        # promql tool: instant + range over seeded samples
+        now = int(time.time())
+        server.db.table("prometheus.samples").append_rows(
+            [{"time": now - 10, "metric_name": "mcp_up",
+              "labels_json": '{"job": "t"}', "value": 3.0}])
+        out = _rpc(server.query_port, "tools/call", {
+            "name": "promql",
+            "arguments": {"query": "mcp_up * 2", "time": now}})
+        payload = json.loads(out["result"]["content"][0]["text"])
+        assert payload["data"]["result"][0]["value"][1] == "6.0"
+        out = _rpc(server.query_port, "tools/call", {
+            "name": "promql",
+            "arguments": {"query": "mcp_up", "start": now - 60,
+                          "end": now, "step": 30}})
+        payload = json.loads(out["result"]["content"][0]["text"])
+        assert payload["data"]["resultType"] == "matrix"
+
+        # list_metrics + search_traces tools
+        out = _rpc(server.query_port, "tools/call",
+                   {"name": "list_metrics", "arguments": {}})
+        payload = json.loads(out["result"]["content"][0]["text"])
+        assert "mcp_up" in payload["metrics"]
+        server.db.table("flow_log.l7_flow_log").append_rows(
+            [{"time": (now - 5) * 1_000_000_000, "trace_id": "mcp-t",
+              "span_id": "s", "app_service": "svc", "request_type": "GET",
+              "endpoint": "/x", "response_duration": 1_000_000,
+              "response_code": 200, "l7_protocol": 1, "flow_id": 9}])
+        out = _rpc(server.query_port, "tools/call", {
+            "name": "search_traces",
+            "arguments": {"tags": "service.name=svc"}})
+        payload = json.loads(out["result"]["content"][0]["text"])
+        assert [t["traceID"] for t in payload["traces"]] == ["mcp-t"]
+
         # errors: unknown method / unknown tool / bad sql
         out = _rpc(server.query_port, "nope/nope")
         assert out["error"]["code"] == -32601

@@ -521,6 +521,16 @@ def test_metadata_api():
     vals = promql.label_values(
         db, "instance", ['conn_limit{zone="z1"}'], T0, T0 + 120)
     assert vals == ["a"]
+    # numeric tag labels resolve too (Grafana label_values(server_port))
+    t = db.table("flow_metrics.network.1s")
+    t.append_rows([{"time": T0, "byte_tx": 1, "ip_src": "1.1.1.1",
+                    "ip_dst": "2.2.2.2", "server_port": 8080,
+                    "protocol": 1, "host": "h9"}])
+    vals = promql.label_values(db, "server_port", [], T0 - 60, T0 + 120)
+    assert "8080" in vals
+    # time scoping: a range before the data sees nothing
+    assert promql.label_values(db, "server_port", [], 0, 100) == []
+    assert "http_requests_total" not in promql.metric_names(db, 0, 100)
 
 
 def test_metadata_http_endpoints():

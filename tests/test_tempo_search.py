@@ -87,6 +87,12 @@ def test_search_filters(server):
     # data is out of scope
     out = get(server, "/api/search", in_range=False)
     assert out["traces"] == []
+    # end-only search is ALSO bounded (end-1h), not a full-history scan
+    out = get(server, f"/api/search?end={END}", in_range=False)
+    assert {t["traceID"] for t in out["traces"]} == {"aaa", "bbb"}
+    far_end = END + 100 * 3600
+    out = get(server, f"/api/search?end={far_end}", in_range=False)
+    assert out["traces"] == []
 
 
 def test_tag_filter_keeps_trace_level_metadata(server):
@@ -115,6 +121,31 @@ def test_search_tags_and_values(server):
     assert {"200", "500"} <= set(out["tagValues"])
     out = get(server, "/api/search/tag/unknown/values")
     assert out["tagValues"] == []
+
+
+def test_dfctl_trace_search_and_promql(server, capsys):
+    from deepflow_tpu.cli import dfctl
+    addr = f"127.0.0.1:{server.query_port}"
+    rc = dfctl.main(["--server", addr, "trace-search",
+                     "--tags", "service.name=pay",
+                     "--start", str(START), "--end", str(END)])
+    out = capsys.readouterr().out
+    assert rc in (0, None)
+    assert "bbb" in out and "POST /charge" in out
+    # promql instant through the CLI
+    import time as _time
+    now = int(_time.time())
+    server.db.table("prometheus.samples").append_rows(
+        [{"time": now - 5, "metric_name": "cli_up",
+          "labels_json": "{}", "value": 1.0}])
+    rc = dfctl.main(["--server", addr, "promql", "cli_up + 1",
+                     "--time", str(now)])
+    out = capsys.readouterr().out
+    assert "2.0" in out
+    # half-open range is an explicit error, not a silent instant query
+    with pytest.raises(SystemExit):
+        dfctl.main(["--server", addr, "promql", "cli_up",
+                    "--start", str(now - 60)])
 
 
 def test_search_bad_tag_is_clean_error(server):
