@@ -41,6 +41,11 @@ class TpuProbeConfig:
 class FlowConfig:
     enabled: bool = False           # needs CAP_NET_RAW
     interface: str = ""             # "" = all interfaces
+    # local: this host's own traffic (self-ports excluded to break the
+    # telemetry feedback loop); mirror: a SPAN/mirror port carrying OTHER
+    # hosts' traffic (promiscuous, no self-port exclusion; tunnels are
+    # decapsulated either way)
+    capture_mode: str = "local"     # local | mirror
     exclude_ports: list = field(
         default_factory=lambda: [20033, 20035, 20416])
 
@@ -162,6 +167,15 @@ class AgentConfig:
             raise ValueError(
                 f"tpuprobe.source must be auto|xplane|hooks|sim, "
                 f"got {self.tpuprobe.source!r}")
+        if self.flow.capture_mode not in ("local", "mirror"):
+            raise ValueError(
+                f"flow.capture_mode must be local|mirror, "
+                f"got {self.flow.capture_mode!r}")
+        if self.flow.capture_mode == "mirror" and not self.flow.interface:
+            raise ValueError(
+                "flow.capture_mode=mirror needs flow.interface: "
+                "promiscuous mode is per-NIC, so 'all interfaces' would "
+                "silently capture only local traffic")
         for b, name in ((self.profiler.enabled, "profiler.enabled"),
                         (self.tpuprobe.enabled, "tpuprobe.enabled"),
                         (self.standalone, "standalone")):

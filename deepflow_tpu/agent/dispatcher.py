@@ -31,6 +31,8 @@ def flow_to_l4_pb(node: FlowNode) -> pb.L4FlowLog:
     f.key.port_dst = node.port_dst
     f.key.proto = node.protocol
     f.key.tap_port = node.tap_port
+    f.key.tunnel_type = node.tunnel_type
+    f.key.tunnel_id = node.tunnel_id
     f.start_time_ns = node.start_ns
     f.end_time_ns = node.end_ns
     f.packet_tx = node.tx.packets

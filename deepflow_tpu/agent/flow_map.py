@@ -70,6 +70,8 @@ class FlowNode:
     protocol: int
     start_ns: int
     tap_port: int = 0
+    tunnel_type: int = 0       # stripped outer tunnel (0 = none)
+    tunnel_id: int = 0
     end_ns: int = 0
     state: FlowState = FlowState.INIT
     tx: DirectionStats = field(default_factory=DirectionStats)  # client->srv
@@ -197,12 +199,14 @@ class FlowMap:
                 flow_id=fid, ip_src=p.ip_dst, ip_dst=p.ip_src,
                 port_src=p.port_dst, port_dst=p.port_src,
                 protocol=p.protocol, start_ns=p.timestamp_ns,
-                tap_port=p.tap_port)
+                tap_port=p.tap_port, tunnel_type=p.tunnel_type,
+                tunnel_id=p.tunnel_id)
         return FlowNode(
             flow_id=fid, ip_src=p.ip_src, ip_dst=p.ip_dst,
             port_src=p.port_src, port_dst=p.port_dst,
             protocol=p.protocol, start_ns=p.timestamp_ns,
-            tap_port=p.tap_port)
+            tap_port=p.tap_port, tunnel_type=p.tunnel_type,
+            tunnel_id=p.tunnel_id)
 
     def _evict_oldest(self) -> None:
         # pop stale heap entries until one matches a live, un-refreshed flow

@@ -30,9 +30,15 @@ ETH_P_ALL = 0x0003
 class LiveCapture:
     def __init__(self, dispatcher, interface: str = "",
                  exclude_ports: tuple = (20033, 20035, 20416),
-                 snaplen: int = 65535) -> None:
+                 snaplen: int = 65535, capture_mode: str = "local") -> None:
         self.dispatcher = dispatcher
         self.interface = interface  # "" = all interfaces
+        # mirror mode (reference: dispatcher mirror/analyzer modes): the
+        # NIC carries OTHER hosts' traffic (SPAN/mirror port) — go
+        # promiscuous. Port exclusions stay: a trunk mirror can include
+        # this host's own uplink, and the telemetry feedback loop they
+        # break exists there too.
+        self.capture_mode = capture_mode
         self.exclude_ports = frozenset(exclude_ports)
         self.snaplen = snaplen
         self._sock: socket.socket | None = None
@@ -50,6 +56,14 @@ class LiveCapture:
                           socket.htons(ETH_P_ALL))
         if self.interface:
             s.bind((self.interface, 0))
+            if self.capture_mode == "mirror":
+                try:  # struct packet_mreq: ifindex, PACKET_MR_PROMISC
+                    import struct as _struct
+                    idx = socket.if_nametoindex(self.interface)
+                    mreq = _struct.pack("iHH8s", idx, 1, 0, b"")
+                    s.setsockopt(263, 1, mreq)  # SOL_PACKET, ADD_MEMBERSHIP
+                except OSError as e:
+                    log.warning("promiscuous mode failed: %s", e)
         s.settimeout(0.5)
         self._sock = s
         self.mode = "socket"
@@ -72,11 +86,17 @@ class LiveCapture:
             return False
         for port in self.exclude_ports:
             nfm.exclude_port(port)
+        if self.capture_mode == "mirror" and self.interface:
+            if not self._ring.promisc(self.interface):
+                log.warning("promiscuous mode failed on %r; mirror "
+                            "capture sees only local traffic",
+                            self.interface)
         self.mode = "ring"
         self._thread = threading.Thread(
             target=self._run_ring, name="df-live-capture", daemon=True)
         self._thread.start()
-        log.info("live capture (TPACKET_V3 ring) on %r (excluding ports %s)",
+        log.info("live capture (TPACKET_V3 ring, %s mode) on %r "
+                 "(excluding ports %s)", self.capture_mode,
                  self.interface or "all", sorted(self.exclude_ports))
         return True
 

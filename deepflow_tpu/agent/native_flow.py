@@ -224,7 +224,9 @@ class NativeFlowMap:
                 ip_src=int(r["ip_src"]).to_bytes(4, "big"),
                 ip_dst=int(r["ip_dst"]).to_bytes(4, "big"),
                 port_src=int(r["port_src"]), port_dst=int(r["port_dst"]),
-                protocol=int(r["protocol"]), start_ns=int(r["start_ns"]))
+                protocol=int(r["protocol"]), start_ns=int(r["start_ns"]),
+                tunnel_type=int(r["tunnel_type"]),
+                tunnel_id=int(r["tunnel_id"]))
         else:
             # flush unanswered requests through the session logic
             while node.pending:
@@ -233,6 +235,8 @@ class NativeFlowMap:
                                     old.timestamp_ns, 0)
             node.pending_by_id.clear()
         node.start_ns = int(r["start_ns"])
+        node.tunnel_type = int(r["tunnel_type"])
+        node.tunnel_id = int(r["tunnel_id"])
         node.end_ns = int(r["end_ns"])
         node.state = FlowState(int(r["state"]))
         node.close_type = _CLOSE_TYPES.get(int(r["close_type"]), "unknown")
@@ -293,7 +297,9 @@ class NativeFlowMap:
             ip_src=int(r["ip_src"]).to_bytes(4, "big"),
             ip_dst=int(r["ip_dst"]).to_bytes(4, "big"),
             port_src=int(r["port_src"]), port_dst=int(r["port_dst"]),
-            protocol=int(r["protocol"]), start_ns=int(r["start_ns"]))
+            protocol=int(r["protocol"]), start_ns=int(r["start_ns"]),
+            tunnel_type=int(r["tunnel_type"]),
+            tunnel_id=int(r["tunnel_id"]))
         node.end_ns = int(r["end_ns"])
         node.tx = DirectionStats(
             packets=int(r["tx_packets"]), bytes=int(r["tx_bytes"]),
@@ -390,6 +396,11 @@ class NativeRing:
 
     def drops(self) -> int:
         return int(self._lib.df_ring_drops(self._h))
+
+    def promisc(self, interface: str, on: bool = True) -> bool:
+        """Promiscuous mode (mirror/SPAN ports see other hosts' frames)."""
+        return self._lib.df_ring_promisc(
+            self._h, interface.encode(), 1 if on else 0) == 0
 
     def close(self) -> None:
         if getattr(self, "_h", None):

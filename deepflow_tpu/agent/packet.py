@@ -43,16 +43,23 @@ class MetaPacket:
     # uprobe-source extras (sslprobe): thread-scoped chain id + tid
     syscall_trace_id: int = 0
     tid: int = 0
+    # tunnel decapsulation (reference: common/decapsulate.rs): when an
+    # outer VXLAN/GENEVE/GRE/ERSPAN layer was stripped, the 5-tuple above
+    # is the INNER packet's and these record the tunnel
+    tunnel_type: int = 0         # 0 none, 1 vxlan, 2 geneve, 3 erspan,
+    tunnel_id: int = 0           # 4 gre-teb; VNI / session id / GRE key
 
     @property
     def key(self) -> tuple:
+        # tunnel identity is part of flow identity: overlapping tenant IP
+        # space across VNIs must not merge into one flow
         return (self.ip_src, self.ip_dst, self.port_src, self.port_dst,
-                self.protocol)
+                self.protocol, self.tunnel_type, self.tunnel_id)
 
     @property
     def reverse_key(self) -> tuple:
         return (self.ip_dst, self.ip_src, self.port_dst, self.port_src,
-                self.protocol)
+                self.protocol, self.tunnel_type, self.tunnel_id)
 
 
 ETH_IPV4 = 0x0800
@@ -60,8 +67,11 @@ ETH_IPV6 = 0x86DD
 
 
 def decode_ethernet(frame: bytes, timestamp_ns: int = 0,
-                    tap_port: int = 0) -> MetaPacket | None:
-    """Ethernet II -> IPv4/IPv6 -> TCP/UDP/ICMP header decode."""
+                    tap_port: int = 0,
+                    _depth: int = 0) -> MetaPacket | None:
+    """Ethernet II -> IPv4/IPv6 -> TCP/UDP/ICMP header decode, with
+    VXLAN/GENEVE/GRE/ERSPAN decapsulation (one nesting level, matching
+    the native fast path)."""
     if len(frame) < 14:
         return None
     eth_type = struct.unpack_from(">H", frame, 12)[0]
@@ -70,14 +80,75 @@ def decode_ethernet(frame: bytes, timestamp_ns: int = 0,
         eth_type = struct.unpack_from(">H", frame, 16)[0]
         off = 18
     if eth_type == ETH_IPV4:
-        return _decode_ipv4(frame, off, timestamp_ns, tap_port, len(frame))
+        return _decode_ipv4(frame, off, timestamp_ns, tap_port, len(frame),
+                            _depth)
     if eth_type == ETH_IPV6:
         return _decode_ipv6(frame, off, timestamp_ns, tap_port, len(frame))
     return None
 
 
+def _decap(frame: bytes, inner_off: int, ttype: int, tid: int, ts: int,
+           tap: int, depth: int) -> MetaPacket | None:
+    if depth >= 2:
+        return None
+    inner = decode_ethernet(frame[inner_off:], ts, tap, _depth=depth + 1)
+    if inner is None:
+        return None
+    if inner.tunnel_type == 0:  # innermost tunnel wins the stamp
+        inner.tunnel_type = ttype
+        inner.tunnel_id = tid
+    return inner
+
+
+def _try_decap_udp(frame: bytes, pay: int, end: int, dport: int, ts: int,
+                   tap: int, depth: int) -> MetaPacket | None:
+    # VXLAN (RFC 7348): 8-byte header, I-flag validates the VNI
+    if dport == 4789 and end >= pay + 8 and frame[pay] & 0x08:
+        vni = int.from_bytes(frame[pay + 4:pay + 7], "big")
+        return _decap(frame, pay + 8, 1, vni, ts, tap, depth)
+    # GENEVE (RFC 8926): options + inner proto Transparent Eth Bridging
+    if dport == 6081 and end >= pay + 8:
+        optlen = (frame[pay] & 0x3F) * 4
+        inner_proto = struct.unpack_from(">H", frame, pay + 2)[0]
+        vni = int.from_bytes(frame[pay + 4:pay + 7], "big")
+        if inner_proto == 0x6558:
+            return _decap(frame, pay + 8 + optlen, 2, vni, ts, tap, depth)
+    return None
+
+
+def _try_decap_gre(frame: bytes, l4: int, end: int, ts: int, tap: int,
+                   depth: int) -> MetaPacket | None:
+    if end < l4 + 4:
+        return None
+    flags, gre_proto = struct.unpack_from(">HH", frame, l4)
+    gh = l4 + 4
+    if flags & 0x8000:
+        gh += 4  # checksum + reserved
+    key = 0
+    if flags & 0x2000:
+        if end < gh + 4:
+            return None
+        key = struct.unpack_from(">I", frame, gh)[0]
+        gh += 4
+    has_seq = bool(flags & 0x1000)
+    if has_seq:
+        gh += 4
+    if gre_proto == 0x88BE:  # ERSPAN: II has an 8B header (seq bit), I none
+        sess = (struct.unpack_from(">H", frame, gh + 2)[0] & 0x03FF
+                if has_seq and end >= gh + 4 else 0)
+        return _decap(frame, gh + (8 if has_seq else 0), 3, sess, ts, tap,
+                      depth)
+    if gre_proto == 0x22EB:  # ERSPAN III: 12B header
+        sess = (struct.unpack_from(">H", frame, gh + 2)[0] & 0x03FF
+                if end >= gh + 4 else 0)
+        return _decap(frame, gh + 12, 3, sess, ts, tap, depth)
+    if gre_proto == 0x6558:  # transparent ethernet bridging
+        return _decap(frame, gh, 4, key, ts, tap, depth)
+    return None
+
+
 def _decode_ipv4(frame: bytes, off: int, ts: int, tap: int,
-                 wire_len: int) -> MetaPacket | None:
+                 wire_len: int, depth: int = 0) -> MetaPacket | None:
     if len(frame) < off + 20:
         return None
     ver_ihl = frame[off]
@@ -88,6 +159,17 @@ def _decode_ipv4(frame: bytes, off: int, ts: int, tap: int,
     ip_dst = frame[off + 16:off + 20]
     l4_off = off + ihl
     end = min(len(frame), off + total_len)
+    if proto == 47:  # GRE / ERSPAN
+        inner = _try_decap_gre(frame, l4_off, end, ts, tap, depth)
+        if inner is not None:
+            return inner
+        return None  # plain GRE payloads are not flow material
+    if proto == 17 and end >= l4_off + 8:
+        dport = struct.unpack_from(">H", frame, l4_off + 2)[0]
+        inner = _try_decap_udp(frame, l4_off + 8, end, dport, ts, tap,
+                               depth)
+        if inner is not None:
+            return inner
     return _decode_l4(frame, l4_off, end, proto, ip_src, ip_dst, ts, tap,
                       wire_len)
 
@@ -213,7 +295,7 @@ def _decode_chunk(raw, decoded, out: list) -> None:
     cols = {name: recs[name].tolist() for name in
             ("ip_src", "ip_dst", "port_src", "port_dst", "protocol",
              "tcp_flags", "window", "seq", "ack", "payload_off",
-             "payload_len")}
+             "payload_len", "tunnel_type", "tunnel_id")}
     ok_l = ok.tolist()
     for i, (data, ts_ns, orig) in enumerate(raw):
         if ok_l[i]:
@@ -228,7 +310,9 @@ def _decode_chunk(raw, decoded, out: list) -> None:
                 protocol=cols["protocol"][i],
                 tcp_flags=cols["tcp_flags"][i], seq=cols["seq"][i],
                 ack=cols["ack"][i], window=cols["window"][i],
-                payload=data[po:po + pl], packet_len=orig))
+                payload=data[po:po + pl], packet_len=orig,
+                tunnel_type=cols["tunnel_type"][i],
+                tunnel_id=cols["tunnel_id"][i]))
         else:  # v6 / vlan / odd frames: Python slow path
             mp = decode_ethernet(data, timestamp_ns=ts_ns)
             if mp is not None:

@@ -212,8 +212,10 @@ class SslProbeListener:
         local, peer = laddr[:alen], paddr[:alen]
         fm = self.dispatcher.flow_map
         with self.dispatcher._lock:  # flush thread iterates fm.flows
-            for key in ((local, peer, lport, pport, 1),
-                        (peer, local, pport, lport, 1)):
+            # keys carry tunnel identity (always 0 for uprobe sources) —
+            # must match MetaPacket.key's shape exactly
+            for key in ((local, peer, lport, pport, 1, 0, 0),
+                        (peer, local, pport, lport, 1, 0, 0)):
                 node = fm.flows.pop(key, None)
                 if node is not None:
                     # silently discard: it held only undecryptable records

@@ -112,6 +112,9 @@ def load():
         ctypes.POINTER(ctypes.c_uint32)]
     lib.df_ring_drops.restype = ctypes.c_uint64
     lib.df_ring_drops.argtypes = [ctypes.c_void_p]
+    lib.df_ring_promisc.restype = ctypes.c_int32
+    lib.df_ring_promisc.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_int32]
     _lib = lib
     return lib
 
@@ -130,7 +133,8 @@ FLOW_RECORD_DTYPE = np.dtype([
     ("tx_zero_window", np.uint32), ("rx_zero_window", np.uint32),
     ("tx_flags_bits", np.uint8), ("rx_flags_bits", np.uint8),
     ("syn_count", np.uint16), ("synack_count", np.uint16),
-    ("rtt_us", np.uint32)])
+    ("rtt_us", np.uint32),
+    ("tunnel_type", np.uint8), ("tunnel_id", np.uint32)])
 
 # must match #pragma pack(1) struct SlowEvent in flowmap.cpp
 SLOW_EVENT_DTYPE = np.dtype([
@@ -145,13 +149,15 @@ L7_EVENT_DTYPE = np.dtype([
     ("port_src", np.uint16), ("port_dst", np.uint16)])
 
 
-# packet record layout must match struct DfPacketOut in dfnative.cpp
+# packet record layout must match struct DfPacketOut in dfpacket.h
 PACKET_DTYPE = np.dtype([
     ("ip_src", np.uint32), ("ip_dst", np.uint32),
     ("port_src", np.uint16), ("port_dst", np.uint16),
     ("protocol", np.uint8), ("tcp_flags", np.uint8),
     ("window", np.uint16), ("seq", np.uint32), ("ack", np.uint32),
-    ("payload_off", np.uint32), ("payload_len", np.uint32)], align=True)
+    ("payload_off", np.uint32), ("payload_len", np.uint32),
+    ("tunnel_type", np.uint8), ("_pad", np.uint8, (3,)),
+    ("tunnel_id", np.uint32)], align=True)
 
 
 def decode_eth_batch(frames: list[bytes]):

@@ -28,20 +28,7 @@
 #include <linux/if_ether.h>
 #include <linux/if_packet.h>
 
-// from dfnative.cpp
-struct DfPacketOut {
-    uint32_t ip_src;
-    uint32_t ip_dst;
-    uint16_t port_src;
-    uint16_t port_dst;
-    uint8_t  protocol;   // 1 tcp, 2 udp, 3 icmp
-    uint8_t  tcp_flags;
-    uint16_t window;
-    uint32_t seq;
-    uint32_t ack;
-    uint32_t payload_off;
-    uint32_t payload_len;
-};
+#include "dfpacket.h"
 extern "C" int32_t df_decode_eth(const uint8_t* data, uint32_t len,
                                  DfPacketOut* out);
 
@@ -54,25 +41,32 @@ namespace {
 struct FlowKey {
     uint64_t a;  // ip_src << 32 | ip_dst
     uint64_t b;  // port_src << 32 | port_dst << 16 | proto
-    bool operator==(const FlowKey& o) const { return a == o.a && b == o.b; }
+    uint64_t c;  // tunnel_type << 32 | tunnel_id — overlapping tenant IP
+                 // space across VNIs must NOT merge into one flow
+    bool operator==(const FlowKey& o) const {
+        return a == o.a && b == o.b && c == o.c;
+    }
 };
 
 static inline FlowKey make_key(const DfPacketOut& p) {
     return FlowKey{(uint64_t)p.ip_src << 32 | p.ip_dst,
                    (uint64_t)p.port_src << 32 |
-                       (uint64_t)p.port_dst << 16 | p.protocol};
+                       (uint64_t)p.port_dst << 16 | p.protocol,
+                   (uint64_t)p.tunnel_type << 32 | p.tunnel_id};
 }
 
 static inline FlowKey reverse_key(const FlowKey& k) {
     return FlowKey{(k.a << 32) | (k.a >> 32),
                    ((k.b >> 32) & 0xFFFF) << 16 |
-                       ((k.b >> 16) & 0xFFFF) << 32 | (k.b & 0xFF)};
+                       ((k.b >> 16) & 0xFFFF) << 32 | (k.b & 0xFF),
+                   k.c};
 }
 
 struct KeyHash {
     size_t operator()(const FlowKey& k) const {
         uint64_t x = k.a * 0x9E3779B97F4A7C15ULL;
         x ^= (k.b + 0xBF58476D1CE4E5B9ULL) * 0x94D049BB133111EBULL;
+        x ^= (k.c + 0xD6E8FEB86659FD93ULL) * 0xFF51AFD7ED558CCDULL;
         x ^= x >> 31;
         return (size_t)x;
     }
@@ -132,6 +126,8 @@ struct FlowRecord {
     uint8_t tx_flags_bits, rx_flags_bits;
     uint16_t syn_count, synack_count;
     uint32_t rtt_us;
+    uint8_t tunnel_type;
+    uint32_t tunnel_id;
 };
 
 // Must match SLOW_EVENT_DTYPE in native/__init__.py: a frame the v4 fast
@@ -229,6 +225,8 @@ static void fill_record(const Flow& f, uint8_t closed_flag, FlowRecord* r) {
     r->syn_count = f.syn_count;
     r->synack_count = f.synack_count;
     r->rtt_us = f.rtt_us;
+    r->tunnel_type = (uint8_t)(f.key.c >> 32);
+    r->tunnel_id = (uint32_t)f.key.c;
 }
 
 static void close_flow(DfFlowMap* fm, Flow& f) {
@@ -590,6 +588,20 @@ void df_ring_close(DfRing* r) {
     if (r->map) munmap(r->map, r->map_len);
     if (r->fd >= 0) close(r->fd);
     delete r;
+}
+
+// Promiscuous mode for mirror/SPAN capture: the NIC must accept frames
+// addressed to the mirrored hosts, not just to us. Returns 0 on success.
+int32_t df_ring_promisc(DfRing* r, const char* ifname, int32_t on) {
+    if (!r || !ifname || !ifname[0]) return -1;
+    unsigned idx = if_nametoindex(ifname);
+    if (!idx) return -1;
+    packet_mreq mr{};
+    mr.mr_ifindex = (int)idx;
+    mr.mr_type = PACKET_MR_PROMISC;
+    int opt = on ? PACKET_ADD_MEMBERSHIP : PACKET_DROP_MEMBERSHIP;
+    return setsockopt(r->fd, SOL_PACKET, opt, &mr, sizeof(mr)) < 0
+        ? -1 : 0;
 }
 
 // Poll for ready blocks and inject frames straight into the flow map.

@@ -254,6 +254,8 @@ class FlowLogDecoder(Decoder):
                     "zero_win_tx": f.zero_win_tx, "zero_win_rx": f.zero_win_rx,
                     "close_type": _close_type_idx(f.close_type),
                     "syn_count": f.syn_count, "synack_count": f.synack_count,
+                    "tunnel_type": min(int(f.key.tunnel_type), 4),
+                    "tunnel_id": f.key.tunnel_id,
                     "gprocess_id_0": f.gpid_0 or self._gpid(
                         f.key.ip_src, f.key.port_src, int(f.key.proto)),
                     "gprocess_id_1": f.gpid_1 or self._gpid(

@@ -117,6 +117,8 @@ _table("flow_log.l4_flow_log", [
     C("close_type", "enum", CLOSE_TYPES),
     C("syn_count", "u32"),
     C("synack_count", "u32"),
+    C("tunnel_type", "enum", ["none", "vxlan", "geneve", "erspan", "gre"]),
+    C("tunnel_id", "u32"),
     C("gprocess_id_0", "u32"),
     C("gprocess_id_1", "u32"),
     C("pod_0", "str"),              # K8s genesis: resource at ip_src
