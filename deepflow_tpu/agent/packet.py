@@ -97,6 +97,9 @@ def _decap(frame: bytes, inner_off: int, ttype: int, tid: int, ts: int,
     if inner.tunnel_type == 0:  # innermost tunnel wins the stamp
         inner.tunnel_type = ttype
         inner.tunnel_id = tid
+    # byte metrics count WIRE bytes: the outer frame's length, including
+    # the overlay headers (matches the native fast path)
+    inner.packet_len = len(frame)
     return inner
 
 

@@ -164,12 +164,15 @@ static int32_t decode_frame(const uint8_t* data, uint32_t len,
         if (end < l4 + 8) return 0;
         uint16_t dport = rd16(data + l4 + 2);
         uint32_t pay = l4 + 8;
-        // VXLAN (RFC 7348): 8-byte header, I-flag bit validates the VNI
+        // VXLAN (RFC 7348): 8-byte header, I-flag bit validates the VNI.
+        // A recognized tunnel whose inner frame the fast path can't decode
+        // (v6 inner, nested vlan) must go to the Python slow path — NOT be
+        // reported as the outer VTEP UDP flow, which would merge every
+        // tenant into one flow
         if (dport == 4789 && end >= pay + 8 && (data[pay] & 0x08)) {
             uint32_t vni = ((uint32_t)data[pay + 4] << 16) |
                            ((uint32_t)data[pay + 5] << 8) | data[pay + 6];
-            if (try_decap_eth(data, end, pay + 8, 1, vni, out, depth))
-                return 1;
+            return try_decap_eth(data, end, pay + 8, 1, vni, out, depth);
         }
         // GENEVE (RFC 8926): variable options, inner proto must be
         // Transparent Ethernet Bridging
@@ -178,10 +181,9 @@ static int32_t decode_frame(const uint8_t* data, uint32_t len,
             uint16_t inner_proto = rd16(data + pay + 2);
             uint32_t vni = ((uint32_t)data[pay + 4] << 16) |
                            ((uint32_t)data[pay + 5] << 8) | data[pay + 6];
-            if (inner_proto == 0x6558 &&
-                try_decap_eth(data, end, pay + 8 + optlen, 2, vni, out,
-                              depth))
-                return 1;
+            if (inner_proto == 0x6558)
+                return try_decap_eth(data, end, pay + 8 + optlen, 2, vni,
+                                     out, depth);
         }
         out->protocol = 2;
         out->port_src = rd16(data + l4);

@@ -393,9 +393,24 @@ class StatsDecoder(Decoder):
 
 
 class EventDecoder(Decoder):
-    """EventBatch -> event.event."""
+    """EventBatch -> event.event, plus the file-IO aggregation reducer
+    (reference: ingester/event/decoder/file_agg_reducer.go): raw
+    file-io-read/write events roll up into per-(pid, path, op) minute
+    windows in event.file_agg."""
 
     MSG_TYPE = MessageType.EVENT
+
+    WINDOW_NS = 60 * 1_000_000_000
+    GRACE_NS = 5 * 1_000_000_000
+
+    def __init__(self, *a, **kw) -> None:
+        super().__init__(*a, **kw)
+        # (window_ns, pid, path, op, tags_json) -> [count, bytes, max, sum]
+        # guarded by _agg_lock: this decoder is stateful, so the base
+        # class's WORKERS>1 knob must not corrupt the windows
+        self._agg: dict[tuple, list] = {}
+        self._agg_lock = threading.Lock()
+        self._watermark = 0
 
     def handle(self, header: FrameHeader, payload: bytes) -> int:
         batch = pb.EventBatch.FromString(payload)
@@ -411,7 +426,56 @@ class EventDecoder(Decoder):
             **tags,
         } for e in batch.events]
         self.write("event.event", rows)
+        for e in batch.events:
+            if e.event_type in ("file-io-read", "file-io-write"):
+                self._reduce_file_io(e, tags)
+        self._flush_agg()
         return len(rows)
+
+    def _reduce_file_io(self, e, tags: dict) -> None:
+        op = 0 if e.event_type == "file-io-read" else 1
+        window = e.timestamp_ns - e.timestamp_ns % self.WINDOW_NS
+        try:
+            latency = int(e.attrs.get("latency_ns", "0"))
+            nbytes = int(e.attrs.get("bytes", "0"))
+        except ValueError:
+            latency = nbytes = 0
+        key = (window, e.pid, e.resource_name, op,
+               json.dumps(tags, sort_keys=True))
+        with self._agg_lock:
+            acc = self._agg.get(key)
+            if acc is None:
+                acc = self._agg[key] = [0, 0, 0, 0]
+            acc[0] += 1
+            acc[1] += nbytes
+            acc[2] = max(acc[2], latency)
+            acc[3] += latency
+            if e.timestamp_ns > self._watermark:
+                self._watermark = e.timestamp_ns
+
+    def _flush_agg(self, force: bool = False) -> None:
+        """Emit windows the watermark has passed (late events within the
+        grace period still merge; anything later starts a fresh row —
+        counts stay correct, the window just splits)."""
+        rows = []
+        with self._agg_lock:
+            limit = self._watermark - self.WINDOW_NS - self.GRACE_NS
+            for key in [k for k in self._agg
+                        if force or k[0] <= limit]:
+                window, pid, path, op, tags_json = key
+                count, nbytes, mx, total = self._agg.pop(key)
+                rows.append({
+                    "time": window, "pid": pid, "path": path, "op": op,
+                    "count": count, "bytes": nbytes,
+                    "max_latency_ns": mx, "sum_latency_ns": total,
+                    **json.loads(tags_json),
+                })
+        if rows:
+            self.write("event.file_agg", rows)
+
+    def flush(self) -> None:
+        """Final flush (server shutdown / tests)."""
+        self._flush_agg(force=True)
 
 
 def _ip_str(raw: bytes) -> str:

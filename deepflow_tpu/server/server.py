@@ -177,6 +177,10 @@ class Server:
         self.receiver.stop()
         for d in self.decoders:
             d.stop()
+            if hasattr(d, "flush"):
+                d.flush()  # stateful reducers drain pending windows
+                # BEFORE the db persists (the file_agg tail otherwise
+                # vanishes on every restart)
         self.http.stop()
         self._stop_singletons()
         self.alerts.stop()

@@ -229,6 +229,21 @@ _table("event.event", [
     *UNIVERSAL_TAGS,
 ])
 
+# windowed file-IO aggregation (reference: ingester/event/dbwriter/
+# file_agg_event.go + decoder/file_agg_reducer.go): per (pid, path, op)
+# minute rollups of the raw file-io events
+_table("event.file_agg", [
+    C("time", "u64"),                   # window start ns
+    C("pid", "u32"),
+    C("path", "str"),
+    C("op", "enum", ["read", "write"]),
+    C("count", "u64"),
+    C("bytes", "u64"),
+    C("max_latency_ns", "u64"),
+    C("sum_latency_ns", "u64"),
+    *UNIVERSAL_TAGS,
+])
+
 # -- prometheus remote-write samples ---------------------------------------
 # reference: server/ingester/prometheus (label->ID SmartEncoding); here the
 # label set is dictionary-encoded as one canonical json string per series

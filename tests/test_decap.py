@@ -114,6 +114,39 @@ def test_native_decap(name, frame, ttype, tid):
     assert r["tunnel_id"] == tid, name
 
 
+def ipv6(next_header: int, src: bytes, dst: bytes, payload: bytes) -> bytes:
+    return struct.pack(">IHBB16s16s", 6 << 28, len(payload), next_header,
+                       64, src, dst) + payload
+
+
+def test_inner_ipv6_defers_to_python_and_decaps():
+    """VXLAN with an IPv6 inner frame: the native fast path must NOT
+    report the outer VTEP UDP flow (merging all tenants) — it defers to
+    the Python slow path, which decapsulates the v6 inner."""
+    inner6 = eth(0x86DD, ipv6(6, b"\x20\x01" + b"\x00" * 13 + b"\x01",
+                              b"\x20\x01" + b"\x00" * 13 + b"\x02",
+                              tcp(50000, 443, b"v6-inner")))
+    hdr = struct.pack(">BBHI", 0x08, 0, 0, 66 << 8)
+    frame = eth(0x0800, ipv4(17, bytes([172, 16, 0, 1]),
+                             bytes([172, 16, 0, 2]),
+                             udp(49152, 4789, hdr + inner6)))
+    if native.load() is not None:
+        out, ok = native.decode_eth_batch([frame])
+        assert not ok[0], "native must defer inner-v6 tunnels"
+    mp = decode_ethernet(frame, 1)
+    assert mp is not None and mp.protocol == 1
+    assert mp.tunnel_type == 1 and mp.tunnel_id == 66
+    assert mp.port_dst == 443 and len(mp.ip_dst) == 16
+
+
+def test_decap_packet_len_is_outer_wire_length():
+    """Byte metrics count wire bytes: the Python decap path must report
+    the OUTER frame length, matching the native path."""
+    frame = vxlan_frame()
+    mp = decode_ethernet(frame, 1)
+    assert mp.packet_len == len(frame)
+
+
 def test_non_tunnel_udp_unchanged():
     plain = eth(0x0800, ipv4(17, bytes([10, 0, 0, 1]), bytes([10, 0, 0, 2]),
                              udp(1111, 2222, b"dns-ish")))
