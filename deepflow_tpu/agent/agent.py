@@ -118,6 +118,20 @@ class Agent:
                     self.extprofilers.append(ep)
                     if f"extprof-{pid}" not in self._components:
                         self._components.append(f"extprof-{pid}")
+                    if self.config.profiler.external_offcpu:
+                        from deepflow_tpu.agent.extprofiler import \
+                            OffCpuProfiler
+                        op = OffCpuProfiler(
+                            None, pid=int(pid),
+                            window_s=self.config.profiler.emit_interval_s)
+                        op.sink = functools.partial(
+                            self._profile_sink,
+                            process_name=op.process_name,
+                            app_service=op.app_service)
+                        op.start()
+                        self.extprofilers.append(op)
+                        if f"offcpu-{pid}" not in self._components:
+                            self._components.append(f"offcpu-{pid}")
                 except (OSError, RuntimeError, ImportError,
                         AttributeError) as e:
                     # AttributeError: stale libdfnative.so without the
@@ -190,6 +204,7 @@ class Agent:
                     self.dispatcher,
                     interface=self.config.flow.interface,
                     exclude_ports=tuple(exclude),
+                    capture_mode=self.config.flow.capture_mode,
                 ).start()
                 self._components.append("live-capture")
             except (OSError, AttributeError) as e:

@@ -23,6 +23,10 @@ class ProfilerConfig:
     # out-of-process perf_event_open targets (ANY pid, not just Python);
     # needs CAP_PERFMON or perf_event_paranoid <= 2 with same-user targets
     external_pids: list = field(default_factory=list)
+    # additionally profile external_pids OFF-CPU (blocked + runqueue wait
+    # flame graphs from context-switch events; needs kernel-context perf,
+    # perf_event_paranoid <= 1 or CAP_PERFMON)
+    external_offcpu: bool = False
 
 
 @dataclass

@@ -242,6 +242,151 @@ class Symbolizer:
         return f"{base}+{addr - m.bias:#x}"
 
 
+class OffCpuProfiler:
+    """Out-of-process OffCPU profiler: blocked-time flame graphs for any
+    pid (reference: the OffCPU profiler of user/extended/extended.h over
+    perf_profiler.bpf.c). Context-switch events sample the blocking
+    callchain at switch-out; PERF_RECORD_SWITCH markers time the
+    switch-in; the native side aggregates blocked nanoseconds per chain.
+    FP chains only (a stack dump per context switch would swamp the
+    rings). Accounting happens at WAKE time, so off-CPU time includes
+    runqueue wait (the standard definition) and a thread blocked for the
+    entire window contributes only once it resumes — the same tail
+    behavior as BPF offcputime tools."""
+
+    ADDR_CAP = 1 << 18
+    STACK_CAP = 8192
+
+    def __init__(self, sink, pid: int, window_s: float = 1.0,
+                 min_block_us: float = 10.0, process_name: str = "",
+                 app_service: str = "") -> None:
+        lib = native.load()
+        if lib is None:
+            raise RuntimeError("libdfnative.so unavailable")
+        self._bind(lib)
+        self._lib = lib
+        self.sink = sink
+        self.pid = pid
+        self.window_s = window_s
+        self.min_block_us = min_block_us
+        self.process_name = process_name or ExternalProfiler._comm(pid)
+        self.app_service = app_service or self.process_name
+        self.stats = SamplerStats()
+        self.lost = 0
+        self.switches = 0
+        self.paired = 0
+        self._h = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._sym = Symbolizer(pid)
+        self._addrs = np.zeros(self.ADDR_CAP, dtype=np.uint64)
+        self._lens = np.zeros(self.STACK_CAP, dtype=np.uint16)
+        self._tids = np.zeros(self.STACK_CAP, dtype=np.uint32)
+        self._values = np.zeros(self.STACK_CAP, dtype=np.uint64)
+        self._counts = np.zeros(self.STACK_CAP, dtype=np.uint32)
+
+    @staticmethod
+    def _bind(lib) -> None:
+        if getattr(lib, "_df_offcpu_bound", False):
+            return
+        lib.df_offcpu_open.restype = ctypes.c_void_p
+        lib.df_offcpu_open.argtypes = [
+            ctypes.c_int32, ctypes.c_uint32, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_int32)]
+        lib.df_offcpu_close.argtypes = [ctypes.c_void_p]
+        lib.df_offcpu_poll.restype = ctypes.c_uint64
+        lib.df_offcpu_poll.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.df_offcpu_export.restype = ctypes.c_uint32
+        lib.df_offcpu_export.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint32,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_uint32]
+        lib.df_offcpu_stats.argtypes = [ctypes.c_void_p,
+                                        np.ctypeslib.ndpointer(np.uint64)]
+        lib._df_offcpu_bound = True
+
+    def start(self) -> "OffCpuProfiler":
+        err = ctypes.c_int32(0)
+        self._h = self._lib.df_offcpu_open(
+            self.pid, 64, int(self.min_block_us * 1000), ctypes.byref(err))
+        if not self._h:
+            raise OSError(err.value, os.strerror(err.value),
+                          f"offcpu perf_event_open pid={self.pid}")
+        self._thread = threading.Thread(
+            target=self._run, name=f"df-offcpu-{self.pid}", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=3.0)
+            if self._thread.is_alive():
+                log.warning("offcpu worker did not exit; leaking handle "
+                            "for pid %d", self.pid)
+                return
+        self._emit()
+        if self._h:
+            self._lib.df_offcpu_close(self._h)
+            self._h = None
+
+    def _run(self) -> None:
+        next_emit = time.monotonic() + self.window_s
+        while not self._stop.is_set():
+            try:
+                self._lib.df_offcpu_poll(self._h, 200)
+            except Exception:
+                log.exception("offcpu poll failed")
+                return
+            if time.monotonic() >= next_emit:
+                next_emit = time.monotonic() + self.window_s
+                try:
+                    self._emit()
+                except Exception:
+                    log.exception("offcpu emit failed")
+
+    def _emit(self) -> None:
+        if not self._h:
+            return
+        self._lib.df_offcpu_poll(self._h, 0)
+        n = self._lib.df_offcpu_export(
+            self._h, self._addrs.ctypes.data_as(ctypes.c_void_p),
+            self.ADDR_CAP, self._lens.ctypes.data_as(ctypes.c_void_p),
+            self._tids.ctypes.data_as(ctypes.c_void_p),
+            self._values.ctypes.data_as(ctypes.c_void_p),
+            self._counts.ctypes.data_as(ctypes.c_void_p), self.STACK_CAP)
+        if n == 0:
+            return
+        self._sym.refresh()
+        ts = time.time_ns()
+        batch = []
+        off = 0
+        for i in range(n):
+            ln = int(self._lens[i])
+            chain = self._addrs[off:off + ln]
+            off += ln
+            frames = [self._sym.resolve(int(a)) for a in chain[::-1]]
+            count = int(self._counts[i])
+            batch.append(ProfileSample(
+                timestamp_ns=ts, pid=self.pid, tid=int(self._tids[i]),
+                thread_name=str(int(self._tids[i])),
+                stack=";".join(frames), count=count,
+                value_us=int(self._values[i]) // 1000,  # blocked time
+                event_type="off-cpu", profiler="perf"))
+            self.stats.samples += count
+        self.stats.emits += 1
+        self.stats.last_emit_stacks = len(batch)
+        st = np.zeros(7, dtype=np.uint64)  # df_offcpu_stats writes SEVEN
+        self._lib.df_offcpu_stats(self._h, st)
+        self.lost = int(st[1])
+        self.switches = int(st[0])
+        self.paired = int(st[5])
+        try:
+            self.sink(batch)
+        except Exception:
+            pass
+
+
 _TABLE_CACHE: dict = {}  # path -> UnwindTable | None (immutable, shared)
 _TABLE_LOCK = threading.Lock()
 

@@ -41,6 +41,15 @@ def load():
     except OSError as e:
         log.debug("dfnative load failed: %s", e)
         return None
+    # stale-.so guard: a previously built lib without the newest symbols
+    # would be called with mismatched signatures/dtypes (silent corruption,
+    # not a clean error) — probe the newest symbol and refuse the whole lib
+    try:
+        lib.df_offcpu_open
+    except AttributeError:
+        log.warning("libdfnative.so is stale (missing df_offcpu_open); "
+                    "rebuild failed? falling back to pure Python")
+        return None
     lib.df_dict_new.restype = ctypes.c_void_p
     lib.df_dict_free.argtypes = [ctypes.c_void_p]
     lib.df_dict_len.argtypes = [ctypes.c_void_p]
@@ -79,7 +88,8 @@ def load():
         ctypes.c_uint32, ctypes.POINTER(ctypes.c_uint32)]  # n_slow
     lib.df_fm_set_l7.argtypes = [
         ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint32,
-        ctypes.c_uint16, ctypes.c_uint16, ctypes.c_uint8, ctypes.c_int32]
+        ctypes.c_uint16, ctypes.c_uint16, ctypes.c_uint8,
+        ctypes.c_uint8, ctypes.c_uint32, ctypes.c_int32]
     lib.df_fm_tick.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
     lib.df_fm_poll_closed.restype = ctypes.c_uint32
     lib.df_fm_poll_closed.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
@@ -146,7 +156,8 @@ L7_EVENT_DTYPE = np.dtype([
     ("payload_off", np.uint32), ("payload_len", np.uint32),
     ("is_tx", np.uint8), ("protocol", np.uint8),
     ("ip_src", np.uint32), ("ip_dst", np.uint32),
-    ("port_src", np.uint16), ("port_dst", np.uint16)])
+    ("port_src", np.uint16), ("port_dst", np.uint16),
+    ("tunnel_type", np.uint8), ("tunnel_id", np.uint32)])
 
 
 # packet record layout must match struct DfPacketOut in dfpacket.h

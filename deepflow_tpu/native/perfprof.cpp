@@ -99,6 +99,73 @@ static long pe_open(perf_event_attr* attr, pid_t pid, int cpu) {
                    PERF_FLAG_FD_CLOEXEC);
 }
 
+namespace {
+
+// Shared attach: one ring-owning event per CPU for the first existing tid,
+// per-tid events redirected into it (SET_OUTPUT, perf-record style);
+// inherit picks up threads spawned later. Returns empty + *err on failure.
+std::vector<CpuRing> open_rings(perf_event_attr* attr, int pid,
+                                uint32_t ring_pages, int32_t* err) {
+    std::vector<CpuRing> rings;
+    auto cleanup = [&]() {
+        for (auto& q : rings) {
+            for (int efd : q.extra_fds) close(efd);
+            if (q.map) munmap(q.map, q.map_len);
+            if (q.fd >= 0) close(q.fd);
+        }
+        rings.clear();
+    };
+    std::vector<int> tids = list_tids(pid);
+    long ncpu = sysconf(_SC_NPROCESSORS_ONLN);
+    for (int cpu = 0; cpu < ncpu; cpu++) {
+        CpuRing r;
+        r.fd = (int)pe_open(attr, tids[0], cpu);
+        if (r.fd < 0) {
+            if (errno == ENODEV) continue;  // offline cpu
+            *err = errno;
+            cleanup();
+            return rings;
+        }
+        r.map_len = (ring_pages + 1) * (size_t)getpagesize();
+        r.map = (uint8_t*)mmap(nullptr, r.map_len, PROT_READ | PROT_WRITE,
+                               MAP_SHARED, r.fd, 0);
+        if (r.map == MAP_FAILED) {
+            *err = errno;
+            close(r.fd);
+            cleanup();
+            return rings;
+        }
+        ioctl(r.fd, PERF_EVENT_IOC_ENABLE, 0);
+        for (size_t t = 1; t < tids.size(); t++) {
+            int efd = (int)pe_open(attr, tids[t], cpu);
+            if (efd < 0) continue;  // tid exited since listing: fine
+            if (ioctl(efd, PERF_EVENT_IOC_SET_OUTPUT, r.fd) < 0) {
+                close(efd);
+                continue;
+            }
+            ioctl(efd, PERF_EVENT_IOC_ENABLE, 0);
+            r.extra_fds.push_back(efd);
+        }
+        rings.push_back(r);
+    }
+    if (rings.empty()) *err = ENODEV;
+    return rings;
+}
+
+void close_rings(std::vector<CpuRing>& rings) {
+    for (auto& r : rings) {
+        for (int efd : r.extra_fds) {
+            ioctl(efd, PERF_EVENT_IOC_DISABLE, 0);
+            close(efd);
+        }
+        if (r.fd >= 0) ioctl(r.fd, PERF_EVENT_IOC_DISABLE, 0);
+        if (r.map) munmap(r.map, r.map_len);
+        if (r.fd >= 0) close(r.fd);
+    }
+}
+
+}  // namespace
+
 // Attach to `pid` (all threads via inherit) at `freq` Hz across all CPUs.
 // dwarf != 0 additionally samples user regs (bp/sp/ip) + a stack dump of
 // stack_dump bytes for the .eh_frame unwinder. Returns nullptr with
@@ -137,52 +204,8 @@ DfProf* df_prof_open_ex(int32_t pid, uint32_t freq, uint32_t max_stack,
     // stack dumps inflate records ~8KB each: give dwarf mode 1MB rings
     // (power of two pages) so a 200ms poll interval can't overflow them
     p->ring_pages = dwarf ? 256 : kRingPages;
-    auto cleanup = [&]() {
-        for (auto& q : p->rings) {
-            for (int efd : q.extra_fds) close(efd);
-            if (q.map) munmap(q.map, q.map_len);
-            if (q.fd >= 0) close(q.fd);
-        }
-        delete p;
-    };
-    // one event per (existing tid, cpu): the leader's event owns the cpu's
-    // ring; the other tids' events redirect into it (SET_OUTPUT), and
-    // inherit picks up any threads spawned later
-    std::vector<int> tids = list_tids(pid);
-    long ncpu = sysconf(_SC_NPROCESSORS_ONLN);
-    for (int cpu = 0; cpu < ncpu; cpu++) {
-        CpuRing r;
-        r.fd = (int)pe_open(&attr, tids[0], cpu);
-        if (r.fd < 0) {
-            if (errno == ENODEV) continue;  // offline cpu
-            *err = errno;
-            cleanup();
-            return nullptr;
-        }
-        r.map_len = (p->ring_pages + 1) * (size_t)getpagesize();
-        r.map = (uint8_t*)mmap(nullptr, r.map_len, PROT_READ | PROT_WRITE,
-                               MAP_SHARED, r.fd, 0);
-        if (r.map == MAP_FAILED) {
-            *err = errno;
-            close(r.fd);
-            cleanup();
-            return nullptr;
-        }
-        ioctl(r.fd, PERF_EVENT_IOC_ENABLE, 0);
-        for (size_t t = 1; t < tids.size(); t++) {
-            int efd = (int)pe_open(&attr, tids[t], cpu);
-            if (efd < 0) continue;  // tid exited since listing: fine
-            if (ioctl(efd, PERF_EVENT_IOC_SET_OUTPUT, r.fd) < 0) {
-                close(efd);
-                continue;
-            }
-            ioctl(efd, PERF_EVENT_IOC_ENABLE, 0);
-            r.extra_fds.push_back(efd);
-        }
-        p->rings.push_back(r);
-    }
+    p->rings = open_rings(&attr, pid, p->ring_pages, err);
     if (p->rings.empty()) {
-        *err = ENODEV;
         delete p;
         return nullptr;
     }
@@ -294,15 +317,7 @@ void dwarf_walk(const DfProf* p, uint64_t ip, uint64_t sp, uint64_t bp,
 
 void df_prof_close(DfProf* p) {
     if (!p) return;
-    for (auto& r : p->rings) {
-        for (int efd : r.extra_fds) {
-            ioctl(efd, PERF_EVENT_IOC_DISABLE, 0);
-            close(efd);
-        }
-        if (r.fd >= 0) ioctl(r.fd, PERF_EVENT_IOC_DISABLE, 0);
-        if (r.map) munmap(r.map, r.map_len);
-        if (r.fd >= 0) close(r.fd);
-    }
+    close_rings(p->rings);
     delete p;
 }
 
@@ -454,6 +469,246 @@ void df_prof_stats2(DfProf* p, uint64_t* out7) {
     out7[4] = p->n_dwarf;
     out7[5] = p->n_fp;
     out7[6] = p->modules.size();
+}
+
+// ---------------------------------------------------------------------------
+// OffCPU profiler: context-switch events with callchains.
+//
+// Reference analog: the OffCPU profiler of user/extended/extended.h:26-80
+// (EE) over perf_profiler.bpf.c's machinery. Redesign without BPF: a
+// software CONTEXT_SWITCHES event (period=1) samples a callchain at every
+// switch-OUT of the target's threads, and attr.context_switch=1 delivers
+// PERF_RECORD_SWITCH markers whose sample_id trailer (sample_id_all)
+// carries tid+time for the switch-IN — blocked duration = in.time -
+// out.time, aggregated per callchain in nanoseconds. FP chains only: an
+// 8KB stack dump per switch (10k+/s under IO load) would swamp the rings,
+// so DWARF stays an OnCPU-only feature.
+// ---------------------------------------------------------------------------
+
+// one drained record, time-sortable ACROSS rings: a thread migrating
+// between CPUs lands its switch and resume records in different rings,
+// and processing them in ring order would pair a resume against a stale
+// departure — counting run time as blocked time
+struct OffCpuRec {
+    uint64_t t;
+    uint32_t tid;
+    uint8_t kind;  // 0 = switch marker (departure candidate), 1 = sample
+    std::vector<uint64_t> chain;  // samples only
+};
+
+struct DfOffCpu {
+    std::vector<CpuRing> rings;
+    // chain (leaf..root + tid tail) -> [total_ns, count]
+    std::map<std::vector<uint64_t>, std::pair<uint64_t, uint64_t>> agg;
+    // tid -> time the task left the CPU (block start)
+    std::map<uint32_t, uint64_t> block_start;
+    std::vector<OffCpuRec> scratch;  // per-poll, sorted by time
+    uint64_t n_switches = 0, n_lost = 0, n_export_dropped = 0;
+    uint64_t n_switch_in = 0, n_paired = 0, n_other = 0;
+    uint64_t min_block_ns = 1000;
+    uint32_t max_stack = 64;
+    uint32_t ring_pages = 256;  // switches burst far harder than 99Hz
+    int target_pid;
+};
+
+DfOffCpu* df_offcpu_open(int32_t pid, uint32_t max_stack,
+                         uint64_t min_block_ns, int32_t* err) {
+    *err = 0;
+    perf_event_attr attr;
+    memset(&attr, 0, sizeof(attr));
+    attr.size = sizeof(attr);
+    attr.type = PERF_TYPE_SOFTWARE;
+    attr.config = PERF_COUNT_SW_CONTEXT_SWITCHES;
+    attr.sample_period = 1;          // every switch-out
+    attr.sample_type = PERF_SAMPLE_IP | PERF_SAMPLE_TID |
+                       PERF_SAMPLE_TIME | PERF_SAMPLE_CALLCHAIN;
+    // the switch event FIRES in kernel context (schedule()), so
+    // exclude_kernel would drop every sample — instead keep the event and
+    // trim kernel frames from the chain (needs perf_event_paranoid <= 1
+    // or CAP_PERFMON; open fails cleanly otherwise)
+    attr.exclude_kernel = 0;
+    attr.exclude_callchain_kernel = 1;
+    attr.exclude_hv = 1;
+    attr.inherit = 1;
+    attr.disabled = 1;
+    attr.context_switch = 1;         // PERF_RECORD_SWITCH in/out markers
+    attr.sample_id_all = 1;          // tid+time trailer on SWITCH records
+    attr.wakeup_events = 256;
+
+    auto* p = new DfOffCpu();
+    if (max_stack) p->max_stack = max_stack;
+    if (min_block_ns) p->min_block_ns = min_block_ns;
+    p->target_pid = pid;
+    p->rings = open_rings(&attr, pid, p->ring_pages, err);
+    if (p->rings.empty()) {
+        delete p;
+        return nullptr;
+    }
+    return p;
+}
+
+void df_offcpu_close(DfOffCpu* p) {
+    if (!p) return;
+    close_rings(p->rings);
+    delete p;
+}
+
+#ifndef PERF_RECORD_MISC_SWITCH_OUT
+#define PERF_RECORD_MISC_SWITCH_OUT (1 << 13)
+#endif
+#ifndef PERF_RECORD_SWITCH_TYPE
+enum { PERF_RECORD_SWITCH_LOCAL = 14 };  // PERF_RECORD_SWITCH
+#define PERF_RECORD_SWITCH_TYPE PERF_RECORD_SWITCH_LOCAL
+#endif
+
+static void offcpu_drain_ring(DfOffCpu* p, CpuRing& r) {
+    auto* meta = (perf_event_mmap_page*)r.map;
+    uint64_t head = __atomic_load_n(&meta->data_head, __ATOMIC_ACQUIRE);
+    uint64_t tail = meta->data_tail;
+    size_t data_size = p->ring_pages * (size_t)getpagesize();
+    uint8_t* data = r.map + getpagesize();
+    std::vector<uint8_t> rec;
+    std::vector<uint64_t> chain;
+    while (tail < head) {
+        auto* hdr = (perf_event_header*)(data + (tail % data_size));
+        uint16_t size = hdr->size;
+        if (size == 0) break;
+        rec.resize(size);
+        size_t off = tail % data_size;
+        size_t first = data_size - off < size ? data_size - off : size;
+        memcpy(rec.data(), data + off, first);
+        if (first < size) memcpy(rec.data() + first, data, size - first);
+        auto* h = (perf_event_header*)rec.data();
+        if (h->type == PERF_RECORD_SAMPLE) {
+            // ip u64, pid/tid u32s, time u64, nr u64 + ips — the sample
+            // fires at switch-OUT with the blocking callchain
+            const uint8_t* q = rec.data() + sizeof(perf_event_header);
+            const uint8_t* end = rec.data() + size;
+            uint64_t ip;
+            memcpy(&ip, q, 8);
+            q += 8;
+            uint32_t spid, tid;
+            memcpy(&spid, q, 4);
+            memcpy(&tid, q + 4, 4);
+            q += 8;
+            uint64_t t;
+            memcpy(&t, q, 8);
+            q += 8;
+            uint64_t nr;
+            memcpy(&nr, q, 8);
+            q += 8;
+            chain.clear();
+            for (uint64_t i = 0; i < nr && q + 8 <= end; i++, q += 8) {
+                uint64_t a;
+                memcpy(&a, q, 8);
+                if (a >= kContextMask) continue;
+                if (chain.size() < p->max_stack) chain.push_back(a);
+            }
+            if (chain.empty() && ip < kContextMask) chain.push_back(ip);
+            p->n_switches++;
+            if (!chain.empty())
+                p->scratch.push_back(OffCpuRec{t, tid, 1, chain});
+        } else if (h->type == PERF_RECORD_SWITCH_TYPE) {
+            bool out_bit = (h->misc & PERF_RECORD_MISC_SWITCH_OUT) != 0;
+            if (!out_bit) p->n_switch_in++;
+            if (size >= sizeof(perf_event_header) + 16) {
+                // sample_id trailer = pid u32, tid u32, time u64
+                const uint8_t* q = rec.data() + sizeof(perf_event_header);
+                uint32_t spid, tid;
+                memcpy(&spid, q, 4);
+                memcpy(&tid, q + 4, 4);
+                uint64_t t;
+                memcpy(&t, q + 8, 8);
+                p->scratch.push_back(OffCpuRec{t, tid, 0, {}});
+            }
+        } else if (h->type == PERF_RECORD_LOST) {
+            uint64_t lost;
+            memcpy(&lost, rec.data() + sizeof(perf_event_header) + 8, 8);
+            p->n_lost += lost;
+        } else {
+            p->n_other++;
+        }
+        tail += size;
+    }
+    __atomic_store_n(&meta->data_tail, tail, __ATOMIC_RELEASE);
+}
+
+uint64_t df_offcpu_poll(DfOffCpu* p, int32_t timeout_ms) {
+    if (timeout_ms > 0) {
+        std::vector<pollfd> fds;
+        for (auto& r : p->rings) fds.push_back({r.fd, POLLIN, 0});
+        poll(fds.data(), fds.size(), timeout_ms);
+    }
+    p->scratch.clear();
+    for (auto& r : p->rings) offcpu_drain_ring(p, r);
+    // merge records ACROSS rings in time order before running the state
+    // machine (migrating threads interleave rings)
+    std::stable_sort(p->scratch.begin(), p->scratch.end(),
+                     [](const OffCpuRec& a, const OffCpuRec& b) {
+                         return a.t < b.t;
+                     });
+    for (auto& rec : p->scratch) {
+        if (rec.kind == 0) {
+            // departure candidate; the LATEST one before the resume
+            // sample bounds the true block (delayed-dequeue kernels emit
+            // an extra quick out/in pair right after blocking, which the
+            // overwrite absorbs)
+            p->block_start[rec.tid] = rec.t;
+            continue;
+        }
+        // Observed semantics (verified on 6.x EEVDF kernels, see the
+        // timeline test): the CONTEXT_SWITCHES sample fires when the task
+        // RESUMES, and its callchain IS the blocking stack (the user
+        // stack is untouched while the task is off-CPU).
+        auto it = p->block_start.find(rec.tid);
+        if (it == p->block_start.end()) continue;
+        uint64_t t0 = it->second;
+        if (rec.t > t0 && rec.t - t0 >= p->min_block_ns) {
+            rec.chain.push_back((uint64_t)rec.tid);  // tid tail
+            auto& acc = p->agg[rec.chain];
+            acc.first += rec.t - t0;
+            acc.second += 1;
+            p->n_paired++;
+        }
+        p->block_start.erase(it);
+    }
+    p->scratch.clear();
+    return p->n_switches;
+}
+
+// Export unique blocked-chains and RESET. values[i] = total blocked ns.
+uint32_t df_offcpu_export(DfOffCpu* p, uint64_t* addrs, uint32_t addr_cap,
+                          uint16_t* lens, uint32_t* tids, uint64_t* values,
+                          uint32_t* counts, uint32_t stack_cap) {
+    uint32_t n = 0, used = 0;
+    for (auto& kv : p->agg) {
+        if (n >= stack_cap || used + (kv.first.size() - 1) > addr_cap) {
+            p->n_export_dropped++;
+            continue;
+        }
+        const auto& chain = kv.first;
+        uint32_t clen = (uint32_t)chain.size() - 1;
+        memcpy(addrs + used, chain.data(), (size_t)clen * 8);
+        lens[n] = (uint16_t)clen;
+        tids[n] = (uint32_t)chain.back();
+        values[n] = kv.second.first;
+        counts[n] = (uint32_t)kv.second.second;
+        used += clen;
+        n++;
+    }
+    p->agg.clear();
+    return n;
+}
+
+// stats: [switches, lost, rings, export_dropped, switch_in, paired, other]
+void df_offcpu_stats(DfOffCpu* p, uint64_t* out7) {
+    out7[0] = p->n_switches;
+    out7[1] = p->n_lost;
+    out7[2] = p->rings.size();
+    out7[3] = p->n_export_dropped;
+    out7[4] = p->n_switch_in;
+    out7[5] = p->n_paired;
+    out7[6] = p->n_other;
 }
 
 }  // extern "C"

@@ -426,13 +426,15 @@ class EventDecoder(Decoder):
             **tags,
         } for e in batch.events]
         self.write("event.event", rows)
+        # tags are constant per batch: serialize ONCE, not per io event
+        tags_json = json.dumps(tags, sort_keys=True)
         for e in batch.events:
             if e.event_type in ("file-io-read", "file-io-write"):
-                self._reduce_file_io(e, tags)
+                self._reduce_file_io(e, tags_json)
         self._flush_agg()
         return len(rows)
 
-    def _reduce_file_io(self, e, tags: dict) -> None:
+    def _reduce_file_io(self, e, tags_json: str) -> None:
         op = 0 if e.event_type == "file-io-read" else 1
         window = e.timestamp_ns - e.timestamp_ns % self.WINDOW_NS
         try:
@@ -440,8 +442,7 @@ class EventDecoder(Decoder):
             nbytes = int(e.attrs.get("bytes", "0"))
         except ValueError:
             latency = nbytes = 0
-        key = (window, e.pid, e.resource_name, op,
-               json.dumps(tags, sort_keys=True))
+        key = (window, e.pid, e.resource_name, op, tags_json)
         with self._agg_lock:
             acc = self._agg.get(key)
             if acc is None:

@@ -191,3 +191,68 @@ def test_profiles_preexisting_threads():
     # without per-tid attach the sampler would see (almost) nothing
     assert total > 50, total
     assert any(t != proc.pid for t in tids), tids
+
+
+def test_offcpu_profiler_blocked_flame():
+    """Out-of-process OffCPU: blocked-time flame graphs from context-switch
+    events (reference: the OffCPU profiler of user/extended/extended.h).
+    Off-CPU time includes runqueue wait, the standard definition."""
+    from deepflow_tpu.agent.extprofiler import OffCpuProfiler
+    code = textwrap.dedent("""
+        import time
+        while True: time.sleep(0.02)
+    """)
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.DEVNULL)
+    try:
+        time.sleep(0.3)
+        batches = []
+        prof = OffCpuProfiler(batches.append, pid=proc.pid,
+                              window_s=0.5).start()
+        time.sleep(2.5)
+        prof.stop()
+    finally:
+        proc.kill()
+    total_us = sum(s.value_us for b in batches for s in b)
+    assert all(s.event_type == "off-cpu" for b in batches for s in b)
+    # a 2.5s window of a 98%-sleeping process: most time is blocked
+    assert total_us > 800_000, total_us
+
+
+def test_offcpu_ships_to_store():
+    """Agent wiring: external_offcpu=True lands off-cpu rows in the
+    profile table."""
+    from deepflow_tpu.agent.agent import Agent
+    from deepflow_tpu.agent.config import AgentConfig
+    from deepflow_tpu.server import Server
+
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0).start()
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import time\nwhile True: time.sleep(0.02)"],
+        stdout=subprocess.DEVNULL)
+    try:
+        time.sleep(0.2)
+        cfg = AgentConfig()
+        cfg.sender.servers = [("127.0.0.1", server.ingest_port)]
+        cfg.profiler.enabled = False
+        cfg.profiler.external_pids = [proc.pid]
+        cfg.profiler.external_offcpu = True
+        cfg.profiler.emit_interval_s = 0.5
+        cfg.tpuprobe.enabled = False
+        cfg.guard.enabled = False
+        agent = Agent(cfg).start()
+        try:
+            time.sleep(2.5)
+        finally:
+            agent.stop()
+        assert server.wait_for_rows("profile.in_process_profile", 1,
+                                    timeout=10)
+        from deepflow_tpu.query import execute
+        t = server.db.table("profile.in_process_profile")
+        r = execute(t, "SELECT event_type, value FROM t "
+                       "WHERE event_type = 'off-cpu'")
+        assert r.values, "no off-cpu rows stored"
+        assert sum(v for _, v in r.values) > 100_000  # us blocked
+    finally:
+        proc.kill()
+        server.stop()
