@@ -65,6 +65,8 @@ def record_to_l7_pb(r: L7Record) -> pb.L7FlowLog:
     f.key.port_src = node.port_src
     f.key.port_dst = node.port_dst
     f.key.proto = node.protocol
+    f.key.tunnel_type = node.tunnel_type
+    f.key.tunnel_id = node.tunnel_id
     f.l7_protocol = node.l7_protocol
     f.start_time_ns = r.start_ns
     f.end_time_ns = r.end_ns

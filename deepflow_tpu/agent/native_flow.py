@@ -170,7 +170,9 @@ class NativeFlowMap:
                 ip_dst=int(ev["ip_dst"]).to_bytes(4, "big"),
                 port_src=int(ev["port_src"]), port_dst=int(ev["port_dst"]),
                 protocol=int(ev["protocol"]),
-                start_ns=int(ev["ts_ns"]))
+                start_ns=int(ev["ts_ns"]),
+                tunnel_type=int(ev["tunnel_type"]),
+                tunnel_id=int(ev["tunnel_id"]))
             self._l7fm.flows[fid] = node
         return node
 
@@ -200,7 +202,8 @@ class NativeFlowMap:
                 self._lib.df_fm_set_l7(
                     self._fm, int(ev["ip_src"]), int(ev["ip_dst"]),
                     int(ev["port_src"]), int(ev["port_dst"]),
-                    int(ev["protocol"]), mode)
+                    int(ev["protocol"]), int(ev["tunnel_type"]),
+                    int(ev["tunnel_id"]), mode)
 
     # -- slow path (v6 / vlan-exotic frames) ----------------------------------
 

@@ -16,6 +16,7 @@ log = logging.getLogger("df.native")
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_DIR, "libdfnative.so")
 _lib = None
+_ABI_VERSION = 2  # must match df_abi_version() in dfnative.cpp
 
 
 def _build() -> bool:
@@ -41,14 +42,20 @@ def load():
     except OSError as e:
         log.debug("dfnative load failed: %s", e)
         return None
-    # stale-.so guard: a previously built lib without the newest symbols
-    # would be called with mismatched signatures/dtypes (silent corruption,
-    # not a clean error) — probe the newest symbol and refuse the whole lib
+    # stale-.so guard: a previously built lib with older signatures/struct
+    # layouts would be called with mismatched dtypes (silent corruption,
+    # not a clean error) — check the ABI version and refuse the whole lib.
+    # _ABI_VERSION must match df_abi_version() in dfnative.cpp; bump both
+    # on any exported-signature or packed-struct change.
     try:
-        lib.df_offcpu_open
+        lib.df_abi_version.restype = ctypes.c_int32
+        got = lib.df_abi_version()
     except AttributeError:
-        log.warning("libdfnative.so is stale (missing df_offcpu_open); "
-                    "rebuild failed? falling back to pure Python")
+        got = -1
+    if got != _ABI_VERSION:
+        log.warning("libdfnative.so ABI %d != expected %d; "
+                    "rebuild failed? falling back to pure Python", got,
+                    _ABI_VERSION)
         return None
     lib.df_dict_new.restype = ctypes.c_void_p
     lib.df_dict_free.argtypes = [ctypes.c_void_p]

@@ -151,6 +151,8 @@ struct L7Event {
     uint8_t protocol;
     uint32_t ip_src, ip_dst;
     uint16_t port_src, port_dst;
+    uint8_t tunnel_type;
+    uint32_t tunnel_id;
 };
 #pragma pack(pop)
 
@@ -375,6 +377,8 @@ static void inject_decoded(DfFlowMap* fm, const DfPacketOut& p,
             e.ip_dst = (uint32_t)f.key.a;
             e.port_src = (uint16_t)(f.key.b >> 32);
             e.port_dst = (uint16_t)(f.key.b >> 16);
+            e.tunnel_type = (uint8_t)(f.key.c >> 32);
+            e.tunnel_id = (uint32_t)f.key.c;
             sink->buf_used += p.payload_len;
             fm->n_l7_events++;
         } else {
@@ -418,9 +422,10 @@ uint64_t df_fm_inject_batch(DfFlowMap* fm, const uint8_t* data,
 
 void df_fm_set_l7(DfFlowMap* fm, uint32_t ip_src, uint32_t ip_dst,
                   uint16_t port_src, uint16_t port_dst, uint8_t proto,
-                  int32_t mode) {
+                  uint8_t tunnel_type, uint32_t tunnel_id, int32_t mode) {
     FlowKey k{(uint64_t)ip_src << 32 | ip_dst,
-              (uint64_t)port_src << 32 | (uint64_t)port_dst << 16 | proto};
+              (uint64_t)port_src << 32 | (uint64_t)port_dst << 16 | proto,
+              (uint64_t)tunnel_type << 32 | tunnel_id};
     auto it = fm->flows.find(k);
     if (it == fm->flows.end()) {
         it = fm->flows.find(reverse_key(k));

@@ -277,6 +277,8 @@ class FlowLogDecoder(Decoder):
                     "ip_dst": dst_s,
                     "port_src": f.key.port_src,
                     "port_dst": f.key.port_dst,
+                    "tunnel_type": min(int(f.key.tunnel_type), 4),
+                    "tunnel_id": f.key.tunnel_id,
                     "l7_protocol": int(f.l7_protocol),
                     "version": f.version,
                     "request_type": f.request_type,

@@ -135,6 +135,8 @@ _table("flow_log.l7_flow_log", [
     C("ip_dst", "str"),
     C("port_src", "u16"),
     C("port_dst", "u16"),
+    C("tunnel_type", "enum", ["none", "vxlan", "geneve", "erspan", "gre"]),
+    C("tunnel_id", "u32"),
     C("l7_protocol", "enum", L7_PROTOS),
     C("version", "str"),
     C("request_type", "str"),

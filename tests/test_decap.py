@@ -294,3 +294,52 @@ def test_native_flow_map_keys_on_inner_tuple():
     assert f.ip_src_str() == "10.1.0.1"
     assert f.ip_dst_str() == "10.1.0.2"
     assert f.port_dst == 443
+
+
+def _vxlan_http_frames(vni: int = 33) -> list[bytes]:
+    """An HTTP request + response riding a VXLAN overlay."""
+    req = b"GET /health HTTP/1.1\r\nHost: a\r\n\r\n"
+    resp = b"HTTP/1.1 200 OK\r\nContent-Length: 0\r\n\r\n"
+
+    def mk(src, dst, sp, dp, payload, seq):
+        t = struct.pack(">HHIIBBHHH", sp, dp, seq, 1, 5 << 4, 0x18,
+                        1024, 0, 0) + payload
+        inner = eth(0x0800, ipv4(6, src, dst, t))
+        hdr = struct.pack(">BBHI", 0x08, 0, 0, vni << 8)
+        return eth(0x0800, ipv4(17, bytes([172, 16, 0, 1]),
+                                bytes([172, 16, 0, 2]),
+                                udp(49152, 4789, hdr + inner)))
+
+    a, b = bytes([10, 1, 0, 1]), bytes([10, 1, 0, 2])
+    return [mk(a, b, 40000, 80, req, 1), mk(b, a, 80, 40000, resp, 1)]
+
+
+def test_l7_log_carries_tunnel_identity():
+    """L7 records from overlay traffic must keep the VNI: without it two
+    tenants with overlapping pod IPs produce byte-identical L7 logs."""
+    from deepflow_tpu.agent.dispatcher import record_to_l7_pb
+    from deepflow_tpu.agent.flow_map import FlowMap
+
+    # python engine
+    recs = []
+    fm = FlowMap(on_l7_log=recs.append)
+    for i, f in enumerate(_vxlan_http_frames()):
+        fm.inject(decode_ethernet(f, 1_000_000_000 + i * 1_000_000))
+    assert recs, "no L7 record from python engine"
+    f = record_to_l7_pb(recs[0])
+    assert f.key.tunnel_type == 1 and f.key.tunnel_id == 33
+    assert f.request_resource == "/health"
+
+    # native engine
+    if native.load() is None:
+        pytest.skip("libdfnative.so unavailable")
+    from deepflow_tpu.agent.native_flow import NativeFlowMap
+    recs2 = []
+    nfm = NativeFlowMap(on_l7_log=recs2.append)
+    nfm.inject_frames([(fr, 1_000_000_000 + i)
+                       for i, fr in enumerate(_vxlan_http_frames())])
+    nfm.flush_all()
+    assert recs2, "no L7 record from native engine"
+    f2 = record_to_l7_pb(recs2[0])
+    assert f2.key.tunnel_type == 1 and f2.key.tunnel_id == 33
+    assert f2.request_resource == "/health"
