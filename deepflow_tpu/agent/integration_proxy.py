@@ -17,7 +17,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 log = logging.getLogger("df.integration-proxy")
 
 _FORWARD_PATHS = ("/api/v1/otlp/traces", "/api/v1/profile/ingest",
-                  "/api/v1/log", "/api/v1/write")
+                  "/api/v1/log", "/api/v1/write", "/api/v1/telegraf",
+                  "/v0.3/traces", "/v0.4/traces", "/v3/segments")
 
 
 class IntegrationProxy:
@@ -69,6 +70,8 @@ class IntegrationProxy:
                 self.send_header("Content-Length", str(len(out)))
                 self.end_headers()
                 self.wfile.write(out)
+
+            do_PUT = do_POST  # dd-trace clients PUT their trace payloads
 
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]

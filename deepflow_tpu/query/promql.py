@@ -59,6 +59,13 @@ _FAMILIES = {
     "flow_metrics_application_": ("flow_metrics.application.1s", _APP_TAGS),
 }
 
+# narrow-format (metric_name/value_name/value) sources served by prefix:
+# self-telemetry and telegraf/external metrics share one storage shape
+_NARROW_TABLES = (
+    ("deepflow_system_", "deepflow_system.deepflow_system"),
+    ("ext_metrics_", "ext_metrics.metrics"),
+)
+
 
 class PromqlError(Exception):
     pass
@@ -554,11 +561,13 @@ def _resolve_metric(db: Database, name: str):
     pre_filters: [(column, code), ...] row filters identifying the metric;
     labels_col: json-encoded label column (series identity) or None.
     """
-    # self-telemetry: deepflow_system_<metric>_<value> with dots mangled,
-    # e.g. deepflow_system_agent_sender_sent_frames
-    if name.startswith("deepflow_system_"):
-        suffix = name[len("deepflow_system_"):]
-        table = db.table("deepflow_system.deepflow_system")
+    # narrow-format tables: <prefix><metric>_<value> with dots mangled,
+    # e.g. deepflow_system_agent_sender_sent_frames, ext_metrics_cpu_usage
+    for prefix, tname in _NARROW_TABLES:
+        if not name.startswith(prefix):
+            continue
+        table = db.table(tname)
+        suffix = name[len(prefix):]
         mdict, vdict = table.dicts["metric_name"], table.dicts["value_name"]
         # longest metric-name match first: mangling can make one name a
         # prefix of another, and first-match would be ingest-order dependent
@@ -699,10 +708,12 @@ def fetch_raw(db: Database, vs: VectorSelector, lo_s: float,
     table, col, tags, pre_filters, labels_col = _resolve_metric(db, vs.metric)
     appliers = _compile_matchers(table, vs.matchers, labels_col)
     # remote-write clients send CUMULATIVE counters (standard Prometheus),
-    # and dfstats self-telemetry snapshots cumulative process counters;
+    # dfstats self-telemetry snapshots cumulative process counters, and the
+    # Telegraf fields people rate() (net/disk totals) are cumulative too;
     # internal flow_metrics tables hold per-interval DELTA samples.
     counter_mode = table.name in ("prometheus.samples",
-                                  "deepflow_system.deepflow_system")
+                                  "deepflow_system.deepflow_system",
+                                  "ext_metrics.metrics")
     chunks = table.snapshot()
     times, values, tag_arrays = [], [], {t: [] for t in tags}
     for ch in chunks:
@@ -1666,27 +1677,29 @@ def metric_names(db: Database, start_s: float = 0,
         for col, spec in table.columns.items():
             if spec.kind == "u64":  # meters are u64; tags are str/enum/ints
                 out.add(prefix + col)
-    try:
-        table = db.table("deepflow_system.deepflow_system")
-        pairs: set[tuple[int, int]] = set()
-        for ch in table.snapshot():
-            if not ch or not len(ch.get("metric_name", ())):
-                continue
-            t = ch["time"].astype(np.int64) // 1_000_000_000
-            mask = (t >= start_s) & (t <= end_s)
-            if not mask.any():
-                continue
-            for mi, vi in zip(*np.unique(np.stack(
-                    [ch["metric_name"][mask], ch["value_name"][mask]]),
-                    axis=1)):
-                pairs.add((int(mi), int(vi)))
-        mdict, vdict = table.dicts["metric_name"], table.dicts["value_name"]
-        for mi, vi in pairs:
-            mn, vn = mdict.decode(mi), vdict.decode(vi)
-            if mn and vn:
-                out.add(f"deepflow_system_{_mangle(mn)}_{_mangle(vn)}")
-    except (KeyError, IndexError):
-        pass
+    for prefix, tname in _NARROW_TABLES:
+        try:
+            table = db.table(tname)
+            pairs: set[tuple[int, int]] = set()
+            for ch in table.snapshot():
+                if not ch or not len(ch.get("metric_name", ())):
+                    continue
+                t = ch["time"].astype(np.int64) // 1_000_000_000
+                mask = (t >= start_s) & (t <= end_s)
+                if not mask.any():
+                    continue
+                for mi, vi in zip(*np.unique(np.stack(
+                        [ch["metric_name"][mask], ch["value_name"][mask]]),
+                        axis=1)):
+                    pairs.add((int(mi), int(vi)))
+            mdict = table.dicts["metric_name"]
+            vdict = table.dicts["value_name"]
+            for mi, vi in pairs:
+                mn, vn = mdict.decode(mi), vdict.decode(vi)
+                if mn and vn:
+                    out.add(f"{prefix}{_mangle(mn)}_{_mangle(vn)}")
+        except (KeyError, IndexError):
+            pass
     try:
         table = db.table("prometheus.samples")
         d = table.dicts["metric_name"]

@@ -17,6 +17,24 @@ from deepflow_tpu.store.db import Database
 log = logging.getLogger("df.integration")
 
 
+def _int0(v) -> int:
+    """Best-effort int from user-controlled tag values; bad data must not
+    poison the rest of the ingest batch."""
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        return 0
+
+
+def _status_from_http(code: int) -> int:
+    # RESPONSE_STATUS: 0 unknown, 1 ok, 2 client_error, 3 server_error
+    if code >= 500:
+        return 3
+    if code >= 400:
+        return 2
+    return 1 if code else 0
+
+
 def _attr_map(attrs: list) -> dict:
     out = {}
     for a in attrs or []:
@@ -42,7 +60,9 @@ class IntegrationAPI:
         self.prom_encoder = prom_encoder
         self._known_set_ids: set[int] = set()
         self._seeded = False
-        self.stats = {"otlp_spans": 0, "profiles": 0, "app_logs": 0}
+        self.stats = {"otlp_spans": 0, "profiles": 0, "app_logs": 0,
+                      "telegraf_rows": 0, "prom_samples": 0,
+                      "datadog_spans": 0, "skywalking_spans": 0}
 
     def _write(self, table_name: str, rows: list[dict]) -> None:
         """HTTP-ingested rows join the re-export pipeline too (same path as
@@ -226,6 +246,141 @@ class IntegrationAPI:
         self._write("event.event", rows)
         self.stats["app_logs"] += len(rows)
         return {"accepted": len(rows)}
+
+    # -- telegraf (POST /api/v1/telegraf) ------------------------------------
+    # reference: agent integration_collector.rs:757 forwards Telegraf
+    # influx-line-protocol posts; server ext_metrics ingester decodes them.
+
+    def ingest_telegraf(self, raw: bytes) -> dict:
+        from deepflow_tpu.utils.influxline import parse_lines
+        points, bad = parse_lines(raw.decode("utf-8", "replace"))
+        now = time.time_ns()
+        rows = []
+        for p in points:
+            tag_json = json.dumps(p.tags, sort_keys=True)
+            ts = p.timestamp_ns or now
+            for k, v in p.fields.items():
+                if isinstance(v, str):  # string fields aren't series values
+                    continue
+                rows.append({
+                    "time": ts,
+                    "metric_name": p.measurement,
+                    "tag_json": tag_json,
+                    "value_name": k,
+                    "value": float(v),
+                    # Telegraf's host tag doubles as the universal host
+                    # column (it would otherwise shadow the json tag in
+                    # PromQL matchers, which prefer real columns)
+                    "host": p.tags.get("host", ""),
+                })
+        self._write("ext_metrics.metrics", rows)
+        self.stats["telegraf_rows"] += len(rows)
+        return {"accepted": len(rows), "bad_lines": bad}
+
+    # -- Datadog traces (PUT/POST /v0.3/traces, /v0.4/traces) ----------------
+    # reference: integration_collector.rs:893. dd-trace clients ship
+    # msgpack (or JSON) bodies: a list of traces, each a list of span maps.
+
+    def ingest_datadog(self, raw: bytes, content_type: str = "") -> dict:
+        if "json" in content_type:
+            traces = json.loads(raw.decode("utf-8", "replace") or "[]")
+        else:
+            from deepflow_tpu.utils import msgpack
+            traces = msgpack.unpackb(raw) if raw else []
+        if not isinstance(traces, list):
+            raise ValueError("datadog body must be a list of traces")
+        rows = []
+        for trace in traces:
+            if not isinstance(trace, list):
+                continue
+            for span in trace:
+                if not isinstance(span, dict):
+                    continue
+                meta = span.get("meta") or {}
+                start = _int0(span.get("start", 0))
+                code = _int0(meta.get("http.status_code", 0) or 0)
+                err = _int0(span.get("error", 0) or 0)
+                rows.append({
+                    "time": start,
+                    "app_service": str(span.get("service", "")),
+                    "l7_protocol": 1,
+                    "request_type": str(meta.get("http.method", "")),
+                    "endpoint": str(span.get("name", "")),
+                    "request_resource": str(span.get("resource", "")),
+                    "request_domain": str(meta.get("http.host", "")),
+                    "response_status": 3 if err else
+                    _status_from_http(code),
+                    "response_code": code,
+                    "response_duration": max(0, _int0(span.get("duration", 0))),
+                    # dd ids are u64; render as 16-hex so they join
+                    # OTLP-propagated w3c ids' low halves
+                    "trace_id": f"{_int0(span.get('trace_id', 0)):016x}",
+                    "span_id": f"{_int0(span.get('span_id', 0)):016x}",
+                    "parent_span_id": f"{_int0(span.get('parent_id', 0)):016x}"
+                    if span.get("parent_id") else "",
+                })
+        self._write("flow_log.l7_flow_log", rows)
+        self.stats["datadog_spans"] += len(rows)
+        return {"accepted_spans": len(rows)}
+
+    # -- SkyWalking segments (POST /v3/segments, segment JSON) ---------------
+    # reference: flow_log decoder skywalking handler + the agent-side
+    # integration plugin; the JSON shape mirrors skywalking-data-collect-
+    # protocol's SegmentObject.
+
+    def ingest_skywalking(self, body) -> dict:
+        segments = body if isinstance(body, list) else [body]
+        rows = []
+        for seg in segments:
+            if not isinstance(seg, dict):
+                continue
+            trace_id = str(seg.get("traceId", ""))
+            seg_id = str(seg.get("traceSegmentId", ""))
+            service = str(seg.get("service", ""))
+            spans = seg.get("spans", [])
+            for span in spans if isinstance(spans, list) else []:
+                if not isinstance(span, dict):
+                    continue
+                raw_tags = span.get("tags") or []
+                tags = {str(t.get("key")): str(t.get("value"))
+                        for t in raw_tags if isinstance(t, dict)} \
+                    if isinstance(raw_tags, list) else {}
+                start_ms = _int0(span.get("startTime", 0))
+                end_ms = _int0(span.get("endTime", start_ms)) or start_ms
+                sid = _int0(span.get("spanId", 0))
+                parent = _int0(span.get("parentSpanId", -1))
+                if parent >= 0:
+                    parent_span = f"{seg_id}-{parent}"
+                else:  # cross-segment link via refs
+                    refs = span.get("refs") or []
+                    ref = refs[0] if refs and isinstance(refs[0], dict) \
+                        else {}
+                    ref_seg = ref.get("parentTraceSegmentId")
+                    parent_span = (f"{ref_seg}-{_int0(ref.get('parentSpanId', 0))}"
+                                   if ref_seg else "")
+                code = _int0(tags.get("http.status_code",
+                                      tags.get("status_code", 0)) or 0)
+                rows.append({
+                    "time": start_ms * 1_000_000,
+                    "app_service": service,
+                    "l7_protocol": 1,
+                    "request_type": str(tags.get("http.method", "")),
+                    "endpoint": str(span.get("operationName", "")),
+                    "request_resource": str(tags.get("url",
+                                                     tags.get("http.url",
+                                                              ""))),
+                    "response_status": 3 if span.get("isError") else
+                    _status_from_http(code),
+                    "response_code": code,
+                    "response_duration": max(0, (end_ms - start_ms)
+                                             * 1_000_000),
+                    "trace_id": trace_id,
+                    "span_id": f"{seg_id}-{sid}",
+                    "parent_span_id": parent_span,
+                })
+        self._write("flow_log.l7_flow_log", rows)
+        self.stats["skywalking_spans"] += len(rows)
+        return {"accepted_spans": len(rows)}
 
 
 # -- prometheus remote-write (POST /api/v1/write) ----------------------------

@@ -669,6 +669,19 @@ class QuerierHTTP:
                         self._send(200,
                                    api.integration.ingest_prometheus(raw))
                         return
+                    if parsed.path.rstrip("/") == "/api/v1/telegraf":
+                        n = int(self.headers.get("Content-Length", 0))
+                        raw = self.rfile.read(n) if n else b""
+                        self._send(200,
+                                   api.integration.ingest_telegraf(raw))
+                        return
+                    if parsed.path.rstrip("/") in ("/v0.3/traces",
+                                                   "/v0.4/traces"):
+                        n = int(self.headers.get("Content-Length", 0))
+                        raw = self.rfile.read(n) if n else b""
+                        self._send(200, api.integration.ingest_datadog(
+                            raw, self.headers.get("Content-Type", "")))
+                        return
                     body = self._body()
                     path = parsed.path.rstrip("/")
                     if path == "/v1/query":
@@ -698,6 +711,9 @@ class QuerierHTTP:
                                    api.integration.ingest_otlp_traces(body))
                     elif path == "/api/v1/log":
                         self._send(200, api.integration.ingest_app_log(body))
+                    elif path == "/v3/segments":
+                        self._send(200,
+                                   api.integration.ingest_skywalking(body))
                     elif path == "/v1/alerts":
                         self._send(200, api.alerts_api("upsert", body))
                     elif path == "/v1/alerts/delete":
@@ -720,6 +736,8 @@ class QuerierHTTP:
                     log.exception("querier 500")
                     self._send(500, {"error": f"{type(e).__name__}: {e}"})
 
+        # dd-trace clients PUT their trace payloads
+        Handler.do_PUT = Handler.do_POST
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]
         t = threading.Thread(target=self._httpd.serve_forever,

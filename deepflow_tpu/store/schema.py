@@ -16,7 +16,7 @@ L7_PROTOS = (
     "postgresql", "mongodb", "memcached", "mqtt", "amqp", "nats", "dubbo",
     "fastcgi", "tls", "ping", "rocketmq", "sofarpc", "zmtp",
     "openwire", "tars", "brpc", "oracle", "dameng", "iso8583", "netsign",
-    "websphere_mq", "someip")
+    "websphere_mq", "someip", "pulsar")
 RESPONSE_STATUS = ("unknown", "ok", "client_error", "server_error", "timeout")
 PROFILE_EVENT_TYPES = (
     "unknown", "on-cpu", "off-cpu", "mem-alloc", "tpu-device", "tpu-host")
@@ -275,6 +275,19 @@ _table("deepflow_system.deepflow_system", [
     C("metric_name", "str"),
     C("tag_json", "str"),
     C("value_name", "str"),
+    C("value", "f64"),
+    *UNIVERSAL_TAGS,
+])
+
+# -- telegraf / external metrics -------------------------------------------
+# reference: ingester/ext_metrics (telegraf influx line protocol ->
+# ext_metrics table); same shape as deepflow_system so the PromQL layer
+# serves both (metric = ext_metrics_<measurement>_<field>)
+_table("ext_metrics.metrics", [
+    C("time", "u64"),
+    C("metric_name", "str"),    # measurement
+    C("tag_json", "str"),
+    C("value_name", "str"),     # field key
     C("value", "f64"),
     *UNIVERSAL_TAGS,
 ])

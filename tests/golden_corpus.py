@@ -329,6 +329,27 @@ def build_cases() -> list[dict]:
     case("netsign", pb.NETSIGN, tcp_session(9989, ns),
          {"request_type": "sign", "records": 1})
 
+    # -- Pulsar (mq/pulsar.rs; [total][cmd_size][BaseCommand pb]) ------------
+    def pb_field(field, wt, val: bytes | int) -> bytes:
+        tag = bytes(varint((field << 3) | wt))
+        if wt == 0:
+            return tag + bytes(varint(val))
+        return tag + bytes(varint(len(val))) + val
+
+    def pulsar_frame(ctype: int, sub: bytes) -> bytes:
+        cmd = pb_field(1, 0, ctype) + pb_field(ctype, 2, sub)
+        return struct.pack(">II", 4 + len(cmd), len(cmd)) + cmd
+
+    producer = pulsar_frame(5, (
+        pb_field(1, 2, b"persistent://public/default/orders")
+        + pb_field(2, 0, 1) + pb_field(3, 0, 9)))
+    producer_ok = pulsar_frame(17, pb_field(1, 0, 9)
+                               + pb_field(2, 2, b"prod-1"))
+    case("pulsar", pb.PULSAR, tcp_session(6650, producer, producer_ok),
+         {"request_type": "Producer", "request_resource": "orders",
+          "endpoint": "Producer orders", "request_id": 9,
+          "response_status": 1, "records": 1})
+
     return cases
 
 

@@ -1,0 +1,158 @@
+"""Datadog (/v0.4/traces msgpack) and SkyWalking (/v3/segments) ingest.
+
+Reference analog: agent/src/integration_collector.rs:893 (datadog),
+ingester/flow_log decoder skywalking handler.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from deepflow_tpu.utils import msgpack
+
+
+def test_msgpack_roundtrip():
+    obj = {
+        "trace_id": 2 ** 63 + 5, "neg": -1234567, "small": -5,
+        "f": 1.25, "name": "web.request", "ok": True, "none": None,
+        "arr": list(range(20)), "bin": b"\x00\x01",
+        "nested": {"k" * 40: "v" * 300},
+    }
+    assert msgpack.unpackb(msgpack.packb(obj)) == obj
+
+
+def test_msgpack_rejects_garbage():
+    with pytest.raises(msgpack.MsgpackError):
+        msgpack.unpackb(b"\xc1")  # never-used type byte
+    with pytest.raises(msgpack.MsgpackError):
+        msgpack.unpackb(b"\xda\x00\x10abc")  # truncated str16
+    with pytest.raises(msgpack.MsgpackError):
+        msgpack.unpackb(msgpack.packb({"a": 1}) + b"x")  # trailing
+
+
+def _dd_span(trace_id, span_id, parent=0, service="checkout",
+             name="web.request", resource="/pay", error=0, code=200):
+    return {
+        "trace_id": trace_id, "span_id": span_id, "parent_id": parent,
+        "service": service, "name": name, "resource": resource,
+        "type": "web", "error": error,
+        "start": 1_700_000_000_000_000_000, "duration": 25_000_000,
+        "meta": {"http.method": "POST", "http.status_code": str(code),
+                 "http.host": "shop.example"},
+        "metrics": {"_sampling_priority_v1": 1},
+    }
+
+
+def test_datadog_and_skywalking_ingest():
+    from deepflow_tpu.query import execute
+    from deepflow_tpu.server import Server
+
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0).start()
+    try:
+        base = f"http://127.0.0.1:{server.query_port}"
+        # datadog: two traces, msgpack body, PUT like dd-trace does
+        body = msgpack.packb([
+            [_dd_span(7, 1), _dd_span(7, 2, parent=1, name="db.query",
+                                      resource="SELECT orders")],
+            [_dd_span(8, 9, error=1, code=500)],
+        ])
+        req = urllib.request.Request(f"{base}/v0.4/traces", data=body,
+                                     method="PUT")
+        out = json.loads(urllib.request.urlopen(req, timeout=5).read())
+        assert out == {"accepted_spans": 3}
+
+        # skywalking: one segment with an exit + entry span pair
+        seg = {
+            "traceId": "sw-trace-1", "traceSegmentId": "seg-a",
+            "service": "cart",
+            "spans": [
+                {"spanId": 0, "parentSpanId": -1,
+                 "operationName": "GET:/cart", "startTime": 1700000000100,
+                 "endTime": 1700000000150,
+                 "tags": [{"key": "http.method", "value": "GET"},
+                          {"key": "http.status_code", "value": "200"}]},
+                {"spanId": 1, "parentSpanId": 0, "isError": True,
+                 "operationName": "mysql/query", "startTime": 1700000000110,
+                 "endTime": 1700000000140, "tags": []},
+            ],
+        }
+        req = urllib.request.Request(f"{base}/v3/segments",
+                                     data=json.dumps(seg).encode(),
+                                     headers={"Content-Type":
+                                              "application/json"})
+        out = json.loads(urllib.request.urlopen(req, timeout=5).read())
+        assert out == {"accepted_spans": 2}
+
+        t = server.db.table("flow_log.l7_flow_log")
+        r = execute(t, "SELECT app_service, endpoint, response_code, "
+                       "response_status, trace_id, parent_span_id "
+                       "FROM l7_flow_log")
+        rows = r.values if hasattr(r, "values") else r["values"]
+        assert len(rows) == 5
+        dd = [x for x in rows if x[0] == "checkout"]
+        assert len(dd) == 3
+        # u64 ids rendered as 16-hex; parentage preserved
+        child = [x for x in dd if x[1] == "db.query"][0]
+        assert child[4] == f"{7:016x}"
+        assert child[5] == f"{1:016x}"
+        err = [x for x in dd if x[3] == "server_error"]
+        assert len(err) == 1 and err[0][2] == 500
+        sw = [x for x in rows if x[0] == "cart"]
+        assert len(sw) == 2
+        assert {x[4] for x in sw} == {"sw-trace-1"}
+        assert [x for x in sw if x[1] == "mysql/query"][0][5] == "seg-a-0"
+
+        # trace view joins the datadog parent/child spans
+        req = urllib.request.Request(
+            f"{base}/v1/trace/Tracing",
+            data=json.dumps({"trace_id": f"{7:016x}"}).encode())
+        tr = json.loads(urllib.request.urlopen(req, timeout=5).read())
+        assert tr["result"]["span_count"] == 2
+        root = tr["result"]["spans"][0]
+        assert root["children"], "child span must nest under the root"
+    finally:
+        server.stop()
+
+
+def test_msgpack_32bit_lengths_roundtrip():
+    big = {"s": "x" * 70000, "b": b"y" * 70000, "a": list(range(70000))}
+    assert msgpack.unpackb(msgpack.packb(big)) == big
+
+
+def test_bad_span_values_do_not_500_the_batch():
+    from deepflow_tpu.server.integration import IntegrationAPI
+    from deepflow_tpu.store import Database
+    api = IntegrationAPI(Database())
+    out = api.ingest_datadog(json.dumps([[{
+        "trace_id": 5, "span_id": 6, "service": "s", "name": "n",
+        "resource": "r", "start": 1, "duration": 2,
+        "meta": {"http.status_code": "error"},  # non-numeric tag
+    }]]).encode(), "application/json")
+    assert out == {"accepted_spans": 1}
+    out = api.ingest_skywalking({
+        "traceId": "t", "traceSegmentId": "seg", "service": "svc",
+        "spans": [{"spanId": 0, "parentSpanId": -1, "operationName": "op",
+                   "startTime": 1, "endTime": 2,
+                   "tags": [{"key": "status_code", "value": "OK"}]}]})
+    assert out == {"accepted_spans": 1}
+
+
+def test_skywalking_malformed_spans_are_isolated():
+    from deepflow_tpu.server.integration import IntegrationAPI
+    from deepflow_tpu.store import Database
+    api = IntegrationAPI(Database())
+    out = api.ingest_skywalking({
+        "traceId": "t", "traceSegmentId": "seg", "service": "svc",
+        "spans": [None, {"spanId": 1, "tags": None},
+                  {"spanId": 2, "parentSpanId": -1,
+                   "refs": [{"parentSpanId": 3}]},  # no parent segment id
+                  "junk"]})
+    assert out == {"accepted_spans": 2}
+    rows = api.db.table("flow_log.l7_flow_log").snapshot()
+    parents = []
+    for ch in rows:
+        if ch and len(ch.get("span_id", ())):
+            d = api.db.table("flow_log.l7_flow_log").dicts["parent_span_id"]
+            parents += [d.decode(int(x)) for x in ch["parent_span_id"]]
+    assert "None-3" not in parents  # missing ref segment id -> empty parent

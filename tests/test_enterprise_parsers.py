@@ -168,3 +168,68 @@ def test_hpack_huffman_rfc_vectors():
         assert huffman_decode(bytes.fromhex(hx)) == want
     # corrupt: EOS mid-string must fail
     assert huffman_decode(b"\xff\xff\xff\xff\xff") is None
+
+
+# -- Pulsar ------------------------------------------------------------------
+
+def _pbf(field, wt, val):
+    from deepflow_tpu.utils.promwire import varint
+    tag = bytes(varint((field << 3) | wt))
+    if wt == 0:
+        return tag + bytes(varint(val))
+    return tag + bytes(varint(len(val))) + val
+
+
+def _pulsar_cmd(ctype: int, sub: bytes) -> bytes:
+    import struct
+    cmd = _pbf(1, 0, ctype) + _pbf(ctype, 2, sub)
+    return struct.pack(">II", 4 + len(cmd), len(cmd)) + cmd
+
+
+def test_pulsar_connect_and_send_error():
+    from deepflow_tpu.agent.protocol_logs.base import get_parser
+    from deepflow_tpu.proto import pb
+    p = get_parser(pb.PULSAR)
+
+    # Connect (type 2): client_version=1, protocol_version=4, broker url=6
+    frame = _pulsar_cmd(2, _pbf(1, 2, b"client-3.1") + _pbf(4, 0, 21)
+                        + _pbf(6, 2, b"pulsar://broker:6650"))
+    assert p.check(frame, port_dst=9999)  # Connect passes off-port too
+    r = p.parse(frame)[0]
+    assert r.request_type == "Connect" and r.version == "21"
+    assert r.request_domain == "pulsar://broker:6650"
+
+    # SendError (type 8): producer 3, sequence 7, error code 2 + message
+    frame = _pulsar_cmd(8, _pbf(1, 0, 3) + _pbf(2, 0, 7) + _pbf(3, 0, 2)
+                        + _pbf(4, 2, b"PersistenceError"))
+    assert p.check(frame, port_dst=6650)
+    assert not p.check(frame, port_dst=9999)  # non-handshake needs the port
+    r = p.parse(frame, is_request=False)[0]
+    assert r.msg_type == 1 and r.response_status == 3
+    assert r.response_code == 2
+    assert r.response_exception == "PersistenceError"
+    assert r.request_id == (3 << 16) | 7
+
+
+def test_pulsar_session_commands_and_pipelining():
+    from deepflow_tpu.agent.protocol_logs.base import get_parser
+    from deepflow_tpu.proto import pb
+    p = get_parser(pb.PULSAR)
+    # Message (type 9, consumer_id + message_id) then Flow (type 11),
+    # pipelined in one segment
+    m1 = _pulsar_cmd(9, _pbf(1, 0, 2)
+                     + _pbf(2, 2, _pbf(1, 0, 5) + _pbf(2, 0, 6)))
+    m2 = _pulsar_cmd(11, _pbf(1, 0, 2) + _pbf(2, 0, 100))
+    recs = p.parse(m1 + m2, is_request=False)
+    assert [r.request_type for r in recs] == ["Message", "Flow"]
+    assert all(r.session_less for r in recs)
+
+
+def test_pulsar_rejects_garbage():
+    from deepflow_tpu.agent.protocol_logs.base import get_parser
+    from deepflow_tpu.proto import pb
+    p = get_parser(pb.PULSAR)
+    assert not p.check(b"\x00" * 16, port_dst=6650)
+    assert not p.check(b"GET / HTTP/1.1\r\n\r\n", port_dst=6650)
+    # truncated command
+    assert not p.check(_pulsar_cmd(18, b"")[:-2], port_dst=6650)
