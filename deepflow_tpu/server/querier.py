@@ -593,11 +593,19 @@ class QuerierHTTP:
                 self.end_headers()
                 self.wfile.write(data)
 
-            def _body(self) -> dict:
+            def _raw(self) -> bytes:
                 n = int(self.headers.get("Content-Length", 0))
-                if n == 0:
-                    return {}
-                return json.loads(self.rfile.read(n) or b"{}")
+                raw = self.rfile.read(n) if n else b""
+                # Telegraf's HTTP output gzips by default; dropping such a
+                # body as "0 accepted" with a 200 would silence all metrics
+                if raw and self.headers.get("Content-Encoding",
+                                            "").lower() == "gzip":
+                    import gzip
+                    raw = gzip.decompress(raw)
+                return raw
+
+            def _body(self) -> dict:
+                return json.loads(self._raw() or b"{}")
 
             def do_GET(self) -> None:
                 from urllib.parse import parse_qsl, urlparse
@@ -658,29 +666,22 @@ class QuerierHTTP:
                 try:
                     parsed = urlparse(self.path)
                     if parsed.path.rstrip("/") == "/api/v1/profile/ingest":
-                        n = int(self.headers.get("Content-Length", 0))
-                        raw = self.rfile.read(n) if n else b""
                         self._send(200, api.integration.ingest_profile(
-                            dict(parse_qsl(parsed.query)), raw))
+                            dict(parse_qsl(parsed.query)), self._raw()))
                         return
                     if parsed.path.rstrip("/") == "/api/v1/write":
-                        n = int(self.headers.get("Content-Length", 0))
-                        raw = self.rfile.read(n) if n else b""
-                        self._send(200,
-                                   api.integration.ingest_prometheus(raw))
+                        self._send(200, api.integration.ingest_prometheus(
+                            self._raw()))
                         return
                     if parsed.path.rstrip("/") == "/api/v1/telegraf":
-                        n = int(self.headers.get("Content-Length", 0))
-                        raw = self.rfile.read(n) if n else b""
-                        self._send(200,
-                                   api.integration.ingest_telegraf(raw))
+                        self._send(200, api.integration.ingest_telegraf(
+                            self._raw()))
                         return
                     if parsed.path.rstrip("/") in ("/v0.3/traces",
                                                    "/v0.4/traces"):
-                        n = int(self.headers.get("Content-Length", 0))
-                        raw = self.rfile.read(n) if n else b""
                         self._send(200, api.integration.ingest_datadog(
-                            raw, self.headers.get("Content-Type", "")))
+                            self._raw(),
+                            self.headers.get("Content-Type", "")))
                         return
                     body = self._body()
                     path = parsed.path.rstrip("/")
@@ -736,8 +737,15 @@ class QuerierHTTP:
                     log.exception("querier 500")
                     self._send(500, {"error": f"{type(e).__name__}: {e}"})
 
-        # dd-trace clients PUT their trace payloads
-        Handler.do_PUT = Handler.do_POST
+            def do_PUT(self) -> None:
+                # only dd-trace PUTs are method-aliased; the rest of the
+                # POST router must not gain mutation-via-PUT
+                from urllib.parse import urlparse
+                if urlparse(self.path).path.rstrip("/") in (
+                        "/v0.3/traces", "/v0.4/traces"):
+                    return self.do_POST()
+                self._send(405, {"error": "method not allowed"})
+
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]
         t = threading.Thread(target=self._httpd.serve_forever,

@@ -55,6 +55,20 @@ def _split_unescaped(s: str, sep: str, quotes: bool = False) -> list[str]:
     return out
 
 
+def _partition_unescaped(s: str, sep: str = "=") -> tuple[str, str | None]:
+    """Split at the first unescaped sep; None if absent. partition() would
+    split spec-legal escaped separators in keys (e.g. tag key 'a\\=b')."""
+    i = 0
+    while i < len(s):
+        if s[i] == "\\" and i + 1 < len(s):
+            i += 2
+            continue
+        if s[i] == sep:
+            return s[:i], s[i + 1:]
+        i += 1
+    return s, None
+
+
 def _unescape(s: str) -> str:
     out, i = [], 0
     while i < len(s):
@@ -123,15 +137,13 @@ def parse_line(line: str) -> Point:
     if not p.measurement:
         raise LineProtocolError("empty measurement")
     for kv in keyparts[1:]:
-        k, eq, v = kv.partition("=")
-        if not eq or not k:
+        k, v = _partition_unescaped(kv)
+        if v is None or not k:
             raise LineProtocolError(f"bad tag {kv!r}")
         p.tags[_unescape(k)] = _unescape(v)
     for kv in _split_unescaped(fieldset, ",", quotes=True):
-        # split key=value on the first '='; field values may themselves
-        # contain '=' only inside quoted strings, after the first '='
-        k, eq, v = kv.partition("=")
-        if not eq or not k:
+        k, v = _partition_unescaped(kv)
+        if v is None or not k:
             raise LineProtocolError(f"bad field {kv!r}")
         p.fields[_unescape(k)] = _parse_field_value(v)
     if not p.fields:

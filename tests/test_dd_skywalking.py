@@ -156,3 +156,19 @@ def test_skywalking_malformed_spans_are_isolated():
             d = api.db.table("flow_log.l7_flow_log").dicts["parent_span_id"]
             parents += [d.decode(int(x)) for x in ch["parent_span_id"]]
     assert "None-3" not in parents  # missing ref segment id -> empty parent
+
+
+def test_put_is_scoped_to_datadog_paths():
+    import urllib.error
+
+    from deepflow_tpu.server import Server
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0).start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.query_port}/v1/alerts",
+            data=b"{}", method="PUT")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=5)
+        assert e.value.code == 405
+    finally:
+        server.stop()

@@ -105,3 +105,25 @@ def test_literal_quotes_in_tags_are_not_special():
     assert p.fields == {"used": 5} and p.timestamp_ns == 123
     p2 = parse_line('m"q,t="v" value=1')
     assert p2.measurement == 'm"q' and p2.tags == {"t": '"v"'}
+
+
+def test_escaped_equals_in_tag_key():
+    p = parse_line('m,a\\=b=c f=1')
+    assert p.tags == {"a=b": "c"} and p.fields == {"f": 1.0}
+
+
+def test_gzipped_telegraf_body():
+    import gzip
+    import urllib.request
+
+    from deepflow_tpu.server import Server
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0).start()
+    try:
+        body = gzip.compress(b"cpu,host=gz usage=1.5 1700000000000000000")
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.query_port}/api/v1/telegraf",
+            data=body, headers={"Content-Encoding": "gzip"})
+        out = json.loads(urllib.request.urlopen(req, timeout=5).read())
+        assert out == {"accepted": 1, "bad_lines": 0}
+    finally:
+        server.stop()
