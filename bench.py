@@ -153,8 +153,8 @@ def _bench_packet_path() -> dict:
 def _bench_ingest() -> dict:
     """Ingest path: serialized FlowLogBatches through the real receiver ->
     decoder -> columnar store, in the DEFAULT single-worker configuration
-    (measured: extra workers don't pay — row building is GIL-bound even
-    though upb parses outside the GIL; see Decoder.WORKERS)."""
+    (measured: extra workers don't pay — the columnar build is GIL-bound
+    even though upb parses outside the GIL; see Decoder.WORKERS)."""
     import socket
 
     from deepflow_tpu.codec import FrameHeader, MessageType, encode_frame

@@ -437,6 +437,11 @@ class ExporterManager:
         for e in self.exporters:
             e.feed(table, rows)
 
+    def wants(self, table: str) -> bool:
+        """Does any registered exporter accept this table? Lets hot-path
+        writers skip materializing row dicts when nobody is listening."""
+        return any(e.accepts(table) for e in self.exporters)
+
     def stop(self) -> None:
         for e in self.exporters:
             e.stop()

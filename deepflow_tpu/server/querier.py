@@ -20,6 +20,9 @@ from deepflow_tpu.store.db import Database
 
 log = logging.getLogger("df.querier")
 
+# dd-trace agent API paths: accepted on POST and (dd default) PUT
+_DD_TRACE_PATHS = ("/v0.3/traces", "/v0.4/traces")
+
 
 class QuerierAPI:
     """Route logic, separated from HTTP plumbing for in-process use."""
@@ -601,7 +604,11 @@ class QuerierHTTP:
                 if raw and self.headers.get("Content-Encoding",
                                             "").lower() == "gzip":
                     import gzip
-                    raw = gzip.decompress(raw)
+                    try:
+                        raw = gzip.decompress(raw)
+                    except (OSError, EOFError) as e:
+                        # client-side input error -> 400, not a 500
+                        raise ValueError(f"bad gzip body: {e}") from None
                 return raw
 
             def _body(self) -> dict:
@@ -677,8 +684,7 @@ class QuerierHTTP:
                         self._send(200, api.integration.ingest_telegraf(
                             self._raw()))
                         return
-                    if parsed.path.rstrip("/") in ("/v0.3/traces",
-                                                   "/v0.4/traces"):
+                    if parsed.path.rstrip("/") in _DD_TRACE_PATHS:
                         self._send(200, api.integration.ingest_datadog(
                             self._raw(),
                             self.headers.get("Content-Type", "")))
@@ -741,8 +747,7 @@ class QuerierHTTP:
                 # only dd-trace PUTs are method-aliased; the rest of the
                 # POST router must not gain mutation-via-PUT
                 from urllib.parse import urlparse
-                if urlparse(self.path).path.rstrip("/") in (
-                        "/v0.3/traces", "/v0.4/traces"):
+                if urlparse(self.path).path.rstrip("/") in _DD_TRACE_PATHS:
                     return self.do_POST()
                 self._send(405, {"error": "method not allowed"})
 

@@ -112,7 +112,13 @@ def test_push_stream_delivers_config_instantly():
                 agent.synchronizer.stats["syncs"] == 0:
             time.sleep(0.05)
         assert agent.synchronizer.config_version == 1
-        time.sleep(0.5)  # let the push stream subscribe
+        # wait until the push stream is actually subscribed (a fixed sleep
+        # flakes under full-suite load)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and \
+                server.controller.push_streams == 0:
+            time.sleep(0.05)
+        assert server.controller.push_streams >= 1
 
         server.controller.configs.update(
             "default", b"profiler:\n  sample_hz: 123.0\n")

@@ -127,3 +127,20 @@ def test_gzipped_telegraf_body():
         assert out == {"accepted": 1, "bad_lines": 0}
     finally:
         server.stop()
+
+
+def test_corrupt_gzip_is_400():
+    import urllib.error
+    import urllib.request
+
+    from deepflow_tpu.server import Server
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0).start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.query_port}/api/v1/telegraf",
+            data=b"\x1f\x8bnot-gzip", headers={"Content-Encoding": "gzip"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=5)
+        assert e.value.code == 400
+    finally:
+        server.stop()
