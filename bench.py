@@ -246,9 +246,29 @@ def _bench_extprofiler() -> dict:
         child.kill()
 
 
+def _device_init_ok(timeout_s: float = 120.0) -> bool:
+    """Probe backend init in a SUBPROCESS with a deadline. The axon TPU
+    relay can wedge (observed: jax.devices() blocked 20+ min at 0% CPU);
+    a dead tunnel must degrade the bench to CPU, not hang the round."""
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].device_kind)"],
+            capture_output=True, timeout=timeout_s)
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+    return out.returncode == 0 and bool(out.stdout.strip())
+
+
 def main() -> None:
     import jax
 
+    if not _device_init_ok():
+        print("bench: device backend init timed out; falling back to CPU",
+              file=sys.stderr)
+        jax.config.update("jax_platforms", "cpu")
     dev = jax.devices()[0]
     chain, params, opt_state, tokens, k_steps = _build(dev.device_kind)
 

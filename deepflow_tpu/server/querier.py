@@ -604,9 +604,10 @@ class QuerierHTTP:
                 if raw and self.headers.get("Content-Encoding",
                                             "").lower() == "gzip":
                     import gzip
+                    import zlib
                     try:
                         raw = gzip.decompress(raw)
-                    except (OSError, EOFError) as e:
+                    except (OSError, EOFError, zlib.error) as e:
                         # client-side input error -> 400, not a 500
                         raise ValueError(f"bad gzip body: {e}") from None
                 return raw

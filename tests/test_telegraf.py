@@ -136,11 +136,16 @@ def test_corrupt_gzip_is_400():
     from deepflow_tpu.server import Server
     server = Server(host="127.0.0.1", ingest_port=0, query_port=0).start()
     try:
-        req = urllib.request.Request(
-            f"http://127.0.0.1:{server.query_port}/api/v1/telegraf",
-            data=b"\x1f\x8bnot-gzip", headers={"Content-Encoding": "gzip"})
-        with pytest.raises(urllib.error.HTTPError) as e:
-            urllib.request.urlopen(req, timeout=5)
-        assert e.value.code == 400
+        # bad magic (BadGzipFile/OSError) and corrupt deflate stream
+        # (zlib.error) must both map to 400
+        bodies = (b"\x1f\x8bnot-gzip",
+                  b"\x1f\x8b\x08\x00\x00\x00\x00\x00\x00\x03garbage")
+        for body in bodies:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.query_port}/api/v1/telegraf",
+                data=body, headers={"Content-Encoding": "gzip"})
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req, timeout=5)
+            assert e.value.code == 400, body
     finally:
         server.stop()
