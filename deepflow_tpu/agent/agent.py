@@ -330,6 +330,11 @@ class Agent:
                 "rss_mb": self.guard.rss_mb,
                 "degraded": int(self.guard.degraded),
                 **self.guard.stats})
+        sync = getattr(self, "synchronizer", None)
+        if sync is not None and sync.stats.get("ntp_syncs"):
+            metric("agent.clock", {
+                "offset_ms": sync.clock_offset_ns / 1e6,
+                "ntp_rtt_ms": sync.ntp_rtt_ns / 1e6})
         self.sender.send(MessageType.DFSTATS, batch.SerializeToString())
 
 

@@ -10,7 +10,10 @@ from __future__ import annotations
 import logging
 import os
 import socket
+import statistics
 import threading
+import time
+from collections import deque
 
 import grpc
 
@@ -19,6 +22,7 @@ from deepflow_tpu.proto import pb
 log = logging.getLogger("df.sync")
 
 _SYNC = "/deepflow_tpu.Synchronizer/Sync"
+_NTP = "/deepflow_tpu.Synchronizer/Ntp"
 _GPID = "/deepflow_tpu.Synchronizer/GpidSync"
 _PUSH = "/deepflow_tpu.Synchronizer/Push"
 _PODMAP = "/deepflow_tpu.Synchronizer/PodMap"
@@ -46,6 +50,11 @@ class Synchronizer:
         self._ops = CommandRegistry(agent)
         self._apply_lock = threading.Lock()  # poll + push threads both apply
         self.stats = {"syncs": 0, "errors": 0, "config_updates": 0}
+        # NTP clock sync vs the controller (reference: rpc/ntp.rs): median
+        # over recent min-rtt exchanges damps outliers from GC/net jitter
+        self.clock_offset_ns = 0
+        self.ntp_rtt_ns = 0
+        self._ntp_samples: deque[int] = deque(maxlen=5)
 
     def start(self) -> "Synchronizer":
         self._channel = grpc.insecure_channel(self.addr)
@@ -113,7 +122,41 @@ class Synchronizer:
             if self._stop.wait(self.interval_s):
                 return
 
+    def ntp_sync(self, exchanges: int = 3) -> int:
+        """One NTP round: several 4-timestamp exchanges, keep the offset
+        from the minimum-RTT one (least queueing noise), fold into the
+        recent-sample median. Returns the current smoothed offset (ns)."""
+        best_rtt = None
+        best_off = 0
+        call = self._channel.unary_unary(
+            _NTP,
+            request_serializer=pb.NtpRequest.SerializeToString,
+            response_deserializer=pb.NtpResponse.FromString)
+        for _ in range(exchanges):
+            t1 = time.time_ns()
+            resp = call(pb.NtpRequest(t1_ns=t1), timeout=5.0)
+            t4 = time.time_ns()
+            if resp.t1_ns != t1:
+                continue  # not our exchange
+            rtt = (t4 - t1) - (resp.t3_ns - resp.t2_ns)
+            off = ((resp.t2_ns - t1) + (resp.t3_ns - t4)) // 2
+            if rtt >= 0 and (best_rtt is None or rtt < best_rtt):
+                best_rtt, best_off = rtt, off
+        if best_rtt is not None:
+            self._ntp_samples.append(best_off)
+            self.ntp_rtt_ns = best_rtt
+            self.clock_offset_ns = int(
+                statistics.median(self._ntp_samples))
+            self.stats["ntp_syncs"] = self.stats.get("ntp_syncs", 0) + 1
+        return self.clock_offset_ns
+
     def sync_once(self) -> pb.SyncResponse:
+        try:
+            self.ntp_sync()
+        except Exception as e:
+            # clock sync is best-effort; a failed exchange must not block
+            # config/platform sync
+            log.debug("ntp sync failed: %s", e)
         req = pb.SyncRequest()
         req.ctrl_ip = _local_ip()
         req.hostname = socket.gethostname()
@@ -132,6 +175,9 @@ class Synchronizer:
             req.mem_bytes = int(guard.rss_mb * 1024 * 1024)
         req.version = "0.1.0"
         req.agent_group = getattr(self.agent.config, "group", "") or "default"
+        # clock_offset_ns = controller_clock - agent_clock: the amount the
+        # server ADDS to this agent's absolute timestamps at ingest
+        req.clock_offset_ns = self.clock_offset_ns
         with self._results_lock:
             sent_results = list(self._pending_results)
         for r in sent_results:

@@ -80,6 +80,8 @@ class AgentRegistry:
                 entry["mem_bytes"] = int(request.mem_bytes)
                 entry["agent_group"] = request.agent_group or "default"
                 entry["config_version"] = int(request.config_version)
+                entry["clock_offset_ms"] = round(
+                    request.clock_offset_ns / 1e6, 3)
             return entry
 
     def list(self) -> list[dict]:
@@ -305,6 +307,11 @@ class Controller:
 
         if request.HasField("platform"):
             self._ingest_platform(agent_id, request.platform)
+        if request.clock_offset_ns:
+            # ingest-time normalization: decoders shift this agent's
+            # absolute timestamps onto the controller clock
+            self.platform_table.set_clock_offset(agent_id,
+                                                 request.clock_offset_ns)
         for proc in request.processes:
             self.gpids.gpid_for(agent_id, proc.pid)
 
@@ -321,6 +328,15 @@ class Controller:
             resp.analyzer_assignment = self._analyzers_managed
         for addr in self.assign_analyzers(agent_id):
             resp.analyzer_addrs.append(addr)
+        return resp
+
+    def Ntp(self, request: pb.NtpRequest, context) -> pb.NtpResponse:
+        """4-timestamp NTP exchange (reference: agent/src/rpc/ntp.rs).
+        t2 is stamped on entry, t3 right before serialization."""
+        resp = pb.NtpResponse()
+        resp.t1_ns = request.t1_ns
+        resp.t2_ns = time.time_ns()
+        resp.t3_ns = time.time_ns()
         return resp
 
     def set_analyzers(self, addrs: list[str]) -> None:
@@ -496,6 +512,9 @@ class Controller:
         async def podmap_h(request, context):
             return self.PodMap(request, context)
 
+        async def ntp_h(request, context):
+            return self.Ntp(request, context)
+
         handlers = {
             "Sync": grpc.unary_unary_rpc_method_handler(
                 sync_h,
@@ -513,6 +532,10 @@ class Controller:
                 podmap_h,
                 request_deserializer=pb.PodMapRequest.FromString,
                 response_serializer=pb.PodMapResponse.SerializeToString),
+            "Ntp": grpc.unary_unary_rpc_method_handler(
+                ntp_h,
+                request_deserializer=pb.NtpRequest.FromString,
+                response_serializer=pb.NtpResponse.SerializeToString),
             "Push": grpc.unary_stream_rpc_method_handler(
                 self.Push,
                 request_deserializer=pb.SyncRequest.FromString,

@@ -88,6 +88,12 @@ class Decoder:
     def handle(self, header: FrameHeader, payload: bytes) -> int:
         raise NotImplementedError
 
+    def _clock_offset(self, header: FrameHeader) -> int:
+        """NTP normalization: ns to add to this agent's absolute
+        timestamps (sub-ms offsets are measurement noise, not skew)."""
+        off = self.platform.offset_for(header.agent_id)
+        return off if abs(off) >= 1_000_000 else 0
+
     def write(self, table_name: str, rows: list[dict]) -> None:
         """Append + feed the re-export pipeline (reference: exporters)."""
         self.db.table(table_name).append_rows(rows)
@@ -116,10 +122,11 @@ class ProfileDecoder(Decoder):
     def handle(self, header: FrameHeader, payload: bytes) -> int:
         batch = pb.ProfileBatch.FromString(payload)
         tags = self.platform.tags_for(header.agent_id)
+        off = self._clock_offset(header)
         rows = []
         for p in batch.profiles:
             rows.append({
-                "time": p.timestamp_ns,
+                "time": p.timestamp_ns + off,
                 "app_service": p.app_service or p.process_name,
                 "process_name": p.process_name,
                 "event_type": int(p.event_type),
@@ -144,10 +151,11 @@ class TpuSpanDecoder(Decoder):
     def handle(self, header: FrameHeader, payload: bytes) -> int:
         batch = pb.TpuSpanBatch.FromString(payload)
         tags = self.platform.tags_for(header.agent_id)
+        off = self._clock_offset(header)
         rows = []
         for s in batch.spans:
             rows.append({
-                "time": s.start_ns,
+                "time": s.start_ns + off,
                 "duration_ns": s.duration_ns,
                 "device_id": s.device_id,
                 "chip_id": s.chip_id,
@@ -263,6 +271,11 @@ class FlowLogDecoder(Decoder):
             pod = pods.get(ip_str)
             return pod.name if pod is not None else ""
 
+        # NTP normalization: shift this agent's absolute timestamps onto
+        # the controller clock (reference corrects on-agent in rpc/ntp.rs;
+        # here ingest-side so every telemetry family is covered at one
+        # choke point). Sub-ms offsets are noise, not skew.
+        off = self._clock_offset(header)
         n = 0
         if batch.l4:
             # columnar build: one C-speed comprehension per column instead
@@ -275,7 +288,7 @@ class FlowLogDecoder(Decoder):
             gp0, gp1, pod_0, pod_1 = self._endpoint_cols(
                 l4, keys, src_s, dst_s, pods, pod_of)
             cols = {
-                "time": [f.end_time_ns for f in l4],
+                "time": [f.end_time_ns + off for f in l4],
                 "flow_id": [f.flow_id for f in l4],
                 "ip_src": src_s,
                 "ip_dst": dst_s,
@@ -285,8 +298,8 @@ class FlowLogDecoder(Decoder):
                 "port_dst": [k.port_dst for k in keys],
                 "protocol": [int(k.proto) for k in keys],
                 "tap_port": [k.tap_port for k in keys],
-                "start_time": [f.start_time_ns for f in l4],
-                "end_time": [f.end_time_ns for f in l4],
+                "start_time": [f.start_time_ns + off for f in l4],
+                "end_time": [f.end_time_ns + off for f in l4],
                 "packet_tx": [f.packet_tx for f in l4],
                 "packet_rx": [f.packet_rx for f in l4],
                 "byte_tx": [f.byte_tx for f in l4],
@@ -321,7 +334,7 @@ class FlowLogDecoder(Decoder):
             gp0, gp1, pod_0, pod_1 = self._endpoint_cols(
                 l7, keys, src_s, dst_s, pods, pod_of)
             cols = {
-                "time": [f.start_time_ns for f in l7],
+                "time": [f.start_time_ns + off for f in l7],
                 "flow_id": [f.flow_id for f in l7],
                 "ip_src": src_s,
                 "ip_dst": dst_s,
@@ -380,11 +393,12 @@ class MetricsDecoder(Decoder):
     def handle(self, header: FrameHeader, payload: bytes) -> int:
         batch = pb.DocumentBatch.FromString(payload)
         tags = self.platform.tags_for(header.agent_id)
+        off_s = round(self._clock_offset(header) / 1e9)  # table is 1s-grain
         n = 0
 
         def base_cols(docs):
             cols = {
-                "time": [d.timestamp_s for d in docs],
+                "time": [d.timestamp_s + off_s for d in docs],
                 "ip_src": [_ip_str(d.tag.ip_src) for d in docs],
                 "ip_dst": [_ip_str(d.tag.ip_dst) for d in docs],
                 "server_port": [d.tag.port for d in docs],
@@ -444,12 +458,13 @@ class StatsDecoder(Decoder):
     def handle(self, header: FrameHeader, payload: bytes) -> int:
         batch = pb.StatsBatch.FromString(payload)
         tags = self.platform.tags_for(header.agent_id)
+        off = self._clock_offset(header)
         rows = []
         for m in batch.metrics:
             tag_json = json.dumps(dict(m.tags), sort_keys=True)
             for vname, v in m.values.items():
                 rows.append({
-                    "time": m.timestamp_ns,
+                    "time": m.timestamp_ns + off,
                     "metric_name": m.name,
                     "tag_json": tag_json,
                     "value_name": vname,
@@ -483,8 +498,9 @@ class EventDecoder(Decoder):
     def handle(self, header: FrameHeader, payload: bytes) -> int:
         batch = pb.EventBatch.FromString(payload)
         tags = self.platform.tags_for(header.agent_id)
+        off = self._clock_offset(header)
         rows = [{
-            "time": e.timestamp_ns,
+            "time": e.timestamp_ns + off,
             "event_type": e.event_type,
             "resource_type": e.resource_type,
             "resource_name": e.resource_name,

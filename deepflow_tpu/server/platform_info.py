@@ -48,6 +48,9 @@ class PlatformInfoTable:
         self._lock = threading.Lock()
         self._agents: dict[int, AgentInfo] = {}
         self._next_host_id = 1
+        # NTP-measured per-agent skew vs the controller clock; kept apart
+        # from AgentInfo so platform re-uploads don't clobber it
+        self._clock_offsets: dict[int, int] = {}
 
     def update(self, info: AgentInfo) -> None:
         with self._lock:
@@ -71,6 +74,17 @@ class PlatformInfoTable:
         if info is _EMPTY:
             return {"agent_id": agent_id}
         return info.tags()
+
+    def set_clock_offset(self, agent_id: int, offset_ns: int) -> None:
+        with self._lock:
+            self._clock_offsets[agent_id] = int(offset_ns)
+
+    def offset_for(self, agent_id: int) -> int:
+        """ns to ADD to this agent's absolute timestamps to land on the
+        controller clock (decoders normalize at ingest; reference agents
+        correct on-host via rpc/ntp.rs — same capability, ingest-side)."""
+        with self._lock:
+            return self._clock_offsets.get(agent_id, 0)
 
 
 @dataclass

@@ -1,0 +1,121 @@
+"""NTP-style clock sync: agent offset measurement -> Sync report ->
+ingest-time timestamp normalization.
+
+Reference analog: agent/src/rpc/ntp.rs + the Ntp rpc (message/agent.proto:10);
+our design corrects at ingest (one choke point for every telemetry family)
+instead of on-agent.
+"""
+
+import queue
+import time
+
+import pytest
+
+from deepflow_tpu.proto import pb
+
+
+def test_offset_math_matches_ntp():
+    # offset = ((t2-t1)+(t3-t4))/2: agent 100ns behind the server, 40ns rtt
+    t1 = 1000
+    t2 = 1120          # = t1 + offset(100) + uplink(20)
+    t3 = 1130
+    t4 = 1050          # = t3 - offset(100) + downlink(20)
+    off = ((t2 - t1) + (t3 - t4)) // 2
+    rtt = (t4 - t1) - (t3 - t2)
+    assert off == 100 and rtt == 40
+
+
+def test_ntp_rpc_and_sync_report():
+    from deepflow_tpu.agent.agent import Agent
+    from deepflow_tpu.agent.config import AgentConfig
+    from deepflow_tpu.server import Server
+
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0,
+                    sync_port=0, enable_controller=True).start()
+    agent = None
+    try:
+        cfg = AgentConfig()
+        cfg.sender.servers = [("127.0.0.1", server.ingest_port)]
+        cfg.controller = f"127.0.0.1:{server.controller.port}"
+        cfg.profiler.enabled = False
+        cfg.tpuprobe.enabled = False
+        cfg.sync_interval_s = 3600
+        agent = Agent(cfg).start()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and \
+                agent.synchronizer.stats.get("ntp_syncs", 0) == 0:
+            time.sleep(0.05)
+        assert agent.synchronizer.stats.get("ntp_syncs", 0) >= 1
+        # same host, same clock: measured offset must be tiny
+        assert abs(agent.synchronizer.clock_offset_ns) < 200_000_000
+        assert agent.synchronizer.ntp_rtt_ns > 0
+        # reported into the fleet health view
+        agents = server.controller.registry.list()
+        assert agents and "clock_offset_ms" in agents[0]
+    finally:
+        if agent:
+            agent.stop()
+        server.stop()
+
+
+def test_ingest_normalizes_skewed_agent():
+    from deepflow_tpu.codec import FrameHeader, MessageType
+    from deepflow_tpu.server.decoders import FlowLogDecoder, StatsDecoder
+    from deepflow_tpu.server.platform_info import PlatformInfoTable
+    from deepflow_tpu.store import Database
+
+    db = Database()
+    platform = PlatformInfoTable()
+    platform.set_clock_offset(7, 5_000_000_000)  # agent 5s behind
+
+    batch = pb.FlowLogBatch()
+    f = batch.l4.add()
+    f.flow_id = 1
+    f.key.ip_src = bytes([10, 0, 0, 1])
+    f.key.ip_dst = bytes([10, 0, 0, 2])
+    f.key.proto = 1
+    f.start_time_ns = 1_000_000_000_000
+    f.end_time_ns = 1_000_500_000_000
+    dec = FlowLogDecoder(queue.Queue(), db, platform)
+    dec.handle(FrameHeader(MessageType.L4_LOG, agent_id=7),
+               batch.SerializeToString())
+    ch = db.table("flow_log.l4_flow_log").snapshot()
+    times = [int(x) for c in ch if c for x in c["time"]]
+    assert times == [1_000_500_000_000 + 5_000_000_000]
+
+    # an agent below the 1ms noise floor is untouched
+    platform.set_clock_offset(8, 400_000)
+    dec.handle(FrameHeader(MessageType.L4_LOG, agent_id=8),
+               batch.SerializeToString())
+    sb = pb.StatsBatch()
+    m = sb.metrics.add()
+    m.name = "agent.sender"
+    m.timestamp_ns = 2_000_000_000_000
+    m.values["sent"] = 1.0
+    sdec = StatsDecoder(queue.Queue(), db, platform)
+    sdec.handle(FrameHeader(MessageType.DFSTATS, agent_id=7),
+                sb.SerializeToString())
+    ch = db.table("deepflow_system.deepflow_system").snapshot()
+    times = [int(x) for c in ch if c for x in c["time"]]
+    assert times == [2_000_000_000_000 + 5_000_000_000]
+
+
+def test_ntp_sync_smoothing_rejects_outliers():
+    from deepflow_tpu.agent.synchronizer import Synchronizer
+
+    class FakeAgent:
+        class config:
+            agent_id = 1
+        process_name = "t"
+        sender = type("S", (), {"servers": []})()
+
+    s = Synchronizer.__new__(Synchronizer)
+    from collections import deque
+    s._ntp_samples = deque(maxlen=5)
+    s.clock_offset_ns = 0
+    s.ntp_rtt_ns = 0
+    s.stats = {}
+    import statistics
+    for off in (100, 110, 9_000_000, 105, 95):  # one GC-pause outlier
+        s._ntp_samples.append(off)
+    assert int(statistics.median(s._ntp_samples)) == 105
