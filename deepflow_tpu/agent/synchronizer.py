@@ -176,8 +176,12 @@ class Synchronizer:
         req.version = "0.1.0"
         req.agent_group = getattr(self.agent.config, "group", "") or "default"
         # clock_offset_ns = controller_clock - agent_clock: the amount the
-        # server ADDS to this agent's absolute timestamps at ingest
-        req.clock_offset_ns = self.clock_offset_ns
+        # server ADDS to this agent's absolute timestamps at ingest.
+        # Presence contract (messages.proto:392): only set once measured —
+        # a restarted agent must not clear the controller's stored skew
+        # with an unmeasured 0 before its first NTP exchange completes.
+        if self._ntp_samples:
+            req.clock_offset_ns = self.clock_offset_ns
         with self._results_lock:
             sent_results = list(self._pending_results)
         for r in sent_results:

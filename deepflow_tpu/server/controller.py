@@ -80,8 +80,13 @@ class AgentRegistry:
                 entry["mem_bytes"] = int(request.mem_bytes)
                 entry["agent_group"] = request.agent_group or "default"
                 entry["config_version"] = int(request.config_version)
-                entry["clock_offset_ms"] = round(
-                    request.clock_offset_ns / 1e6, 3)
+                if request.HasField("clock_offset_ns"):
+                    entry["clock_offset_ms"] = round(
+                        request.clock_offset_ns / 1e6, 3)
+                else:
+                    # unmeasured, not "0 ms skew" — operators must be able
+                    # to tell the two apart in /v1/agents
+                    entry["clock_offset_ms"] = None
             return entry
 
     def list(self) -> list[dict]:
@@ -307,7 +312,7 @@ class Controller:
 
         if request.HasField("platform"):
             self._ingest_platform(agent_id, request.platform)
-        if request.clock_offset_ns:
+        if request.HasField("clock_offset_ns"):
             # ingest-time normalization: decoders shift this agent's
             # absolute timestamps onto the controller clock
             self.platform_table.set_clock_offset(agent_id,

@@ -14,6 +14,7 @@ bool (t/true/T/f/false/F), string ("...").
 
 from __future__ import annotations
 
+import math
 from dataclasses import dataclass, field
 
 
@@ -127,7 +128,12 @@ def _parse_field_value(v: str):
         return False
     if v[-1] in "iu":
         return int(v[:-1])
-    return float(v)
+    f = float(v)
+    # line protocol has no NaN/inf literal; float() accepting 'nan'/'inf'
+    # would otherwise poison sum/avg aggregations over the stored series
+    if not math.isfinite(f):
+        raise LineProtocolError(f"non-finite field value {v!r}")
+    return f
 
 
 def parse_line(line: str) -> Point:

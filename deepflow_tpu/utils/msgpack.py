@@ -20,7 +20,15 @@ def _need(buf: bytes, i: int, n: int) -> None:
         raise MsgpackError("truncated msgpack")
 
 
-def _decode(buf: bytes, i: int):
+# dd-trace payloads are at most a few levels deep; a bound keeps a
+# crafted body of nested fixarrays from hitting Python's recursion limit
+# (which would surface as a 500 instead of a 400 MsgpackError).
+_MAX_DEPTH = 100
+
+
+def _decode(buf: bytes, i: int, depth: int = 0):
+    if depth > _MAX_DEPTH:
+        raise MsgpackError("msgpack nesting too deep")
     _need(buf, i, 1)
     b = buf[i]
     i += 1
@@ -29,9 +37,9 @@ def _decode(buf: bytes, i: int):
     if b >= 0xE0:                       # negative fixint
         return b - 0x100, i
     if 0x80 <= b <= 0x8F:               # fixmap
-        return _decode_map(buf, i, b & 0x0F)
+        return _decode_map(buf, i, b & 0x0F, depth + 1)
     if 0x90 <= b <= 0x9F:               # fixarray
-        return _decode_array(buf, i, b & 0x0F)
+        return _decode_array(buf, i, b & 0x0F, depth + 1)
     if 0xA0 <= b <= 0xBF:               # fixstr
         n = b & 0x1F
         _need(buf, i, n)
@@ -74,28 +82,32 @@ def _decode(buf: bytes, i: int):
         w = 2 << (b - 0xDC)
         _need(buf, i, w)
         n = int.from_bytes(buf[i:i + w], "big")
-        return _decode_array(buf, i + w, n)
+        return _decode_array(buf, i + w, n, depth + 1)
     if b in (0xDE, 0xDF):               # map16/32
         w = 2 << (b - 0xDE)
         _need(buf, i, w)
         n = int.from_bytes(buf[i:i + w], "big")
-        return _decode_map(buf, i + w, n)
+        return _decode_map(buf, i + w, n, depth + 1)
     raise MsgpackError(f"unsupported msgpack type byte 0x{b:02x}")
 
 
-def _decode_array(buf: bytes, i: int, n: int):
+def _decode_array(buf: bytes, i: int, n: int, depth: int = 0):
     out = []
     for _ in range(n):
-        v, i = _decode(buf, i)
+        v, i = _decode(buf, i, depth)
         out.append(v)
     return out, i
 
 
-def _decode_map(buf: bytes, i: int, n: int):
+def _decode_map(buf: bytes, i: int, n: int, depth: int = 0):
     out = {}
     for _ in range(n):
-        k, i = _decode(buf, i)
-        v, i = _decode(buf, i)
+        k, i = _decode(buf, i, depth)
+        if isinstance(k, (list, dict)):
+            # unhashable key would raise TypeError -> generic 500 at the
+            # HTTP layer; crafted input must stay a 400 MsgpackError
+            raise MsgpackError("container msgpack map key")
+        v, i = _decode(buf, i, depth)
         out[k] = v
     return out, i
 

@@ -172,3 +172,25 @@ def test_put_is_scoped_to_datadog_paths():
         assert e.value.code == 405
     finally:
         server.stop()
+
+
+def test_msgpack_nesting_depth_bounded():
+    # ~2KB of nested fixarrays must raise MsgpackError (-> 400), not
+    # RecursionError (-> 500)
+    deep = b"\x91" * 2000 + b"\xc0"
+    with pytest.raises(msgpack.MsgpackError):
+        msgpack.unpackb(deep)
+    # sane nesting still decodes
+    ok = b"\x91" * 50 + b"\xc0"
+    v = msgpack.unpackb(ok)
+    for _ in range(50):
+        assert isinstance(v, list) and len(v) == 1
+        v = v[0]
+    assert v is None
+
+
+def test_msgpack_container_map_key_rejected():
+    # fixmap{fixarray: nil} — unhashable key must be MsgpackError, not
+    # TypeError (which the HTTP layer would 500)
+    with pytest.raises(msgpack.MsgpackError):
+        msgpack.unpackb(b"\x81\x90\xc0")

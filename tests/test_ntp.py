@@ -49,8 +49,13 @@ def test_ntp_rpc_and_sync_report():
         # same host, same clock: measured offset must be tiny
         assert abs(agent.synchronizer.clock_offset_ns) < 200_000_000
         assert agent.synchronizer.ntp_rtt_ns > 0
-        # reported into the fleet health view
+        # reported into the fleet health view (the Sync RPC that registers
+        # the agent races the Ntp RPC we just observed — wait for it)
+        deadline = time.monotonic() + 5
         agents = server.controller.registry.list()
+        while time.monotonic() < deadline and not agents:
+            time.sleep(0.05)
+            agents = server.controller.registry.list()
         assert agents and "clock_offset_ms" in agents[0]
     finally:
         if agent:
@@ -119,3 +124,34 @@ def test_ntp_sync_smoothing_rejects_outliers():
     for off in (100, 110, 9_000_000, 105, 95):  # one GC-pause outlier
         s._ntp_samples.append(off)
     assert int(statistics.median(s._ntp_samples)) == 105
+
+
+def test_measured_zero_offset_clears_stored_skew():
+    """A present clock_offset_ns of 0 must overwrite a stored non-zero
+    offset (messages.proto:392 made the field optional for exactly this);
+    absence must leave the stored value alone."""
+    from deepflow_tpu.server.controller import Controller
+    from deepflow_tpu.server.platform_info import PlatformInfoTable
+
+    table = PlatformInfoTable()
+    ctl = Controller(table)
+    req = pb.SyncRequest()
+    req.hostname = "h"
+    req.ctrl_ip = "10.0.0.9"
+    req.clock_offset_ns = 5_000_000_000
+    resp = ctl.Sync(req, None)
+    aid = resp.agent_id
+    assert table.offset_for(aid) == 5_000_000_000
+
+    # absent field: stored offset survives
+    req2 = pb.SyncRequest()
+    req2.hostname = "h"
+    req2.ctrl_ip = "10.0.0.9"
+    req2.agent_id = aid
+    ctl.Sync(req2, None)
+    assert table.offset_for(aid) == 5_000_000_000
+
+    # measured 0: stored offset is cleared
+    req2.clock_offset_ns = 0
+    ctl.Sync(req2, None)
+    assert table.offset_for(aid) == 0

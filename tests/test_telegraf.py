@@ -149,3 +149,9 @@ def test_corrupt_gzip_is_400():
             assert e.value.code == 400, body
     finally:
         server.stop()
+
+
+def test_non_finite_field_values_rejected():
+    for bad in ("m v=nan", "m v=NaN", "m v=inf", "m v=-inf", "m v=Infinity"):
+        with pytest.raises(LineProtocolError):
+            parse_line(bad)
