@@ -282,9 +282,35 @@ def _agg_eval(e, env: _Env, order: np.ndarray, bounds: np.ndarray) -> _Val:
                 out[i] = np.percentile(a[s0:e0], p) if e0 > s0 else 0.0
             return _Val(out)
         raise QueryError(f"unknown aggregate {e.name}")
+    if isinstance(e, S.Not):
+        v = _agg_eval(e.expr, env, order, bounds)
+        return _Val(~v.arr.astype(bool), "bool")
     if isinstance(e, S.BinOp):
+        # logical / comparison ops appear here via HAVING
+        if e.op in ("AND", "OR"):
+            lv = _agg_eval(e.left, env, order, bounds).arr.astype(bool)
+            rv = _agg_eval(e.right, env, order, bounds).arr.astype(bool)
+            return _Val(lv & rv if e.op == "AND" else lv | rv, "bool")
+        if e.op == "IN":
+            lv = _agg_eval(e.left, env, order, bounds)
+            vals = [lit.value for lit in e.right]
+            if lv.kind in ("str", "enum"):
+                dec = np.asarray(lv.decoded(), dtype=object)
+                return _Val(np.isin(dec, vals), "bool")
+            return _Val(np.isin(lv.arr, vals), "bool")
         lv = _agg_eval(e.left, env, order, bounds)
         rv = _agg_eval(e.right, env, order, bounds)
+        if e.op in ("=", "!=", "<", "<=", ">", ">="):
+            if lv.kind in ("str", "enum") or rv.kind in ("str", "enum"):
+                # HAVING over group-key strings: compare decoded values
+                l = np.asarray(lv.decoded(), dtype=object)
+                r = (np.asarray(rv.decoded(), dtype=object)
+                     if rv.kind in ("str", "enum") else rv.arr)
+            else:
+                l, r = lv.arr, rv.arr
+            res = {"=": l.__eq__, "!=": l.__ne__, "<": l.__lt__,
+                   "<=": l.__le__, ">": l.__gt__, ">=": l.__ge__}[e.op](r)
+            return _Val(np.asarray(res), "bool")
         l, r = lv.arr.astype(np.float64), rv.arr.astype(np.float64)
         if e.op == "+":
             return _Val(l + r)
@@ -310,15 +336,40 @@ def _agg_eval(e, env: _Env, order: np.ndarray, bounds: np.ndarray) -> _Val:
 def execute(table: ColumnarTable, query: S.Select | str) -> QueryResult:
     if isinstance(query, str):
         query = S.parse(query)
+    # derived metrics (Avg(rtt) -> Sum(rtt_sum)/Sum(rtt_count)) before
+    # column validation, so the virtual names never hit the store.
+    # Display names and ORDER BY matching use the PRE-rewrite expressions:
+    # the user asked for Avg(rtt), not the implementation ratio.
+    from deepflow_tpu.query import catalog as _catalog
+    try:
+        tcols = set(table.columns)
+        # alias defaults to the PRE-rewrite display name, which also lets
+        # ORDER BY Avg(rtt) match its SELECT item by name below
+        query_items = [
+            S.SelectItem(_catalog.rewrite_derived(i.expr, table.name, tcols),
+                         i.alias or S.expr_name(i.expr))
+            for i in query.items]
+        having = (_catalog.rewrite_derived(query.having, table.name, tcols)
+                  if query.having is not None else None)
+    except _catalog._DerivedError as e:
+        raise QueryError(str(e)) from None
+    query = S.Select(items=query_items, table=query.table,
+                     where=query.where, group_by=query.group_by,
+                     having=having, order_by=query.order_by,
+                     limit=query.limit)
     needed: set[str] = set()
     for item in query.items:
         _collect_cols(item.expr, needed)
     for g in query.group_by:
         _collect_cols(g, needed)
+    if query.having is not None:
+        _collect_cols(query.having, needed)
     aliases = {i.alias for i in query.items if i.alias}
     for e, _ in query.order_by:
         if isinstance(e, S.Col) and e.name in aliases:
             continue  # refers to a SELECT alias, not a table column
+        if S.expr_name(e) in aliases:
+            continue  # matches a SELECT item (possibly a derived metric)
         _collect_cols(e, needed)
     if query.where is not None:
         _collect_cols(query.where, needed)
@@ -352,7 +403,7 @@ def execute(table: ColumnarTable, query: S.Select | str) -> QueryResult:
                           np.empty(0, dtype=table.columns[name].np_dtype))
     env = _Env(table, cols)
 
-    is_agg = bool(query.group_by) or any(
+    is_agg = bool(query.group_by) or query.having is not None or any(
         S.contains_agg(i.expr) for i in query.items)
 
     names = [i.alias or S.expr_name(i.expr) for i in query.items]
@@ -392,6 +443,12 @@ def execute(table: ColumnarTable, query: S.Select | str) -> QueryResult:
     decoded = [v.decoded() for v in outs]
     n_out = max((len(d) for d in decoded), default=0)
     rows = [list(r) for r in zip(*decoded)] if n_out else []
+
+    if query.having is not None:
+        mask = _agg_eval(query.having, env, order, bounds).arr
+        if mask.ndim == 0:
+            mask = np.full(len(rows), bool(mask))
+        rows = [r for r, keep in zip(rows, mask.astype(bool)) if keep]
 
     # ORDER BY over output columns
     for e, desc in reversed(query.order_by):

@@ -4,8 +4,10 @@ Dialect (subset mirroring the reference querier's surface,
 server/querier/engine/clickhouse/parse.go):
 
     SELECT expr [AS alias], ... FROM table
-    [WHERE cond] [GROUP BY expr, ...] [ORDER BY expr [ASC|DESC], ...]
-    [LIMIT n]
+    [WHERE cond] [GROUP BY expr, ...] [HAVING cond]
+    [ORDER BY expr [ASC|DESC], ...] [LIMIT n]
+
+    SHOW DATABASES | SHOW TABLES | SHOW TAGS FROM t | SHOW METRICS FROM t
 
 Aggregates: Sum, Avg, Min, Max, Count, Last, Percentile(x, p).
 Scalars: time(time, interval_s) — time bucketing.
@@ -18,7 +20,8 @@ import re
 from dataclasses import dataclass, field
 
 KEYWORDS = {"SELECT", "FROM", "WHERE", "GROUP", "ORDER", "BY", "LIMIT",
-            "AS", "AND", "OR", "NOT", "IN", "LIKE", "ASC", "DESC"}
+            "AS", "AND", "OR", "NOT", "IN", "LIKE", "ASC", "DESC",
+            "HAVING", "SHOW"}
 AGG_FUNCS = {"SUM", "AVG", "MIN", "MAX", "COUNT", "LAST", "PERCENTILE"}
 SCALAR_FUNCS = {"TIME"}
 
@@ -110,8 +113,18 @@ class Select:
     table: str
     where: object | None = None
     group_by: list = field(default_factory=list)
+    having: object | None = None
     order_by: list = field(default_factory=list)  # (expr, desc: bool)
     limit: int | None = None
+
+
+@dataclass
+class Show:
+    """SHOW DATABASES | TABLES | TAGS FROM t | METRICS FROM t
+    (reference: querier `show tags/metrics` introspection backed by
+    db_descriptions/)."""
+    what: str                 # databases | tables | tags | metrics
+    table: str | None = None
 
 
 class _Parser:
@@ -157,6 +170,8 @@ class _Parser:
             while self.peek().value == ",":
                 self.next()
                 sel.group_by.append(self.parse_expr())
+        if self.accept_kw("HAVING"):
+            sel.having = self.parse_expr()
         if self.accept_kw("ORDER"):
             self.expect("kw", "BY")
             sel.order_by.append(self.parse_order_item())
@@ -302,8 +317,35 @@ class _Parser:
         raise SqlError(f"unexpected {t.value!r} at {t.pos}")
 
 
+    def parse_show(self) -> Show:
+        self.expect("kw", "SHOW")
+        t = self.next()
+        if t.kind != "ident":
+            raise SqlError(f"expected SHOW target at {t.pos}")
+        what = t.value.lower()
+        if what in ("databases", "tables"):
+            stmt = Show(what)
+        elif what in ("tags", "metrics"):
+            self.expect("kw", "FROM")
+            stmt = Show(what, self.expect("ident").value)
+        else:
+            raise SqlError(f"cannot SHOW {t.value!r}")
+        if self.peek().kind != "eof":
+            t2 = self.peek()
+            raise SqlError(f"trailing input at {t2.pos}: {t2.value!r}")
+        return stmt
+
+
 def parse(sql: str) -> Select:
     return _Parser(tokenize(sql)).parse_select()
+
+
+def parse_statement(sql: str) -> Select | Show:
+    """Entry point that also accepts SHOW statements."""
+    toks = tokenize(sql)
+    if toks and toks[0].kind == "kw" and toks[0].value == "SHOW":
+        return _Parser(toks).parse_show()
+    return _Parser(toks).parse_select()
 
 
 def expr_name(e) -> str:

@@ -81,13 +81,16 @@ def _rows(table: ColumnarTable, mask_fn) -> list[dict]:
 def build_trace(l7_table: ColumnarTable, trace_id: str,
                 tpu_table: ColumnarTable | None = None,
                 max_spans: int = 1000) -> dict:
-    """Assemble the trace tree for one trace_id."""
+    """Assemble the trace tree for one trace_id by scanning l7_flow_log.
+
+    This is the FALLBACK path (standalone library use, or data not yet
+    precomputed); the server prefers build_trace_from_spans over the
+    ingest-time flow_log.trace_tree rows."""
     tid_code = l7_table.dicts["trace_id"].lookup(trace_id)
     if tid_code is None:
-        return {"trace_id": trace_id, "spans": [], "span_count": 0}
+        return {"trace_id": trace_id, "spans": [], "span_count": 0,
+                "truncated": False}
     rows = _rows(l7_table, lambda ch: ch["trace_id"] == tid_code)
-    rows = rows[:max_spans]
-
     spans: list[TraceSpan] = []
     for r in rows:
         name = r["endpoint"] or r["request_resource"] or r["request_type"]
@@ -105,6 +108,49 @@ def build_trace(l7_table: ColumnarTable, trace_id: str,
             attrs={"flow_id": r["flow_id"],
                    "x_request_id": r["x_request_id"]},
         ))
+    return _assemble(trace_id, spans, tpu_table, max_spans)
+
+
+def build_trace_from_spans(trace_id: str, span_dicts: list[dict],
+                           tpu_table: ColumnarTable | None = None,
+                           max_spans: int = 1000) -> dict:
+    """Assemble from precomputed span dicts (flow_log.trace_tree rows +
+    TraceTreeBuilder pending spans) — touches ONLY this trace's data.
+    Reference: querier reading ingester-written trace_tree
+    (libs/tracetree/tracetree.go:47)."""
+    spans: list[TraceSpan] = []
+    seen: set = set()
+    for d in span_dicts:
+        key = (d.get("span_id", ""), int(d.get("start_ns", 0)),
+               int(d.get("flow_id", 0)))
+        if key in seen:  # straggler rows can duplicate a span
+            continue
+        seen.add(key)
+        spans.append(TraceSpan(
+            span_id=d.get("span_id", ""),
+            parent_span_id=d.get("parent_span_id", ""),
+            name=d.get("name", ""),
+            service=d.get("service", ""),
+            l7_protocol=str(d.get("l7_protocol", "")),
+            start_ns=int(d.get("start_ns", 0)),
+            end_ns=int(d.get("end_ns", 0)),
+            status=str(d.get("status", "unknown")),
+            response_code=int(d.get("response_code", 0)),
+            ip_src=d.get("ip_src", ""), ip_dst=d.get("ip_dst", ""),
+            attrs={"flow_id": d.get("flow_id", 0),
+                   "x_request_id": d.get("x_request_id", "")},
+        ))
+    return _assemble(trace_id, spans, tpu_table, max_spans)
+
+
+def _assemble(trace_id: str, spans: list[TraceSpan],
+              tpu_table: ColumnarTable | None,
+              max_spans: int) -> dict:
+    total = len(spans)
+    truncated = total > max_spans
+    if truncated:
+        # deterministic: keep the earliest spans, report the cut
+        spans = sorted(spans, key=lambda s: s.start_ns)[:max_spans]
     spans.sort(key=lambda s: (s.start_ns, -(s.end_ns - s.start_ns)))
 
     # explicit parent links first
@@ -175,7 +221,8 @@ def build_trace(l7_table: ColumnarTable, trace_id: str,
 
     return {
         "trace_id": trace_id,
-        "span_count": len(spans),
+        "span_count": total,
+        "truncated": truncated,
         "spans": [s.to_dict() for s in
                   sorted(roots, key=lambda s: s.start_ns)],
     }

@@ -18,11 +18,17 @@ from deepflow_tpu.store.db import Database
 log = logging.getLogger("df.datasource")
 
 # per family: (tag columns, summed meter columns, max meter columns)
+# per-side universal resource tags carried through every rollup stage
+from deepflow_tpu.store import schema as _schema
+
+_SIDE_TAGS = [f"{n}_{s}" for s in ("0", "1")
+              for n in _schema.SIDE_TAG_NAMES]
+
 _FAMILIES = {
     "flow_metrics.network": (
         ["ip_src", "ip_dst", "server_port", "protocol", "direction",
          "agent_id", "host_id", "host", "pod_name", "pod_ns", "tpu_pod",
-         "tpu_worker", "slice_id"],
+         "tpu_worker", "slice_id"] + _SIDE_TAGS,
         ["packet_tx", "packet_rx", "byte_tx", "byte_rx", "flow_count",
          "new_flow", "closed_flow", "rtt_sum", "rtt_count", "retrans",
          "syn_count", "synack_count"],
@@ -30,7 +36,7 @@ _FAMILIES = {
     "flow_metrics.application": (
         ["ip_src", "ip_dst", "server_port", "l7_protocol", "app_service",
          "agent_id", "host_id", "host", "pod_name", "pod_ns", "tpu_pod",
-         "tpu_worker", "slice_id"],
+         "tpu_worker", "slice_id"] + _SIDE_TAGS,
         ["request", "response", "rrt_sum", "rrt_count", "error_client",
          "error_server", "timeout"],
         ["rrt_max"]),

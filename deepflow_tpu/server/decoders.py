@@ -18,7 +18,7 @@ from deepflow_tpu.proto import pb
 from deepflow_tpu.store.db import Database
 from deepflow_tpu.store.schema import (
     L4_PROTOS, L7_PROTOS, PROFILE_EVENT_TYPES, RESPONSE_STATUS,
-    TPU_SPAN_KINDS, CLOSE_TYPES)
+    SIDE_RESOLVE_NAMES, TPU_SPAN_KINDS, CLOSE_TYPES)
 from deepflow_tpu.server.platform_info import PlatformInfoTable
 
 log = logging.getLogger("df.decoder")
@@ -41,12 +41,15 @@ class Decoder:
     def __init__(self, q: queue.Queue, db: Database,
                  platform: PlatformInfoTable, exporters=None,
                  pod_index=None, gpid_table=None,
-                 workers: int | None = None) -> None:
+                 workers: int | None = None, resources=None,
+                 trace_trees=None) -> None:
         self.q = q
         self.db = db
         self.platform = platform
         self.exporters = exporters
         self.pod_index = pod_index  # K8s genesis IP->pod (optional)
+        self.resources = resources  # ResourceIndex: ip -> universal tags
+        self.trace_trees = trace_trees  # TraceTreeBuilder (optional)
         self.gpid_table = gpid_table  # controller GpidAllocator (optional)
         self.workers = workers if workers is not None else self.WORKERS
         self._stop = threading.Event()
@@ -240,37 +243,50 @@ class FlowLogDecoder(Decoder):
             return 0
         return self.gpid_table.lookup(bytes(ip), port, proto)
 
-    def _endpoint_cols(self, items, keys, src_s, dst_s, pods, pod_of):
-        """gprocess/pod columns shared by the l4 and l7 branches: agent
-        values win; otherwise resolve via the controller gpid table /
-        genesis pod index (skipped wholesale when absent)."""
+    def _endpoint_cols(self, items, keys, src_s, dst_s) -> dict:
+        """gprocess/resource columns shared by the l4 and l7 branches:
+        agent values win for pod; everything else resolves via the
+        controller gpid table / genesis ResourceIndex. Returns the full
+        per-side universal-tag column dict (reference:
+        grpc_platformdata.go QueryIPV4Infos per-side Info fill)."""
+        cols: dict[str, list] = {}
         if self.gpid_table is None:
-            gp0 = [f.gpid_0 for f in items]
-            gp1 = [f.gpid_1 for f in items]
+            cols["gprocess_id_0"] = [f.gpid_0 for f in items]
+            cols["gprocess_id_1"] = [f.gpid_1 for f in items]
         else:
-            gp0 = [f.gpid_0 or self._gpid(k.ip_src, k.port_src, int(k.proto))
-                   for f, k in zip(items, keys)]
-            gp1 = [f.gpid_1 or self._gpid(k.ip_dst, k.port_dst, int(k.proto))
-                   for f, k in zip(items, keys)]
-        if pods:
-            pod_0 = [f.pod_0 or pod_of(s) for f, s in zip(items, src_s)]
-            pod_1 = [f.pod_1 or pod_of(s) for f, s in zip(items, dst_s)]
+            cols["gprocess_id_0"] = [
+                f.gpid_0 or self._gpid(k.ip_src, k.port_src, int(k.proto))
+                for f, k in zip(items, keys)]
+            cols["gprocess_id_1"] = [
+                f.gpid_1 or self._gpid(k.ip_dst, k.port_dst, int(k.proto))
+                for f, k in zip(items, keys)]
+        if self.resources is not None:
+            res = self.resources.batch_resolver()
+            t0 = [res(s) for s in src_s]
+            t1 = [res(s) for s in dst_s]
+            cols["pod_0"] = [f.pod_0 or t.pod for f, t in zip(items, t0)]
+            cols["pod_1"] = [f.pod_1 or t.pod for f, t in zip(items, t1)]
+            for name in SIDE_RESOLVE_NAMES:
+                cols[f"{name}_0"] = [getattr(t, name) for t in t0]
+                cols[f"{name}_1"] = [getattr(t, name) for t in t1]
+        elif self.pod_index is not None and len(self.pod_index):
+            pods = self.pod_index.snapshot()
+
+            def pod_of(ip_str: str) -> str:
+                pod = pods.get(ip_str)
+                return pod.name if pod is not None else ""
+            cols["pod_0"] = [f.pod_0 or pod_of(s)
+                             for f, s in zip(items, src_s)]
+            cols["pod_1"] = [f.pod_1 or pod_of(s)
+                             for f, s in zip(items, dst_s)]
         else:
-            pod_0 = [f.pod_0 for f in items]
-            pod_1 = [f.pod_1 for f in items]
-        return gp0, gp1, pod_0, pod_1
+            cols["pod_0"] = [f.pod_0 for f in items]
+            cols["pod_1"] = [f.pod_1 for f in items]
+        return cols
 
     def handle(self, header: FrameHeader, payload: bytes) -> int:
         batch = pb.FlowLogBatch.FromString(payload)
         tags = self.platform.tags_for(header.agent_id)
-        # one snapshot per batch, not two lock round-trips per row
-        pods = (self.pod_index.snapshot()
-                if self.pod_index is not None else {})
-
-        def pod_of(ip_str: str) -> str:
-            pod = pods.get(ip_str)
-            return pod.name if pod is not None else ""
-
         # NTP normalization: shift this agent's absolute timestamps onto
         # the controller clock (reference corrects on-agent in rpc/ntp.rs;
         # here ingest-side so every telemetry family is covered at one
@@ -285,8 +301,7 @@ class FlowLogDecoder(Decoder):
             keys = [f.key for f in l4]
             src_s = [_ip_str(k.ip_src) for k in keys]
             dst_s = [_ip_str(k.ip_dst) for k in keys]
-            gp0, gp1, pod_0, pod_1 = self._endpoint_cols(
-                l4, keys, src_s, dst_s, pods, pod_of)
+            endpoint_cols = self._endpoint_cols(l4, keys, src_s, dst_s)
             cols = {
                 "time": [f.end_time_ns + off for f in l4],
                 "flow_id": [f.flow_id for f in l4],
@@ -317,10 +332,7 @@ class FlowLogDecoder(Decoder):
                 "synack_count": [f.synack_count for f in l4],
                 "tunnel_type": [min(int(k.tunnel_type), 4) for k in keys],
                 "tunnel_id": [k.tunnel_id for k in keys],
-                "gprocess_id_0": gp0,
-                "gprocess_id_1": gp1,
-                "pod_0": pod_0,
-                "pod_1": pod_1,
+                **endpoint_cols,
             }
             for tk, tv in tags.items():
                 cols[tk] = [tv] * len(l4)
@@ -331,8 +343,7 @@ class FlowLogDecoder(Decoder):
             keys = [f.key for f in l7]
             src_s = [_ip_str(k.ip_src) for k in keys]
             dst_s = [_ip_str(k.ip_dst) for k in keys]
-            gp0, gp1, pod_0, pod_1 = self._endpoint_cols(
-                l7, keys, src_s, dst_s, pods, pod_of)
+            endpoint_cols = self._endpoint_cols(l7, keys, src_s, dst_s)
             cols = {
                 "time": [f.start_time_ns + off for f in l7],
                 "flow_id": [f.flow_id for f in l7],
@@ -369,10 +380,7 @@ class FlowLogDecoder(Decoder):
                     f.captured_request_byte for f in l7],
                 "captured_response_byte": [
                     f.captured_response_byte for f in l7],
-                "gprocess_id_0": gp0,
-                "gprocess_id_1": gp1,
-                "pod_0": pod_0,
-                "pod_1": pod_1,
+                **endpoint_cols,
                 "process_kname_0": [f.process_kname_0 for f in l7],
                 "process_kname_1": [f.process_kname_1 for f in l7],
                 "attrs": [f.attrs_json for f in l7],
@@ -380,8 +388,47 @@ class FlowLogDecoder(Decoder):
             for tk, tv in tags.items():
                 cols[tk] = [tv] * len(l7)
             self.write_columns("flow_log.l7_flow_log", cols, len(l7))
+            if self.trace_trees is not None:
+                self._feed_trace_trees(cols, len(l7))
             n += len(l7)
         return n
+
+    def _feed_trace_trees(self, cols: dict, n: int) -> None:
+        """Traced rows (non-empty trace_id: typically a small subset)
+        feed the ingest-time trace_tree precompute."""
+        from deepflow_tpu.server.tracetree import span_from_l7
+        tids = cols["trace_id"]
+        for i in range(n):
+            tid = tids[i]
+            if not tid:
+                continue
+            proto_i = cols["l7_protocol"][i]
+            status_i = cols["response_status"][i]
+            self.trace_trees.add_span(tid, span_from_l7({
+                "time": cols["time"][i],
+                "flow_id": cols["flow_id"][i],
+                "request_id": cols["request_id"][i],
+                "span_id": cols["span_id"][i],
+                "parent_span_id": cols["parent_span_id"][i],
+                "request_type": cols["request_type"][i],
+                "endpoint": cols["endpoint"][i],
+                "request_resource": cols["request_resource"][i],
+                "app_service": cols["app_service"][i]
+                if "app_service" in cols else "",
+                "service_1": cols.get("service_1", [""] * n)[i],
+                "host": cols.get("host", [""] * n)[i],
+                "l7_protocol": (L7_PROTOS[proto_i]
+                                if 0 <= proto_i < len(L7_PROTOS)
+                                else "unknown"),
+                "response_status": (RESPONSE_STATUS[status_i]
+                                    if 0 <= status_i < len(RESPONSE_STATUS)
+                                    else "unknown"),
+                "response_code": cols["response_code"][i],
+                "response_duration": cols["response_duration"][i],
+                "ip_src": cols["ip_src"][i],
+                "ip_dst": cols["ip_dst"][i],
+                "x_request_id": cols["x_request_id"][i],
+            }))
 
 
 class MetricsDecoder(Decoder):
@@ -397,12 +444,25 @@ class MetricsDecoder(Decoder):
         n = 0
 
         def base_cols(docs):
+            src_s = [_ip_str(d.tag.ip_src) for d in docs]
+            dst_s = [_ip_str(d.tag.ip_dst) for d in docs]
             cols = {
                 "time": [d.timestamp_s + off_s for d in docs],
-                "ip_src": [_ip_str(d.tag.ip_src) for d in docs],
-                "ip_dst": [_ip_str(d.tag.ip_dst) for d in docs],
+                "ip_src": src_s,
+                "ip_dst": dst_s,
                 "server_port": [d.tag.port for d in docs],
             }
+            if self.resources is not None:
+                # per-side universal tags on metrics rows: this is what
+                # makes "group any metric by any resource" work
+                res = self.resources.batch_resolver()
+                t0 = [res(s) for s in src_s]
+                t1 = [res(s) for s in dst_s]
+                cols["pod_0"] = [t.pod for t in t0]
+                cols["pod_1"] = [t.pod for t in t1]
+                for name in SIDE_RESOLVE_NAMES:
+                    cols[f"{name}_0"] = [getattr(t, name) for t in t0]
+                    cols[f"{name}_1"] = [getattr(t, name) for t in t1]
             for tk, tv in tags.items():
                 cols[tk] = [tv] * len(docs)
             return cols

@@ -4,10 +4,11 @@ tables.
 Reference analog: agent/src/platform/kubernetes/api_watcher.rs (pod/node
 list-watch) + server/controller/genesis/genesis.go:54 (resource ingestion).
 Redesign: the watcher lives server-side (one watcher per cluster, not one
-per agent) and feeds the PodIpIndex used by the ingest decoders to tag both
-sides of every flow by IP. No kubernetes client library — raw HTTP against
-the apiserver with the in-cluster service-account token, list + watch with
-resourceVersion resume and bounded backoff.
+per agent) and feeds the PodIpIndex + ResourceIndex used by the ingest
+decoders to tag both sides of every flow by IP (pods, service ClusterIPs,
+nodes, subnets). No kubernetes client library — raw HTTP against the
+apiserver with the in-cluster service-account token, list + watch with
+resourceVersion resume and bounded backoff, one loop per resource kind.
 """
 
 from __future__ import annotations
@@ -19,7 +20,8 @@ import ssl
 import threading
 import urllib.request
 
-from deepflow_tpu.server.platform_info import PodInfo, PodIpIndex
+from deepflow_tpu.server.platform_info import (
+    NodeInfo, PodInfo, PodIpIndex, ResourceIndex, ServiceInfo)
 
 log = logging.getLogger("df.genesis")
 
@@ -57,14 +59,120 @@ def build_api_context(api_base: str, ca_path: str = "",
                      "(or explicit insecure_skip_verify=True)")
 
 
+class _ResourceLoop:
+    """One list+watch loop for one resource kind. `apply(etype, obj,
+    emit_events)` returns the reconcile keys the object contributes;
+    `reconcile(seen)` evicts keys a relist no longer reports (a relist is
+    authoritative, not additive)."""
+
+    def __init__(self, genesis: "K8sGenesis", path: str, count_key: str,
+                 apply, reconcile) -> None:
+        self.g = genesis
+        self.path = path
+        self.count_key = count_key
+        self.apply = apply
+        self.reconcile = reconcile
+        self.resource_version = ""
+        self._thread: threading.Thread | None = None
+
+    def list_once(self) -> int:
+        n = 0
+        cont = ""
+        seen: set = set()
+        while True:
+            path = f"{self.path}?limit=500"
+            if cont:
+                path += f"&continue={cont}"
+            with self.g._open(path, timeout=30) as r:
+                data = json.load(r)
+            for item in data.get("items", []):
+                # relist reconciles STATE; it must not re-emit
+                # resource-added events for survivors of a watch gap
+                keys = self.apply("ADDED", item, emit_events=False)
+                if keys:
+                    seen.update(keys)
+                n += 1
+            meta = data.get("metadata", {})
+            self.resource_version = meta.get("resourceVersion",
+                                             self.resource_version)
+            cont = meta.get("continue", "")
+            if not cont:
+                break
+        self.reconcile(seen)
+        self.g.stats[self.count_key] = n
+        return n
+
+    def watch_once(self) -> None:
+        path = (f"{self.path}?watch=1&allowWatchBookmarks=true"
+                f"&timeoutSeconds={self.g.watch_timeout_s}")
+        if self.resource_version:
+            path += f"&resourceVersion={self.resource_version}"
+        with self.g._open(path, timeout=self.g.watch_timeout_s + 30) as r:
+            for line in r:
+                if self.g._stop.is_set():
+                    return
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue
+                etype = ev.get("type", "")
+                obj = ev.get("object", {})
+                rv = obj.get("metadata", {}).get("resourceVersion")
+                if rv:
+                    self.resource_version = rv
+                if etype == "BOOKMARK":
+                    continue
+                if etype == "ERROR":
+                    # expired resourceVersion: force a relist
+                    self.resource_version = ""
+                    return
+                self.apply(etype, obj, True)
+                self.g.stats["events"] += 1
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name=f"df-k8s-{self.count_key}", daemon=True)
+        self._thread.start()
+
+    def join(self, timeout: float) -> None:
+        if self._thread:
+            self._thread.join(timeout=timeout)
+
+    def _run(self) -> None:
+        backoff = 1.0
+        while not self.g._stop.is_set():
+            try:
+                if not self.resource_version:
+                    self.list_once()
+                    self.g.stats["relists"] += 1
+                self.watch_once()
+                backoff = 1.0
+            except Exception as e:
+                self.g.stats["errors"] += 1
+                # first failure (and every 50th) at WARNING: an RBAC/token
+                # problem must be operator-visible, not debug-only
+                if self.g.stats["errors"] == 1 or \
+                        self.g.stats["errors"] % 50 == 0:
+                    log.warning("genesis %s watch error (#%d): %s",
+                                self.count_key, self.g.stats["errors"], e)
+                else:
+                    log.debug("genesis %s watch error: %s",
+                              self.count_key, e)
+                if self.g._stop.wait(backoff):
+                    return
+                backoff = min(backoff * 2, 30.0)
+
+
 class K8sGenesis:
-    """Pod list-watch -> PodIpIndex."""
+    """Pod (+ Service/Endpoints/Node when a ResourceIndex is attached)
+    list-watch -> platform tables."""
 
     def __init__(self, pod_index: PodIpIndex, api_base: str | None = None,
                  token: str = "", ca_path: str = "",
                  watch_timeout_s: int = 300,
                  insecure_skip_verify: bool = False,
-                 event_sink=None) -> None:
+                 event_sink=None,
+                 resources: ResourceIndex | None = None) -> None:
         # event_sink(rows) receives resource-change events (reference:
         # controller/recorder resource diffs -> event tables)
         self.event_sink = event_sink
@@ -77,12 +185,34 @@ class K8sGenesis:
         self.token = token
         self.watch_timeout_s = watch_timeout_s
         self.pod_index = pod_index
+        self.resources = resources
         self._ctx = build_api_context(self.api_base, ca_path,
                                       insecure_skip_verify)
         self._stop = threading.Event()
-        self._thread: threading.Thread | None = None
-        self.resource_version = ""
-        self.stats = {"pods": 0, "events": 0, "relists": 0, "errors": 0}
+        self.stats = {"pods": 0, "events": 0, "relists": 0, "errors": 0,
+                      "services": 0, "endpoints": 0, "nodes": 0}
+        self._loops = [_ResourceLoop(
+            self, "/api/v1/pods", "pods", self._apply,
+            self.pod_index.retain_ips)]
+        if resources is not None:
+            self._loops += [
+                _ResourceLoop(self, "/api/v1/services", "services",
+                              self._apply_service, resources.retain_services),
+                _ResourceLoop(self, "/api/v1/endpoints", "endpoints",
+                              self._apply_endpoints,
+                              resources.retain_endpoints),
+                _ResourceLoop(self, "/api/v1/nodes", "nodes",
+                              self._apply_node, resources.retain_nodes),
+            ]
+
+    # back-compat: tests poke gen.resource_version to force relists
+    @property
+    def resource_version(self) -> str:
+        return self._loops[0].resource_version
+
+    @resource_version.setter
+    def resource_version(self, v: str) -> None:
+        self._loops[0].resource_version = v
 
     # -- http -----------------------------------------------------------------
 
@@ -93,7 +223,25 @@ class K8sGenesis:
         return urllib.request.urlopen(req, timeout=timeout,
                                       context=self._ctx)
 
-    # -- resource handling -----------------------------------------------------
+    # -- resource events -------------------------------------------------------
+
+    def _emit_event(self, etype: str, resource_type: str, name: str,
+                    description: str) -> None:
+        if self.event_sink is None or etype not in ("ADDED", "DELETED"):
+            return
+        import time as _t
+        try:
+            self.event_sink([{
+                "time": _t.time_ns(),
+                "event_type": f"{resource_type}-{etype.lower()}",
+                "resource_type": resource_type,
+                "resource_name": name,
+                "description": description,
+            }])
+        except Exception:
+            log.debug("event sink failed", exc_info=True)
+
+    # -- pods ------------------------------------------------------------------
 
     @staticmethod
     def _workload_of(pod: dict) -> str:
@@ -107,7 +255,7 @@ class K8sGenesis:
         return ""
 
     def _apply(self, event_type: str, pod: dict,
-               emit_events: bool = True) -> None:
+               emit_events: bool = True) -> set:
         meta = pod.get("metadata", {})
         status = pod.get("status", {})
         ips = [e.get("ip") for e in status.get("podIPs", [])
@@ -127,118 +275,105 @@ class K8sGenesis:
         else:  # ADDED | MODIFIED
             for ip in ips:
                 self.pod_index.upsert(ip, info)
-        if emit_events and self.event_sink is not None and \
-                event_type in ("ADDED", "DELETED"):
-            import time as _t
-            try:
-                self.event_sink([{
-                    "time": _t.time_ns(),
-                    "event_type": f"pod-{event_type.lower()}",
-                    "resource_type": "pod",
-                    "resource_name": f"{info.namespace}/{info.name}",
-                    "description": f"node={info.node} "
-                                   f"workload={info.workload} "
-                                   f"ips={','.join(ips)}",
-                }])
-            except Exception:
-                log.debug("event sink failed", exc_info=True)
+        if emit_events:
+            self._emit_event(
+                event_type, "pod", f"{info.namespace}/{info.name}",
+                f"node={info.node} workload={info.workload} "
+                f"ips={','.join(ips)}")
+        return set(ips)
 
-    # -- list + watch ----------------------------------------------------------
+    # -- services / endpoints / nodes -----------------------------------------
+
+    def _apply_service(self, event_type: str, obj: dict,
+                       emit_events: bool = True) -> set:
+        meta = obj.get("metadata", {})
+        spec = obj.get("spec", {})
+        ns, name = meta.get("namespace", ""), meta.get("name", "")
+        # defensive: ignore non-Service shapes (shared fake servers)
+        if not name or ("clusterIP" not in spec and "ports" not in spec):
+            return set()
+        if event_type == "DELETED":
+            self.resources.remove_service(ns, name)
+        else:
+            self.resources.upsert_service(ServiceInfo(
+                name=name, namespace=ns,
+                cluster_ip=spec.get("clusterIP", "") or "",
+                svc_type=spec.get("type", "ClusterIP"),
+                ports=tuple(p.get("port") for p in spec.get("ports", [])
+                            if p.get("port"))))
+        if emit_events:
+            self._emit_event(event_type, "service", f"{ns}/{name}",
+                             f"cluster_ip={spec.get('clusterIP', '')}")
+        return {(ns, name)}
+
+    def _apply_endpoints(self, event_type: str, obj: dict,
+                         emit_events: bool = True) -> set:
+        meta = obj.get("metadata", {})
+        ns, name = meta.get("namespace", ""), meta.get("name", "")
+        # K8s serializes subsets with omitempty: a service scaled to zero
+        # arrives WITHOUT the key and must clear its stale pod-ip mappings.
+        # Only objects that are clearly another kind (pods have spec/status;
+        # Endpoints never do) are skipped.
+        if not name or ("subsets" not in obj
+                        and ("spec" in obj or "status" in obj)):
+            return set()
+        if event_type == "DELETED":
+            self.resources.set_endpoints(ns, name, ())
+            return set()
+        ips = [a.get("ip")
+               for s in (obj.get("subsets") or [])
+               for a in (s.get("addresses") or [])
+               if a.get("ip")]
+        self.resources.set_endpoints(ns, name, ips)
+        return {(ns, name)}
+
+    def _apply_node(self, event_type: str, obj: dict,
+                    emit_events: bool = True) -> set:
+        meta = obj.get("metadata", {})
+        name = meta.get("name", "")
+        status = obj.get("status", {})
+        if not name or "addresses" not in status:
+            return set()
+        if event_type == "DELETED":
+            self.resources.remove_node(name)
+            self._emit_event(event_type, "node", name, "")
+            return set()
+        labels = meta.get("labels", {}) or {}
+        spec = obj.get("spec", {})
+        internal = ""
+        for a in status.get("addresses") or []:
+            if a.get("type") == "InternalIP":
+                internal = a.get("address", "")
+                break
+        cidrs = spec.get("podCIDRs") or \
+            ([spec["podCIDR"]] if spec.get("podCIDR") else [])
+        node = NodeInfo(
+            name=name,
+            az=labels.get("topology.kubernetes.io/zone", ""),
+            region=labels.get("topology.kubernetes.io/region", ""),
+            internal_ip=internal, pod_cidrs=tuple(cidrs))
+        self.resources.upsert_node(node)
+        if emit_events:
+            self._emit_event(event_type, "node", name,
+                             f"az={node.az} ip={internal}")
+        return {name}
+
+    # -- back-compat single-loop entry points (tests drive these) -------------
 
     def list_once(self) -> int:
-        """Full pod list; returns pod count. Sets the watch resume point
-        and RECONCILES: IPs whose pods vanished during a watch gap are
-        evicted (a relist is authoritative, not additive)."""
-        n = 0
-        cont = ""
-        seen_ips: set[str] = set()
-        while True:
-            path = "/api/v1/pods?limit=500"
-            if cont:
-                path += f"&continue={cont}"
-            with self._open(path, timeout=30) as r:
-                data = json.load(r)
-            for pod in data.get("items", []):
-                # relist reconciles STATE; it must not re-emit pod-added
-                # for pods that merely survived a watch gap
-                self._apply("ADDED", pod, emit_events=False)
-                status = pod.get("status", {})
-                for e in status.get("podIPs", []):
-                    if e.get("ip"):
-                        seen_ips.add(e["ip"])
-                if status.get("podIP"):
-                    seen_ips.add(status["podIP"])
-                n += 1
-            meta = data.get("metadata", {})
-            self.resource_version = meta.get("resourceVersion",
-                                             self.resource_version)
-            cont = meta.get("continue", "")
-            if not cont:
-                break
-        self.pod_index.retain_ips(seen_ips)
-        self.stats["pods"] = n
-        return n
+        return self._loops[0].list_once()
 
     def watch_once(self) -> None:
-        """One watch connection; applies events until it ends."""
-        path = (f"/api/v1/pods?watch=1&allowWatchBookmarks=true"
-                f"&timeoutSeconds={self.watch_timeout_s}")
-        if self.resource_version:
-            path += f"&resourceVersion={self.resource_version}"
-        with self._open(path, timeout=self.watch_timeout_s + 30) as r:
-            for line in r:
-                if self._stop.is_set():
-                    return
-                try:
-                    ev = json.loads(line)
-                except ValueError:
-                    continue
-                etype = ev.get("type", "")
-                obj = ev.get("object", {})
-                rv = obj.get("metadata", {}).get("resourceVersion")
-                if rv:
-                    self.resource_version = rv
-                if etype == "BOOKMARK":
-                    continue
-                if etype == "ERROR":
-                    # expired resourceVersion: force a relist
-                    self.resource_version = ""
-                    return
-                self._apply(etype, obj)
-                self.stats["events"] += 1
+        self._loops[0].watch_once()
 
     # -- lifecycle -------------------------------------------------------------
 
     def start(self) -> "K8sGenesis":
-        self._thread = threading.Thread(
-            target=self._run, name="df-k8s-genesis", daemon=True)
-        self._thread.start()
+        for loop in self._loops:
+            loop.start()
         return self
 
     def stop(self) -> None:
         self._stop.set()
-        if self._thread:
-            self._thread.join(timeout=3.0)
-
-    def _run(self) -> None:
-        backoff = 1.0
-        while not self._stop.is_set():
-            try:
-                if not self.resource_version:
-                    self.list_once()
-                    self.stats["relists"] += 1
-                self.watch_once()
-                backoff = 1.0
-            except Exception as e:
-                self.stats["errors"] += 1
-                # first failure (and every 50th) at WARNING: an RBAC/token
-                # problem must be operator-visible, not debug-only
-                if self.stats["errors"] == 1 or \
-                        self.stats["errors"] % 50 == 0:
-                    log.warning("genesis watch error (#%d): %s",
-                                self.stats["errors"], e)
-                else:
-                    log.debug("genesis watch error: %s", e)
-                if self._stop.wait(backoff):
-                    return
-                backoff = min(backoff * 2, 30.0)
+        for loop in self._loops:
+            loop.join(timeout=3.0)

@@ -47,9 +47,10 @@ def _attr_map(attrs: list) -> dict:
 
 class IntegrationAPI:
     def __init__(self, db: Database, exporters=None,
-                 prom_encoder=None) -> None:
+                 prom_encoder=None, trace_trees=None) -> None:
         self.db = db
         self.exporters = exporters
+        self.trace_trees = trace_trees  # TraceTreeBuilder (optional)
         # SmartEncoding allocator: the controller's PromEncoder in a
         # combined binary, a GrpcPromEncoderClient on remote ingest nodes,
         # or a process-local PromEncoder standalone (ids still stable
@@ -70,6 +71,23 @@ class IntegrationAPI:
         self.db.table(table_name).append_rows(rows)
         if self.exporters is not None and rows:
             self.exporters.feed(table_name, rows)
+        if (self.trace_trees is not None
+                and table_name == "flow_log.l7_flow_log"):
+            from deepflow_tpu.store.schema import L7_PROTOS, RESPONSE_STATUS
+            from deepflow_tpu.server.tracetree import span_from_l7
+            for r in rows:
+                tid = r.get("trace_id", "")
+                if not tid:
+                    continue
+                d = dict(r)
+                # integration rows carry enum CODES; persist labels
+                for key, labels in (("l7_protocol", L7_PROTOS),
+                                    ("response_status", RESPONSE_STATUS)):
+                    v = d.get(key, 0)
+                    if isinstance(v, int):
+                        d[key] = (labels[v] if 0 <= v < len(labels)
+                                  else "unknown")
+                self.trace_trees.add_span(tid, span_from_l7(d))
 
     # -- OTLP/HTTP JSON traces (POST /api/v1/otlp/traces) --------------------
 

@@ -28,18 +28,21 @@ class QuerierAPI:
     """Route logic, separated from HTTP plumbing for in-process use."""
 
     def __init__(self, db: Database, stats_provider=None,
-                 controller=None, exporters=None, alerts=None) -> None:
+                 controller=None, exporters=None, alerts=None,
+                 trace_trees=None) -> None:
         self.db = db
         self.stats_provider = stats_provider or (lambda: {})
         self.controller = controller
         self.exporters = exporters
         self.alerts = alerts
+        self.trace_trees = trace_trees  # TraceTreeBuilder (optional)
         from deepflow_tpu.server.integration import IntegrationAPI
         # combined binary: ingest shares the controller's authoritative
         # SmartEncoding allocator; standalone: process-local allocator
         self.integration = IntegrationAPI(
             db, exporters=exporters,
-            prom_encoder=getattr(controller, "prom_encoder", None))
+            prom_encoder=getattr(controller, "prom_encoder", None),
+            trace_trees=trace_trees)
         from deepflow_tpu.server.mcp import McpServer
         self.mcp = McpServer(self)
         from deepflow_tpu.query.tracing_adapter import AdapterRegistry
@@ -95,7 +98,15 @@ class QuerierAPI:
     def query(self, body: dict) -> dict:
         sql_text = body.get("sql", "")
         db_name = body.get("db", "")
-        select = qsql.parse(sql_text)
+        select = qsql.parse_statement(sql_text)
+        if isinstance(select, qsql.Show):
+            from deepflow_tpu.query import catalog
+            try:
+                result = catalog.show(select.what, select.table)
+            except KeyError as e:
+                raise qengine.QueryError(
+                    f"no such table {e.args[0]!r} for SHOW") from None
+            return {"result": result, "debug": {"show": select.what}}
         table_name = select.table
         # resolution order: as-given, db-prefixed, then with the default
         # interval suffix (flow_metrics tables are <name>.<interval>)
@@ -454,13 +465,65 @@ class QuerierAPI:
                 self.db.table("flow_log.l7_flow_log"), syscall_id)}
         if not trace_id:
             raise qengine.QueryError("trace_id or syscall_trace_id required")
-        from deepflow_tpu.query.tracing import build_trace
-        tree = build_trace(
-            self.db.table("flow_log.l7_flow_log"), trace_id,
-            tpu_table=self.db.table("profile.tpu_hlo_span"))
+        tree = self._assemble_trace(trace_id)
         # tracing adapter: splice spans from configured EXTERNAL backends
         tree = self.trace_adapters.merge_into(tree, trace_id)
         return {"result": tree}
+
+    def _assemble_trace(self, trace_id: str, max_spans: int = 1000) -> dict:
+        """Prefer the ingest-time precompute (flow_log.trace_tree rows +
+        TraceTreeBuilder pending spans): touches only this trace's data.
+        Falls back to the l7 scan for data ingested before the builder
+        existed (e.g. loaded from an old data_dir)."""
+        import json as _json
+
+        import numpy as np
+
+        from deepflow_tpu.query.tracing import (build_trace,
+                                                build_trace_from_spans)
+        spans: list[dict] = []
+        tree_table = self.db.table("flow_log.trace_tree")
+        code = tree_table.dicts["trace_id"].lookup(trace_id)
+        if code is not None:
+            for ch in tree_table.snapshot():
+                if not ch:
+                    continue
+                for i in np.flatnonzero(ch["trace_id"] == code).tolist():
+                    spans.extend(_json.loads(
+                        tree_table.dicts["tree"].decode(int(ch["tree"][i]))))
+        if self.trace_trees is not None:
+            spans.extend(self.trace_trees.pending_spans(trace_id))
+        if spans:
+            return build_trace_from_spans(
+                trace_id, spans,
+                tpu_table=self.db.table("profile.tpu_hlo_span"),
+                max_spans=max_spans)
+        return build_trace(
+            self.db.table("flow_log.l7_flow_log"), trace_id,
+            tpu_table=self.db.table("profile.tpu_hlo_span"),
+            max_spans=max_spans)
+
+    def trace_search(self, body: dict) -> dict:
+        """Service-path search over precomputed trace trees (reference:
+        trace_tree service-path queries). Body: {service_path: [..],
+        root_service, from_ns, to_ns, min_duration_ns, limit}."""
+        from deepflow_tpu.server import tracetree as tt
+        body = body or {}
+        path = body.get("service_path") or []
+        if isinstance(path, str):
+            path = [p for p in path.split(">") if p]
+        pending = (self.trace_trees.pending_summaries()
+                   if self.trace_trees is not None else None)
+        hits = tt.search(
+            self.db.table("flow_log.trace_tree"),
+            service_path_query=[str(p) for p in path],
+            root_service=body.get("root_service"),
+            time_from_ns=int(body.get("from_ns", 0) or 0),
+            time_to_ns=int(body.get("to_ns", 0) or 0),
+            min_duration_ns=int(body.get("min_duration_ns", 0) or 0),
+            limit=int(body.get("limit", 50) or 50),
+            pending=pending)
+        return {"result": {"traces": hits, "count": len(hits)}}
 
     def tracing_adapters_api(self, body: dict | None = None) -> dict:
         if body and body.get("remove"):
@@ -714,6 +777,8 @@ class QuerierHTTP:
                         self._send(200, api.update_agent_config(body))
                     elif path == "/v1/trace/Tracing":
                         self._send(200, api.trace(body))
+                    elif path == "/v1/trace/Search":
+                        self._send(200, api.trace_search(body))
                     elif path == "/api/v1/otlp/traces":
                         self._send(200,
                                    api.integration.ingest_otlp_traces(body))

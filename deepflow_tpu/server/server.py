@@ -36,8 +36,12 @@ class Server:
         self.election = None
         self.db = Database(data_dir=data_dir)
         self.platform = PlatformInfoTable()
-        from deepflow_tpu.server.platform_info import PodIpIndex
+        from deepflow_tpu.server.platform_info import (PodIpIndex,
+                                                       ResourceIndex)
         self.pod_index = PodIpIndex()  # K8s genesis resource model
+        # IP-keyed universal-tag resolution (pods + services + nodes +
+        # subnets) shared by every ingest decoder
+        self.resources = ResourceIndex(self.pod_index)
         self.genesis = None            # started via start_genesis()
         self.receiver = Receiver(host=host, port=ingest_port)
         self.decoders = []
@@ -53,11 +57,15 @@ class Server:
                     pod_index=self.pod_index)
         from deepflow_tpu.server.alerting import AlertEngine
         from deepflow_tpu.server.exporters import ExporterManager
+        from deepflow_tpu.server.tracetree import TraceTreeBuilder
         self.exporters = ExporterManager()
         self.alerts = AlertEngine(self.db)
+        # ingest-time trace precompute (reference: tracetree_writer.go)
+        self.trace_trees = TraceTreeBuilder(self.db)
         self.api = QuerierAPI(self.db, stats_provider=self._stats,
                               controller=self.controller,
-                              exporters=self.exporters, alerts=self.alerts)
+                              exporters=self.exporters, alerts=self.alerts,
+                              trace_trees=self.trace_trees)
         self.http = QuerierHTTP(self.api, host=host, port=query_port)
         from deepflow_tpu.server.datasource import RollupJob
         from deepflow_tpu.server.janitor import Janitor
@@ -76,7 +84,8 @@ class Server:
 
             self.genesis = K8sGenesis(self.pod_index, api_base=api_base,
                                       token=token, ca_path=ca_path,
-                                      event_sink=_events).start()
+                                      event_sink=_events,
+                                      resources=self.resources).start()
             return True
         except (RuntimeError, ValueError) as e:
             # ValueError: https without ca (e.g. serviceaccount ca.crt
@@ -112,7 +121,7 @@ class Server:
         for cls, mtype in pairs:
             q = self.receiver.register(mtype)
             d = cls(q, self.db, self.platform, exporters=self.exporters,
-                    pod_index=self.pod_index,
+                    pod_index=self.pod_index, resources=self.resources,
                     gpid_table=(self.controller.gpids
                                 if self.controller else None))
             d.MSG_TYPE = mtype  # FlowLogDecoder serves two types

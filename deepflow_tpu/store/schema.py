@@ -38,6 +38,31 @@ UNIVERSAL_TAGS = [
     C("slice_id", "u16"),
 ]
 
+# Per-side resource tags resolved from the genesis ResourceIndex by IP at
+# ingest time (reference: grpc_platformdata.go:292 QueryIPV4Infos + the
+# tagrecorder ch_* catalogs). Side 0 = ip_src, side 1 = ip_dst. These are
+# what make "group any metric by any resource" possible with zero agent
+# config; all dictionary-encoded strings (SmartEncoding analog).
+
+
+def _side_tags(side: str) -> list[C]:
+    return [
+        C(f"pod_ns_{side}", "str"),
+        C(f"workload_{side}", "str"),     # pod_group analog
+        C(f"service_{side}", "str"),
+        C(f"node_{side}", "str"),
+        C(f"az_{side}", "str"),
+        C(f"subnet_{side}", "str"),
+    ]
+
+
+PER_SIDE_TAGS = _side_tags("0") + _side_tags("1")
+# the tag names (without side suffix); `pod` is handled separately at
+# ingest because agent-supplied values win over the ResourceIndex
+SIDE_TAG_NAMES = ("pod", "pod_ns", "workload", "service", "node", "az",
+                  "subnet")
+SIDE_RESOLVE_NAMES = tuple(n for n in SIDE_TAG_NAMES if n != "pod")
+
 TABLES: dict[str, list[C]] = {}
 
 
@@ -123,6 +148,7 @@ _table("flow_log.l4_flow_log", [
     C("gprocess_id_1", "u32"),
     C("pod_0", "str"),              # K8s genesis: resource at ip_src
     C("pod_1", "str"),              # K8s genesis: resource at ip_dst
+    *PER_SIDE_TAGS,
     *UNIVERSAL_TAGS,
 ])
 
@@ -159,6 +185,7 @@ _table("flow_log.l7_flow_log", [
     C("syscall_thread_1", "u32"),
     C("pod_0", "str"),              # K8s genesis: resource at ip_src
     C("pod_1", "str"),              # K8s genesis: resource at ip_dst
+    *PER_SIDE_TAGS,
     C("captured_request_byte", "u64"),
     C("captured_response_byte", "u64"),
     C("gprocess_id_0", "u32"),
@@ -167,6 +194,21 @@ _table("flow_log.l7_flow_log", [
     C("process_kname_1", "str"),
     C("attrs", "str"),                  # json: parser extras (sql, alpn, ...)
     *UNIVERSAL_TAGS,
+])
+
+# precomputed trace trees: one row per (trace_id, flush window), written
+# at ingest by the TraceTreeBuilder so trace assembly touches only that
+# trace's rows and service-path search never scans l7_flow_log.
+# Reference: server/ingester/flow_log/dbwriter/tracetree_writer.go:74 +
+# server/libs/tracetree/tracetree.go:47.
+_table("flow_log.trace_tree", [
+    C("time", "u64"),                   # earliest span start ns
+    C("trace_id", "str"),
+    C("span_count", "u32"),
+    C("duration_ns", "u64"),
+    C("root_service", "str"),
+    C("services", "str"),               # json: DFS-ordered service path
+    C("tree", "str"),                   # json: encoded span list
 ])
 
 # -- flow metrics ----------------------------------------------------------
@@ -190,6 +232,9 @@ _NETWORK_COLS = [
     C("retrans", "u64"),
     C("syn_count", "u64"),
     C("synack_count", "u64"),
+    C("pod_0", "str"),
+    C("pod_1", "str"),
+    *PER_SIDE_TAGS,
     *UNIVERSAL_TAGS,
 ]
 _table("flow_metrics.network.1s", list(_NETWORK_COLS))
@@ -212,6 +257,9 @@ _APP_COLS = [
     C("error_client", "u64"),
     C("error_server", "u64"),
     C("timeout", "u64"),
+    C("pod_0", "str"),
+    C("pod_1", "str"),
+    *PER_SIDE_TAGS,
     *UNIVERSAL_TAGS,
 ]
 _table("flow_metrics.application.1s", list(_APP_COLS))

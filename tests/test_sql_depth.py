@@ -1,0 +1,213 @@
+"""SQL engine depth: HAVING, derived metrics, SHOW introspection.
+
+Golden-style tests in the spirit of the reference's
+server/querier/engine/clickhouse/clickhouse_test.go table of
+(sql, expected) pairs.
+"""
+
+import pytest
+
+from deepflow_tpu.query import catalog
+from deepflow_tpu.query import sql as S
+from deepflow_tpu.query.engine import QueryError, execute
+from deepflow_tpu.store import Database
+
+
+def _network_1m():
+    db = Database()
+    t = db.table("flow_metrics.network.1m")
+    t.append_rows([
+        # pod web-1: two rows, avg rtt = (300+100)/(2+2) = 100 us
+        {"time": 60, "pod_0": "web-1", "service_1": "db-svc",
+         "rtt_sum": 300, "rtt_count": 2, "byte_tx": 10},
+        {"time": 120, "pod_0": "web-1", "service_1": "db-svc",
+         "rtt_sum": 100, "rtt_count": 2, "byte_tx": 30},
+        # pod web-2: avg rtt = 9000/1 = 9000 us
+        {"time": 60, "pod_0": "web-2", "service_1": "db-svc",
+         "rtt_sum": 9000, "rtt_count": 1, "byte_tx": 100},
+        # different service, high rtt but filtered by WHERE
+        {"time": 60, "pod_0": "web-3", "service_1": "other",
+         "rtt_sum": 5000, "rtt_count": 1, "byte_tx": 5},
+    ])
+    return db, t
+
+
+def test_having_filters_groups():
+    db, t = _network_1m()
+    res = execute(t, "SELECT pod_0, Sum(byte_tx) AS b FROM t "
+                     "GROUP BY pod_0 HAVING Sum(byte_tx) > 20 "
+                     "ORDER BY b DESC")
+    assert res.values == [["web-2", 100.0], ["web-1", 40.0]]
+
+
+def test_having_with_string_group_key():
+    db, t = _network_1m()
+    res = execute(t, "SELECT service_1, Sum(byte_tx) FROM t "
+                     "GROUP BY service_1 HAVING service_1 = 'db-svc'")
+    assert res.values == [["db-svc", 140.0]]
+
+
+def test_reference_style_flagship_query():
+    """The VERDICT's acid test: SELECT pod, Avg(rtt) ... WHERE service
+    ... GROUP BY pod HAVING Avg(rtt) > threshold."""
+    db, t = _network_1m()
+    res = execute(t, "SELECT pod_0, Avg(rtt) AS art FROM t "
+                     "WHERE service_1 = 'db-svc' "
+                     "GROUP BY pod_0 HAVING Avg(rtt) > 1000")
+    assert res.values == [["web-2", 9000.0]]
+    # and the complement
+    res = execute(t, "SELECT pod_0, Avg(rtt) AS art FROM t "
+                     "WHERE service_1 = 'db-svc' "
+                     "GROUP BY pod_0 HAVING Avg(rtt) <= 1000")
+    assert res.values == [["web-1", 100.0]]
+
+
+def test_derived_avg_rtt_is_sum_ratio_not_avg_of_avgs():
+    db, t = _network_1m()
+    res = execute(t, "SELECT Avg(rtt) FROM t WHERE pod_0 = 'web-1'")
+    # (300+100)/(2+2) = 100, NOT avg(150, 50) = 100 here but the ratio
+    # semantics matter with uneven counts:
+    assert res.values == [[100.0]]
+    t.append_rows([{"time": 180, "pod_0": "web-1", "service_1": "db-svc",
+                    "rtt_sum": 400, "rtt_count": 8, "byte_tx": 0}])
+    res = execute(t, "SELECT Avg(rtt) FROM t WHERE pod_0 = 'web-1'")
+    # (300+100+400)/(2+2+8) = 800/12, not mean(150,50,50)
+    assert res.values[0][0] == pytest.approx(800 / 12)
+
+
+def test_derived_rrt_max_and_error_sum():
+    db = Database()
+    t = db.table("flow_metrics.application.1m")
+    t.append_rows([
+        {"time": 60, "app_service": "a", "rrt_sum": 100, "rrt_count": 1,
+         "rrt_max": 70, "error_client": 2, "error_server": 1},
+        {"time": 120, "app_service": "a", "rrt_sum": 300, "rrt_count": 3,
+         "rrt_max": 250, "error_client": 0, "error_server": 4},
+    ])
+    res = execute(t, "SELECT Max(rrt), Avg(rrt), Sum(error) FROM t")
+    assert res.values == [[250.0, 100.0, 7.0]]
+
+
+def test_derived_unsupported_aggregate_is_clear_error():
+    db, t = _network_1m()
+    with pytest.raises(QueryError, match="not defined for derived"):
+        execute(t, "SELECT Percentile(rtt, 95) FROM t")
+
+
+def test_raw_table_rtt_column_not_rewritten():
+    """flow_log.l4_flow_log has a REAL rtt column; the derived registry
+    must not shadow it."""
+    db = Database()
+    t = db.table("flow_log.l4_flow_log")
+    t.append_rows([{"time": 1, "rtt": 500}, {"time": 2, "rtt": 700}])
+    res = execute(t, "SELECT Avg(rtt) FROM t")
+    assert res.values == [[600.0]]
+
+
+def test_having_without_group_by():
+    db, t = _network_1m()
+    # single implicit group; HAVING filters it in or out wholesale
+    res = execute(t, "SELECT Sum(byte_tx) FROM t HAVING Sum(byte_tx) > 1000")
+    assert res.values == []
+    res = execute(t, "SELECT Sum(byte_tx) FROM t HAVING Sum(byte_tx) > 10")
+    assert res.values == [[145.0]]
+
+
+# -- SHOW introspection ----------------------------------------------------
+
+
+def test_show_databases_and_tables():
+    res = catalog.show("databases")
+    dbs = [r[0] for r in res["values"]]
+    assert {"flow_log", "flow_metrics", "profile", "event",
+            "prometheus"} <= set(dbs)
+    res = catalog.show("tables")
+    tables = [r[0] for r in res["values"]]
+    assert "flow_log.l7_flow_log" in tables
+    assert "flow_metrics.network.1m" in tables
+
+
+def test_show_tags_classifies_dimensions():
+    res = catalog.show("tags", "flow_log.l7_flow_log")
+    names = {r[0] for r in res["values"]}
+    # strings, enums, universal + per-side tags are tags
+    assert {"l7_protocol", "request_resource", "trace_id", "pod_0",
+            "service_1", "az_0", "host", "agent_id"} <= names
+    # metrics are NOT tags
+    assert "response_duration" not in names
+    # enum tags carry their value set for autocomplete
+    enum_row = next(r for r in res["values"] if r[0] == "response_status")
+    assert enum_row[1] == "enum" and "server_error" in enum_row[2]
+
+
+def test_show_metrics_includes_derived():
+    res = catalog.show("metrics", "flow_metrics.network.1m")
+    names = {r[0] for r in res["values"]}
+    assert {"byte_tx", "rtt_sum", "rtt_count", "rtt"} <= names
+    derived_row = next(r for r in res["values"] if r[0] == "rtt")
+    assert "derived" in derived_row[1]
+    # tags are NOT metrics
+    assert "pod_0" not in names and "server_port" not in names
+
+
+def test_show_resolves_short_names():
+    # e.g. `show tags from network` hits flow_metrics.network.1s
+    res = catalog.show("tags", "network")
+    assert res["table"] == "flow_metrics.network.1s"
+
+
+def test_show_statement_parses():
+    stmt = S.parse_statement("SHOW TAGS FROM flow_log.l4_flow_log")
+    assert isinstance(stmt, S.Show)
+    assert stmt.what == "tags" and stmt.table == "flow_log.l4_flow_log"
+    stmt = S.parse_statement("show databases")
+    assert stmt.what == "databases"
+    sel = S.parse_statement("SELECT 1 FROM t")
+    assert isinstance(sel, S.Select)
+    with pytest.raises(S.SqlError):
+        S.parse_statement("SHOW nonsense")
+    with pytest.raises(S.SqlError):
+        S.parse_statement("SHOW TAGS")  # missing FROM
+
+
+def test_show_over_http_api():
+    import json
+    import urllib.request
+
+    from deepflow_tpu.server import Server
+    s = Server(host="127.0.0.1", ingest_port=0, query_port=0).start()
+    try:
+        r = urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{s.query_port}/v1/query/",
+            data=json.dumps({"sql": "show tags from "
+                                    "flow_metrics.application.1m"}).encode(),
+            headers={"Content-Type": "application/json"}), timeout=5)
+        out = json.loads(r.read())
+        names = {row[0] for row in out["result"]["values"]}
+        assert "app_service" in names and "service_0" in names
+    finally:
+        s.stop()
+
+
+def test_order_by_derived_metric():
+    db, t = _network_1m()
+    res = execute(t, "SELECT pod_0, Avg(rtt) FROM t GROUP BY pod_0 "
+                     "ORDER BY Avg(rtt) DESC LIMIT 1")
+    assert res.columns == ["pod_0", "AVG(rtt)"]
+    assert res.values == [["web-2", 9000.0]]
+
+
+def test_having_enum_in():
+    db = Database()
+    t = db.table("flow_log.l4_flow_log")
+    t.append_rows([{"time": 1, "protocol": 1, "byte_tx": 10},
+                   {"time": 2, "protocol": 2, "byte_tx": 20}])
+    res = execute(t, "SELECT protocol, Sum(byte_tx) FROM t "
+                     "GROUP BY protocol HAVING protocol IN ('tcp')")
+    assert res.values == [["tcp", 10.0]]
+
+
+def test_derived_column_display_name():
+    db, t = _network_1m()
+    res = execute(t, "SELECT Avg(rtt) FROM t")
+    assert res.columns == ["AVG(rtt)"]

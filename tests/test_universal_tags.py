@@ -1,0 +1,349 @@
+"""Universal tag injection: genesis resource model (pods + services +
+endpoints + nodes) -> IP-keyed ResourceIndex -> per-side tags on every
+flow/metric row at ingest -> queryable by SQL.
+
+Reference analog: server/libs/grpc/grpc_platformdata.go:292 QueryIPV4Infos
+backed by controller/tagrecorder dictionaries (const.go:66).
+"""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from deepflow_tpu.server.platform_info import (
+    NodeInfo, PodInfo, ResourceIndex, ServiceInfo)
+
+
+# -- ResourceIndex unit behavior ------------------------------------------
+
+
+def make_index():
+    r = ResourceIndex()
+    r.pod_index.upsert("10.244.1.5", PodInfo(
+        "web-6b7f9c-abc", "prod", node="node-1", workload="web"))
+    r.pod_index.upsert("10.244.2.7", PodInfo(
+        "api-0", "prod", node="node-2", workload="api"))
+    r.upsert_service(ServiceInfo("web-svc", "prod",
+                                 cluster_ip="10.96.0.10", ports=(80,)))
+    r.set_endpoints("prod", "web-svc", ["10.244.1.5"])
+    r.upsert_node(NodeInfo("node-1", az="us-east1-b",
+                           internal_ip="10.0.0.4",
+                           pod_cidrs=("10.244.1.0/24",)))
+    r.upsert_node(NodeInfo("node-2", az="us-east1-c",
+                           internal_ip="10.0.0.5",
+                           pod_cidrs=("10.244.2.0/24",)))
+    return r
+
+
+def test_resolve_pod_service_node_subnet():
+    r = make_index()
+    t = r.resolve("10.244.1.5")
+    assert t.resource_type == "pod" and t.pod == "web-6b7f9c-abc"
+    assert t.workload == "web" and t.service == "web-svc"
+    assert t.az == "us-east1-b" and t.subnet == "10.244.1.0/24"
+    # ClusterIP side resolves to the service itself
+    t = r.resolve("10.96.0.10")
+    assert t.resource_type == "service" and t.service == "web-svc"
+    assert t.pod_ns == "prod"
+    # node IP
+    t = r.resolve("10.0.0.4")
+    assert t.resource_type == "node" and t.node == "node-1"
+    assert t.az == "us-east1-b"
+    # unknown pod-range IP still gets subnet attribution
+    t = r.resolve("10.244.2.99")
+    assert t.resource_type == "" and t.subnet == "10.244.2.0/24"
+    # pod without endpoints membership: no service tag
+    assert r.resolve("10.244.2.7").service == ""
+
+
+def test_endpoints_update_and_service_churn():
+    r = make_index()
+    # endpoint set replacement: pod leaves the service
+    r.set_endpoints("prod", "web-svc", ["10.244.2.7"])
+    assert r.resolve("10.244.1.5").service == ""
+    assert r.resolve("10.244.2.7").service == "web-svc"
+    # service re-created with a different ClusterIP: old IP must unmap
+    r.upsert_service(ServiceInfo("web-svc", "prod", cluster_ip="10.96.0.99"))
+    assert r.resolve("10.96.0.10").resource_type == ""
+    assert r.resolve("10.96.0.99").service == "web-svc"
+    # deletion clears cluster-ip and endpoints mappings
+    r.remove_service("prod", "web-svc")
+    assert r.resolve("10.96.0.99").resource_type == ""
+    assert r.resolve("10.244.2.7").service == ""
+
+
+def test_reconciliation_evicts_stale():
+    r = make_index()
+    r.retain_services(set())            # relist says: no services
+    assert r.resolve("10.96.0.10").resource_type == ""
+    r.retain_endpoints(set())
+    assert r.resolve("10.244.1.5").service == ""
+    r.retain_nodes({"node-2"})
+    assert r.resolve("10.0.0.4").resource_type == ""
+    assert r.resolve("10.244.1.5").az == ""      # node-1 az gone
+    assert r.resolve("10.244.1.5").subnet == ""  # node-1 cidr gone
+    assert r.resolve("10.244.2.99").subnet == "10.244.2.0/24"
+
+
+def test_version_bumps_on_mutation():
+    r = ResourceIndex()
+    v0 = r.summary()["version"]
+    r.upsert_service(ServiceInfo("s", "d", cluster_ip="10.96.0.1"))
+    r.upsert_node(NodeInfo("n", internal_ip="10.0.0.1"))
+    r.set_endpoints("d", "s", ["10.244.0.1"])
+    assert r.summary()["version"] > v0
+
+
+# -- genesis list-watch over all four resources ---------------------------
+
+
+class _FakeK8sAll(BaseHTTPRequestHandler):
+    """Serves distinct PodList/ServiceList/EndpointsList/NodeList bodies
+    and one watch event stream per resource path."""
+    resources: dict = {}       # path suffix -> items
+    watch_events: dict = {}    # path suffix -> [events]
+
+    def log_message(self, *a):
+        pass
+
+    def _kind_of(self):
+        for kind in ("pods", "services", "endpoints", "nodes"):
+            if f"/{kind}" in self.path.split("?")[0]:
+                return kind
+        return "pods"
+
+    def do_GET(self):
+        kind = self._kind_of()
+        if "watch=1" in self.path:
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            for ev in self.watch_events.get(kind, []):
+                self.wfile.write((json.dumps(ev) + "\n").encode())
+                self.wfile.flush()
+            time.sleep(0.3)
+            return
+        body = json.dumps({
+            "kind": kind.capitalize() + "List",
+            "metadata": {"resourceVersion": "100"},
+            "items": self.resources.get(kind, [])}).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def _pod(name, ns, ip, node="node-1", owner=None):
+    meta = {"name": name, "namespace": ns, "resourceVersion": "101",
+            "labels": {"app": name}}
+    if owner:
+        meta["ownerReferences"] = [owner]
+    return {"metadata": meta, "spec": {"nodeName": node},
+            "status": {"podIP": ip, "podIPs": [{"ip": ip}]}}
+
+
+def _svc(name, ns, cluster_ip, ports=(80,)):
+    return {"metadata": {"name": name, "namespace": ns,
+                         "resourceVersion": "102"},
+            "spec": {"clusterIP": cluster_ip, "type": "ClusterIP",
+                     "ports": [{"port": p} for p in ports]}}
+
+
+def _eps(name, ns, ips):
+    return {"metadata": {"name": name, "namespace": ns,
+                         "resourceVersion": "103"},
+            "subsets": [{"addresses": [{"ip": ip} for ip in ips],
+                         "ports": [{"port": 80}]}]}
+
+
+def _node(name, az, internal_ip, pod_cidr):
+    return {"metadata": {"name": name, "resourceVersion": "104",
+                         "labels": {"topology.kubernetes.io/zone": az}},
+            "spec": {"podCIDR": pod_cidr, "podCIDRs": [pod_cidr]},
+            "status": {"addresses": [
+                {"type": "InternalIP", "address": internal_ip}]}}
+
+
+def _start_fake_k8s():
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _FakeK8sAll)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def test_genesis_watches_services_endpoints_nodes():
+    from deepflow_tpu.server.genesis import K8sGenesis
+    _FakeK8sAll.resources = {
+        "pods": [_pod("web-6b7f9c-abc", "prod", "10.244.1.5",
+                      owner={"kind": "ReplicaSet", "name": "web-6b7f9c"})],
+        "services": [_svc("web-svc", "prod", "10.96.0.10")],
+        "endpoints": [_eps("web-svc", "prod", ["10.244.1.5"])],
+        "nodes": [_node("node-1", "us-east1-b", "10.0.0.4",
+                        "10.244.1.0/24")],
+    }
+    _FakeK8sAll.watch_events = {
+        "services": [{"type": "ADDED",
+                      "object": _svc("db-svc", "prod", "10.96.0.20")}],
+    }
+    srv = _start_fake_k8s()
+    resources = ResourceIndex()
+    gen = K8sGenesis(resources.pod_index,
+                     api_base=f"http://127.0.0.1:{srv.server_port}",
+                     watch_timeout_s=1, resources=resources).start()
+    try:
+        deadline = time.time() + 5
+        while time.time() < deadline and (
+                resources.resolve("10.244.1.5").service != "web-svc"
+                or resources.resolve("10.96.0.20").resource_type == ""):
+            time.sleep(0.05)
+        t = resources.resolve("10.244.1.5")
+        assert t.pod == "web-6b7f9c-abc" and t.workload == "web"
+        assert t.service == "web-svc" and t.az == "us-east1-b"
+        assert t.subnet == "10.244.1.0/24"
+        assert resources.resolve("10.96.0.10").service == "web-svc"
+        assert resources.resolve("10.0.0.4").node == "node-1"
+        # watch ADDED service arrived
+        assert resources.resolve("10.96.0.20").service == "db-svc"
+        assert gen.stats["services"] == 1 and gen.stats["nodes"] == 1
+    finally:
+        gen.stop()
+        srv.shutdown()
+
+
+# -- end to end: genesis -> ingest -> SQL ---------------------------------
+
+
+def test_universal_tags_genesis_to_query():
+    """A flow between two pods carries both endpoints' pod/service/az
+    tags with zero agent config."""
+    from deepflow_tpu.agent.dispatcher import Dispatcher
+    from deepflow_tpu.agent.packet import TcpFlags, build_tcp
+    from deepflow_tpu.agent.sender import UniformSender
+    from deepflow_tpu.query import execute
+    from deepflow_tpu.server import Server
+
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0).start()
+    r = server.resources
+    r.pod_index.upsert("10.244.1.5", PodInfo(
+        "web-abc", "prod", node="node-1", workload="web"))
+    r.pod_index.upsert("10.244.2.7", PodInfo(
+        "api-xyz", "prod", node="node-2", workload="api"))
+    r.upsert_service(ServiceInfo("api-svc", "prod",
+                                 cluster_ip="10.96.0.30"))
+    r.set_endpoints("prod", "api-svc", ["10.244.2.7"])
+    r.upsert_node(NodeInfo("node-1", az="us-east1-b",
+                           pod_cidrs=("10.244.1.0/24",)))
+    r.upsert_node(NodeInfo("node-2", az="us-east1-c",
+                           pod_cidrs=("10.244.2.0/24",)))
+    sender = UniformSender(
+        servers=[("127.0.0.1", server.ingest_port)]).start()
+    disp = Dispatcher(sender=sender, engine="python")
+    try:
+        disp.inject(build_tcp("10.244.1.5", "10.244.2.7", 40000, 80,
+                              TcpFlags.SYN, timestamp_ns=time.time_ns()))
+        disp.flush(force=True)
+        assert server.wait_for_rows("flow_log.l4_flow_log", 1, timeout=10)
+        res = execute(server.db.table("flow_log.l4_flow_log"),
+                      "SELECT pod_0, workload_0, az_0, subnet_0, pod_1, "
+                      "service_1, az_1 FROM flow_log.l4_flow_log")
+        row = dict(zip(res.columns, res.values[0]))
+        assert row["pod_0"] == "web-abc" and row["workload_0"] == "web"
+        assert row["az_0"] == "us-east1-b"
+        assert row["subnet_0"] == "10.244.1.0/24"
+        assert row["pod_1"] == "api-xyz" and row["service_1"] == "api-svc"
+        assert row["az_1"] == "us-east1-c"
+    finally:
+        sender.flush_and_stop()
+        server.stop()
+
+
+def test_cluster_ip_flow_tagged_with_service():
+    """A flow to a ClusterIP is tagged with the service on the dst side
+    (the agent can't see the backing pod after DNAT upstream of it)."""
+    import queue as _q
+
+    from deepflow_tpu.codec import FrameHeader, MessageType
+    from deepflow_tpu.proto import pb
+    from deepflow_tpu.query import execute
+    from deepflow_tpu.server.decoders import FlowLogDecoder
+    from deepflow_tpu.server.platform_info import PlatformInfoTable
+    from deepflow_tpu.store import Database
+
+    db = Database()
+    r = make_index()
+    batch = pb.FlowLogBatch()
+    f = batch.l4.add()
+    f.flow_id = 9
+    f.key.ip_src = bytes([10, 244, 1, 5])
+    f.key.ip_dst = bytes([10, 96, 0, 10])    # ClusterIP of web-svc
+    f.key.port_src = 41000
+    f.key.port_dst = 80
+    f.key.proto = 1
+    f.start_time_ns = f.end_time_ns = time.time_ns()
+    dec = FlowLogDecoder(_q.Queue(), db, PlatformInfoTable(), resources=r)
+    dec.handle(FrameHeader(MessageType.L4_LOG, agent_id=1),
+               batch.SerializeToString())
+    res = execute(db.table("flow_log.l4_flow_log"),
+                  "SELECT service_1, pod_ns_1, pod_1 "
+                  "FROM flow_log.l4_flow_log")
+    row = dict(zip(res.columns, res.values[0]))
+    assert row["service_1"] == "web-svc" and row["pod_ns_1"] == "prod"
+    assert row["pod_1"] == ""
+
+
+def test_metrics_rows_carry_side_tags_through_rollup():
+    import queue as _q
+
+    from deepflow_tpu.codec import FrameHeader, MessageType
+    from deepflow_tpu.proto import pb
+    from deepflow_tpu.query import execute
+    from deepflow_tpu.server.datasource import RollupJob
+    from deepflow_tpu.server.decoders import MetricsDecoder
+    from deepflow_tpu.server.platform_info import PlatformInfoTable
+    from deepflow_tpu.store import Database
+
+    db = Database()
+    r = make_index()
+    now_s = 1_700_000_000
+    batch = pb.DocumentBatch()
+    for i in range(2):
+        d = batch.docs.add()
+        d.timestamp_s = now_s + i
+        d.tag.ip_src = bytes([10, 244, 1, 5])
+        d.tag.ip_dst = bytes([10, 244, 2, 7])
+        d.tag.port = 80
+        d.tag.proto = 1
+        d.flow_meter.byte_tx = 100
+        d.flow_meter.packet_tx = 1
+    dec = MetricsDecoder(_q.Queue(), db, PlatformInfoTable(), resources=r)
+    dec.handle(FrameHeader(MessageType.METRICS, agent_id=1),
+               batch.SerializeToString())
+    res = execute(db.table("flow_metrics.network.1s"),
+                  "SELECT pod_0, service_0, az_1 "
+                  "FROM flow_metrics.network.1s")
+    row = dict(zip(res.columns, res.values[0]))
+    assert row["pod_0"] == "web-6b7f9c-abc"
+    assert row["service_0"] == "web-svc" and row["az_1"] == "us-east1-c"
+    # tags survive the 1s -> 1m rollup (grouped dims, not dropped)
+    job = RollupJob(db, lateness_s=0)
+    job.roll(now_s + 120)
+    res = execute(db.table("flow_metrics.network.1m"),
+                  "SELECT pod_0, az_1, byte_tx FROM flow_metrics.network.1m")
+    row = dict(zip(res.columns, res.values[0]))
+    assert row["pod_0"] == "web-6b7f9c-abc" and row["az_1"] == "us-east1-c"
+    assert row["byte_tx"] == 200
+
+
+def test_endpoints_without_subsets_clears_mapping():
+    """K8s omits `subsets` when a service scales to zero; the stale
+    pod-ip -> service mapping must clear, not linger until relist."""
+    from deepflow_tpu.server.genesis import K8sGenesis
+    resources = make_index()
+    gen = K8sGenesis(resources.pod_index, api_base="http://127.0.0.1:1",
+                     watch_timeout_s=1, resources=resources)
+    assert resources.resolve("10.244.1.5").service == "web-svc"
+    gen._apply_endpoints("MODIFIED", {
+        "metadata": {"name": "web-svc", "namespace": "prod"}})
+    assert resources.resolve("10.244.1.5").service == ""
+    # a pod object leaking onto the endpoints path is still ignored
+    gen._apply_endpoints("MODIFIED", _pod("x", "prod", "10.244.9.9"))
