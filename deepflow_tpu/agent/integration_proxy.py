@@ -17,7 +17,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 log = logging.getLogger("df.integration-proxy")
 
 _FORWARD_PATHS = ("/api/v1/otlp/traces", "/api/v1/profile/ingest",
-                  "/api/v1/log", "/api/v1/write", "/api/v1/telegraf",
+                  "/api/v1/log", "/api/v1/otlp/logs",
+                  "/api/v1/write", "/api/v1/telegraf",
                   "/v0.3/traces", "/v0.4/traces", "/v3/segments")
 
 

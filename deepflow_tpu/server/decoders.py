@@ -112,9 +112,11 @@ class Decoder:
         if (self.exporters is not None and n
                 and self.exporters.wants(table_name)):
             names = list(cols)
+            expanded = [v if isinstance(v, list) else [v] * n
+                        for v in cols.values()]
             self.exporters.feed(
                 table_name,
-                [dict(zip(names, vals)) for vals in zip(*cols.values())])
+                [dict(zip(names, vals)) for vals in zip(*expanded)])
 
 
 class ProfileDecoder(Decoder):
@@ -260,7 +262,7 @@ class FlowLogDecoder(Decoder):
             cols["gprocess_id_1"] = [
                 f.gpid_1 or self._gpid(k.ip_dst, k.port_dst, int(k.proto))
                 for f, k in zip(items, keys)]
-        if self.resources is not None:
+        if self.resources is not None and not self.resources.is_empty():
             res = self.resources.batch_resolver()
             t0 = [res(s) for s in src_s]
             t1 = [res(s) for s in dst_s]
@@ -269,6 +271,13 @@ class FlowLogDecoder(Decoder):
             for name in SIDE_RESOLVE_NAMES:
                 cols[f"{name}_0"] = [getattr(t, name) for t in t0]
                 cols[f"{name}_1"] = [getattr(t, name) for t in t1]
+        elif self.resources is not None:
+            # nothing can resolve: constant columns (scalar broadcast)
+            cols["pod_0"] = [f.pod_0 for f in items]
+            cols["pod_1"] = [f.pod_1 for f in items]
+            for name in SIDE_RESOLVE_NAMES:
+                cols[f"{name}_0"] = ""
+                cols[f"{name}_1"] = ""
         elif self.pod_index is not None and len(self.pod_index):
             pods = self.pod_index.snapshot()
 
@@ -299,16 +308,18 @@ class FlowLogDecoder(Decoder):
             # building was the GIL-bound bottleneck, see Decoder.WORKERS)
             l4 = list(batch.l4)
             keys = [f.key for f in l4]
-            src_s = [_ip_str(k.ip_src) for k in keys]
-            dst_s = [_ip_str(k.ip_dst) for k in keys]
+            src_d = [_ip_decode(k.ip_src) for k in keys]
+            dst_d = [_ip_decode(k.ip_dst) for k in keys]
+            src_s = [t[0] for t in src_d]
+            dst_s = [t[0] for t in dst_d]
             endpoint_cols = self._endpoint_cols(l4, keys, src_s, dst_s)
             cols = {
                 "time": [f.end_time_ns + off for f in l4],
                 "flow_id": [f.flow_id for f in l4],
                 "ip_src": src_s,
                 "ip_dst": dst_s,
-                "ip4_src": [_ip4_u32(k.ip_src) for k in keys],
-                "ip4_dst": [_ip4_u32(k.ip_dst) for k in keys],
+                "ip4_src": [t[1] for t in src_d],
+                "ip4_dst": [t[1] for t in dst_d],
                 "port_src": [k.port_src for k in keys],
                 "port_dst": [k.port_dst for k in keys],
                 "protocol": [int(k.proto) for k in keys],
@@ -334,8 +345,7 @@ class FlowLogDecoder(Decoder):
                 "tunnel_id": [k.tunnel_id for k in keys],
                 **endpoint_cols,
             }
-            for tk, tv in tags.items():
-                cols[tk] = [tv] * len(l4)
+            cols.update(tags)  # constant per batch: scalar broadcast
             self.write_columns("flow_log.l4_flow_log", cols, len(l4))
             n += len(l4)
         if batch.l7:
@@ -385,8 +395,7 @@ class FlowLogDecoder(Decoder):
                 "process_kname_1": [f.process_kname_1 for f in l7],
                 "attrs": [f.attrs_json for f in l7],
             }
-            for tk, tv in tags.items():
-                cols[tk] = [tv] * len(l7)
+            cols.update(tags)  # constant per batch: scalar broadcast
             self.write_columns("flow_log.l7_flow_log", cols, len(l7))
             if self.trace_trees is not None:
                 self._feed_trace_trees(cols, len(l7))
@@ -397,6 +406,10 @@ class FlowLogDecoder(Decoder):
         """Traced rows (non-empty trace_id: typically a small subset)
         feed the ingest-time trace_tree precompute."""
         from deepflow_tpu.server.tracetree import span_from_l7
+
+        def at(col, i):
+            """Columns may be scalars (constant broadcast) or lists."""
+            return col[i] if isinstance(col, list) else col
         tids = cols["trace_id"]
         for i in range(n):
             tid = tids[i]
@@ -413,10 +426,10 @@ class FlowLogDecoder(Decoder):
                 "request_type": cols["request_type"][i],
                 "endpoint": cols["endpoint"][i],
                 "request_resource": cols["request_resource"][i],
-                "app_service": cols["app_service"][i]
+                "app_service": at(cols["app_service"], i)
                 if "app_service" in cols else "",
-                "service_1": cols.get("service_1", [""] * n)[i],
-                "host": cols.get("host", [""] * n)[i],
+                "service_1": at(cols.get("service_1", ""), i),
+                "host": at(cols.get("host", ""), i),
                 "l7_protocol": (L7_PROTOS[proto_i]
                                 if 0 <= proto_i < len(L7_PROTOS)
                                 else "unknown"),
@@ -452,7 +465,7 @@ class MetricsDecoder(Decoder):
                 "ip_dst": dst_s,
                 "server_port": [d.tag.port for d in docs],
             }
-            if self.resources is not None:
+            if self.resources is not None and not self.resources.is_empty():
                 # per-side universal tags on metrics rows: this is what
                 # makes "group any metric by any resource" work
                 res = self.resources.batch_resolver()
@@ -463,8 +476,14 @@ class MetricsDecoder(Decoder):
                 for name in SIDE_RESOLVE_NAMES:
                     cols[f"{name}_0"] = [getattr(t, name) for t in t0]
                     cols[f"{name}_1"] = [getattr(t, name) for t in t1]
-            for tk, tv in tags.items():
-                cols[tk] = [tv] * len(docs)
+            elif self.resources is not None:
+                # keep the exported row shape stable vs the resolving case
+                cols["pod_0"] = ""
+                cols["pod_1"] = ""
+                for name in SIDE_RESOLVE_NAMES:
+                    cols[f"{name}_0"] = ""
+                    cols[f"{name}_1"] = ""
+            cols.update(tags)  # constant per batch: scalar broadcast
             return cols
 
         net = [d for d in batch.docs if d.HasField("flow_meter")]
@@ -623,22 +642,39 @@ class EventDecoder(Decoder):
         self._flush_agg(force=True)
 
 
+_IP_CACHE: dict[bytes, tuple[str, int]] = {}
+_IP_CACHE_MAX = 1 << 16
+
+
+def _ip_decode(raw: bytes) -> tuple[str, int]:
+    """raw bytes -> (dotted string, u32). Memoized: real traffic repeats a
+    bounded host set, so the formatting cost is paid once per address."""
+    hit = _IP_CACHE.get(raw)
+    if hit is not None:
+        return hit
+    if len(raw) == 4:
+        val = ("%d.%d.%d.%d" % (raw[0], raw[1], raw[2], raw[3]),
+               int.from_bytes(raw, "big"))
+    elif not raw:
+        val = ("", 0)
+    else:
+        import ipaddress
+        try:
+            val = (str(ipaddress.ip_address(raw)), 0)
+        except ValueError:
+            val = (raw.hex(), 0)
+    if len(_IP_CACHE) >= _IP_CACHE_MAX:
+        _IP_CACHE.clear()  # coarse reset beats per-entry LRU bookkeeping
+    _IP_CACHE[bytes(raw)] = val
+    return val
+
+
 def _ip_str(raw: bytes) -> str:
-    if len(raw) == 4:  # hot path: ipaddress costs ~5us/call, this ~0.3us
-        return "%d.%d.%d.%d" % (raw[0], raw[1], raw[2], raw[3])
-    if not raw:
-        return ""
-    import ipaddress
-    try:
-        return str(ipaddress.ip_address(raw))
-    except ValueError:
-        return raw.hex()
+    return _ip_decode(raw)[0]
 
 
 def _ip4_u32(raw: bytes) -> int:
-    if len(raw) == 4:
-        return int.from_bytes(raw, "big")
-    return 0
+    return _ip_decode(raw)[1]
 
 
 def _close_type_idx(name: str) -> int:

@@ -35,6 +35,20 @@ def _status_from_http(code: int) -> int:
     return 1 if code else 0
 
 
+def _any_value_str(v) -> str:
+    """OTLP AnyValue -> display string: plain scalars verbatim, structured
+    bodies (kvlist/array/bytes) as JSON — a structured-logging client's
+    body must not silently become empty."""
+    if not isinstance(v, dict):
+        return "" if v is None else str(v)
+    for key in ("stringValue", "intValue", "doubleValue", "boolValue"):
+        if key in v:
+            return str(v[key])
+    if "kvlistValue" in v or "arrayValue" in v or "bytesValue" in v:
+        return json.dumps(v, sort_keys=True)
+    return ""
+
+
 def _attr_map(attrs: list) -> dict:
     out = {}
     for a in attrs or []:
@@ -246,22 +260,85 @@ class IntegrationAPI:
             seed(metric_ids, set_ids)
 
     # -- app logs (POST /api/v1/log) -----------------------------------------
+    # reference: server/ingester/app_log — a DEDICATED log store (not an
+    # event row): untruncated body, OTLP severity, trace/span join columns.
+
+    _SEVERITY_NUM = {"trace": 1, "debug": 5, "info": 9, "warn": 13,
+                     "warning": 13, "error": 17, "fatal": 21, "crit": 21,
+                     "critical": 21}
 
     def ingest_app_log(self, body: dict) -> dict:
         entries = body if isinstance(body, list) else [body]
         entries = [e for e in entries if isinstance(e, dict)]
-        rows = [{
-            "time": int(e.get("timestamp_ns", time.time_ns())),
-            "event_type": "app-log",
-            "resource_type": "log",
-            "resource_name": str(e.get("service", "")),
-            "description": str(e.get("message", ""))[:1024],
-            "attrs": json.dumps(
-                {k: str(v) for k, v in e.items()
-                 if k not in ("message", "timestamp_ns")},
-                sort_keys=True),
-        } for e in entries]
-        self._write("event.event", rows)
+        rows = []
+        for e in entries:
+            sev_text = str(e.get("severity", e.get("level", "")))
+            sev_num = _int0(e.get("severity_number", 0)) or \
+                self._SEVERITY_NUM.get(sev_text.lower(), 0)
+            rows.append({
+                "time": int(e.get("timestamp_ns", time.time_ns())),
+                "app_service": str(e.get("service", "")),
+                "app_instance": str(e.get("instance", "")),
+                "log_source": 1,  # app
+                "severity_number": min(24, max(0, sev_num)),
+                "severity_text": sev_text,
+                "body": str(e.get("message", "")),
+                "trace_id": str(e.get("trace_id", "")),
+                "span_id": str(e.get("span_id", "")),
+                "attrs": json.dumps(
+                    {k: str(v) for k, v in e.items()
+                     if k not in ("message", "timestamp_ns", "service",
+                                  "instance", "severity", "level",
+                                  "severity_number", "trace_id", "span_id")},
+                    sort_keys=True),
+            })
+        self._write("application_log.log", rows)
+        self.stats["app_logs"] += len(rows)
+        return {"accepted": len(rows)}
+
+    # -- OTLP logs (POST /api/v1/otlp/logs) ----------------------------------
+    # OTLP/HTTP JSON LogsData: resourceLogs -> scopeLogs -> logRecords.
+
+    def ingest_otlp_logs(self, body: dict) -> dict:
+        if not isinstance(body, dict):
+            raise ValueError("OTLP body must be a JSON object")
+        rows = []
+        for rl in body.get("resourceLogs", []):
+            if not isinstance(rl, dict):
+                raise ValueError("resourceLogs entries must be objects")
+            res = rl.get("resource", {})
+            if not isinstance(res, dict):
+                raise ValueError("resource must be an object")
+            res_attrs = _attr_map(res.get("attributes"))
+            service = str(res_attrs.get("service.name", ""))
+            instance = str(res_attrs.get("service.instance.id", ""))
+            for sl in rl.get("scopeLogs", []):
+                if not isinstance(sl, dict):
+                    continue
+                for rec in sl.get("logRecords", []):
+                    if not isinstance(rec, dict):
+                        continue
+                    text = _any_value_str(rec.get("body", {}))
+                    attrs = _attr_map(rec.get("attributes"))
+                    ts = _int0(rec.get("timeUnixNano", 0)) or \
+                        _int0(rec.get("observedTimeUnixNano", 0)) or \
+                        time.time_ns()
+                    rows.append({
+                        "time": ts,
+                        "app_service": service,
+                        "app_instance": instance,
+                        "log_source": 2,  # otlp
+                        "severity_number": min(24, max(0, _int0(
+                            rec.get("severityNumber", 0)))),
+                        "severity_text": str(rec.get("severityText", "")),
+                        "body": text,
+                        "trace_id": str(rec.get("traceId", "")),
+                        "span_id": str(rec.get("spanId", "")),
+                        "attrs": json.dumps(
+                            {k: str(v) for k, v in attrs.items()},
+                            sort_keys=True),
+                    })
+        self._write("application_log.log", rows)
         self.stats["app_logs"] += len(rows)
         return {"accepted": len(rows)}
 

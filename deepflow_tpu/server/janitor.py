@@ -32,6 +32,7 @@ DEFAULT_TTL_S = {
     "prometheus.samples": 7 * 86400,
     "deepflow_system.deepflow_system": 7 * 86400,
     "event.event": 7 * 86400,
+    "application_log.log": 7 * 86400,
 }
 
 
@@ -89,6 +90,15 @@ class Janitor:
             n = table.trim_before("time", cutoff)
             if n:
                 log.info("janitor: trimmed %d rows from %s", n, name)
+                # dictionaries are append-only; without compaction after a
+                # trim, high-cardinality columns (log bodies, trace ids,
+                # stacks) grow without bound
+                compacted = table.compact_dictionaries()
+                if compacted:
+                    log.info("janitor: compacted dictionaries on %s: %s",
+                             name, compacted)
+                    self.stats["dicts_compacted"] = \
+                        self.stats.get("dicts_compacted", 0) + len(compacted)
             trimmed += n
         self.stats["sweeps"] += 1
         self.stats["rows_trimmed"] += trimmed

@@ -376,6 +376,14 @@ class ResourceIndex:
                                     az=node.az, subnet=subnet)
             return EndpointTags(subnet=subnet) if subnet else _EMPTY_TAGS
 
+    def is_empty(self) -> bool:
+        """True when no resource can resolve to anything — decoders then
+        skip per-row resolution entirely (the common standalone case)."""
+        with self._lock:
+            has_any = (self._svc_by_key or self._node_by_name
+                       or self._subnets)
+        return not has_any and len(self.pod_index) == 0
+
     def batch_resolver(self):
         """Per-batch memoized resolve: decoders call this once per batch so
         repeated IPs cost one dict hit, not a lock round-trip."""

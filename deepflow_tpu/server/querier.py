@@ -503,6 +503,67 @@ class QuerierAPI:
             tpu_table=self.db.table("profile.tpu_hlo_span"),
             max_spans=max_spans)
 
+    def log_search(self, body: dict) -> dict:
+        """Search over the dedicated application_log.log store (reference:
+        server/ingester/app_log + querier log queries). Filters:
+        app_service, trace_id, min_severity, body substring (pushed down
+        onto the body dictionary, not the rows), time range, limit.
+        Newest rows first."""
+        import numpy as np
+        body = body or {}
+        t = self.db.table("application_log.log")
+        limit = max(1, min(10_000, int(body.get("limit", 100) or 100)))
+        svc = body.get("app_service")
+        needle = body.get("query") or body.get("body_contains")
+        trace_id = body.get("trace_id")
+        min_sev = int(body.get("min_severity", 0) or 0)
+        t_from = int(body.get("from_ns", 0) or 0)
+        t_to = int(body.get("to_ns", 0) or 0)
+        empty = {"result": {"logs": [], "count": 0}}
+        body_ids = None
+        if needle:
+            needle_l = str(needle).lower()
+            body_ids = t.dicts["body"].match_ids(
+                lambda s: needle_l in s.lower())
+            if not len(body_ids):
+                return empty
+        svc_id = t.dicts["app_service"].lookup(str(svc)) if svc else None
+        if svc and svc_id is None:
+            return empty
+        tid_id = (t.dicts["trace_id"].lookup(str(trace_id))
+                  if trace_id else None)
+        if trace_id and tid_id is None:
+            return empty
+        names = ("time", "app_service", "app_instance", "severity_number",
+                 "severity_text", "body", "trace_id", "span_id", "attrs")
+        out: list[dict] = []
+        for ch in reversed(t.snapshot()):    # chunks are time-ordered
+            if not ch:
+                continue
+            mask = np.ones(len(ch["time"]), dtype=bool)
+            if t_from:
+                mask &= ch["time"] >= t_from
+            if t_to:
+                mask &= ch["time"] < t_to
+            if svc_id is not None:
+                mask &= ch["app_service"] == svc_id
+            if tid_id is not None:
+                mask &= ch["trace_id"] == tid_id
+            if min_sev:
+                mask &= ch["severity_number"] >= min_sev
+            if body_ids is not None:
+                mask &= np.isin(ch["body"], body_ids)
+            for i in np.flatnonzero(mask).tolist()[::-1]:
+                row = {}
+                for n in names:
+                    v = ch[n][i]
+                    row[n] = (t.dicts[n].decode(int(v)) if n in t.dicts
+                              else int(v))
+                out.append(row)
+                if len(out) >= limit:
+                    return {"result": {"logs": out, "count": len(out)}}
+        return {"result": {"logs": out, "count": len(out)}}
+
     def trace_search(self, body: dict) -> dict:
         """Service-path search over precomputed trace trees (reference:
         trace_tree service-path queries). Body: {service_path: [..],
@@ -784,6 +845,11 @@ class QuerierHTTP:
                                    api.integration.ingest_otlp_traces(body))
                     elif path == "/api/v1/log":
                         self._send(200, api.integration.ingest_app_log(body))
+                    elif path == "/api/v1/otlp/logs":
+                        self._send(200,
+                                   api.integration.ingest_otlp_logs(body))
+                    elif path == "/v1/log/search":
+                        self._send(200, api.log_search(body))
                     elif path == "/v3/segments":
                         self._send(200,
                                    api.integration.ingest_skywalking(body))

@@ -294,6 +294,25 @@ _table("event.file_agg", [
     *UNIVERSAL_TAGS,
 ])
 
+# -- application logs ------------------------------------------------------
+# reference: server/ingester/app_log/dbwriter (application_log.log table):
+# dedicated log store with UNTRUNCATED body, OTLP severity, and
+# trace_id/span_id join columns so a log line links to its trace.
+_table("application_log.log", [
+    C("time", "u64"),                   # ns
+    C("app_service", "str"),
+    C("app_instance", "str"),
+    C("log_source", "enum",
+      ("unknown", "app", "otlp", "syslog", "agent")),
+    C("severity_number", "u8"),         # OTLP severity 1-24 (0 unknown)
+    C("severity_text", "str"),
+    C("body", "str"),                   # full line, never truncated
+    C("trace_id", "str"),
+    C("span_id", "str"),
+    C("attrs", "str"),                  # json
+    *UNIVERSAL_TAGS,
+])
+
 # -- prometheus remote-write samples ---------------------------------------
 # reference: server/ingester/prometheus (label->ID SmartEncoding); here the
 # label set is dictionary-encoded as one canonical json string per series

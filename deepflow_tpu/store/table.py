@@ -80,11 +80,16 @@ class ColumnarTable:
 
     def append_columns(self, cols: dict[str, list | np.ndarray],
                        n: int | None = None) -> None:
-        """Column-oriented append (fast path for decoders)."""
+        """Column-oriented append (fast path for decoders).
+
+        A column value may be a SCALAR (str/int/float), meaning "this value
+        for every row in the batch" — constant columns (per-agent universal
+        tags) then cost one dictionary encode + one list multiply instead of
+        n per-cell encodes."""
         if n is None:
             n = len(next(iter(cols.values())))
         for name, v in cols.items():
-            if len(v) != n:
+            if isinstance(v, (list, np.ndarray)) and len(v) != n:
                 raise ValueError(
                     f"{self.name}: column {name!r} has {len(v)} values, "
                     f"expected {n}")
@@ -95,9 +100,12 @@ class ColumnarTable:
                 col = self._buf[name]
                 if name in cols:
                     v = cols[name]
-                    if spec.kind == "str":
-                        d = self.dicts[name]
-                        col.extend(d.encode(s) for s in v)
+                    if not isinstance(v, (list, np.ndarray)):  # scalar
+                        if spec.kind == "str":
+                            v = self.dicts[name].encode(v)
+                        col.extend([v] * n)
+                    elif spec.kind == "str":
+                        col.extend(self.dicts[name].encode_batch(v))
                     elif isinstance(v, np.ndarray):
                         col.extend(v.tolist())
                     else:
@@ -194,6 +202,50 @@ class ColumnarTable:
             self._chunks = kept
             self.rows_written -= dropped  # keep __len__ = live rows
         return dropped
+
+    def compact_dictionaries(self, min_entries: int = 4096,
+                             max_live_frac: float = 0.5) -> dict:
+        """Rebuild string dictionaries down to the ids still referenced by
+        live data. TTL trims drop chunks but dictionaries are append-only,
+        so high-cardinality columns (log bodies, trace ids, folded stacks)
+        would otherwise grow without bound (ClickHouse reclaims
+        LowCardinality storage on partition drop; the embedded store needs
+        this explicit pass). Only columns with >= min_entries entries of
+        which <= max_live_frac are still referenced get rebuilt.
+
+        Chunks are remapped into NEW chunk dicts and swapped together with
+        the new dictionary under the table lock. A reader that snapshotted
+        before the swap and decodes via self.dicts after it may mis-render
+        strings for that one scan; the janitor runs this rarely
+        (post-trim) to keep the window negligible."""
+        stats: dict[str, dict] = {}
+        with self._lock:
+            for name in list(self.dicts):
+                d = self.dicts[name]
+                old_n = len(d)
+                if old_n < min_entries:
+                    continue
+                used: set[int] = set()
+                for ch in self._chunks:
+                    used.update(np.unique(ch[name]).tolist())
+                used.update(self._buf[name])
+                used.discard(0)
+                if len(used) + 1 > old_n * max_live_frac:
+                    continue
+                order = sorted(used)
+                strings = [""] + [d.decode(i) for i in order]
+                lut = np.zeros(old_n, dtype=np.uint32)
+                for new_id, old_id in enumerate(order, start=1):
+                    lut[old_id] = new_id
+                self._chunks = [
+                    {**ch, name: lut[ch[name]]} for ch in self._chunks]
+                self._buf[name] = [int(lut[i]) for i in self._buf[name]]
+                nd = Dictionary(d.name)
+                nd._strings = strings
+                nd._str_to_id = {s: i for i, s in enumerate(strings)}
+                self.dicts[name] = nd
+                stats[name] = {"before": old_n, "after": len(strings)}
+        return stats
 
     # -- persistence (npz per chunk + dict json) -----------------------------
 

@@ -1,0 +1,166 @@
+"""application_log.log: dedicated log store (reference:
+server/ingester/app_log — untruncated body, severity, trace join)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from deepflow_tpu.server import Server
+
+
+def _post(port: int, path: str, obj) -> dict:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"})
+    return json.loads(urllib.request.urlopen(req, timeout=5).read())
+
+
+@pytest.fixture
+def server():
+    s = Server(host="127.0.0.1", ingest_port=0, query_port=0).start()
+    yield s
+    s.stop()
+
+
+def test_log_roundtrip_untruncated(server):
+    big = "x" * 5000 + "-END"  # far past the old 1024-char event cap
+    out = _post(server.query_port, "/api/v1/log", {
+        "service": "checkout", "message": big, "level": "error",
+        "trace_id": "abc123", "span_id": "s1", "timestamp_ns": 1_000,
+        "custom": "v"})
+    assert out["accepted"] == 1
+    res = _post(server.query_port, "/v1/log/search",
+                {"app_service": "checkout"})["result"]
+    assert res["count"] == 1
+    row = res["logs"][0]
+    assert row["body"] == big                  # untruncated
+    assert row["severity_number"] == 17        # error
+    assert row["severity_text"] == "error"
+    assert row["trace_id"] == "abc123"
+    assert json.loads(row["attrs"])["custom"] == "v"
+
+
+def test_log_joins_trace(server):
+    tid = "deadbeefcafe0001"
+    # a trace span and a log line sharing the trace id
+    _post(server.query_port, "/api/v1/otlp/traces", {
+        "resourceSpans": [{
+            "resource": {"attributes": [
+                {"key": "service.name",
+                 "value": {"stringValue": "checkout"}}]},
+            "scopeSpans": [{"spans": [{
+                "traceId": tid, "spanId": "aaa", "name": "GET /pay",
+                "startTimeUnixNano": 1000, "endTimeUnixNano": 2000}]}]}]})
+    _post(server.query_port, "/api/v1/log", {
+        "service": "checkout", "message": "payment failed",
+        "level": "warn", "trace_id": tid})
+    res = _post(server.query_port, "/v1/log/search",
+                {"trace_id": tid})["result"]
+    assert res["count"] == 1
+    assert res["logs"][0]["body"] == "payment failed"
+    # and the trace itself is assemblable
+    tree = _post(server.query_port, "/v1/trace/Tracing",
+                 {"trace_id": tid})["result"]
+    assert tree["span_count"] == 1
+
+
+def test_otlp_logs_ingest(server):
+    out = _post(server.query_port, "/api/v1/otlp/logs", {
+        "resourceLogs": [{
+            "resource": {"attributes": [
+                {"key": "service.name", "value": {"stringValue": "svc-a"}},
+                {"key": "service.instance.id",
+                 "value": {"stringValue": "pod-1"}}]},
+            "scopeLogs": [{"logRecords": [
+                {"timeUnixNano": "123456789", "severityNumber": 9,
+                 "severityText": "INFO",
+                 "body": {"stringValue": "started ok"},
+                 "traceId": "t1", "spanId": "s1",
+                 "attributes": [{"key": "k",
+                                 "value": {"stringValue": "v"}}]},
+                {"severityNumber": 17, "severityText": "ERROR",
+                 "body": {"stringValue": "boom"}},
+            ]}]}]})
+    assert out["accepted"] == 2
+    res = _post(server.query_port, "/v1/log/search",
+                {"min_severity": 17})["result"]
+    assert res["count"] == 1
+    assert res["logs"][0]["body"] == "boom"
+    res = _post(server.query_port, "/v1/log/search",
+                {"query": "started"})["result"]
+    assert res["count"] == 1
+    assert res["logs"][0]["app_instance"] == "pod-1"
+    assert res["logs"][0]["time"] == 123456789
+
+
+def test_otlp_structured_body_and_bad_resource(server):
+    # structured AnyValue bodies must not be silently emptied
+    out = _post(server.query_port, "/api/v1/otlp/logs", {
+        "resourceLogs": [{
+            "scopeLogs": [{"logRecords": [
+                {"body": {"intValue": "42"}},
+                {"body": {"kvlistValue": {"values": [
+                    {"key": "k", "value": {"stringValue": "v"}}]}}},
+            ]}]}]})
+    assert out["accepted"] == 2
+    res = _post(server.query_port, "/v1/log/search", {})["result"]
+    bodies = {r["body"] for r in res["logs"]}
+    assert "42" in bodies
+    assert any("kvlistValue" in b for b in bodies)
+    # malformed resource is a 400, not a 500
+    import urllib.error
+    try:
+        _post(server.query_port, "/api/v1/otlp/logs",
+              {"resourceLogs": [{"resource": []}]})
+        assert False, "expected 400"
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+
+
+def test_dictionary_compaction_after_ttl():
+    """TTL trim + compaction bounds the body dictionary (review finding:
+    append-only dictionaries would otherwise retain every distinct log
+    line forever)."""
+    from deepflow_tpu.server.janitor import Janitor
+    from deepflow_tpu.store.db import Database
+    db = Database()
+    t = db.table("application_log.log")
+    t.chunk_rows = 1024
+    old_ns = 1_000_000_000 * 1_000_000_000       # ancient
+    t.append_rows([{"time": old_ns, "body": f"old-line-{i}"}
+                   for i in range(8192)])
+    t.append_rows([{"time": 2_000_000_000 * 1_000_000_000,
+                    "body": "fresh"}])
+    t.flush()
+    assert len(t.dicts["body"]) > 8192
+    jan = Janitor(db)
+    jan.sweep(now_s=2_000_000_000)               # old rows past TTL
+    assert len(t) == 1
+    assert len(t.dicts["body"]) == 2             # "" + "fresh"
+    # remap kept the surviving row decodable
+    ch = t.snapshot()[0]
+    assert t.dicts["body"].decode(int(ch["body"][0])) == "fresh"
+
+
+def test_log_sql_and_ttl(server):
+    _post(server.query_port, "/api/v1/log",
+          {"service": "s1", "message": "m1", "level": "info"})
+    out = _post(server.query_port, "/v1/query/", {
+        "sql": "SELECT app_service, severity_number, body FROM "
+               "application_log.log"})
+    rows = out["result"]
+    assert rows["values"][0][rows["columns"].index("body")] == "m1"
+    from deepflow_tpu.server.janitor import DEFAULT_TTL_S
+    assert "application_log.log" in DEFAULT_TTL_S
+
+
+def test_log_search_newest_first_and_limit(server):
+    for i in range(5):
+        _post(server.query_port, "/api/v1/log",
+              {"service": "s", "message": f"line-{i}",
+               "timestamp_ns": 1000 + i})
+    res = _post(server.query_port, "/v1/log/search",
+                {"limit": 2})["result"]
+    assert [r["body"] for r in res["logs"]] == ["line-4", "line-3"]

@@ -188,7 +188,7 @@ def test_integration_proxy_forwards(server):
             f"http://127.0.0.1:{proxy.port}/api/v1/log", data=body)
         out = json.loads(urllib.request.urlopen(req, timeout=5).read())
         assert out["accepted"] == 1
-        assert server.wait_for_rows("event.event", 1)
+        assert server.wait_for_rows("application_log.log", 1)
         # unknown paths rejected locally, not forwarded
         req = urllib.request.Request(
             f"http://127.0.0.1:{proxy.port}/evil", data=b"x")

@@ -220,7 +220,7 @@ def test_http_ingest_feeds_exporters():
         _post(server.query_port, "/v1/exporters", {
             "type": "json-lines",
             "endpoint": f"http://127.0.0.1:{sink.port}/x",
-            "tables": ["event.event"]})
+            "tables": ["application_log.log"]})
         _post(server.query_port, "/api/v1/log",
               {"service": "s", "message": "from-http"})
         deadline = time.monotonic() + 10
