@@ -246,30 +246,123 @@ def _bench_extprofiler() -> dict:
         child.kill()
 
 
-def _device_init_ok(timeout_s: float = 120.0) -> bool:
+def _probe_device(timeout_s: float, probe_log: list) -> bool:
     """Probe backend init in a SUBPROCESS with a deadline. The axon TPU
     relay can wedge (observed: jax.devices() blocked 20+ min at 0% CPU);
-    a dead tunnel must degrade the bench to CPU, not hang the round."""
+    a dead tunnel must degrade the bench, not hang the round. Each
+    attempt's outcome (incl. the subprocess stderr tail) is recorded in
+    probe_log so a wedged relay is diagnosable from the bench artifact.
+    Output goes through temp FILES: on POSIX, TimeoutExpired from
+    subprocess.run carries no captured output, which would lose the
+    stderr tail in exactly the wedged case this exists to diagnose."""
     import subprocess
+    import tempfile
 
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.devices()[0].device_kind)"],
-            capture_output=True, timeout=timeout_s)
-    except (subprocess.TimeoutExpired, OSError):
-        return False
-    return out.returncode == 0 and bool(out.stdout.strip())
+    t0 = time.perf_counter()
+    with tempfile.TemporaryFile() as fout, tempfile.TemporaryFile() as ferr:
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, "-c",
+                 "import jax; print(jax.devices()[0].device_kind)"],
+                stdout=fout, stderr=ferr)
+        except OSError as e:
+            probe_log.append({"outcome": f"spawn failed: {e}"})
+            return False
+        try:
+            rc = proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+            rc = None
+
+        def tail(f) -> str:
+            f.seek(0)
+            return f.read()[-500:].decode("utf-8", "replace")
+
+        stdout, stderr = tail(fout), tail(ferr)
+    kind = stdout.strip()
+    # a fast CPU FALLBACK inside the probe is a failure: the whole point
+    # is a TPU headline, and returning ok here would skip the retries
+    ok = rc == 0 and "TPU" in kind
+    probe_log.append({
+        "outcome": (kind if ok else
+                    f"timeout after {timeout_s:.0f}s" if rc is None else
+                    f"exit {rc}, stdout {kind!r} (no TPU)"),
+        "elapsed_s": round(time.perf_counter() - t0, 1),
+        "stderr": stderr,
+    })
+    return ok
+
+
+def _acquire_device(probe_log: list) -> bool:
+    """Retry across the round with backoff (VERDICT r03 item 1: one
+    120 s up-front probe left the bench on CPU fallback two rounds in a
+    row). Worst case ~13 min before giving up."""
+    for attempt, (timeout_s, sleep_s) in enumerate(
+            [(180, 20), (240, 60), (300, 0)]):
+        if _probe_device(timeout_s, probe_log):
+            return True
+        print(f"bench: device probe attempt {attempt + 1} failed: "
+              f"{probe_log[-1]['outcome']}", file=sys.stderr)
+        if sleep_s:
+            time.sleep(sleep_s)
+    return False
 
 
 def main() -> None:
+    probe_log: list[dict] = []
+    # CPU-side phases FIRST: they need no device, and running them up
+    # front gives a wedged TPU relay extra minutes to come back before
+    # the retry loop concludes.
+    cpu_detail = {}
+    cpu_detail.update(_bench_packet_path())
+    cpu_detail.update(_bench_ingest())
+    cpu_detail.update(_bench_extprofiler())
+    # perf guard (VERDICT r03 item 5): a regression must be visible
+    # in-round, not discovered by the next judge
+    cpu_detail["ingest_below_target"] = \
+        cpu_detail.get("ingest_rows_per_sec", 0) < 190_000
+
+    have_device = _acquire_device(probe_log)
+
     import jax
 
-    if not _device_init_ok():
-        print("bench: device backend init timed out; falling back to CPU",
+    if not have_device:
+        print("bench: device backend unavailable after retries; "
+              "running on CPU — headline will be DEGRADED (null)",
               file=sys.stderr)
         jax.config.update("jax_platforms", "cpu")
-    dev = jax.devices()[0]
+        dev = jax.devices()[0]
+    else:
+        # the probe is a separate connection: the relay can still wedge
+        # between probe and use (TOCTOU). Init in a thread with a
+        # deadline; if it trips, emit the degraded artifact rather than
+        # hanging the round (we cannot safely re-init as CPU while a
+        # thread is blocked inside backend init).
+        import threading
+        box: dict = {}
+
+        def _init():
+            try:
+                box["devices"] = jax.devices()
+            except Exception as e:  # noqa: BLE001 — record, don't hang
+                box["error"] = repr(e)
+        t = threading.Thread(target=_init, daemon=True)
+        t.start()
+        t.join(timeout=300)
+        if "devices" not in box:
+            probe_log.append({"outcome": "in-process backend init wedged "
+                              "after successful probe: "
+                              + box.get("error", "300s deadline")})
+            print(json.dumps({
+                "metric": "agent_overhead_pct", "value": None,
+                "unit": "%", "vs_baseline": None, "degraded": True,
+                "detail": {"device": "none", "probe_log": probe_log,
+                           **cpu_detail},
+            }))
+            import os
+            os._exit(0)  # the blocked init thread won't join; hard-exit
+        dev = box["devices"][0]
     chain, params, opt_state, tokens, k_steps = _build(dev.device_kind)
 
     params, opt_state, _ = _time_chains(chain, params, opt_state, tokens, 2)
@@ -329,13 +422,20 @@ def main() -> None:
     raw_pct = (prof_step - base_step) / base_step * 100.0
     overhead_pct = max(0.0, raw_pct)
 
+    # The headline claims "<1% agent overhead ON TPU" (BASELINE.md). A CPU
+    # fallback can't evidence that: refuse a passing-looking number
+    # (VERDICT r03 item 1 — two rounds of silent 0.0 on CPU).
+    degraded = dev.platform == "cpu"
     result = {
         "metric": "agent_overhead_pct",
-        "value": round(overhead_pct, 3),
+        "value": None if degraded else round(overhead_pct, 3),
         "unit": "%",
-        "vs_baseline": round(overhead_pct / 1.0, 3),
+        "vs_baseline": None if degraded else round(overhead_pct / 1.0, 3),
+        "degraded": degraded,
         "detail": {
             "device": dev.device_kind,
+            "device_platform": dev.platform,
+            "probe_log": probe_log,
             "rtt_ms": round(rtt * 1000, 1),
             "baseline_step_ms": round(base_step * 1000, 3),
             "profiled_step_ms": round(prof_step * 1000, 3),
@@ -361,9 +461,7 @@ def main() -> None:
             "xplane_overhead_pct": (
                 round(max(0.0, (covered_step - base_step) / base_step
                           * 100.0), 3) if cov_times else 0.0),
-            **_bench_packet_path(),
-            **_bench_ingest(),
-            **_bench_extprofiler(),
+            **cpu_detail,
         },
     }
     print(json.dumps(result))
