@@ -388,6 +388,7 @@ class OffCpuProfiler:
 
 
 _TABLE_CACHE: dict = {}  # path -> UnwindTable | None (immutable, shared)
+_TABLE_MISS = object()   # sentinel: "not cached" (None means "no table")
 _TABLE_LOCK = threading.Lock()
 
 
@@ -529,15 +530,45 @@ class ExternalProfiler:
         return self
 
     def _request_tables(self) -> None:
-        """Queue every executable file-backed mapping for table build."""
+        """Register/queue every executable file-backed mapping. Paths whose
+        table already sits in the process-wide memory cache register
+        IMMEDIATELY (this runs on the thread that owns the native handle),
+        so a maps-change rebuild costs a few add_table copies, not a trip
+        through the builder — attach-time dlopen churn was re-parsing the
+        whole map set per change and burning ~half a core for seconds
+        (BENCH_r03's extprof_observer_pct: 50)."""
         for m in self._sym.maps:
             key = (m.path, m.start)
             if key in self._requested or not m.path.startswith("/"):
                 continue
             self._requested.add(key)
+            with _TABLE_LOCK:
+                cached = _TABLE_CACHE.get(m.path, _TABLE_MISS)
+            if cached is not _TABLE_MISS:
+                if cached is not None and len(cached):
+                    self._register_table(m, cached)
+                continue
             with self._pending_lock:
                 self._pending += 1
             self._build_q.put((self._gen, m))
+
+    def _bias_for(self, m: _Map) -> int:
+        try:
+            e = self._sym._elf(m.path)
+            return e.bias_for(m) if e.et_dyn else 0
+        except Exception:
+            return 0
+
+    def _add_table(self, start: int, end: int, bias: int, table) -> None:
+        """Single registration point (must run on the thread owning the
+        native handle — see df_prof_add_table's thread contract)."""
+        self._lib.df_prof_add_table(
+            self._h, start, end, bias, table.pc, table.cfa_reg,
+            table.cfa_off, table.rbp_off, table.ra_off, len(table))
+        self.unwind_tables += 1
+
+    def _register_table(self, m: _Map, table) -> None:
+        self._add_table(m.start, m.end, self._bias_for(m), table)
 
     def _done_one(self) -> None:
         with self._pending_lock:
@@ -552,6 +583,11 @@ class ExternalProfiler:
                 gen, m = self._build_q.get(timeout=0.5)
             except queue.Empty:
                 continue
+            if gen != self._gen:
+                # stale generation: a maps change already re-requested this
+                # work; parsing it anyway doubles the churn cost
+                self._done_one()
+                continue
             try:
                 table = _unwind_table_cached(
                     m.path, should_stop=self._stop.is_set)
@@ -565,12 +601,8 @@ class ExternalProfiler:
             if table is None or not len(table):
                 self._done_one()
                 continue
-            try:
-                e = self._sym._elf(m.path)
-                bias = e.bias_for(m) if e.et_dyn else 0
-            except Exception:
-                bias = 0
-            self._ready_q.put((gen, m.start, m.end, bias, table))
+            self._ready_q.put((gen, m.start, m.end, self._bias_for(m),
+                               table))
 
     def builder_busy(self) -> bool:
         """True while unwind tables are still being parsed/registered
@@ -593,10 +625,7 @@ class ExternalProfiler:
             self._done_one()
             if gen != self._gen:
                 continue
-            self._lib.df_prof_add_table(
-                self._h, start, end, bias, table.pc, table.cfa_reg,
-                table.cfa_off, table.rbp_off, table.ra_off, len(table))
-            self.unwind_tables += 1
+            self._add_table(start, end, bias, table)
 
     def stop(self) -> None:
         self._stop.set()

