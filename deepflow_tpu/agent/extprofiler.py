@@ -427,7 +427,7 @@ class ExternalProfiler:
     def __init__(self, sink, pid: int, hz: float = 99.0,
                  window_s: float = 1.0, process_name: str = "",
                  app_service: str = "", dwarf: bool = True,
-                 stack_dump: int = 8192) -> None:
+                 stack_dump: int = 8192, python_stacks: bool = True) -> None:
         lib = native.load()
         if lib is None:
             raise RuntimeError("libdfnative.so unavailable")
@@ -447,6 +447,14 @@ class ExternalProfiler:
         self.dwarf_samples = 0
         self.fp_samples = 0
         self.unwind_tables = 0
+        # remote interpreter stacks (py-spy style, pystacks.py): spliced
+        # over the _PyEval_EvalFrameDefault runs so a JAX host's profile
+        # shows Python function names, not interpreter-loop soup
+        self._py_enabled = python_stacks
+        self._py: "object | None" = None       # RemotePython once attached
+        self._py_attempts = 0
+        self.py_threads = 0
+        self.py_spliced = 0
         self._h = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -666,6 +674,61 @@ class ExternalProfiler:
                 except Exception:
                     log.exception("extprofiler emit failed")
 
+    def _sample_python_stacks(self) -> dict:
+        """One interpreter-state read per window (py-spy cadence). The
+        target must share this build's CPython (pystacks validates); a
+        non-Python target disables itself after a few attach attempts."""
+        if not self._py_enabled:
+            return {}
+        if self._py is None:
+            self._py_attempts += 1
+            try:
+                from deepflow_tpu.agent.pystacks import RemotePython
+                self._py = RemotePython(self.pid)
+            except Exception as e:
+                # early startup can race the maps scan: retry a few
+                # windows before concluding the target isn't Python
+                if self._py_attempts >= 5:
+                    self._py_enabled = False
+                    log.info("remote python stacks unavailable for pid "
+                             "%d: %s", self.pid, e)
+                return {}
+        try:
+            stacks = self._py.sample()
+            self.py_threads = len(stacks)
+            return stacks
+        except Exception:
+            log.exception("python stack sample failed")
+            return {}
+
+    @staticmethod
+    def _is_python_image(frame: str) -> bool:
+        mod = frame.split("`", 1)[0].split("+", 1)[0]
+        return mod.startswith("libpython") or mod.startswith("python")
+
+    def _splice_python(self, frames: list[str],
+                       py: list[str] | None) -> list[str]:
+        """Replace the first contiguous run of python-image frames (the
+        interpreter: Py_RunMain .. _PyEval_EvalFrameDefault and its
+        stripped .cold chunks, which symbolize as libpython+0x…) with the
+        thread's sampled Python frames, root-first. The native prefix
+        (ld/libc startup) and any non-libpython suffix (a C-extension
+        leaf) survive. Window-close sampling means the Python stack is an
+        approximation of each individual sample's — the standard async
+        mixed-mode tradeoff."""
+        if not py:
+            return frames
+        first = next((i for i, f in enumerate(frames)
+                      if self._is_python_image(f)), -1)
+        if first < 0:
+            return frames
+        last = first
+        while last + 1 < len(frames) and \
+                self._is_python_image(frames[last + 1]):
+            last += 1
+        self.py_spliced += 1
+        return frames[:first] + py + frames[last + 1:]
+
     def _emit(self) -> None:
         if not self._h:
             return
@@ -694,6 +757,7 @@ class ExternalProfiler:
                 self._drain_ready_tables()
             except Exception:
                 log.exception("unwind table registration failed")
+        py_stacks = self._sample_python_stacks()
         ts = time.time_ns()
         period_us = int(1e6 / self.hz)
         batch = []
@@ -704,6 +768,10 @@ class ExternalProfiler:
             off += ln
             # chains arrive leaf-first; folded stacks are root-first
             frames = [self._sym.resolve(int(a)) for a in chain[::-1]]
+            if py_stacks:
+                frames = self._splice_python(frames,
+                                             py_stacks.get(
+                                                 int(self._tids[i])))
             count = int(self._counts[i])
             batch.append(ProfileSample(
                 timestamp_ns=ts, pid=self.pid, tid=int(self._tids[i]),
