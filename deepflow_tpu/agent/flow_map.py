@@ -85,6 +85,7 @@ class FlowNode:
     art_count: int = 0
     l7_protocol: int = pb.L7_UNKNOWN
     l7_inferred: bool = False
+    l7_infer_attempts: int = 0
     l7_request: int = 0
     l7_response: int = 0
     pending: deque = field(default_factory=deque)   # PendingRequest FIFO
@@ -127,10 +128,21 @@ class FlowMap:
         FlowState.FIN_1: 30_000_000_000,
     }
     MAX_PENDING = 128
+    # L7 inference budget (reference: per-endpoint inference verdict table
+    # with inference_max_retries, server/agent_config/template.yaml:4276 —
+    # redesigned as a per-flow attempt budget plus a negative per-endpoint
+    # cache so fleets of unparseable flows to one service stop paying the
+    # full parser sweep)
+    INFER_MAX_ATTEMPTS = 5
+    INFER_ENDPOINT_FAILS = 16     # flow give-ups before the endpoint caches
+    INFER_RETRY_EVERY = 64        # periodic re-probe of a cached endpoint
+    INFER_CACHE_CAP = 65536
 
     def __init__(self, on_l4_log=None, on_l7_log=None, on_flow_update=None,
                  agent_id: int = 0, max_flows: int = 1 << 16) -> None:
         self.flows: dict[tuple, FlowNode] = {}
+        # (ip_dst, port_dst, protocol) -> consecutive inference failures
+        self._infer_fails: dict[tuple, int] = {}
         self.on_l4_log = on_l4_log or (lambda f: None)
         self.on_l7_log = on_l7_log or (lambda r: None)
         self.on_flow_update = on_flow_update or (lambda f, closed: None)
@@ -293,12 +305,30 @@ class FlowMap:
     def _l7_update(self, node: FlowNode, p: MetaPacket, is_tx: bool) -> None:
         records: list[L7ParseResult] = []
         if not node.l7_inferred:
+            ep = (node.ip_dst, node.port_dst, node.protocol)
+            fails = self._infer_fails.get(ep, 0)
+            if fails >= self.INFER_ENDPOINT_FAILS:
+                # endpoint is known-unparseable: skip the parser sweep,
+                # but re-probe periodically so a service that changes
+                # protocol on the same port is eventually re-detected
+                self._infer_fails[ep] = fails + 1
+                if (fails - self.INFER_ENDPOINT_FAILS) \
+                        % self.INFER_RETRY_EVERY:
+                    node.l7_inferred = True  # give up (stays unknown)
+                    return
             proto, records = infer_and_parse(p.payload, node.port_dst)
+            node.l7_infer_attempts += 1
             if proto != pb.L7_UNKNOWN:
                 node.l7_protocol = proto
                 node.l7_inferred = True
-            elif node.tx.packets + node.rx.packets > 10:
+                self._infer_fails.pop(ep, None)
+            elif node.l7_infer_attempts >= self.INFER_MAX_ATTEMPTS or \
+                    node.tx.packets + node.rx.packets > 10:
                 node.l7_inferred = True  # give up (stays unknown)
+                if len(self._infer_fails) >= self.INFER_CACHE_CAP:
+                    self._infer_fails.clear()
+                self._infer_fails[ep] = \
+                    self._infer_fails.get(ep, 0) + 1
             if not records:
                 return
         else:

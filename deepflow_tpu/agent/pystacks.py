@@ -79,7 +79,8 @@ class PyOffsets:
     ts_frame: int = -1          # PyThreadState -> (cframe | current_frame)
     ts_frame_indirect: bool = True   # True: deref once (3.11/3.12 cframe)
     ts_interp: int = -1
-    ts_next: int = -1
+    ts_next: int = -1           # toward OLDER threads (main is the tail)
+    ts_prev: int = -1           # toward NEWER threads (head end)
     ts_native_tid: int = -1
     code_qualname: int = -1
     code_filename: int = -1
@@ -91,7 +92,8 @@ class PyOffsets:
     def complete(self) -> bool:
         return (self.frame_code >= 0 and self.frame_prev >= 0
                 and self.ts_frame >= 0 and self.ts_interp >= 0
-                and self.ts_next >= 0 and self.ts_native_tid >= 0
+                and self.ts_next >= 0 and self.ts_prev >= 0
+                and self.ts_native_tid >= 0
                 and self.code_qualname >= 0 and self.code_filename >= 0
                 and bool(self.runtime_interp_offs)
                 and bool(self.interp_head_offs))
@@ -230,15 +232,43 @@ def _calibrate() -> PyOffsets:
                 cur = nxt
             return seen
 
-        # next offset: following it from SOME known tstate must reach
-        # other known tstates (the list is newest-first; try all starts)
+        # next/prev disambiguation (they are adjacent pointer fields and
+        # "a walk reaches other known tstates" is true of BOTH): anchor
+        # on the real list HEAD from the C API — only the true `next`
+        # offset walks from the head through every live tstate (the
+        # head's `prev` is NULL), and only the true `prev` walks back
+        # from the next-chain's tail through everything.
+        ctypes.pythonapi.PyInterpreterState_ThreadHead.restype = \
+            ctypes.c_void_p
+        ctypes.pythonapi.PyInterpreterState_ThreadHead.argtypes = \
+            [ctypes.c_void_p]
+        list_head = ctypes.pythonapi.PyInterpreterState_ThreadHead(interp)
         for cand in range(0, 256, 8):
-            if any(len(walk(start, cand) & all_ts) >= 2
-                   for start in all_ts):
+            if all_ts <= walk(list_head, cand):
                 off.ts_next = cand
                 break
         if off.ts_next < 0:
             raise _CalibrationError("no tstate next-link found")
+
+        def ordered_walk(start: int, next_off: int) -> list[int]:
+            out: list[int] = []
+            cur = start
+            while _PTR_MIN < cur < _PTR_MAX and len(out) < 256 \
+                    and cur not in out:
+                out.append(cur)
+                nxt = rd.u64(cur + next_off)
+                if nxt is None:
+                    break
+                cur = nxt
+            return out
+
+        tail = ordered_walk(list_head, off.ts_next)[-1]
+        for cand in range(0, 256, 8):
+            if cand != off.ts_next and all_ts <= walk(tail, cand):
+                off.ts_prev = cand
+                break
+        if off.ts_prev < 0:
+            raise _CalibrationError("no tstate prev-link found")
 
         # interp->threads.head: a slot whose walk visits ALL known tstates
         ib = rd.read(interp, 4096)
@@ -351,11 +381,56 @@ def _elf_object_symbol(path: str, name: bytes) -> int | None:
     return None
 
 
+def _python_image_of(pid: int) -> tuple[str, int] | None:
+    """(path, load bias) of a process's libpython / python binary —
+    the image that defines _PyRuntime."""
+    from deepflow_tpu.agent.extprofiler import ElfSymbols, _Map
+    maps: list[_Map] = []
+    try:
+        with open(f"/proc/{pid}/maps") as f:
+            for line in f:
+                parts = line.split()
+                if len(parts) < 6 or not parts[5].startswith("/"):
+                    continue
+                a, b = parts[0].split("-")
+                maps.append(_Map(start=int(a, 16), end=int(b, 16),
+                                 offset=int(parts[2], 16),
+                                 path=parts[5]))
+    except OSError:
+        return None
+    for m in maps:
+        base = os.path.basename(m.path)
+        if "libpython" in base or base.startswith("python"):
+            if _elf_object_symbol(m.path, b"_PyRuntime") is None:
+                continue
+            # load bias is uniform across an object's segments: compute
+            # it from any mapping of the file (ELF phdr walk)
+            e = ElfSymbols(m.path)
+            first = min((x for x in maps if x.path == m.path),
+                        key=lambda x: x.start)
+            bias = e.bias_for(first) if e.et_dyn else 0
+            return m.path, bias
+    return None
+
+
+def _image_identity(path: str) -> tuple | None:
+    """(st_dev, st_ino) of the image file — build identity that survives
+    different mount paths of the same file and distinguishes rebuilt or
+    different-version interpreters on the same path name."""
+    try:
+        st = os.stat(path)
+        return (st.st_dev, st.st_ino)
+    except OSError:
+        return None
+
+
 class RemotePython:
     """Reader of one target process's Python thread stacks.
 
-    Requires the target to run the SAME CPython build as this process
-    (checked by libpython path identity); raises RuntimeError otherwise.
+    Requires the target to run the SAME CPython build as this process:
+    the target's _PyRuntime-defining image must be the same file
+    (st_dev, st_ino) as ours; raises RuntimeError otherwise — calibrated
+    offsets from one build must never be applied to another.
     """
 
     MAX_THREADS = 256
@@ -373,41 +448,20 @@ class RemotePython:
         self.stats = {"samples": 0, "threads": 0, "bad_frames": 0}
 
     def _python_image(self) -> tuple[str, int] | None:
-        """(path, load bias) of the target's libpython / python binary —
-        the image that defines _PyRuntime."""
-        from deepflow_tpu.agent.extprofiler import ElfSymbols, _Map
-        maps: list[_Map] = []
-        try:
-            with open(f"/proc/{self.pid}/maps") as f:
-                for line in f:
-                    parts = line.split()
-                    if len(parts) < 6 or not parts[5].startswith("/"):
-                        continue
-                    a, b = parts[0].split("-")
-                    maps.append(_Map(start=int(a, 16), end=int(b, 16),
-                                     offset=int(parts[2], 16),
-                                     path=parts[5]))
-        except OSError:
-            return None
-        for m in maps:
-            base = os.path.basename(m.path)
-            if "libpython" in base or base.startswith("python"):
-                if _elf_object_symbol(m.path, b"_PyRuntime") is None:
-                    continue
-                # load bias is uniform across an object's segments: compute
-                # it from any mapping of the file (ELF phdr walk)
-                e = ElfSymbols(m.path)
-                first = min((x for x in maps if x.path == m.path),
-                            key=lambda x: x.start)
-                bias = e.bias_for(first) if e.et_dyn else 0
-                return m.path, bias
-        return None
+        return _python_image_of(self.pid)
 
     def _find_runtime(self) -> int:
         img = self._python_image()
         if img is None:
             raise RuntimeError("target has no python image with _PyRuntime")
         path, bias = img
+        ours = _python_image_of(os.getpid())
+        if ours is None:
+            raise RuntimeError("cannot locate our own python image")
+        if _image_identity(path) != _image_identity(ours[0]):
+            raise RuntimeError(
+                f"target python build {path} differs from ours {ours[0]}; "
+                "calibrated offsets do not transfer")
         vaddr = _elf_object_symbol(path, b"_PyRuntime")
         our = offsets()
         assert our is not None and vaddr is not None
@@ -479,35 +533,46 @@ class RemotePython:
         (no stop-the-world): a torn frame chain yields a truncated stack
         for that one thread, never an error."""
         off = self.off
-        interp = None
+        interp = head_off = None
         for o in off.runtime_interp_offs:
             cand = self.rd.u64(self.runtime_addr + o)
             if cand is None:
                 continue
             # validate: candidate's threads.head walks to tstates whose
-            # interp field points back at the candidate
+            # interp field points back at the candidate; the thread walk
+            # below must then use the SAME head offset that validated
             for ho in off.interp_head_offs:
                 head = self.rd.u64(cand + ho)
                 if head and self.rd.u64(head + off.ts_interp) == cand:
-                    interp = cand
+                    interp, head_off = cand, ho
                     break
             if interp is not None:
                 break
         if interp is None:
             return {}
         result: dict[int, list[str]] = {}
-        seen = set()
-        ts = self.rd.u64(interp + off.interp_head_offs[0])
-        while ts and _PTR_MIN < ts < _PTR_MAX and ts not in seen and \
-                len(seen) < self.MAX_THREADS:
-            seen.add(ts)
+        seen: set[int] = set()
+
+        def visit(ts: int) -> None:
             tid = self.rd.u64(ts + off.ts_native_tid)
             if tid and tid < 1 << 22:   # plausible Linux tid
                 stack = self._thread_stack(ts)
                 if stack:
                     result[int(tid)] = stack
-            nxt = self.rd.u64(ts + off.ts_next)
-            ts = nxt if nxt else 0
+
+        # walk both directions from the head snapshot: `next` covers the
+        # whole list from the true head; `prev` additionally catches
+        # threads inserted at the head between our head read and now
+        head = self.rd.u64(interp + head_off)
+        starts = (head,
+                  self.rd.u64(head + off.ts_prev) if head else None)
+        for link, ts in zip((off.ts_next, off.ts_prev), starts):
+            while ts and _PTR_MIN < ts < _PTR_MAX and ts not in seen and \
+                    len(seen) < self.MAX_THREADS:
+                seen.add(ts)
+                visit(ts)
+                nxt = self.rd.u64(ts + link)
+                ts = nxt if nxt else 0
         self.stats["samples"] += 1
         self.stats["threads"] = len(result)
         return result

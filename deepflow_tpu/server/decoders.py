@@ -13,6 +13,8 @@ import os
 import queue
 import threading
 
+import numpy as np
+
 from deepflow_tpu.codec import FrameHeader, MessageType
 from deepflow_tpu.proto import pb
 from deepflow_tpu.store.db import Database
@@ -112,7 +114,7 @@ class Decoder:
         if (self.exporters is not None and n
                 and self.exporters.wants(table_name)):
             names = list(cols)
-            expanded = [v if isinstance(v, list) else [v] * n
+            expanded = [v if isinstance(v, (list, np.ndarray)) else [v] * n
                         for v in cols.values()]
             self.exporters.feed(
                 table_name,

@@ -276,7 +276,7 @@ class IntegrationAPI:
             sev_num = _int0(e.get("severity_number", 0)) or \
                 self._SEVERITY_NUM.get(sev_text.lower(), 0)
             rows.append({
-                "time": int(e.get("timestamp_ns", time.time_ns())),
+                "time": _int0(e.get("timestamp_ns") or 0) or time.time_ns(),
                 "app_service": str(e.get("service", "")),
                 "app_instance": str(e.get("instance", "")),
                 "log_source": 1,  # app

@@ -57,11 +57,16 @@ class Dictionary:
         return out
 
     def decode(self, sid: int) -> str:
-        return self._strings[sid]
+        # A reader holding a pre-compaction snapshot may carry ids from the
+        # old (larger) dictionary; render those as "" instead of raising out
+        # of a query path (store/table.py compact_dictionaries swap window).
+        strings = self._strings
+        return strings[sid] if 0 <= sid < len(strings) else ""
 
     def decode_many(self, ids: np.ndarray) -> list[str]:
         strings = self._strings
-        return [strings[i] for i in ids.tolist()]
+        n = len(strings)
+        return [strings[i] if 0 <= i < n else "" for i in ids.tolist()]
 
     def lookup(self, s: str) -> int | None:
         """Return id without inserting (query-side)."""
