@@ -37,6 +37,7 @@ class Agent:
         self.extprofilers: list = []
         self.tpuprobe = None
         self.synchronizer = None
+        self.socket_scanner = None
         self.guard = None
         self.integration_proxy = None
         self.dispatcher = None
@@ -231,6 +232,12 @@ class Agent:
                 self, self.config.controller,
                 interval_s=self.config.sync_interval_s).start()
             self._components.append("synchronizer")
+            if getattr(self.config, "socket_scan_interval_s", 0) > 0:
+                from deepflow_tpu.agent.socket_scan import SocketScanner
+                self.socket_scanner = SocketScanner(
+                    self.synchronizer, agent_id=self.config.agent_id,
+                    interval_s=self.config.socket_scan_interval_s).start()
+                self._components.append("socket-scan")
         self._stats_thread = threading.Thread(
             target=self._stats_loop, name="df-agent-stats", daemon=True)
         self._stats_thread.start()
@@ -242,6 +249,8 @@ class Agent:
         self._stop.set()
         if self.guard:
             self.guard.stop()
+        if getattr(self, "socket_scanner", None):
+            self.socket_scanner.stop()
         if self.synchronizer:
             self.synchronizer.stop()
         if self.sampler:

@@ -92,6 +92,10 @@ class AgentConfig:
     group: str = "default"        # agent-group for config routing
     controller: str = ""          # host:port; empty = standalone mode
     standalone: bool = True
+    # /proc socket-inode scan feeding GpidSync: flow logs get process
+    # identity (gpid + comm) for ANY local process, no preload required.
+    # 0 disables. Needs a controller (entries ride the sync plane).
+    socket_scan_interval_s: float = 30.0
     profiler: ProfilerConfig = field(default_factory=ProfilerConfig)
     tpuprobe: TpuProbeConfig = field(default_factory=TpuProbeConfig)
     guard: GuardConfig = field(default_factory=GuardConfig)

@@ -381,11 +381,18 @@ def _elf_object_symbol(path: str, name: bytes) -> int | None:
     return None
 
 
-def _python_image_of(pid: int) -> tuple[str, int] | None:
-    """(path, load bias) of a process's libpython / python binary —
-    the image that defines _PyRuntime."""
+def _python_image_of(pid: int) -> tuple[str, int, tuple] | None:
+    """(access_path, load bias, identity) of a process's libpython /
+    python binary — the image that defines _PyRuntime.
+
+    identity is the (dev, inode) straight from that process's own maps
+    line, so it is correct across mount namespaces (stat()ing the path
+    string in OUR namespace could hit a different file for a
+    containerized target); access_path goes through /proc/<pid>/root so
+    ELF reads see the target's file, not a same-named host file."""
     from deepflow_tpu.agent.extprofiler import ElfSymbols, _Map
     maps: list[_Map] = []
+    idents: dict[str, tuple] = {}
     try:
         with open(f"/proc/{pid}/maps") as f:
             for line in f:
@@ -396,32 +403,25 @@ def _python_image_of(pid: int) -> tuple[str, int] | None:
                 maps.append(_Map(start=int(a, 16), end=int(b, 16),
                                  offset=int(parts[2], 16),
                                  path=parts[5]))
+                idents.setdefault(parts[5], (parts[3], int(parts[4])))
     except OSError:
         return None
     for m in maps:
         base = os.path.basename(m.path)
         if "libpython" in base or base.startswith("python"):
-            if _elf_object_symbol(m.path, b"_PyRuntime") is None:
+            access = f"/proc/{pid}/root{m.path}"
+            if not os.path.exists(access):
+                access = m.path
+            if _elf_object_symbol(access, b"_PyRuntime") is None:
                 continue
             # load bias is uniform across an object's segments: compute
             # it from any mapping of the file (ELF phdr walk)
-            e = ElfSymbols(m.path)
+            e = ElfSymbols(access)
             first = min((x for x in maps if x.path == m.path),
                         key=lambda x: x.start)
             bias = e.bias_for(first) if e.et_dyn else 0
-            return m.path, bias
+            return access, bias, idents.get(m.path, ())
     return None
-
-
-def _image_identity(path: str) -> tuple | None:
-    """(st_dev, st_ino) of the image file — build identity that survives
-    different mount paths of the same file and distinguishes rebuilt or
-    different-version interpreters on the same path name."""
-    try:
-        st = os.stat(path)
-        return (st.st_dev, st.st_ino)
-    except OSError:
-        return None
 
 
 class RemotePython:
@@ -447,21 +447,21 @@ class RemotePython:
         self.runtime_addr = self._find_runtime()
         self.stats = {"samples": 0, "threads": 0, "bad_frames": 0}
 
-    def _python_image(self) -> tuple[str, int] | None:
+    def _python_image(self) -> tuple[str, int, tuple] | None:
         return _python_image_of(self.pid)
 
     def _find_runtime(self) -> int:
         img = self._python_image()
         if img is None:
             raise RuntimeError("target has no python image with _PyRuntime")
-        path, bias = img
+        path, bias, ident = img
         ours = _python_image_of(os.getpid())
         if ours is None:
             raise RuntimeError("cannot locate our own python image")
-        if _image_identity(path) != _image_identity(ours[0]):
+        if not ident or ident != ours[2]:
             raise RuntimeError(
-                f"target python build {path} differs from ours {ours[0]}; "
-                "calibrated offsets do not transfer")
+                f"target python build {path} ({ident}) differs from ours "
+                f"{ours[0]} ({ours[2]}); calibrated offsets do not transfer")
         vaddr = _elf_object_symbol(path, b"_PyRuntime")
         our = offsets()
         assert our is not None and vaddr is not None

@@ -97,12 +97,20 @@ class AgentRegistry:
 class GpidAllocator:
     """Global process IDs: (agent_id, pid) -> gpid, plus the 5-tuple table
     that lets the ingester join client/server sides of one connection
-    (reference §2.8 GPID glue)."""
+    (reference §2.8 GPID glue).
+
+    Lifecycle: each agent's sync is a full snapshot — entries that agent
+    reported before and no longer does are dropped (a dead process's
+    ephemeral port must not attribute a later process's flows), and a TTL
+    sweep retires entries from agents that stopped syncing entirely."""
+
+    ENTRY_TTL_S = 600.0
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._gpids: dict[tuple, int] = {}
-        self._entries: dict[tuple, pb.GpidEntry] = {}
+        # key (ip, port, proto, role) -> (entry, monotonic ts)
+        self._entries: dict[tuple, tuple[pb.GpidEntry, float]] = {}
         self._next = 1
 
     def gpid_for(self, agent_id: int, pid: int) -> int:
@@ -116,14 +124,29 @@ class GpidAllocator:
             return g
 
     def sync(self, req: pb.GpidSyncRequest) -> pb.GpidSyncResponse:
+        now = time.monotonic()
         with self._lock:
+            fresh: set[tuple] = set()
             for e in req.entries:
+                e.agent_id = req.agent_id  # never trust the entry field
                 e.gpid = self._gpids.get((req.agent_id, e.pid), 0) or \
                     self._alloc_locked(req.agent_id, e.pid)
-                self._entries[(bytes(e.ip), e.port, int(e.proto),
-                               e.role)] = e
+                key = (bytes(e.ip), e.port, int(e.proto), e.role)
+                self._entries[key] = (e, now)
+                fresh.add(key)
+            # snapshot semantics: this agent's stale entries go away now
+            self._entries = {
+                k: (e, ts) for k, (e, ts) in self._entries.items()
+                if k in fresh or e.agent_id != req.agent_id}
+            # TTL sweep: agents that stopped syncing (crash, drain)
+            cutoff = now - self.ENTRY_TTL_S
+            self._entries = {k: v for k, v in self._entries.items()
+                             if v[1] >= cutoff}
+            # echo only the REQUESTER's entries (gpids now filled) — the
+            # ingest-side join lives here, and echoing the whole fleet's
+            # socket table back on every scan would be O(fleet) waste
             resp = pb.GpidSyncResponse()
-            resp.entries.extend(self._entries.values())
+            resp.entries.extend(req.entries)
             return resp
 
     def _alloc_locked(self, agent_id: int, pid: int) -> int:
@@ -136,13 +159,29 @@ class GpidAllocator:
         """Ingest-side join (reference grpc_platformdata.go:2047): map a
         flow endpoint to its global process id; tries server role (exact
         listen tuple) then client role."""
+        e = self._entry_for(ip, port, proto)
+        return e.gpid if e is not None else 0
+
+    def name_lookup(self, ip: bytes, port: int, proto: int
+                    ) -> tuple[int, str]:
+        """(gpid, process_name) for a flow endpoint — lets flow logs show
+        identity for processes that never loaded the preload interposer
+        (socket-inode scan supplies the entries)."""
+        e = self._entry_for(ip, port, proto)
+        return (e.gpid, e.process_name) if e is not None else (0, "")
+
+    def _entry_for(self, ip: bytes, port: int, proto: int):
+        # exact-match ONLY: wildcard binds are expanded into concrete
+        # local addresses agent-side (socket_scan.scan_entries) — a
+        # server-side any-ip fallback would attribute flows toward
+        # REMOTE endpoints on the same port to a local listener
         entries = self._entries  # GIL-atomic point reads; values are
         # replaced per sync, never mutated after insertion
         for role in (1, 0):
-            e = entries.get((ip, port, proto, role))
-            if e is not None:
-                return e.gpid
-        return 0
+            v = entries.get((ip, port, proto, role))
+            if v is not None:
+                return v[0]
+        return None
 
 
 class ConfigStore:

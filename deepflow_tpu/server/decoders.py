@@ -242,11 +242,6 @@ class FlowLogDecoder(Decoder):
 
     MSG_TYPE = MessageType.L4_LOG
 
-    def _gpid(self, ip: bytes, port: int, proto: int) -> int:
-        if self.gpid_table is None:
-            return 0
-        return self.gpid_table.lookup(bytes(ip), port, proto)
-
     def _endpoint_cols(self, items, keys, src_s, dst_s) -> dict:
         """gprocess/resource columns shared by the l4 and l7 branches:
         agent values win for pod; everything else resolves via the
@@ -257,13 +252,23 @@ class FlowLogDecoder(Decoder):
         if self.gpid_table is None:
             cols["gprocess_id_0"] = [f.gpid_0 for f in items]
             cols["gprocess_id_1"] = [f.gpid_1 for f in items]
+            cols["process_kname_0"] = [""] * len(items)
+            cols["process_kname_1"] = [""] * len(items)
         else:
+            # socket-inode scan entries give every flow endpoint a
+            # gpid AND a process name, preload or not (reference:
+            # linux_socket.rs scan -> grpc_platformdata.go join)
+            nl = self.gpid_table.name_lookup
+            side0 = [nl(bytes(k.ip_src), k.port_src, int(k.proto))
+                     for k in keys]
+            side1 = [nl(bytes(k.ip_dst), k.port_dst, int(k.proto))
+                     for k in keys]
             cols["gprocess_id_0"] = [
-                f.gpid_0 or self._gpid(k.ip_src, k.port_src, int(k.proto))
-                for f, k in zip(items, keys)]
+                f.gpid_0 or g for f, (g, _) in zip(items, side0)]
             cols["gprocess_id_1"] = [
-                f.gpid_1 or self._gpid(k.ip_dst, k.port_dst, int(k.proto))
-                for f, k in zip(items, keys)]
+                f.gpid_1 or g for f, (g, _) in zip(items, side1)]
+            cols["process_kname_0"] = [n for _, n in side0]
+            cols["process_kname_1"] = [n for _, n in side1]
         if self.resources is not None and not self.resources.is_empty():
             res = self.resources.batch_resolver()
             t0 = [res(s) for s in src_s]
@@ -393,8 +398,14 @@ class FlowLogDecoder(Decoder):
                 "captured_response_byte": [
                     f.captured_response_byte for f in l7],
                 **endpoint_cols,
-                "process_kname_0": [f.process_kname_0 for f in l7],
-                "process_kname_1": [f.process_kname_1 for f in l7],
+                # agent-observed kernel thread name wins (sslprobe path);
+                # the socket-scan join fills the rest
+                "process_kname_0": [
+                    f.process_kname_0 or n for f, n in zip(
+                        l7, endpoint_cols["process_kname_0"])],
+                "process_kname_1": [
+                    f.process_kname_1 or n for f, n in zip(
+                        l7, endpoint_cols["process_kname_1"])],
                 "attrs": [f.attrs_json for f in l7],
             }
             cols.update(tags)  # constant per batch: scalar broadcast

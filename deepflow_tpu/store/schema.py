@@ -146,6 +146,8 @@ _table("flow_log.l4_flow_log", [
     C("tunnel_id", "u32"),
     C("gprocess_id_0", "u32"),
     C("gprocess_id_1", "u32"),
+    C("process_kname_0", "str"),    # socket-inode scan: comm at ip:port
+    C("process_kname_1", "str"),
     C("pod_0", "str"),              # K8s genesis: resource at ip_src
     C("pod_1", "str"),              # K8s genesis: resource at ip_dst
     *PER_SIDE_TAGS,

@@ -141,28 +141,44 @@ def test_non_python_target_fails_closed():
         proc.kill()
 
 
-def test_build_identity_guard(tmp_path, monkeypatch):
-    """If the target's python image is a DIFFERENT file than ours, attach
-    must refuse even though the image defines _PyRuntime (ADVICE r04
-    medium: calibrated offsets must not transfer across builds)."""
-    import shutil
+def test_build_identity_guard(monkeypatch):
+    """If the target's python image is a DIFFERENT file than ours (maps
+    dev:inode differ — e.g. a containerized target whose path string
+    matches a host file), attach must refuse even though the image
+    defines _PyRuntime (ADVICE r04 medium: calibrated offsets must not
+    transfer across builds)."""
     from deepflow_tpu.agent import pystacks
     proc = _spawn_child()
     try:
-        ours = pystacks._python_image_of(os.getpid())
-        assert ours, "cannot locate our own python image"
-        copy = tmp_path / os.path.basename(ours[0])
-        shutil.copy(ours[0], copy)  # same bytes, different inode
         real = pystacks._python_image_of
 
         def fake(pid):
-            if pid == os.getpid():
-                return (str(copy), ours[1])
-            return real(pid)
+            img = real(pid)
+            if img and pid == os.getpid():
+                path, bias, (dev, ino) = img
+                return (path, bias, (dev, ino ^ 1))  # different file
+            return img
 
         monkeypatch.setattr(pystacks, "_python_image_of", fake)
         with pytest.raises(RuntimeError, match="differs from ours"):
             pystacks.RemotePython(proc.pid)
+    finally:
+        proc.kill()
+
+
+def test_image_identity_comes_from_target_maps():
+    """The identity compared is the (dev, inode) from the TARGET's own
+    maps — not a stat() of the path string in our namespace."""
+    from deepflow_tpu.agent import pystacks
+    proc = _spawn_child()
+    try:
+        img = pystacks._python_image_of(proc.pid)
+        assert img is not None
+        access, _bias, ident = img
+        assert ident and len(ident) == 2
+        # access path routes through the target's root
+        assert access.startswith(f"/proc/{proc.pid}/root") or \
+            os.path.exists(access)
     finally:
         proc.kill()
 
