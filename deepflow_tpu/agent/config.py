@@ -39,6 +39,9 @@ class TpuProbeConfig:
     # this fraction of ALL steps is captured
     target_coverage: float = 0.5
     steps_per_capture: int = 20
+    # per-device HBM usage sampling cadence (allocator statistics; ~free).
+    # 0 disables.
+    memory_poll_s: float = 5.0
 
 
 @dataclass

@@ -110,6 +110,14 @@ def main(argv: list[str] | None = None) -> int:
     p_tpu.add_argument("--include-host", action="store_true",
                        help="include host compile/runtime spans")
 
+    p_mem = sub.add_parser("tpu-memory",
+                           help="per-device HBM usage, headroom, top ops "
+                                "by memory traffic, OOM forensics")
+    p_mem.add_argument("--device", type=int, default=None)
+    p_mem.add_argument("--start", type=int, default=None)
+    p_mem.add_argument("--end", type=int, default=None)
+    p_mem.add_argument("--top", type=int, default=15)
+
     p_coll = sub.add_parser("collectives",
                             help="cross-device collective groups "
                                  "(latency/skew/bandwidth)")
@@ -194,6 +202,43 @@ def main(argv: list[str] | None = None) -> int:
             _time.sleep(0.5)
         print("timed out waiting for result", rid)
         return 2
+    elif args.cmd == "tpu-memory":
+        body = {"top": args.top}
+        if args.device is not None:
+            body["device_id"] = args.device
+        if args.start:
+            body["time_start"] = args.start
+        if args.end:
+            body["time_end"] = args.end
+        r = _api(args.server, "/v1/profile/TpuMemory", body)["result"]
+        if not r["devices"]:
+            print("(no HBM samples)")
+            return 0
+        gib = 1 << 30
+        print_table(
+            ["DEVICE", "IN_USE_GIB", "PEAK_GIB", "LIMIT_GIB", "PEAK_%",
+             "FRAG_FREE_GIB"],
+            [[d["device_id"],
+              round(d["bytes_in_use"] / gib, 2),
+              round(d["peak_bytes_in_use"] / gib, 2),
+              round(d["bytes_limit"] / gib, 2),
+              d["peak_pct"],
+              round(d["largest_free_block"] / gib, 2)]
+             for d in r["devices"]])
+        if r["top_ops"]:
+            print("\ntop HLO ops by HBM traffic:")
+            print_table(
+                ["OP", "MODULE", "GIB_ACCESSED", "GB/S", "COUNT"],
+                [[o["hlo_op"], o["hlo_module"],
+                  round(o["bytes_accessed"] / gib, 2), o["hbm_gbps"],
+                  o["count"]] for o in r["top_ops"]])
+        f = r.get("forensics")
+        if f:
+            print(f"\npressure peak: {f['pressure_pct']}% of HBM on "
+                  f"device {f['pressure_peak']['device_id']} at "
+                  f"{f['pressure_peak']['time']}")
+            for o in f["ops_near_peak"]:
+                print(f"  {o['hlo_op']}: {o['bytes_accessed']:,}B near peak")
     elif args.cmd == "collectives":
         body = {}
         if args.start:

@@ -185,7 +185,23 @@ class TpuSpanDecoder(Decoder):
                 **{**tags, "slice_id": s.slice_id or tags.get("slice_id", 0)},
             })
         self.write("profile.tpu_hlo_span", rows)
-        return len(rows)
+        mem_rows = []
+        for m in batch.memory:
+            mem_rows.append({
+                "time": m.timestamp_ns + off,
+                "device_id": m.device_id,
+                "bytes_in_use": m.bytes_in_use,
+                "peak_bytes_in_use": m.peak_bytes_in_use,
+                "bytes_limit": m.bytes_limit,
+                "largest_free_block": m.largest_free_block,
+                "num_allocs": m.num_allocs,
+                "pid": m.pid,
+                "process_name": m.process_name,
+                **tags,
+            })
+        if mem_rows:
+            self.write("profile.tpu_memory", mem_rows)
+        return len(rows) + len(mem_rows)
 
 
 class PcapDecoder(Decoder):

@@ -168,6 +168,88 @@ class QuerierAPI:
             values.append(int(d))
         return {"result": build_flame_tree(stacks, values).to_dict()}
 
+    def tpu_memory(self, body: dict) -> dict:
+        """HBM observability (BASELINE config 3 '+ HBM'): per-device usage
+        timeline, headroom summary, per-HLO memory attribution (top ops by
+        bytes_accessed), and OOM forensics — what ran in the window around
+        the highest-pressure sample. Reference analog: the EE memory
+        profiler (memory_profile.rs) flame view, redesigned around XLA
+        allocator statistics + xplane span memory traffic."""
+        mem = self.db.table("profile.tpu_memory")
+        where = ["bytes_limit > 0"]
+        if body.get("time_start"):
+            where.append(f"time >= {int(body['time_start'])}")
+        if body.get("time_end"):
+            where.append(f"time < {int(body['time_end'])}")
+        if body.get("device_id") is not None:
+            where.append(f"device_id = {int(body['device_id'])}")
+        res = qengine.execute(
+            mem, "SELECT time, device_id, bytes_in_use, peak_bytes_in_use, "
+                 "bytes_limit, largest_free_block FROM t "
+                 f"WHERE {' AND '.join(where)} ORDER BY time")
+        timeline = [
+            {"time": int(t), "device_id": int(d), "bytes_in_use": int(b),
+             "peak_bytes_in_use": int(p), "bytes_limit": int(lim),
+             "largest_free_block": int(fr)}
+            for t, d, b, p, lim, fr in res.values]
+        devices: dict[int, dict] = {}
+        for s in timeline:  # time-ordered: last write wins = latest
+            d = s["device_id"]
+            cur = devices.setdefault(d, {"device_id": d, "peak_pct": 0.0})
+            cur["bytes_in_use"] = s["bytes_in_use"]
+            cur["peak_bytes_in_use"] = s["peak_bytes_in_use"]
+            cur["bytes_limit"] = s["bytes_limit"]
+            cur["largest_free_block"] = s["largest_free_block"]
+            cur["peak_pct"] = round(
+                100.0 * s["peak_bytes_in_use"] / s["bytes_limit"], 1)
+            cur["headroom_bytes"] = s["bytes_limit"] - s["peak_bytes_in_use"]
+        # per-HLO memory attribution: top ops by HBM traffic in the window
+        spans = self.db.table("profile.tpu_hlo_span")
+        swhere = ["bytes_accessed > 0"]
+        if body.get("time_start"):
+            swhere.append(f"time >= {int(body['time_start'])}")
+        if body.get("time_end"):
+            swhere.append(f"time < {int(body['time_end'])}")
+        top_n = int(body.get("top", 15))
+        sres = qengine.execute(
+            spans, "SELECT hlo_op, hlo_module, Sum(bytes_accessed) AS b, "
+                   "Sum(duration_ns) AS d, Count() AS n FROM t "
+                   f"WHERE {' AND '.join(swhere)} "
+                   "GROUP BY hlo_op, hlo_module ORDER BY b DESC "
+                   f"LIMIT {top_n}")
+        top_ops = [
+            {"hlo_op": op, "hlo_module": mod, "bytes_accessed": int(b),
+             "duration_ns": int(d), "count": int(n),
+             "hbm_gbps": round(b / max(1, d), 2)}  # bytes/ns = GB/s
+            for op, mod, b, d, n in sres.values]
+        # OOM forensics: the highest-pressure sample and what ran near it
+        forensics = None
+        if timeline:
+            worst = max(timeline,
+                        key=lambda s: s["bytes_in_use"] / s["bytes_limit"])
+            w = int(body.get("forensics_window_s", 10)) * 1_000_000_000
+            t0, t1 = worst["time"] - w, worst["time"] + w
+            fres = qengine.execute(
+                spans, "SELECT hlo_op, Sum(bytes_accessed) AS b FROM t "
+                       f"WHERE bytes_accessed > 0 AND time >= {t0} "
+                       f"AND time < {t1} GROUP BY hlo_op "
+                       "ORDER BY b DESC LIMIT 10")
+            forensics = {
+                "pressure_peak": worst,
+                "pressure_pct": round(
+                    100.0 * worst["bytes_in_use"] / worst["bytes_limit"], 1),
+                "ops_near_peak": [
+                    {"hlo_op": op, "bytes_accessed": int(b)}
+                    for op, b in fres.values],
+            }
+        return {"result": {
+            "devices": sorted(devices.values(),
+                              key=lambda d: d["device_id"]),
+            "timeline": timeline[-int(body.get("limit", 2000)):],
+            "top_ops": top_ops,
+            "forensics": forensics,
+        }}
+
     def tpu_collectives(self, body: dict) -> dict:
         """Cross-device stitched collectives (reference: SURVEY §2.9.5 ICI
         observation). Each group = one collective instance across all its
@@ -826,6 +908,8 @@ class QuerierHTTP:
                         self._send(200, api.tpu_collectives(body))
                     elif path == "/v1/profile/TpuStepTrace":
                         self._send(200, api.tpu_step_trace(body))
+                    elif path == "/v1/profile/TpuMemory":
+                        self._send(200, api.tpu_memory(body))
                     elif path == "/v1/tracing-adapters":
                         self._send(200, api.tracing_adapters_api(body))
                     elif path == "/v1/pcaps":

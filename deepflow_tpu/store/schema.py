@@ -112,6 +112,22 @@ _table("profile.tpu_hlo_span", [
     *UNIVERSAL_TAGS,
 ])
 
+# Per-device HBM usage timeline (reference analog: EE memory profiler
+# memory_profile.rs — here allocator-statistics polling; BASELINE config 3
+# "+ HBM")
+_table("profile.tpu_memory", [
+    C("time", "u64"),                   # sample ns
+    C("device_id", "u16"),
+    C("bytes_in_use", "u64"),
+    C("peak_bytes_in_use", "u64"),
+    C("bytes_limit", "u64"),
+    C("largest_free_block", "u64"),
+    C("num_allocs", "u32"),
+    C("pid", "u32"),
+    C("process_name", "str"),
+    *UNIVERSAL_TAGS,
+])
+
 # -- flow logs -------------------------------------------------------------
 # reference: server/ingester/flow_log/log_data/l4_flow_log.go
 _table("flow_log.l4_flow_log", [

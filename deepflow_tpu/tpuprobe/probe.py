@@ -12,8 +12,10 @@ import os
 import sys
 import threading
 
+from deepflow_tpu.proto import pb
 from deepflow_tpu.tpuprobe.events import TpuSpanEvent, batch_to_pb
-from deepflow_tpu.tpuprobe.sources import HooksSource, SimSource, XPlaneSource
+from deepflow_tpu.tpuprobe.sources import (
+    HooksSource, MemorySource, SimMemorySource, SimSource, XPlaneSource)
 
 log = logging.getLogger("df.tpuprobe")
 
@@ -39,12 +41,17 @@ class TpuProbe:
                 target_coverage=self.cfg.target_coverage,
                 steps_per_capture=self.cfg.steps_per_capture).start())
             self.sources.append(HooksSource(self._sink).start())
+            if self.cfg.memory_poll_s > 0:
+                self.sources.append(MemorySource(
+                    self._mem_sink,
+                    poll_interval_s=self.cfg.memory_poll_s).start())
         elif mode == "hooks":
             self.sources.append(HooksSource(self._sink).start())
         elif mode == "sim":
             src = SimSource(self._sink)
             self.sources.append(src)
             src.generate()
+            SimMemorySource(self._mem_sink).generate()
         return self
 
     def stop(self) -> None:
@@ -62,4 +69,17 @@ class TpuProbe:
         with self._lock:
             self.stats["spans_sent"] += len(events)
             self.stats["batches"] += 1
+        self.agent.send_tpu_spans(batch)
+
+    def _mem_sink(self, samples: list[dict]) -> None:
+        if not samples:
+            return
+        batch = pb.TpuSpanBatch()
+        for s in samples:
+            m = batch.memory.add(**s)
+            m.pid = os.getpid()
+            m.process_name = self.agent.process_name
+        with self._lock:
+            self.stats["mem_samples_sent"] = \
+                self.stats.get("mem_samples_sent", 0) + len(samples)
         self.agent.send_tpu_spans(batch)

@@ -226,6 +226,133 @@ class XPlaneSource:
             shutil.rmtree(tmpdir, ignore_errors=True)
 
 
+class MemorySource:
+    """Per-device HBM usage timeline via allocator statistics.
+
+    Reference analog: the EE memory profiler
+    (agent/src/ebpf_dispatcher/memory_profile.rs) builds allocation
+    ledgers from malloc uprobes; HBM is owned by XLA's BFC allocator, so
+    the TPU-native design polls `device.memory_stats()` — bytes_in_use,
+    peak, limit, largest free block (fragmentation) — at a fixed cadence
+    with zero interference with the workload (statistics reads, no
+    device sync). ~0 cost: one host call per device per poll."""
+
+    def __init__(self, sink, poll_interval_s: float = 5.0,
+                 devices_fn=None) -> None:
+        self.sink = sink
+        self.poll_interval_s = poll_interval_s
+        self._devices_fn = devices_fn
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.stats = {"polls": 0, "samples": 0, "errors": 0}
+
+    def _devices(self) -> list:
+        if self._devices_fn is not None:
+            return self._devices_fn()
+        import sys
+        jax = sys.modules.get("jax")
+        if jax is None:
+            return []
+        try:
+            from jax._src import xla_bridge
+            if not xla_bridge.backends_are_initialized():
+                return []  # never steal the TPU from a non-JAX process
+        except Exception:
+            pass
+        try:
+            return jax.devices()
+        except Exception:
+            return []
+
+    def poll_once(self) -> list[dict]:
+        samples = []
+        ts = time.time_ns()
+        for d in self._devices():
+            try:
+                st = d.memory_stats() or {}
+            except Exception:
+                continue
+            if not st:
+                continue
+            samples.append({
+                "timestamp_ns": ts,
+                "device_id": int(getattr(d, "id", 0)),
+                "bytes_in_use": int(st.get("bytes_in_use", 0)),
+                "peak_bytes_in_use": int(st.get("peak_bytes_in_use", 0)),
+                "bytes_limit": int(st.get("bytes_limit", 0)),
+                "largest_free_block": int(
+                    st.get("largest_free_block_bytes", 0)),
+                "num_allocs": int(st.get("num_allocs", 0)),
+            })
+        self.stats["polls"] += 1
+        self.stats["samples"] += len(samples)
+        if samples:
+            self.sink(samples)
+        return samples
+
+    def start(self) -> "MemorySource":
+        self._thread = threading.Thread(
+            target=self._run, name="df-tpuprobe-memory", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=3.0)
+
+    def _run(self) -> None:
+        if self._stop.wait(1.0):
+            return
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception:
+                self.stats["errors"] += 1
+                log.exception("memory poll failed")
+            if self._stop.wait(self.poll_interval_s):
+                return
+
+
+class SimMemorySource:
+    """Deterministic HBM-usage stream for CI: a ramp to a peak (the OOM
+    shape) then a drop — exercises timeline, headroom, and forensics
+    queries without a device."""
+
+    def __init__(self, sink, n_devices: int = 4,
+                 bytes_limit: int = 16 << 30) -> None:
+        self.sink = sink
+        self.n_devices = n_devices
+        self.bytes_limit = bytes_limit
+
+    def generate(self, start_ns: int | None = None,
+                 n_samples: int = 12) -> list[dict]:
+        t0 = start_ns if start_ns is not None else time.time_ns()
+        samples = []
+        peak = int(self.bytes_limit * 0.92)
+        for i in range(n_samples):
+            # ramp to 92% at 3/4 through, then release
+            frac = (i / (n_samples * 0.75) if i < n_samples * 0.75
+                    else 0.3)
+            in_use = min(peak, int(self.bytes_limit * 0.15 +
+                                   frac * self.bytes_limit * 0.8))
+            for dev in range(self.n_devices):
+                samples.append({
+                    "timestamp_ns": t0 + i * 1_000_000_000,
+                    "device_id": dev,
+                    "bytes_in_use": in_use,
+                    "peak_bytes_in_use": max(in_use, peak if
+                                             i >= n_samples * 0.75 else
+                                             in_use),
+                    "bytes_limit": self.bytes_limit,
+                    "largest_free_block": self.bytes_limit - in_use,
+                    "num_allocs": 100 + i,
+                })
+        if self.sink:
+            self.sink(samples)
+        return samples
+
+
 class HooksSource:
     """Host-side runtime events via jax.monitoring listeners.
 
@@ -293,11 +420,13 @@ class SimSource:
     fusions and ICI collectives across n_devices. CI stand-in for the real
     chip (reference test strategy: in-repo fake backends, SURVEY.md §4)."""
 
+    # (op, category, duration_ns, flops, bytes_transferred, bytes_accessed)
     OPS = [
-        ("fusion.1", "convolution fusion", 2_000_000, 3_500_000_000, 0),
-        ("fusion.2", "loop fusion", 400_000, 120_000_000, 0),
-        ("all-reduce.1", "all-reduce", 900_000, 0, 4_194_304),
-        ("copy.3", "copy", 50_000, 0, 0),
+        ("fusion.1", "convolution fusion", 2_000_000, 3_500_000_000, 0,
+         268_435_456),
+        ("fusion.2", "loop fusion", 400_000, 120_000_000, 0, 67_108_864),
+        ("all-reduce.1", "all-reduce", 900_000, 0, 4_194_304, 8_388_608),
+        ("copy.3", "copy", 50_000, 0, 0, 16_777_216),
     ]
 
     def __init__(self, sink, n_devices: int = 4, steps_per_batch: int = 5,
@@ -316,13 +445,14 @@ class SimSource:
             self._step += 1
             for dev in range(self.n_devices):
                 t = t0
-                for op, cat, dur, flops, xfer in self.OPS:
+                for op, cat, dur, flops, xfer, acc in self.OPS:
                     kind, coll = classify(cat, op)
                     events.append(TpuSpanEvent(
                         start_ns=t, duration_ns=dur, device_id=dev,
                         chip_id=dev, hlo_module=self.module, hlo_op=op,
                         hlo_category=cat, kind=kind, flops=flops,
                         collective=coll, bytes_transferred=xfer,
+                        bytes_accessed=acc,
                         run_id=self._step, step=self._step))
                     t += dur
             t0 = t + 100_000
