@@ -247,10 +247,11 @@ def main(argv: list[str] | None = None) -> int:
             body["time_end"] = args.end
         out = _api(args.server, "/v1/profile/TpuCollectives", body)
         rows = [[g["collective"], g["hlo_op"], g["run_id"],
-                 g["n_participants"], g["latency_ns"], g["skew_ns"],
-                 g["algo_bw_gbyte_s"]] for g in out["result"]]
-        print_table(["COLLECTIVE", "OP", "RUN", "DEVS", "LATENCY_NS",
-                     "SKEW_NS", "GB/S"], rows)
+                 g["n_participants"], g.get("transport", "ici"),
+                 len(g.get("hosts", [])) or 1, g["latency_ns"],
+                 g["skew_ns"], g["algo_bw_gbyte_s"]] for g in out["result"]]
+        print_table(["COLLECTIVE", "OP", "RUN", "DEVS", "TRANSPORT",
+                     "HOSTS", "LATENCY_NS", "SKEW_NS", "GB/S"], rows)
     elif args.cmd == "step-trace":
         body = {}
         if args.run_id is not None:
@@ -262,8 +263,7 @@ def main(argv: list[str] | None = None) -> int:
         print(f"run {tr['run_id']}: step {tr['step_latency_ns']:,}ns, "
               f"device skew {tr['device_skew_ns']:,}ns")
         rows = [[d, v["compute_ns"], v["collective_ns"], v["n_spans"]]
-                for d, v in sorted(tr["devices"].items(),
-                                   key=lambda kv: int(kv[0]))]
+                for d, v in sorted(tr["devices"].items())]
         print_table(["DEVICE", "COMPUTE_NS", "COLLECTIVE_NS", "SPANS"],
                     rows)
         for g in tr["collectives"]:

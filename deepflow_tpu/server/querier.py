@@ -279,7 +279,8 @@ class QuerierAPI:
             where.append(f"time < {int(body['time_end'])}")
         sql_text = (
             "SELECT time, duration_ns, device_id, core_id, hlo_op, "
-            "collective, run_id, bytes_transferred, step FROM t "
+            "collective, run_id, bytes_transferred, replica_group_size, "
+            "step, host, slice_id, tpu_pod FROM t "
             f"WHERE {' AND '.join(where)}")
         res = qengine.execute(table, sql_text)
         cols = res.columns

@@ -53,6 +53,7 @@ class TpuSpanEvent:
     run_id: int = 0
     collective: str = ""
     bytes_transferred: int = 0
+    replica_group_size: int = 0   # devices per replica group (0 = all)
     step: int = 0
 
     def fill_pb(self, s: "pb.TpuSpan", pid: int = 0,
@@ -72,6 +73,7 @@ class TpuSpanEvent:
         s.run_id = self.run_id & 0xFFFFFFFF
         s.collective = self.collective
         s.bytes_transferred = self.bytes_transferred
+        s.replica_group_size = self.replica_group_size
         s.step = self.step
         s.pid = pid
         s.process_name = process_name

@@ -200,6 +200,9 @@ def extract_device_spans(planes: list[XPlaneView],
                     run_id=run_id,
                     collective=coll,
                     bytes_transferred=bytes_acc if coll else 0,
+                    replica_group_size=int(
+                        ev.stats.get("replica_group_size",
+                                     ev.stats.get("group_size", 0)) or 0),
                 ))
         # module-level launch spans (for launch-rate metrics / step spans)
         for ms, me, rid, name, prog in modules:

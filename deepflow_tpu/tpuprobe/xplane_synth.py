@@ -55,6 +55,7 @@ class SynthOp:
     duration_ps: int
     flops: int = 0
     bytes_accessed: int = 0
+    replica_group_size: int = 0   # devices per replica group (collectives)
 
 
 @dataclass
@@ -89,6 +90,7 @@ def build_xspace(devices: dict[int, list[SynthModule]],
         stat_meta = {
             1: "run_id", 2: "device_offset_ps", 3: "device_duration_ps",
             4: "hlo_category", 5: "model_flops", 6: "bytes_accessed",
+            7: "replica_group_size",
         }
         # interned category strings get their own stat-metadata ids (the
         # real profiler interns strings via ref_value)
@@ -142,12 +144,56 @@ def build_xspace(devices: dict[int, list[SynthModule]],
                     stats += _ld(4, _stat(5, u64=op.flops))
                 if op.bytes_accessed:
                     stats += _ld(4, _stat(6, u64=op.bytes_accessed))
+                if op.replica_group_size:
+                    stats += _ld(4, _stat(7, u64=op.replica_group_size))
                 ev = (_vi(1, event_meta[op.name]) + _vi(2, op.offset_ps)
                       + _vi(3, op.duration_ps) + stats)
                 oline += _ld(4, ev)
         plane += _ld(3, oline)
         space += _ld(1, plane)
     return space
+
+
+def synth_multislice_step(n_slices: int = 2, devices_per_slice: int = 4,
+                          n_steps: int = 1, step_ps: int = 10_000_000,
+                          skew_ps: int = 50_000) -> dict[str, bytes]:
+    """Per-HOST captures of ONE multislice job (BASELINE config 5): each
+    host owns one slice's devices with LOCAL ids 0..devices_per_slice-1
+    (as real per-worker profiler output numbers them), all running the
+    same program/run_id. Per step each device runs a compute fusion, an
+    in-slice reduce-scatter (replica_group_size = devices_per_slice ->
+    ICI), and a cross-slice all-reduce over everyone (DCN). Returns
+    {hostname: xspace_bytes}; stitching multiple hosts' parses must
+    host-qualify device ids and split the reduce-scatter per slice."""
+    captures: dict[str, bytes] = {}
+    total = n_slices * devices_per_slice
+    for sl in range(n_slices):
+        host_devices: dict[int, list[SynthModule]] = {}
+        for dev in range(devices_per_slice):
+            gdev = sl * devices_per_slice + dev
+            mods = []
+            for s in range(n_steps):
+                base = s * step_ps + gdev * skew_ps
+                run_id = 5000 + s
+                ops = [
+                    SynthOp("fusion.9", "loop fusion", base + 10_000,
+                            4_000_000, flops=2_000_000_000,
+                            bytes_accessed=8_388_608),
+                    SynthOp("reduce-scatter.2", "reduce-scatter",
+                            base + 4_050_000, 700_000 + dev * 5_000,
+                            bytes_accessed=2_097_152,
+                            replica_group_size=devices_per_slice),
+                    SynthOp("all-reduce.11", "all-reduce",
+                            base + 5_000_000,
+                            2_500_000 + sl * 200_000 + dev * 10_000,
+                            bytes_accessed=4_194_304,
+                            replica_group_size=total),
+                ]
+                mods.append(SynthModule("jit_multislice_step(77)", run_id,
+                                        base, 8_000_000, ops))
+            host_devices[dev] = mods
+        captures[f"worker-{sl}"] = build_xspace(host_devices)
+    return captures
 
 
 def synth_spmd_step(n_devices: int = 8, n_steps: int = 2,
