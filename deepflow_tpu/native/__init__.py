@@ -16,7 +16,7 @@ log = logging.getLogger("df.native")
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_DIR, "libdfnative.so")
 _lib = None
-_ABI_VERSION = 2  # must match df_abi_version() in dfnative.cpp
+_ABI_VERSION = 3  # must match df_abi_version() in dfnative.cpp
 
 
 def _build() -> bool:
@@ -132,6 +132,13 @@ def load():
     lib.df_ring_promisc.restype = ctypes.c_int32
     lib.df_ring_promisc.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                     ctypes.c_int32]
+    # -- columnar protobuf decode (ingest hot path) -------------------------
+    lib.df_decode_l4_cols.restype = ctypes.c_int64
+    lib.df_decode_l4_cols.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_void_p,
+        np.ctypeslib.ndpointer(np.uint32),           # l7_off
+        np.ctypeslib.ndpointer(np.uint32),           # l7_len
+        ctypes.c_uint32, ctypes.POINTER(ctypes.c_uint32)]  # n_l7
     _lib = lib
     return lib
 
@@ -264,3 +271,77 @@ class NativeDict:
 
 def available() -> bool:
     return load() is not None
+
+
+# -- columnar L4 protobuf decode (must mirror DfL4Cols in pbcols.cpp) -------
+
+class _DfL4Cols(ctypes.Structure):
+    _pack_ = 1
+    _fields_ = (
+        [(n, ctypes.c_void_p) for n in (
+            "flow_id", "start_time_ns", "end_time_ns", "packet_tx",
+            "packet_rx", "byte_tx", "byte_rx", "l7_request", "l7_response",
+            "rtt_us", "art_us", "retrans_tx", "retrans_rx", "zero_win_tx",
+            "zero_win_rx", "close_type", "syn_count", "synack_count",
+            "gpid_0", "gpid_1", "ip4_src", "ip4_dst", "is_v6",
+            "ip6_src_off", "ip6_dst_off", "port_src", "port_dst", "proto",
+            "tap_port", "tunnel_type", "tunnel_id", "pod0_off", "pod0_len",
+            "pod1_off", "pod1_len", "arena")]
+        + [("arena_cap", ctypes.c_uint32),
+           ("arena_used", ctypes.c_uint32),
+           ("cap", ctypes.c_uint32)])
+
+
+class L4ColumnDecoder:
+    """Reusable buffers for df_decode_l4_cols: FlowLogBatch bytes ->
+    numpy column views with zero Python-object rows. decode() returns
+    (n_l4, cols dict, l7_segments, arena bytes-view) or None when the
+    native path can't take the batch (overflow/malformed) — caller falls
+    back to the protobuf Python path."""
+
+    U64 = ("flow_id", "start_time_ns", "end_time_ns", "packet_tx",
+           "packet_rx", "byte_tx", "byte_rx", "l7_request", "l7_response")
+    U32 = ("rtt_us", "art_us", "retrans_tx", "retrans_rx", "zero_win_tx",
+           "zero_win_rx", "syn_count", "synack_count", "gpid_0", "gpid_1",
+           "ip4_src", "ip4_dst", "ip6_src_off", "ip6_dst_off", "tap_port",
+           "tunnel_id", "pod0_off", "pod0_len", "pod1_off", "pod1_len")
+    U16 = ("port_src", "port_dst")
+    U8 = ("close_type", "is_v6", "proto", "tunnel_type")
+
+    def __init__(self, cap: int = 65536, arena_cap: int = 1 << 20,
+                 l7_cap: int = 65536) -> None:
+        lib = load()
+        if lib is None:
+            raise RuntimeError("libdfnative.so unavailable")
+        self._lib = lib
+        self.cap = cap
+        self.arrays: dict[str, np.ndarray] = {}
+        for names, dt in ((self.U64, np.uint64), (self.U32, np.uint32),
+                          (self.U16, np.uint16), (self.U8, np.uint8)):
+            for n in names:
+                self.arrays[n] = np.zeros(cap, dtype=dt)
+        self.arena = np.zeros(arena_cap, dtype=np.uint8)
+        self._l7_off = np.zeros(l7_cap, dtype=np.uint32)
+        self._l7_len = np.zeros(l7_cap, dtype=np.uint32)
+        self._l7_cap = l7_cap
+        self._n_l7 = ctypes.c_uint32(0)
+        self._cols = _DfL4Cols()
+        for n, a in self.arrays.items():
+            setattr(self._cols, n, a.ctypes.data)
+        self._cols.arena = self.arena.ctypes.data
+        self._cols.arena_cap = arena_cap
+        self._cols.cap = cap
+
+    def decode(self, payload: bytes):
+        n = self._lib.df_decode_l4_cols(
+            payload, len(payload), ctypes.byref(self._cols),
+            self._l7_off, self._l7_len, self._l7_cap,
+            ctypes.byref(self._n_l7))
+        if n < 0:
+            return None
+        n = int(n)
+        n_l7 = int(self._n_l7.value)
+        l7_segs = [(int(self._l7_off[i]), int(self._l7_len[i]))
+                   for i in range(n_l7)]
+        cols = {k: a[:n] for k, a in self.arrays.items()}
+        return n, cols, l7_segs, self.arena[:self._cols.arena_used]

@@ -1,0 +1,309 @@
+// Native columnar decode of FlowLogBatch L4 rows: protobuf wire format ->
+// struct-of-arrays, no Python objects on the hot path.
+//
+// Reference analog: the Go ingester's per-type unmarshallers
+// (server/ingester/flow_metrics/flow_metrics.go:55 fan decode across
+// cores). Redesign: instead of sharding Python decode across processes,
+// the columnar parse itself is native and releases the GIL — N decoder
+// threads then scale across cores while Python only broadcasts tags and
+// appends numpy arrays.
+//
+// Wire schema parsed here must match deepflow_tpu/proto/messages.proto:
+//   FlowLogBatch{ repeated L4FlowLog l4 = 1; repeated L7FlowLog l7 = 2; }
+//   L4FlowLog fields 1..26 (see proto); FlowKey fields 1..8.
+// Unknown fields are skipped by wire type, so proto ADDITIONS stay
+// compatible; if a parsed field changes meaning, bump DF_ABI_VERSION.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+struct Reader {
+    const uint8_t* p;
+    const uint8_t* end;
+    bool ok = true;
+
+    uint64_t varint() {
+        uint64_t v = 0;
+        int shift = 0;
+        while (p < end && shift < 64) {
+            uint8_t b = *p++;
+            v |= (uint64_t)(b & 0x7F) << shift;
+            if (!(b & 0x80)) return v;
+            shift += 7;
+        }
+        ok = false;
+        return 0;
+    }
+
+    bool skip(uint32_t wire) {
+        switch (wire) {
+            case 0: varint(); return ok;
+            case 1: if (end - p < 8) return ok = false; p += 8; return true;
+            case 2: {
+                uint64_t n = varint();
+                if (!ok || (uint64_t)(end - p) < n) return ok = false;
+                p += n;
+                return true;
+            }
+            case 5: if (end - p < 4) return ok = false; p += 4; return true;
+            default: return ok = false;
+        }
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Packed column output for one batch of L4 rows. Arrays are caller-owned
+// with capacity `cap`. Strings (close_type as enum; pod_0/pod_1) land in
+// a shared arena as (offset,len) pairs. Layout must match the ctypes
+// binding in native/__init__.py; bump DF_ABI_VERSION on change.
+#pragma pack(push, 1)
+struct DfL4Cols {
+    uint64_t* flow_id;
+    uint64_t* start_time_ns;
+    uint64_t* end_time_ns;
+    uint64_t* packet_tx;
+    uint64_t* packet_rx;
+    uint64_t* byte_tx;
+    uint64_t* byte_rx;
+    uint64_t* l7_request;
+    uint64_t* l7_response;
+    uint32_t* rtt_us;
+    uint32_t* art_us;
+    uint32_t* retrans_tx;
+    uint32_t* retrans_rx;
+    uint32_t* zero_win_tx;
+    uint32_t* zero_win_rx;
+    uint8_t*  close_type;      // enum idx: 0 unknown,1 fin,2 rst,3 timeout,4 forced
+    uint32_t* syn_count;
+    uint32_t* synack_count;
+    uint32_t* gpid_0;
+    uint32_t* gpid_1;
+    // key
+    uint32_t* ip4_src;         // host byte order; 0 when v6 (see is_v6)
+    uint32_t* ip4_dst;
+    uint8_t*  is_v6;           // 1 -> ips live in the arena
+    uint32_t* ip6_src_off;     // arena offsets (16 bytes each) when v6
+    uint32_t* ip6_dst_off;
+    uint16_t* port_src;
+    uint16_t* port_dst;
+    uint8_t*  proto;
+    uint32_t* tap_port;
+    uint8_t*  tunnel_type;
+    uint32_t* tunnel_id;
+    // pod strings: arena (off,len); len 0 = empty
+    uint32_t* pod0_off;
+    uint32_t* pod0_len;
+    uint32_t* pod1_off;
+    uint32_t* pod1_len;
+    // shared string arena
+    uint8_t*  arena;
+    uint32_t  arena_cap;
+    uint32_t  arena_used;
+    uint32_t  cap;
+};
+#pragma pack(pop)
+
+static uint8_t close_type_idx(const uint8_t* s, uint64_t n) {
+    // matches store/schema.py CLOSE_TYPES order
+    if (n == 3 && !memcmp(s, "fin", 3)) return 1;
+    if (n == 3 && !memcmp(s, "rst", 3)) return 2;
+    if (n == 7 && !memcmp(s, "timeout", 7)) return 3;
+    if (n == 6 && !memcmp(s, "forced", 6)) return 4;
+    return 0;
+}
+
+static bool arena_put(DfL4Cols* c, const uint8_t* s, uint64_t n,
+                      uint32_t* off_out, uint32_t* len_out) {
+    if (c->arena_used + n > c->arena_cap) return false;
+    memcpy(c->arena + c->arena_used, s, n);
+    *off_out = c->arena_used;
+    if (len_out) *len_out = (uint32_t)n;
+    c->arena_used += (uint32_t)n;
+    return true;
+}
+
+// Parse one L4FlowLog submessage into row r. Returns false on malformed
+// input or arena overflow.
+static bool parse_l4(Reader& rd, const uint8_t* end, DfL4Cols* c,
+                     uint32_t r) {
+    // zero the row (batches reuse arrays)
+    c->flow_id[r] = c->start_time_ns[r] = c->end_time_ns[r] = 0;
+    c->packet_tx[r] = c->packet_rx[r] = c->byte_tx[r] = c->byte_rx[r] = 0;
+    c->l7_request[r] = c->l7_response[r] = 0;
+    c->rtt_us[r] = c->art_us[r] = 0;
+    c->retrans_tx[r] = c->retrans_rx[r] = 0;
+    c->zero_win_tx[r] = c->zero_win_rx[r] = 0;
+    c->close_type[r] = 0;
+    c->syn_count[r] = c->synack_count[r] = 0;
+    c->gpid_0[r] = c->gpid_1[r] = 0;
+    c->ip4_src[r] = c->ip4_dst[r] = 0;
+    c->is_v6[r] = 0;
+    c->ip6_src_off[r] = c->ip6_dst_off[r] = 0;
+    c->port_src[r] = c->port_dst[r] = 0;
+    c->proto[r] = 0;
+    c->tap_port[r] = 0;
+    c->tunnel_type[r] = 0;
+    c->tunnel_id[r] = 0;
+    c->pod0_len[r] = c->pod1_len[r] = 0;
+    c->pod0_off[r] = c->pod1_off[r] = 0;
+
+    while (rd.ok && rd.p < end) {
+        uint64_t tag = rd.varint();
+        if (!rd.ok) return false;
+        uint32_t field = (uint32_t)(tag >> 3), wire = (uint32_t)(tag & 7);
+        if (wire == 0) {
+            uint64_t v = rd.varint();
+            if (!rd.ok) return false;
+            switch (field) {
+                case 1: c->flow_id[r] = v; break;
+                case 3: c->start_time_ns[r] = v; break;
+                case 4: c->end_time_ns[r] = v; break;
+                case 5: c->packet_tx[r] = v; break;
+                case 6: c->packet_rx[r] = v; break;
+                case 7: c->byte_tx[r] = v; break;
+                case 8: c->byte_rx[r] = v; break;
+                case 9: c->l7_request[r] = v; break;
+                case 10: c->l7_response[r] = v; break;
+                case 11: c->rtt_us[r] = (uint32_t)v; break;
+                case 12: c->art_us[r] = (uint32_t)v; break;
+                case 13: c->retrans_tx[r] = (uint32_t)v; break;
+                case 14: c->retrans_rx[r] = (uint32_t)v; break;
+                case 15: c->zero_win_tx[r] = (uint32_t)v; break;
+                case 16: c->zero_win_rx[r] = (uint32_t)v; break;
+                case 20: c->syn_count[r] = (uint32_t)v; break;
+                case 21: c->synack_count[r] = (uint32_t)v; break;
+                case 23: c->gpid_0[r] = (uint32_t)v; break;
+                case 24: c->gpid_1[r] = (uint32_t)v; break;
+                default: break;  // 18,19,22 unused by the row build
+            }
+            continue;
+        }
+        if (wire == 2) {
+            uint64_t n = rd.varint();
+            if (!rd.ok || (uint64_t)(end - rd.p) < n) return false;
+            const uint8_t* sub = rd.p;
+            rd.p += n;
+            switch (field) {
+                case 2: {  // FlowKey
+                    Reader kr{sub, sub + n};
+                    while (kr.ok && kr.p < kr.end) {
+                        uint64_t ktag = kr.varint();
+                        if (!kr.ok) return false;
+                        uint32_t kf = (uint32_t)(ktag >> 3),
+                                 kw = (uint32_t)(ktag & 7);
+                        if (kw == 0) {
+                            uint64_t kv = kr.varint();
+                            if (!kr.ok) return false;
+                            switch (kf) {
+                                case 3: c->port_src[r] = (uint16_t)kv; break;
+                                case 4: c->port_dst[r] = (uint16_t)kv; break;
+                                case 5: c->proto[r] = (uint8_t)kv; break;
+                                case 6: c->tap_port[r] = (uint32_t)kv; break;
+                                case 7: c->tunnel_type[r] = (uint8_t)kv; break;
+                                case 8: c->tunnel_id[r] = (uint32_t)kv; break;
+                                default: break;
+                            }
+                        } else if (kw == 2) {
+                            uint64_t kn = kr.varint();
+                            if (!kr.ok ||
+                                (uint64_t)(kr.end - kr.p) < kn)
+                                return false;
+                            const uint8_t* ks = kr.p;
+                            kr.p += kn;
+                            if (kf == 1 || kf == 2) {
+                                if (kn == 4) {
+                                    uint32_t ip =
+                                        (uint32_t)ks[0] << 24 |
+                                        (uint32_t)ks[1] << 16 |
+                                        (uint32_t)ks[2] << 8 | ks[3];
+                                    (kf == 1 ? c->ip4_src
+                                             : c->ip4_dst)[r] = ip;
+                                } else if (kn == 16) {
+                                    c->is_v6[r] = 1;
+                                    uint32_t off;
+                                    if (!arena_put(c, ks, kn, &off,
+                                                   nullptr))
+                                        return false;
+                                    (kf == 1 ? c->ip6_src_off
+                                             : c->ip6_dst_off)[r] = off;
+                                }
+                            }
+                        } else if (!kr.skip(kw)) {
+                            return false;
+                        }
+                    }
+                    if (!kr.ok) return false;
+                    break;
+                }
+                case 17:
+                    c->close_type[r] = close_type_idx(sub, n);
+                    break;
+                case 25:
+                    if (n && !arena_put(c, sub, n, &c->pod0_off[r],
+                                        &c->pod0_len[r]))
+                        return false;
+                    break;
+                case 26:
+                    if (n && !arena_put(c, sub, n, &c->pod1_off[r],
+                                        &c->pod1_len[r]))
+                        return false;
+                    break;
+                default:
+                    break;
+            }
+            continue;
+        }
+        if (!rd.skip(wire)) return false;
+    }
+    return rd.ok;
+}
+
+// Decode FlowLogBatch L4 rows columnar. Returns the number of L4 rows
+// decoded, or -1 on malformed input / capacity overflow (caller falls
+// back to the Python pb path). L7 submessages are NOT parsed; their
+// (offset, length) pairs within `data` are written to l7_off/l7_len
+// (capacity l7_cap) and counted in *n_l7 so Python can parse exactly
+// those bytes without re-walking the batch.
+int64_t df_decode_l4_cols(const uint8_t* data, uint64_t len,
+                          DfL4Cols* cols, uint32_t* l7_off,
+                          uint32_t* l7_len, uint32_t l7_cap,
+                          uint32_t* n_l7) {
+    Reader rd{data, data + len};
+    uint32_t n = 0, l7n = 0;
+    cols->arena_used = 0;
+    while (rd.ok && rd.p < rd.end) {
+        uint64_t tag = rd.varint();
+        if (!rd.ok) return -1;
+        uint32_t field = (uint32_t)(tag >> 3), wire = (uint32_t)(tag & 7);
+        if (field == 1 && wire == 2) {
+            uint64_t sublen = rd.varint();
+            if (!rd.ok || (uint64_t)(rd.end - rd.p) < sublen) return -1;
+            if (n >= cols->cap) return -1;
+            const uint8_t* sub = rd.p;
+            rd.p += sublen;
+            Reader sr{sub, sub + sublen};
+            if (!parse_l4(sr, sub + sublen, cols, n)) return -1;
+            n++;
+        } else if (field == 2 && wire == 2) {
+            uint64_t sublen = rd.varint();
+            if (!rd.ok || (uint64_t)(rd.end - rd.p) < sublen) return -1;
+            if (l7n >= l7_cap) return -1;
+            l7_off[l7n] = (uint32_t)(rd.p - data);
+            l7_len[l7n] = (uint32_t)sublen;
+            l7n++;
+            rd.p += sublen;
+        } else if (!rd.skip(wire)) {
+            return -1;
+        }
+    }
+    if (!rd.ok) return -1;
+    *n_l7 = l7n;
+    return n;
+}
+
+}  // extern "C"

@@ -31,13 +31,11 @@ class Decoder:
 
     MSG_TYPE: MessageType
 
-    WORKERS = 1  # ingest parallelism hook (reference: per-type decoder
-    # queues with N workers). MEASURED on this design: >1 worker does not
-    # help because the remaining cost is GIL-bound python (columnar
-    # building; upb parsing releases the GIL) — so the default stays 1;
-    # the knob exists for a future native row builder. Columnar build
-    # (one C-speed comprehension per column + append_columns) measured
-    # 169k rows/s end-to-end vs 64k for per-row dicts.
+    WORKERS = 1  # ingest parallelism (reference: per-type decoder queues
+    # with N workers, flow_metrics.go:55-61). FlowLogDecoder overrides
+    # via DF_INGEST_WORKERS: its native columnar parse (pbcols.cpp)
+    # releases the GIL, so extra workers scale across cores — unlike the
+    # python-object decode this comment used to caveat.
     # Row ORDER across workers is not guaranteed.
 
     def __init__(self, q: queue.Queue, db: Database,
@@ -114,7 +112,11 @@ class Decoder:
         if (self.exporters is not None and n
                 and self.exporters.wants(table_name)):
             names = list(cols)
-            expanded = [v if isinstance(v, (list, np.ndarray)) else [v] * n
+            # ndarray -> tolist(): exported cells must be PYTHON numbers
+            # (np scalars would json-serialize via default=str as strings,
+            # silently changing the export wire format)
+            expanded = [v.tolist() if isinstance(v, np.ndarray)
+                        else v if isinstance(v, list) else [v] * n
                         for v in cols.values()]
             self.exporters.feed(
                 table_name,
@@ -254,50 +256,114 @@ class PcapDecoder(Decoder):
 
 class FlowLogDecoder(Decoder):
     """FlowLogBatch -> flow_log.l4_flow_log / l7_flow_log. Registered for
-    both L4_LOG and L7_LOG message types."""
+    both L4_LOG and L7_LOG message types.
+
+    Hot path: the native columnar wire decoder (native/pbcols.cpp) parses
+    L4 rows straight into numpy arrays with the GIL RELEASED — which is
+    what makes WORKERS > 1 genuinely scale across cores (reference: the
+    Go ingester fans decode across cores,
+    flow_metrics/flow_metrics.go:55-61; Python-object decode was
+    GIL-bound). v6 or malformed batches fall back to the protobuf path.
+    """
 
     MSG_TYPE = MessageType.L4_LOG
+    # decode workers: >1 scales on multi-core hosts because the native
+    # parse releases the GIL (set DF_INGEST_WORKERS to the core budget)
+    try:
+        WORKERS = max(1, int(os.environ.get("DF_INGEST_WORKERS", "1")
+                             or 1))
+    except ValueError:
+        WORKERS = 1  # malformed env must not take the server down
+
+    def __init__(self, *args, **kw) -> None:
+        super().__init__(*args, **kw)
+        self._tl = threading.local()  # per-worker native decode buffers
+
+    def _fast_decoder(self):
+        """Per-thread L4ColumnDecoder (its buffers are not shareable)."""
+        dec = getattr(self._tl, "l4cols", False)
+        if dec is False:
+            try:
+                from deepflow_tpu.native import L4ColumnDecoder
+                dec = L4ColumnDecoder()
+            except Exception:
+                dec = None
+            self._tl.l4cols = dec
+        return dec
 
     def _endpoint_cols(self, items, keys, src_s, dst_s) -> dict:
-        """gprocess/resource columns shared by the l4 and l7 branches:
-        agent values win for pod; everything else resolves via the
-        controller gpid table / genesis ResourceIndex. Returns the full
-        per-side universal-tag column dict (reference:
-        grpc_platformdata.go QueryIPV4Infos per-side Info fill)."""
-        cols: dict[str, list] = {}
+        """Protobuf-object front end of the shared resolution ladder."""
+        return self._resolve_endpoint_cols(
+            len(items),
+            [bytes(k.ip_src) for k in keys],
+            [bytes(k.ip_dst) for k in keys],
+            [k.port_src for k in keys], [k.port_dst for k in keys],
+            [int(k.proto) for k in keys],
+            [f.gpid_0 for f in items], [f.gpid_1 for f in items],
+            [f.pod_0 for f in items], [f.pod_1 for f in items],
+            src_s, dst_s)
+
+    def _resolve_endpoint_cols(self, n, ipb0, ipb1, ports0, ports1,
+                               protos, agent_g0, agent_g1, pod0, pod1,
+                               src_s, dst_s) -> dict:
+        """gprocess/resource columns shared by the l4/l7 branches AND the
+        native columnar fast path — ONE ladder, so the two decode paths
+        cannot diverge on how the same traffic resolves. Agent values win
+        for pod/gpid; everything else resolves via the controller gpid
+        table / genesis ResourceIndex, deduped per distinct endpoint
+        (reference: grpc_platformdata.go QueryIPV4Infos per-side fill).
+        pod0/pod1 may be lists or a scalar broadcast."""
+        def aslist(p):
+            return p if isinstance(p, list) else [p] * n
+        cols: dict = {}
         if self.gpid_table is None:
-            cols["gprocess_id_0"] = [f.gpid_0 for f in items]
-            cols["gprocess_id_1"] = [f.gpid_1 for f in items]
-            cols["process_kname_0"] = [""] * len(items)
-            cols["process_kname_1"] = [""] * len(items)
+            cols["gprocess_id_0"] = agent_g0
+            cols["gprocess_id_1"] = agent_g1
+            cols["process_kname_0"] = ""
+            cols["process_kname_1"] = ""
         else:
             # socket-inode scan entries give every flow endpoint a
             # gpid AND a process name, preload or not (reference:
             # linux_socket.rs scan -> grpc_platformdata.go join)
             nl = self.gpid_table.name_lookup
-            side0 = [nl(bytes(k.ip_src), k.port_src, int(k.proto))
-                     for k in keys]
-            side1 = [nl(bytes(k.ip_dst), k.port_dst, int(k.proto))
-                     for k in keys]
-            cols["gprocess_id_0"] = [
-                f.gpid_0 or g for f, (g, _) in zip(items, side0)]
-            cols["gprocess_id_1"] = [
-                f.gpid_1 or g for f, (g, _) in zip(items, side1)]
-            cols["process_kname_0"] = [n for _, n in side0]
-            cols["process_kname_1"] = [n for _, n in side1]
+            cache: dict = {}
+
+            def side(ipbs, ports, agents):
+                gpids, names = [], []
+                for ipb, port, proto, ag in zip(ipbs, ports, protos,
+                                                agents):
+                    k = (ipb, port, proto)
+                    v = cache.get(k)
+                    if v is None:
+                        v = cache[k] = nl(ipb, port, proto)
+                    gpids.append(ag or v[0])
+                    names.append(v[1])
+                return gpids, names
+            cols["gprocess_id_0"], cols["process_kname_0"] = side(
+                ipb0, ports0, agent_g0)
+            cols["gprocess_id_1"], cols["process_kname_1"] = side(
+                ipb1, ports1, agent_g1)
         if self.resources is not None and not self.resources.is_empty():
             res = self.resources.batch_resolver()
-            t0 = [res(s) for s in src_s]
-            t1 = [res(s) for s in dst_s]
-            cols["pod_0"] = [f.pod_0 or t.pod for f, t in zip(items, t0)]
-            cols["pod_1"] = [f.pod_1 or t.pod for f, t in zip(items, t1)]
+            rcache: dict = {}
+
+            def resolve(s):
+                t = rcache.get(s)
+                if t is None:
+                    t = rcache[s] = res(s)
+                return t
+            t0 = [resolve(s) for s in src_s]
+            t1 = [resolve(s) for s in dst_s]
+            cols["pod_0"] = [p or t.pod
+                             for p, t in zip(aslist(pod0), t0)]
+            cols["pod_1"] = [p or t.pod
+                             for p, t in zip(aslist(pod1), t1)]
             for name in SIDE_RESOLVE_NAMES:
                 cols[f"{name}_0"] = [getattr(t, name) for t in t0]
                 cols[f"{name}_1"] = [getattr(t, name) for t in t1]
         elif self.resources is not None:
-            # nothing can resolve: constant columns (scalar broadcast)
-            cols["pod_0"] = [f.pod_0 for f in items]
-            cols["pod_1"] = [f.pod_1 for f in items]
+            # nothing can resolve: agent values / constant broadcast
+            cols["pod_0"], cols["pod_1"] = pod0, pod1
             for name in SIDE_RESOLVE_NAMES:
                 cols[f"{name}_0"] = ""
                 cols[f"{name}_1"] = ""
@@ -307,16 +373,36 @@ class FlowLogDecoder(Decoder):
             def pod_of(ip_str: str) -> str:
                 pod = pods.get(ip_str)
                 return pod.name if pod is not None else ""
-            cols["pod_0"] = [f.pod_0 or pod_of(s)
-                             for f, s in zip(items, src_s)]
-            cols["pod_1"] = [f.pod_1 or pod_of(s)
-                             for f, s in zip(items, dst_s)]
+            cols["pod_0"] = [p or pod_of(s)
+                             for p, s in zip(aslist(pod0), src_s)]
+            cols["pod_1"] = [p or pod_of(s)
+                             for p, s in zip(aslist(pod1), dst_s)]
         else:
-            cols["pod_0"] = [f.pod_0 for f in items]
-            cols["pod_1"] = [f.pod_1 for f in items]
+            cols["pod_0"], cols["pod_1"] = pod0, pod1
         return cols
 
     def handle(self, header: FrameHeader, payload: bytes) -> int:
+        fast = self._fast_decoder()
+        if fast is not None:
+            try:
+                res = fast.decode(payload)
+            except Exception:
+                res = None
+            # v6 rows ride the pb path (printable-string formatting and
+            # 128-bit handling are not worth a native fork; v6 flows are
+            # the rare case in TPU fleets)
+            if res is not None and not res[1]["is_v6"].any():
+                n_l4, cols, l7segs, arena = res
+                tags = self.platform.tags_for(header.agent_id)
+                off = self._clock_offset(header)
+                n = 0
+                if n_l4:
+                    n += self._handle_l4_cols(cols, n_l4, arena, tags, off)
+                if l7segs:
+                    l7 = [pb.L7FlowLog.FromString(payload[o:o + ln])
+                          for o, ln in l7segs]
+                    n += self._handle_l7_list(l7, tags, off)
+                return n
         batch = pb.FlowLogBatch.FromString(payload)
         tags = self.platform.tags_for(header.agent_id)
         # NTP normalization: shift this agent's absolute timestamps onto
@@ -325,7 +411,7 @@ class FlowLogDecoder(Decoder):
         # choke point). Sub-ms offsets are noise, not skew.
         off = self._clock_offset(header)
         n = 0
-        if batch.l4:
+        if batch.l4:  # pure-pb fallback path (v6 / no native lib)
             # columnar build: one C-speed comprehension per column instead
             # of per-row dicts (measured ~3x on the ingest bench; row
             # building was the GIL-bound bottleneck, see Decoder.WORKERS)
@@ -372,64 +458,149 @@ class FlowLogDecoder(Decoder):
             self.write_columns("flow_log.l4_flow_log", cols, len(l4))
             n += len(l4)
         if batch.l7:
-            l7 = list(batch.l7)
-            keys = [f.key for f in l7]
-            src_s = [_ip_str(k.ip_src) for k in keys]
-            dst_s = [_ip_str(k.ip_dst) for k in keys]
-            endpoint_cols = self._endpoint_cols(l7, keys, src_s, dst_s)
-            cols = {
-                "time": [f.start_time_ns + off for f in l7],
-                "flow_id": [f.flow_id for f in l7],
-                "ip_src": src_s,
-                "ip_dst": dst_s,
-                "port_src": [k.port_src for k in keys],
-                "port_dst": [k.port_dst for k in keys],
-                "tunnel_type": [min(int(k.tunnel_type), 4) for k in keys],
-                "tunnel_id": [k.tunnel_id for k in keys],
-                "l7_protocol": [int(f.l7_protocol) for f in l7],
-                "version": [f.version for f in l7],
-                "request_type": [f.request_type for f in l7],
-                "request_domain": [f.request_domain for f in l7],
-                "request_resource": [f.request_resource for f in l7],
-                "endpoint": [f.endpoint for f in l7],
-                "request_id": [f.request_id for f in l7],
-                "response_status": [int(f.response_status) for f in l7],
-                "response_code": [f.response_code for f in l7],
-                "response_exception": [f.response_exception for f in l7],
-                "response_result": [f.response_result for f in l7],
-                "response_duration": [
-                    max(0, f.end_time_ns - f.start_time_ns) for f in l7],
-                "trace_id": [f.trace_id for f in l7],
-                "span_id": [f.span_id for f in l7],
-                "parent_span_id": [f.parent_span_id for f in l7],
-                "x_request_id": [f.x_request_id for f in l7],
-                "syscall_trace_id_request": [
-                    f.syscall_trace_id_request for f in l7],
-                "syscall_trace_id_response": [
-                    f.syscall_trace_id_response for f in l7],
-                "syscall_thread_0": [f.syscall_thread_0 for f in l7],
-                "syscall_thread_1": [f.syscall_thread_1 for f in l7],
-                "captured_request_byte": [
-                    f.captured_request_byte for f in l7],
-                "captured_response_byte": [
-                    f.captured_response_byte for f in l7],
-                **endpoint_cols,
-                # agent-observed kernel thread name wins (sslprobe path);
-                # the socket-scan join fills the rest
-                "process_kname_0": [
-                    f.process_kname_0 or n for f, n in zip(
-                        l7, endpoint_cols["process_kname_0"])],
-                "process_kname_1": [
-                    f.process_kname_1 or n for f, n in zip(
-                        l7, endpoint_cols["process_kname_1"])],
-                "attrs": [f.attrs_json for f in l7],
-            }
-            cols.update(tags)  # constant per batch: scalar broadcast
-            self.write_columns("flow_log.l7_flow_log", cols, len(l7))
-            if self.trace_trees is not None:
-                self._feed_trace_trees(cols, len(l7))
-            n += len(l7)
+            n += self._handle_l7_list(list(batch.l7), tags, off)
         return n
+
+    def _handle_l4_cols(self, cols: dict, n: int, arena, tags: dict,
+                        off: int) -> int:
+        """Native columnar L4 path: numpy views from pbcols.cpp become
+        store columns directly. Per-row Python work is deduped — ip
+        strings and gpid endpoints resolve once per DISTINCT value, which
+        is how real traffic behaves (bounded host/endpoint sets)."""
+        import struct as _struct
+        ip4s, ip4d = cols["ip4_src"], cols["ip4_dst"]
+        ip_lut = {
+            int(u): "%d.%d.%d.%d" % (u >> 24 & 255, u >> 16 & 255,
+                                     u >> 8 & 255, u & 255)
+            for u in np.unique(np.concatenate((ip4s, ip4d))).tolist()}
+        src_s = [ip_lut[x] for x in ip4s.tolist()]
+        dst_s = [ip_lut[x] for x in ip4d.tolist()]
+
+        # agent-labeled pods (usually empty -> scalar broadcast)
+        def pods(which: str):
+            lens = cols[f"{which}_len"]
+            if not lens.any():
+                return ""
+            ab = arena.tobytes()
+            return [ab[o:o + ln].decode("utf-8", "replace") if ln else ""
+                    for o, ln in zip(cols[f"{which}_off"].tolist(),
+                                     lens.tolist())]
+        pod0, pod1 = pods("pod0"), pods("pod1")
+
+        # bytes form of each ip for the gpid join, built once per
+        # distinct address
+        b_lut = {u: _struct.pack(">I", u) for u in ip_lut}
+        ep = self._resolve_endpoint_cols(
+            n,
+            [b_lut[x] for x in ip4s.tolist()],
+            [b_lut[x] for x in ip4d.tolist()],
+            cols["port_src"].tolist(), cols["port_dst"].tolist(),
+            cols["proto"].tolist(),
+            cols["gpid_0"].tolist(), cols["gpid_1"].tolist(),
+            pod0, pod1, src_s, dst_s)
+
+        if off:
+            t_end = (cols["end_time_ns"].astype(np.int64)
+                     + off).astype(np.uint64)
+            t_start = (cols["start_time_ns"].astype(np.int64)
+                       + off).astype(np.uint64)
+        else:
+            t_end, t_start = cols["end_time_ns"], cols["start_time_ns"]
+        out = {
+            "time": t_end,
+            "flow_id": cols["flow_id"],
+            "ip_src": src_s,
+            "ip_dst": dst_s,
+            "ip4_src": ip4s,
+            "ip4_dst": ip4d,
+            "port_src": cols["port_src"],
+            "port_dst": cols["port_dst"],
+            "protocol": cols["proto"],
+            "tap_port": cols["tap_port"],
+            "start_time": t_start,
+            "end_time": t_end,
+            "packet_tx": cols["packet_tx"],
+            "packet_rx": cols["packet_rx"],
+            "byte_tx": cols["byte_tx"],
+            "byte_rx": cols["byte_rx"],
+            "l7_request": cols["l7_request"],
+            "l7_response": cols["l7_response"],
+            "rtt": cols["rtt_us"],
+            "art": cols["art_us"],
+            "retrans_tx": cols["retrans_tx"],
+            "retrans_rx": cols["retrans_rx"],
+            "zero_win_tx": cols["zero_win_tx"],
+            "zero_win_rx": cols["zero_win_rx"],
+            "close_type": cols["close_type"],
+            "syn_count": cols["syn_count"],
+            "synack_count": cols["synack_count"],
+            "tunnel_type": np.minimum(cols["tunnel_type"], 4),
+            "tunnel_id": cols["tunnel_id"],
+            **ep,
+        }
+        out.update(tags)
+        self.write_columns("flow_log.l4_flow_log", out, n)
+        return n
+
+    def _handle_l7_list(self, l7: list, tags: dict, off: int) -> int:
+        keys = [f.key for f in l7]
+        src_s = [_ip_str(k.ip_src) for k in keys]
+        dst_s = [_ip_str(k.ip_dst) for k in keys]
+        endpoint_cols = self._endpoint_cols(l7, keys, src_s, dst_s)
+        cols = {
+            "time": [f.start_time_ns + off for f in l7],
+            "flow_id": [f.flow_id for f in l7],
+            "ip_src": src_s,
+            "ip_dst": dst_s,
+            "port_src": [k.port_src for k in keys],
+            "port_dst": [k.port_dst for k in keys],
+            "tunnel_type": [min(int(k.tunnel_type), 4) for k in keys],
+            "tunnel_id": [k.tunnel_id for k in keys],
+            "l7_protocol": [int(f.l7_protocol) for f in l7],
+            "version": [f.version for f in l7],
+            "request_type": [f.request_type for f in l7],
+            "request_domain": [f.request_domain for f in l7],
+            "request_resource": [f.request_resource for f in l7],
+            "endpoint": [f.endpoint for f in l7],
+            "request_id": [f.request_id for f in l7],
+            "response_status": [int(f.response_status) for f in l7],
+            "response_code": [f.response_code for f in l7],
+            "response_exception": [f.response_exception for f in l7],
+            "response_result": [f.response_result for f in l7],
+            "response_duration": [
+                max(0, f.end_time_ns - f.start_time_ns) for f in l7],
+            "trace_id": [f.trace_id for f in l7],
+            "span_id": [f.span_id for f in l7],
+            "parent_span_id": [f.parent_span_id for f in l7],
+            "x_request_id": [f.x_request_id for f in l7],
+            "syscall_trace_id_request": [
+                f.syscall_trace_id_request for f in l7],
+            "syscall_trace_id_response": [
+                f.syscall_trace_id_response for f in l7],
+            "syscall_thread_0": [f.syscall_thread_0 for f in l7],
+            "syscall_thread_1": [f.syscall_thread_1 for f in l7],
+            "captured_request_byte": [
+                f.captured_request_byte for f in l7],
+            "captured_response_byte": [
+                f.captured_response_byte for f in l7],
+            **endpoint_cols,
+            # agent-observed kernel thread name wins (sslprobe path);
+            # the socket-scan join fills the rest (may be a scalar "")
+            "process_kname_0": [
+                f.process_kname_0 or n for f, n in zip(
+                    l7, _aslist(endpoint_cols["process_kname_0"],
+                                len(l7)))],
+            "process_kname_1": [
+                f.process_kname_1 or n for f, n in zip(
+                    l7, _aslist(endpoint_cols["process_kname_1"],
+                                len(l7)))],
+            "attrs": [f.attrs_json for f in l7],
+        }
+        cols.update(tags)  # constant per batch: scalar broadcast
+        self.write_columns("flow_log.l7_flow_log", cols, len(l7))
+        if self.trace_trees is not None:
+            self._feed_trace_trees(cols, len(l7))
+        return len(l7)
 
     def _feed_trace_trees(self, cols: dict, n: int) -> None:
         """Traced rows (non-empty trace_id: typically a small subset)
@@ -669,6 +840,12 @@ class EventDecoder(Decoder):
     def flush(self) -> None:
         """Final flush (server shutdown / tests)."""
         self._flush_agg(force=True)
+
+
+def _aslist(v, n: int) -> list:
+    """Scalar column broadcast -> per-row list (store columns may be
+    scalars meaning 'this value for every row')."""
+    return v if isinstance(v, list) else [v] * n
 
 
 _IP_CACHE: dict[bytes, tuple[str, int]] = {}

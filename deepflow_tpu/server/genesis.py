@@ -173,9 +173,14 @@ class K8sGenesis:
                  insecure_skip_verify: bool = False,
                  event_sink=None,
                  resources: ResourceIndex | None = None) -> None:
-        # event_sink(rows) receives resource-change events (reference:
-        # controller/recorder resource diffs -> event tables)
+        # event_sink(rows) receives resource-change events through the
+        # snapshot-diff recorder (reference: controller/recorder resource
+        # diffs -> event tables): added/deleted AND attribute-level
+        # modified events with before/after payloads
+        from deepflow_tpu.server.recorder import ResourceRecorder
         self.event_sink = event_sink
+        self.recorder = ResourceRecorder(event_sink)
+        self._workload_pods: dict[str, set] = {}
         if api_base is None:
             cfg = in_cluster_config()
             if cfg is None:
@@ -193,17 +198,46 @@ class K8sGenesis:
                       "services": 0, "endpoints": 0, "nodes": 0}
         self._loops = [_ResourceLoop(
             self, "/api/v1/pods", "pods", self._apply,
-            self.pod_index.retain_ips)]
+            self._retain_pods)]
         if resources is not None:
             self._loops += [
                 _ResourceLoop(self, "/api/v1/services", "services",
-                              self._apply_service, resources.retain_services),
+                              self._apply_service, self._retain_services),
                 _ResourceLoop(self, "/api/v1/endpoints", "endpoints",
                               self._apply_endpoints,
                               resources.retain_endpoints),
                 _ResourceLoop(self, "/api/v1/nodes", "nodes",
-                              self._apply_node, resources.retain_nodes),
+                              self._apply_node, self._retain_nodes),
             ]
+
+    # -- relist reconciliation (state AND recorder) ---------------------------
+
+    def _retain_pods(self, seen: set) -> None:
+        # split the mixed reconcile set _apply returns: plain strings are
+        # pod IPs, ("__pod__", key) tuples are live pod identities
+        # (IP-less Pending pods appear ONLY as the latter)
+        ips = {k for k in seen if isinstance(k, str)}
+        live = {k[1] for k in seen if isinstance(k, tuple)}
+        self.pod_index.retain_ips(ips)
+        # objects that vanished during a watch gap get their deleted
+        # events here — the relist is authoritative
+        self.recorder.reconcile("pod", live)
+        live_w: dict[str, set] = {}
+        for wkey, members in self._workload_pods.items():
+            kept = members & live
+            if kept:
+                live_w[wkey] = kept
+        self._workload_pods = live_w
+        self.recorder.reconcile("workload", set(live_w))
+
+    def _retain_services(self, keys: set) -> None:
+        self.resources.retain_services(keys)
+        self.recorder.reconcile("service",
+                                {f"{ns}/{n}" for ns, n in keys})
+
+    def _retain_nodes(self, names: set) -> None:
+        self.resources.retain_nodes(names)
+        self.recorder.reconcile("node", set(names))
 
     # back-compat: tests poke gen.resource_version to force relists
     @property
@@ -222,24 +256,6 @@ class K8sGenesis:
             req.add_header("Authorization", f"Bearer {self.token}")
         return urllib.request.urlopen(req, timeout=timeout,
                                       context=self._ctx)
-
-    # -- resource events -------------------------------------------------------
-
-    def _emit_event(self, etype: str, resource_type: str, name: str,
-                    description: str) -> None:
-        if self.event_sink is None or etype not in ("ADDED", "DELETED"):
-            return
-        import time as _t
-        try:
-            self.event_sink([{
-                "time": _t.time_ns(),
-                "event_type": f"{resource_type}-{etype.lower()}",
-                "resource_type": resource_type,
-                "resource_name": name,
-                "description": description,
-            }])
-        except Exception:
-            log.debug("event sink failed", exc_info=True)
 
     # -- pods ------------------------------------------------------------------
 
@@ -269,18 +285,40 @@ class K8sGenesis:
             workload=self._workload_of(pod),
             labels=meta.get("labels", {}) or {},
         )
-        if event_type == "DELETED":
+        key = f"{info.namespace}/{info.name}"
+        deleted = event_type == "DELETED"
+        if deleted:
             for ip in ips:
                 self.pod_index.remove_ip(ip)
         else:  # ADDED | MODIFIED
             for ip in ips:
                 self.pod_index.upsert(ip, info)
-        if emit_events:
-            self._emit_event(
-                event_type, "pod", f"{info.namespace}/{info.name}",
-                f"node={info.node} workload={info.workload} "
-                f"ips={','.join(ips)}")
-        return set(ips)
+        self.recorder.observe(
+            "pod", key,
+            None if deleted else {"node": info.node,
+                                  "workload": info.workload,
+                                  "ips": sorted(ips)},
+            deleted=deleted, emit=emit_events)
+        # derived workload lifecycle (reference records pod_group state):
+        # first pod of a workload -> workload-added; last gone -> deleted
+        if info.workload:
+            wkey = f"{info.namespace}/{info.workload}"
+            members = self._workload_pods.setdefault(wkey, set())
+            if deleted:
+                members.discard(key)
+                if not members:
+                    self._workload_pods.pop(wkey, None)
+                    self.recorder.observe("workload", wkey, None,
+                                          deleted=True, emit=emit_events)
+            else:
+                members.add(key)
+                self.recorder.observe(
+                    "workload", wkey, {"namespace": info.namespace},
+                    emit=emit_events)
+        # reconcile keys: the pod's IPs (pod_index retention) plus a
+        # name marker — a Pending pod has NO ip yet but is still alive,
+        # and the recorder's relist reconcile must not declare it dead
+        return set(ips) | {("__pod__", key)}
 
     # -- services / endpoints / nodes -----------------------------------------
 
@@ -292,18 +330,24 @@ class K8sGenesis:
         # defensive: ignore non-Service shapes (shared fake servers)
         if not name or ("clusterIP" not in spec and "ports" not in spec):
             return set()
-        if event_type == "DELETED":
+        deleted = event_type == "DELETED"
+        ports = tuple(p.get("port") for p in spec.get("ports", [])
+                      if p.get("port"))
+        if deleted:
             self.resources.remove_service(ns, name)
         else:
             self.resources.upsert_service(ServiceInfo(
                 name=name, namespace=ns,
                 cluster_ip=spec.get("clusterIP", "") or "",
                 svc_type=spec.get("type", "ClusterIP"),
-                ports=tuple(p.get("port") for p in spec.get("ports", [])
-                            if p.get("port"))))
-        if emit_events:
-            self._emit_event(event_type, "service", f"{ns}/{name}",
-                             f"cluster_ip={spec.get('clusterIP', '')}")
+                ports=ports))
+        self.recorder.observe(
+            "service", f"{ns}/{name}",
+            None if deleted else {
+                "cluster_ip": spec.get("clusterIP", "") or "",
+                "type": spec.get("type", "ClusterIP"),
+                "ports": sorted(ports)},
+            deleted=deleted, emit=emit_events)
         return {(ns, name)}
 
     def _apply_endpoints(self, event_type: str, obj: dict,
@@ -336,7 +380,8 @@ class K8sGenesis:
             return set()
         if event_type == "DELETED":
             self.resources.remove_node(name)
-            self._emit_event(event_type, "node", name, "")
+            self.recorder.observe("node", name, None, deleted=True,
+                                  emit=emit_events)
             return set()
         labels = meta.get("labels", {}) or {}
         spec = obj.get("spec", {})
@@ -353,9 +398,19 @@ class K8sGenesis:
             region=labels.get("topology.kubernetes.io/region", ""),
             internal_ip=internal, pod_cidrs=tuple(cidrs))
         self.resources.upsert_node(node)
-        if emit_events:
-            self._emit_event(event_type, "node", name,
-                             f"az={node.az} ip={internal}")
+        # node readiness is the attr ops ask about first ("did the node
+        # go NotReady right before the regression?")
+        ready = ""
+        for cond in status.get("conditions") or []:
+            if cond.get("type") == "Ready":
+                ready = cond.get("status", "")
+                break
+        self.recorder.observe(
+            "node", name,
+            {"az": node.az, "region": node.region,
+             "internal_ip": internal, "pod_cidrs": sorted(cidrs),
+             "ready": ready},
+            emit=emit_events)
         return {name}
 
     # -- back-compat single-loop entry points (tests drive these) -------------

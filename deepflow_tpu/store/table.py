@@ -53,6 +53,10 @@ class ColumnarTable:
             c.name: Dictionary(f"{name}.{c.name}")
             for c in columns if c.kind == "str"}
         self._chunks: list[dict[str, np.ndarray]] = []
+        # write buffer: per column, a list of SEGMENTS — python lists
+        # (converted at seal) or typed ndarrays (pass straight through);
+        # segment buffering lets the columnar ingest path hand over numpy
+        # arrays without a tolist/extend/asarray round trip
         self._buf: dict[str, list] = {c.name: [] for c in columns}
         self._buf_rows = 0
         self._lock = threading.Lock()
@@ -66,13 +70,13 @@ class ColumnarTable:
             return
         with self._lock:
             for name, spec in self.columns.items():
-                col = self._buf[name]
                 if spec.kind == "str":
                     d = self.dicts[name]
-                    col.extend(d.encode(r.get(name, "")) for r in rows)
+                    seg = [d.encode(r.get(name, "")) for r in rows]
                 else:
                     dflt = spec.default
-                    col.extend(r.get(name, dflt) for r in rows)
+                    seg = [r.get(name, dflt) for r in rows]
+                self._buf[name].append(seg)
             self._buf_rows += len(rows)
             self.rows_written += len(rows)
             if self._buf_rows >= self.chunk_rows:
@@ -103,19 +107,34 @@ class ColumnarTable:
                     if not isinstance(v, (list, np.ndarray)):  # scalar
                         if spec.kind == "str":
                             v = self.dicts[name].encode(v)
-                        col.extend([v] * n)
+                        try:  # typed constant segment (no per-row list)
+                            col.append(np.full(n, v, dtype=spec.np_dtype))
+                        except (OverflowError, ValueError, TypeError):
+                            col.append([v] * n)  # poisoned: seal handles
                     elif spec.kind == "str":
-                        col.extend(self.dicts[name].encode_batch(v))
+                        col.append(self.dicts[name].encode_batch(v))
                     elif isinstance(v, np.ndarray):
-                        col.extend(v.tolist())
+                        # typed segment passes through; COPY — callers
+                        # (native decoder) reuse their buffers
+                        col.append(v.astype(spec.np_dtype))
                     else:
-                        col.extend(v)
+                        col.append(list(v))  # shallow copy: caller may reuse
                 else:
-                    col.extend([spec.default] * n)
+                    col.append(np.full(n, spec.default,
+                                       dtype=spec.np_dtype))
             self._buf_rows += n
             self.rows_written += n
             if self._buf_rows >= self.chunk_rows:
                 self._seal_locked()
+
+    def _materialize_buf(self, name: str, spec) -> np.ndarray:
+        segs = self._buf[name]
+        if len(segs) == 1 and isinstance(segs[0], np.ndarray):
+            return segs[0]
+        parts = [s if isinstance(s, np.ndarray)
+                 else np.asarray(s, dtype=spec.np_dtype) for s in segs]
+        return (np.concatenate(parts) if parts
+                else np.empty(0, dtype=spec.np_dtype))
 
     def _seal_locked(self) -> None:
         if self._buf_rows == 0:
@@ -123,7 +142,7 @@ class ColumnarTable:
         chunk = {}
         try:
             for name, spec in self.columns.items():
-                chunk[name] = np.asarray(self._buf[name], dtype=spec.np_dtype)
+                chunk[name] = self._materialize_buf(name, spec)
         except (OverflowError, ValueError, TypeError) as e:
             # a poisoned value must not wedge the table: drop the window
             dropped = self._buf_rows
@@ -151,7 +170,7 @@ class ColumnarTable:
             chunks = list(self._chunks)
             if self._buf_rows:
                 chunks.append({
-                    name: np.asarray(self._buf[name], dtype=spec.np_dtype)
+                    name: self._materialize_buf(name, spec)
                     for name, spec in self.columns.items()})
         return chunks
 
@@ -228,7 +247,9 @@ class ColumnarTable:
                 used: set[int] = set()
                 for ch in self._chunks:
                     used.update(np.unique(ch[name]).tolist())
-                used.update(self._buf[name])
+                for seg in self._buf[name]:
+                    used.update(np.unique(seg).tolist()
+                                if isinstance(seg, np.ndarray) else seg)
                 used.discard(0)
                 if len(used) + 1 > old_n * max_live_frac:
                     continue
@@ -239,7 +260,10 @@ class ColumnarTable:
                     lut[old_id] = new_id
                 self._chunks = [
                     {**ch, name: lut[ch[name]]} for ch in self._chunks]
-                self._buf[name] = [int(lut[i]) for i in self._buf[name]]
+                self._buf[name] = [
+                    lut[seg] if isinstance(seg, np.ndarray)
+                    else [int(lut[i]) for i in seg]
+                    for seg in self._buf[name]]
                 nd = Dictionary(d.name)
                 nd._strings = strings
                 nd._str_to_id = {s: i for i, s in enumerate(strings)}

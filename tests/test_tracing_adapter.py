@@ -180,6 +180,9 @@ def test_merge_survives_mutually_referencing_spans():
 
 
 def test_relist_does_not_reemit_added_events():
+    """List seeding is silent, and a resync re-ADD of an IDENTICAL known
+    object is a no-op in the diff engine; a real change emits a
+    modified event with before/after attrs."""
     from deepflow_tpu.server.genesis import K8sGenesis
     from deepflow_tpu.server.platform_info import PodIpIndex
     rows = []
@@ -190,8 +193,14 @@ def test_relist_does_not_reemit_added_events():
            "status": {"podIP": "10.0.0.1", "podIPs": [{"ip": "10.0.0.1"}]}}
     gen._apply("ADDED", pod, emit_events=False)  # what list_once does
     assert rows == []
-    gen._apply("ADDED", pod)                     # real watch event
-    assert len(rows) == 1
+    gen._apply("ADDED", pod)        # resync of known identical state
+    assert rows == []
+    pod["spec"]["nodeName"] = "n2"  # rescheduled
+    gen._apply("MODIFIED", pod)
+    assert len(rows) == 1 and rows[0]["event_type"] == "pod-modified"
+    import json as _json
+    changed = _json.loads(rows[0]["attrs"])["changed"]
+    assert changed["node"] == {"before": "n", "after": "n2"}
 
 
 def test_adapter_rejects_empty_base_url():
