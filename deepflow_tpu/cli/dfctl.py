@@ -100,6 +100,13 @@ def main(argv: list[str] | None = None) -> int:
     p_query = sub.add_parser("query")
     p_query.add_argument("sql")
     p_query.add_argument("--db", default="")
+    p_query.add_argument("--org", type=int, default=None,
+                         help="scope results to this org id")
+
+    p_org = sub.add_parser("org", help="org/team scoping: assign agent "
+                                       "groups to orgs, list assignments")
+    p_org.add_argument("--assign", nargs=2, metavar=("GROUP", "ORG_ID"),
+                       default=None)
 
     p_flame = sub.add_parser("flame")
     p_flame.add_argument("--service", default=None)
@@ -143,6 +150,8 @@ def main(argv: list[str] | None = None) -> int:
     p_promql.add_argument("--start", type=int, default=None)
     p_promql.add_argument("--end", type=int, default=None)
     p_promql.add_argument("--step", type=int, default=15)
+    p_promql.add_argument("--org", type=int, default=None,
+                          help="scope results to this org id")
 
     p_ts = sub.add_parser(
         "trace-search", help="search traces by tags/duration "
@@ -277,8 +286,10 @@ def main(argv: list[str] | None = None) -> int:
                    {"group": args.group, "yaml": yaml_text})
         print(f"group {out['group']} -> version {out['version']}")
     elif args.cmd == "query":
-        out = _api(args.server, "/v1/query/",
-                   {"db": args.db, "sql": args.sql})
+        body = {"db": args.db, "sql": args.sql}
+        if args.org is not None:
+            body["org_id"] = args.org
+        out = _api(args.server, "/v1/query/", body)
         r = out["result"]
         print_table(r["columns"], r["values"])
     elif args.cmd == "flame":
@@ -295,6 +306,22 @@ def main(argv: list[str] | None = None) -> int:
             body["include_host"] = True
         out = _api(args.server, "/v1/profile/TpuFlame", body)
         print_flame(out["result"])
+    elif args.cmd == "org":
+        body = {"action": "list"}
+        if args.assign:
+            try:
+                org_id = int(args.assign[1])
+            except ValueError:
+                raise SystemExit(
+                    f"org: ORG_ID must be an integer, got "
+                    f"{args.assign[1]!r}")
+            body = {"action": "assign", "group": args.assign[0],
+                    "org_id": org_id}
+        out = _api(args.server, "/v1/orgs", body)
+        rows = sorted(out["orgs"].items())
+        print_table(["GROUP", "ORG_ID"],
+                    [[g, o] for g, o in rows] or
+                    [["(all groups)", out["default_org"]]])
     elif args.cmd == "promql":
         from urllib.parse import quote
         import time as _time
@@ -302,9 +329,11 @@ def main(argv: list[str] | None = None) -> int:
             raise SystemExit(
                 "promql: --start and --end must be given together "
                 "(a range query needs both bounds)")
+        org_q = f"&org_id={args.org}" if args.org is not None else ""
         if args.start is not None and args.end is not None:
             url = (f"/prom/api/v1/query_range?query={quote(args.expr)}"
-                   f"&start={args.start}&end={args.end}&step={args.step}")
+                   f"&start={args.start}&end={args.end}&step={args.step}"
+                   f"{org_q}")
             out = _api(args.server, url)
             if out.get("status") != "success":
                 raise SystemExit(f"promql: {out.get('error')}")
@@ -314,7 +343,8 @@ def main(argv: list[str] | None = None) -> int:
                     print(f"  {t}  {v}")
         else:
             t = args.time if args.time is not None else int(_time.time())
-            url = f"/prom/api/v1/query?query={quote(args.expr)}&time={t}"
+            url = (f"/prom/api/v1/query?query={quote(args.expr)}"
+                   f"&time={t}{org_q}")
             out = _api(args.server, url)
             if out.get("status") != "success":
                 raise SystemExit(f"promql: {out.get('error')}")

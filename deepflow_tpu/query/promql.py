@@ -618,8 +618,10 @@ def _compile_matchers(table, matchers, labels_col):
         # json-labeled metrics: remote-write user labels ALWAYS match via
         # the json column (they'd be shadowed by same-named universal tag
         # columns); self-telemetry prefers real columns (host/agent_id) and
-        # falls back to the json tags
-        if labels_col is not None and (
+        # falls back to the json tags. Exception: org_id is the tenancy
+        # boundary — it must always hit the ingest-injected real column,
+        # never a user-supplied label
+        if labels_col is not None and lbl != "org_id" and (
                 labels_col == "labels_json" or lbl not in table.columns):
             ids = _labels_json_ids(table, lbl, op, val, labels_col)
             appliers.append(("isin", labels_col, ids, negate))
@@ -1571,6 +1573,29 @@ class _Evaluator:
 
 
 # -- public API --------------------------------------------------------------
+
+def scope_to_org(node, org_id: int):
+    """Enforce tenancy on a parsed query: append an org_id matcher to
+    every vector selector (org_id is a universal-tag column on every
+    table, so the numeric-eq matcher path applies it). Returns the same
+    AST, mutated."""
+    if isinstance(node, VectorSelector):
+        node.matchers = [m for m in node.matchers if m[0] != "org_id"]
+        node.matchers.append(("org_id", "=", str(int(org_id))))
+        return node
+    if isinstance(node, MatrixSelector):
+        scope_to_org(node.vs, org_id)
+        return node
+    for f in getattr(node, "__dataclass_fields__", {}):
+        v = getattr(node, f)
+        if isinstance(v, list):
+            for item in v:
+                if hasattr(item, "__dataclass_fields__"):
+                    scope_to_org(item, org_id)
+        elif hasattr(v, "__dataclass_fields__"):
+            scope_to_org(v, org_id)
+    return node
+
 
 def evaluate(db: Database, query, start_s: int, end_s: int,
              step_s: int = 15) -> list[dict]:

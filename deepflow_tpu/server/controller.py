@@ -93,6 +93,13 @@ class AgentRegistry:
         with self._lock:
             return [dict(v) for v in self._by_key.values()]
 
+    def group_of(self, agent_id: int) -> str:
+        with self._lock:
+            for e in self._by_key.values():
+                if e["agent_id"] == agent_id:
+                    return e.get("agent_group", "default")
+        return "default"
+
 
 class GpidAllocator:
     """Global process IDs: (agent_id, pid) -> gpid, plus the 5-tuple table
@@ -301,6 +308,12 @@ class Controller:
         self.pod_index = pod_index  # K8s genesis resource model (server's)
         self.registry = AgentRegistry()
         self.gpids = GpidAllocator()
+        # agent-group -> org assignment (reference: controller/db org/team
+        # model; redesigned as group-level scoping — the group is already
+        # the config-routing identity, so it is the tenancy boundary too).
+        # Unassigned groups belong to the default org 1.
+        self._orgs: dict[str, int] = {}
+        self._orgs_lock = threading.Lock()
         from deepflow_tpu.server.prom_encoder import PromEncoder
         self.prom_encoder = PromEncoder()
         self.commands = CommandQueue()
@@ -494,6 +507,23 @@ class Controller:
 
         loop.call_soon_threadsafe(_notify)
 
+    def assign_org(self, group: str, org_id: int) -> None:
+        """Assign an agent group to an org (takes effect on the agents'
+        next platform sync). org 1 assignments just clear the entry."""
+        with self._orgs_lock:
+            if int(org_id) == 1:
+                self._orgs.pop(group, None)
+            else:
+                self._orgs[group] = int(org_id)
+
+    def org_of_group(self, group: str) -> int:
+        with self._orgs_lock:
+            return self._orgs.get(group, 1)
+
+    def org_assignments(self) -> dict:
+        with self._orgs_lock:
+            return dict(self._orgs)
+
     def _ingest_platform(self, agent_id: int, p: pb.PlatformData) -> None:
         """Genesis upload -> platform snapshot + ingester tag table."""
         with self._platform_lock:
@@ -511,6 +541,7 @@ class Controller:
             tpu_pod=p.tpu_pod_name,
             tpu_worker=int(p.tpu_worker_id or 0),
             slice_id=p.devices[0].slice_id if p.devices else 0,
+            org_id=self.org_of_group(self.registry.group_of(agent_id)),
         ))
 
     def _merged_platform_locked(self) -> pb.PlatformData:

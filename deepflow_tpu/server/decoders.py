@@ -314,7 +314,7 @@ class FlowLogDecoder(Decoder):
         (reference: grpc_platformdata.go QueryIPV4Infos per-side fill).
         pod0/pod1 may be lists or a scalar broadcast."""
         def aslist(p):
-            return p if isinstance(p, list) else [p] * n
+            return _aslist(p, n)
         cols: dict = {}
         if self.gpid_table is None:
             cols["gprocess_id_0"] = agent_g0

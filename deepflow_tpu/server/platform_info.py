@@ -23,9 +23,11 @@ class AgentInfo:
     tpu_pod: str = ""
     tpu_worker: int = 0
     slice_id: int = 0
+    org_id: int = 1   # multi-tenancy scope; 1 = default org
 
     def tags(self) -> dict:
         return {
+            "org_id": self.org_id,
             "agent_id": self.agent_id,
             "host_id": self.host_id,
             "host": self.host,
@@ -72,7 +74,9 @@ class PlatformInfoTable:
     def tags_for(self, agent_id: int) -> dict:
         info = self.query(agent_id)
         if info is _EMPTY:
-            return {"agent_id": agent_id}
+            # unknown agents land in the default org (single-org setups
+            # never configure orgs and must keep working unchanged)
+            return {"agent_id": agent_id, "org_id": 1}
         return info.tags()
 
     def set_clock_offset(self, agent_id: int, offset_ns: int) -> None:

@@ -124,6 +124,21 @@ class QuerierAPI:
         if table is None:
             raise qengine.QueryError(
                 f"no such table {table_name!r}; known: {self.db.tables()}")
+        org = body.get("org_id")
+        if org is not None:
+            if "org_id" not in table.columns:
+                # silently dropping the filter would hand one tenant
+                # another tenant's rows — refuse instead
+                raise qengine.QueryError(
+                    f"table {table.name!r} has no org scoping; "
+                    "query it without org_id")
+            # tenancy enforcement OUTSIDE the user's SQL text: AND the
+            # org filter into the parsed AST (reference: ORG_ID threading
+            # through the querier)
+            cond = qsql.BinOp("=", qsql.Col("org_id"),
+                              qsql.Lit(int(org)))
+            select.where = (cond if select.where is None
+                            else qsql.BinOp("AND", select.where, cond))
         result = qengine.execute(table, select)
         return {"result": result.to_dict(), "debug": {"table": table.name}}
 
@@ -286,6 +301,25 @@ class QuerierAPI:
         cols = res.columns
         return [dict(zip(cols, row)) for row in res.values]
 
+    def orgs_api(self, body: dict) -> dict:
+        """Org/team scoping admin (reference: controller/db org model):
+        assign an agent group to an org; list assignments. Scoped reads
+        pass org_id on /v1/query and the PromQL endpoints."""
+        if self.controller is None:
+            raise qengine.QueryError("no controller")
+        action = body.get("action", "list")
+        if action == "assign":
+            group = body.get("group", "default")
+            try:
+                org = int(body.get("org_id", 1))
+            except (TypeError, ValueError):
+                raise qengine.QueryError("org_id must be an integer")
+            if org < 1 or org > 0xFFFF:
+                raise qengine.QueryError("org_id out of range (1..65535)")
+            self.controller.assign_org(group, org)
+        return {"orgs": self.controller.org_assignments(),
+                "default_org": 1}
+
     def prom_query_range(self, params: dict) -> dict:
         """GET /prom/api/v1/query_range (reference: querier/app/prometheus,
         router.go:41)."""
@@ -298,7 +332,10 @@ class QuerierAPI:
         except ValueError as e:
             raise qengine.QueryError(f"bad time param: {e}")
         try:
-            result = promql.evaluate(self.db, q, start, end, step)
+            ast = promql.parse(q)
+            if params.get("org_id") is not None:
+                promql.scope_to_org(ast, int(params["org_id"]))
+            result = promql.evaluate(self.db, ast, start, end, step)
         except promql.PromqlError as e:
             return {"status": "error", "errorType": "bad_data",
                     "error": str(e)}
@@ -317,7 +354,10 @@ class QuerierAPI:
         except ValueError as e:
             raise qengine.QueryError(f"bad time param: {e}")
         try:
-            data = promql.evaluate_instant(self.db, q, t)
+            ast = promql.parse(q)
+            if params.get("org_id") is not None:
+                promql.scope_to_org(ast, int(params["org_id"]))
+            data = promql.evaluate_instant(self.db, ast, t)
         except promql.PromqlError as e:
             return {"status": "error", "errorType": "bad_data",
                     "error": str(e)}
@@ -917,6 +957,8 @@ class QuerierHTTP:
                         self._send(200, api.pcaps(body))
                     elif path == "/v1/analyzers":
                         self._send(200, api.analyzers_api(body))
+                    elif path == "/v1/orgs":
+                        self._send(200, api.orgs_api(body))
                     elif path == "/v1/agents/exec":
                         self._send(200, api.agent_exec(body))
                     elif path == "/v1/agent-group-config":

@@ -28,6 +28,12 @@ CLOSE_TYPES = ("unknown", "fin", "rst", "timeout", "forced")
 # Universal tags injected by the ingester on every row
 # (reference: server/libs/grpc/grpc_platformdata.go PlatformInfoTable).
 UNIVERSAL_TAGS = [
+    # multi-tenancy scope (reference: controller/db org model). Default 1:
+    # every writer that doesn't thread an org — server-local sinks like
+    # the resource recorder, integration HTTP ingest, alert events, and
+    # pre-org saved data backfilled at load — lands in the default org,
+    # so org-scoped queries (org_id=1) still see it.
+    C("org_id", "u16", default=1),
     C("agent_id", "u16"),
     C("host_id", "u16"),
     C("host", "str"),

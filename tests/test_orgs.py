@@ -1,0 +1,210 @@
+"""Org/team multi-tenancy scoping (VERDICT r04 next #10): an org_id
+universal tag rides every row; query-time scoping on DF-SQL and PromQL
+isolates tenants; the single default org (1) stays the unconfigured
+behavior.
+
+Reference analog: controller/db org model + ORG_ID threading through
+querier/ingester.
+"""
+
+import json
+import socket
+import time
+import urllib.parse
+import urllib.request
+
+from deepflow_tpu.codec import FrameHeader, MessageType, encode_frame
+from deepflow_tpu.proto import pb
+from deepflow_tpu.server import Server
+from deepflow_tpu.server.platform_info import AgentInfo
+
+
+def _send_l7(server, agent_id, domain):
+    batch = pb.FlowLogBatch()
+    f = batch.l7.add()
+    f.flow_id = agent_id * 100
+    f.key.ip_src = socket.inet_aton("10.0.0.1")
+    f.key.ip_dst = socket.inet_aton("10.0.0.2")
+    f.key.port_src = 1234
+    f.key.port_dst = 443
+    f.key.proto = 1
+    f.l7_protocol = 1
+    f.request_type = "GET"
+    f.request_domain = domain
+    f.start_time_ns = time.time_ns()
+    f.end_time_ns = f.start_time_ns + 1000
+    frame = encode_frame(FrameHeader(MessageType.L7_LOG, agent_id=agent_id),
+                         batch.SerializeToString())
+    s = socket.create_connection(("127.0.0.1", server.ingest_port))
+    s.sendall(frame)
+    s.close()
+
+
+def _post(port, path, body):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    return json.load(urllib.request.urlopen(req))
+
+
+def test_two_org_isolation_l7_and_promql():
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0).start()
+    try:
+        # agent 1 -> org 1 (default), agent 2 -> org 2
+        server.platform.update(AgentInfo(agent_id=1, host="h1"))
+        server.platform.update(AgentInfo(agent_id=2, host="h2", org_id=2))
+        _send_l7(server, 1, "tenant-one.example")
+        _send_l7(server, 2, "tenant-two.example")
+        assert server.wait_for_rows("flow_log.l7_flow_log", 2, timeout=10)
+
+        # DF-SQL scoping: org 2 sees only its rows
+        r2 = _post(server.query_port, "/v1/query/",
+                   {"sql": "SELECT request_domain, org_id FROM "
+                           "flow_log.l7_flow_log", "org_id": 2})["result"]
+        assert [row[0] for row in r2["values"]] == ["tenant-two.example"]
+        assert all(row[1] == 2 for row in r2["values"])
+        r1 = _post(server.query_port, "/v1/query/",
+                   {"sql": "SELECT request_domain FROM "
+                           "flow_log.l7_flow_log", "org_id": 1})["result"]
+        assert [row[0] for row in r1["values"]] == ["tenant-one.example"]
+        # a user WHERE still composes with the enforced scope
+        rw = _post(server.query_port, "/v1/query/",
+                   {"sql": "SELECT request_domain FROM flow_log.l7_flow_log"
+                           " WHERE request_type = 'GET'",
+                    "org_id": 2})["result"]
+        assert len(rw["values"]) == 1
+        # unscoped (default single-org behavior): everything visible
+        ra = _post(server.query_port, "/v1/query/",
+                   {"sql": "SELECT request_domain FROM "
+                           "flow_log.l7_flow_log"})["result"]
+        assert len(ra["values"]) == 2
+
+        # PromQL scoping over application metrics: one Document per org
+        for agent_id, svc in ((1, "svc-one"), (2, "svc-two")):
+            docs = pb.DocumentBatch()
+            d = docs.docs.add()
+            d.timestamp_s = int(time.time())
+            d.interval_s = 1
+            d.tag.ip_src = socket.inet_aton("10.0.0.1")
+            d.tag.ip_dst = socket.inet_aton("10.0.0.2")
+            d.tag.port = 443
+            d.tag.proto = 1
+            d.tag.l7_protocol = 1
+            d.tag.app_service = svc
+            d.app_meter.request = 5
+            d.app_meter.response = 5
+            frame = encode_frame(
+                FrameHeader(MessageType.METRICS, agent_id=agent_id),
+                docs.SerializeToString())
+            s = socket.create_connection(
+                ("127.0.0.1", server.ingest_port))
+            s.sendall(frame)
+            s.close()
+        assert server.wait_for_rows("flow_metrics.application.1s", 2,
+                                    timeout=10)
+        now = int(time.time())
+        q = urllib.parse.quote(
+            "sum by (app_service) "
+            "(count_over_time(flow_metrics_application_request[10m]))")
+        base = (f"http://127.0.0.1:{server.query_port}/prom/api/v1/query"
+                f"?query={q}&time={now + 60}")
+        all_series = json.load(urllib.request.urlopen(base))
+        assert all_series["status"] == "success"
+        names_all = {s["metric"].get("app_service")
+                     for s in all_series["data"]["result"]}
+        assert names_all == {"svc-one", "svc-two"}, all_series
+        org2 = json.load(urllib.request.urlopen(base + "&org_id=2"))
+        assert org2["status"] == "success"
+        names_2 = {s["metric"].get("app_service")
+                   for s in org2["data"]["result"]}
+        assert names_2 == {"svc-two"}, org2
+    finally:
+        server.stop()
+
+
+def test_org_assignment_via_controller_and_api():
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0,
+                    sync_port=0, enable_controller=True).start()
+    try:
+        out = _post(server.query_port, "/v1/orgs",
+                    {"action": "assign", "group": "team-b", "org_id": 7})
+        assert out["orgs"] == {"team-b": 7}
+        assert server.controller.org_of_group("team-b") == 7
+        assert server.controller.org_of_group("default") == 1
+        # reassigning to the default org clears the entry
+        out = _post(server.query_port, "/v1/orgs",
+                    {"action": "assign", "group": "team-b", "org_id": 1})
+        assert out["orgs"] == {}
+    finally:
+        server.stop()
+
+
+def test_promql_org_matcher_scopes_selectors():
+    from deepflow_tpu.query import promql
+    ast = promql.parse(
+        'sum(rate(flow_log__l7_flow_log__request{host="h1"}[1m]))')
+    promql.scope_to_org(ast, 2)
+
+    found = []
+
+    def walk(n):
+        if isinstance(n, promql.VectorSelector):
+            found.append(n)
+        for f in getattr(n, "__dataclass_fields__", {}):
+            v = getattr(n, f)
+            if isinstance(v, list):
+                [walk(x) for x in v if hasattr(x, "__dataclass_fields__")]
+            elif hasattr(v, "__dataclass_fields__"):
+                walk(v)
+    walk(ast)
+    assert found
+    for vs in found:
+        assert ("org_id", "=", "2") in vs.matchers
+        # a user-supplied org_id matcher cannot override the enforced one
+    ast2 = promql.parse('up{org_id="9"}')
+    promql.scope_to_org(ast2, 3)
+    walk2 = []
+
+    def collect(n):
+        if isinstance(n, promql.VectorSelector):
+            walk2.append(n)
+    collect(ast2)
+    if walk2:
+        assert [m for m in walk2[0].matchers if m[0] == "org_id"] == \
+            [("org_id", "=", "3")]
+
+
+def test_scoped_query_on_unscopable_table_refused():
+    """Tables without an org_id column must REJECT scoped queries, never
+    silently return cross-tenant rows."""
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0).start()
+    try:
+        import urllib.error
+        try:
+            _post(server.query_port, "/v1/query/",
+                  {"sql": "SELECT trace_id FROM flow_log.trace_tree",
+                   "org_id": 2})
+            raise AssertionError("scoped query on unscopable table passed")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            assert b"org" in e.read().lower()
+    finally:
+        server.stop()
+
+
+def test_serverside_events_visible_to_default_org():
+    """Recorder/integration rows without an explicit org land in the
+    DEFAULT org (column default 1), so org-1-scoped forensics queries
+    still see them."""
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0).start()
+    try:
+        server.db.table("event.event").append_rows([{
+            "time": time.time_ns(), "event_type": "node-modified",
+            "resource_type": "node", "resource_name": "n1",
+            "description": "ready: True->False", "attrs": "{}"}])
+        r = _post(server.query_port, "/v1/query/",
+                  {"sql": "SELECT event_type, org_id FROM event.event",
+                   "org_id": 1})["result"]
+        assert r["values"] == [["node-modified", 1]]
+    finally:
+        server.stop()
