@@ -43,6 +43,7 @@ class Agent:
         self.dispatcher = None
         self.live_capture = None
         self.sslprobe = None
+        self.memhook = None
         from deepflow_tpu.agent.labeler import AclRule, Labeler
         self.labeler = Labeler()
         self.labeler.load_acls([
@@ -194,6 +195,21 @@ class Agent:
             self.sslprobe = SslProbeListener(
                 self.dispatcher, self.config.sslprobe_sock).start()
             self._components.append("ssl-probe")
+        if self.config.memhook_sock:
+            from deepflow_tpu.agent.memhook import MemHookListener
+
+            def _mem_sink(batch):
+                pid = batch[0].pid if batch else 0
+                try:
+                    with open(f"/proc/{pid}/comm") as f:
+                        name = f.read().strip()
+                except OSError:
+                    name = str(pid)
+                self._profile_sink(batch, process_name=name,
+                                   app_service=name)
+            self.memhook = MemHookListener(
+                _mem_sink, self.config.memhook_sock).start()
+            self._components.append("memhook")
         if self.config.flow.enabled:
             from deepflow_tpu.agent.live_capture import LiveCapture
             # the agent's own telemetry must never be captured (feedback
@@ -266,6 +282,8 @@ class Agent:
             self.integration_proxy.stop()
         if self.sslprobe:
             self.sslprobe.stop()
+        if self.memhook:
+            self.memhook.stop()
         if self.live_capture:
             self.live_capture.stop()
         if self.dispatcher:

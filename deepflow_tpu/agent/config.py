@@ -86,6 +86,9 @@ class AgentConfig:
     # AF_UNIX path for the LD_PRELOAD ssl/syscall probe (pre-encryption L7
     # visibility); "" = disabled
     sslprobe_sock: str = ""
+    # AF_UNIX path for the LD_PRELOAD malloc interposer (out-of-process
+    # allocation flame graphs, libdfmemhook.so); "" = disabled
+    memhook_sock: str = ""
     # agent-side ACLs (reference: policy first_path rules): list of dicts
     # {cidr, port, protocol, action: trace|ignore}
     acls: list = field(default_factory=list)

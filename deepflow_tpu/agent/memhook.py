@@ -1,0 +1,162 @@
+"""Agent side of the malloc-interposer memory profiler: decode sampled
+allocation ledgers from preloaded processes, symbolize OUT of process,
+emit leak-hunting flame samples.
+
+Reference analog: the EE memory profiler's user half
+(ebpf_dispatcher/memory_profile.rs — allocation ledger -> memory flame
+graphs). The wire protocol is produced by native/memhook.cpp; stacks
+arrive as raw PCs and are resolved here against /proc/<pid>/maps + ELF
+symbols (the extprofiler's Symbolizer), so the target pays nothing for
+symbolization.
+
+Emitted samples: event_type "mem-alloc", profiler "memhook",
+value = NET LIVE GROWTH in bytes for the stack during the report window
+(clamped at 0). Summing values over a query range yields net growth in
+that range — churn (alloc+free) nets out, leaks accumulate.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import struct
+import threading
+import time
+
+from deepflow_tpu.agent.profiler import ProfileSample
+
+log = logging.getLogger("df.memhook")
+
+_MAGIC = 0x4D454D48
+_HDR = struct.Struct("<IIIQ")
+_REC_FIXED = struct.Struct("<QQQH")
+_MAX_PCS = 24
+_REC_SIZE = _REC_FIXED.size + _MAX_PCS * 8
+
+
+class MemHookListener:
+    """AF_UNIX datagram listener for libdfmemhook.so reports."""
+
+    def __init__(self, sink, sock_path: str) -> None:
+        self.sink = sink              # sink(list[ProfileSample])
+        self.sock_path = sock_path
+        self._sock: socket.socket | None = None
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        # (pid, stack_hash) -> last seen (alloc_w, free_w) for deltas
+        self._last: dict[tuple, tuple[int, int]] = {}
+        self._symbolizers: dict[int, object] = {}
+        self.stats = {"reports": 0, "records": 0, "samples_emitted": 0,
+                      "symbolize_errors": 0, "dropped_target": 0}
+
+    def start(self) -> "MemHookListener":
+        try:
+            os.unlink(self.sock_path)
+        except OSError:
+            pass
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
+        s.bind(self.sock_path)
+        s.settimeout(0.5)
+        self._sock = s
+        self._thread = threading.Thread(target=self._run,
+                                        name="df-memhook", daemon=True)
+        self._thread.start()
+        log.info("memhook listening on %s", self.sock_path)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2.0)
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None
+        try:
+            os.unlink(self.sock_path)
+        except OSError:
+            pass
+
+    def _symbolizer(self, pid: int):
+        sym = self._symbolizers.get(pid)
+        if sym is None:
+            from deepflow_tpu.agent.extprofiler import Symbolizer
+            sym = self._symbolizers[pid] = Symbolizer(pid)
+        return sym
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                data = self._sock.recv(65536)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                self.handle_datagram(data)
+            except Exception:
+                log.exception("memhook datagram failed")
+
+    def handle_datagram(self, data: bytes) -> int:
+        if len(data) < _HDR.size:
+            return 0
+        magic, pid, n_records, dropped = _HDR.unpack_from(data, 0)
+        if magic != _MAGIC:
+            return 0
+        self.stats["reports"] += 1
+        self.stats["dropped_target"] = int(dropped)
+        try:
+            sym = self._symbolizer(pid)
+            sym.refresh()  # once per datagram: maps parsing is the cost
+        except Exception:
+            self.stats["symbolize_errors"] += 1
+            return 0
+        ts = time.time_ns()
+        batch: list[ProfileSample] = []
+        off = _HDR.size
+        for _ in range(n_records):
+            if off + _REC_SIZE > len(data):
+                break
+            alloc_w, free_w, count, n_pcs = _REC_FIXED.unpack_from(
+                data, off)
+            pcs = struct.unpack_from(
+                f"<{min(n_pcs, _MAX_PCS)}Q", data, off + _REC_FIXED.size)
+            off += _REC_SIZE
+            self.stats["records"] += 1
+            key = (pid, pcs)
+            last_a, last_f = self._last.get(key, (0, 0))
+            self._last[key] = (alloc_w, free_w)
+            live_delta = (alloc_w - free_w) - (last_a - last_f)
+            if live_delta <= 0:
+                continue  # churn nets out; shrinking stacks aren't leaks
+            try:
+                frames = [sym.resolve(int(a)) for a in reversed(pcs)]
+            except Exception:
+                self.stats["symbolize_errors"] += 1
+                continue
+            batch.append(ProfileSample(
+                timestamp_ns=ts, pid=pid, tid=pid, thread_name="",
+                stack=";".join(frames), count=max(1, int(count)),
+                value_us=int(live_delta),
+                event_type="mem-alloc", profiler="memhook"))
+        if batch:
+            self.stats["samples_emitted"] += len(batch)
+            try:
+                self.sink(batch)
+            except Exception:
+                pass  # a failing sink must never kill the listener
+        if len(self._last) > 65536:
+            self._evict_dead()
+        return len(batch)
+
+    def _evict_dead(self) -> None:
+        """Drop baselines and symbolizers of EXITED pids only — clearing
+        live pids' baselines would re-emit their whole cumulative growth
+        as a spurious leak spike on the next report. Live entries are
+        bounded (the interposer tracks <= 2048 stacks per process)."""
+        alive = {pid for pid, _ in self._last if
+                 os.path.exists(f"/proc/{pid}")}
+        self._last = {k: v for k, v in self._last.items()
+                      if k[0] in alive}
+        self._symbolizers = {p: s for p, s in self._symbolizers.items()
+                             if p in alive}

@@ -32,34 +32,56 @@ def main() -> int:
                              "ssl/syscall interposer into CHILD processes "
                              "this workload spawns (and configure the "
                              "in-process agent to receive its events)")
+    parser.add_argument("--mem-profile", action="store_true",
+                        help="allocation flame graphs: LD_PRELOAD the "
+                             "sampling malloc interposer into CHILD "
+                             "processes (reports land as mem-alloc "
+                             "profile events)")
     parser.add_argument("target")
     parser.add_argument("args", nargs=argparse.REMAINDER)
     opts = parser.parse_args()
 
     sslprobe_sock = ""
-    if opts.ssl_probe:
+    memhook_sock = ""
+    if opts.ssl_probe or opts.mem_profile:
         import os
         import tempfile
-        so = os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "native", "libdfsslprobe.so")
-        if os.path.exists(so):
-            # private 0700 dir: a predictable /tmp name could be squatted
-            sslprobe_sock = os.path.join(
-                tempfile.mkdtemp(prefix="dfprobe-"), "probe.sock")
+        native_dir = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "native")
+        # private 0700 dir: a predictable /tmp name could be squatted
+        sock_dir = tempfile.mkdtemp(prefix="dfprobe-")
+        preloads = []
+        if opts.ssl_probe:
+            so = os.path.join(native_dir, "libdfsslprobe.so")
+            if os.path.exists(so):
+                sslprobe_sock = os.path.join(sock_dir, "probe.sock")
+                preloads.append(so)
+                os.environ["DF_SSLPROBE_SOCK"] = sslprobe_sock
+                if opts.io_probe_ms > 0:
+                    os.environ["DF_IOPROBE_NS"] = str(
+                        int(opts.io_probe_ms * 1e6))
+            else:
+                print("deepflow-run: libdfsslprobe.so not built; "
+                      "--ssl-probe disabled", file=sys.stderr)
+        if opts.mem_profile:
+            so = os.path.join(native_dir, "libdfmemhook.so")
+            if os.path.exists(so):
+                memhook_sock = os.path.join(sock_dir, "memhook.sock")
+                preloads.append(so)
+                os.environ["DF_MEMHOOK_SOCK"] = memhook_sock
+            else:
+                print("deepflow-run: libdfmemhook.so not built; "
+                      "--mem-profile disabled", file=sys.stderr)
+        if preloads:
             prior = os.environ.get("LD_PRELOAD", "")
-            os.environ["LD_PRELOAD"] = f"{so}:{prior}" if prior else so
-            os.environ["DF_SSLPROBE_SOCK"] = sslprobe_sock
-            if opts.io_probe_ms > 0:
-                os.environ["DF_IOPROBE_NS"] = str(
-                    int(opts.io_probe_ms * 1e6))
-        else:
-            print("deepflow-run: libdfsslprobe.so not built; "
-                  "--ssl-probe disabled", file=sys.stderr)
+            chain = ":".join(preloads)
+            os.environ["LD_PRELOAD"] = (f"{chain}:{prior}" if prior
+                                        else chain)
 
     from deepflow_tpu.agent.agent import attach, detach
     attach(app_service=opts.service or opts.target,
            servers=[opts.server], controller=opts.controller,
-           sslprobe_sock=sslprobe_sock)
+           sslprobe_sock=sslprobe_sock, memhook_sock=memhook_sock)
 
     sys.argv = [opts.target] + opts.args
     try:

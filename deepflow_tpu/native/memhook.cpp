@@ -1,0 +1,389 @@
+// LD_PRELOAD malloc interposer: sampled allocation ledger -> memory
+// flame graphs for processes OUTSIDE the agent.
+//
+// Reference analog: the EE memory profiler
+// (agent/src/ebpf_dispatcher/memory_profile.rs + uprobes on allocator
+// entry points, extended.h MEMORY flag) — an allocation ledger keyed by
+// stack, frees credited back, periodic reports of net-live bytes.
+// Redesign without eBPF: symbol interposition in the target's own
+// address space (the sslprobe pattern), byte-rate SAMPLING so the hot
+// path costs a thread-local counter bump in the common case, raw PCs
+// shipped over AF_UNIX datagrams, symbolization done OUT of process by
+// the agent (/proc/<pid>/maps + its ELF symbolizer).
+//
+// Build: part of `make -C deepflow_tpu/native` -> libdfmemhook.so.
+// Activate: LD_PRELOAD=libdfmemhook.so DF_MEMHOOK_SOCK=/path cmd...
+// Knobs: DF_MEMHOOK_SAMPLE (bytes between samples, default 1 MiB),
+//        DF_MEMHOOK_INTERVAL (report seconds, default 5).
+
+#define _GNU_SOURCE 1
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+#include <dlfcn.h>
+#include <execinfo.h>
+#include <pthread.h>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+namespace {
+
+using malloc_t = void* (*)(size_t);
+using free_t = void (*)(void*);
+using calloc_t = void* (*)(size_t, size_t);
+using realloc_t = void* (*)(void*, size_t);
+
+malloc_t real_malloc;
+free_t real_free;
+calloc_t real_calloc;
+realloc_t real_realloc;
+
+// dlsym itself calloc()s: serve those from a static arena until the
+// real symbols are resolved
+char boot_arena[16384];
+size_t boot_used;
+
+bool inited;
+uint64_t sample_bytes = 1 << 20;
+unsigned report_interval_s = 5;
+int sock_fd = -1;
+uint32_t my_pid;
+
+__thread uint64_t tl_since_sample;
+__thread int tl_in_hook;  // reentrancy guard (backtrace may allocate)
+
+constexpr int kMaxPcs = 24;
+constexpr int kStackSlots = 2048;   // distinct allocation sites
+constexpr int kLiveSlots = 1 << 15; // sampled live allocations
+
+struct StackRec {
+    uint64_t hash = 0;
+    int n_pcs = 0;
+    void* pcs[kMaxPcs];
+    uint64_t alloc_w = 0;    // sampled (weighted) bytes allocated
+    uint64_t free_w = 0;     // sampled bytes later freed
+    uint64_t alloc_count = 0;
+    bool dirty = false;
+};
+
+struct LiveRec {
+    void* ptr = nullptr;     // nullptr = empty, kTombstone = deleted
+    uint32_t stack_idx = 0;
+    uint64_t weight = 0;
+};
+
+void* const kTombstone = (void*)(uintptr_t)1;
+
+StackRec stacks[kStackSlots];
+LiveRec live[kLiveSlots];
+pthread_mutex_t ledger_mu = PTHREAD_MUTEX_INITIALIZER;
+uint64_t dropped_samples;
+
+uint64_t hash_pcs(void* const* pcs, int n) {
+    uint64_t h = 0xCBF29CE484222325ULL;
+    for (int i = 0; i < n; i++) {
+        h ^= (uint64_t)pcs[i];
+        h *= 0x100000001B3ULL;
+    }
+    return h ? h : 1;
+}
+
+int stack_slot(void* const* pcs, int n, uint64_t h) {
+    int idx = (int)(h % kStackSlots);
+    for (int probe = 0; probe < 64; probe++) {
+        StackRec& s = stacks[idx];
+        if (s.hash == h && s.n_pcs == n &&
+            !memcmp(s.pcs, pcs, n * sizeof(void*)))
+            return idx;
+        if (s.hash == 0) {
+            s.hash = h;
+            s.n_pcs = n;
+            memcpy(s.pcs, pcs, n * sizeof(void*));
+            return idx;
+        }
+        idx = (idx + 1) % kStackSlots;
+    }
+    return -1;  // table full: drop
+}
+
+void record_sample(void* ptr, uint64_t weight) {
+    void* pcs[kMaxPcs + 4];
+    int n = backtrace(pcs, kMaxPcs + 4);
+    // skip our own frames (record_sample, hook, plt)
+    int skip = 2;
+    if (n <= skip) return;
+    void** upcs = pcs + skip;
+    int un = n - skip;
+    if (un > kMaxPcs) un = kMaxPcs;
+    uint64_t h = hash_pcs(upcs, un);
+    pthread_mutex_lock(&ledger_mu);
+    int sidx = stack_slot(upcs, un, h);
+    if (sidx < 0) {
+        dropped_samples++;
+        pthread_mutex_unlock(&ledger_mu);
+        return;
+    }
+    StackRec& s = stacks[sidx];
+    s.alloc_w += weight;
+    s.alloc_count++;
+    s.dirty = true;
+    // track the pointer so a later free credits this stack (tombstones
+    // keep probe chains intact for colliding pointers; inserts reuse
+    // the first tombstone seen)
+    uint64_t lh = (uint64_t)ptr * 0x9E3779B97F4A7C15ULL;
+    int lidx = (int)(lh % kLiveSlots);
+    int reuse = -1;
+    for (int probe = 0; probe < 32; probe++) {
+        LiveRec& l = live[lidx];
+        if (l.ptr == ptr) {
+            reuse = lidx;
+            break;
+        }
+        if (l.ptr == kTombstone) {
+            if (reuse < 0) reuse = lidx;
+        } else if (l.ptr == nullptr) {
+            if (reuse < 0) reuse = lidx;
+            break;
+        }
+        lidx = (lidx + 1) % kLiveSlots;
+    }
+    if (reuse >= 0) {
+        live[reuse].ptr = ptr;
+        live[reuse].stack_idx = (uint32_t)sidx;
+        live[reuse].weight = weight;
+    }
+    pthread_mutex_unlock(&ledger_mu);  // table full: alloc-only stats
+}
+
+// lock-free pre-check: sampled pointers are ~1 per sample_bytes of
+// traffic, so the vast majority of frees must skip the ledger mutex.
+// Racy reads are benign: a false hit re-checks under the lock; a miss
+// during a concurrent insert loses one free credit (sampling noise).
+bool maybe_sampled(void* ptr) {
+    uint64_t lh = (uint64_t)ptr * 0x9E3779B97F4A7C15ULL;
+    int lidx = (int)(lh % kLiveSlots);
+    for (int probe = 0; probe < 32; probe++) {
+        void* p = __atomic_load_n(&live[lidx].ptr, __ATOMIC_RELAXED);
+        if (p == ptr) return true;
+        if (p == nullptr) return false;
+        lidx = (lidx + 1) % kLiveSlots;
+    }
+    return false;
+}
+
+void record_free(void* ptr) {
+    if (!maybe_sampled(ptr)) return;
+    uint64_t lh = (uint64_t)ptr * 0x9E3779B97F4A7C15ULL;
+    int lidx = (int)(lh % kLiveSlots);
+    pthread_mutex_lock(&ledger_mu);
+    for (int probe = 0; probe < 32; probe++) {
+        LiveRec& l = live[lidx];
+        if (l.ptr == ptr) {
+            StackRec& s = stacks[l.stack_idx];
+            s.free_w += l.weight;
+            s.dirty = true;
+            l.ptr = kTombstone;  // chain stays walkable for collisions
+            break;
+        }
+        if (l.ptr == nullptr) break;
+        lidx = (lidx + 1) % kLiveSlots;
+    }
+    pthread_mutex_unlock(&ledger_mu);
+}
+
+void maybe_sample(void* ptr, size_t size) {
+    if (!inited || ptr == nullptr || tl_in_hook) return;
+    tl_since_sample += size;
+    if (tl_since_sample < sample_bytes) return;
+    uint64_t weight = tl_since_sample;
+    tl_since_sample = 0;
+    tl_in_hook = 1;
+    record_sample(ptr, weight);
+    tl_in_hook = 0;
+}
+
+// -- report thread -----------------------------------------------------------
+
+#pragma pack(push, 1)
+struct WireHeader {               // must match MEMHOOK dtypes (memhook.py)
+    uint32_t magic;               // 0x4D454D48 "MEMH"
+    uint32_t pid;
+    uint32_t n_records;
+    uint64_t dropped;
+};
+struct WireRecord {
+    uint64_t alloc_w;
+    uint64_t free_w;
+    uint64_t alloc_count;
+    uint16_t n_pcs;
+    uint64_t pcs[kMaxPcs];        // first n_pcs valid
+};
+#pragma pack(pop)
+
+void send_report() {
+    if (sock_fd < 0) return;
+    // datagrams of up to ~15 records each
+    constexpr int kPerDgram = 15;
+    static char buf[sizeof(WireHeader) + kPerDgram * sizeof(WireRecord)];
+    WireRecord recs[kPerDgram];
+    int n = 0;
+    pthread_mutex_lock(&ledger_mu);
+    for (int i = 0; i < kStackSlots; i++) {
+        StackRec& s = stacks[i];
+        if (!s.hash || !s.dirty) continue;
+        WireRecord& r = recs[n];
+        r.alloc_w = s.alloc_w;
+        r.free_w = s.free_w;
+        r.alloc_count = s.alloc_count;
+        r.n_pcs = (uint16_t)s.n_pcs;
+        for (int p = 0; p < s.n_pcs; p++)
+            r.pcs[p] = (uint64_t)s.pcs[p];
+        s.dirty = false;
+        if (++n == kPerDgram) {
+            pthread_mutex_unlock(&ledger_mu);
+            WireHeader h{0x4D454D48, my_pid, (uint32_t)n, dropped_samples};
+            memcpy(buf, &h, sizeof(h));
+            memcpy(buf + sizeof(h), recs, n * sizeof(WireRecord));
+            send(sock_fd, buf,
+                 sizeof(h) + n * sizeof(WireRecord), MSG_DONTWAIT);
+            n = 0;
+            pthread_mutex_lock(&ledger_mu);
+        }
+    }
+    pthread_mutex_unlock(&ledger_mu);
+    if (n) {
+        WireHeader h{0x4D454D48, my_pid, (uint32_t)n, dropped_samples};
+        memcpy(buf, &h, sizeof(h));
+        memcpy(buf + sizeof(h), recs, n * sizeof(WireRecord));
+        send(sock_fd, buf, sizeof(h) + n * sizeof(WireRecord),
+             MSG_DONTWAIT);
+    }
+}
+
+void* report_main(void*) {
+    for (;;) {
+        sleep(report_interval_s);
+        tl_in_hook = 1;  // reporter's own allocations are not samples
+        send_report();
+        tl_in_hook = 0;
+    }
+    return nullptr;
+}
+
+__attribute__((constructor)) void memhook_init() {
+    real_malloc = (malloc_t)dlsym(RTLD_NEXT, "malloc");
+    real_free = (free_t)dlsym(RTLD_NEXT, "free");
+    real_calloc = (calloc_t)dlsym(RTLD_NEXT, "calloc");
+    real_realloc = (realloc_t)dlsym(RTLD_NEXT, "realloc");
+    my_pid = (uint32_t)getpid();
+    const char* s = getenv("DF_MEMHOOK_SAMPLE");
+    if (s && atoll(s) > 0) sample_bytes = (uint64_t)atoll(s);
+    const char* iv = getenv("DF_MEMHOOK_INTERVAL");
+    if (iv && atoi(iv) > 0) report_interval_s = (unsigned)atoi(iv);
+    // prime backtrace: its first call dlopens libgcc (allocates)
+    tl_in_hook = 1;
+    void* prime[4];
+    backtrace(prime, 4);
+    tl_in_hook = 0;
+    const char* path = getenv("DF_MEMHOOK_SOCK");
+    if (path && *path) {
+        sock_fd = socket(AF_UNIX, SOCK_DGRAM, 0);
+        if (sock_fd >= 0) {
+            sockaddr_un addr{};
+            addr.sun_family = AF_UNIX;
+            strncpy(addr.sun_path, path, sizeof(addr.sun_path) - 1);
+            if (connect(sock_fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+                close(sock_fd);
+                sock_fd = -1;
+            }
+        }
+    }
+    if (sock_fd >= 0) {
+        pthread_t t;
+        pthread_create(&t, nullptr, report_main, nullptr);
+        pthread_detach(t);
+    }
+    inited = true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* malloc(size_t size) {
+    if (!real_malloc) {  // pre-init (dlsym bootstrap)
+        void* p = boot_arena + boot_used;
+        boot_used += (size + 15) & ~(size_t)15;
+        return boot_used <= sizeof(boot_arena) ? p : nullptr;
+    }
+    void* p = real_malloc(size);
+    maybe_sample(p, size);
+    return p;
+}
+
+void* calloc(size_t n, size_t size) {
+    if (!real_calloc) {
+        size_t total = n * size;
+        void* p = boot_arena + boot_used;
+        boot_used += (total + 15) & ~(size_t)15;
+        if (boot_used > sizeof(boot_arena)) return nullptr;
+        memset(p, 0, total);
+        return p;
+    }
+    void* p = real_calloc(n, size);
+    maybe_sample(p, n * size);
+    return p;
+}
+
+void* realloc(void* old, size_t size) {
+    bool old_in_arena =
+        old >= (void*)boot_arena &&
+        old < (void*)(boot_arena + sizeof(boot_arena));
+    if (!real_realloc) {
+        // pre-init: behave like malloc from the bootstrap arena (old is
+        // either NULL or itself an arena block; arena blocks never move)
+        void* p = boot_arena + boot_used;
+        boot_used += (size + 15) & ~(size_t)15;
+        if (boot_used > sizeof(boot_arena)) return nullptr;
+        if (old_in_arena) {
+            size_t avail =
+                (size_t)((char*)boot_arena + sizeof(boot_arena) -
+                         (char*)old);
+            memcpy(p, old, size < avail ? size : avail);
+        }
+        return p;
+    }
+    if (old_in_arena) {
+        // a bootstrap block must never reach the real allocator: copy it
+        // into a real allocation (size of the old block is unknown, but
+        // the whole arena is readable — copy up to the requested size)
+        void* p = real_malloc(size);
+        if (p) {
+            size_t avail =
+                (size_t)((char*)boot_arena + sizeof(boot_arena) -
+                         (char*)old);
+            memcpy(p, old, size < avail ? size : avail);
+        }
+        maybe_sample(p, size);
+        return p;
+    }
+    if (inited && old && !tl_in_hook) record_free(old);
+    void* p = real_realloc(old, size);
+    maybe_sample(p, size);
+    return p;
+}
+
+void free(void* p) {
+    if (p >= (void*)boot_arena &&
+        p < (void*)(boot_arena + sizeof(boot_arena)))
+        return;  // bootstrap arena is never freed
+    if (!real_free) return;
+    if (inited && p && !tl_in_hook) record_free(p);
+    real_free(p);
+}
+
+}  // extern "C"

@@ -1,0 +1,149 @@
+"""Out-of-process allocation profiling (VERDICT r04 missing #4): the
+LD_PRELOAD malloc interposer samples a target's allocations by byte
+rate, the agent symbolizes raw PCs out of process, and a LEAKING
+function dominates the mem-alloc flame while alloc+free churn nets out.
+
+Reference analog: the EE memory profiler
+(agent/src/ebpf_dispatcher/memory_profile.rs + extended.h MEMORY flag).
+"""
+
+import os
+import socket
+import subprocess
+import tempfile
+import textwrap
+import time
+
+import pytest
+
+_SO = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "deepflow_tpu", "native",
+    "libdfmemhook.so")
+
+if not os.path.exists(_SO):
+    from deepflow_tpu import native
+    native.load()  # triggers make
+if not os.path.exists(_SO):
+    pytest.skip("libdfmemhook.so unavailable", allow_module_level=True)
+
+LEAK_C = textwrap.dedent("""
+    #include <stdlib.h>
+    #include <string.h>
+    #include <unistd.h>
+    char* sink[100000];
+    char* volatile churn_sink;
+    int n;
+    __attribute__((noinline)) void leaky_alloc(int sz) {
+        sink[n % 100000] = malloc(sz);
+        memset(sink[n % 100000], 1, sz);
+        n++;
+    }
+    __attribute__((noinline)) void churn_alloc(int sz) {
+        churn_sink = malloc(sz);
+        memset(churn_sink, 2, sz);
+        free(churn_sink);
+    }
+    int main() {
+        for (;;) {
+            leaky_alloc(4096);
+            churn_alloc(8192);
+            usleep(200);
+        }
+    }
+""")
+
+
+@pytest.fixture(scope="module")
+def leak_binary(tmp_path_factory):
+    d = tmp_path_factory.mktemp("leak")
+    src = d / "leak.c"
+    src.write_text(LEAK_C)
+    exe = d / "leak"
+    subprocess.run(["gcc", "-O1", "-fno-omit-frame-pointer", "-o",
+                    str(exe), str(src)], check=True)
+    return str(exe)
+
+
+def _spawn_hooked(exe, sock_path, sample=64 << 10, interval=1):
+    env = dict(os.environ)
+    env["LD_PRELOAD"] = _SO
+    env["DF_MEMHOOK_SOCK"] = sock_path
+    env["DF_MEMHOOK_SAMPLE"] = str(sample)
+    env["DF_MEMHOOK_INTERVAL"] = str(interval)
+    return subprocess.Popen([exe], env=env,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+
+
+def test_listener_resolves_leaking_stack(leak_binary):
+    from deepflow_tpu.agent.memhook import MemHookListener
+    sock_path = os.path.join(tempfile.mkdtemp(prefix="df-mh-"), "m.sock")
+    batches = []
+    lst = MemHookListener(batches.append, sock_path).start()
+    child = _spawn_hooked(leak_binary, sock_path)
+    try:
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            time.sleep(0.5)
+            if any("leaky_alloc" in s.stack
+                   for b in batches for s in b):
+                break
+    finally:
+        child.kill()
+        lst.stop()
+    leak_bytes = sum(s.value_us for b in batches for s in b
+                     if "leaky_alloc" in s.stack)
+    churn_bytes = sum(s.value_us for b in batches for s in b
+                      if "churn_alloc" in s.stack)
+    assert leak_bytes > 1 << 20, f"leak not attributed: {leak_bytes}"
+    # churn allocs are freed within the window: net live growth ~0
+    assert churn_bytes < leak_bytes / 4, (churn_bytes, leak_bytes)
+    samples = [s for b in batches for s in b]
+    assert all(s.event_type == "mem-alloc" and s.profiler == "memhook"
+               for s in samples)
+    assert all(s.pid == child.pid for s in samples)
+
+
+def test_memhook_ships_to_store(leak_binary):
+    """Full path: preloaded leaker -> agent listener -> server profile
+    table -> flame tree shows the leaking function."""
+    from deepflow_tpu.agent.agent import Agent
+    from deepflow_tpu.agent.config import AgentConfig
+    from deepflow_tpu.server import Server
+
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0).start()
+    sock_path = os.path.join(tempfile.mkdtemp(prefix="df-mh-"), "m.sock")
+    agent = None
+    child = None
+    try:
+        cfg = AgentConfig()
+        cfg.sender.servers = [("127.0.0.1", server.ingest_port)]
+        cfg.profiler.enabled = False
+        cfg.tpuprobe.enabled = False
+        cfg.guard.enabled = False
+        cfg.memhook_sock = sock_path
+        agent = Agent(cfg).start()
+        assert agent.memhook is not None
+        child = _spawn_hooked(leak_binary, sock_path)
+        deadline = time.monotonic() + 25
+        from deepflow_tpu.query import execute
+        t = server.db.table("profile.in_process_profile")
+        found = False
+        while time.monotonic() < deadline and not found:
+            time.sleep(0.5)
+            if len(t) == 0:
+                continue
+            r = execute(t, "SELECT stack, value FROM t "
+                           "WHERE profiler = 'memhook'")
+            found = any("leaky_alloc" in row[0] for row in r.values)
+        assert found, "leak stack never reached the store"
+        r = execute(t, "SELECT process_name, Sum(value) AS b FROM t "
+                       "WHERE profiler = 'memhook' GROUP BY process_name")
+        assert r.values and r.values[0][0] == "leak"
+        assert r.values[0][1] > 0
+    finally:
+        if child:
+            child.kill()
+        if agent:
+            agent.stop()
+        server.stop()
