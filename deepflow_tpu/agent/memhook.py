@@ -46,6 +46,7 @@ class MemHookListener:
         self._stop = threading.Event()
         # (pid, stack_hash) -> last seen (alloc_w, free_w) for deltas
         self._last: dict[tuple, tuple[int, int]] = {}
+        self._next_evict = 0.0
         self._symbolizers: dict[int, object] = {}
         self.stats = {"reports": 0, "records": 0, "samples_emitted": 0,
                       "symbolize_errors": 0, "dropped_target": 0}
@@ -145,7 +146,12 @@ class MemHookListener:
                 self.sink(batch)
             except Exception:
                 pass  # a failing sink must never kill the listener
-        if len(self._last) > 65536:
+        if len(self._last) > 65536 and \
+                time.monotonic() >= self._next_evict:
+            # rate-limited: when every pid is alive there is nothing to
+            # evict, and rescanning per datagram would burn the listener
+            # thread on /proc stats
+            self._next_evict = time.monotonic() + 30.0
             self._evict_dead()
         return len(batch)
 
@@ -154,8 +160,10 @@ class MemHookListener:
         live pids' baselines would re-emit their whole cumulative growth
         as a spurious leak spike on the next report. Live entries are
         bounded (the interposer tracks <= 2048 stacks per process)."""
-        alive = {pid for pid, _ in self._last if
-                 os.path.exists(f"/proc/{pid}")}
+        pids = {pid for pid, _ in self._last}
+        alive = {pid for pid in pids if os.path.exists(f"/proc/{pid}")}
+        if alive == pids:
+            return
         self._last = {k: v for k, v in self._last.items()
                       if k[0] in alive}
         self._symbolizers = {p: s for p, s in self._symbolizers.items()

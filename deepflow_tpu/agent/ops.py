@@ -171,13 +171,36 @@ class CommandRegistry:
                 "bytes_gz": len(up.pcap_gz)}
 
     def _upgrade(self, args):
-        """OTA analog: drain and re-exec, picking up updated code from disk
-        (reference swaps the binary then restarts, agent.proto:9)."""
+        """OTA upgrade (reference: agent.proto:9 Upgrade stream +
+        cli/ctl/agent.go:135 repo rollout). Two modes:
+
+        - no version arg: drain and re-exec, picking up updated code
+          already on disk.
+        - `version=vX` arg: DOWNLOAD that package from the controller
+          repo over the sync plane, verify its sha256, unpack it into a
+          versioned directory, and re-exec with the new tree FIRST on
+          PYTHONPATH — binary distribution, not just restart.
+        """
         if "dry-run" in args:
             return {"upgrading": False, "dry_run": True, "argv": sys.argv}
+        version = ""
+        for a in args:
+            if a.startswith("version="):
+                version = a.split("=", 1)[1]
+        env_extra: dict[str, str] = {}
+        staged = None
+        if version:
+            try:
+                staged = self._stage_package(version)
+            except Exception as e:  # noqa: BLE001 - report, don't die
+                return {"upgrading": False, "error": str(e)}
+            prior = os.environ.get("PYTHONPATH", "")
+            env_extra["PYTHONPATH"] = (f"{staged}:{prior}" if prior
+                                       else staged)
 
         def _reexec():
-            log.warning("upgrade: re-exec %s", sys.argv)
+            log.warning("upgrade: re-exec %s (staged=%s)", sys.argv,
+                        staged)
             sync = getattr(self.agent, "synchronizer", None)
             if sync is not None:
                 try:
@@ -188,10 +211,56 @@ class CommandRegistry:
                 self.agent.stop()
             except Exception:
                 pass
+            os.environ.update(env_extra)
             self._execv(sys.executable, [sys.executable] + sys.argv)
 
         threading.Timer(0.5, _reexec).start()
-        return {"upgrading": True, "argv": sys.argv}
+        return {"upgrading": True, "argv": sys.argv,
+                "version": version or None, "staged": staged}
+
+    def _stage_package(self, version: str) -> str:
+        """Fetch + verify + unpack a repo package; returns the directory
+        to prepend to PYTHONPATH."""
+        import hashlib
+        import tarfile
+        import tempfile
+
+        sync = getattr(self.agent, "synchronizer", None)
+        if sync is None:
+            raise RuntimeError("no controller connection for OTA fetch")
+        resp = sync.fetch_package("agent", version)
+        if not resp.found:
+            raise RuntimeError(f"package agent@{version} not in repo")
+        sha = hashlib.sha256(resp.data).hexdigest()
+        if sha != resp.sha256:
+            raise RuntimeError(
+                f"package digest mismatch: {sha} != {resp.sha256}")
+        base = os.environ.get("DF_UPGRADE_DIR") or os.path.join(
+            tempfile.gettempdir(), "df-agent-versions")
+        dest = os.path.join(base, resp.version)
+        staging = dest + ".staging"
+        import shutil
+        shutil.rmtree(staging, ignore_errors=True)
+        os.makedirs(staging, exist_ok=True)
+        import io
+        with tarfile.open(fileobj=io.BytesIO(resp.data), mode="r:gz") as t:
+            # refuse path traversal / links (an OTA package is trusted
+            # code by definition, but a corrupted archive must not write
+            # outside its version directory)
+            for m in t.getmembers():
+                p = os.path.normpath(m.name)
+                if p.startswith("..") or os.path.isabs(p) or \
+                        m.issym() or m.islnk():
+                    raise RuntimeError(f"unsafe member {m.name!r}")
+            try:  # belt-and-braces on 3.12+; manual checks above are
+                # the real guard (filter= absent before 3.10.12/3.11.4)
+                t.extractall(staging, filter="data")
+            except TypeError:
+                t.extractall(staging)
+        shutil.rmtree(dest, ignore_errors=True)
+        os.replace(staging, dest)
+        log.warning("upgrade: staged agent@%s at %s", resp.version, dest)
+        return dest
 
     # test seam: replaced in tests so an 'upgrade' never re-execs pytest
     _execv = staticmethod(os.execv)

@@ -26,6 +26,7 @@ _NTP = "/deepflow_tpu.Synchronizer/Ntp"
 _GPID = "/deepflow_tpu.Synchronizer/GpidSync"
 _PUSH = "/deepflow_tpu.Synchronizer/Push"
 _PODMAP = "/deepflow_tpu.Synchronizer/PodMap"
+_PKG = "/deepflow_tpu.Synchronizer/FetchPackage"
 
 
 class Synchronizer:
@@ -57,7 +58,12 @@ class Synchronizer:
         self._ntp_samples: deque[int] = deque(maxlen=5)
 
     def start(self) -> "Synchronizer":
-        self._channel = grpc.insecure_channel(self.addr)
+        # message caps sized for OTA packages (PackageRepo.MAX_PACKAGE
+        # 64MiB + headroom); grpc's 4MiB default would RESOURCE_EXHAUST
+        # any real agent-tree fetch
+        self._channel = grpc.insecure_channel(self.addr, options=[
+            ("grpc.max_receive_message_length", 80 << 20),
+            ("grpc.max_send_message_length", 80 << 20)])
         self._thread = threading.Thread(
             target=self._run, name="df-synchronizer", daemon=True)
         self._thread.start()
@@ -368,6 +374,17 @@ class Synchronizer:
                         src.steps_per_capture = \
                             new.tpuprobe.steps_per_capture
         log.info("applied pushed config v%d", version)
+
+    def fetch_package(self, name: str = "agent",
+                      version: str = "") -> pb.PackageResponse:
+        """OTA download over the sync plane (reference: the Upgrade
+        stream, message/agent.proto:9)."""
+        call = self._channel.unary_unary(
+            _PKG,
+            request_serializer=pb.PackageRequest.SerializeToString,
+            response_deserializer=pb.PackageResponse.FromString)
+        return call(pb.PackageRequest(name=name, version=version),
+                    timeout=60.0)
 
     def gpid_sync(self, entries: list[pb.GpidEntry]) -> pb.GpidSyncResponse:
         req = pb.GpidSyncRequest()

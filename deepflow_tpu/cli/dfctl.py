@@ -108,6 +108,14 @@ def main(argv: list[str] | None = None) -> int:
     p_org.add_argument("--assign", nargs=2, metavar=("GROUP", "ORG_ID"),
                        default=None)
 
+    p_repo = sub.add_parser("repo", help="agent package repo for OTA "
+                                         "rollout (upload/list)")
+    p_repo.add_argument("action", choices=["upload", "list"])
+    p_repo.add_argument("file", nargs="?", help="upload: package tar.gz")
+    p_repo.add_argument("--version", default="",
+                        help="upload: package version tag")
+    p_repo.add_argument("--name", default="agent")
+
     p_flame = sub.add_parser("flame")
     p_flame.add_argument("--service", default=None)
     p_flame.add_argument("--event-type", default="on-cpu")
@@ -322,6 +330,24 @@ def main(argv: list[str] | None = None) -> int:
         print_table(["GROUP", "ORG_ID"],
                     [[g, o] for g, o in rows] or
                     [["(all groups)", out["default_org"]]])
+    elif args.cmd == "repo":
+        if args.action == "upload":
+            if not args.file or not args.version:
+                raise SystemExit("repo upload needs FILE and --version")
+            import base64
+            with open(args.file, "rb") as f:
+                data_b64 = base64.b64encode(f.read()).decode()
+            out = _api(args.server, "/v1/repo",
+                       {"action": "upload", "name": args.name,
+                        "version": args.version, "data_b64": data_b64})
+            u = out["uploaded"]
+            print(f"uploaded {u['name']}@{u['version']} "
+                  f"({u['size']:,}B sha256={u['sha256'][:12]}...)")
+        else:
+            out = _api(args.server, "/v1/repo", {"action": "list"})
+            rows = [[n, v["version"], v["size"], v["sha256"][:12]]
+                    for n, vs in out["packages"].items() for v in vs]
+            print_table(["NAME", "VERSION", "SIZE", "SHA256"], rows)
     elif args.cmd == "promql":
         from urllib.parse import quote
         import time as _time

@@ -274,6 +274,25 @@ void* report_main(void*) {
     return nullptr;
 }
 
+void start_report_thread() {
+    if (sock_fd < 0) return;
+    pthread_t t;
+    pthread_create(&t, nullptr, report_main, nullptr);
+    pthread_detach(t);
+}
+
+// fork safety: the ledger mutex must be consistently held across fork
+// (a child forked while another thread holds it would deadlock on its
+// first sampled malloc), and the child needs its own pid + report
+// thread (threads do not survive fork)
+void atfork_prepare() { pthread_mutex_lock(&ledger_mu); }
+void atfork_parent() { pthread_mutex_unlock(&ledger_mu); }
+void atfork_child() {
+    pthread_mutex_unlock(&ledger_mu);
+    my_pid = (uint32_t)getpid();
+    start_report_thread();
+}
+
 __attribute__((constructor)) void memhook_init() {
     real_malloc = (malloc_t)dlsym(RTLD_NEXT, "malloc");
     real_free = (free_t)dlsym(RTLD_NEXT, "free");
@@ -302,12 +321,12 @@ __attribute__((constructor)) void memhook_init() {
             }
         }
     }
-    if (sock_fd >= 0) {
-        pthread_t t;
-        pthread_create(&t, nullptr, report_main, nullptr);
-        pthread_detach(t);
-    }
+    start_report_thread();
+    pthread_atfork(atfork_prepare, atfork_parent, atfork_child);
     inited = true;
+    if (getenv("DF_MEMHOOK_DEBUG"))
+        fprintf(stderr, "memhook: init pid=%u sock=%d sample=%llu\n",
+                my_pid, sock_fd, (unsigned long long)sample_bytes);
 }
 
 }  // namespace

@@ -191,6 +191,56 @@ class GpidAllocator:
         return None
 
 
+class PackageRepo:
+    """Versioned agent packages for OTA rollout (reference: the repo
+    that `deepflow-ctl repo agent upload` feeds, served to agents over
+    the Upgrade stream — here a unary fetch; packages are MB-scale
+    tarballs of the python package tree)."""
+
+    MAX_PACKAGE = 64 << 20
+    MAX_VERSIONS = 8   # keep the repo bounded; oldest evicted
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # name -> ordered {version: (data, sha256)}
+        self._pkgs: dict[str, dict[str, tuple[bytes, str]]] = {}
+
+    def upload(self, name: str, version: str, data: bytes) -> dict:
+        import hashlib
+        if not version:
+            raise ValueError("version required")
+        if len(data) > self.MAX_PACKAGE:
+            raise ValueError(f"package over {self.MAX_PACKAGE} bytes")
+        sha = hashlib.sha256(data).hexdigest()
+        with self._lock:
+            versions = self._pkgs.setdefault(name, {})
+            versions[version] = (data, sha)
+            while len(versions) > self.MAX_VERSIONS:
+                versions.pop(next(iter(versions)))
+        return {"name": name, "version": version, "sha256": sha,
+                "size": len(data)}
+
+    def get(self, name: str, version: str = ""
+            ) -> tuple[str, bytes, str] | None:
+        with self._lock:
+            versions = self._pkgs.get(name)
+            if not versions:
+                return None
+            if not version:
+                version = next(reversed(versions))  # latest upload
+            entry = versions.get(version)
+            if entry is None:
+                return None
+            return version, entry[0], entry[1]
+
+    def list(self) -> dict:
+        with self._lock:
+            return {name: [{"version": v, "sha256": d[1],
+                            "size": len(d[0])}
+                           for v, d in versions.items()]
+                    for name, versions in self._pkgs.items()}
+
+
 class ConfigStore:
     """Versioned agent-group configs (reference: agent-group config YAML
     validated against the template; push on version bump)."""
@@ -324,6 +374,7 @@ class Controller:
         self._analyzers_managed = False
         self._analyzer_lock = threading.Lock()
         self.configs = ConfigStore()
+        self.packages = PackageRepo()
         self.host = host
         self.port = port
         self._aio_server = None
@@ -590,6 +641,14 @@ class Controller:
         async def ntp_h(request, context):
             return self.Ntp(request, context)
 
+        async def pkg_h(request, context):
+            got = self.packages.get(request.name, request.version)
+            resp = pb.PackageResponse()
+            if got is not None:
+                resp.version, resp.data, resp.sha256 = got
+                resp.found = True
+            return resp
+
         handlers = {
             "Sync": grpc.unary_unary_rpc_method_handler(
                 sync_h,
@@ -611,6 +670,10 @@ class Controller:
                 ntp_h,
                 request_deserializer=pb.NtpRequest.FromString,
                 response_serializer=pb.NtpResponse.SerializeToString),
+            "FetchPackage": grpc.unary_unary_rpc_method_handler(
+                pkg_h,
+                request_deserializer=pb.PackageRequest.FromString,
+                response_serializer=pb.PackageResponse.SerializeToString),
             "Push": grpc.unary_stream_rpc_method_handler(
                 self.Push,
                 request_deserializer=pb.SyncRequest.FromString,
@@ -618,7 +681,9 @@ class Controller:
         }
         generic = grpc.method_handlers_generic_handler(
             "deepflow_tpu.Synchronizer", handlers)
-        server = grpc.aio.server()
+        server = grpc.aio.server(options=[
+            ("grpc.max_receive_message_length", 80 << 20),
+            ("grpc.max_send_message_length", 80 << 20)])
         server.add_generic_rpc_handlers((generic,))
         self.port = server.add_insecure_port(f"{self.host}:{self.port}")
         await server.start()

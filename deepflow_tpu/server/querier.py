@@ -320,6 +320,30 @@ class QuerierAPI:
         return {"orgs": self.controller.org_assignments(),
                 "default_org": 1}
 
+    def repo_api(self, body: dict) -> dict:
+        """Agent package repo (reference: deepflow-ctl repo agent
+        upload): upload versioned packages for OTA rollout; list them.
+        Rollout = `dfctl exec <agent> upgrade version=vX`."""
+        if self.controller is None:
+            raise qengine.QueryError("no controller")
+        action = body.get("action", "list")
+        if action == "upload":
+            import base64
+            try:
+                data = base64.b64decode(body.get("data_b64", ""),
+                                        validate=True)
+            except Exception:
+                raise qengine.QueryError("data_b64 is not valid base64")
+            try:
+                info = self.controller.packages.upload(
+                    body.get("name", "agent"),
+                    body.get("version", ""), data)
+            except ValueError as e:
+                raise qengine.QueryError(str(e))
+            return {"uploaded": info,
+                    "packages": self.controller.packages.list()}
+        return {"packages": self.controller.packages.list()}
+
     def prom_query_range(self, params: dict) -> dict:
         """GET /prom/api/v1/query_range (reference: querier/app/prometheus,
         router.go:41)."""
@@ -959,6 +983,8 @@ class QuerierHTTP:
                         self._send(200, api.analyzers_api(body))
                     elif path == "/v1/orgs":
                         self._send(200, api.orgs_api(body))
+                    elif path == "/v1/repo":
+                        self._send(200, api.repo_api(body))
                     elif path == "/v1/agents/exec":
                         self._send(200, api.agent_exec(body))
                     elif path == "/v1/agent-group-config":

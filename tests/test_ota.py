@@ -1,0 +1,175 @@
+"""OTA upgrade with binary distribution (VERDICT r04 missing #6):
+upload a versioned package to the controller repo, roll it out to an
+agent over the sync plane — the agent downloads, verifies the digest,
+stages the tree, and re-execs with it first on PYTHONPATH.
+
+Reference analog: message/agent.proto:9 Upgrade stream +
+cli/ctl/agent.go:135 (deepflow-ctl repo agent upload / agent upgrade).
+"""
+
+import base64
+import hashlib
+import io
+import json
+import os
+import tarfile
+import time
+import urllib.request
+
+import pytest
+
+from deepflow_tpu.agent.agent import Agent
+from deepflow_tpu.agent.config import AgentConfig
+from deepflow_tpu.server import Server
+
+
+def _make_package(marker: str) -> bytes:
+    """A tiny package tree: new_agent/version.py carrying a marker."""
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as t:
+        data = f'VERSION = "{marker}"\n'.encode()
+        info = tarfile.TarInfo("new_agent/version.py")
+        info.size = len(data)
+        t.addfile(info, io.BytesIO(data))
+    return buf.getvalue()
+
+
+def _post(port, path, body):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    return json.load(urllib.request.urlopen(req))
+
+
+@pytest.fixture
+def server():
+    s = Server(host="127.0.0.1", ingest_port=0, query_port=0, sync_port=0,
+               enable_controller=True).start()
+    yield s
+    s.stop()
+
+
+def test_repo_upload_list_fetch(server):
+    pkg = _make_package("v9")
+    out = _post(server.query_port, "/v1/repo",
+                {"action": "upload", "name": "agent", "version": "v9",
+                 "data_b64": base64.b64encode(pkg).decode()})
+    up = out["uploaded"]
+    assert up["sha256"] == hashlib.sha256(pkg).hexdigest()
+    listing = _post(server.query_port, "/v1/repo", {})["packages"]
+    assert listing["agent"][0]["version"] == "v9"
+    # grpc fetch returns the same bytes + digest; latest wins when
+    # version is empty
+    got = server.controller.packages.get("agent", "")
+    assert got is not None
+    version, data, sha = got
+    assert version == "v9" and data == pkg
+    assert server.controller.packages.get("agent", "nope") is None
+
+
+def test_repo_rejects_bad_upload(server):
+    import urllib.error
+    try:
+        _post(server.query_port, "/v1/repo",
+              {"action": "upload", "version": "v1", "data_b64": "!!!"})
+        raise AssertionError("bad base64 accepted")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+    try:
+        _post(server.query_port, "/v1/repo",
+              {"action": "upload", "version": "",
+               "data_b64": base64.b64encode(b"x").decode()})
+        raise AssertionError("empty version accepted")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+
+
+def test_ota_rollout_stages_and_reexecs(server, tmp_path, monkeypatch):
+    """Full rollout: package in repo -> upgrade version=vX command ->
+    agent fetches over sync plane, verifies, stages, re-execs with the
+    staged tree on PYTHONPATH."""
+    monkeypatch.setenv("DF_UPGRADE_DIR", str(tmp_path / "versions"))
+    pkg = _make_package("v2-marker")
+    _post(server.query_port, "/v1/repo",
+          {"action": "upload", "name": "agent", "version": "v2",
+           "data_b64": base64.b64encode(pkg).decode()})
+
+    cfg = AgentConfig()
+    cfg.sender.servers = [("127.0.0.1", server.ingest_port)]
+    cfg.controller = f"127.0.0.1:{server.controller.port}"
+    cfg.standalone = False
+    cfg.profiler.enabled = False
+    cfg.tpuprobe.enabled = False
+    cfg.guard.enabled = False
+    cfg.sync_interval_s = 0.2
+    cfg.socket_scan_interval_s = 0
+    agent = Agent(cfg).start()
+    execs = []
+    try:
+        from deepflow_tpu.agent import ops
+        monkeypatch.setattr(
+            ops.CommandRegistry, "_execv",
+            staticmethod(lambda *a: execs.append(a)))
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and \
+                agent.synchronizer.stats["syncs"] == 0:
+            time.sleep(0.05)
+        code, out = agent.synchronizer._ops.run("upgrade",
+                                                ["version=v2"])
+        assert code == 0, out
+        result = json.loads(out)
+        assert result["upgrading"] is True
+        assert result["version"] == "v2"
+        staged = result["staged"]
+        assert staged and os.path.isdir(staged)
+        with open(os.path.join(staged, "new_agent", "version.py")) as f:
+            assert "v2-marker" in f.read()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not execs:
+            time.sleep(0.05)
+        assert execs, "re-exec never fired"
+        assert staged in os.environ.get("PYTHONPATH", "")
+    finally:
+        try:
+            agent.stop()
+        except Exception:
+            pass
+
+
+def test_ota_digest_and_missing_version_fail_closed(server, tmp_path,
+                                                    monkeypatch):
+    monkeypatch.setenv("DF_UPGRADE_DIR", str(tmp_path / "versions"))
+    cfg = AgentConfig()
+    cfg.sender.servers = [("127.0.0.1", server.ingest_port)]
+    cfg.controller = f"127.0.0.1:{server.controller.port}"
+    cfg.standalone = False
+    cfg.profiler.enabled = False
+    cfg.tpuprobe.enabled = False
+    cfg.guard.enabled = False
+    cfg.sync_interval_s = 0.2
+    cfg.socket_scan_interval_s = 0
+    agent = Agent(cfg).start()
+    try:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and \
+                agent.synchronizer.stats["syncs"] == 0:
+            time.sleep(0.05)
+        code, out = agent.synchronizer._ops.run(
+            "upgrade", ["version=does-not-exist"])
+        res = json.loads(out)
+        assert res["upgrading"] is False and "not in repo" in res["error"]
+        # a package with an unsafe member must refuse to stage
+        evil = io.BytesIO()
+        with tarfile.open(fileobj=evil, mode="w:gz") as t:
+            data = b"boom"
+            info = tarfile.TarInfo("../escape.py")
+            info.size = len(data)
+            t.addfile(info, io.BytesIO(data))
+        server.controller.packages.upload("agent", "evil",
+                                          evil.getvalue())
+        code, out = agent.synchronizer._ops.run("upgrade",
+                                                ["version=evil"])
+        res = json.loads(out)
+        assert res["upgrading"] is False and "unsafe" in res["error"]
+    finally:
+        agent.stop()
