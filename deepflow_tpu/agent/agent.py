@@ -184,12 +184,21 @@ class Agent:
             self.start_tpuprobe()
             if self.tpuprobe is not None:
                 self._components.append("tpuprobe")
-        if self.config.flow.enabled or self.config.sslprobe_sock:
+        has_pkt_acls = any(a.get("action") in ("pcap", "npb")
+                           for a in getattr(self.config, "acls", []))
+        if self.config.flow.enabled or self.config.sslprobe_sock or \
+                has_pkt_acls:
             from deepflow_tpu.agent.dispatcher import Dispatcher
             self.dispatcher = Dispatcher(
                 sender=self.sender,
                 agent_id=self.config.agent_id,
                 labeler=self.labeler).start()
+            from deepflow_tpu.agent.packet_actions import PacketActions
+            self.dispatcher.packet_actions = PacketActions(
+                self.labeler, sender=self.sender,
+                agent_id=self.config.agent_id,
+                npb_target=self.config.npb_target,
+                npb_vni=self.config.npb_vni)
         if self.config.sslprobe_sock:
             from deepflow_tpu.agent.sslprobe import SslProbeListener
             self.sslprobe = SslProbeListener(
@@ -287,9 +296,29 @@ class Agent:
         if self.live_capture:
             self.live_capture.stop()
         if self.dispatcher:
+            if self.dispatcher.packet_actions is not None:
+                self.dispatcher.packet_actions.stop()
             self.dispatcher.stop()
         self._emit_stats()  # final stats flush
         self.sender.flush_and_stop()
+
+    def ensure_packet_actions(self, cfg=None) -> None:
+        """Controller-pushed pcap/npb ACLs need a dispatcher + executor
+        even when the agent booted without one (hot-apply path)."""
+        cfg = cfg or self.config
+        if self.dispatcher is None:
+            from deepflow_tpu.agent.dispatcher import Dispatcher
+            self.dispatcher = Dispatcher(
+                sender=self.sender, agent_id=self.config.agent_id,
+                labeler=self.labeler).start()
+            self._components.append("dispatcher")
+        if self.dispatcher.packet_actions is None:
+            from deepflow_tpu.agent.packet_actions import PacketActions
+            self.dispatcher.packet_actions = PacketActions(
+                self.labeler, sender=self.sender,
+                agent_id=self.config.agent_id,
+                npb_target=getattr(cfg, "npb_target", ""),
+                npb_vni=getattr(cfg, "npb_vni", 1))
 
     # -- sinks ---------------------------------------------------------------
 

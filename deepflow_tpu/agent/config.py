@@ -90,8 +90,15 @@ class AgentConfig:
     # allocation flame graphs, libdfmemhook.so); "" = disabled
     memhook_sock: str = ""
     # agent-side ACLs (reference: policy first_path rules): list of dicts
-    # {cidr, port, protocol, action: trace|ignore}
+    # {cidr, port, protocol, action: trace|ignore|pcap|npb} — pcap and
+    # npb imply trace and additionally capture/forward matched PACKETS
+    # (frame-visible paths: replay + socket capture mode)
     acls: list = field(default_factory=list)
+    # NPB packet broker target for action=npb ACLs (reference:
+    # plugins/npb_sender): matched frames are VXLAN-encapsulated to
+    # host:port; "" disables forwarding
+    npb_target: str = ""
+    npb_vni: int = 1
     # parser plugin modules (reference: wasm plugin hooks): each exports
     # PARSERS = [L7Parser subclasses], registered ahead of builtins
     plugins: list = field(default_factory=list)
@@ -168,8 +175,10 @@ class AgentConfig:
         for i, a in enumerate(self.acls):
             if not isinstance(a, dict):
                 raise ValueError(f"acls[{i}] must be a mapping, got {a!r}")
-            if a.get("action", "trace") not in ("trace", "ignore"):
-                raise ValueError(f"acls[{i}].action must be trace|ignore")
+            if a.get("action", "trace") not in ("trace", "ignore",
+                                                "pcap", "npb"):
+                raise ValueError(
+                    f"acls[{i}].action must be trace|ignore|pcap|npb")
             if a.get("cidr"):
                 try:
                     _ipaddr.ip_network(a["cidr"], strict=False)
@@ -216,7 +225,9 @@ _TEMPLATE_DOCS = {
     "group": "agent-group for config routing",
     "controller": "host:port; empty = standalone mode",
     "sslprobe_sock": "AF_UNIX path for the LD_PRELOAD ssl probe; empty=off",
-    "acls": "policy rules: [{cidr, port, protocol, action: trace|ignore}]",
+    "acls": "policy rules: [{cidr, port, protocol, action: "
+            "trace|ignore|pcap|npb}]; pcap/npb also capture/forward "
+            "matched packets",
     "plugins": "parser plugin modules exporting PARSERS",
     "profiler.sample_hz": "OnCPU sampling rate",
     "profiler.external_pids": "out-of-process perf targets (any pid)",

@@ -139,6 +139,21 @@ class Dispatcher:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._lock = threading.Lock()
+        # per-packet ACL actions (pcap capture / NPB forward) run on the
+        # frame-visible paths; None until an agent wires one in
+        self.packet_actions = None
+
+    def _run_packet_actions(self, frames) -> None:
+        """frames: iterable of (frame, ts_ns)."""
+        pa = self.packet_actions
+        if pa is None or not pa.enabled():
+            return
+        for frame, ts_ns in frames:
+            try:
+                pa.handle_frame(frame, ts_ns)
+            except Exception:
+                # one malformed frame must not lose the rest of the batch
+                log.exception("packet action failed")
 
     # -- pipeline callbacks ----------------------------------------------------
 
@@ -234,12 +249,21 @@ class Dispatcher:
         if self.native_map is not None:
             from deepflow_tpu.agent.packet import read_pcap_records
             raw = read_pcap_records(path)
+            self._run_packet_actions(
+                (frame, ts_ns) for frame, ts_ns, _ in raw)
             with self._lock:
                 self.native_map.inject_frames(
                     [(frame, ts_ns) for frame, ts_ns, _ in raw])
             if tick:
                 self.flush(force=True)
             return len(raw)
+        if self.packet_actions is not None and \
+                self.packet_actions.enabled():
+            # only pay the second parse when a pcap/npb ACL exists
+            from deepflow_tpu.agent.packet import read_pcap_records
+            self._run_packet_actions(
+                (frame, ts_ns)
+                for frame, ts_ns, _ in read_pcap_records(path))
         packets = read_pcap(path)
         for p in packets:
             self.inject(p)

@@ -126,6 +126,7 @@ class Labeler:
         with self._lock:
             self._acls = ok
             self._fast.clear()
+            self.acl_version = getattr(self, "acl_version", 0) + 1
 
     # -- lookup ----------------------------------------------------------------
 

@@ -169,5 +169,11 @@ class LiveCapture:
                     mp.port_dst in self.exclude_ports:
                 self.stats["excluded"] += 1
                 continue
+            pa = self.dispatcher.packet_actions
+            if pa is not None and pa.enabled():
+                try:
+                    pa.handle_meta(mp, frame)  # reuse the decode above
+                except Exception:
+                    log.exception("packet action failed")
             self.dispatcher.inject(mp)
             self.stats["injected"] += 1

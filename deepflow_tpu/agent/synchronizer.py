@@ -327,6 +327,10 @@ class Synchronizer:
                         protocol=int(a.get("protocol", 0)),
                         action=a.get("action", "trace"))
                 for a in new.acls])
+            if any(a.get("action") in ("pcap", "npb") for a in new.acls):
+                # pushed packet-action ACLs must not be silently inert
+                # on agents that started without a dispatcher
+                self.agent.ensure_packet_actions(new)
 
         # guard limits retune live (the controller's knob for hot agents)
         guard = self.agent.guard
