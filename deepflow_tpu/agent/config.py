@@ -52,7 +52,7 @@ class FlowConfig:
     # telemetry feedback loop); mirror: a SPAN/mirror port carrying OTHER
     # hosts' traffic (promiscuous, no self-port exclusion; tunnels are
     # decapsulated either way)
-    capture_mode: str = "local"     # local | mirror
+    capture_mode: str = "local"     # local | mirror | analyzer
     exclude_ports: list = field(
         default_factory=lambda: [20033, 20035, 20416])
 
@@ -190,15 +190,17 @@ class AgentConfig:
             raise ValueError(
                 f"tpuprobe.source must be auto|xplane|hooks|sim, "
                 f"got {self.tpuprobe.source!r}")
-        if self.flow.capture_mode not in ("local", "mirror"):
+        if self.flow.capture_mode not in ("local", "mirror", "analyzer"):
             raise ValueError(
-                f"flow.capture_mode must be local|mirror, "
+                f"flow.capture_mode must be local|mirror|analyzer, "
                 f"got {self.flow.capture_mode!r}")
-        if self.flow.capture_mode == "mirror" and not self.flow.interface:
+        if self.flow.capture_mode in ("mirror", "analyzer") and \
+                not self.flow.interface:
             raise ValueError(
-                "flow.capture_mode=mirror needs flow.interface: "
-                "promiscuous mode is per-NIC, so 'all interfaces' would "
-                "silently capture only local traffic")
+                f"flow.capture_mode={self.flow.capture_mode} needs "
+                "flow.interface: promiscuous mode is per-NIC, and an "
+                "analyzer NIC must be named (capturing 'all' would "
+                "include this host's own telemetry with exclusions off)")
         for b, name in ((self.profiler.enabled, "profiler.enabled"),
                         (self.tpuprobe.enabled, "tpuprobe.enabled"),
                         (self.standalone, "standalone")):

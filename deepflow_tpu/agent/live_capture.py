@@ -33,12 +33,24 @@ class LiveCapture:
                  snaplen: int = 65535, capture_mode: str = "local") -> None:
         self.dispatcher = dispatcher
         self.interface = interface  # "" = all interfaces
-        # mirror mode (reference: dispatcher mirror/analyzer modes): the
-        # NIC carries OTHER hosts' traffic (SPAN/mirror port) — go
-        # promiscuous. Port exclusions stay: a trunk mirror can include
-        # this host's own uplink, and the telemetry feedback loop they
-        # break exists there too.
+        # capture modes (reference: dispatcher/recv_engine 6 modes):
+        # - local: this host's own traffic; self-ports excluded to break
+        #   the telemetry feedback loop.
+        # - mirror: a SPAN/mirror port carrying OTHER hosts' traffic —
+        #   promiscuous. Port exclusions stay: a trunk mirror can include
+        #   this host's own uplink.
+        # - analyzer: a DEDICATED analyzer NIC fed by remote TAPs —
+        #   promiscuous, and NO port exclusions: the NIC never carries
+        #   this host's own telemetry, and dropping the monitored
+        #   fleet's port-20033 traffic would blind the analyzer to
+        #   exactly the infrastructure it watches.
         self.capture_mode = capture_mode
+        if capture_mode == "analyzer":
+            if not interface:
+                log.warning("analyzer mode without an interface captures "
+                            "ALL NICs including this host's own; set "
+                            "flow.interface to the analyzer port")
+            exclude_ports = ()
         self.exclude_ports = frozenset(exclude_ports)
         self.snaplen = snaplen
         self._sock: socket.socket | None = None
@@ -56,7 +68,7 @@ class LiveCapture:
                           socket.htons(ETH_P_ALL))
         if self.interface:
             s.bind((self.interface, 0))
-            if self.capture_mode == "mirror":
+            if self.capture_mode in ("mirror", "analyzer"):
                 try:  # struct packet_mreq: ifindex, PACKET_MR_PROMISC
                     import struct as _struct
                     idx = socket.if_nametoindex(self.interface)
@@ -86,7 +98,7 @@ class LiveCapture:
             return False
         for port in self.exclude_ports:
             nfm.exclude_port(port)
-        if self.capture_mode == "mirror" and self.interface:
+        if self.capture_mode in ("mirror", "analyzer") and self.interface:
             if not self._ring.promisc(self.interface):
                 log.warning("promiscuous mode failed on %r; mirror "
                             "capture sees only local traffic",

@@ -343,3 +343,32 @@ def test_l7_log_carries_tunnel_identity():
     f2 = record_to_l7_pb(recs2[0])
     assert f2.key.tunnel_type == 1 and f2.key.tunnel_id == 33
     assert f2.request_resource == "/health"
+
+
+def test_analyzer_mode_no_exclusions_and_validation():
+    """Analyzer mode (reference: dispatcher analyzer mode): dedicated
+    analyzer NIC — promiscuous, NO self-port exclusions (the monitored
+    fleet's telemetry ports must stay visible); config requires an
+    interface."""
+    import pytest
+    from deepflow_tpu.agent.config import AgentConfig
+    from deepflow_tpu.agent.live_capture import LiveCapture
+
+    lc = LiveCapture(dispatcher=None, interface="mon0",
+                     exclude_ports=(20033, 20035), capture_mode="analyzer")
+    assert lc.exclude_ports == frozenset()
+    lc_mirror = LiveCapture(dispatcher=None, interface="mon0",
+                            exclude_ports=(20033,), capture_mode="mirror")
+    assert 20033 in lc_mirror.exclude_ports  # mirror keeps exclusions
+
+    cfg = AgentConfig()
+    cfg.flow.enabled = True
+    cfg.flow.capture_mode = "analyzer"
+    cfg.flow.interface = ""
+    with pytest.raises(ValueError, match="analyzer"):
+        cfg.validate()
+    cfg.flow.interface = "mon0"
+    cfg.validate()
+    cfg.flow.capture_mode = "bogus"
+    with pytest.raises(ValueError, match="local|mirror|analyzer"):
+        cfg.validate()
