@@ -77,13 +77,18 @@ def rewrite_derived(expr, table_name: str, columns: set):
                 if rule[0] == "sum2":
                     return S.BinOp("+", S.Func("SUM", (S.Col(rule[1]),)),
                                    S.Func("SUM", (S.Col(rule[2]),)))
-            return S.Func(e.name, tuple(walk(a) for a in e.args))
+            return S.Func(e.name, tuple(walk(a) for a in e.args),
+                          distinct=e.distinct)
         if isinstance(e, S.BinOp):
             right = (e.right if isinstance(e.right, tuple)
                      else walk(e.right))
             return S.BinOp(e.op, walk(e.left), right)
         if isinstance(e, S.Not):
             return S.Not(walk(e.expr))
+        if isinstance(e, S.Case):
+            return S.Case(
+                tuple((walk(c), walk(v)) for c, v in e.whens),
+                walk(e.default) if e.default is not None else None)
         return e
 
     return walk(expr)

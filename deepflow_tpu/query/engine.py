@@ -50,6 +50,7 @@ class _Val:
             return [lab[i] for i in self.arr.tolist()]
         if self.kind == "bool":
             return self.arr.astype(bool).tolist()
+        # 'obj': python values (CASE branches mixing literals)
         return self.arr.tolist()
 
 
@@ -79,6 +80,12 @@ def _collect_cols(e, out: set) -> None:
             _collect_cols(e.right, out)
     elif isinstance(e, S.Not):
         _collect_cols(e.expr, out)
+    elif isinstance(e, S.Case):
+        for c, v in e.whens:
+            _collect_cols(c, out)
+            _collect_cols(v, out)
+        if e.default is not None:
+            _collect_cols(e.default, out)
 
 
 def _like_to_pred(pattern: str):
@@ -93,6 +100,37 @@ def _like_to_pred(pattern: str):
             parts.append(re.escape(ch))
     rx = re.compile("^" + "".join(parts) + "$", re.DOTALL)
     return lambda s: rx.match(s) is not None
+
+
+
+def _case_select(conds, vals, default, shape) -> _Val:
+    """Shared CASE combination for the row-level and aggregate paths.
+    All-numeric branches stay float64; any non-numeric branch coerces
+    EVERY branch to strings (one consistent dtype — a mixed str/num
+    object array would crash GROUP BY/ORDER BY comparisons)."""
+    branch_vals = vals + ([default] if default is not None else [])
+    if all(v.kind == "num" and v.arr.dtype.kind in "fiub"
+           for v in branch_vals):
+        choices = [np.broadcast_to(v.arr.astype(np.float64), shape)
+                   for v in vals]
+        dflt = (default.arr.astype(np.float64) if default is not None
+                else np.nan)
+        if getattr(dflt, "ndim", 0):
+            dflt = np.broadcast_to(dflt, shape)
+        return _Val(np.select(conds, choices, default=dflt))
+
+    def as_str(v: _Val):
+        dec = v.decoded()
+        if not isinstance(dec, list):
+            return dec if isinstance(dec, str) else str(dec)
+        return np.asarray([x if isinstance(x, str) else str(x)
+                           for x in dec], dtype=object)
+    choices = [np.broadcast_to(np.asarray(as_str(v), dtype=object), shape)
+               for v in vals]
+    dflt = as_str(default) if default is not None else ""
+    if not isinstance(dflt, str) and getattr(dflt, "ndim", 0):
+        dflt = np.broadcast_to(dflt, shape)
+    return _Val(np.select(conds, choices, default=dflt), "obj")
 
 
 class _Env:
@@ -117,9 +155,17 @@ class _Env:
             return self._eval_func(e)
         if isinstance(e, S.BinOp):
             return self._eval_binop(e)
+        if isinstance(e, S.Case):
+            return self._eval_case(e)
         if isinstance(e, S.Star):
             raise QueryError("* only valid inside Count()")
         raise QueryError(f"cannot evaluate {e!r}")
+
+    def _eval_case(self, e: "S.Case") -> _Val:
+        conds = [self.eval(c).arr.astype(bool) for c, _ in e.whens]
+        vals = [self.eval(v) for _, v in e.whens]
+        default = self.eval(e.default) if e.default is not None else None
+        return _case_select(conds, vals, default, conds[0].shape)
 
     def _eval_func(self, e: S.Func) -> _Val:
         if e.name in S.AGG_FUNCS:
@@ -246,13 +292,33 @@ def _agg_eval(e, env: _Env, order: np.ndarray, bounds: np.ndarray) -> _Val:
     starts = bounds
     ends = np.append(bounds[1:], len(order))
     if isinstance(e, S.Func) and e.name in S.AGG_FUNCS:
+        if e.distinct and e.name != "COUNT":
+            raise QueryError(
+                f"DISTINCT is only supported in Count(), not {e.name}")
+        if e.name == "COUNT" and e.distinct:
+            if len(e.args) != 1 or isinstance(e.args[0], S.Star):
+                raise QueryError(
+                    "COUNT(DISTINCT) takes exactly one column")
+            v = env.eval(e.args[0])
+            a = v.arr[order]  # encoded ids / numerics both hash fine
+            if not len(a):
+                return _Val(np.zeros(len(starts), dtype=np.float64))
+            # one lexsort total instead of one np.unique per group:
+            # sort (group, value), count within-group value changes
+            grp = np.repeat(np.arange(len(starts)), ends - starts)
+            idx = np.lexsort((a, grp))
+            sa, sg = a[idx], grp[idx]
+            fresh = np.append(True, (sa[1:] != sa[:-1]) |
+                              (sg[1:] != sg[:-1]))
+            return _Val(np.add.reduceat(
+                fresh.astype(np.float64), starts))
         if e.name == "COUNT":
             return _Val((ends - starts).astype(np.float64))
         arg = e.args[0] if e.args else S.Star()
         if isinstance(arg, S.Star):
             return _Val((ends - starts).astype(np.float64))
         v = env.eval(arg)
-        if v.kind in ("str", "enum") and e.name != "LAST":
+        if v.kind in ("str", "enum", "obj") and e.name != "LAST":
             raise QueryError(
                 f"{e.name} over string column {S.expr_name(arg)!r}")
         a = v.arr.astype(np.float64)[order]
@@ -324,7 +390,15 @@ def _agg_eval(e, env: _Env, order: np.ndarray, bounds: np.ndarray) -> _Val:
         raise QueryError(f"op {e.op} not valid over aggregates")
     if isinstance(e, S.Lit):
         return _Val(np.asarray(e.value))
-    if isinstance(e, (S.Col, S.Func)):
+    if isinstance(e, S.Case) and S.contains_agg(e):
+        # CASE over aggregates (per-group labels from per-group stats)
+        conds = [_agg_eval(c, env, order, bounds).arr.astype(bool)
+                 for c, _ in e.whens]
+        vals = [_agg_eval(v, env, order, bounds) for _, v in e.whens]
+        default = (_agg_eval(e.default, env, order, bounds)
+                   if e.default is not None else None)
+        return _case_select(conds, vals, default, (len(bounds),))
+    if isinstance(e, (S.Col, S.Func, S.Case)):
         # group-key expression: first value per group
         v = env.eval(e)
         out = _Val(v.arr[order][bounds], v.kind, labels=v.labels, unit=v.unit)
@@ -353,8 +427,16 @@ def execute(table: ColumnarTable, query: S.Select | str) -> QueryResult:
                   if query.having is not None else None)
     except _catalog._DerivedError as e:
         raise QueryError(str(e)) from None
+    # GROUP BY <alias>: substitute the SELECT item's expression (the
+    # alias names no real column)
+    alias_map = {i.alias: i.expr for i in query_items if i.alias}
+    group_by = [
+        alias_map[g.name]
+        if isinstance(g, S.Col) and g.name not in table.columns
+        and g.name in alias_map else g
+        for g in query.group_by]
     query = S.Select(items=query_items, table=query.table,
-                     where=query.where, group_by=query.group_by,
+                     where=query.where, group_by=group_by,
                      having=having, order_by=query.order_by,
                      limit=query.limit)
     needed: set[str] = set()

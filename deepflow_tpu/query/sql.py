@@ -21,7 +21,8 @@ from dataclasses import dataclass, field
 
 KEYWORDS = {"SELECT", "FROM", "WHERE", "GROUP", "ORDER", "BY", "LIMIT",
             "AS", "AND", "OR", "NOT", "IN", "LIKE", "ASC", "DESC",
-            "HAVING", "SHOW"}
+            "HAVING", "SHOW", "DISTINCT", "CASE", "WHEN", "THEN",
+            "ELSE", "END"}
 AGG_FUNCS = {"SUM", "AVG", "MIN", "MAX", "COUNT", "LAST", "PERCENTILE"}
 SCALAR_FUNCS = {"TIME"}
 
@@ -79,9 +80,17 @@ class Lit:
 
 
 @dataclass(frozen=True)
+class Case:
+    """CASE WHEN cond THEN expr [WHEN ...] [ELSE expr] END."""
+    whens: tuple     # ((cond, expr), ...)
+    default: object = None
+
+
+@dataclass(frozen=True)
 class Func:
     name: str      # upper-cased
     args: tuple
+    distinct: bool = False   # COUNT(DISTINCT col)
 
 
 @dataclass(frozen=True)
@@ -302,17 +311,34 @@ class _Parser:
             return e
         if t.kind == "op" and t.value == "*":
             return Star()
+        if t.kind == "kw" and t.value == "CASE":
+            whens = []
+            while self.accept_kw("WHEN"):
+                cond = self.parse_or()
+                self.expect("kw", "THEN")
+                whens.append((cond, self.parse_expr()))
+            if not whens:
+                raise SqlError(f"CASE needs at least one WHEN at {t.pos}")
+            default = None
+            if self.accept_kw("ELSE"):
+                default = self.parse_expr()
+            self.expect("kw", "END")
+            return Case(tuple(whens), default)
         if t.kind == "ident":
             if self.peek().kind == "op" and self.peek().value == "(":
                 self.next()
                 args = []
+                distinct = False
+                if self.accept_kw("DISTINCT"):
+                    distinct = True
                 if not (self.peek().kind == "op" and self.peek().value == ")"):
                     args.append(self.parse_expr())
                     while self.peek().value == ",":
                         self.next()
                         args.append(self.parse_expr())
                 self.expect("op", ")")
-                return Func(t.value.upper(), tuple(args))
+                return Func(t.value.upper(), tuple(args),
+                            distinct=distinct)
             return Col(t.value)
         raise SqlError(f"unexpected {t.value!r} at {t.pos}")
 
@@ -357,7 +383,17 @@ def expr_name(e) -> str:
     if isinstance(e, Star):
         return "*"
     if isinstance(e, Func):
-        return f"{e.name}({', '.join(expr_name(a) for a in e.args)})"
+        inner = ", ".join(expr_name(a) for a in e.args)
+        if e.distinct:
+            inner = f"DISTINCT {inner}"
+        return f"{e.name}({inner})"
+    if isinstance(e, Case):
+        parts = " ".join(
+            f"WHEN {expr_name(c)} THEN {expr_name(v)}"
+            for c, v in e.whens)
+        tail = f" ELSE {expr_name(e.default)}" if e.default is not None \
+            else ""
+        return f"CASE {parts}{tail} END"
     if isinstance(e, BinOp):
         return f"{expr_name(e.left)} {e.op} {expr_name(e.right)}"
     if isinstance(e, Not):
@@ -375,4 +411,8 @@ def contains_agg(e) -> bool:
             not isinstance(e.right, tuple) and contains_agg(e.right))
     if isinstance(e, Not):
         return contains_agg(e.expr)
+    if isinstance(e, Case):
+        return any(contains_agg(c) or contains_agg(v)
+                   for c, v in e.whens) or (
+            e.default is not None and contains_agg(e.default))
     return False

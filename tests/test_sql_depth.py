@@ -211,3 +211,61 @@ def test_derived_column_display_name():
     db, t = _network_1m()
     res = execute(t, "SELECT Avg(rtt) FROM t")
     assert res.columns == ["AVG(rtt)"]
+
+
+# -- round 5: CASE WHEN, COUNT(DISTINCT), GROUP BY alias ---------------------
+
+def _lat_table():
+    from deepflow_tpu.store.table import ColumnarTable, ColumnSpec as C
+    t = ColumnarTable("t", [C("time", "u64"), C("svc", "str"),
+                            C("lat", "u32")])
+    t.append_rows([{"time": i * 10**9, "svc": f"s{i % 3}", "lat": i * 10}
+                   for i in range(100)])
+    return t
+
+
+def test_count_distinct():
+    t = _lat_table()
+    r = execute(t, "SELECT Count(DISTINCT svc) FROM t")
+    assert r.values == [[3.0]]
+    r = execute(t, "SELECT svc, Count(DISTINCT lat) AS n FROM t "
+                   "GROUP BY svc ORDER BY svc")
+    assert [row[1] for row in r.values] == [34.0, 33.0, 33.0]
+    r = execute(t, "SELECT svc FROM t GROUP BY svc "
+                   "HAVING Count(DISTINCT lat) > 33")
+    assert r.values == [["s0"]]
+
+
+def test_case_when_row_level():
+    t = _lat_table()
+    r = execute(t, "SELECT CASE WHEN lat > 900 THEN 'vslow' "
+                   "WHEN lat > 500 THEN 'slow' ELSE 'fast' END AS c, "
+                   "Count(), Avg(lat) FROM t GROUP BY c ORDER BY c")
+    assert [row[0] for row in r.values] == ["fast", "slow", "vslow"]
+    assert [row[1] for row in r.values] == [51.0, 40.0, 9.0]
+    # numeric branches stay numeric
+    r = execute(t, "SELECT CASE WHEN lat > 500 THEN 1 ELSE 0 END AS hot, "
+                   "Count() FROM t GROUP BY hot ORDER BY hot")
+    assert r.values == [[0.0, 51.0], [1.0, 49.0]]
+    # no ELSE: unmatched numeric rows are NaN-excluded from labels path
+    r = execute(t, "SELECT CASE WHEN lat > 500 THEN 'slow' END AS c, "
+                   "Count() FROM t GROUP BY c ORDER BY c")
+    assert {row[0] for row in r.values} == {"", "slow"}
+
+
+def test_case_over_aggregates():
+    t = _lat_table()
+    r = execute(t, "SELECT svc, CASE WHEN Avg(lat) > 490 THEN 'hot' "
+                   "ELSE 'cold' END AS heat FROM t GROUP BY svc "
+                   "ORDER BY svc")
+    assert r.values == [["s0", "hot"], ["s1", "cold"], ["s2", "hot"]]
+
+
+def test_group_by_alias():
+    t = _lat_table()
+    r = execute(t, "SELECT Time(time, 10) AS bucket, Count() FROM t "
+                   "GROUP BY bucket ORDER BY bucket")
+    assert len(r.values) == 10 and r.values[0][1] == 10.0
+    # an alias shadowing a REAL column still groups by the column
+    r = execute(t, "SELECT svc AS lat, Count() FROM t GROUP BY lat")
+    assert len(r.values) == 100  # grouped by the real lat column
