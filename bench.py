@@ -162,51 +162,120 @@ def _bench_packet_path() -> dict:
     }
 
 
-def _bench_ingest() -> dict:
-    """Ingest path: serialized FlowLogBatches through the real receiver ->
-    decoder -> columnar store, in the DEFAULT single-worker configuration
-    (measured: extra workers don't pay — the columnar build is GIL-bound
-    even though upb parses outside the GIL; see Decoder.WORKERS)."""
-    import socket
-
+def _make_l4_frame():
     from deepflow_tpu.codec import FrameHeader, MessageType, encode_frame
     from deepflow_tpu.proto import pb
+    batch = pb.FlowLogBatch()
+    for i in range(256):
+        f = batch.l4.add()
+        f.flow_id = i
+        f.key.ip_src = bytes([10, 0, i >> 8 & 255, i & 255])
+        f.key.ip_dst = bytes([10, 9, 9, 9])
+        f.key.port_src = 40000 + i
+        f.key.port_dst = 443
+        f.key.proto = 1
+        f.end_time_ns = 1_700_000_000_000_000_000 + i
+        f.packet_tx = 10
+        f.byte_tx = 1000
+    return (encode_frame(FrameHeader(MessageType.L4_LOG, agent_id=1),
+                         batch.SerializeToString()),
+            "flow_log.l4_flow_log", MessageType.L4_LOG)
+
+
+def _make_l7_frame():
+    from deepflow_tpu.codec import FrameHeader, MessageType, encode_frame
+    from deepflow_tpu.proto import pb
+    batch = pb.FlowLogBatch()
+    for i in range(256):
+        f = batch.l7.add()
+        f.flow_id = i
+        f.key.ip_src = bytes([10, 0, i >> 8 & 255, i & 255])
+        f.key.ip_dst = bytes([10, 9, 9, 9])
+        f.key.port_src = 40000 + i
+        f.key.port_dst = 80
+        f.key.proto = 1
+        f.l7_protocol = pb.HTTP1
+        f.request_type = "GET"
+        f.request_domain = "api.internal"
+        f.request_resource = f"/v1/items/{i % 32}"
+        f.endpoint = f"/v1/items/{i % 32}"
+        f.response_status = pb.OK
+        f.response_code = 200
+        f.start_time_ns = 1_700_000_000_000_000_000 + i
+        f.end_time_ns = 1_700_000_000_000_000_000 + i + 2_000_000
+        f.captured_request_byte = 200
+        f.captured_response_byte = 900
+    return (encode_frame(FrameHeader(MessageType.L7_LOG, agent_id=1),
+                         batch.SerializeToString()),
+            "flow_log.l7_flow_log", MessageType.L7_LOG)
+
+
+def _run_ingest(make_frame, n_batches: int = 400,
+                workers: int | None = None) -> dict:
+    """Send n_batches pre-serialized frames through the real receiver ->
+    decoder -> columnar store; returns rows/s plus the per-stage split
+    (frames dispatched, decode ns, append ns) so a regression localizes
+    to receiver hand-off, protobuf decode, or store append."""
+    import socket
+
     from deepflow_tpu.server import Server
 
-    server = Server(host="127.0.0.1", ingest_port=0, query_port=0)
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0,
+                    ingest_workers=workers)
     server.start()
     try:
-        batch = pb.FlowLogBatch()
-        for i in range(256):
-            f = batch.l4.add()
-            f.flow_id = i
-            f.key.ip_src = bytes([10, 0, i >> 8 & 255, i & 255])
-            f.key.ip_dst = bytes([10, 9, 9, 9])
-            f.key.port_src = 40000 + i
-            f.key.port_dst = 443
-            f.key.proto = 1
-            f.end_time_ns = 1_700_000_000_000_000_000 + i
-            f.packet_tx = 10
-            f.byte_tx = 1000
-        frame = encode_frame(FrameHeader(MessageType.L4_LOG, agent_id=1),
-                             batch.SerializeToString())
-        n_batches = 400
+        frame, table_name, msg_type = make_frame()
         sock = socket.create_connection(("127.0.0.1", server.ingest_port))
         t0 = time.perf_counter()
         for _ in range(n_batches):
             sock.sendall(frame)
         total = n_batches * 256
-        table = server.db.table("flow_log.l4_flow_log")
+        table = server.db.table(table_name)
         while len(table) < total and time.perf_counter() - t0 < 60:
             time.sleep(0.01)
         dt = time.perf_counter() - t0
         sock.close()
-        return {"ingest_rows_per_sec": round(len(table) / dt),
-                "ingest_rows": len(table),
-                "ingest_rows_expected": total,
-                "ingest_timed_out": len(table) < total}
+        dec = next(d for d in server.decoders if d.MSG_TYPE == msg_type)
+        stats = dict(dec.stats)
+        append_ms = stats["append_ns"] / 1e6
+        decode_ms = (stats["handle_ns"] - stats["append_ns"]) / 1e6
+        return {"rows_per_sec": round(len(table) / dt),
+                "rows": len(table),
+                "rows_expected": total,
+                "timed_out": len(table) < total,
+                "frames_dispatched": server.receiver.stats["frames"],
+                "frames_dropped": server.receiver.stats["dropped"],
+                "decode_ms": round(decode_ms, 1),
+                "append_ms": round(append_ms, 1)}
     finally:
         server.stop()
+
+
+def _bench_ingest() -> dict:
+    """Ingest path: L4 (single worker — the native columnar decode there
+    is already faster than one sender can feed) and L7 at 1 vs 4 workers:
+    the native DfL7Cols parse releases the GIL, so DF_INGEST_WORKERS
+    should scale on multi-core hosts and this bench PROVES it per run."""
+    l4 = _run_ingest(_make_l4_frame)
+    l7_w1 = _run_ingest(_make_l7_frame, workers=1)
+    l7_w4 = _run_ingest(_make_l7_frame, workers=4)
+    return {
+        "ingest_rows_per_sec": l4["rows_per_sec"],
+        "ingest_rows": l4["rows"],
+        "ingest_rows_expected": l4["rows_expected"],
+        "ingest_timed_out": l4["timed_out"],
+        "ingest_stage_breakdown": {
+            k: {"frames_dispatched": v["frames_dispatched"],
+                "frames_dropped": v["frames_dropped"],
+                "decode_ms": v["decode_ms"],
+                "append_ms": v["append_ms"]}
+            for k, v in (("l4", l4), ("l7_w1", l7_w1), ("l7_w4", l7_w4))},
+        "ingest_l7_rows_per_sec": l7_w4["rows_per_sec"],
+        "ingest_l7_rows_per_sec_w1": l7_w1["rows_per_sec"],
+        "ingest_l7_timed_out": l7_w1["timed_out"] or l7_w4["timed_out"],
+        "ingest_l7_workers_scale": (
+            l7_w4["rows_per_sec"] > l7_w1["rows_per_sec"]),
+    }
 
 
 _BUSY_C = """

@@ -46,6 +46,10 @@ class MemHookListener:
         self._stop = threading.Event()
         # (pid, stack_hash) -> last seen (alloc_w, free_w) for deltas
         self._last: dict[tuple, tuple[int, int]] = {}
+        # pid -> its latest per-process dropped counter; the interposer's
+        # counter is cumulative per process, so summing across pids (not
+        # overwriting with whichever pid reported last) is the fleet total
+        self._dropped_by_pid: dict[int, int] = {}
         self._next_evict = 0.0
         self._symbolizers: dict[int, object] = {}
         self.stats = {"reports": 0, "records": 0, "samples_emitted": 0,
@@ -105,7 +109,8 @@ class MemHookListener:
         if magic != _MAGIC:
             return 0
         self.stats["reports"] += 1
-        self.stats["dropped_target"] = int(dropped)
+        self._dropped_by_pid[pid] = int(dropped)
+        self.stats["dropped_target"] = sum(self._dropped_by_pid.values())
         try:
             sym = self._symbolizer(pid)
             sym.refresh()  # once per datagram: maps parsing is the cost

@@ -16,7 +16,7 @@ log = logging.getLogger("df.native")
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_DIR, "libdfnative.so")
 _lib = None
-_ABI_VERSION = 3  # must match df_abi_version() in dfnative.cpp
+_ABI_VERSION = 4  # must match df_abi_version() in dfnative.cpp
 
 
 def _build() -> bool:
@@ -31,8 +31,12 @@ def _build() -> bool:
 
 def load():
     """Load (building first — make is mtime-based so a fresh dfnative.cpp
-    always rebuilds). Returns the ctypes lib or None."""
+    always rebuilds). Returns the ctypes lib or None. DF_NO_NATIVE=1 is
+    the operator/test kill-switch: every native fast path then reports
+    unavailable and the pure-Python fallbacks take over."""
     global _lib
+    if os.environ.get("DF_NO_NATIVE"):
+        return None
     if _lib is not None:
         return _lib
     if not _build() and not os.path.exists(_SO):
@@ -139,6 +143,9 @@ def load():
         np.ctypeslib.ndpointer(np.uint32),           # l7_off
         np.ctypeslib.ndpointer(np.uint32),           # l7_len
         ctypes.c_uint32, ctypes.POINTER(ctypes.c_uint32)]  # n_l7
+    lib.df_decode_l7_cols.restype = ctypes.c_int64
+    lib.df_decode_l7_cols.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_void_p]
     _lib = lib
     return lib
 
@@ -345,3 +352,87 @@ class L4ColumnDecoder:
                    for i in range(n_l7)]
         cols = {k: a[:n] for k, a in self.arrays.items()}
         return n, cols, l7_segs, self.arena[:self._cols.arena_used]
+
+
+# -- columnar L7 protobuf decode (must mirror DfL7Cols in pbcols.cpp) -------
+
+# string-column slot order; must match l7_str_slot() in pbcols.cpp
+L7_STRS = ("version", "request_type", "request_domain", "request_resource",
+           "endpoint", "response_exception", "response_result", "trace_id",
+           "span_id", "parent_span_id", "x_request_id", "process_kname_0",
+           "process_kname_1", "attrs_json", "pod_0", "pod_1")
+
+
+class _DfL7Cols(ctypes.Structure):
+    _pack_ = 1
+    _fields_ = (
+        [(n, ctypes.c_void_p) for n in (
+            "flow_id", "start_time_ns", "end_time_ns",
+            "syscall_trace_id_request", "syscall_trace_id_response",
+            "captured_request_byte", "captured_response_byte",
+            "l7_protocol", "request_id", "response_status",
+            "response_code", "syscall_thread_0", "syscall_thread_1",
+            "gpid_0", "gpid_1", "ip4_src", "ip4_dst", "is_v6",
+            "ip6_src_off", "ip6_dst_off", "port_src", "port_dst", "proto",
+            "tunnel_type", "tunnel_id")]
+        + [("str_off", ctypes.c_void_p * 16),
+           ("str_len", ctypes.c_void_p * 16),
+           ("arena", ctypes.c_void_p),
+           ("arena_cap", ctypes.c_uint32),
+           ("arena_used", ctypes.c_uint32),
+           ("cap", ctypes.c_uint32)])
+
+
+class L7ColumnDecoder:
+    """Reusable buffers for df_decode_l7_cols: FlowLogBatch bytes ->
+    numpy column views for every L7FlowLog field the row build consumes
+    (varints + 16 string columns in a shared arena). decode() returns
+    (n_l7, cols dict, arena bytes-view) or None when the native path
+    can't take the batch (overflow/malformed) — caller falls back to the
+    protobuf Python path."""
+
+    U64 = ("flow_id", "start_time_ns", "end_time_ns",
+           "syscall_trace_id_request", "syscall_trace_id_response",
+           "captured_request_byte", "captured_response_byte")
+    U32 = ("l7_protocol", "request_id", "response_status",
+           "syscall_thread_0", "syscall_thread_1", "gpid_0", "gpid_1",
+           "ip4_src", "ip4_dst", "ip6_src_off", "ip6_dst_off", "tunnel_id")
+    I32 = ("response_code",)
+    U16 = ("port_src", "port_dst")
+    U8 = ("is_v6", "proto", "tunnel_type")
+
+    def __init__(self, cap: int = 65536, arena_cap: int = 1 << 22) -> None:
+        lib = load()
+        if lib is None:
+            raise RuntimeError("libdfnative.so unavailable")
+        self._lib = lib
+        self.cap = cap
+        self.arrays: dict[str, np.ndarray] = {}
+        for names, dt in ((self.U64, np.uint64), (self.U32, np.uint32),
+                          (self.I32, np.int32), (self.U16, np.uint16),
+                          (self.U8, np.uint8)):
+            for n in names:
+                self.arrays[n] = np.zeros(cap, dtype=dt)
+        for s in L7_STRS:
+            self.arrays[f"{s}_off"] = np.zeros(cap, dtype=np.uint32)
+            self.arrays[f"{s}_len"] = np.zeros(cap, dtype=np.uint32)
+        self.arena = np.zeros(arena_cap, dtype=np.uint8)
+        self._cols = _DfL7Cols()
+        for names in (self.U64, self.U32, self.I32, self.U16, self.U8):
+            for n in names:
+                setattr(self._cols, n, self.arrays[n].ctypes.data)
+        for i, s in enumerate(L7_STRS):
+            self._cols.str_off[i] = self.arrays[f"{s}_off"].ctypes.data
+            self._cols.str_len[i] = self.arrays[f"{s}_len"].ctypes.data
+        self._cols.arena = self.arena.ctypes.data
+        self._cols.arena_cap = arena_cap
+        self._cols.cap = cap
+
+    def decode(self, payload: bytes):
+        n = self._lib.df_decode_l7_cols(payload, len(payload),
+                                        ctypes.byref(self._cols))
+        if n < 0:
+            return None
+        n = int(n)
+        cols = {k: a[:n] for k, a in self.arrays.items()}
+        return n, cols, self.arena[:self._cols.arena_used]

@@ -1,0 +1,99 @@
+"""Self-check CLI: report which native fast paths are live.
+
+    python -m deepflow_tpu.native --selfcheck
+
+Builds (or loads) libdfnative.so the same way the server does, then
+probes each fast path with a tiny synthetic input so the report shows
+what will ACTUALLY run — a present-but-ABI-stale .so, a set
+DF_NO_NATIVE, or a missing compiler all show up here as the fallback
+they cause, instead of surfacing later as silently degraded ingest
+throughput.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def _probe_l4(native) -> bool:
+    try:
+        dec = native.L4ColumnDecoder(cap=16)
+        return dec.decode(b"") is not None  # empty batch: 0 rows, no error
+    except Exception:
+        return False
+
+
+def _probe_l7(native) -> bool:
+    try:
+        dec = native.L7ColumnDecoder(cap=16)
+        return dec.decode(b"") is not None
+    except Exception:
+        return False
+
+
+def _probe_eth(native) -> bool:
+    try:
+        outs, ok = native.decode_eth_batch([b"\x00" * 60])
+        return outs is not None and len(ok) == 1
+    except Exception:
+        return False
+
+
+def selfcheck() -> int:
+    from deepflow_tpu import native
+
+    no_native = bool(os.environ.get("DF_NO_NATIVE"))
+    workers = os.environ.get("DF_INGEST_WORKERS", "1")
+    lib = native.load()
+    so = os.path.join(os.path.dirname(native.__file__), "libdfnative.so")
+
+    print("deepflow-tpu native selfcheck")
+    print(f"  DF_NO_NATIVE        : {'1 (kill-switch ON)' if no_native else 'unset'}")
+    print(f"  DF_INGEST_WORKERS   : {workers}")
+    print(f"  libdfnative.so      : "
+          f"{'present' if os.path.exists(so) else 'MISSING'} ({so})")
+    if lib is None:
+        reason = ("kill-switch" if no_native else
+                  "build/load failed or ABI mismatch")
+        print(f"  library             : NOT LOADED ({reason})")
+    else:
+        print(f"  library             : loaded, ABI {lib.df_abi_version()}"
+              f" (expected {native._ABI_VERSION})")
+
+    paths = [
+        ("L4 flow-log columnar decode", lib is not None and _probe_l4(native),
+         "per-field python protobuf parse"),
+        ("L7 flow-log columnar decode", lib is not None and _probe_l7(native),
+         "per-field python protobuf parse"),
+        ("ethernet/IPv4 batch decode", lib is not None and _probe_eth(native),
+         "python struct unpack per header"),
+        ("native FlowMap", lib is not None and hasattr(lib, "df_fm_new"),
+         "python FlowMap"),
+        ("AF_PACKET ring capture", lib is not None and
+         hasattr(lib, "df_ring_open"), "python raw socket recv"),
+    ]
+    live = 0
+    for name, ok, fallback in paths:
+        live += bool(ok)
+        status = "native" if ok else f"fallback ({fallback})"
+        print(f"  {name:<28}: {status}")
+
+    for extra in ("libdfsslprobe.so", "libdfmemhook.so"):
+        p = os.path.join(os.path.dirname(native.__file__), extra)
+        print(f"  {extra:<28}: "
+              f"{'built' if os.path.exists(p) else 'not built'}")
+
+    print(f"  fast paths live     : {live}/{len(paths)}")
+    return 0
+
+
+def main(argv: list[str]) -> int:
+    if "--selfcheck" in argv or not argv:
+        return selfcheck()
+    print(__doc__)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -263,6 +263,225 @@ static bool parse_l4(Reader& rd, const uint8_t* end, DfL4Cols* c,
     return rd.ok;
 }
 
+// Packed column output for one batch of L7 rows. Same ownership model as
+// DfL4Cols: caller-owned arrays with capacity `cap`, strings land in the
+// shared arena as (offset,len) pairs. Layout must match _DfL7Cols in
+// native/__init__.py; bump DF_ABI_VERSION on change.
+#pragma pack(push, 1)
+struct DfL7Cols {
+    uint64_t* flow_id;
+    uint64_t* start_time_ns;
+    uint64_t* end_time_ns;
+    uint64_t* syscall_trace_id_request;
+    uint64_t* syscall_trace_id_response;
+    uint64_t* captured_request_byte;
+    uint64_t* captured_response_byte;
+    uint32_t* l7_protocol;
+    uint32_t* request_id;
+    uint32_t* response_status;
+    int32_t*  response_code;
+    uint32_t* syscall_thread_0;
+    uint32_t* syscall_thread_1;
+    uint32_t* gpid_0;
+    uint32_t* gpid_1;
+    // key
+    uint32_t* ip4_src;         // host byte order; 0 when v6 (see is_v6)
+    uint32_t* ip4_dst;
+    uint8_t*  is_v6;           // 1 -> ips live in the arena
+    uint32_t* ip6_src_off;     // arena offsets (16 bytes each) when v6
+    uint32_t* ip6_dst_off;
+    uint16_t* port_src;
+    uint16_t* port_dst;
+    uint8_t*  proto;
+    uint8_t*  tunnel_type;
+    uint32_t* tunnel_id;
+    // string columns: arena (off,len); len 0 = empty. Order here matches
+    // L7ColumnDecoder.STRS in native/__init__.py.
+    uint32_t* str_off[16];
+    uint32_t* str_len[16];
+    // shared string arena
+    uint8_t*  arena;
+    uint32_t  arena_cap;
+    uint32_t  arena_used;
+    uint32_t  cap;
+};
+#pragma pack(pop)
+
+// proto field number -> index into str_off/str_len (STRS order):
+//   0 version(4) 1 request_type(5) 2 request_domain(6)
+//   3 request_resource(7) 4 endpoint(8) 5 response_exception(12)
+//   6 response_result(13) 7 trace_id(16) 8 span_id(17)
+//   9 parent_span_id(18) 10 x_request_id(19) 11 process_kname_0(29)
+//   12 process_kname_1(30) 13 attrs_json(31) 14 pod_0(32) 15 pod_1(33)
+static int l7_str_slot(uint32_t field) {
+    switch (field) {
+        case 4: return 0; case 5: return 1; case 6: return 2;
+        case 7: return 3; case 8: return 4; case 12: return 5;
+        case 13: return 6; case 16: return 7; case 17: return 8;
+        case 18: return 9; case 19: return 10; case 29: return 11;
+        case 30: return 12; case 31: return 13; case 32: return 14;
+        case 33: return 15;
+        default: return -1;
+    }
+}
+
+static bool arena_put7(DfL7Cols* c, const uint8_t* s, uint64_t n,
+                       uint32_t* off_out, uint32_t* len_out) {
+    if (c->arena_used + n > c->arena_cap) return false;
+    memcpy(c->arena + c->arena_used, s, n);
+    *off_out = c->arena_used;
+    if (len_out) *len_out = (uint32_t)n;
+    c->arena_used += (uint32_t)n;
+    return true;
+}
+
+// Parse one L7FlowLog submessage into row r. Returns false on malformed
+// input or arena overflow.
+static bool parse_l7(Reader& rd, const uint8_t* end, DfL7Cols* c,
+                     uint32_t r) {
+    // zero the row (batches reuse arrays)
+    c->flow_id[r] = c->start_time_ns[r] = c->end_time_ns[r] = 0;
+    c->syscall_trace_id_request[r] = c->syscall_trace_id_response[r] = 0;
+    c->captured_request_byte[r] = c->captured_response_byte[r] = 0;
+    c->l7_protocol[r] = c->request_id[r] = c->response_status[r] = 0;
+    c->response_code[r] = 0;
+    c->syscall_thread_0[r] = c->syscall_thread_1[r] = 0;
+    c->gpid_0[r] = c->gpid_1[r] = 0;
+    c->ip4_src[r] = c->ip4_dst[r] = 0;
+    c->is_v6[r] = 0;
+    c->ip6_src_off[r] = c->ip6_dst_off[r] = 0;
+    c->port_src[r] = c->port_dst[r] = 0;
+    c->proto[r] = 0;
+    c->tunnel_type[r] = 0;
+    c->tunnel_id[r] = 0;
+    for (int i = 0; i < 16; i++) {
+        c->str_off[i][r] = 0;
+        c->str_len[i][r] = 0;
+    }
+
+    while (rd.ok && rd.p < end) {
+        uint64_t tag = rd.varint();
+        if (!rd.ok) return false;
+        uint32_t field = (uint32_t)(tag >> 3), wire = (uint32_t)(tag & 7);
+        if (wire == 0) {
+            uint64_t v = rd.varint();
+            if (!rd.ok) return false;
+            switch (field) {
+                case 1: c->flow_id[r] = v; break;
+                case 3: c->l7_protocol[r] = (uint32_t)v; break;
+                case 9: c->request_id[r] = (uint32_t)v; break;
+                case 10: c->response_status[r] = (uint32_t)v; break;
+                case 11: c->response_code[r] = (int32_t)v; break;
+                case 14: c->start_time_ns[r] = v; break;
+                case 15: c->end_time_ns[r] = v; break;
+                case 20: c->syscall_trace_id_request[r] = v; break;
+                case 21: c->syscall_trace_id_response[r] = v; break;
+                case 22: c->syscall_thread_0[r] = (uint32_t)v; break;
+                case 23: c->syscall_thread_1[r] = (uint32_t)v; break;
+                case 24: c->captured_request_byte[r] = v; break;
+                case 25: c->captured_response_byte[r] = v; break;
+                case 27: c->gpid_0[r] = (uint32_t)v; break;
+                case 28: c->gpid_1[r] = (uint32_t)v; break;
+                default: break;  // 26 agent_id unused by the row build
+            }
+            continue;
+        }
+        if (wire == 2) {
+            uint64_t n = rd.varint();
+            if (!rd.ok || (uint64_t)(end - rd.p) < n) return false;
+            const uint8_t* sub = rd.p;
+            rd.p += n;
+            if (field == 2) {  // FlowKey
+                Reader kr{sub, sub + n};
+                while (kr.ok && kr.p < kr.end) {
+                    uint64_t ktag = kr.varint();
+                    if (!kr.ok) return false;
+                    uint32_t kf = (uint32_t)(ktag >> 3),
+                             kw = (uint32_t)(ktag & 7);
+                    if (kw == 0) {
+                        uint64_t kv = kr.varint();
+                        if (!kr.ok) return false;
+                        switch (kf) {
+                            case 3: c->port_src[r] = (uint16_t)kv; break;
+                            case 4: c->port_dst[r] = (uint16_t)kv; break;
+                            case 5: c->proto[r] = (uint8_t)kv; break;
+                            case 7: c->tunnel_type[r] = (uint8_t)kv; break;
+                            case 8: c->tunnel_id[r] = (uint32_t)kv; break;
+                            default: break;  // 6 tap_port unused on l7
+                        }
+                    } else if (kw == 2) {
+                        uint64_t kn = kr.varint();
+                        if (!kr.ok || (uint64_t)(kr.end - kr.p) < kn)
+                            return false;
+                        const uint8_t* ks = kr.p;
+                        kr.p += kn;
+                        if (kf == 1 || kf == 2) {
+                            if (kn == 4) {
+                                uint32_t ip =
+                                    (uint32_t)ks[0] << 24 |
+                                    (uint32_t)ks[1] << 16 |
+                                    (uint32_t)ks[2] << 8 | ks[3];
+                                (kf == 1 ? c->ip4_src
+                                         : c->ip4_dst)[r] = ip;
+                            } else if (kn == 16) {
+                                c->is_v6[r] = 1;
+                                uint32_t off;
+                                if (!arena_put7(c, ks, kn, &off, nullptr))
+                                    return false;
+                                (kf == 1 ? c->ip6_src_off
+                                         : c->ip6_dst_off)[r] = off;
+                            }
+                        }
+                    } else if (!kr.skip(kw)) {
+                        return false;
+                    }
+                }
+                if (!kr.ok) return false;
+                continue;
+            }
+            int slot = l7_str_slot(field);
+            if (slot >= 0 && n) {
+                if (!arena_put7(c, sub, n, &c->str_off[slot][r],
+                                &c->str_len[slot][r]))
+                    return false;
+            }
+            continue;
+        }
+        if (!rd.skip(wire)) return false;
+    }
+    return rd.ok;
+}
+
+// Decode FlowLogBatch L7 rows columnar (top-level field 2 submessages;
+// L4 submessages are skipped — the L4 pass handles those). Returns the
+// number of L7 rows decoded, or -1 on malformed input / capacity
+// overflow (caller falls back to the Python pb path).
+int64_t df_decode_l7_cols(const uint8_t* data, uint64_t len,
+                          DfL7Cols* cols) {
+    Reader rd{data, data + len};
+    uint32_t n = 0;
+    cols->arena_used = 0;
+    while (rd.ok && rd.p < rd.end) {
+        uint64_t tag = rd.varint();
+        if (!rd.ok) return -1;
+        uint32_t field = (uint32_t)(tag >> 3), wire = (uint32_t)(tag & 7);
+        if (field == 2 && wire == 2) {
+            uint64_t sublen = rd.varint();
+            if (!rd.ok || (uint64_t)(rd.end - rd.p) < sublen) return -1;
+            if (n >= cols->cap) return -1;
+            const uint8_t* sub = rd.p;
+            rd.p += sublen;
+            Reader sr{sub, sub + sublen};
+            if (!parse_l7(sr, sub + sublen, cols, n)) return -1;
+            n++;
+        } else if (!rd.skip(wire)) {
+            return -1;
+        }
+    }
+    if (!rd.ok) return -1;
+    return n;
+}
+
 // Decode FlowLogBatch L4 rows columnar. Returns the number of L4 rows
 // decoded, or -1 on malformed input / capacity overflow (caller falls
 // back to the Python pb path). L7 submessages are NOT parsed; their

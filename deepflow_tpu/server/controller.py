@@ -109,15 +109,31 @@ class GpidAllocator:
     Lifecycle: each agent's sync is a full snapshot — entries that agent
     reported before and no longer does are dropped (a dead process's
     ephemeral port must not attribute a later process's flows), and a TTL
-    sweep retires entries from agents that stopped syncing entirely."""
+    sweep retires entries from agents that stopped syncing entirely.
+
+    Entries are bucketed PER AGENT: a sync diffs only that agent's bucket
+    against the flat lookup index instead of rebuilding the whole
+    fleet-wide table (which made every sync O(fleet) — at 1k agents x 30s
+    sync the controller spent most of its lock hold time re-dict-ing
+    other agents' unchanged entries). The TTL sweep likewise moved off
+    the per-sync path onto an interval: it only has work to do when an
+    agent has been silent for minutes, so running it per sync was pure
+    overhead."""
 
     ENTRY_TTL_S = 600.0
+    SWEEP_INTERVAL_S = 60.0
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._gpids: dict[tuple, int] = {}
-        # key (ip, port, proto, role) -> (entry, monotonic ts)
-        self._entries: dict[tuple, tuple[pb.GpidEntry, float]] = {}
+        # agent_id -> {(ip, port, proto, role): entry} (that agent's
+        # last full snapshot) and its last-sync monotonic timestamp
+        self._by_agent: dict[int, dict[tuple, pb.GpidEntry]] = {}
+        self._agent_ts: dict[int, float] = {}
+        # flat (ip, port, proto, role) -> entry index for ingest-side
+        # point reads; maintained incrementally from the buckets
+        self._flat: dict[tuple, pb.GpidEntry] = {}
+        self._last_sweep = 0.0
         self._next = 1
 
     def gpid_for(self, agent_id: int, pid: int) -> int:
@@ -133,28 +149,45 @@ class GpidAllocator:
     def sync(self, req: pb.GpidSyncRequest) -> pb.GpidSyncResponse:
         now = time.monotonic()
         with self._lock:
-            fresh: set[tuple] = set()
+            bucket: dict[tuple, pb.GpidEntry] = {}
             for e in req.entries:
                 e.agent_id = req.agent_id  # never trust the entry field
                 e.gpid = self._gpids.get((req.agent_id, e.pid), 0) or \
                     self._alloc_locked(req.agent_id, e.pid)
-                key = (bytes(e.ip), e.port, int(e.proto), e.role)
-                self._entries[key] = (e, now)
-                fresh.add(key)
-            # snapshot semantics: this agent's stale entries go away now
-            self._entries = {
-                k: (e, ts) for k, (e, ts) in self._entries.items()
-                if k in fresh or e.agent_id != req.agent_id}
-            # TTL sweep: agents that stopped syncing (crash, drain)
-            cutoff = now - self.ENTRY_TTL_S
-            self._entries = {k: v for k, v in self._entries.items()
-                             if v[1] >= cutoff}
+                bucket[(bytes(e.ip), e.port, int(e.proto), e.role)] = e
+            # snapshot semantics: this agent's stale entries go away now —
+            # only keys this agent owned and stopped reporting are touched
+            old = self._by_agent.get(req.agent_id)
+            if old:
+                for k in old:
+                    if k not in bucket:
+                        cur = self._flat.get(k)
+                        if cur is not None and \
+                                cur.agent_id == req.agent_id:
+                            del self._flat[k]
+            self._by_agent[req.agent_id] = bucket
+            self._agent_ts[req.agent_id] = now
+            self._flat.update(bucket)
+            # TTL sweep (agents that stopped syncing: crash, drain) runs
+            # on an interval, not per sync
+            if now - self._last_sweep >= self.SWEEP_INTERVAL_S:
+                self._sweep_locked(now)
             # echo only the REQUESTER's entries (gpids now filled) — the
             # ingest-side join lives here, and echoing the whole fleet's
             # socket table back on every scan would be O(fleet) waste
             resp = pb.GpidSyncResponse()
             resp.entries.extend(req.entries)
             return resp
+
+    def _sweep_locked(self, now: float) -> None:
+        self._last_sweep = now
+        cutoff = now - self.ENTRY_TTL_S
+        for aid in [a for a, ts in self._agent_ts.items() if ts < cutoff]:
+            for k in self._by_agent.pop(aid, {}):
+                cur = self._flat.get(k)
+                if cur is not None and cur.agent_id == aid:
+                    del self._flat[k]
+            del self._agent_ts[aid]
 
     def _alloc_locked(self, agent_id: int, pid: int) -> int:
         g = self._next
@@ -182,12 +215,12 @@ class GpidAllocator:
         # local addresses agent-side (socket_scan.scan_entries) — a
         # server-side any-ip fallback would attribute flows toward
         # REMOTE endpoints on the same port to a local listener
-        entries = self._entries  # GIL-atomic point reads; values are
-        # replaced per sync, never mutated after insertion
+        flat = self._flat  # GIL-atomic point reads; entry objects are
+        # never mutated after insertion (a sync inserts fresh ones)
         for role in (1, 0):
-            v = entries.get((ip, port, proto, role))
-            if v is not None:
-                return v[0]
+            e = flat.get((ip, port, proto, role))
+            if e is not None:
+                return e
         return None
 
 

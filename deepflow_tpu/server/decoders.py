@@ -11,7 +11,9 @@ import json
 import logging
 import os
 import queue
+import struct
 import threading
+import time
 
 import numpy as np
 
@@ -55,7 +57,11 @@ class Decoder:
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._stats_lock = threading.Lock()
-        self.stats = {"batches": 0, "rows": 0, "errors": 0}
+        # handle_ns: total wall time inside handle(); append_ns: the part
+        # spent in store appends (handle_ns - append_ns = pure decode).
+        # Exposed so the ingest bench can localize regressions per stage.
+        self.stats = {"batches": 0, "rows": 0, "errors": 0,
+                      "handle_ns": 0, "append_ns": 0}
 
     def start(self) -> "Decoder":
         for i in range(max(1, self.workers)):
@@ -72,21 +78,38 @@ class Decoder:
             t.join(timeout=2.0)
         self._threads = []
 
+    DRAIN_FRAMES = 64  # max frames one worker consumes per wakeup
+
     def _run(self) -> None:
         while not self._stop.is_set():
             try:
-                header, payload = self.q.get(timeout=0.2)
+                items = self.q.get(timeout=0.2)
             except queue.Empty:
                 continue
-            try:
-                n = self.handle(header, payload)
-                with self._stats_lock:
-                    self.stats["batches"] += 1
-                    self.stats["rows"] += n
-            except Exception:
-                with self._stats_lock:
-                    self.stats["errors"] += 1
-                log.exception("decode error (%s)", self.MSG_TYPE.name)
+            # greedy drain: the receiver enqueues LISTS of frames (one per
+            # recv()), and each wakeup additionally drains whatever else is
+            # already queued — bounded so one worker doesn't starve its
+            # siblings under WORKERS > 1
+            while len(items) < self.DRAIN_FRAMES:
+                try:
+                    items.extend(self.q.get_nowait())
+                except queue.Empty:
+                    break
+            batches = rows = errors = 0
+            t0 = time.perf_counter_ns()
+            for header, payload in items:
+                try:
+                    rows += self.handle(header, payload)
+                    batches += 1
+                except Exception:
+                    errors += 1
+                    log.exception("decode error (%s)", self.MSG_TYPE.name)
+            dt = time.perf_counter_ns() - t0
+            with self._stats_lock:
+                self.stats["batches"] += batches
+                self.stats["rows"] += rows
+                self.stats["errors"] += errors
+                self.stats["handle_ns"] += dt
 
     def handle(self, header: FrameHeader, payload: bytes) -> int:
         raise NotImplementedError
@@ -99,7 +122,11 @@ class Decoder:
 
     def write(self, table_name: str, rows: list[dict]) -> None:
         """Append + feed the re-export pipeline (reference: exporters)."""
+        t0 = time.perf_counter_ns()
         self.db.table(table_name).append_rows(rows)
+        dt = time.perf_counter_ns() - t0
+        with self._stats_lock:
+            self.stats["append_ns"] += dt
         if self.exporters is not None and rows:
             self.exporters.feed(table_name, rows)
 
@@ -108,7 +135,11 @@ class Decoder:
         """Columnar append (the hot-path shape: one list per column, no
         per-row dicts). Row dicts are materialized for the re-export
         pipeline ONLY if an exporter actually wants this table."""
+        t0 = time.perf_counter_ns()
         self.db.table(table_name).append_columns(cols, n)
+        dt = time.perf_counter_ns() - t0
+        with self._stats_lock:
+            self.stats["append_ns"] += dt
         if (self.exporters is not None and n
                 and self.exporters.wants(table_name)):
             names = list(cols)
@@ -275,9 +306,36 @@ class FlowLogDecoder(Decoder):
     except ValueError:
         WORKERS = 1  # malformed env must not take the server down
 
+    _IP_MEMO_MAX = 1 << 20  # distinct v4 addresses before a full reset
+
     def __init__(self, *args, **kw) -> None:
         super().__init__(*args, **kw)
         self._tl = threading.local()  # per-worker native decode buffers
+        # uint32 ip -> (dotted str, packed bytes), memoized ACROSS batches
+        # (real fleets see a bounded address set, so after warmup the per
+        # batch cost drops to dict gets). Shared by workers: dict get/set
+        # are GIL-atomic, a racy duplicate insert is harmless.
+        self._ip_memo: dict[int, tuple[str, bytes]] = {}
+
+    def _ip_views(self, ip4s: np.ndarray, ip4d: np.ndarray):
+        """(src dotted, dst dotted, src packed, dst packed) row lists for
+        two uint32 address columns; packed lists are None when no gpid
+        table is attached (the only consumer of the bytes form)."""
+        memo = self._ip_memo
+        for u in np.unique(np.concatenate((ip4s, ip4d))).tolist():
+            if u not in memo:
+                if len(memo) >= self._IP_MEMO_MAX:
+                    memo.clear()
+                memo[u] = ("%d.%d.%d.%d" % (u >> 24 & 255, u >> 16 & 255,
+                                            u >> 8 & 255, u & 255),
+                           struct.pack(">I", u))
+        src = [memo[x] for x in ip4s.tolist()]
+        dst = [memo[x] for x in ip4d.tolist()]
+        src_s = [t[0] for t in src]
+        dst_s = [t[0] for t in dst]
+        if self.gpid_table is None:
+            return src_s, dst_s, None, None
+        return src_s, dst_s, [t[1] for t in src], [t[1] for t in dst]
 
     def _fast_decoder(self):
         """Per-thread L4ColumnDecoder (its buffers are not shareable)."""
@@ -289,6 +347,18 @@ class FlowLogDecoder(Decoder):
             except Exception:
                 dec = None
             self._tl.l4cols = dec
+        return dec
+
+    def _fast_l7_decoder(self):
+        """Per-thread L7ColumnDecoder (its buffers are not shareable)."""
+        dec = getattr(self._tl, "l7cols", False)
+        if dec is False:
+            try:
+                from deepflow_tpu.native import L7ColumnDecoder
+                dec = L7ColumnDecoder()
+            except Exception:
+                dec = None
+            self._tl.l7cols = dec
         return dec
 
     def _endpoint_cols(self, items, keys, src_s, dst_s) -> dict:
@@ -312,7 +382,9 @@ class FlowLogDecoder(Decoder):
         for pod/gpid; everything else resolves via the controller gpid
         table / genesis ResourceIndex, deduped per distinct endpoint
         (reference: grpc_platformdata.go QueryIPV4Infos per-side fill).
-        pod0/pod1 may be lists or a scalar broadcast."""
+        pod0/pod1 may be lists or a scalar broadcast; ipb0/ipb1 (bytes
+        form, consumed only by the gpid join) may be None when no gpid
+        table is attached."""
         def aslist(p):
             return _aslist(p, n)
         cols: dict = {}
@@ -399,9 +471,24 @@ class FlowLogDecoder(Decoder):
                 if n_l4:
                     n += self._handle_l4_cols(cols, n_l4, arena, tags, off)
                 if l7segs:
-                    l7 = [pb.L7FlowLog.FromString(payload[o:o + ln])
-                          for o, ln in l7segs]
-                    n += self._handle_l7_list(l7, tags, off)
+                    # L4 columns are consumed above, so the L7 decoder's
+                    # separate buffers may now be filled from the same
+                    # payload (it walks only top-level field-2 records)
+                    l7fast = self._fast_l7_decoder()
+                    l7res = None
+                    if l7fast is not None:
+                        try:
+                            l7res = l7fast.decode(payload)
+                        except Exception:
+                            l7res = None
+                    if l7res is not None and l7res[0] and \
+                            not l7res[1]["is_v6"].any():
+                        n += self._handle_l7_cols(
+                            l7res[1], l7res[0], l7res[2], tags, off)
+                    else:  # v6 / overflow: pb-parse exactly those bytes
+                        l7 = [pb.L7FlowLog.FromString(payload[o:o + ln])
+                              for o, ln in l7segs]
+                        n += self._handle_l7_list(l7, tags, off)
                 return n
         batch = pb.FlowLogBatch.FromString(payload)
         tags = self.platform.tags_for(header.agent_id)
@@ -467,14 +554,8 @@ class FlowLogDecoder(Decoder):
         store columns directly. Per-row Python work is deduped — ip
         strings and gpid endpoints resolve once per DISTINCT value, which
         is how real traffic behaves (bounded host/endpoint sets)."""
-        import struct as _struct
         ip4s, ip4d = cols["ip4_src"], cols["ip4_dst"]
-        ip_lut = {
-            int(u): "%d.%d.%d.%d" % (u >> 24 & 255, u >> 16 & 255,
-                                     u >> 8 & 255, u & 255)
-            for u in np.unique(np.concatenate((ip4s, ip4d))).tolist()}
-        src_s = [ip_lut[x] for x in ip4s.tolist()]
-        dst_s = [ip_lut[x] for x in ip4d.tolist()]
+        src_s, dst_s, ipb0, ipb1 = self._ip_views(ip4s, ip4d)
 
         # agent-labeled pods (usually empty -> scalar broadcast)
         def pods(which: str):
@@ -487,13 +568,8 @@ class FlowLogDecoder(Decoder):
                                      lens.tolist())]
         pod0, pod1 = pods("pod0"), pods("pod1")
 
-        # bytes form of each ip for the gpid join, built once per
-        # distinct address
-        b_lut = {u: _struct.pack(">I", u) for u in ip_lut}
         ep = self._resolve_endpoint_cols(
-            n,
-            [b_lut[x] for x in ip4s.tolist()],
-            [b_lut[x] for x in ip4d.tolist()],
+            n, ipb0, ipb1,
             cols["port_src"].tolist(), cols["port_dst"].tolist(),
             cols["proto"].tolist(),
             cols["gpid_0"].tolist(), cols["gpid_1"].tolist(),
@@ -540,6 +616,107 @@ class FlowLogDecoder(Decoder):
         }
         out.update(tags)
         self.write_columns("flow_log.l4_flow_log", out, n)
+        return n
+
+    def _handle_l7_cols(self, cols: dict, n: int, arena, tags: dict,
+                        off: int) -> int:
+        """Native columnar L7 path (pbcols.cpp DfL7Cols): numpy views
+        become store columns directly; the ~35-key per-row dict build of
+        the pb path disappears. String cells decode from the shared arena
+        once per DISTINCT value (request types, domains, endpoints repeat
+        heavily in real traffic). Must stay row-identical to
+        _handle_l7_list — the golden parity test enforces it."""
+        ab = arena.tobytes()
+        smemo: dict[bytes, str] = {}
+
+        def strs(name: str):
+            """Arena (off,len) pairs -> python strings; scalar "" when the
+            whole column is empty (constant broadcast, store-supported)."""
+            lens = cols[f"{name}_len"]
+            if not lens.any():
+                return ""
+            get = smemo.get
+            out = []
+            for o, ln in zip(cols[f"{name}_off"].tolist(), lens.tolist()):
+                if not ln:
+                    out.append("")
+                    continue
+                b = ab[o:o + ln]
+                s = get(b)
+                if s is None:
+                    s = smemo[b] = b.decode("utf-8", "replace")
+                out.append(s)
+            return out
+
+        ip4s, ip4d = cols["ip4_src"], cols["ip4_dst"]
+        src_s, dst_s, ipb0, ipb1 = self._ip_views(ip4s, ip4d)
+        ep = self._resolve_endpoint_cols(
+            n, ipb0, ipb1,
+            cols["port_src"].tolist(), cols["port_dst"].tolist(),
+            cols["proto"].tolist(),
+            cols["gpid_0"].tolist(), cols["gpid_1"].tolist(),
+            strs("pod_0"), strs("pod_1"), src_s, dst_s)
+
+        if off:
+            t_start = (cols["start_time_ns"].astype(np.int64)
+                       + off).astype(np.uint64)
+        else:
+            t_start = cols["start_time_ns"]
+        dur = np.maximum(
+            cols["end_time_ns"].astype(np.int64)
+            - cols["start_time_ns"].astype(np.int64), 0).astype(np.uint64)
+
+        def kname_merge(agent_kn, resolved):
+            """Agent-observed kernel thread name wins (sslprobe path);
+            the socket-scan join fills the rest — same precedence as the
+            pb path."""
+            if not isinstance(agent_kn, list):  # all-empty broadcast
+                return resolved
+            return [a or r for a, r in
+                    zip(agent_kn, _aslist(resolved, n))]
+
+        out = {
+            "time": t_start,
+            "flow_id": cols["flow_id"],
+            "ip_src": src_s,
+            "ip_dst": dst_s,
+            "port_src": cols["port_src"],
+            "port_dst": cols["port_dst"],
+            "tunnel_type": np.minimum(cols["tunnel_type"], 4),
+            "tunnel_id": cols["tunnel_id"],
+            "l7_protocol": cols["l7_protocol"],
+            "version": strs("version"),
+            "request_type": strs("request_type"),
+            "request_domain": strs("request_domain"),
+            "request_resource": strs("request_resource"),
+            "endpoint": strs("endpoint"),
+            "request_id": cols["request_id"],
+            "response_status": cols["response_status"],
+            "response_code": cols["response_code"],
+            "response_exception": strs("response_exception"),
+            "response_result": strs("response_result"),
+            "response_duration": dur,
+            "trace_id": strs("trace_id"),
+            "span_id": strs("span_id"),
+            "parent_span_id": strs("parent_span_id"),
+            "x_request_id": strs("x_request_id"),
+            "syscall_trace_id_request": cols["syscall_trace_id_request"],
+            "syscall_trace_id_response": cols["syscall_trace_id_response"],
+            "syscall_thread_0": cols["syscall_thread_0"],
+            "syscall_thread_1": cols["syscall_thread_1"],
+            "captured_request_byte": cols["captured_request_byte"],
+            "captured_response_byte": cols["captured_response_byte"],
+            **ep,
+            "process_kname_0": kname_merge(strs("process_kname_0"),
+                                           ep["process_kname_0"]),
+            "process_kname_1": kname_merge(strs("process_kname_1"),
+                                           ep["process_kname_1"]),
+            "attrs": strs("attrs_json"),
+        }
+        out.update(tags)
+        self.write_columns("flow_log.l7_flow_log", out, n)
+        if self.trace_trees is not None:
+            self._feed_trace_trees(out, n)
         return n
 
     def _handle_l7_list(self, l7: list, tags: dict, off: int) -> int:
@@ -608,24 +785,29 @@ class FlowLogDecoder(Decoder):
         from deepflow_tpu.server.tracetree import span_from_l7
 
         def at(col, i):
-            """Columns may be scalars (constant broadcast) or lists."""
-            return col[i] if isinstance(col, list) else col
+            """Columns may be scalars (constant broadcast), lists, or
+            ndarrays (native columnar path)."""
+            return col[i] if isinstance(col, (list, np.ndarray)) else col
         tids = cols["trace_id"]
+        if isinstance(tids, str):
+            if not tids:
+                return  # all-empty broadcast: nothing is traced
+            tids = [tids] * n
         for i in range(n):
             tid = tids[i]
             if not tid:
                 continue
-            proto_i = cols["l7_protocol"][i]
-            status_i = cols["response_status"][i]
+            proto_i = int(at(cols["l7_protocol"], i))
+            status_i = int(at(cols["response_status"], i))
             self.trace_trees.add_span(tid, span_from_l7({
-                "time": cols["time"][i],
-                "flow_id": cols["flow_id"][i],
-                "request_id": cols["request_id"][i],
-                "span_id": cols["span_id"][i],
-                "parent_span_id": cols["parent_span_id"][i],
-                "request_type": cols["request_type"][i],
-                "endpoint": cols["endpoint"][i],
-                "request_resource": cols["request_resource"][i],
+                "time": at(cols["time"], i),
+                "flow_id": at(cols["flow_id"], i),
+                "request_id": at(cols["request_id"], i),
+                "span_id": at(cols["span_id"], i),
+                "parent_span_id": at(cols["parent_span_id"], i),
+                "request_type": at(cols["request_type"], i),
+                "endpoint": at(cols["endpoint"], i),
+                "request_resource": at(cols["request_resource"], i),
                 "app_service": at(cols["app_service"], i)
                 if "app_service" in cols else "",
                 "service_1": at(cols.get("service_1", ""), i),
@@ -636,11 +818,11 @@ class FlowLogDecoder(Decoder):
                 "response_status": (RESPONSE_STATUS[status_i]
                                     if 0 <= status_i < len(RESPONSE_STATUS)
                                     else "unknown"),
-                "response_code": cols["response_code"][i],
-                "response_duration": cols["response_duration"][i],
-                "ip_src": cols["ip_src"][i],
-                "ip_dst": cols["ip_dst"][i],
-                "x_request_id": cols["x_request_id"][i],
+                "response_code": at(cols["response_code"], i),
+                "response_duration": at(cols["response_duration"], i),
+                "ip_src": at(cols["ip_src"], i),
+                "ip_dst": at(cols["ip_dst"], i),
+                "x_request_id": at(cols["x_request_id"], i),
             }))
 
 

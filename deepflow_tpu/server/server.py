@@ -26,7 +26,11 @@ class Server:
                  query_port: int = 20416, data_dir: str | None = None,
                  sync_port: int = 20035, enable_controller: bool = False,
                  ha_lease_path: str | None = None,
-                 ha_k8s_lease: str | None = None) -> None:
+                 ha_k8s_lease: str | None = None,
+                 ingest_workers: int | None = None) -> None:
+        # flow-log decode parallelism for THIS server instance; None
+        # defers to the DF_INGEST_WORKERS env knob read at import time
+        self.ingest_workers = ingest_workers
         # HA: with a lease (file path on a shared volume, OR a K8s Lease
         # object name for clusters without one), cluster SINGLETONS
         # (controller, rollups, janitor) run only on the elected leader;
@@ -120,10 +124,13 @@ class Server:
         ]
         for cls, mtype in pairs:
             q = self.receiver.register(mtype)
+            kw = {}
+            if self.ingest_workers and cls is FlowLogDecoder:
+                kw["workers"] = self.ingest_workers
             d = cls(q, self.db, self.platform, exporters=self.exporters,
                     pod_index=self.pod_index, resources=self.resources,
                     gpid_table=(self.controller.gpids
-                                if self.controller else None))
+                                if self.controller else None), **kw)
             d.MSG_TYPE = mtype  # FlowLogDecoder serves two types
             self.decoders.append(d.start())
         self.receiver.start()

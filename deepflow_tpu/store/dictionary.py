@@ -43,17 +43,25 @@ class Dictionary:
                            count=len(values))
 
     def encode_batch(self, values) -> list[int]:
-        """Batch encode: one dict-get per cell on the hit path (no per-cell
-        function call), falling back to the locked insert only for strings
-        never seen before. The ingest hot path — measured ~3x cheaper than
-        per-cell encode() at flow-log batch sizes."""
+        """Batch encode: one dict-get per cell on the lock-free hit path (no
+        per-cell function call, no lock when every string is known — the
+        read-mostly steady state), then a SINGLE lock acquisition covering
+        all misses instead of one lock round trip per new string. The ingest
+        hot path — measured ~3x cheaper than per-cell encode() at flow-log
+        batch sizes."""
         get = self._str_to_id.get
         out = [get(s) for s in values]
         if None in out:
-            enc = self.encode
-            for i, sid in enumerate(out):
-                if sid is None:
-                    out[i] = enc(values[i])
+            with self._lock:
+                for i, sid in enumerate(out):
+                    if sid is None:
+                        s = values[i]
+                        sid = get(s)  # may have raced in since the scan
+                        if sid is None:
+                            sid = len(self._strings)
+                            self._strings.append(s)
+                            self._str_to_id[s] = sid
+                        out[i] = sid
         return out
 
     def decode(self, sid: int) -> str:

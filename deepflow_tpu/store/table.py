@@ -9,6 +9,7 @@ read path.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import threading
 from dataclasses import dataclass, field
@@ -41,8 +42,36 @@ class ColumnSpec:
         return self.enum_values.index(label)
 
 
+class _Stripe:
+    """One writer thread's private buffer: per column, a list of SEGMENTS —
+    python lists (converted at seal) or typed ndarrays (pass straight
+    through); segment buffering lets the columnar ingest path hand over
+    numpy arrays without a tolist/extend/asarray round trip."""
+
+    __slots__ = ("lock", "buf", "rows")
+
+    def __init__(self, names) -> None:
+        self.lock = threading.Lock()
+        self.buf: dict[str, list] = {n: [] for n in names}
+        self.rows = 0
+
+
 class ColumnarTable:
-    """Append-only columnar table; chunked; per-str-column dictionaries."""
+    """Append-only columnar table; chunked; per-str-column dictionaries.
+
+    Write path is STRIPED: each writer thread buffers into its own stripe
+    (dictionary encodes happen outside any table lock — Dictionary is
+    internally thread-safe) and only touches the shared state to bump the
+    row counter and, at chunk boundaries, to seal its stripe into the
+    shared chunk list. N ingest workers therefore append concurrently
+    instead of serializing on one table lock; the single-writer/many-reader
+    snapshot contract is kept because readers snapshot chunks + stripe
+    buffers under the stripe locks. Row order across stripes is not
+    guaranteed (matches the decoder workers contract).
+
+    Lock order (deadlock-free): stripe lock(s) BEFORE self._lock, always;
+    multi-stripe holders (snapshot/flush/compact) acquire stripe locks in a
+    stable sort order."""
 
     def __init__(self, name: str, columns: list[ColumnSpec],
                  chunk_rows: int = 1 << 16) -> None:
@@ -53,34 +82,52 @@ class ColumnarTable:
             c.name: Dictionary(f"{name}.{c.name}")
             for c in columns if c.kind == "str"}
         self._chunks: list[dict[str, np.ndarray]] = []
-        # write buffer: per column, a list of SEGMENTS — python lists
-        # (converted at seal) or typed ndarrays (pass straight through);
-        # segment buffering lets the columnar ingest path hand over numpy
-        # arrays without a tolist/extend/asarray round trip
-        self._buf: dict[str, list] = {c.name: [] for c in columns}
-        self._buf_rows = 0
-        self._lock = threading.Lock()
+        self._stripes: dict[int, _Stripe] = {}  # thread id -> stripe
+        self._lock = threading.Lock()  # guards _chunks, rows_written,
+        # dicts swap (compaction) and stripe creation
         self.rows_written = 0
 
     # -- write path ----------------------------------------------------------
+
+    def _stripe(self) -> _Stripe:
+        tid = threading.get_ident()
+        s = self._stripes.get(tid)
+        if s is None:
+            with self._lock:
+                s = self._stripes.get(tid)
+                if s is None:
+                    s = self._stripes[tid] = _Stripe(self.columns)
+        return s
+
+    def _all_stripes(self) -> list[_Stripe]:
+        """Stable acquisition order for multi-stripe holders."""
+        return sorted(self._stripes.values(), key=id)
+
+    def _encode_str_segment(self, name: str, v, n: int):
+        """Dictionary-encode one str column value (scalar or per-row) into
+        a buffer segment. Returns (dictionary used, segment) — the caller
+        re-encodes if a compaction swapped the dictionary in between."""
+        d = self.dicts[name]
+        if isinstance(v, (list, np.ndarray)):
+            return d, d.encode_batch(v)
+        return d, np.full(n, d.encode(v), dtype=np.uint32)
 
     def append_rows(self, rows: list[dict]) -> None:
         """Append a batch of row dicts. Missing columns take the default."""
         if not rows:
             return
-        with self._lock:
-            for name, spec in self.columns.items():
-                if spec.kind == "str":
-                    d = self.dicts[name]
-                    seg = [d.encode(r.get(name, "")) for r in rows]
-                else:
-                    dflt = spec.default
-                    seg = [r.get(name, dflt) for r in rows]
-                self._buf[name].append(seg)
-            self._buf_rows += len(rows)
-            self.rows_written += len(rows)
-            if self._buf_rows >= self.chunk_rows:
-                self._seal_locked()
+        segs: dict[str, object] = {}
+        str_raw: dict[str, tuple] = {}
+        for name, spec in self.columns.items():
+            if spec.kind == "str":
+                raw = [r.get(name, "") for r in rows]
+                d, segs[name] = self._encode_str_segment(name, raw,
+                                                         len(rows))
+                str_raw[name] = (d, raw)
+            else:
+                dflt = spec.default
+                segs[name] = [r.get(name, dflt) for r in rows]
+        self._append_segments(segs, len(rows), str_raw)
 
     def append_columns(self, cols: dict[str, list | np.ndarray],
                        n: int | None = None) -> None:
@@ -99,36 +146,54 @@ class ColumnarTable:
                     f"expected {n}")
         if n == 0:
             return
-        with self._lock:
-            for name, spec in self.columns.items():
-                col = self._buf[name]
-                if name in cols:
-                    v = cols[name]
-                    if not isinstance(v, (list, np.ndarray)):  # scalar
-                        if spec.kind == "str":
-                            v = self.dicts[name].encode(v)
-                        try:  # typed constant segment (no per-row list)
-                            col.append(np.full(n, v, dtype=spec.np_dtype))
-                        except (OverflowError, ValueError, TypeError):
-                            col.append([v] * n)  # poisoned: seal handles
-                    elif spec.kind == "str":
-                        col.append(self.dicts[name].encode_batch(v))
-                    elif isinstance(v, np.ndarray):
-                        # typed segment passes through; COPY — callers
-                        # (native decoder) reuse their buffers
-                        col.append(v.astype(spec.np_dtype))
-                    else:
-                        col.append(list(v))  # shallow copy: caller may reuse
+        segs: dict[str, object] = {}
+        str_raw: dict[str, tuple] = {}
+        for name, spec in self.columns.items():
+            if name in cols:
+                v = cols[name]
+                if spec.kind == "str":
+                    d, segs[name] = self._encode_str_segment(name, v, n)
+                    str_raw[name] = (d, v)
+                elif not isinstance(v, (list, np.ndarray)):  # scalar
+                    try:  # typed constant segment (no per-row list)
+                        segs[name] = np.full(n, v, dtype=spec.np_dtype)
+                    except (OverflowError, ValueError, TypeError):
+                        segs[name] = [v] * n  # poisoned: seal handles
+                elif isinstance(v, np.ndarray):
+                    # typed segment passes through; COPY — callers
+                    # (native decoder) reuse their buffers
+                    segs[name] = v.astype(spec.np_dtype)
                 else:
-                    col.append(np.full(n, spec.default,
-                                       dtype=spec.np_dtype))
-            self._buf_rows += n
-            self.rows_written += n
-            if self._buf_rows >= self.chunk_rows:
-                self._seal_locked()
+                    segs[name] = list(v)  # shallow copy: caller may reuse
+            else:
+                segs[name] = np.full(n, spec.default, dtype=spec.np_dtype)
+        self._append_segments(segs, n, str_raw)
 
-    def _materialize_buf(self, name: str, spec) -> np.ndarray:
-        segs = self._buf[name]
+    def _append_segments(self, segs: dict[str, object], n: int,
+                         str_raw: dict[str, tuple] | None = None) -> None:
+        """Buffer pre-encoded segments into this thread's stripe; seal to
+        the shared chunk list at the chunk boundary. str_raw carries the
+        (dictionary used, raw value) per str column so segments encoded
+        against a dictionary that a concurrent compaction has since
+        swapped are re-encoded — compaction holds every stripe lock, so
+        inside our stripe lock the identity check is race-free."""
+        s = self._stripe()
+        with s.lock:
+            if str_raw:
+                for name, (d_used, raw) in str_raw.items():
+                    if self.dicts[name] is not d_used:
+                        _, segs[name] = self._encode_str_segment(
+                            name, raw, n)
+            for name, seg in segs.items():
+                s.buf[name].append(seg)
+            s.rows += n
+            with self._lock:
+                self.rows_written += n
+            if s.rows >= self.chunk_rows:
+                self._seal_stripe(s)
+
+    @staticmethod
+    def _materialize(segs: list, spec) -> np.ndarray:
         if len(segs) == 1 and isinstance(segs[0], np.ndarray):
             return segs[0]
         parts = [s if isinstance(s, np.ndarray)
@@ -136,42 +201,54 @@ class ColumnarTable:
         return (np.concatenate(parts) if parts
                 else np.empty(0, dtype=spec.np_dtype))
 
-    def _seal_locked(self) -> None:
-        if self._buf_rows == 0:
+    def _seal_stripe(self, s: _Stripe) -> None:
+        """Materialize one stripe's buffer into a sealed chunk. Caller
+        holds s.lock (NOT self._lock)."""
+        if s.rows == 0:
             return
         chunk = {}
         try:
             for name, spec in self.columns.items():
-                chunk[name] = self._materialize_buf(name, spec)
+                chunk[name] = self._materialize(s.buf[name], spec)
         except (OverflowError, ValueError, TypeError) as e:
             # a poisoned value must not wedge the table: drop the window
-            dropped = self._buf_rows
+            dropped = s.rows
             for name in self.columns:
-                self._buf[name] = []
-            self._buf_rows = 0
-            self.rows_written -= dropped
+                s.buf[name] = []
+            s.rows = 0
+            with self._lock:
+                self.rows_written -= dropped
             raise ValueError(
                 f"{self.name}: dropped {dropped} buffered rows — "
                 f"value out of range for a column: {e}") from e
         for name in self.columns:
-            self._buf[name] = []
-        self._chunks.append(chunk)
-        self._buf_rows = 0
+            s.buf[name] = []
+        s.rows = 0
+        with self._lock:
+            self._chunks.append(chunk)
 
     def flush(self) -> None:
-        with self._lock:
-            self._seal_locked()
+        for s in self._all_stripes():
+            with s.lock:
+                self._seal_stripe(s)
 
     # -- read path -----------------------------------------------------------
 
     def snapshot(self) -> list[dict[str, np.ndarray]]:
-        """Chunk list incl. current buffer (sealed copy)."""
-        with self._lock:
-            chunks = list(self._chunks)
-            if self._buf_rows:
-                chunks.append({
-                    name: self._materialize_buf(name, spec)
-                    for name, spec in self.columns.items()})
+        """Chunk list incl. every stripe's current buffer (sealed copies).
+        All stripe locks are held while reading so no seal can move rows
+        between the chunk list and a buffer mid-snapshot."""
+        stripes = self._all_stripes()
+        with contextlib.ExitStack() as stack:
+            for s in stripes:
+                stack.enter_context(s.lock)
+            with self._lock:
+                chunks = list(self._chunks)
+            for s in stripes:
+                if s.rows:
+                    chunks.append({
+                        name: self._materialize(s.buf[name], spec)
+                        for name, spec in self.columns.items()})
         return chunks
 
     def column_concat(self, names: list[str],
@@ -233,42 +310,52 @@ class ColumnarTable:
         which <= max_live_frac are still referenced get rebuilt.
 
         Chunks are remapped into NEW chunk dicts and swapped together with
-        the new dictionary under the table lock. A reader that snapshotted
-        before the swap and decodes via self.dicts after it may mis-render
-        strings for that one scan; the janitor runs this rarely
-        (post-trim) to keep the window negligible."""
+        the new dictionary under ALL stripe locks + the table lock — a
+        writer mid-append either encoded against the old dictionary (its
+        stripe lock makes it re-encode, see _append_segments) or will
+        encode against the new one. A reader that snapshotted before the
+        swap and decodes via self.dicts after it may mis-render strings
+        for that one scan; the janitor runs this rarely (post-trim) to
+        keep the window negligible."""
         stats: dict[str, dict] = {}
-        with self._lock:
-            for name in list(self.dicts):
-                d = self.dicts[name]
-                old_n = len(d)
-                if old_n < min_entries:
-                    continue
-                used: set[int] = set()
-                for ch in self._chunks:
-                    used.update(np.unique(ch[name]).tolist())
-                for seg in self._buf[name]:
-                    used.update(np.unique(seg).tolist()
-                                if isinstance(seg, np.ndarray) else seg)
-                used.discard(0)
-                if len(used) + 1 > old_n * max_live_frac:
-                    continue
-                order = sorted(used)
-                strings = [""] + [d.decode(i) for i in order]
-                lut = np.zeros(old_n, dtype=np.uint32)
-                for new_id, old_id in enumerate(order, start=1):
-                    lut[old_id] = new_id
-                self._chunks = [
-                    {**ch, name: lut[ch[name]]} for ch in self._chunks]
-                self._buf[name] = [
-                    lut[seg] if isinstance(seg, np.ndarray)
-                    else [int(lut[i]) for i in seg]
-                    for seg in self._buf[name]]
-                nd = Dictionary(d.name)
-                nd._strings = strings
-                nd._str_to_id = {s: i for i, s in enumerate(strings)}
-                self.dicts[name] = nd
-                stats[name] = {"before": old_n, "after": len(strings)}
+        stripes = self._all_stripes()
+        with contextlib.ExitStack() as stack:
+            for s in stripes:
+                stack.enter_context(s.lock)
+            with self._lock:
+                for name in list(self.dicts):
+                    d = self.dicts[name]
+                    old_n = len(d)
+                    if old_n < min_entries:
+                        continue
+                    used: set[int] = set()
+                    for ch in self._chunks:
+                        used.update(np.unique(ch[name]).tolist())
+                    for s in stripes:
+                        for seg in s.buf[name]:
+                            used.update(np.unique(seg).tolist()
+                                        if isinstance(seg, np.ndarray)
+                                        else seg)
+                    used.discard(0)
+                    if len(used) + 1 > old_n * max_live_frac:
+                        continue
+                    order = sorted(used)
+                    strings = [""] + [d.decode(i) for i in order]
+                    lut = np.zeros(old_n, dtype=np.uint32)
+                    for new_id, old_id in enumerate(order, start=1):
+                        lut[old_id] = new_id
+                    self._chunks = [
+                        {**ch, name: lut[ch[name]]} for ch in self._chunks]
+                    for s in stripes:
+                        s.buf[name] = [
+                            lut[seg] if isinstance(seg, np.ndarray)
+                            else [int(lut[i]) for i in seg]
+                            for seg in s.buf[name]]
+                    nd = Dictionary(d.name)
+                    nd._strings = strings
+                    nd._str_to_id = {s: i for i, s in enumerate(strings)}
+                    self.dicts[name] = nd
+                    stats[name] = {"before": old_n, "after": len(strings)}
         return stats
 
     # -- persistence (npz per chunk + dict json) -----------------------------
@@ -326,7 +413,12 @@ class ColumnarTable:
         if loadable is None:
             return
         dirpath = loadable
-        with self._lock:
+        with contextlib.ExitStack() as stack:
+            for s in self._all_stripes():
+                stack.enter_context(s.lock)
+                s.buf = {name: [] for name in self.columns}
+                s.rows = 0
+            stack.enter_context(self._lock)
             self._chunks = []
             for fn in sorted(os.listdir(dirpath)):
                 if fn.startswith("chunk_") and fn.endswith(".npz"):
