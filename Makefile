@@ -1,0 +1,20 @@
+PYTHON ?= python
+JAX_ENV := env JAX_PLATFORMS=cpu
+
+.PHONY: test selfmon-check bench native
+
+test:
+	timeout -k 10 870 $(JAX_ENV) $(PYTHON) -m pytest tests/ -q -m 'not slow' \
+		--continue-on-collection-errors -p no:cacheprovider -p no:xdist \
+		-p no:randomly
+
+# Brief e2e run of the real agent+server pipeline; exits non-zero if any
+# hop's frame ledger fails to balance or any stage reports no heartbeat.
+selfmon-check:
+	timeout -k 10 120 $(JAX_ENV) $(PYTHON) -m deepflow_tpu.cli.selfmon_check
+
+bench:
+	$(JAX_ENV) $(PYTHON) bench.py
+
+native:
+	$(MAKE) -C deepflow_tpu/native libdfmemhook.so

@@ -211,7 +211,8 @@ def _make_l7_frame():
 
 
 def _run_ingest(make_frame, n_batches: int = 400,
-                workers: int | None = None) -> dict:
+                workers: int | None = None,
+                selfmon: bool | None = None) -> dict:
     """Send n_batches pre-serialized frames through the real receiver ->
     decoder -> columnar store; returns rows/s plus the per-stage split
     (frames dispatched, decode ns, append ns) so a regression localizes
@@ -221,7 +222,7 @@ def _run_ingest(make_frame, n_batches: int = 400,
     from deepflow_tpu.server import Server
 
     server = Server(host="127.0.0.1", ingest_port=0, query_port=0,
-                    ingest_workers=workers)
+                    ingest_workers=workers, selfmon=selfmon)
     server.start()
     try:
         frame, table_name, msg_type = make_frame()
@@ -275,6 +276,26 @@ def _bench_ingest() -> dict:
         "ingest_l7_timed_out": l7_w1["timed_out"] or l7_w4["timed_out"],
         "ingest_l7_workers_scale": (
             l7_w4["rows_per_sec"] > l7_w1["rows_per_sec"]),
+    }
+
+
+def _bench_selfmon_overhead() -> dict:
+    """Self-telemetry overhead gate: the hop ledger + heartbeats ride
+    every ingest hot path, so their cost must stay under 2% of ingest
+    throughput. Best-of-3 per arm — a 2% verdict drowns in single-shot
+    scheduler noise otherwise."""
+    on = max(_run_ingest(_make_l4_frame, selfmon=True)["rows_per_sec"]
+             for _ in range(3))
+    off = max(_run_ingest(_make_l4_frame, selfmon=False)["rows_per_sec"]
+              for _ in range(3))
+    pct = (off - on) / off * 100.0 if off else 0.0
+    return {
+        "selfmon_rows_per_sec_on": on,
+        "selfmon_rows_per_sec_off": off,
+        "selfmon_overhead_pct": round(max(0.0, pct), 2),
+        # perf guard in the same spirit as ingest/pps_below_target:
+        # a telemetry-cost regression must be visible in-round
+        "selfmon_overhead_above_gate": pct > 2.0,
     }
 
 
@@ -523,6 +544,7 @@ def main() -> None:
     cpu_detail = {}
     cpu_detail.update(_bench_packet_path())
     cpu_detail.update(_bench_ingest())
+    cpu_detail.update(_bench_selfmon_overhead())
     cpu_detail.update(_bench_extprofiler())
     # perf guards (VERDICT r03 item 5 / r04 item 8): a regression must be
     # visible in-round, not discovered by the next judge

@@ -80,6 +80,18 @@ class SenderConfig:
 
 
 @dataclass
+class SelfmonConfig:
+    """Self-telemetry spine: frame ledger + heartbeats + deadman
+    (deepflow_tpu/telemetry.py). Also disabled globally by
+    DF_NO_SELFMON=1."""
+    enabled: bool = True
+    # a stage with no heartbeat for this long is flagged wedged (its
+    # stack is snapshotted and shipped via dfstats)
+    deadman_window_s: float = 15.0
+    check_interval_s: float = 0.0   # 0 = deadman_window_s / 4
+
+
+@dataclass
 class AgentConfig:
     agent_id: int = 0
     app_service: str = ""
@@ -116,6 +128,7 @@ class AgentConfig:
     integration: IntegrationConfig = field(
         default_factory=IntegrationConfig)
     sender: SenderConfig = field(default_factory=SenderConfig)
+    selfmon: SelfmonConfig = field(default_factory=SelfmonConfig)
     stats_interval_s: float = 10.0
     sync_interval_s: float = 10.0
 
@@ -139,9 +152,11 @@ class AgentConfig:
                     tuple(x) if isinstance(x, (list, tuple))
                     else _parse_addr(x) for x in sd["servers"]]
             cfg.sender = SenderConfig(**sd)
+        if isinstance(d.get("selfmon"), dict):
+            cfg.selfmon = SelfmonConfig(**d["selfmon"])
         for f in dataclasses.fields(cls):
             if f.name in ("profiler", "tpuprobe", "guard", "integration",
-                          "flow", "sender"):
+                          "flow", "sender", "selfmon"):
                 continue
             if f.name in d:
                 setattr(cfg, f.name, d[f.name])
@@ -168,6 +183,8 @@ class AgentConfig:
             1, 10_000)
         num(self.stats_interval_s, "stats_interval_s", 0.1)
         num(self.sync_interval_s, "sync_interval_s", 0.1)
+        num(self.selfmon.deadman_window_s, "selfmon.deadman_window_s", 0.1)
+        num(self.selfmon.check_interval_s, "selfmon.check_interval_s", 0)
         num(self.guard.max_cpu_pct, "guard.max_cpu_pct", 1)
         num(self.guard.max_mem_mb, "guard.max_mem_mb", 16)
         num(self.guard.check_interval_s, "guard.check_interval_s", 0.1)
@@ -203,6 +220,7 @@ class AgentConfig:
                 "include this host's own telemetry with exclusions off)")
         for b, name in ((self.profiler.enabled, "profiler.enabled"),
                         (self.tpuprobe.enabled, "tpuprobe.enabled"),
+                        (self.selfmon.enabled, "selfmon.enabled"),
                         (self.standalone, "standalone")):
             if not isinstance(b, bool):
                 raise ValueError(f"{name} must be a bool, got {b!r}")
@@ -239,6 +257,9 @@ _TEMPLATE_DOCS = {
     "flow.interface": "capture interface; empty = all",
     "flow.exclude_ports": "never capture these ports (feedback guard)",
     "sender.servers": "ingest endpoints, failover order",
+    "selfmon.deadman_window_s": "flag a stage wedged after this many "
+                                "seconds without a heartbeat",
+    "selfmon.check_interval_s": "deadman scan cadence; 0 = window/4",
 }
 
 

@@ -110,8 +110,18 @@ class Dispatcher:
     def __init__(self, sender=None, agent_id: int = 0,
                  flush_interval_s: float = 1.0,
                  batch_size: int = 256, engine: str = "auto",
-                 labeler=None) -> None:
+                 labeler=None, telemetry=None) -> None:
         self.sender = sender
+        if telemetry is None:
+            from deepflow_tpu.telemetry import Telemetry
+            telemetry = Telemetry("agent", enabled=False)
+        self._telemetry = telemetry
+        # ledger hops: flow_map counts records surfaced by the flow engine,
+        # collector counts metric documents, dispatcher counts wire batches
+        # handed to the sender (the only hop here that can drop)
+        self._fm_hop = telemetry.hop("flow_map")
+        self._co_hop = telemetry.hop("collector")
+        self._hop = telemetry.hop("dispatcher")
         self.labeler = labeler  # agent-side policy/labeler (optional)
         self.batch_size = batch_size
         self.flush_interval_s = flush_interval_s
@@ -175,7 +185,9 @@ class Dispatcher:
         src, dst, action = self._label(node)
         if action == "ignore":
             self.labeler.stats["ignored_flows"] += 1
+            self._fm_hop.account(emitted=1, dropped=1, reason="acl_ignore")
             return
+        self._fm_hop.account(emitted=1, delivered=1)
         f = flow_to_l4_pb(node)
         if src is not None:
             f.pod_0 = src.pod
@@ -189,7 +201,9 @@ class Dispatcher:
         src, dst, action = self._label(record.flow)
         if action == "ignore":
             self.labeler.stats["ignored_flows"] += 1
+            self._fm_hop.account(emitted=1, dropped=1, reason="acl_ignore")
             return
+        self._fm_hop.account(emitted=1, delivered=1)
         self.quadruple.add_l7(record)
         f = record_to_l7_pb(record)
         if src is not None:
@@ -202,27 +216,37 @@ class Dispatcher:
 
     def _flush_l4(self) -> None:
         if not self._l4_buf or self.sender is None:
+            if self._l4_buf:
+                self._hop.account(emitted=1, dropped=1, reason="no_sender")
             self._l4_buf = []
             return
         batch = pb.FlowLogBatch()
         batch.l4.extend(self._l4_buf)
         self._l4_buf = []
+        self._hop.account(emitted=1, delivered=1)
         self.sender.send(MessageType.L4_LOG, batch.SerializeToString())
 
     def _flush_l7(self) -> None:
         if not self._l7_buf or self.sender is None:
+            if self._l7_buf:
+                self._hop.account(emitted=1, dropped=1, reason="no_sender")
             self._l7_buf = []
             return
         batch = pb.FlowLogBatch()
         batch.l7.extend(self._l7_buf)
         self._l7_buf = []
+        self._hop.account(emitted=1, delivered=1)
         self.sender.send(MessageType.L7_LOG, batch.SerializeToString())
 
     def _emit_docs(self, docs: list) -> None:
+        self._co_hop.account(emitted=len(docs))
         if self.sender is None:
+            self._co_hop.account(dropped=len(docs), reason="no_sender")
             return
+        self._co_hop.account(delivered=len(docs))
         batch = pb.DocumentBatch()
         batch.docs.extend(docs)
+        self._hop.account(emitted=1, delivered=1)
         self.sender.send(MessageType.METRICS, batch.SerializeToString())
 
     @property
@@ -301,7 +325,13 @@ class Dispatcher:
         self.flush(force=True)
 
     def _run(self) -> None:
+        hb = self._telemetry.heartbeat(
+            "dispatcher", interval_hint_s=self.flush_interval_s)
+        flushes = 0
+        hb.beat()
         while not self._stop.wait(self.flush_interval_s):
+            flushes += 1
+            hb.beat(progress=flushes)
             try:
                 self.flush()
             except Exception:

@@ -26,7 +26,7 @@ class UniformSender:
 
     def __init__(self, servers: list[tuple[str, int]], agent_id: int = 0,
                  org_id: int = 0, team_id: int = 0, queue_size: int = 8192,
-                 connect_timeout: float = 3.0) -> None:
+                 connect_timeout: float = 3.0, telemetry=None) -> None:
         if not servers:
             raise ValueError("need at least one server address")
         from deepflow_tpu.agent.config import _parse_addr
@@ -43,6 +43,11 @@ class UniformSender:
         self._server_idx = 0
         self.stats = {"sent_frames": 0, "sent_bytes": 0, "dropped": 0,
                       "reconnects": 0, "errors": 0}
+        if telemetry is None:
+            from deepflow_tpu.telemetry import Telemetry
+            telemetry = Telemetry("agent", enabled=False)
+        self._hop = telemetry.hop("sender")
+        self._telemetry = telemetry
 
     def start(self) -> "UniformSender":
         self._thread = threading.Thread(
@@ -58,14 +63,16 @@ class UniformSender:
         with self._q.mutex:
             items = list(self._q.queue)[:n]
         return [{"type": getattr(mt, "name", str(mt)), "bytes": len(p)}
-                for mt, p in items]
+                for mt, p, _enq in items]
 
     def send(self, msg_type: MessageType, payload: bytes) -> bool:
+        self._hop.account(emitted=1)
         try:
-            self._q.put_nowait((msg_type, payload))
+            self._q.put_nowait((msg_type, payload, time.monotonic_ns()))
             return True
         except queue.Full:
             self.stats["dropped"] += 1
+            self._hop.account(dropped=1, reason="queue_full")
             return False
 
     def flush_and_stop(self, timeout: float = 5.0) -> None:
@@ -104,7 +111,9 @@ class UniformSender:
 
     def _run(self) -> None:
         backoff = 0.1
+        hb = self._telemetry.heartbeat("sender")
         while not self._stop.is_set():
+            hb.beat(progress=self.stats["sent_frames"])
             if self._sock is None:
                 if not self._connect():
                     time.sleep(min(backoff, 5.0))
@@ -112,7 +121,7 @@ class UniformSender:
                     continue
                 backoff = 0.1
             try:
-                msg_type, payload = self._q.get(timeout=0.2)
+                msg_type, payload, enq_ns = self._q.get(timeout=0.2)
             except queue.Empty:
                 continue
             frame = encode_frame(
@@ -123,9 +132,12 @@ class UniformSender:
                 self._sock.sendall(frame)
                 self.stats["sent_frames"] += 1
                 self.stats["sent_bytes"] += len(frame)
+                self._hop.account(
+                    delivered=1, wait_ns=time.monotonic_ns() - enq_ns)
             except OSError as e:
                 # the frame is lost; rotate to the next server
                 self.stats["errors"] += 1
+                self._hop.account(dropped=1, reason="send_error")
                 log.warning("send failed (%s); reconnecting", e)
                 self._close()
                 self._server_idx = (self._server_idx + 1) % len(self.servers)

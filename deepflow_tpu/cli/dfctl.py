@@ -20,15 +20,19 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import urllib.error
 import urllib.request
 
 
-def _api(server: str, path: str, body: dict | None = None) -> dict:
+def _api(server: str, path: str, body: dict | None = None,
+         token: str | None = None) -> dict:
     url = f"http://{server}{path}"
     data = json.dumps(body).encode() if body is not None else None
     req = urllib.request.Request(url, data=data)
+    if token:
+        req.add_header("X-DF-Token", token)
     try:
         with urllib.request.urlopen(req, timeout=10) as resp:
             return json.loads(resp.read())
@@ -85,9 +89,20 @@ def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="dfctl")
     parser.add_argument("--server", default="127.0.0.1:20416",
                         help="querier host:port")
+    parser.add_argument("--token", default=None,
+                        help="API token for gated endpoints (repo upload, "
+                             "OTA exec); default $DF_API_TOKEN")
     sub = parser.add_subparsers(dest="cmd", required=True)
 
-    sub.add_parser("health")
+    p_health = sub.add_parser(
+        "health", help="per-stage heartbeats, wedge verdicts, ledger "
+                       "imbalance — server and agents")
+    p_health.add_argument("--json", action="store_true",
+                          help="raw /v1/health JSON instead of tables")
+
+    sub.add_parser(
+        "pipeline", help="hop-by-hop frame ledger waterfall "
+                         "(emitted/delivered/drops/queue waits)")
 
     p_agent = sub.add_parser("agent")
     p_agent.add_argument("action", choices=["list"])
@@ -191,10 +206,77 @@ def main(argv: list[str] | None = None) -> int:
                        help="add: json {type,endpoint,...}; delete: endpoint")
 
     args = parser.parse_args(argv)
+    token = args.token or os.environ.get("DF_API_TOKEN") or None
 
     if args.cmd == "health":
         h = _api(args.server, "/v1/health")
-        print(json.dumps(h, indent=2))
+        if args.json:
+            print(json.dumps(h, indent=2))
+            return 0
+        print(f"status: {h['status']}")
+        if h.get("wedged_stages"):
+            print("wedged: " + ", ".join(h["wedged_stages"]))
+        stages = h.get("stages", [])
+        if stages:
+            print("\nserver stages:")
+            print_table(
+                ["STAGE", "BEATS", "PROGRESS", "AGE_S", "HINT_S", "STATE"],
+                [[s["stage"], s["beats"], s["progress"], s["age_s"],
+                  s["interval_hint_s"],
+                  "WEDGED" if s.get("wedged") else "ok"] for s in stages])
+        ag = h.get("agents_selfmon", {})
+        hbs = ag.get("heartbeats", {})
+        if hbs:
+            print("\nagent stages (via deepflow_system):")
+            print_table(
+                ["STAGE", "BEATS", "PROGRESS", "AGE_S", "STATE"],
+                [[s["stage"], int(s.get("beats", 0)),
+                  int(s.get("progress", 0)), s.get("age_s", ""),
+                  "WEDGED" if s.get("wedged") else "ok"]
+                 for s in sorted(hbs.values(), key=lambda x: x["stage"])])
+        for w in h.get("wedges", []):
+            print(f"\nserver wedge: {w['stage']} "
+                  f"stalled {w.get('stalled_s', '?')}s "
+                  f"(window {w.get('window_s', '?')}s)")
+            if w.get("stack"):
+                print(w["stack"].rstrip())
+        for w in ag.get("wedges", []):
+            print(f"\nagent wedge: {w['stage']} "
+                  f"stalled {w.get('stalled_s', '?')}s")
+            if w.get("stack"):
+                print(w["stack"].rstrip())
+        if "ledger_imbalance" in h:
+            print(f"\nledger imbalance (in-flight): "
+                  f"{h['ledger_imbalance']}")
+    elif args.cmd == "pipeline":
+        h = _api(args.server, "/v1/health")
+        hops = h.get("pipeline", [])
+        if hops:
+            print("server pipeline:")
+            print_table(
+                ["HOP", "EMITTED", "DELIVERED", "DROPPED", "REASONS",
+                 "IN_FLIGHT", "WAIT_P50_MS", "WAIT_P99_MS"],
+                [[p["hop"], p["emitted"], p["delivered"],
+                  p["dropped_total"],
+                  ",".join(f"{k}={v}"
+                           for k, v in sorted(p["dropped"].items())) or "-",
+                  p["in_flight"], p["wait"]["p50_ms"], p["wait"]["p99_ms"]]
+                 for p in hops])
+        ag_hops = h.get("agents_selfmon", {}).get("pipeline", {})
+        if ag_hops:
+            print("\nagent pipeline (via deepflow_system):")
+            print_table(
+                ["HOP", "EMITTED", "DELIVERED", "DROPPED", "REASONS",
+                 "IN_FLIGHT", "WAIT_P99_MS"],
+                [[p["hop"], int(p.get("emitted", 0)),
+                  int(p.get("delivered", 0)), int(p.get("dropped", 0)),
+                  ",".join(f"{k}={int(v)}" for k, v in sorted(
+                      p.get("dropped_by_reason", {}).items())) or "-",
+                  int(p.get("in_flight", 0)), p.get("wait_p99_ms", "")]
+                 for p in sorted(ag_hops.values(),
+                                 key=lambda x: x["hop"])])
+        if not hops and not ag_hops:
+            print("(no pipeline telemetry — selfmon disabled?)")
     elif args.cmd == "agent":
         out = _api(args.server, "/v1/agents")
         rows = [[a["agent_id"], a["hostname"], a["ctrl_ip"],
@@ -207,7 +289,7 @@ def main(argv: list[str] | None = None) -> int:
         import time as _time
         out = _api(args.server, "/v1/agents/exec",
                    {"agent_id": args.agent_id, "cmd": args.command,
-                    "args": args.cargs})
+                    "args": args.cargs}, token=token)
         rid = out["result_id"]
         deadline = _time.time() + args.timeout
         while _time.time() < deadline:
@@ -339,7 +421,8 @@ def main(argv: list[str] | None = None) -> int:
                 data_b64 = base64.b64encode(f.read()).decode()
             out = _api(args.server, "/v1/repo",
                        {"action": "upload", "name": args.name,
-                        "version": args.version, "data_b64": data_b64})
+                        "version": args.version, "data_b64": data_b64},
+                       token=token)
             u = out["uploaded"]
             print(f"uploaded {u['name']}@{u['version']} "
                   f"({u['size']:,}B sha256={u['sha256'][:12]}...)")

@@ -195,8 +195,11 @@ void record_free(void* ptr) {
     pthread_mutex_unlock(&ledger_mu);
 }
 
+void maybe_start_report_thread();  // defined with the report thread below
+
 void maybe_sample(void* ptr, size_t size) {
     if (!inited || ptr == nullptr || tl_in_hook) return;
+    maybe_start_report_thread();  // one relaxed load unless post-fork
     tl_since_sample += size;
     if (tl_since_sample < sample_bytes) return;
     uint64_t weight = tl_since_sample;
@@ -281,16 +284,33 @@ void start_report_thread() {
     pthread_detach(t);
 }
 
+// set by atfork_child, consumed by the first post-fork malloc hook:
+// pthread_create is not async-signal-safe, so it must never run inside
+// the fork handler itself (POSIX only guarantees async-signal-safe
+// calls between fork and exec). A child that execs never trips the
+// flag; a child that mallocs is already past the restricted window.
+int need_report_thread = 0;
+
+void maybe_start_report_thread() {
+    if (!__atomic_load_n(&need_report_thread, __ATOMIC_RELAXED)) return;
+    if (!__atomic_exchange_n(&need_report_thread, 0, __ATOMIC_ACQ_REL))
+        return;  // another thread won the race
+    tl_in_hook = 1;  // pthread_create allocates; not a sample
+    start_report_thread();
+    tl_in_hook = 0;
+}
+
 // fork safety: the ledger mutex must be consistently held across fork
 // (a child forked while another thread holds it would deadlock on its
 // first sampled malloc), and the child needs its own pid + report
-// thread (threads do not survive fork)
+// thread (threads do not survive fork) — the thread is deferred to the
+// first post-fork malloc hook, see need_report_thread above
 void atfork_prepare() { pthread_mutex_lock(&ledger_mu); }
 void atfork_parent() { pthread_mutex_unlock(&ledger_mu); }
 void atfork_child() {
     pthread_mutex_unlock(&ledger_mu);
     my_pid = (uint32_t)getpid();
-    start_report_thread();
+    __atomic_store_n(&need_report_thread, 1, __ATOMIC_RELEASE);
 }
 
 __attribute__((constructor)) void memhook_init() {

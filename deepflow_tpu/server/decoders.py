@@ -44,7 +44,7 @@ class Decoder:
                  platform: PlatformInfoTable, exporters=None,
                  pod_index=None, gpid_table=None,
                  workers: int | None = None, resources=None,
-                 trace_trees=None) -> None:
+                 trace_trees=None, telemetry=None) -> None:
         self.q = q
         self.db = db
         self.platform = platform
@@ -62,11 +62,21 @@ class Decoder:
         # Exposed so the ingest bench can localize regressions per stage.
         self.stats = {"batches": 0, "rows": 0, "errors": 0,
                       "handle_ns": 0, "append_ns": 0}
+        if telemetry is None:
+            from deepflow_tpu.telemetry import Telemetry
+            telemetry = Telemetry("server", enabled=False)
+        self.telemetry = telemetry
+        # hops are created in start(): MSG_TYPE may be assigned after
+        # construction (FlowLogDecoder serves two message types)
+        self._hop = None
+        self._tw_hop = None
 
     def start(self) -> "Decoder":
+        self._hop = self.telemetry.hop(f"decoder.{self.MSG_TYPE.name}")
+        self._tw_hop = self.telemetry.hop("table_write")
         for i in range(max(1, self.workers)):
             t = threading.Thread(
-                target=self._run,
+                target=self._run, args=(i,),
                 name=f"df-decoder-{self.MSG_TYPE.name}-{i}", daemon=True)
             t.start()
             self._threads.append(t)
@@ -80,10 +90,27 @@ class Decoder:
 
     DRAIN_FRAMES = 64  # max frames one worker consumes per wakeup
 
-    def _run(self) -> None:
+    def _unwrap(self, item) -> list:
+        """Accept both the receiver's ``(enqueue_ns, frames)`` shape and a
+        bare frame list (tests feed decoder queues directly); account the
+        dequeue on the ledger + queue-wait histogram."""
+        if isinstance(item, tuple):
+            enq_ns, frames = item
+            self._hop.account(emitted=len(frames),
+                              wait_ns=time.monotonic_ns() - enq_ns)
+        else:
+            frames = item
+            self._hop.account(emitted=len(frames))
+        return frames
+
+    def _run(self, worker_idx: int = 0) -> None:
+        hb = self.telemetry.heartbeat(
+            f"decoder.{self.MSG_TYPE.name}.{worker_idx}")
+        handled = 0
         while not self._stop.is_set():
+            hb.beat(progress=handled)
             try:
-                items = self.q.get(timeout=0.2)
+                items = self._unwrap(self.q.get(timeout=0.2))
             except queue.Empty:
                 continue
             # greedy drain: the receiver enqueues LISTS of frames (one per
@@ -92,7 +119,7 @@ class Decoder:
             # siblings under WORKERS > 1
             while len(items) < self.DRAIN_FRAMES:
                 try:
-                    items.extend(self.q.get_nowait())
+                    items = items + self._unwrap(self.q.get_nowait())
                 except queue.Empty:
                     break
             batches = rows = errors = 0
@@ -105,6 +132,9 @@ class Decoder:
                     errors += 1
                     log.exception("decode error (%s)", self.MSG_TYPE.name)
             dt = time.perf_counter_ns() - t0
+            handled += len(items)
+            self._hop.account(delivered=batches, dropped=errors,
+                              reason="decode_error" if errors else "")
             with self._stats_lock:
                 self.stats["batches"] += batches
                 self.stats["rows"] += rows
@@ -125,6 +155,9 @@ class Decoder:
         t0 = time.perf_counter_ns()
         self.db.table(table_name).append_rows(rows)
         dt = time.perf_counter_ns() - t0
+        if self._tw_hop is not None:
+            self._tw_hop.account(emitted=len(rows), delivered=len(rows),
+                                 wait_ns=dt)
         with self._stats_lock:
             self.stats["append_ns"] += dt
         if self.exporters is not None and rows:
@@ -138,6 +171,8 @@ class Decoder:
         t0 = time.perf_counter_ns()
         self.db.table(table_name).append_columns(cols, n)
         dt = time.perf_counter_ns() - t0
+        if self._tw_hop is not None:
+            self._tw_hop.account(emitted=n, delivered=n, wait_ns=dt)
         with self._stats_lock:
             self.stats["append_ns"] += dt
         if (self.exporters is not None and n

@@ -140,7 +140,13 @@ class _ResourceLoop:
 
     def _run(self) -> None:
         backoff = 1.0
+        # a watch legitimately blocks up to watch_timeout_s between
+        # beats — declare it so the deadman widens this stage's window
+        hb = self.g.telemetry.heartbeat(
+            f"genesis.{self.count_key}",
+            interval_hint_s=float(self.g.watch_timeout_s))
         while not self.g._stop.is_set():
+            hb.beat(progress=self.g.stats["events"])
             try:
                 if not self.resource_version:
                     self.list_once()
@@ -172,7 +178,8 @@ class K8sGenesis:
                  watch_timeout_s: int = 300,
                  insecure_skip_verify: bool = False,
                  event_sink=None,
-                 resources: ResourceIndex | None = None) -> None:
+                 resources: ResourceIndex | None = None,
+                 telemetry=None) -> None:
         # event_sink(rows) receives resource-change events through the
         # snapshot-diff recorder (reference: controller/recorder resource
         # diffs -> event tables): added/deleted AND attribute-level
@@ -194,6 +201,10 @@ class K8sGenesis:
         self._ctx = build_api_context(self.api_base, ca_path,
                                       insecure_skip_verify)
         self._stop = threading.Event()
+        if telemetry is None:
+            from deepflow_tpu.telemetry import Telemetry
+            telemetry = Telemetry("server", enabled=False)
+        self.telemetry = telemetry
         self.stats = {"pods": 0, "events": 0, "relists": 0, "errors": 0,
                       "services": 0, "endpoints": 0, "nodes": 0}
         self._loops = [_ResourceLoop(

@@ -38,7 +38,7 @@ DEFAULT_TTL_S = {
 
 class Janitor:
     def __init__(self, db, ttl_s: dict | None = None,
-                 interval_s: float = 300.0) -> None:
+                 interval_s: float = 300.0, telemetry=None) -> None:
         self.db = db
         self.ttl_s = dict(DEFAULT_TTL_S)
         if ttl_s:
@@ -47,6 +47,10 @@ class Janitor:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.stats = {"sweeps": 0, "rows_trimmed": 0}
+        if telemetry is None:
+            from deepflow_tpu.telemetry import Telemetry
+            telemetry = Telemetry("server", enabled=False)
+        self._telemetry = telemetry
 
     def start(self) -> "Janitor":
         if self.running():
@@ -105,7 +109,13 @@ class Janitor:
         return trimmed
 
     def _run(self) -> None:
+        # interval_hint: the janitor legitimately sleeps interval_s
+        # between beats; the deadman widens its window accordingly
+        hb = self._telemetry.heartbeat("janitor",
+                                       interval_hint_s=self.interval_s)
+        hb.beat()
         while not self._stop.wait(self.interval_s):
+            hb.beat(progress=self.stats["sweeps"])
             try:
                 self.sweep()
             except Exception:

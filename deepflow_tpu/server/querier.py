@@ -24,18 +24,31 @@ log = logging.getLogger("df.querier")
 _DD_TRACE_PATHS = ("/v0.3/traces", "/v0.4/traces")
 
 
+class AuthError(Exception):
+    """Missing/invalid API token on a gated endpoint (HTTP 403)."""
+
+
 class QuerierAPI:
     """Route logic, separated from HTTP plumbing for in-process use."""
 
     def __init__(self, db: Database, stats_provider=None,
                  controller=None, exporters=None, alerts=None,
-                 trace_trees=None) -> None:
+                 trace_trees=None, telemetry=None,
+                 api_token: str | None = None) -> None:
         self.db = db
         self.stats_provider = stats_provider or (lambda: {})
         self.controller = controller
         self.exporters = exporters
         self.alerts = alerts
         self.trace_trees = trace_trees  # TraceTreeBuilder (optional)
+        self.telemetry = telemetry  # server-side Telemetry (optional)
+        # shared token gating the mutating control-plane surface
+        # (/v1/repo upload, the OTA `upgrade` exec). Empty/None = open:
+        # the default deployment binds the querier to localhost, and the
+        # trust boundary is documented in docs/SECURITY.md.
+        import os as _os
+        self.api_token = (api_token if api_token is not None
+                          else _os.environ.get("DF_API_TOKEN", ""))
         from deepflow_tpu.server.integration import IntegrationAPI
         # combined binary: ingest shares the controller's authoritative
         # SmartEncoding allocator; standalone: process-local allocator
@@ -132,9 +145,10 @@ class QuerierAPI:
                 raise qengine.QueryError(
                     f"table {table.name!r} has no org scoping; "
                     "query it without org_id")
-            # tenancy enforcement OUTSIDE the user's SQL text: AND the
-            # org filter into the parsed AST (reference: ORG_ID threading
-            # through the querier)
+            # cooperative VIEW filter, not a security boundary: the
+            # caller names the org it wants and nothing verifies it may
+            # (see docs/SECURITY.md). ANDed into the parsed AST rather
+            # than the SQL text so the filter can't be quoted away.
             cond = qsql.BinOp("=", qsql.Col("org_id"),
                               qsql.Lit(int(org)))
             select.where = (cond if select.where is None
@@ -304,7 +318,8 @@ class QuerierAPI:
     def orgs_api(self, body: dict) -> dict:
         """Org/team scoping admin (reference: controller/db org model):
         assign an agent group to an org; list assignments. Scoped reads
-        pass org_id on /v1/query and the PromQL endpoints."""
+        pass org_id on /v1/query and the PromQL endpoints — cooperative
+        view filtering only, not tenant isolation (docs/SECURITY.md)."""
         if self.controller is None:
             raise qengine.QueryError("no controller")
         action = body.get("action", "list")
@@ -320,7 +335,15 @@ class QuerierAPI:
         return {"orgs": self.controller.org_assignments(),
                 "default_org": 1}
 
-    def repo_api(self, body: dict) -> dict:
+    def _require_token(self, token: str | None, what: str) -> None:
+        """Reject a gated control-plane action unless the caller presented
+        the shared token (no-op when no token is configured — localhost
+        trust, see docs/SECURITY.md)."""
+        if self.api_token and (token or "") != self.api_token:
+            raise AuthError(f"{what} requires a valid API token "
+                            "(X-DF-Token header or token field)")
+
+    def repo_api(self, body: dict, token: str | None = None) -> dict:
         """Agent package repo (reference: deepflow-ctl repo agent
         upload): upload versioned packages for OTA rollout; list them.
         Rollout = `dfctl exec <agent> upgrade version=vX`."""
@@ -328,6 +351,9 @@ class QuerierAPI:
             raise qengine.QueryError("no controller")
         action = body.get("action", "list")
         if action == "upload":
+            # uploads feed the OTA path: an unauthenticated upload would
+            # be remote code execution on every agent that upgrades
+            self._require_token(token, "/v1/repo upload")
             import base64
             try:
                 data = base64.b64decode(body.get("data_b64", ""),
@@ -780,7 +806,7 @@ class QuerierAPI:
                     from None
         return {"analyzers": self.controller.analyzers()}
 
-    def agent_exec(self, body: dict) -> dict:
+    def agent_exec(self, body: dict, token: str | None = None) -> dict:
         """Queue a registry command for an agent; poll with result_id."""
         if self.controller is None:
             raise qengine.QueryError("no controller")
@@ -793,6 +819,10 @@ class QuerierAPI:
         cmd = str(body.get("cmd", ""))
         if not agent_id or not cmd:
             raise qengine.QueryError("agent_id and cmd required")
+        if cmd == "upgrade":
+            # `upgrade` makes the agent re-exec a repo package: it is the
+            # other half of the OTA code-execution path — same gate
+            self._require_token(token, "the `upgrade` exec command")
         cid = self.controller.commands.submit(
             agent_id, cmd, [str(a) for a in body.get("args", [])])
         return {"result_id": cid}
@@ -836,12 +866,43 @@ class QuerierAPI:
         return {"group": group, "version": version}
 
     def health(self) -> dict:
-        return {
+        """Liveness + the self-telemetry spine: per-stage heartbeat
+        status, the per-hop frame ledger (with imbalance), and wedge
+        verdicts — the server's from its live Telemetry, the agents'
+        mined back out of deepflow_system.deepflow_system (they run in
+        other processes; the DFSTATS path is their only voice here)."""
+        out = {
             "status": "ok",
             "tables": {name: len(self.db.table(name))
                        for name in self.db.tables()},
             "stats": self.stats_provider(),
         }
+        wedged_stages: list[str] = []
+        if self.telemetry is not None:
+            selfmon = self.telemetry.snapshot()
+            out["selfmon"] = selfmon
+            out["stages"] = selfmon["stages"]
+            out["pipeline"] = selfmon["pipeline"]
+            out["ledger_imbalance"] = selfmon["ledger_imbalance"]
+            out["wedges"] = selfmon["wedges"]
+            wedged_stages += [w["stage"] for w in selfmon["wedges"]]
+        from deepflow_tpu.telemetry import collect_agent_selfmon
+        agents = collect_agent_selfmon(self.db)
+        if (agents["pipeline"] or agents["heartbeats"]
+                or agents["wedges"]):
+            out["agents_selfmon"] = agents
+        # an agent wedge only degrades health while it is CURRENT
+        # (latest heartbeat row still says wedged=1): recovered stages
+        # stop counting even though their verdict rows persist
+        live = {s["stage"] for s in agents["heartbeats"].values()
+                if s.get("wedged")}
+        for w in agents["wedges"]:
+            if w["stage"] in live or not agents["heartbeats"]:
+                wedged_stages.append("agent:" + w["stage"])
+        if wedged_stages:
+            out["status"] = "degraded"
+            out["wedged_stages"] = sorted(set(wedged_stages))
+        return out
 
 
 class QuerierHTTP:
@@ -885,6 +946,19 @@ class QuerierHTTP:
 
             def _body(self) -> dict:
                 return json.loads(self._raw() or b"{}")
+
+            def _token(self, body: dict | None = None) -> str | None:
+                """Shared API token: X-DF-Token header, Bearer auth, or a
+                `token` body field (dfctl sends the header)."""
+                tok = self.headers.get("X-DF-Token")
+                if tok:
+                    return tok
+                auth = self.headers.get("Authorization", "")
+                if auth.startswith("Bearer "):
+                    return auth[len("Bearer "):]
+                if body is not None:
+                    return body.get("token")
+                return None
 
             def do_GET(self) -> None:
                 from urllib.parse import parse_qsl, urlparse
@@ -984,9 +1058,11 @@ class QuerierHTTP:
                     elif path == "/v1/orgs":
                         self._send(200, api.orgs_api(body))
                     elif path == "/v1/repo":
-                        self._send(200, api.repo_api(body))
+                        self._send(200, api.repo_api(
+                            body, token=self._token(body)))
                     elif path == "/v1/agents/exec":
-                        self._send(200, api.agent_exec(body))
+                        self._send(200, api.agent_exec(
+                            body, token=self._token(body)))
                     elif path == "/v1/agent-group-config":
                         self._send(200, api.update_agent_config(body))
                     elif path == "/v1/trace/Tracing":
@@ -1020,6 +1096,8 @@ class QuerierHTTP:
                                    resp or {"accepted": True})
                     else:
                         self._send(404, {"error": f"no route {self.path}"})
+                except AuthError as e:
+                    self._send(403, {"error": str(e)})
                 except (qengine.QueryError, qsql.SqlError, KeyError,
                         json.JSONDecodeError, ValueError,
                         yaml.YAMLError) as e:

@@ -12,6 +12,7 @@ import queue
 import socket
 import socketserver
 import threading
+import time
 
 from deepflow_tpu.codec import (
     FrameDecodeError, FrameHeader, MessageType, StreamDecoder, decode_frame)
@@ -23,7 +24,8 @@ class Receiver:
     """Listens on TCP (and UDP) and fans frames out to registered queues."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 20033,
-                 queue_size: int = 4096, enable_udp: bool = True) -> None:
+                 queue_size: int = 4096, enable_udp: bool = True,
+                 telemetry=None) -> None:
         self.host = host
         self.port = port
         self._queues: dict[MessageType, queue.Queue] = {}
@@ -34,6 +36,11 @@ class Receiver:
         self._enable_udp = enable_udp
         self.stats = {"frames": 0, "bytes": 0, "dropped": 0, "bad_frames": 0,
                       "connections": 0}
+        if telemetry is None:
+            from deepflow_tpu.telemetry import Telemetry
+            telemetry = Telemetry("server", enabled=False)
+        self.telemetry = telemetry
+        self._hop = telemetry.hop("receiver")
 
     def register(self, msg_type: MessageType) -> queue.Queue:
         q = self._queues.get(msg_type)
@@ -44,19 +51,24 @@ class Receiver:
 
     def _dispatch(self, header: FrameHeader, payload: bytes) -> None:
         """Hand one frame to its decoder queue (UDP path: one frame per
-        datagram). Queue items are LISTS of (header, payload) so consumers
-        see one contract for both paths."""
+        datagram). Queue items are (enqueue_ns, LIST of (header, payload))
+        so consumers see one contract for both paths and can histogram
+        their queue wait."""
         self.stats["frames"] += 1
         self.stats["bytes"] += len(payload)
+        self._hop.account(emitted=1)
         q = self._queues.get(header.msg_type)
         if q is None:
             self.stats["dropped"] += 1
+            self._hop.account(dropped=1, reason="no_handler")
             return
         try:
-            q.put_nowait([(header, payload)])
+            q.put_nowait((time.monotonic_ns(), [(header, payload)]))
+            self._hop.account(delivered=1)
         except queue.Full:
             # backpressure stance: drop newest, count it (reference drops too)
             self.stats["dropped"] += 1
+            self._hop.account(dropped=1, reason="queue_full")
 
     def _dispatch_many(self, frames: list[tuple[FrameHeader, bytes]]) -> None:
         """Hand all frames parsed out of one recv() to their decoder queues
@@ -71,16 +83,21 @@ class Receiver:
             if group is None:
                 group = by_type[header.msg_type] = []
             group.append((header, payload))
+        self._hop.account(emitted=len(frames))
+        enq_ns = time.monotonic_ns()
         for msg_type, group in by_type.items():
             q = self._queues.get(msg_type)
             if q is None:
                 self.stats["dropped"] += len(group)
+                self._hop.account(dropped=len(group), reason="no_handler")
                 continue
             try:
-                q.put_nowait(group)
+                q.put_nowait((enq_ns, group))
+                self._hop.account(delivered=len(group))
             except queue.Full:
                 # backpressure stance: drop newest, count it
                 self.stats["dropped"] += len(group)
+                self._hop.account(dropped=len(group), reason="queue_full")
 
     # -- TCP -----------------------------------------------------------------
 
@@ -109,9 +126,18 @@ class Receiver:
                         log.warning("dropping connection: %s", e)
                         return
 
+        # NOT beaten here: the first beat records the owning thread's
+        # ident for stack snapshots, and that must be the serve loop
+        hb = self.telemetry.heartbeat("receiver", interval_hint_s=0.5)
+
         class Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
             daemon_threads = True
+
+            def service_actions(inner) -> None:
+                # called by serve_forever every poll (~0.5s): the accept
+                # loop's own liveness, with frame count as progress
+                hb.beat(progress=recv.stats["frames"])
 
         self._tcp = Server((self.host, self.port), Handler)
         self.port = self._tcp.server_address[1]  # resolve port 0

@@ -6,7 +6,9 @@ receiver, modules) combined with server/cmd/server/main.go (one process).
 
 from __future__ import annotations
 
+import json
 import logging
+import threading
 import time
 
 from deepflow_tpu.codec import MessageType
@@ -27,7 +29,12 @@ class Server:
                  sync_port: int = 20035, enable_controller: bool = False,
                  ha_lease_path: str | None = None,
                  ha_k8s_lease: str | None = None,
-                 ingest_workers: int | None = None) -> None:
+                 ingest_workers: int | None = None,
+                 query_host: str | None = None,
+                 selfmon: bool | None = None,
+                 deadman_window_s: float = 15.0,
+                 selfstats_interval_s: float = 10.0,
+                 api_token: str | None = None) -> None:
         # flow-log decode parallelism for THIS server instance; None
         # defers to the DF_INGEST_WORKERS env knob read at import time
         self.ingest_workers = ingest_workers
@@ -47,7 +54,18 @@ class Server:
         # subnets) shared by every ingest decoder
         self.resources = ResourceIndex(self.pod_index)
         self.genesis = None            # started via start_genesis()
-        self.receiver = Receiver(host=host, port=ingest_port)
+        # self-telemetry spine: per-hop frame ledger + stage heartbeats
+        # + deadman detection (see deepflow_tpu/telemetry.py). One
+        # Telemetry per Server instance — tests run several per process.
+        from deepflow_tpu.telemetry import DeadmanDetector, Telemetry
+        self.telemetry = Telemetry("server", enabled=selfmon)
+        self.deadman = DeadmanDetector(self.telemetry,
+                                       window_s=deadman_window_s)
+        self._selfstats_interval_s = selfstats_interval_s
+        self._selfstats_stop = threading.Event()
+        self._selfstats_thread: threading.Thread | None = None
+        self.receiver = Receiver(host=host, port=ingest_port,
+                                 telemetry=self.telemetry)
         self.decoders = []
         self.controller = None
         if enable_controller:
@@ -69,12 +87,16 @@ class Server:
         self.api = QuerierAPI(self.db, stats_provider=self._stats,
                               controller=self.controller,
                               exporters=self.exporters, alerts=self.alerts,
-                              trace_trees=self.trace_trees)
-        self.http = QuerierHTTP(self.api, host=host, port=query_port)
+                              trace_trees=self.trace_trees,
+                              telemetry=self.telemetry,
+                              api_token=api_token)
+        self.http = QuerierHTTP(self.api,
+                                host=query_host if query_host else host,
+                                port=query_port)
         from deepflow_tpu.server.datasource import RollupJob
         from deepflow_tpu.server.janitor import Janitor
         self.rollup = RollupJob(self.db)
-        self.janitor = Janitor(self.db)
+        self.janitor = Janitor(self.db, telemetry=self.telemetry)
         self._started = False
 
     def start_genesis(self, api_base: str | None = None, token: str = "",
@@ -89,7 +111,8 @@ class Server:
             self.genesis = K8sGenesis(self.pod_index, api_base=api_base,
                                       token=token, ca_path=ca_path,
                                       event_sink=_events,
-                                      resources=self.resources).start()
+                                      resources=self.resources,
+                                      telemetry=self.telemetry).start()
             return True
         except (RuntimeError, ValueError) as e:
             # ValueError: https without ca (e.g. serviceaccount ca.crt
@@ -106,6 +129,34 @@ class Server:
             "genesis": (dict(self.genesis.stats)
                         if self.genesis is not None else None),
         }
+
+    def _selfstats_loop(self) -> None:
+        """Write the server's OWN telemetry into deepflow_system — the
+        analog of the reference's ckmonitor/self stats: the server has no
+        agent in front of it, so it writes rows directly rather than
+        shipping a StatsBatch to itself."""
+        hb = self.telemetry.heartbeat(
+            "selfstats", interval_hint_s=self._selfstats_interval_s)
+        while not self._selfstats_stop.wait(self._selfstats_interval_s):
+            hb.beat()
+            try:
+                self._write_selfstats()
+            except Exception:
+                log.exception("selfstats write failed")
+
+    def _write_selfstats(self) -> None:
+        tags = self.platform.tags_for(0)
+        now = time.time_ns()
+        rows = []
+        for name, mtags, values in self.telemetry.stats_metrics():
+            tag_json = json.dumps(mtags, sort_keys=True)
+            for vname, v in values.items():
+                rows.append({"time": now, "metric_name": name,
+                             "tag_json": tag_json, "value_name": vname,
+                             "value": v, **tags})
+        if rows:
+            self.db.table("deepflow_system.deepflow_system") \
+                .append_rows(rows)
 
     def start(self) -> "Server":
         if self.db.data_dir:
@@ -130,12 +181,20 @@ class Server:
             d = cls(q, self.db, self.platform, exporters=self.exporters,
                     pod_index=self.pod_index, resources=self.resources,
                     gpid_table=(self.controller.gpids
-                                if self.controller else None), **kw)
+                                if self.controller else None),
+                    telemetry=self.telemetry, **kw)
             d.MSG_TYPE = mtype  # FlowLogDecoder serves two types
             self.decoders.append(d.start())
         self.receiver.start()
         self.http.start()
         self.alerts.start()
+        self.deadman.start()
+        if self.telemetry.enabled:
+            self._selfstats_stop.clear()
+            self._selfstats_thread = threading.Thread(
+                target=self._selfstats_loop, name="df-selfstats",
+                daemon=True)
+            self._selfstats_thread.start()
         if self.ha_k8s_lease:
             import os as _os_e
             from deepflow_tpu.server.election import K8sLeaseElection
@@ -190,6 +249,11 @@ class Server:
             self.genesis = None
         if not self._started:
             return
+        self.deadman.stop()
+        self._selfstats_stop.set()
+        if self._selfstats_thread is not None:
+            self._selfstats_thread.join(timeout=2.0)
+            self._selfstats_thread = None
         self.receiver.stop()
         for d in self.decoders:
             d.stop()
@@ -233,7 +297,21 @@ def main() -> None:
     parser = argparse.ArgumentParser(description="deepflow-tpu server")
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--ingest-port", type=int, default=20033)
+    # querier default: LOCALHOST. The query surface carries control-plane
+    # mutations (repo upload, agent exec); exposing it is an explicit
+    # opt-in (--query-host 0.0.0.0) best paired with --api-token.
+    # See docs/SECURITY.md.
+    parser.add_argument("--query-host", default="127.0.0.1",
+                        help="querier bind address (default localhost; "
+                             "set 0.0.0.0 to expose, ideally with "
+                             "--api-token)")
     parser.add_argument("--query-port", type=int, default=20416)
+    parser.add_argument("--api-token", default=None,
+                        help="shared token gating /v1/repo upload and the "
+                             "OTA upgrade exec (default: $DF_API_TOKEN)")
+    parser.add_argument("--deadman-window-s", type=float, default=15.0,
+                        help="flag a stage wedged after this many seconds "
+                             "without a heartbeat")
     parser.add_argument("--sync-port", type=int, default=20035)
     parser.add_argument("--data-dir", default=None)
     parser.add_argument("--ha-lease", default=None,
@@ -249,9 +327,12 @@ def main() -> None:
         format="%(asctime)s %(name)s %(levelname)s %(message)s")
     server = Server(host=args.host, ingest_port=args.ingest_port,
                     query_port=args.query_port, sync_port=args.sync_port,
+                    query_host=args.query_host,
                     data_dir=args.data_dir,
                     ha_lease_path=args.ha_lease,
                     ha_k8s_lease=args.ha_k8s_lease,
+                    api_token=args.api_token,
+                    deadman_window_s=args.deadman_window_s,
                     enable_controller=not args.no_controller).start()
     try:
         while True:

@@ -28,6 +28,12 @@ class TpuProbe:
         self.sources: list = []
         self._lock = threading.Lock()
         self.stats = {"spans_sent": 0, "batches": 0}
+        telemetry = getattr(agent, "telemetry", None)
+        if telemetry is None:
+            from deepflow_tpu.telemetry import Telemetry
+            telemetry = Telemetry("agent", enabled=False)
+        self.telemetry = telemetry
+        self._hop = telemetry.hop("tpuprobe")
 
     def start(self) -> "TpuProbe":
         mode = self.cfg.source
@@ -39,12 +45,14 @@ class TpuProbe:
                 interval_s=self.cfg.trace_interval_s,
                 duration_ms=self.cfg.trace_duration_ms,
                 target_coverage=self.cfg.target_coverage,
-                steps_per_capture=self.cfg.steps_per_capture).start())
+                steps_per_capture=self.cfg.steps_per_capture,
+                telemetry=self.telemetry).start())
             self.sources.append(HooksSource(self._sink).start())
             if self.cfg.memory_poll_s > 0:
                 self.sources.append(MemorySource(
                     self._mem_sink,
-                    poll_interval_s=self.cfg.memory_poll_s).start())
+                    poll_interval_s=self.cfg.memory_poll_s,
+                    telemetry=self.telemetry).start())
         elif mode == "hooks":
             self.sources.append(HooksSource(self._sink).start())
         elif mode == "sim":
@@ -69,6 +77,7 @@ class TpuProbe:
         with self._lock:
             self.stats["spans_sent"] += len(events)
             self.stats["batches"] += 1
+        self._hop.account(emitted=1, delivered=1)
         self.agent.send_tpu_spans(batch)
 
     def _mem_sink(self, samples: list[dict]) -> None:
@@ -82,4 +91,5 @@ class TpuProbe:
         with self._lock:
             self.stats["mem_samples_sent"] = \
                 self.stats.get("mem_samples_sent", 0) + len(samples)
+        self._hop.account(emitted=1, delivered=1)
         self.agent.send_tpu_spans(batch)

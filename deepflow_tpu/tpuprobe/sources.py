@@ -55,8 +55,12 @@ class XPlaneSource:
                  steps_per_capture: int = 20,
                  min_duration_ms: int = 200,
                  max_duration_ms: int = 4000,
-                 min_gap_ms: int = 200) -> None:
+                 min_gap_ms: int = 200, telemetry=None) -> None:
         self.sink = sink
+        if telemetry is None:
+            from deepflow_tpu.telemetry import Telemetry
+            telemetry = Telemetry("agent", enabled=False)
+        self._telemetry = telemetry
         self.interval_s = interval_s        # fallback cadence (no steps yet)
         self.duration_ms = duration_ms
         self.target_coverage = min(max(target_coverage, 0.05), 0.95)
@@ -105,10 +109,20 @@ class XPlaneSource:
                                           self.max_duration_ms / 1000 + 4))
 
     def _run(self) -> None:
+        # cadence for the deadman: worst case is a max-length window plus
+        # the fallback gap — anything slower than that is a wedge
+        hb = self._telemetry.heartbeat(
+            "tpuprobe.xplane",
+            interval_hint_s=self.interval_s + self.max_duration_ms / 1000.0)
+        hb.beat()
         # first capture soon after attach, then on the adaptive cadence
         if self._stop.wait(1.0):
             return
         while not self._stop.is_set():
+            # beat BEFORE the capture: a capture_once that never returns
+            # (profiler wedge) freezes the progress counter and trips the
+            # deadman, instead of looking like a long gap
+            hb.beat(progress=self.stats["captures"] + self.stats["skipped"])
             if self.available():
                 try:
                     self.capture_once()
@@ -238,10 +252,14 @@ class MemorySource:
     device sync). ~0 cost: one host call per device per poll."""
 
     def __init__(self, sink, poll_interval_s: float = 5.0,
-                 devices_fn=None) -> None:
+                 devices_fn=None, telemetry=None) -> None:
         self.sink = sink
         self.poll_interval_s = poll_interval_s
         self._devices_fn = devices_fn
+        if telemetry is None:
+            from deepflow_tpu.telemetry import Telemetry
+            telemetry = Telemetry("agent", enabled=False)
+        self._telemetry = telemetry
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.stats = {"polls": 0, "samples": 0, "errors": 0}
@@ -302,9 +320,15 @@ class MemorySource:
             self._thread.join(timeout=3.0)
 
     def _run(self) -> None:
+        hb = self._telemetry.heartbeat(
+            "tpuprobe.memory", interval_hint_s=self.poll_interval_s)
+        hb.beat()
         if self._stop.wait(1.0):
             return
         while not self._stop.is_set():
+            # beat before the poll so a wedged memory_stats() call is
+            # caught as a stall, not hidden behind the sleep
+            hb.beat(progress=self.stats["polls"])
             try:
                 self.poll_once()
             except Exception:
