@@ -1,7 +1,7 @@
 PYTHON ?= python
 JAX_ENV := env JAX_PLATFORMS=cpu
 
-.PHONY: test selfmon-check bench native
+.PHONY: test selfmon-check cluster-check bench native
 
 test:
 	timeout -k 10 870 $(JAX_ENV) $(PYTHON) -m pytest tests/ -q -m 'not slow' \
@@ -12,6 +12,12 @@ test:
 # hop's frame ledger fails to balance or any stage reports no heartbeat.
 selfmon-check:
 	timeout -k 10 120 $(JAX_ENV) $(PYTHON) -m deepflow_tpu.cli.selfmon_check
+
+# Brief e2e run of a 3-shard cluster + agent fleet; exits non-zero if the
+# federated count diverges from the union of shard counts or any
+# cluster.* fan-out hop's frame ledger fails to balance.
+cluster-check:
+	timeout -k 10 120 $(JAX_ENV) $(PYTHON) -m deepflow_tpu.cli.cluster_check
 
 bench:
 	$(JAX_ENV) $(PYTHON) bench.py

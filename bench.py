@@ -299,6 +299,83 @@ def _bench_selfmon_overhead() -> dict:
     }
 
 
+def _bench_federation() -> dict:
+    """Scatter-gather arm: the SAME total row count and the same GROUP-BY
+    aggregate, answered by 1 / 2 / 4 shards. One shard is the plain local
+    path (no cluster wiring); the multi-shard arms pay membership +
+    fan-out + partial merge, so the ratio is the federation overhead at
+    this corpus size. All arms must agree on the result — a merge that
+    drifts from the single-node answer is a correctness failure, not a
+    perf number."""
+    import urllib.request
+    from deepflow_tpu.server import Server
+
+    total_rows = 24_000
+    queries = 20
+    body = json.dumps({
+        "sql": "SELECT app_service, Count(*) AS n, "
+               "Avg(response_duration) AS d FROM l7_flow_log "
+               "GROUP BY app_service ORDER BY app_service",
+        "db": "flow_log"}).encode()
+    out: dict = {"federation_rows": total_rows,
+                 "federation_query_ms": {}, "federation_qps": {}}
+    answers = {}
+    for n_shards in (1, 2, 4):
+        servers = []
+        try:
+            seed = Server(
+                host="127.0.0.1", ingest_port=0, query_port=0,
+                sync_port=0, shard_id=1,
+                cluster_advertise="" if n_shards > 1 else None).start()
+            servers.append(seed)
+            seed_addr = f"127.0.0.1:{seed.query_port}"
+            for sid in range(2, n_shards + 1):
+                servers.append(Server(
+                    host="127.0.0.1", ingest_port=0, query_port=0,
+                    sync_port=0, shard_id=sid,
+                    cluster_seed=seed_addr).start())
+            deadline = time.time() + 15.0
+            while (n_shards > 1 and time.time() < deadline and
+                   len(seed.api.federation.remote_peers())
+                   < n_shards - 1):
+                time.sleep(0.1)
+            per = total_rows // n_shards
+            for i, srv in enumerate(servers):
+                srv.db.table("flow_log.l7_flow_log").append_rows([
+                    {"app_service": f"svc-{(i * per + j) % 8}",
+                     "response_duration": 1_000 + (i * per + j) % 5_000,
+                     "time": 1_754_000_000_000_000_000
+                     + (i * per + j) * 1_000_000}
+                    for j in range(per)])
+            url = f"http://127.0.0.1:{seed.query_port}/v1/query"
+            times = []
+            for _ in range(queries):
+                req = urllib.request.Request(
+                    url, data=body,
+                    headers={"Content-Type": "application/json"})
+                t0 = time.perf_counter()
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    got = json.loads(resp.read())
+                times.append(time.perf_counter() - t0)
+            answers[n_shards] = got["result"]["values"]
+            med = statistics.median(times)
+            out["federation_query_ms"][f"shards_{n_shards}"] = round(
+                med * 1e3, 2)
+            out["federation_qps"][f"shards_{n_shards}"] = round(
+                1.0 / med, 1) if med else 0.0
+        finally:
+            for s in servers:
+                s.stop()
+    base = [[r[0], r[1], round(float(r[2]), 6)] for r in answers[1]]
+    out["federation_merge_matches_single"] = all(
+        [[r[0], r[1], round(float(r[2]), 6)] for r in answers[n]] == base
+        for n in (2, 4))
+    ms = out["federation_query_ms"]
+    out["federation_overhead_x_4shard"] = round(
+        ms["shards_4"] / ms["shards_1"], 2) if ms["shards_1"] else 0.0
+    return out
+
+
 _BUSY_C = """
 static unsigned long v;
 __attribute__((noinline)) void busy_leaf(void) {
@@ -545,6 +622,7 @@ def main() -> None:
     cpu_detail.update(_bench_packet_path())
     cpu_detail.update(_bench_ingest())
     cpu_detail.update(_bench_selfmon_overhead())
+    cpu_detail.update(_bench_federation())
     cpu_detail.update(_bench_extprofiler())
     # perf guards (VERDICT r03 item 5 / r04 item 8): a regression must be
     # visible in-round, not discovered by the next judge

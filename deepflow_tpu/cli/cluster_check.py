@@ -1,0 +1,169 @@
+"""cluster-check: brief e2e run proving scatter-gather federation works.
+
+Spins up a real 3-shard cluster in-process (one seed + two joiners) plus
+a small agent fleet — one agent per shard, each pointed at its shard's
+ingest port — then fails (exit 1) if:
+
+  * membership never converges (the seed must see both joiners),
+  * rows are not stamped with the receiving shard's shard_id,
+  * a federated `SELECT Count(*)` does not equal the union of the
+    per-shard row counts (the acceptance criterion of the federation
+    contract: one querier answers for all shards, exactly),
+  * any cluster.* fan-out hop's frame ledger does not balance
+    (emitted != delivered + dropped once quiesced).
+
+Wired as `make cluster-check` — cheap enough for CI, real enough to
+catch a merge step that double-counts or a fan-out hop that stops
+accounting.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+import urllib.request
+
+
+def _fail(msg: str) -> None:
+    print(f"cluster-check: FAIL: {msg}")
+    sys.exit(1)
+
+
+def _post(port: int, path: str, body: dict) -> dict:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def main() -> int:
+    from deepflow_tpu.agent.agent import Agent
+    from deepflow_tpu.agent.config import AgentConfig
+    from deepflow_tpu.server import Server
+
+    servers: list = []
+    agents: list = []
+    try:
+        seed = Server(host="127.0.0.1", ingest_port=0, query_port=0,
+                      sync_port=0, shard_id=1,
+                      cluster_advertise="").start()
+        servers.append(seed)
+        seed_addr = f"127.0.0.1:{seed.query_port}"
+        for sid in (2, 3):
+            servers.append(Server(
+                host="127.0.0.1", ingest_port=0, query_port=0,
+                sync_port=0, shard_id=sid,
+                cluster_seed=seed_addr).start())
+
+        # membership: seed must see both joiners before we fan anything out
+        deadline = time.time() + 15.0
+        while time.time() < deadline:
+            if len(seed.api.federation.remote_peers()) == 2:
+                break
+            time.sleep(0.2)
+        else:
+            _fail("membership never converged: seed sees "
+                  f"{len(seed.api.federation.remote_peers())} of 2 peers")
+
+        # small fleet: one profiling agent per shard, ~1s of traffic each
+        for i, srv in enumerate(servers):
+            cfg = AgentConfig()
+            cfg.app_service = f"cluster-check-{i + 1}"
+            cfg.sender.servers = [("127.0.0.1", srv.ingest_port)]
+            cfg.profiler.sample_hz = 200.0
+            cfg.profiler.emit_interval_s = 0.2
+            cfg.tpuprobe.enabled = False
+            cfg.stats_interval_s = 0.3
+            agents.append(Agent(cfg).start())
+
+        stop = threading.Event()
+
+        def busy() -> None:
+            while not stop.is_set():
+                sum(i * i for i in range(2000))
+
+        th = threading.Thread(target=busy, name="busy")
+        th.start()
+        time.sleep(1.2)
+        stop.set()
+        th.join()
+        for a in agents:
+            a.stop()
+        agents = []
+
+        # quiesce: per-shard profile counts must be nonzero and stable
+        # (in-flight decoder batches land after the senders disconnect)
+        table = "profile.in_process_profile"
+        counts = []
+        deadline = time.time() + 15.0
+        while time.time() < deadline:
+            cur = [len(s.db.table(table)) for s in servers]
+            if all(cur) and cur == counts:
+                break
+            counts = cur
+            time.sleep(0.3)
+        if not all(counts):
+            _fail(f"a shard ingested no profile rows: {counts}")
+
+        # shard identity: every row carries the RECEIVING shard's id
+        for srv in servers:
+            for ch in srv.db.table(table).snapshot():
+                ids = set(ch["shard_id"].tolist())
+                if ids != {srv.shard_id}:
+                    _fail(f"shard {srv.shard_id} rows tagged {ids}")
+
+        # the acceptance criterion: federated count == union of shards
+        union = sum(counts)
+        got = _post(seed.query_port, "/v1/query", {
+            "sql": "SELECT Count(*) AS n FROM in_process_profile",
+            "db": "profile"})
+        fed = got.get("federation") or {}
+        if fed.get("missing_shards"):
+            _fail(f"healthy cluster reported missing shards: {fed}")
+        n = got["result"]["values"][0][0]
+        if int(n) != union:
+            _fail(f"federated Count(*) = {n}, union of shards = {union} "
+                  f"(per-shard {counts})")
+
+        # per-shard breakdown must reproduce the same union
+        got = _post(seed.query_port, "/v1/query", {
+            "sql": "SELECT shard_id, Count(*) AS n FROM in_process_profile"
+                   " GROUP BY shard_id ORDER BY shard_id",
+            "db": "profile"})
+        by_shard = {int(r[0]): int(r[1]) for r in got["result"]["values"]}
+        if by_shard != {i + 1: c for i, c in enumerate(counts)}:
+            _fail(f"GROUP BY shard_id {by_shard} != per-shard {counts}")
+
+        # fan-out hop ledgers: every cluster.* hop balances, none in flight
+        snap = seed.telemetry.snapshot()
+        hops = [p for p in snap.get("pipeline", [])
+                if p["hop"].startswith("cluster.")]
+        if not hops:
+            _fail("no cluster.* hops in seed telemetry "
+                  "(selfmon disabled? DF_NO_SELFMON set?)")
+        for p in hops:
+            if p["emitted"] != p["delivered"] + p["dropped_total"] \
+                    + p["in_flight"]:
+                _fail(f"hop {p['hop']!r} ledger does not balance: {p}")
+            if p["in_flight"] != 0:
+                _fail(f"hop {p['hop']!r} never drained: {p}")
+        if not any(p["emitted"] for p in hops):
+            _fail("federation hops saw no traffic")
+
+        print(f"cluster-check: OK — 3 shards, {union} rows "
+              f"(per-shard {counts}), federated count exact, "
+              f"{len(hops)} fan-out hops balanced")
+        return 0
+    finally:
+        for a in agents:
+            a.stop()
+        for s in servers:
+            s.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

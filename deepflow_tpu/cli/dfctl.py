@@ -104,6 +104,12 @@ def main(argv: list[str] | None = None) -> int:
         "pipeline", help="hop-by-hop frame ledger waterfall "
                          "(emitted/delivered/drops/queue waits)")
 
+    p_cluster = sub.add_parser(
+        "cluster", help="federation peer table: shard id, epoch, "
+                        "last-seen, per-shard row counts, probe latency")
+    p_cluster.add_argument("--json", action="store_true",
+                           help="raw /v1/cluster/status JSON")
+
     p_agent = sub.add_parser("agent")
     p_agent.add_argument("action", choices=["list"])
 
@@ -277,6 +283,33 @@ def main(argv: list[str] | None = None) -> int:
                                  key=lambda x: x["hop"])])
         if not hops and not ag_hops:
             print("(no pipeline telemetry — selfmon disabled?)")
+    elif args.cmd == "cluster":
+        st = _api(args.server, "/v1/cluster/status")
+        if args.json:
+            print(json.dumps(st, indent=2))
+            return 0
+        print(f"answering shard: {st['shard_id']}  "
+              f"directory version: {st['version']}")
+        peers = sorted(st.get("peers", []), key=lambda p: p["shard_id"])
+        print_table(
+            ["SHARD", "ADDR", "EPOCH", "LAST_SEEN_S", "ROWS",
+             "LATENCY_MS", "STATE"],
+            [[p["shard_id"],
+              p["addr"] + (" *" if p["shard_id"] == st["shard_id"]
+                           else ""),
+              p["epoch"], p["last_seen_s"],
+              p["rows"] if p["rows"] is not None else "-",
+              p["latency_ms"] if p["latency_ms"] is not None else "-",
+              "alive" if p["alive"]
+              else ("DEAD " + p.get("error", "")).strip()]
+             for p in peers])
+        fan = st.get("fanout") or {}
+        if fan:
+            print("\nfan-out clients (this shard -> peer):")
+            print_table(
+                ["ADDR", "ATTEMPTS", "HEDGES", "ERRORS"],
+                [[addr, s.get("attempts", 0), s.get("hedges", 0),
+                  s.get("errors", 0)] for addr, s in sorted(fan.items())])
     elif args.cmd == "agent":
         out = _api(args.server, "/v1/agents")
         rows = [[a["agent_id"], a["hostname"], a["ctrl_ip"],

@@ -1,0 +1,20 @@
+"""Cluster federation: N servers answering as one querier.
+
+Reference analog: the multi-ingester DeepFlow deployment where the
+querier fans a query out over every ClickHouse shard and merges
+(server/querier engine + ingester sharding). Here each server owns a
+shard-local store.Database; this package adds membership gossip, a
+framed columnar result wire format, a retry/hedge remote-execution
+client, and the scatter-gather merge used by the querier.
+"""
+
+from deepflow_tpu.cluster.membership import (ClusterMembership, Peer,
+                                             PeerDirectory)
+from deepflow_tpu.cluster.remote import FanOut, ShardCallError, ShardClient
+from deepflow_tpu.cluster.wire import decode_result, encode_result
+
+__all__ = [
+    "ClusterMembership", "Peer", "PeerDirectory",
+    "FanOut", "ShardCallError", "ShardClient",
+    "encode_result", "decode_result",
+]

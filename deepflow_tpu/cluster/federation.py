@@ -1,0 +1,267 @@
+"""Scatter-gather coordinator: one querier answers for N sharded servers.
+
+Merge semantics (docs/CLUSTER.md spells out the contract):
+
+- DF-SQL: partial-aggregate push-down. Each shard runs
+  engine.execute_partial (groups keyed by DECODED values — SmartEncoding
+  ids are shard-local and never merged); the coordinator reduces with
+  engine.merge_partials. Exact for SUM/COUNT/MIN/MAX/AVG/LAST/
+  COUNT(DISTINCT); PERCENTILE merges histogram sketches (~2% error).
+- PromQL: Thanos-style raw-selector fan-out. Only fetch_raw is
+  federated (via the db-shim below); the whole AST evaluates at the
+  coordinator, so every PromQL function stays EXACT.
+- Tempo search: shards return per-trace scan partials; one trace's spans
+  may land on many shards, so trace-level start/end/duration exist only
+  after the merge — duration filters and the limit apply here, never
+  shard-side.
+- Trace assembly / flame graphs: span-dict union (dedup by
+  (span_id, start_ns, flow_id) in build_trace_from_spans) and
+  stack-string sums.
+- Degraded mode: a dead or timed-out shard never fails the query; its
+  ids land in the "missing_shards" annotation of the partial result.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from deepflow_tpu.cluster.membership import (DEFAULT_TTL_S,
+                                             ClusterMembership, Peer)
+from deepflow_tpu.cluster.remote import FanOut, ShardCallError
+from deepflow_tpu.query import engine, promql
+from deepflow_tpu.query import sql as qsql
+from deepflow_tpu.query.flamegraph import merge_stack_values
+
+
+def merge_tempo_partials(parts: list[list[dict]]) -> list[dict]:
+    """Union per-shard Tempo scan partials by traceID.
+
+    Per trace: start = min, end = max (span sets are disjoint-ish across
+    shards), _matched OR (a tag may match on any shard's spans), root
+    fields from whichever shard saw the earliest span (_root_t)."""
+    by_id: dict[str, dict] = {}
+    for part in parts:
+        for tr in part:
+            cur = by_id.get(tr["traceID"])
+            if cur is None:
+                by_id[tr["traceID"]] = dict(tr)
+                continue
+            if tr.get("_root_t", 0) < cur.get("_root_t", 0):
+                cur["rootServiceName"] = tr.get("rootServiceName", "")
+                cur["rootTraceName"] = tr.get("rootTraceName", "")
+                cur["_root_t"] = tr.get("_root_t", 0)
+            cur["_start_ns"] = min(cur["_start_ns"], tr["_start_ns"])
+            cur["_end_ns"] = max(cur["_end_ns"], tr["_end_ns"])
+            cur["spanCount"] = cur.get("spanCount", 0) + tr.get(
+                "spanCount", 0)
+            cur["_matched"] = cur.get("_matched", False) or tr.get(
+                "_matched", False)
+    return list(by_id.values())
+
+
+class _FederatedPromDb:
+    """Database shim handed to promql.evaluate: intercepts fetch_raw
+    (the promql_fetch_raw hook) and merges local + remote RawSeries by
+    full label set. Everything else (table/tables for metadata paths)
+    delegates to the local store. One instance per request — it
+    accumulates that request's missing_shards."""
+
+    def __init__(self, coord: "FederationCoordinator") -> None:
+        self._coord = coord
+        self._db = coord.db
+        self.missing_shards: set[int] = set()
+
+    def table(self, name: str):
+        return self._db.table(name)
+
+    def tables(self) -> list[str]:
+        return self._db.tables()
+
+    def __getattr__(self, name: str):
+        return getattr(self._db, name)
+
+    def promql_fetch_raw(self, vs, lo_s: float, hi_s: float):
+        local_unknown = False
+        try:
+            local = promql.fetch_raw(self._db, vs, lo_s, hi_s)
+        except promql.UnknownMetricError:
+            local, local_unknown = [], True
+        results, missing = self._coord.scatter(
+            {"op": "promql_raw", "metric": vs.metric,
+             "matchers": [list(m) for m in vs.matchers],
+             "lo_s": float(lo_s), "hi_s": float(hi_s)},
+            hop_name="cluster.promql")
+        self.missing_shards.update(missing)
+        remote_known = False
+        merged: dict[tuple, promql.RawSeries] = {}
+
+        def fold(series_list):
+            for s in series_list:
+                key = tuple(sorted((k, str(v))
+                            for k, v in s.labels.items()))
+                cur = merged.get(key)
+                if cur is None:
+                    merged[key] = s
+                else:
+                    t = np.concatenate([cur.t, s.t])
+                    v = np.concatenate([cur.v, s.v])
+                    order = np.argsort(t, kind="stable")
+                    cur.t, cur.v = t[order], v[order]
+
+        fold(local)
+        for res in results.values():
+            if res.get("unknown"):
+                continue
+            remote_known = True
+            fold([promql.RawSeries(
+                labels=d["labels"],
+                t=np.asarray(d["t"], dtype=np.int64),
+                v=np.asarray(d["v"], dtype=np.float64),
+                counter=bool(d["counter"])) for d in res["series"]])
+        if local_unknown and not remote_known and not self.missing_shards:
+            # only a clean miss is an error: with a shard unreachable the
+            # metric may live exactly there, and the degraded contract
+            # says partial-and-annotated, never a 500
+            raise promql.UnknownMetricError(
+                f"unknown metric {vs.metric!r} on every shard")
+        return list(merged.values())
+
+
+class FederationCoordinator:
+    """Ties membership + FanOut + the per-signal merge steps together.
+    Every public method returns (result, fed_info) where fed_info is
+    {"shards": total answering, "missing_shards": [ids]} — the degraded
+    -mode contract: partial data is annotated, never a 500."""
+
+    def __init__(self, db, membership: ClusterMembership,
+                 fanout: FanOut, shard_id: int = 0,
+                 ttl_s: float = DEFAULT_TTL_S) -> None:
+        self.db = db
+        self.membership = membership
+        self.fanout = fanout
+        self.shard_id = shard_id
+        self.ttl_s = ttl_s
+
+    # -- plumbing -----------------------------------------------------------
+
+    def remote_peers(self) -> list[Peer]:
+        return self.membership.peers(include_self=False, ttl_s=self.ttl_s)
+
+    def active(self) -> bool:
+        """Any alive remote peer right now? (Single node: every query
+        stays on the plain local path, zero overhead.)"""
+        return bool(self.remote_peers())
+
+    def scatter(self, body: dict,
+                hop_name: str) -> tuple[dict[int, object], list[int]]:
+        return self.fanout.scatter(self.remote_peers(), body, hop_name)
+
+    def _info(self, results: dict, missing: list[int]) -> dict:
+        return {"shards": 1 + len(results) + len(missing),
+                "missing_shards": missing}
+
+    # -- DF-SQL -------------------------------------------------------------
+
+    def sql_query(self, table, select: qsql.Select, sql_text: str,
+                  org_id=None):
+        """table/select: the coordinator's locally-resolved table and
+        (org-scoped) AST. The exact resolved table NAME, the original
+        sql_text and org_id travel to the shards, which re-scope
+        themselves (the org filter lives in the AST, not the text) —
+        both sides derive the partial layout from the same normalized
+        text."""
+        body = {"op": "sql_partial", "sql": sql_text,
+                "table": table.name}
+        if org_id is not None:
+            body["org_id"] = org_id
+        results, missing = self.scatter(body, hop_name="cluster.sql")
+        partials = [engine.execute_partial(table, select)]
+        partials.extend(results[sid] for sid in sorted(results))
+        res = engine.merge_partials(table, select, partials)
+        return res, self._info(results, missing)
+
+    # -- PromQL -------------------------------------------------------------
+
+    def prom_db(self) -> _FederatedPromDb:
+        return _FederatedPromDb(self)
+
+    # -- Tempo / tracing ----------------------------------------------------
+
+    def tempo_search(self, scan_fn, params: dict):
+        """scan_fn: the local shard's scan (querier._tempo_scan)."""
+        results, missing = self.scatter(
+            {"op": "tempo_scan", "params": params},
+            hop_name="cluster.tempo")
+        parts = [scan_fn(params)]
+        parts.extend(results[sid]["traces"] for sid in sorted(results))
+        return merge_tempo_partials(parts), self._info(results, missing)
+
+    def trace_spans(self, local_spans: list[dict], trace_id: str):
+        """Union span dicts across shards; build_trace_from_spans dedups
+        by (span_id, start_ns, flow_id) at assembly."""
+        results, missing = self.scatter(
+            {"op": "trace_spans", "trace_id": trace_id},
+            hop_name="cluster.trace")
+        spans = list(local_spans)
+        for sid in sorted(results):
+            spans.extend(results[sid]["spans"])
+        return spans, self._info(results, missing)
+
+    # -- flame graphs -------------------------------------------------------
+
+    def flame_stacks(self, local_part: tuple[list, list], params: dict):
+        """Sum per-shard (stacks, values) by stack string before one
+        build_flame_tree at the coordinator."""
+        results, missing = self.scatter(
+            {"op": "profile_flame", "params": params},
+            hop_name="cluster.flame")
+        parts = [local_part]
+        for sid in sorted(results):
+            r = results[sid]
+            parts.append((r["stacks"], r["values"]))
+        return merge_stack_values(parts), self._info(results, missing)
+
+    # -- dfctl / status -----------------------------------------------------
+
+    def local_table_counts(self) -> dict:
+        return {name: len(self.db.table(name))
+                for name in self.db.tables()}
+
+    def cluster_status(self) -> dict:
+        """Peer table for dfctl: every known peer with per-shard row
+        counts and a timed status probe (sequential — a status page,
+        not a query path)."""
+        now_ns = time.time_ns()
+        self.membership.refresh_self()
+        snap = self.membership.directory.snapshot()
+        rows = []
+        for p in [Peer.from_dict(d) for d in snap["peers"]]:
+            entry = {"shard_id": p.shard_id, "addr": p.addr,
+                     "epoch": p.epoch,
+                     "last_seen_s": round(
+                         max(0, now_ns - p.last_seen_ns) / 1e9, 1),
+                     "alive": True, "latency_ms": None, "rows": None}
+            if p.shard_id == self.shard_id:
+                t0 = time.monotonic()
+                counts = self.local_table_counts()
+                entry["latency_ms"] = round(
+                    (time.monotonic() - t0) * 1e3, 2)
+                entry["rows"] = sum(counts.values())
+            else:
+                try:
+                    t0 = time.monotonic()
+                    counts = self.fanout.client(p.addr).call(
+                        {"op": "table_counts"})
+                    entry["latency_ms"] = round(
+                        (time.monotonic() - t0) * 1e3, 2)
+                    entry["rows"] = sum(counts.values())
+                except ShardCallError as e:
+                    entry["alive"] = False
+                    entry["error"] = str(e)
+            rows.append(entry)
+        return {"shard_id": self.shard_id,
+                "version": self.membership.directory.version,
+                "peers": rows,
+                "fanout": self.fanout.stats()}

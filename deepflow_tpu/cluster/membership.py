@@ -1,0 +1,186 @@
+"""Cluster membership: seed-anchored join/heartbeat with gossip readback.
+
+Reference analog: controller node registration + the genesis sync that
+lets every DeepFlow component read one authoritative node list. Peers
+POST /v1/cluster/join to the seed (the leader controller's querier
+port) on an interval; every join response carries the seed's full
+versioned directory, which the joiner adopts — so any node, and dfctl,
+can answer GET /v1/cluster/peers with the same picture after one
+heartbeat round-trip.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import urllib.request
+from dataclasses import dataclass, field
+
+log = logging.getLogger("df.cluster")
+
+DEFAULT_TTL_S = 15.0          # peer considered dead after this silence
+DEFAULT_HEARTBEAT_S = 2.0
+
+
+@dataclass
+class Peer:
+    shard_id: int
+    addr: str                 # "host:query_port" serving /v1/shard/exec
+    epoch: int                # process start time (ns) — restarts bump it
+    last_seen_ns: int = 0
+
+    def to_dict(self) -> dict:
+        return {"shard_id": self.shard_id, "addr": self.addr,
+                "epoch": self.epoch, "last_seen_ns": self.last_seen_ns}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Peer":
+        return cls(shard_id=int(d["shard_id"]), addr=str(d["addr"]),
+                   epoch=int(d.get("epoch", 0)),
+                   last_seen_ns=int(d.get("last_seen_ns", 0)))
+
+
+@dataclass
+class PeerDirectory:
+    """Versioned peer list. The version bumps only on membership CHANGE
+    (new shard, address move, epoch bump = restart), not on heartbeats,
+    so watchers can cheaply detect topology changes."""
+
+    _peers: dict[int, Peer] = field(default_factory=dict)
+    version: int = 0
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def upsert(self, peer: Peer) -> bool:
+        with self._lock:
+            cur = self._peers.get(peer.shard_id)
+            changed = (cur is None or cur.addr != peer.addr
+                       or cur.epoch != peer.epoch)
+            if changed:
+                self.version += 1
+            peer.last_seen_ns = peer.last_seen_ns or time.time_ns()
+            self._peers[peer.shard_id] = peer
+            return changed
+
+    def adopt(self, snap: dict) -> None:
+        """Replace local state with a (seed-authored) snapshot, keeping
+        the freshest last_seen per shard."""
+        with self._lock:
+            if int(snap.get("version", 0)) < self.version:
+                return
+            incoming = {}
+            for d in snap.get("peers", []):
+                p = Peer.from_dict(d)
+                cur = self._peers.get(p.shard_id)
+                if cur is not None and cur.last_seen_ns > p.last_seen_ns:
+                    p.last_seen_ns = cur.last_seen_ns
+                incoming[p.shard_id] = p
+            self._peers = incoming
+            self.version = int(snap.get("version", 0))
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"version": self.version,
+                    "peers": [p.to_dict() for _, p in
+                              sorted(self._peers.items())]}
+
+    def alive(self, ttl_s: float = DEFAULT_TTL_S,
+              exclude_shard: int | None = None) -> list[Peer]:
+        horizon = time.time_ns() - int(ttl_s * 1e9)
+        with self._lock:
+            return [p for _, p in sorted(self._peers.items())
+                    if p.last_seen_ns >= horizon
+                    and p.shard_id != exclude_shard]
+
+
+class ClusterMembership:
+    """One node's view: local identity + join loop against the seed.
+
+    A node with no seed (or whose advertise addr IS the seed) acts as
+    the seed: its directory is authoritative and serves joins."""
+
+    def __init__(self, shard_id: int, advertise: str,
+                 seed: str | None = None,
+                 heartbeat_s: float = DEFAULT_HEARTBEAT_S,
+                 telemetry=None) -> None:
+        self.shard_id = shard_id
+        self.advertise = advertise
+        self.seed = (seed or "").strip() or None
+        self.epoch = time.time_ns()
+        self.directory = PeerDirectory()
+        self.heartbeat_s = heartbeat_s
+        self.telemetry = telemetry
+        self.stats = {"joins": 0, "join_errors": 0}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    @property
+    def is_seed(self) -> bool:
+        return self.seed is None or self.seed == self.advertise
+
+    def self_peer(self) -> Peer:
+        return Peer(shard_id=self.shard_id, addr=self.advertise,
+                    epoch=self.epoch, last_seen_ns=time.time_ns())
+
+    # -- seed side ----------------------------------------------------
+    def handle_join(self, body: dict) -> dict:
+        """Register/refresh one peer, answer with the full directory."""
+        peer = Peer.from_dict(body)
+        peer.last_seen_ns = time.time_ns()
+        if self.directory.upsert(peer):
+            log.info("cluster: shard %d at %s joined (epoch %d)",
+                     peer.shard_id, peer.addr, peer.epoch)
+        self.directory.upsert(self.self_peer())
+        return self.directory.snapshot()
+
+    # -- joiner side --------------------------------------------------
+    def _join_once(self) -> None:
+        req = urllib.request.Request(
+            f"http://{self.seed}/v1/cluster/join",
+            data=json.dumps(self.self_peer().to_dict()).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=3.0) as resp:
+            snap = json.loads(resp.read())
+        self.directory.adopt(snap)
+        self.stats["joins"] += 1
+
+    def _loop(self) -> None:
+        beat = (self.telemetry.heartbeat(
+            "cluster.membership", interval_hint_s=self.heartbeat_s)
+            if self.telemetry is not None else None)
+        while not self._stop.is_set():
+            if beat is not None:
+                beat.beat()
+            try:
+                self._join_once()
+            except Exception as e:
+                self.stats["join_errors"] += 1
+                log.debug("cluster join to %s failed: %s", self.seed, e)
+            self._stop.wait(self.heartbeat_s)
+
+    def start(self) -> "ClusterMembership":
+        self.directory.upsert(self.self_peer())
+        if not self.is_seed:
+            self._thread = threading.Thread(
+                target=self._loop, name="df-cluster-join", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    def refresh_self(self) -> None:
+        """Seed keeps its own last_seen fresh (joiners do via join)."""
+        self.directory.upsert(self.self_peer())
+
+    def peers(self, include_self: bool = True,
+              ttl_s: float = DEFAULT_TTL_S) -> list[Peer]:
+        self.refresh_self()
+        alive = self.directory.alive(ttl_s=ttl_s)
+        if include_self:
+            return alive
+        return [p for p in alive if p.shard_id != self.shard_id]

@@ -43,6 +43,7 @@ class MessageType(IntEnum):
     PROMETHEUS = 9       # remote-write passthrough
     APP_LOG = 10
     PCAP = 11            # on-demand capture uploads (pcap policy)
+    SHARD_RESULT = 12    # cluster scatter-gather shard responses
 
 
 @dataclass(frozen=True)

@@ -407,9 +407,11 @@ def _agg_eval(e, env: _Env, order: np.ndarray, bounds: np.ndarray) -> _Val:
     raise QueryError(f"cannot aggregate {e!r}")
 
 
-def execute(table: ColumnarTable, query: S.Select | str) -> QueryResult:
-    if isinstance(query, str):
-        query = S.parse(query)
+def _normalize(table: ColumnarTable, query: S.Select) -> S.Select:
+    """Shared front half of execute(): derived-metric rewrite + GROUP BY
+    alias substitution. Runs identically on every shard AND on the merge
+    coordinator, so both sides derive the same partial-result layout
+    from the same SQL text."""
     # derived metrics (Avg(rtt) -> Sum(rtt_sum)/Sum(rtt_count)) before
     # column validation, so the virtual names never hit the store.
     # Display names and ORDER BY matching use the PRE-rewrite expressions:
@@ -435,11 +437,18 @@ def execute(table: ColumnarTable, query: S.Select | str) -> QueryResult:
         if isinstance(g, S.Col) and g.name not in table.columns
         and g.name in alias_map else g
         for g in query.group_by]
-    query = S.Select(items=query_items, table=query.table,
-                     where=query.where, group_by=group_by,
-                     having=having, order_by=query.order_by,
-                     limit=query.limit)
-    needed: set[str] = set()
+    return S.Select(items=query_items, table=query.table,
+                    where=query.where, group_by=group_by,
+                    having=having, order_by=query.order_by,
+                    limit=query.limit)
+
+
+def _materialize(table: ColumnarTable, query: S.Select,
+                 extra_cols: set[str] | None = None) -> tuple[_Env, int]:
+    """WHERE-filter the chunks and materialize every referenced column
+    into one _Env. extra_cols: additional columns the caller needs (the
+    federated LAST merge wants `time` alongside the value)."""
+    needed: set[str] = set(extra_cols or ())
     for item in query.items:
         _collect_cols(item.expr, needed)
     for g in query.group_by:
@@ -483,10 +492,42 @@ def execute(table: ColumnarTable, query: S.Select | str) -> QueryResult:
             parts = [ch[name] for ch in chunks]
             cols[name] = (np.concatenate(parts) if parts else
                           np.empty(0, dtype=table.columns[name].np_dtype))
-    env = _Env(table, cols)
+    return _Env(table, cols), n_rows
 
-    is_agg = bool(query.group_by) or query.having is not None or any(
+
+def _group_order(env: _Env, query: S.Select,
+                 n_rows: int) -> tuple[np.ndarray, np.ndarray]:
+    """-> (order, bounds) group permutation for the aggregate path."""
+    if query.group_by:
+        key_vals = [env.eval(g) for g in query.group_by]
+        if n_rows == 0:
+            return (np.empty(0, dtype=np.int64),
+                    np.empty(0, dtype=np.int64))
+        key = np.zeros(n_rows, dtype=np.int64)
+        for kv in key_vals:
+            _, inv = np.unique(kv.arr, return_inverse=True)
+            key = key * (int(inv.max(initial=0)) + 1) + inv
+        order = np.argsort(key, kind="stable")
+        sk = key[order]
+        bounds = np.flatnonzero(np.append(True, sk[1:] != sk[:-1]))
+        return order, bounds
+    # one group over all rows; zero rows -> zero groups
+    return (np.arange(n_rows),
+            np.zeros(1 if n_rows else 0, dtype=np.int64))
+
+
+def _is_agg_query(query: S.Select) -> bool:
+    return bool(query.group_by) or query.having is not None or any(
         S.contains_agg(i.expr) for i in query.items)
+
+
+def execute(table: ColumnarTable, query: S.Select | str) -> QueryResult:
+    if isinstance(query, str):
+        query = S.parse(query)
+    query = _normalize(table, query)
+    env, n_rows = _materialize(table, query)
+
+    is_agg = _is_agg_query(query)
 
     names = [i.alias or S.expr_name(i.expr) for i in query.items]
     if not is_agg:
@@ -497,23 +538,7 @@ def execute(table: ColumnarTable, query: S.Select | str) -> QueryResult:
                 v = _Val(np.full(n_rows, v.arr.item()), v.kind)
             outs.append(v)
     else:
-        if query.group_by:
-            key_vals = [env.eval(g) for g in query.group_by]
-            if n_rows == 0:
-                order = np.empty(0, dtype=np.int64)
-                bounds = np.empty(0, dtype=np.int64)
-            else:
-                key = np.zeros(n_rows, dtype=np.int64)
-                for kv in key_vals:
-                    _, inv = np.unique(kv.arr, return_inverse=True)
-                    key = key * (int(inv.max(initial=0)) + 1) + inv
-                order = np.argsort(key, kind="stable")
-                sk = key[order]
-                bounds = np.flatnonzero(np.append(True, sk[1:] != sk[:-1]))
-        else:
-            # one group over all rows; zero rows -> zero groups
-            order = np.arange(n_rows)
-            bounds = np.zeros(1 if n_rows else 0, dtype=np.int64)
+        order, bounds = _group_order(env, query, n_rows)
         n_groups = len(bounds)
         outs = []
         for i in query.items:
@@ -532,7 +557,14 @@ def execute(table: ColumnarTable, query: S.Select | str) -> QueryResult:
             mask = np.full(len(rows), bool(mask))
         rows = [r for r, keep in zip(rows, mask.astype(bool)) if keep]
 
-    # ORDER BY over output columns
+    rows = _order_limit(query, names, rows)
+    return QueryResult(columns=names, values=rows)
+
+
+def _order_limit(query: S.Select, names: list[str],
+                 rows: list[list]) -> list[list]:
+    """ORDER BY over output columns, then LIMIT (shared by the local
+    executor and the federated merge reduce)."""
     for e, desc in reversed(query.order_by):
         key_name = S.expr_name(e)
         if key_name in names:
@@ -542,7 +574,313 @@ def execute(table: ColumnarTable, query: S.Select | str) -> QueryResult:
         else:
             raise QueryError(f"ORDER BY {key_name!r} must appear in SELECT")
         rows.sort(key=lambda r: r[idx], reverse=desc)
-
     if query.limit is not None:
         rows = rows[:query.limit]
-    return QueryResult(columns=names, values=rows)
+    return rows
+
+
+# -- cluster federation: partial aggregates + merge reduce ------------------
+#
+# Scatter-gather contract: every shard parses the SAME SQL text and runs
+# execute_partial(); the coordinator runs merge_partials() over the shard
+# results (its own local partial included). Both sides derive the result
+# layout from the same _normalize()d query, so the wire carries no schema.
+# Distributive aggregates (SUM/COUNT/MIN/MAX) push down exactly, AVG
+# travels as (sum, count), COUNT(DISTINCT) as per-group decoded distinct
+# values, LAST as (value, time) pairs resolved by max time, PERCENTILE as
+# a mergeable histogram sketch (the one documented-approximate merge).
+# Dictionary/enum columns are ALWAYS decoded to label strings shard-side
+# before merge — shard-local SmartEncoding ids are never comparable.
+
+def _agg_sites(query: S.Select) -> list[S.Func]:
+    """Unique aggregate call sites (by display name) across SELECT items
+    and HAVING, in discovery order."""
+    sites: list[S.Func] = []
+    seen: set[str] = set()
+
+    def walk(e) -> None:
+        if isinstance(e, S.Func):
+            if e.name in S.AGG_FUNCS:
+                k = S.expr_name(e)
+                if k not in seen:
+                    seen.add(k)
+                    sites.append(e)
+                return
+            for a in e.args:
+                walk(a)
+        elif isinstance(e, S.BinOp):
+            walk(e.left)
+            if not isinstance(e.right, tuple):
+                walk(e.right)
+        elif isinstance(e, S.Not):
+            walk(e.expr)
+        elif isinstance(e, S.Case):
+            for c, v in e.whens:
+                walk(c)
+                walk(v)
+            if e.default is not None:
+                walk(e.default)
+
+    for item in query.items:
+        walk(item.expr)
+    if query.having is not None:
+        walk(query.having)
+    return sites
+
+
+def _decode_slice(v: _Val, arr: np.ndarray) -> list:
+    w = _Val(arr, v.kind, labels=v.labels)
+    w.dict_ = v.dict_
+    return w.decoded()
+
+
+def _partial_state(site: S.Func, env: _Env, order: np.ndarray,
+                   starts: np.ndarray, ends: np.ndarray) -> list:
+    """Per-group mergeable state for one aggregate site (JSON-able)."""
+    n_groups = len(starts)
+    if n_groups == 0:
+        return []
+    name = site.name
+    if name == "COUNT" and site.distinct:
+        if len(site.args) != 1 or isinstance(site.args[0], S.Star):
+            raise QueryError("COUNT(DISTINCT) takes exactly one column")
+        v = env.eval(site.args[0])
+        a = v.arr[order]
+        return [_decode_slice(v, np.unique(a[s0:e0]))
+                for s0, e0 in zip(starts, ends)]
+    if site.distinct:
+        raise QueryError(
+            f"DISTINCT is only supported in Count(), not {name}")
+    if name == "COUNT" or not site.args or isinstance(site.args[0], S.Star):
+        return (ends - starts).astype(np.float64).tolist()
+    v = env.eval(site.args[0])
+    if name == "LAST":
+        idx = order[ends - 1]
+        vals = _decode_slice(v, v.arr[idx])
+        # pair with the row's timestamp so the merge picks the globally
+        # newest candidate; without a time column the pick is arbitrary
+        t = (env.cols["time"][idx].astype(np.int64).tolist()
+             if "time" in env.cols else [0] * n_groups)
+        return [[val, int(tt)] for val, tt in zip(vals, t)]
+    if v.kind in ("str", "enum", "obj"):
+        raise QueryError(
+            f"{name} over string column {S.expr_name(site.args[0])!r}")
+    a = v.arr.astype(np.float64)[order]
+    if name == "SUM":
+        return np.add.reduceat(a, starts).tolist()
+    if name == "AVG":
+        s = np.add.reduceat(a, starts)
+        return [[float(x), int(c)] for x, c in zip(s, ends - starts)]
+    if name == "MIN":
+        return np.minimum.reduceat(a, starts).tolist()
+    if name == "MAX":
+        return np.maximum.reduceat(a, starts).tolist()
+    if name == "PERCENTILE":
+        from deepflow_tpu.cluster.sketch import HistogramSketch
+        out = []
+        for s0, e0 in zip(starts, ends):
+            sk = HistogramSketch()
+            sk.add_many(a[s0:e0])
+            out.append(sk.to_dict())
+        return out
+    raise QueryError(f"unknown aggregate {name}")
+
+
+def execute_partial(table: ColumnarTable, query: S.Select | str) -> dict:
+    """Shard-local half of a federated query. Row queries run fully
+    (ORDER/LIMIT pushed down — a shard-local top-k is a superset of the
+    global top-k's contribution); aggregate queries return per-group
+    partial states keyed by DECODED group-key values."""
+    if isinstance(query, str):
+        query = S.parse(query)
+    if not _is_agg_query(_normalize(table, query)):
+        res = execute(table, query)
+        return {"kind": "rows", "columns": res.columns,
+                "values": res.values}
+    query = _normalize(table, query)
+    sites = _agg_sites(query)
+    needs_time = (any(s.name == "LAST" for s in sites)
+                  and "time" in table.columns)
+    env, n_rows = _materialize(
+        table, query, extra_cols={"time"} if needs_time else None)
+    order, bounds = _group_order(env, query, n_rows)
+    starts = bounds
+    ends = np.append(bounds[1:], len(order))
+    n_groups = len(bounds)
+    keys = []
+    for g in query.group_by:
+        v = env.eval(g)
+        arr = v.arr[order][bounds] if n_groups else v.arr[:0]
+        keys.append(_decode_slice(v, arr))
+    items: dict[str, list] = {}
+    for idx, item in enumerate(query.items):
+        if S.contains_agg(item.expr):
+            continue
+        v = env.eval(item.expr)
+        if v.arr.ndim == 0:   # bare literal: broadcast over groups
+            items[str(idx)] = [v.arr.item()] * n_groups
+        else:
+            arr = v.arr[order][bounds] if n_groups else v.arr[:0]
+            items[str(idx)] = _decode_slice(v, arr)
+    return {"kind": "agg", "n_groups": n_groups, "keys": keys,
+            "items": items,
+            "sites": {S.expr_name(s): _partial_state(s, env, order,
+                                                     starts, ends)
+                      for s in sites}}
+
+
+def _merge_site(site: S.Func, states: list) -> object:
+    """Combine one aggregate site's per-shard states into the final
+    scalar for one group."""
+    name = site.name
+    if name == "COUNT" and site.distinct:
+        u: set = set()
+        for s in states:
+            u.update(s)
+        return float(len(u))
+    if name in ("COUNT", "SUM"):
+        return float(sum(states))
+    if name == "MIN":
+        return float(min(states))
+    if name == "MAX":
+        return float(max(states))
+    if name == "AVG":
+        tot = sum(s for s, _ in states)
+        n = sum(c for _, c in states)
+        return float(tot) / max(n, 1)
+    if name == "LAST":
+        return max(states, key=lambda vt: vt[1])[0]
+    if name == "PERCENTILE":
+        from deepflow_tpu.cluster.sketch import HistogramSketch
+        merged = HistogramSketch()
+        for d in states:
+            merged.merge(HistogramSketch.from_dict(d))
+        p_arg = site.args[1] if len(site.args) == 2 else None
+        if not isinstance(p_arg, S.Lit):
+            raise QueryError(
+                "Percentile(col, p) needs a literal p to federate")
+        return merged.percentile(float(p_arg.value))
+    raise QueryError(f"unknown aggregate {name}")
+
+
+_CMP = {"=": lambda l, r: l == r, "!=": lambda l, r: l != r,
+        "<": lambda l, r: l < r, "<=": lambda l, r: l <= r,
+        ">": lambda l, r: l > r, ">=": lambda l, r: l >= r}
+
+
+def _scalar_eval(e, agg_vals: dict, named: dict):
+    """Evaluate one merged group's output expression: aggregate sites
+    resolve to their merged values, everything else must be a group key
+    (or shipped non-agg item) looked up by display name."""
+    if isinstance(e, S.Lit):
+        return e.value
+    if isinstance(e, S.Func) and e.name in S.AGG_FUNCS:
+        return agg_vals[S.expr_name(e)]
+    if not S.contains_agg(e):
+        key = S.expr_name(e)
+        if key in named:
+            return named[key]
+        if isinstance(e, (S.Col, S.Func)):
+            raise QueryError(
+                f"federated merge cannot evaluate {key!r}: "
+                "not a group key or aggregate")
+    if isinstance(e, S.Not):
+        return not _scalar_eval(e.expr, agg_vals, named)
+    if isinstance(e, S.Case):
+        for c, v in e.whens:
+            if _scalar_eval(c, agg_vals, named):
+                return _scalar_eval(v, agg_vals, named)
+        return (_scalar_eval(e.default, agg_vals, named)
+                if e.default is not None else None)
+    if isinstance(e, S.BinOp):
+        op = e.op
+        if op == "AND":
+            return bool(_scalar_eval(e.left, agg_vals, named)) and \
+                bool(_scalar_eval(e.right, agg_vals, named))
+        if op == "OR":
+            return bool(_scalar_eval(e.left, agg_vals, named)) or \
+                bool(_scalar_eval(e.right, agg_vals, named))
+        if op == "IN":
+            lv = _scalar_eval(e.left, agg_vals, named)
+            return lv in tuple(lit.value for lit in e.right)
+        if op == "LIKE":
+            lv = _scalar_eval(e.left, agg_vals, named)
+            return _like_to_pred(e.right.value)(str(lv))
+        left = _scalar_eval(e.left, agg_vals, named)
+        right = _scalar_eval(e.right, agg_vals, named)
+        if op in _CMP:
+            return _CMP[op](left, right)
+        lf, rf = float(left), float(right)
+        if op == "+":
+            return lf + rf
+        if op == "-":
+            return lf - rf
+        if op == "*":
+            return lf * rf
+        if op == "/":
+            return lf / rf if rf else 0.0
+        raise QueryError(f"unknown op {op}")
+    raise QueryError(f"cannot merge-evaluate {e!r}")
+
+
+def merge_partials(table: ColumnarTable, query: S.Select | str,
+                   partials: list[dict]) -> QueryResult:
+    """Coordinator reduce step over execute_partial() results (the
+    local shard's partial included). Groups join on DECODED key tuples;
+    HAVING / ORDER BY / LIMIT apply only here, at the top."""
+    if isinstance(query, str):
+        query = S.parse(query)
+    query = _normalize(table, query)
+    names = [i.alias or S.expr_name(i.expr) for i in query.items]
+    parts = [p for p in partials if p]
+    if not _is_agg_query(query):
+        rows = []
+        for p in parts:
+            if p.get("kind") != "rows":
+                raise QueryError("shard returned mismatched partial kind")
+            rows.extend(list(r) for r in p.get("values", []))
+        return QueryResult(columns=names,
+                           values=_order_limit(query, names, rows))
+    sites = _agg_sites(query)
+    site_keys = [S.expr_name(s) for s in sites]
+    groups: dict[tuple, dict] = {}
+    group_seq: list[tuple] = []
+    for p in parts:
+        if p.get("kind") != "agg":
+            raise QueryError("shard returned mismatched partial kind")
+        keys = p.get("keys", [])
+        for gi in range(int(p.get("n_groups", 0))):
+            kt = tuple(col[gi] for col in keys)
+            g = groups.get(kt)
+            if g is None:
+                g = groups[kt] = {
+                    "items": {k: v[gi]
+                              for k, v in p.get("items", {}).items()},
+                    "sites": {sk: [] for sk in site_keys}}
+                group_seq.append(kt)
+            for sk in site_keys:
+                g["sites"][sk].append(p["sites"][sk][gi])
+    rows = []
+    for kt in group_seq:
+        g = groups[kt]
+        merged = {sk: _merge_site(s, g["sites"][sk])
+                  for s, sk in zip(sites, site_keys)}
+        named: dict[str, object] = {}
+        for gexpr, kv in zip(query.group_by, kt):
+            named[S.expr_name(gexpr)] = kv
+        for idx, item in enumerate(query.items):
+            if not S.contains_agg(item.expr):
+                v = g["items"].get(str(idx))
+                named[S.expr_name(item.expr)] = v
+                if item.alias:
+                    named[item.alias] = v
+        if query.having is not None and \
+                not _scalar_eval(query.having, merged, named):
+            continue
+        rows.append([
+            (g["items"].get(str(idx))
+             if not S.contains_agg(item.expr)
+             else _scalar_eval(item.expr, merged, named))
+            for idx, item in enumerate(query.items)])
+    return QueryResult(columns=names,
+                       values=_order_limit(query, names, rows))

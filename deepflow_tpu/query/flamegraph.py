@@ -54,15 +54,20 @@ def build_flame_tree(stacks: list[str], values: list[int],
     return root
 
 
-def profile_flame_tree(table: ColumnarTable,
-                       time_start_ns: int | None = None,
-                       time_end_ns: int | None = None,
-                       event_type: str | None = None,
-                       app_service: str | None = None,
-                       profiler: str | None = None,
-                       stack_col: str = "stack",
-                       value_col: str = "value") -> FlameNode:
-    """Flame tree straight off the in_process_profile table.
+def profile_stack_values(table: ColumnarTable,
+                         time_start_ns: int | None = None,
+                         time_end_ns: int | None = None,
+                         event_type: str | None = None,
+                         app_service: str | None = None,
+                         profiler: str | None = None,
+                         stack_col: str = "stack",
+                         value_col: str = "value") -> tuple[list, list]:
+    """Per-stack aggregated (folded_stacks, values) — the pre-tree form.
+
+    This is the cluster-federation unit: each shard aggregates in its
+    own encoded space, DECODES the surviving unique stacks, and the
+    coordinator sums by stack string before one build_flame_tree — the
+    stack ids themselves are shard-local and never merged.
 
     Aggregates by stack *in encoded space* (SmartEncoding: group by the
     dictionary id, decode only the surviving unique stacks).
@@ -78,12 +83,12 @@ def profile_flame_tree(table: ColumnarTable,
     if app_service is not None:
         svc_code = table.dicts["app_service"].lookup(app_service)
         if svc_code is None:
-            return FlameNode("root")
+            return [], []
     prof_code = None
     if profiler is not None:
         prof_code = table.dicts["profiler"].lookup(profiler)
         if prof_code is None:
-            return FlameNode("root")
+            return [], []
     for ch in chunks:
         mask = np.ones(len(ch[stack_col]), dtype=bool)
         if time_start_ns is not None:
@@ -105,4 +110,29 @@ def profile_flame_tree(table: ColumnarTable,
         for sid, v in zip(uniq.tolist(), sums.tolist()):
             agg[sid] = agg.get(sid, 0) + int(v)
     stacks = [d.decode(sid) for sid in agg]
-    return build_flame_tree(stacks, list(agg.values()))
+    return stacks, list(agg.values())
+
+
+def merge_stack_values(parts: list[tuple[list, list]]) -> tuple[list, list]:
+    """Sum per-shard (stacks, values) aggregates by stack string."""
+    agg: dict[str, int] = {}
+    for stacks, values in parts:
+        for s, v in zip(stacks, values):
+            agg[s] = agg.get(s, 0) + int(v)
+    return list(agg.keys()), list(agg.values())
+
+
+def profile_flame_tree(table: ColumnarTable,
+                       time_start_ns: int | None = None,
+                       time_end_ns: int | None = None,
+                       event_type: str | None = None,
+                       app_service: str | None = None,
+                       profiler: str | None = None,
+                       stack_col: str = "stack",
+                       value_col: str = "value") -> FlameNode:
+    """Flame tree straight off the in_process_profile table."""
+    stacks, values = profile_stack_values(
+        table, time_start_ns=time_start_ns, time_end_ns=time_end_ns,
+        event_type=event_type, app_service=app_service, profiler=profiler,
+        stack_col=stack_col, value_col=value_col)
+    return build_flame_tree(stacks, values)

@@ -707,6 +707,15 @@ def fetch_raw(db: Database, vs: VectorSelector, lo_s: float,
     """All samples in [lo_s, hi_s] for the selector, split into series by
     the full tag set (series identity is always the full tag set; any
     grouping happens later across evaluated series)."""
+    # cluster federation hook: a federated db-shim intercepts selector
+    # materialization here (BEFORE local metric resolution — a remote
+    # shard may know a metric this node has never seen) and hands back
+    # local + remote series merged by label set. The whole PromQL AST
+    # then evaluates at the coordinator, so federated results are EXACT
+    # for every function (Thanos-style raw-selector fan-out).
+    hook = getattr(db, "promql_fetch_raw", None)
+    if hook is not None:
+        return hook(vs, lo_s, hi_s)
     table, col, tags, pre_filters, labels_col = _resolve_metric(db, vs.metric)
     appliers = _compile_matchers(table, vs.matchers, labels_col)
     # remote-write clients send CUMULATIVE counters (standard Prometheus),

@@ -78,6 +78,37 @@ def _rows(table: ColumnarTable, mask_fn) -> list[dict]:
     return out
 
 
+def scan_trace_spans(l7_table: ColumnarTable, trace_id: str) -> list[dict]:
+    """One shard's raw span dicts for a trace, scanned from l7_flow_log.
+    The dict shape feeds build_trace_from_spans, so a cluster coordinator
+    can pool span dicts from every shard (spans of one trace may land on
+    many shards) and assemble once — dedup is by (span_id, start_ns,
+    flow_id) there."""
+    tid_code = l7_table.dicts["trace_id"].lookup(trace_id)
+    if tid_code is None:
+        return []
+    rows = _rows(l7_table, lambda ch: ch["trace_id"] == tid_code)
+    spans: list[dict] = []
+    for r in rows:
+        name = r["endpoint"] or r["request_resource"] or r["request_type"]
+        spans.append({
+            "span_id": (r["span_id"]
+                        or f"flow-{r['flow_id']}-{r['request_id']}"),
+            "parent_span_id": r["parent_span_id"],
+            "name": f"{r['request_type']} {name}".strip(),
+            "service": r.get("app_service") or r.get("host", ""),
+            "l7_protocol": r["l7_protocol"],
+            "start_ns": r["time"],
+            "end_ns": r["time"] + r["response_duration"],
+            "status": r["response_status"],
+            "response_code": r["response_code"],
+            "ip_src": r["ip_src"], "ip_dst": r["ip_dst"],
+            "flow_id": r["flow_id"],
+            "x_request_id": r["x_request_id"],
+        })
+    return spans
+
+
 def build_trace(l7_table: ColumnarTable, trace_id: str,
                 tpu_table: ColumnarTable | None = None,
                 max_spans: int = 1000) -> dict:
@@ -86,29 +117,11 @@ def build_trace(l7_table: ColumnarTable, trace_id: str,
     This is the FALLBACK path (standalone library use, or data not yet
     precomputed); the server prefers build_trace_from_spans over the
     ingest-time flow_log.trace_tree rows."""
-    tid_code = l7_table.dicts["trace_id"].lookup(trace_id)
-    if tid_code is None:
+    spans = scan_trace_spans(l7_table, trace_id)
+    if not spans:
         return {"trace_id": trace_id, "spans": [], "span_count": 0,
                 "truncated": False}
-    rows = _rows(l7_table, lambda ch: ch["trace_id"] == tid_code)
-    spans: list[TraceSpan] = []
-    for r in rows:
-        name = r["endpoint"] or r["request_resource"] or r["request_type"]
-        spans.append(TraceSpan(
-            span_id=r["span_id"] or f"flow-{r['flow_id']}-{r['request_id']}",
-            parent_span_id=r["parent_span_id"],
-            name=f"{r['request_type']} {name}".strip(),
-            service=r.get("app_service") or r.get("host", ""),
-            l7_protocol=r["l7_protocol"],
-            start_ns=r["time"],
-            end_ns=r["time"] + r["response_duration"],
-            status=r["response_status"],
-            response_code=r["response_code"],
-            ip_src=r["ip_src"], ip_dst=r["ip_dst"],
-            attrs={"flow_id": r["flow_id"],
-                   "x_request_id": r["x_request_id"]},
-        ))
-    return _assemble(trace_id, spans, tpu_table, max_spans)
+    return build_trace_from_spans(trace_id, spans, tpu_table, max_spans)
 
 
 def build_trace_from_spans(trace_id: str, span_dicts: list[dict],

@@ -34,7 +34,9 @@ class QuerierAPI:
     def __init__(self, db: Database, stats_provider=None,
                  controller=None, exporters=None, alerts=None,
                  trace_trees=None, telemetry=None,
-                 api_token: str | None = None) -> None:
+                 api_token: str | None = None,
+                 membership=None, federation=None,
+                 shard_id: int = 0) -> None:
         self.db = db
         self.stats_provider = stats_provider or (lambda: {})
         self.controller = controller
@@ -42,6 +44,12 @@ class QuerierAPI:
         self.alerts = alerts
         self.trace_trees = trace_trees  # TraceTreeBuilder (optional)
         self.telemetry = telemetry  # server-side Telemetry (optional)
+        # cluster federation (optional): ClusterMembership +
+        # FederationCoordinator — when peers are alive, queries scatter
+        # over /v1/shard/exec and merge here (see cluster/federation.py)
+        self.membership = membership
+        self.federation = federation
+        self.shard_id = shard_id
         # shared token gating the mutating control-plane surface
         # (/v1/repo upload, the OTA `upgrade` exec). Empty/None = open:
         # the default deployment binds the querier to localhost, and the
@@ -108,6 +116,45 @@ class QuerierAPI:
         endpoint = body.get("endpoint", "")
         return {"removed": self.exporters.remove(endpoint)}
 
+    def _resolve_table(self, table_name: str, db_name: str = ""):
+        # resolution order: as-given, db-prefixed, then with the default
+        # interval suffix (flow_metrics tables are <name>.<interval>)
+        candidates = [table_name, f"{table_name}.1s"]
+        if db_name:
+            candidates = [f"{db_name}.{table_name}",
+                          f"{db_name}.{table_name}.1s"] + candidates
+        for cand in candidates:
+            try:
+                return self.db.table(cand)
+            except KeyError:
+                continue
+        raise qengine.QueryError(
+            f"no such table {table_name!r}; known: {self.db.tables()}")
+
+    @staticmethod
+    def _org_scope(select: qsql.Select, table, org) -> None:
+        if "org_id" not in table.columns:
+            # silently dropping the filter would hand one tenant
+            # another tenant's rows — refuse instead
+            raise qengine.QueryError(
+                f"table {table.name!r} has no org scoping; "
+                "query it without org_id")
+        # cooperative VIEW filter, not a security boundary: the
+        # caller names the org it wants and nothing verifies it may
+        # (see docs/SECURITY.md). ANDed into the parsed AST rather
+        # than the SQL text so the filter can't be quoted away.
+        cond = qsql.BinOp("=", qsql.Col("org_id"),
+                          qsql.Lit(int(org)))
+        select.where = (cond if select.where is None
+                        else qsql.BinOp("AND", select.where, cond))
+
+    def _fed(self):
+        """The FederationCoordinator iff remote peers are alive right
+        now — otherwise every query takes the plain local path."""
+        if self.federation is not None and self.federation.active():
+            return self.federation
+        return None
+
     def query(self, body: dict) -> dict:
         sql_text = body.get("sql", "")
         db_name = body.get("db", "")
@@ -120,53 +167,58 @@ class QuerierAPI:
                 raise qengine.QueryError(
                     f"no such table {e.args[0]!r} for SHOW") from None
             return {"result": result, "debug": {"show": select.what}}
-        table_name = select.table
-        # resolution order: as-given, db-prefixed, then with the default
-        # interval suffix (flow_metrics tables are <name>.<interval>)
-        candidates = [table_name, f"{table_name}.1s"]
-        if db_name:
-            candidates = [f"{db_name}.{table_name}",
-                          f"{db_name}.{table_name}.1s"] + candidates
-        table = None
-        for cand in candidates:
-            try:
-                table = self.db.table(cand)
-                break
-            except KeyError:
-                continue
-        if table is None:
-            raise qengine.QueryError(
-                f"no such table {table_name!r}; known: {self.db.tables()}")
+        table = self._resolve_table(select.table, db_name)
         org = body.get("org_id")
         if org is not None:
-            if "org_id" not in table.columns:
-                # silently dropping the filter would hand one tenant
-                # another tenant's rows — refuse instead
-                raise qengine.QueryError(
-                    f"table {table.name!r} has no org scoping; "
-                    "query it without org_id")
-            # cooperative VIEW filter, not a security boundary: the
-            # caller names the org it wants and nothing verifies it may
-            # (see docs/SECURITY.md). ANDed into the parsed AST rather
-            # than the SQL text so the filter can't be quoted away.
-            cond = qsql.BinOp("=", qsql.Col("org_id"),
-                              qsql.Lit(int(org)))
-            select.where = (cond if select.where is None
-                            else qsql.BinOp("AND", select.where, cond))
+            self._org_scope(select, table, org)
+        fed = self._fed()
+        if fed is not None:
+            result, info = fed.sql_query(table, select, sql_text,
+                                         org_id=org)
+            return {"result": result.to_dict(),
+                    "debug": {"table": table.name},
+                    "federation": info}
         result = qengine.execute(table, select)
         return {"result": result.to_dict(), "debug": {"table": table.name}}
 
     def profile_tracing(self, body: dict) -> dict:
         table = self.db.table("profile.in_process_profile")
+        params = {"time_start": body.get("time_start"),
+                  "time_end": body.get("time_end"),
+                  "event_type": body.get("event_type"),
+                  "app_service": body.get("app_service"),
+                  "profiler": body.get("profiler")}
+        fed = self._fed()
+        if fed is not None:
+            from deepflow_tpu.query.flamegraph import build_flame_tree
+            local = self._flame_stacks(params)
+            (stacks, values), info = fed.flame_stacks(
+                (local["stacks"], local["values"]), params)
+            return {"result": build_flame_tree(stacks, values).to_dict(),
+                    "federation": info}
         tree = profile_flame_tree(
             table,
-            time_start_ns=body.get("time_start"),
-            time_end_ns=body.get("time_end"),
-            event_type=body.get("event_type"),
-            app_service=body.get("app_service"),
-            profiler=body.get("profiler"),
+            time_start_ns=params["time_start"],
+            time_end_ns=params["time_end"],
+            event_type=params["event_type"],
+            app_service=params["app_service"],
+            profiler=params["profiler"],
         )
         return {"result": tree.to_dict()}
+
+    def _flame_stacks(self, params: dict) -> dict:
+        """Shard-local half of a federated flame graph: aggregate by
+        stack in this shard's encoded space, return DECODED stacks."""
+        from deepflow_tpu.query.flamegraph import profile_stack_values
+        table = self.db.table("profile.in_process_profile")
+        stacks, values = profile_stack_values(
+            table,
+            time_start_ns=params.get("time_start"),
+            time_end_ns=params.get("time_end"),
+            event_type=params.get("event_type"),
+            app_service=params.get("app_service"),
+            profiler=params.get("profiler"))
+        return {"stacks": stacks, "values": values}
 
     def tpu_flame(self, body: dict) -> dict:
         """Flame view over HLO device spans: module -> op hierarchy.
@@ -189,13 +241,24 @@ class QuerierAPI:
             "SELECT hlo_module, hlo_category, hlo_op, Sum(duration_ns) AS d "
             f"FROM t WHERE {' AND '.join(where)} "
             "GROUP BY hlo_module, hlo_category, hlo_op")
-        res = qengine.execute(table, sql_text)
+        fed = self._fed()
+        info = None
+        if fed is not None:
+            # an exact push-down case: Sum partials merge shard-side ids
+            # never travel (group keys are decoded strings)
+            res, info = fed.sql_query(table, qsql.parse(sql_text),
+                                      sql_text)
+        else:
+            res = qengine.execute(table, sql_text)
         from deepflow_tpu.query.flamegraph import build_flame_tree
         stacks, values = [], []
         for mod, cat, op, d in res.values:
             stacks.append(";".join(x for x in (mod, cat or "other", op) if x))
             values.append(int(d))
-        return {"result": build_flame_tree(stacks, values).to_dict()}
+        out = {"result": build_flame_tree(stacks, values).to_dict()}
+        if info is not None:
+            out["federation"] = info
+        return out
 
     def tpu_memory(self, body: dict) -> dict:
         """HBM observability (BASELINE config 3 '+ HBM'): per-device usage
@@ -370,6 +433,22 @@ class QuerierAPI:
                     "packages": self.controller.packages.list()}
         return {"packages": self.controller.packages.list()}
 
+    def _prom_db(self):
+        """The db handed to promql.evaluate: the federated shim when
+        peers are alive (raw selectors fan out, the AST still evaluates
+        here — exact), else the plain local store."""
+        fed = self._fed()
+        return fed.prom_db() if fed is not None else self.db
+
+    @staticmethod
+    def _prom_annotate(out: dict, db) -> dict:
+        missing = sorted(getattr(db, "missing_shards", ()))
+        if missing:
+            out["federation"] = {"missing_shards": missing}
+            out.setdefault("warnings", []).append(
+                f"partial result: shards {missing} did not answer")
+        return out
+
     def prom_query_range(self, params: dict) -> dict:
         """GET /prom/api/v1/query_range (reference: querier/app/prometheus,
         router.go:41)."""
@@ -381,16 +460,18 @@ class QuerierAPI:
             step = max(1, int(float(params.get("step", 15))))
         except ValueError as e:
             raise qengine.QueryError(f"bad time param: {e}")
+        db = self._prom_db()
         try:
             ast = promql.parse(q)
             if params.get("org_id") is not None:
                 promql.scope_to_org(ast, int(params["org_id"]))
-            result = promql.evaluate(self.db, ast, start, end, step)
+            result = promql.evaluate(db, ast, start, end, step)
         except promql.PromqlError as e:
             return {"status": "error", "errorType": "bad_data",
                     "error": str(e)}
-        return {"status": "success",
-                "data": {"resultType": "matrix", "result": result}}
+        return self._prom_annotate(
+            {"status": "success",
+             "data": {"resultType": "matrix", "result": result}}, db)
 
     def prom_query(self, params: dict) -> dict:
         """GET /prom/api/v1/query — instant queries (reference:
@@ -403,15 +484,16 @@ class QuerierAPI:
             t = int(float(params.get("time", _time.time())))
         except ValueError as e:
             raise qengine.QueryError(f"bad time param: {e}")
+        db = self._prom_db()
         try:
             ast = promql.parse(q)
             if params.get("org_id") is not None:
                 promql.scope_to_org(ast, int(params["org_id"]))
-            data = promql.evaluate_instant(self.db, ast, t)
+            data = promql.evaluate_instant(db, ast, t)
         except promql.PromqlError as e:
             return {"status": "error", "errorType": "bad_data",
                     "error": str(e)}
-        return {"status": "success", "data": data}
+        return self._prom_annotate({"status": "success", "data": data}, db)
 
     def _prom_meta_args(self, params: dict) -> tuple:
         """params is a parse_qs dict (every value a list — match[] can
@@ -434,9 +516,11 @@ class QuerierAPI:
         if not matches:
             return {"status": "error", "errorType": "bad_data",
                     "error": "no match[] parameter"}
+        db = self._prom_db()  # series() goes through fetch_raw: federates
         try:
-            return {"status": "success",
-                    "data": promql.series(self.db, matches, start, end)}
+            return self._prom_annotate(
+                {"status": "success",
+                 "data": promql.series(db, matches, start, end)}, db)
         except promql.PromqlError as e:
             return {"status": "error", "errorType": "bad_data",
                     "error": str(e)}
@@ -444,10 +528,14 @@ class QuerierAPI:
     def prom_labels(self, params: dict) -> dict:
         from deepflow_tpu.query import promql
         matches, start, end = self._prom_meta_args(params)
+        # with match[]: goes through series() -> fetch_raw, so the shim
+        # federates it; without matches, metadata stays LOCAL by design
+        # (schema is identical cluster-wide — docs/CLUSTER.md)
+        db = self._prom_db() if matches else self.db
         try:
-            return {"status": "success",
-                    "data": promql.label_names(self.db, matches, start,
-                                               end)}
+            return self._prom_annotate(
+                {"status": "success",
+                 "data": promql.label_names(db, matches, start, end)}, db)
         except promql.PromqlError as e:
             return {"status": "error", "errorType": "bad_data",
                     "error": str(e)}
@@ -455,10 +543,12 @@ class QuerierAPI:
     def prom_label_values(self, label: str, params: dict) -> dict:
         from deepflow_tpu.query import promql
         matches, start, end = self._prom_meta_args(params)
+        db = self._prom_db() if matches else self.db
         try:
-            return {"status": "success",
-                    "data": promql.label_values(self.db, label, matches,
-                                                start, end)}
+            return self._prom_annotate(
+                {"status": "success",
+                 "data": promql.label_values(db, label, matches,
+                                             start, end)}, db)
         except promql.PromqlError as e:
             return {"status": "error", "errorType": "bad_data",
                     "error": str(e)}
@@ -477,17 +567,13 @@ class QuerierAPI:
     _TEMPO_TAGS = ("service.name", "endpoint", "l7.protocol",
                    "http.status_code")
 
-    def tempo_search(self, params: dict) -> dict:
-        """GET /api/search — Tempo search API (reference: querier/tempo):
-        logfmt tags filter, min/maxDuration, time range, limit.
-
-        Tempo semantics: tags select traces (any single span matching ALL
-        tags qualifies the trace), but root/start/duration report the
-        WHOLE trace — so the scan keeps every span of the window and
-        filters at the trace level."""
+    def _tempo_scan(self, params: dict) -> list[dict]:
+        """Shard-local Tempo scan: one partial dict per trace seen HERE.
+        Tags select per-SPAN, but start/end/duration are per-TRACE and a
+        trace's spans may live on several shards — so duration filters
+        and the limit must NOT apply here; only at the merge/finalize."""
         import re as _re
         import time as _time
-        limit = max(1, min(int(params.get("limit", 20)), 500))
         tags = {}
         for k, v_quoted, v_plain in _re.findall(
                 r'([\w.]+)=(?:"([^"]*)"|(\S+))', params.get("tags", "")):
@@ -497,10 +583,6 @@ class QuerierAPI:
                 raise qengine.QueryError(
                     f"unsupported search tag {k!r}; known: "
                     f"{sorted(self._TEMPO_TAGS)}")
-        min_ns = (self._tempo_duration_ns(params["minDuration"])
-                  if params.get("minDuration") else 0)
-        max_ns = (self._tempo_duration_ns(params["maxDuration"])
-                  if params.get("maxDuration") else 0)
         where = ["trace_id != ''"]
         # a search must ALWAYS have a lower bound (a bare or end-only
         # request must not scan all history): default start is one hour
@@ -531,23 +613,47 @@ class QuerierAPI:
             tr = traces.get(tid)
             if tr is None:
                 tr = traces[tid] = {
-                    "traceID": tid, "start": t, "end": t + dur,
+                    "traceID": tid, "_start_ns": t, "_end_ns": t + dur,
+                    "spanCount": 1,
                     "rootServiceName": svc or "",
                     "rootTraceName": f"{rtype} {ep}".strip() or tid,
                     "_root_t": t, "_matched": matched}
             else:
-                tr["start"] = min(tr["start"], t)
-                tr["end"] = max(tr["end"], t + dur)
+                tr["_start_ns"] = min(tr["_start_ns"], t)
+                tr["_end_ns"] = max(tr["_end_ns"], t + dur)
+                tr["spanCount"] += 1
                 tr["_matched"] = tr["_matched"] or matched
                 if t < tr["_root_t"]:
                     tr["_root_t"] = t
                     tr["rootServiceName"] = svc or ""
                     tr["rootTraceName"] = f"{rtype} {ep}".strip() or tid
+        return list(traces.values())
+
+    def tempo_search(self, params: dict) -> dict:
+        """GET /api/search — Tempo search API (reference: querier/tempo):
+        logfmt tags filter, min/maxDuration, time range, limit.
+
+        Tempo semantics: tags select traces (any single span matching ALL
+        tags qualifies the trace), but root/start/duration report the
+        WHOLE trace — so the scan keeps every span of the window and
+        filters at the trace level (cluster: after the cross-shard
+        merge)."""
+        limit = max(1, min(int(params.get("limit", 20)), 500))
+        min_ns = (self._tempo_duration_ns(params["minDuration"])
+                  if params.get("minDuration") else 0)
+        max_ns = (self._tempo_duration_ns(params["maxDuration"])
+                  if params.get("maxDuration") else 0)
+        fed = self._fed()
+        info = None
+        if fed is not None:
+            traces, info = fed.tempo_search(self._tempo_scan, params)
+        else:
+            traces = self._tempo_scan(params)
         out = []
-        for tr in traces.values():
+        for tr in traces:
             if not tr["_matched"]:
                 continue
-            dur_ns = tr["end"] - tr["start"]
+            dur_ns = tr["_end_ns"] - tr["_start_ns"]
             if min_ns and dur_ns < min_ns:
                 continue
             if max_ns and dur_ns > max_ns:
@@ -555,11 +661,14 @@ class QuerierAPI:
             out.append({"traceID": tr["traceID"],
                         "rootServiceName": tr["rootServiceName"],
                         "rootTraceName": tr["rootTraceName"],
-                        "startTimeUnixNano": str(tr["start"]),
+                        "startTimeUnixNano": str(tr["_start_ns"]),
                         "durationMs": dur_ns // 1_000_000})
         out.sort(key=lambda tr: -int(tr["startTimeUnixNano"]))
-        return {"traces": out[:limit], "metrics": {
+        resp = {"traces": out[:limit], "metrics": {
             "inspectedTraces": len(traces)}}
+        if info is not None:
+            resp["federation"] = info
+        return resp
 
     def tempo_search_tags(self) -> dict:
         return {"tagNames": list(self._TEMPO_TAGS)}
@@ -596,9 +705,7 @@ class QuerierAPI:
     def tempo_trace(self, trace_id: str) -> dict:
         """GET /api/traces/{id} — Grafana Tempo-compatible shape
         (reference: querier/tempo)."""
-        from deepflow_tpu.query.tracing import build_trace
-        tree = build_trace(self.db.table("flow_log.l7_flow_log"), trace_id,
-                           tpu_table=self.db.table("profile.tpu_hlo_span"))
+        tree = self._assemble_trace(trace_id)
         spans = []
 
         def walk(node, parent_id=""):
@@ -643,17 +750,17 @@ class QuerierAPI:
         tree = self.trace_adapters.merge_into(tree, trace_id)
         return {"result": tree}
 
-    def _assemble_trace(self, trace_id: str, max_spans: int = 1000) -> dict:
-        """Prefer the ingest-time precompute (flow_log.trace_tree rows +
-        TraceTreeBuilder pending spans): touches only this trace's data.
-        Falls back to the l7 scan for data ingested before the builder
-        existed (e.g. loaded from an old data_dir)."""
+    def collect_trace_spans(self, trace_id: str) -> list[dict]:
+        """This shard's span dicts for one trace. Prefers the ingest-time
+        precompute (flow_log.trace_tree rows + TraceTreeBuilder pending
+        spans): touches only this trace's data. Falls back to the l7 scan
+        for data ingested before the builder existed (e.g. loaded from an
+        old data_dir)."""
         import json as _json
 
         import numpy as np
 
-        from deepflow_tpu.query.tracing import (build_trace,
-                                                build_trace_from_spans)
+        from deepflow_tpu.query.tracing import scan_trace_spans
         spans: list[dict] = []
         tree_table = self.db.table("flow_log.trace_tree")
         code = tree_table.dicts["trace_id"].lookup(trace_id)
@@ -666,15 +773,28 @@ class QuerierAPI:
                         tree_table.dicts["tree"].decode(int(ch["tree"][i]))))
         if self.trace_trees is not None:
             spans.extend(self.trace_trees.pending_spans(trace_id))
-        if spans:
-            return build_trace_from_spans(
-                trace_id, spans,
-                tpu_table=self.db.table("profile.tpu_hlo_span"),
-                max_spans=max_spans)
-        return build_trace(
-            self.db.table("flow_log.l7_flow_log"), trace_id,
+        if not spans:
+            spans = scan_trace_spans(
+                self.db.table("flow_log.l7_flow_log"), trace_id)
+        return spans
+
+    def _assemble_trace(self, trace_id: str, max_spans: int = 1000) -> dict:
+        """One trace's tree: this shard's spans, plus — when peers are
+        alive — every other shard's (one trace's spans may be ingested
+        anywhere; build_trace_from_spans dedups on the merged set)."""
+        from deepflow_tpu.query.tracing import build_trace_from_spans
+        spans = self.collect_trace_spans(trace_id)
+        fed = self._fed()
+        info = None
+        if fed is not None:
+            spans, info = fed.trace_spans(spans, trace_id)
+        tree = build_trace_from_spans(
+            trace_id, spans,
             tpu_table=self.db.table("profile.tpu_hlo_span"),
             max_spans=max_spans)
+        if info is not None:
+            tree["federation"] = info
+        return tree
 
     def log_search(self, body: dict) -> dict:
         """Search over the dedicated application_log.log store (reference:
@@ -865,6 +985,72 @@ class QuerierAPI:
         version = self.controller.configs.update(group, yaml_text.encode())
         return {"group": group, "version": version}
 
+    # -- cluster (scatter-gather) endpoints ---------------------------------
+
+    def shard_exec(self, body: dict, token: str | None = None) -> dict:
+        """POST /v1/shard/exec — the shard-local half of every federated
+        query. Execution here is STRICTLY local (never re-fans-out, even
+        with peers alive): the coordinator is the only merge point, so a
+        cycle of shards can't amplify one query."""
+        self._require_token(token, "/v1/shard/exec")
+        op = body.get("op", "")
+        if op == "sql_partial":
+            table = (self.db.table(body["table"]) if body.get("table")
+                     else self._resolve_table("", ""))
+            select = qsql.parse_statement(body.get("sql", ""))
+            if not isinstance(select, qsql.Select):
+                raise qengine.QueryError("sql_partial needs a SELECT")
+            org = body.get("org_id")
+            if org is not None:
+                # the coordinator's org filter lives in its AST, not the
+                # SQL text — re-inject it here from the op body
+                self._org_scope(select, table, org)
+            return qengine.execute_partial(table, select)
+        if op == "promql_raw":
+            from deepflow_tpu.query import promql
+            vs = promql.VectorSelector(
+                metric=str(body.get("metric", "")),
+                matchers=[tuple(m) for m in body.get("matchers", [])])
+            try:
+                series = promql.fetch_raw(self.db, vs,
+                                          float(body.get("lo_s", 0)),
+                                          float(body.get("hi_s", 0)))
+            except promql.UnknownMetricError:
+                return {"unknown": True}
+            return {"series": [
+                {"labels": s.labels, "t": s.t.tolist(), "v": s.v.tolist(),
+                 "counter": bool(s.counter)} for s in series]}
+        if op == "tempo_scan":
+            return {"traces": self._tempo_scan(body.get("params") or {})}
+        if op == "trace_spans":
+            return {"spans": self.collect_trace_spans(
+                str(body.get("trace_id", "")))}
+        if op == "profile_flame":
+            return self._flame_stacks(body.get("params") or {})
+        if op == "table_counts":
+            return {name: len(self.db.table(name))
+                    for name in self.db.tables()}
+        raise qengine.QueryError(f"unknown shard op {op!r}")
+
+    def cluster_join(self, body: dict) -> dict:
+        if self.membership is None:
+            raise qengine.QueryError("clustering not enabled")
+        return self.membership.handle_join(body)
+
+    def cluster_peers(self) -> dict:
+        if self.membership is None:
+            return {"version": 0, "peers": []}
+        self.membership.refresh_self()
+        return self.membership.directory.snapshot()
+
+    def cluster_status(self) -> dict:
+        """The dfctl `cluster` view: peer table with per-shard row
+        counts and probe latency."""
+        if self.federation is None:
+            return {"shard_id": self.shard_id, "version": 0, "peers": [],
+                    "fanout": {}}
+        return self.federation.cluster_status()
+
     def health(self) -> dict:
         """Liveness + the self-telemetry spine: per-stage heartbeat
         status, the per-hop frame ledger (with imbalance), and wedge
@@ -877,6 +1063,12 @@ class QuerierAPI:
                        for name in self.db.tables()},
             "stats": self.stats_provider(),
         }
+        if self.membership is not None:
+            out["cluster"] = {
+                "shard_id": self.shard_id,
+                "version": self.membership.directory.version,
+                "peers_alive": len(self.membership.peers()),
+            }
         wedged_stages: list[str] = []
         if self.telemetry is not None:
             selfmon = self.telemetry.snapshot()
@@ -968,6 +1160,10 @@ class QuerierHTTP:
                 try:
                     if path in ("/v1/health", "/health"):
                         self._send(200, api.health())
+                    elif path == "/v1/cluster/peers":
+                        self._send(200, api.cluster_peers())
+                    elif path == "/v1/cluster/status":
+                        self._send(200, api.cluster_status())
                     elif path == "/v1/agents":
                         self._send(200, api.agents())
                     elif path == "/v1/alerts":
@@ -1037,7 +1233,25 @@ class QuerierHTTP:
                         return
                     body = self._body()
                     path = parsed.path.rstrip("/")
-                    if path == "/v1/query":
+                    if path == "/v1/shard/exec":
+                        # binary columnar response (codec SHARD_RESULT
+                        # frame), not JSON: numeric result columns ride
+                        # as raw little-endian arrays
+                        from deepflow_tpu.cluster import wire
+                        obj = api.shard_exec(body, token=self._token(body))
+                        payload = wire.encode_result(
+                            obj, shard_id=api.shard_id)
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "application/octet-stream")
+                        self.send_header("Content-Length",
+                                         str(len(payload)))
+                        self.end_headers()
+                        self.wfile.write(payload)
+                        return
+                    if path == "/v1/cluster/join":
+                        self._send(200, api.cluster_join(body))
+                    elif path == "/v1/query":
                         self._send(200, api.query(body))
                     elif path == "/v1/profile/ProfileTracing":
                         self._send(200, api.profile_tracing(body))

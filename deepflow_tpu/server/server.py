@@ -34,7 +34,12 @@ class Server:
                  selfmon: bool | None = None,
                  deadman_window_s: float = 15.0,
                  selfstats_interval_s: float = 10.0,
-                 api_token: str | None = None) -> None:
+                 api_token: str | None = None,
+                 shard_id: int = 0,
+                 cluster_seed: str | None = None,
+                 cluster_advertise: str | None = None,
+                 fanout_timeout_s: float = 5.0,
+                 fanout_hedge_delay_s: float = 0.25) -> None:
         # flow-log decode parallelism for THIS server instance; None
         # defers to the DF_INGEST_WORKERS env knob read at import time
         self.ingest_workers = ingest_workers
@@ -45,7 +50,20 @@ class Server:
         self.ha_lease_path = ha_lease_path
         self.ha_k8s_lease = ha_k8s_lease
         self.election = None
-        self.db = Database(data_dir=data_dir)
+        # cluster federation: this node's shard identity + how to find
+        # the seed (leader controller). Enabled by passing a seed and/or
+        # an advertise address — a lone seed is a working 1-node cluster
+        self.shard_id = shard_id
+        self.cluster_seed = cluster_seed
+        self.cluster_advertise = cluster_advertise
+        self._cluster_on = (cluster_seed is not None
+                            or cluster_advertise is not None)
+        self._fanout_timeout_s = fanout_timeout_s
+        self._fanout_hedge_delay_s = fanout_hedge_delay_s
+        self.membership = None
+        self.fanout = None
+        self.federation = None
+        self.db = Database(data_dir=data_dir, shard_id=shard_id)
         self.platform = PlatformInfoTable()
         from deepflow_tpu.server.platform_info import (PodIpIndex,
                                                        ResourceIndex)
@@ -89,7 +107,8 @@ class Server:
                               exporters=self.exporters, alerts=self.alerts,
                               trace_trees=self.trace_trees,
                               telemetry=self.telemetry,
-                              api_token=api_token)
+                              api_token=api_token,
+                              shard_id=shard_id)
         self.http = QuerierHTTP(self.api,
                                 host=query_host if query_host else host,
                                 port=query_port)
@@ -187,6 +206,28 @@ class Server:
             self.decoders.append(d.start())
         self.receiver.start()
         self.http.start()
+        if self._cluster_on:
+            # after http.start(): with --query-port 0 the advertise
+            # address needs the REAL bound port
+            from deepflow_tpu.cluster.federation import (
+                FederationCoordinator)
+            from deepflow_tpu.cluster.membership import ClusterMembership
+            from deepflow_tpu.cluster.remote import FanOut
+            adv = (self.cluster_advertise
+                   or f"127.0.0.1:{self.http.port}")
+            self.membership = ClusterMembership(
+                self.shard_id, adv, seed=self.cluster_seed,
+                telemetry=self.telemetry).start()
+            self.fanout = FanOut(
+                telemetry=self.telemetry,
+                timeout_s=self._fanout_timeout_s,
+                hedge_delay_s=self._fanout_hedge_delay_s,
+                api_token=self.api.api_token or None)
+            self.federation = FederationCoordinator(
+                self.db, self.membership, self.fanout,
+                shard_id=self.shard_id)
+            self.api.membership = self.membership
+            self.api.federation = self.federation
         self.alerts.start()
         self.deadman.start()
         if self.telemetry.enabled:
@@ -250,6 +291,10 @@ class Server:
         if not self._started:
             return
         self.deadman.stop()
+        if self.membership is not None:
+            self.membership.stop()
+        if self.fanout is not None:
+            self.fanout.close()
         self._selfstats_stop.set()
         if self._selfstats_thread is not None:
             self._selfstats_thread.join(timeout=2.0)
@@ -313,6 +358,18 @@ def main() -> None:
                         help="flag a stage wedged after this many seconds "
                              "without a heartbeat")
     parser.add_argument("--sync-port", type=int, default=20035)
+    parser.add_argument("--shard-id", type=int, default=0,
+                        help="this node's cluster shard identity "
+                             "(tags ingested rows; 0 = standalone)")
+    parser.add_argument("--cluster-seed", default=None,
+                        help="seed node addr host:query_port to join "
+                             "(the leader controller's querier)")
+    parser.add_argument("--advertise", default=None,
+                        help="addr other shards reach THIS querier at "
+                             "(default 127.0.0.1:<query-port>)")
+    parser.add_argument("--fanout-timeout-s", type=float, default=5.0,
+                        help="per-shard scatter-gather call deadline; "
+                             "slower shards degrade to missing_shards")
     parser.add_argument("--data-dir", default=None)
     parser.add_argument("--ha-lease", default=None,
                         help="shared-volume lease FILE for leader election")
@@ -333,6 +390,10 @@ def main() -> None:
                     ha_k8s_lease=args.ha_k8s_lease,
                     api_token=args.api_token,
                     deadman_window_s=args.deadman_window_s,
+                    shard_id=args.shard_id,
+                    cluster_seed=args.cluster_seed,
+                    cluster_advertise=args.advertise,
+                    fanout_timeout_s=args.fanout_timeout_s,
                     enable_controller=not args.no_controller).start()
     try:
         while True:

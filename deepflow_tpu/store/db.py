@@ -13,9 +13,13 @@ class Database:
     """A set of named ColumnarTables (the ClickHouse analog, embedded)."""
 
     def __init__(self, data_dir: str | None = None,
-                 chunk_rows: int = 1 << 16) -> None:
+                 chunk_rows: int = 1 << 16, shard_id: int = 0) -> None:
         self.data_dir = data_dir
         self.chunk_rows = chunk_rows
+        # cluster shard identity: every ingested row that has a shard_id
+        # column gets stamped with it (virtual tag of the RECEIVING
+        # server; 0 = standalone)
+        self.shard_id = shard_id
         self._tables: dict[str, ColumnarTable] = {}
         self._lock = threading.Lock()
         for name, cols in schema.TABLES.items():
@@ -27,6 +31,8 @@ class Database:
             if name in self._tables:
                 return self._tables[name]
             t = ColumnarTable(name, columns, chunk_rows=self.chunk_rows)
+            if self.shard_id and "shard_id" in t.columns:
+                t.fills["shard_id"] = self.shard_id
             self._tables[name] = t
             return t
 

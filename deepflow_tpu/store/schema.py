@@ -34,6 +34,11 @@ UNIVERSAL_TAGS = [
     # pre-org saved data backfilled at load — lands in the default org,
     # so org-scoped queries (org_id=1) still see it.
     C("org_id", "u16", default=1),
+    # receiving-shard identity (cluster federation): stamped by the
+    # ingesting server via ColumnarTable.fills, 0 = standalone. Lets a
+    # coordinator GROUP BY shard_id to audit the split, and cluster-check
+    # assert federated == union-of-shards.
+    C("shard_id", "u16"),
     C("agent_id", "u16"),
     C("host_id", "u16"),
     C("host", "str"),

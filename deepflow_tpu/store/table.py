@@ -86,6 +86,15 @@ class ColumnarTable:
         self._lock = threading.Lock()  # guards _chunks, rows_written,
         # dicts swap (compaction) and stripe creation
         self.rows_written = 0
+        # per-table fill overrides: the value a column takes when a write
+        # omits it (and when load() backfills chunks persisted before the
+        # column existed), instead of the schema default. Set once at
+        # wiring time — e.g. Database(shard_id=N) stamps every row this
+        # node ingests with its cluster shard identity.
+        self.fills: dict[str, object] = {}
+
+    def _fill(self, name: str, spec: ColumnSpec):
+        return self.fills.get(name, spec.default)
 
     # -- write path ----------------------------------------------------------
 
@@ -120,12 +129,13 @@ class ColumnarTable:
         str_raw: dict[str, tuple] = {}
         for name, spec in self.columns.items():
             if spec.kind == "str":
-                raw = [r.get(name, "") for r in rows]
+                dflt_s = self.fills.get(name, "")
+                raw = [r.get(name, dflt_s) for r in rows]
                 d, segs[name] = self._encode_str_segment(name, raw,
                                                          len(rows))
                 str_raw[name] = (d, raw)
             else:
-                dflt = spec.default
+                dflt = self._fill(name, spec)
                 segs[name] = [r.get(name, dflt) for r in rows]
         self._append_segments(segs, len(rows), str_raw)
 
@@ -166,7 +176,8 @@ class ColumnarTable:
                 else:
                     segs[name] = list(v)  # shallow copy: caller may reuse
             else:
-                segs[name] = np.full(n, spec.default, dtype=spec.np_dtype)
+                segs[name] = np.full(n, self._fill(name, spec),
+                                     dtype=spec.np_dtype)
         self._append_segments(segs, n, str_raw)
 
     def _append_segments(self, segs: dict[str, object], n: int,
@@ -429,13 +440,17 @@ class ColumnarTable:
                         ch = migration.migrate_chunk(self.name, ch,
                                                      from_version)
                     # additive schema compat: chunks persisted before a
-                    # column existed get the column's default (else any
-                    # query touching the new column KeyErrors)
+                    # column existed get the column's fill (else any
+                    # query touching the new column KeyErrors). Fill, not
+                    # schema default: rows saved by a pre-cluster node
+                    # and loaded by shard N were ingested HERE, so they
+                    # take this shard's identity
                     if ch:
                         n = len(next(iter(ch.values())))
                         for name, spec in self.columns.items():
                             if name not in ch:
-                                ch[name] = np.full(n, spec.default,
+                                ch[name] = np.full(n,
+                                                   self._fill(name, spec),
                                                    dtype=spec.np_dtype)
                     self._chunks.append(ch)
             for name in self.dicts:

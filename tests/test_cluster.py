@@ -1,0 +1,491 @@
+"""Cluster federation tests: wire format, sketch, membership, and the
+merge-equivalence contract — a golden corpus split over 3 shards must
+answer DF-SQL / PromQL / Tempo / flame queries identically to one
+standalone server holding every row (docs/CLUSTER.md)."""
+
+import json
+import time
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deepflow_tpu.cluster import wire
+from deepflow_tpu.cluster.membership import Peer, PeerDirectory
+from deepflow_tpu.cluster.sketch import HistogramSketch
+from deepflow_tpu.store.schema import (L7_PROTOS, PROFILE_EVENT_TYPES,
+                                       RESPONSE_STATUS, TPU_SPAN_KINDS)
+
+
+# -- wire format -----------------------------------------------------------
+
+def test_wire_roundtrip_table():
+    obj = {"columns": ["n", "name", "ratio"],
+           "values": [[1, "alpha", 2.5], [2, "beta", -0.25],
+                      [3, "", 1e12]],
+           "extra": {"groups": 3}}
+    obj2, sid = wire.decode_result(wire.encode_result(obj, shard_id=7))
+    assert sid == 7
+    assert obj2["columns"] == obj["columns"]
+    assert obj2["values"] == obj["values"]
+    assert obj2["extra"] == {"groups": 3}
+    # int column survives as int (i64 path), float column as float
+    assert isinstance(obj2["values"][0][0], int)
+    assert isinstance(obj2["values"][0][2], float)
+
+
+def test_wire_roundtrip_empty_and_large():
+    obj = {"columns": ["a"], "values": []}
+    obj2, _ = wire.decode_result(wire.encode_result(obj))
+    assert obj2["values"] == []
+    # > 512B payload exercises the frame layer's zlib path
+    big = {"columns": ["s", "v"],
+           "values": [[f"stack;frame_{i};leaf", i] for i in range(200)]}
+    big2, sid = wire.decode_result(wire.encode_result(big, shard_id=3))
+    assert sid == 3 and big2["values"] == big["values"]
+
+
+def test_wire_json_fallback():
+    obj = {"spans": [{"span_id": "s1", "start_ns": 5}], "unknown": True}
+    obj2, sid = wire.decode_result(wire.encode_result(obj, shard_id=2))
+    assert obj2 == obj and sid == 2
+    with pytest.raises(wire.WireError):
+        wire.decode_result(b"\x00\x01")
+
+
+# -- histogram sketch ------------------------------------------------------
+
+def test_sketch_merge_matches_single_sketch():
+    values = np.geomspace(1.0, 1e6, 500)
+    whole = HistogramSketch()
+    whole.add_many(values)
+    merged = HistogramSketch()
+    for part in np.array_split(values, 3):
+        s = HistogramSketch()
+        s.add_many(part)
+        merged.merge(HistogramSketch.from_dict(s.to_dict()))  # wire form
+    assert merged.count == whole.count == 500
+    for p in (50, 90, 95, 99):
+        assert merged.percentile(p) == whole.percentile(p)
+        # ~2% relative error vs the exact percentile (gamma = 1.02)
+        exact = float(np.percentile(values, p))
+        assert merged.percentile(p) == pytest.approx(exact, rel=0.05)
+
+
+def test_sketch_zeros_and_bounds():
+    s = HistogramSketch()
+    s.add_many(np.array([0.0, 0.0, 10.0, 20.0]))
+    assert s.percentile(25) == 0.0            # zeros rank first
+    assert s.percentile(100) <= 20.0          # clamped to observed max
+    assert HistogramSketch().percentile(99) == 0.0
+
+
+# -- membership directory --------------------------------------------------
+
+def test_peer_directory_version_semantics():
+    d = PeerDirectory()
+    assert d.upsert(Peer(shard_id=1, addr="a:1", epoch=10)) is True
+    assert d.version == 1
+    # heartbeat (same addr + epoch) refreshes last_seen, no version bump
+    assert d.upsert(Peer(shard_id=1, addr="a:1", epoch=10)) is False
+    assert d.version == 1
+    # restart (epoch bump) and address move ARE membership changes
+    assert d.upsert(Peer(shard_id=1, addr="a:1", epoch=11)) is True
+    assert d.upsert(Peer(shard_id=1, addr="b:2", epoch=11)) is True
+    assert d.version == 3
+    d.upsert(Peer(shard_id=2, addr="c:3", epoch=5))
+    assert len(d.alive()) == 2
+    # adopt: a joiner takes the seed's snapshot wholesale
+    j = PeerDirectory()
+    j.adopt(d.snapshot())
+    assert j.version == d.version
+    assert [p["shard_id"] for p in j.snapshot()["peers"]] == [1, 2]
+    # stale snapshot (lower version) is ignored
+    j.upsert(Peer(shard_id=3, addr="d:4", epoch=1))
+    v = j.version
+    j.adopt({"version": 1, "peers": []})
+    assert j.version == v and len(j.snapshot()["peers"]) == 3
+
+
+# -- satellite: unchanged analyzer list must not rebalance the sender ------
+
+def test_apply_analyzers_unchanged_list_is_noop():
+    """Re-applying the SAME analyzer assignment (every sync response
+    carries it) must not tear down / reconnect the sender."""
+    from types import SimpleNamespace
+
+    from deepflow_tpu.agent.sender import UniformSender
+    from deepflow_tpu.agent.synchronizer import Synchronizer
+
+    sender = UniformSender(servers=[("127.0.0.1", 20033)])
+    fake = SimpleNamespace(agent=SimpleNamespace(sender=sender),
+                           _configured_servers=[("127.0.0.1", 20033)])
+    Synchronizer._apply_analyzers(fake, ["10.0.0.1:30033",
+                                         "10.0.0.2:30033"])
+    assert sender.stats.get("rebalances") == 1
+    servers_obj = sender.servers
+    assert servers_obj == [("10.0.0.1", 30033), ("10.0.0.2", 30033)]
+    # the same list again: no reassignment, no rebalance, no reconnect
+    for _ in range(3):
+        Synchronizer._apply_analyzers(fake, ["10.0.0.1:30033",
+                                             "10.0.0.2:30033"])
+    assert sender.servers is servers_obj
+    assert sender.stats.get("rebalances") == 1
+    assert sender.stats["reconnects"] == 0
+    # empty assignment falls back to the configured servers (a change)
+    Synchronizer._apply_analyzers(fake, [])
+    assert sender.servers == [("127.0.0.1", 20033)]
+    assert sender.stats.get("rebalances") == 2
+
+
+# -- golden corpus ---------------------------------------------------------
+
+BASE_S = 1_754_000_000
+BASE_NS = BASE_S * 1_000_000_000
+
+_L7 = {n: i for i, n in enumerate(L7_PROTOS)}
+_RS = {n: i for i, n in enumerate(RESPONSE_STATUS)}
+_EV = {n: i for i, n in enumerate(PROFILE_EVENT_TYPES)}
+_KIND = {n: i for i, n in enumerate(TPU_SPAN_KINDS)}
+
+
+def _corpus() -> dict:
+    """Rows per table. Every start_ns is unique (deterministic trace
+    trees) and every flame stack total is distinct (deterministic child
+    order)."""
+    l7 = []
+    svcs = ("svc-a", "svc-b", "svc-c")
+    protos = (_L7["http1"], _L7["dns"], _L7["http1"], _L7["mysql"])
+    # trace-1: 5 spans (s1 root), trace-2: 3 spans, rest single-span
+    span_plan = {0: ("trace-1", "s1", ""), 1: ("trace-1", "s2", "s1"),
+                 2: ("trace-1", "s3", "s1"), 3: ("trace-1", "s4", "s2"),
+                 4: ("trace-1", "s5", "s2"),
+                 5: ("trace-2", "r1", ""), 6: ("trace-2", "r2", "r1"),
+                 7: ("trace-2", "r3", "r2")}
+    for i in range(24):
+        tid, sid, parent = span_plan.get(
+            i, (f"solo-{i}", f"sp-{i}", ""))
+        l7.append({
+            "time": BASE_NS + i * 1_000_000,      # unique start_ns
+            "flow_id": 100 + i,
+            "app_service": svcs[i % 3],
+            "ip_src": f"10.0.0.{i % 4}", "ip_dst": "10.0.1.1",
+            "port_src": 40000 + i, "port_dst": 8080,
+            "l7_protocol": protos[i % 4],
+            "request_type": "GET" if i % 2 == 0 else "POST",
+            "endpoint": f"/api/{'abc'[i % 3]}",
+            "request_id": i,
+            "response_status": (_RS["ok"] if i % 5 else
+                                _RS["server_error"]),
+            "response_code": (200, 404, 500)[i % 3],
+            "response_duration": 10_000 + i * 150,  # small adjacent gaps
+            "trace_id": tid, "span_id": sid, "parent_span_id": parent,
+        })
+    prom = []
+    for i in range(6):
+        prom.append({"time": BASE_S + i * 10,
+                     "metric_name": "fed_requests_total",
+                     "labels_json": '{"job": "a"}',
+                     "value": float(100 + i * 10)})
+        prom.append({"time": BASE_S + i * 10,
+                     "metric_name": "fed_requests_total",
+                     "labels_json": '{"job": "b"}',
+                     "value": float(50 + i * 5)})
+        prom.append({"time": BASE_S + i * 10,
+                     "metric_name": "fed_gauge",
+                     "labels_json": '{"host": "h1"}',
+                     "value": float(7 + i)})
+    profile = []
+    for stack, per, n in (("main;ingest;decode", 10, 4),
+                          ("main;ingest;write", 5, 5),
+                          ("main;query;merge", 6, 2)):
+        for k in range(n):
+            profile.append({"time": BASE_NS + len(profile) * 1000,
+                            "app_service": "svc-prof",
+                            "process_name": "df", "event_type": _EV["on-cpu"],
+                            "profiler": "py-spy", "stack": stack,
+                            "value": per, "count": 1})
+    tpu = []
+    plan = (("mod_a", "convolution", "conv.1", 900),
+            ("mod_a", "all-reduce", "ar.1", 410),
+            ("mod_b", "convolution", "conv.2", 170),
+            ("mod_b", "other", "copy.3", 65))
+    for j, (mod, cat, op, dur) in enumerate(plan):
+        for k in range(3):
+            tpu.append({"time": BASE_NS + (j * 3 + k) * 500,
+                        "duration_ns": dur + k, "device_id": k,
+                        "kind": _KIND["device-compute"],
+                        "hlo_module": mod, "hlo_category": cat,
+                        "hlo_op": op, "flops": 1000})
+    # one host-side span: must be excluded by the default TpuFlame view
+    tpu.append({"time": BASE_NS, "duration_ns": 9999, "device_id": 0,
+                "kind": _KIND["host-compile"], "hlo_module": "mod_h",
+                "hlo_category": "compile", "hlo_op": "jit", "flops": 0})
+    return {"flow_log.l7_flow_log": l7, "prometheus.samples": prom,
+            "profile.in_process_profile": profile,
+            "profile.tpu_hlo_span": tpu}
+
+
+def _canon(x):
+    if isinstance(x, bool):
+        return x
+    if isinstance(x, (int, float)):
+        return round(float(x), 6)
+    if isinstance(x, list):
+        return [_canon(v) for v in x]
+    if isinstance(x, dict):
+        return {k: _canon(v) for k, v in x.items()}
+    return x
+
+
+def _get(port: int, path: str, params: dict | None = None) -> dict:
+    url = f"http://127.0.0.1:{port}{path}"
+    if params:
+        url += "?" + urllib.parse.urlencode(params)
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def _post(port: int, path: str, body: dict) -> dict:
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}",
+                                 data=json.dumps(body).encode())
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def _sorted_prom(data: dict) -> dict:
+    data = dict(data)
+    data["result"] = sorted(
+        data.get("result", []),
+        key=lambda s: json.dumps(s.get("metric", {}), sort_keys=True))
+    return data
+
+
+# -- the 3-shard equivalence + degraded-mode integration test --------------
+
+def test_cluster_federation_end_to_end():
+    from deepflow_tpu.server import Server
+
+    corpus = _corpus()
+    solo = Server(host="127.0.0.1", ingest_port=0, query_port=0,
+                  sync_port=0).start()
+    seed = Server(host="127.0.0.1", ingest_port=0, query_port=0,
+                  sync_port=0, shard_id=1, cluster_advertise="").start()
+    shards = [seed]
+    try:
+        seed_addr = f"127.0.0.1:{seed.query_port}"
+        for sid in (2, 3):
+            shards.append(Server(
+                host="127.0.0.1", ingest_port=0, query_port=0,
+                sync_port=0, shard_id=sid,
+                cluster_seed=seed_addr).start())
+
+        # corpus: all rows on solo, round-robin across the 3 shards
+        for name, rows in corpus.items():
+            solo.db.table(name).append_rows(rows)
+            for i, row in enumerate(rows):
+                shards[i % 3].db.table(name).append_rows([row])
+
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if len(seed.api.federation.remote_peers()) == 2:
+                break
+            time.sleep(0.05)
+        assert len(seed.api.federation.remote_peers()) == 2, \
+            "joiners never registered with the seed"
+        sp, fp = solo.query_port, seed.query_port
+
+        # -- satellite: ingested rows carry the receiving shard's id ----
+        l7 = seed.db.table("flow_log.l7_flow_log")
+        codes = set()
+        for ch in l7.snapshot():
+            if ch:
+                codes.update(np.unique(ch["shard_id"]).tolist())
+        assert codes == {1}
+
+        # -- DF-SQL: exact multi-agg push-down --------------------------
+        exact_sql = [
+            "SELECT app_service, Sum(response_duration) AS s, "
+            "Count(*) AS n, Avg(response_duration) AS a, "
+            "Min(response_code) AS mn, Max(response_code) AS mx "
+            "FROM l7_flow_log GROUP BY app_service ORDER BY app_service",
+            "SELECT Count(DISTINCT endpoint) AS d, Count(*) AS n "
+            "FROM l7_flow_log",
+            "SELECT app_service, Count(*) AS n FROM l7_flow_log "
+            "GROUP BY app_service HAVING Count(*) > 5 "
+            "ORDER BY app_service",
+            "SELECT time, app_service, endpoint FROM l7_flow_log "
+            "WHERE response_code = 200 ORDER BY time DESC LIMIT 7",
+            "SELECT app_service, Last(response_code) AS lc "
+            "FROM l7_flow_log GROUP BY app_service ORDER BY app_service",
+            # dict + enum group keys: shard-local codes must never merge
+            "SELECT l7_protocol, response_status, Count(*) AS n "
+            "FROM l7_flow_log GROUP BY l7_protocol, response_status "
+            "ORDER BY l7_protocol, response_status",
+        ]
+        for sql in exact_sql:
+            body = {"sql": sql, "db": "flow_log"}
+            want = _post(sp, "/v1/query", body)["result"]
+            got = _post(fp, "/v1/query", body)
+            assert got["federation"]["missing_shards"] == [], sql
+            assert got["federation"]["shards"] == 3, sql
+            assert _canon(got["result"]) == _canon(want), sql
+
+        # percentile is the one documented-approximate merge (~2%)
+        for p in (50, 95):
+            sql = (f"SELECT Percentile(response_duration, {p}) AS p "
+                   "FROM l7_flow_log")
+            body = {"sql": sql, "db": "flow_log"}
+            want = _post(sp, "/v1/query", body)["result"]["values"][0][0]
+            got = _post(fp, "/v1/query", body)["result"]["values"][0][0]
+            assert got == pytest.approx(want, rel=0.03), (p, got, want)
+
+        # federated total == union of the per-shard counts
+        n_union = sum(len(s.db.table("flow_log.l7_flow_log"))
+                      for s in shards)
+        body = {"sql": "SELECT Count(*) AS n FROM l7_flow_log",
+                "db": "flow_log"}
+        assert _post(fp, "/v1/query", body)["result"]["values"][0][0] \
+            == n_union == 24
+        # GROUP BY shard_id audits the split (exactly one group/shard)
+        body = {"sql": "SELECT shard_id, Count(*) AS n FROM l7_flow_log "
+                       "GROUP BY shard_id ORDER BY shard_id",
+                "db": "flow_log"}
+        audit = _post(fp, "/v1/query", body)["result"]["values"]
+        assert [r[0] for r in audit] == [1, 2, 3]
+        assert sum(r[1] for r in audit) == n_union
+
+        # -- PromQL: raw-selector federation is exact -------------------
+        prom_queries = (
+            "sum(rate(fed_requests_total[50s]))",
+            "sum by (job) (rate(fed_requests_total[50s]))",
+            "fed_requests_total",
+            "max(fed_gauge)",
+        )
+        rng = {"start": BASE_S + 50, "end": BASE_S + 50, "step": 15}
+        for q in prom_queries:
+            want = _get(sp, "/prom/api/v1/query_range",
+                        {"query": q, **rng})
+            got = _get(fp, "/prom/api/v1/query_range",
+                       {"query": q, **rng})
+            assert want["status"] == got["status"] == "success", q
+            assert "federation" not in got, q
+            assert _canon(_sorted_prom(got["data"])) \
+                == _canon(_sorted_prom(want["data"])), q
+        inst = {"query": "fed_gauge", "time": BASE_S + 55}
+        want = _get(sp, "/prom/api/v1/query", inst)
+        got = _get(fp, "/prom/api/v1/query", inst)
+        assert _canon(_sorted_prom(got["data"])) \
+            == _canon(_sorted_prom(want["data"]))
+
+        # -- Tempo: search + cross-shard trace assembly -----------------
+        window = {"start": BASE_S - 10, "end": BASE_S + 3600,
+                  "limit": 50}
+        for extra in ({}, {"tags": 'service.name="svc-a"'},
+                      {"minDuration": "2ms"}):
+            want = _get(sp, "/api/search", {**window, **extra})
+            got = _get(fp, "/api/search", {**window, **extra})
+            assert got.pop("federation")["missing_shards"] == []
+            assert _canon(got) == _canon(want), extra
+        for tid in ("trace-1", "trace-2"):
+            want = _get(sp, f"/api/traces/{tid}")
+            got = _get(fp, f"/api/traces/{tid}")
+            assert _canon(got) == _canon(want), tid
+            want = _post(sp, "/v1/trace/Tracing", {"trace_id": tid})
+            got = _post(fp, "/v1/trace/Tracing", {"trace_id": tid})
+            fed = got["result"].pop("federation")
+            assert fed["missing_shards"] == []
+            assert _canon(got) == _canon(want), tid
+        # trace-1's spans really are split across shards
+        per_shard = [len(s.api.collect_trace_spans("trace-1"))
+                     for s in shards]
+        assert sorted(per_shard) == [1, 2, 2] and sum(per_shard) == 5
+
+        # -- flame graphs -----------------------------------------------
+        body = {"app_service": "svc-prof"}
+        want = _post(sp, "/v1/profile/ProfileTracing", body)
+        got = _post(fp, "/v1/profile/ProfileTracing", body)
+        assert got["federation"]["missing_shards"] == []
+        assert _canon(got["result"]) == _canon(want["result"])
+        assert got["result"]["total_value"] == 10 * 4 + 5 * 5 + 6 * 2
+        want = _post(sp, "/v1/profile/TpuFlame", {})
+        got = _post(fp, "/v1/profile/TpuFlame", {})
+        assert got["federation"]["missing_shards"] == []
+        assert _canon(got["result"]) == _canon(want["result"])
+        assert "mod_h" not in json.dumps(got["result"])  # host excluded
+
+        # -- membership surfaces ----------------------------------------
+        peers = _get(fp, "/v1/cluster/peers")
+        assert [p["shard_id"] for p in peers["peers"]] == [1, 2, 3]
+        assert peers["version"] >= 3
+        # a joiner adopts the seed's full directory (gossip readback
+        # rides the 2s join heartbeat — poll one round)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            j2 = _get(shards[1].query_port, "/v1/cluster/peers")
+            if len(j2["peers"]) == 3:
+                break
+            time.sleep(0.2)
+        assert [p["shard_id"] for p in j2["peers"]] == [1, 2, 3]
+        status = _get(fp, "/v1/cluster/status")
+        assert status["shard_id"] == 1
+        by_id = {p["shard_id"]: p for p in status["peers"]}
+        assert all(by_id[s]["alive"] for s in (1, 2, 3))
+        assert by_id[2]["rows"] and by_id[2]["latency_ms"] is not None
+        health = _get(fp, "/v1/health")
+        assert health["cluster"]["peers_alive"] == 3
+
+        # -- degraded mode: a killed shard yields an annotated partial --
+        shards[2].stop()
+        body = {"sql": "SELECT app_service, Count(*) AS n "
+                       "FROM l7_flow_log GROUP BY app_service "
+                       "ORDER BY app_service", "db": "flow_log"}
+        got = _post(fp, "/v1/query", body)   # HTTP 200, not a 500
+        assert got["federation"]["missing_shards"] == [3]
+        n_partial = sum(r[1] for r in got["result"]["values"])
+        assert n_partial == len(shards[0].db.table(
+            "flow_log.l7_flow_log")) + len(shards[1].db.table(
+                "flow_log.l7_flow_log"))
+        # every fed_gauge row lives on the dead shard: the metric is now
+        # unknown on every REACHABLE shard, which must degrade to an
+        # annotated empty partial, not an unknown-metric error
+        got = _get(fp, "/prom/api/v1/query_range",
+                   {"query": "sum(fed_gauge)", **rng})
+        assert got["status"] == "success"
+        assert got["data"]["result"] == []
+        assert got["federation"]["missing_shards"] == [3]
+        assert any("shards [3]" in w for w in got.get("warnings", []))
+        # a metric the survivors do hold still answers with partial data
+        got = _get(fp, "/prom/api/v1/query_range",
+                   {"query": "sum(rate(fed_requests_total[50s]))", **rng})
+        assert got["status"] == "success" and got["data"]["result"]
+        assert got["federation"]["missing_shards"] == [3]
+        got = _get(fp, "/api/search", window)
+        assert got["federation"]["missing_shards"] == [3]
+        got = _get(fp, f"/api/traces/trace-1")
+        assert got["batches"][0]["spans"]          # partial, still a 200
+        status = _get(fp, "/v1/cluster/status")
+        by_id = {p["shard_id"]: p for p in status["peers"]}
+        assert by_id[3]["alive"] is False and by_id[2]["alive"] is True
+
+        # -- ledger balance over every fan-out hop ----------------------
+        snap = seed.telemetry.snapshot()
+        cluster_hops = [h for h in snap["pipeline"]
+                        if h["hop"].startswith("cluster.")]
+        assert {h["hop"] for h in cluster_hops} >= {
+            "cluster.sql", "cluster.promql", "cluster.tempo",
+            "cluster.trace", "cluster.flame"}
+        for h in cluster_hops:
+            assert h["emitted"] == h["delivered"] + h["dropped_total"], h
+            assert h["in_flight"] == 0, h
+        # the degraded queries above dropped frames with a reason
+        assert sum(h["dropped_total"] for h in cluster_hops) > 0
+        assert any("error" in h["dropped"] or "timeout" in h["dropped"]
+                   for h in cluster_hops)
+    finally:
+        for s in [solo] + shards:
+            try:
+                s.stop()
+            except Exception:
+                pass
