@@ -1,7 +1,7 @@
 PYTHON ?= python
 JAX_ENV := env JAX_PLATFORMS=cpu
 
-.PHONY: test selfmon-check cluster-check bench native
+.PHONY: test selfmon-check cluster-check steps-check bench native
 
 test:
 	timeout -k 10 870 $(JAX_ENV) $(PYTHON) -m pytest tests/ -q -m 'not slow' \
@@ -18,6 +18,12 @@ selfmon-check:
 # cluster.* fan-out hop's frame ledger fails to balance.
 cluster-check:
 	timeout -k 10 120 $(JAX_ENV) $(PYTHON) -m deepflow_tpu.cli.cluster_check
+
+# Brief e2e run of the step-health pipeline: synthetic 4-device pod with
+# one injected 2x-slow device; exits non-zero unless the regression
+# detector fires once and names that device and its dominant HLO.
+steps-check:
+	timeout -k 10 120 $(JAX_ENV) $(PYTHON) -m deepflow_tpu.cli.steps_check
 
 bench:
 	$(JAX_ENV) $(PYTHON) bench.py

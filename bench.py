@@ -299,6 +299,93 @@ def _bench_selfmon_overhead() -> dict:
     }
 
 
+def _make_steps_frame():
+    from deepflow_tpu.codec import FrameHeader, MessageType, encode_frame
+    from deepflow_tpu.tpuprobe.stepmetrics import encode_step_payload
+    records = []
+    for i in range(256):
+        t0 = 1_754_000_000_000_000_000 + i * 10_000_000
+        records.append({
+            "time": t0, "end_ns": t0 + 3_000_000,
+            "latency_ns": 3_000_000, "run_id": i + 1, "step": i + 1,
+            "job": "jit_bench_train_step", "device_count": 4,
+            "device_skew_ns": 40_000, "compute_ns": 8_000_000,
+            "collective_ns": 3_600_000, "straggler_device": i % 4,
+            "straggler_lag_ns": 20_000,
+            "top_hlos": [["fusion.1", 2_000_000, "convolution fusion"],
+                         ["all-reduce.1", 900_000, "all-reduce"]],
+        })
+    payload = encode_step_payload(records, pid=1, process_name="bench")
+    return (encode_frame(FrameHeader(MessageType.STEP_METRICS, agent_id=1),
+                         payload),
+            "profile.tpu_step_metrics", MessageType.STEP_METRICS)
+
+
+def _bench_steps() -> dict:
+    """Step-health overhead gate: the rollup pipeline (STEP_METRICS
+    decode + the 1 Hz regression-detector scan) rides the same server as
+    flow ingest, so its cost must stay under 2% of ingest throughput.
+    Arm A: L4 ingest alone. Arm B: L4 ingest while a paced step stream
+    (100 records/s — ~10x a real pod's step rate) lands in
+    tpu_step_metrics and the live detector re-merges and scores it every
+    second. Best-of-3 per arm, like the selfmon gate. Also reports the
+    raw STEP_METRICS decode rate."""
+    import socket
+    import threading
+
+    from deepflow_tpu.server import Server
+
+    def l4_with_steps() -> int:
+        server = Server(host="127.0.0.1", ingest_port=0,
+                        query_port=0).start()
+        stop = threading.Event()
+        try:
+            frame, table_name, _ = _make_l4_frame()
+            step_frame, _, _ = _make_steps_frame()
+
+            def pump() -> None:
+                s = socket.create_connection(
+                    ("127.0.0.1", server.ingest_port))
+                try:
+                    while not stop.wait(2.56):  # 256 records / 2.56s
+                        s.sendall(step_frame)
+                finally:
+                    s.close()
+
+            th = threading.Thread(target=pump, daemon=True)
+            th.start()
+            sock = socket.create_connection(
+                ("127.0.0.1", server.ingest_port))
+            n_batches = 400
+            t0 = time.perf_counter()
+            for _ in range(n_batches):
+                sock.sendall(frame)
+            total = n_batches * 256
+            table = server.db.table(table_name)
+            while len(table) < total and time.perf_counter() - t0 < 60:
+                time.sleep(0.01)
+            dt = time.perf_counter() - t0
+            sock.close()
+            return round(len(table) / dt)
+        finally:
+            stop.set()
+            server.stop()
+
+    on = max(l4_with_steps() for _ in range(3))
+    off = max(_run_ingest(_make_l4_frame)["rows_per_sec"]
+              for _ in range(3))
+    decode = _run_ingest(_make_steps_frame, n_batches=100)
+    pct = (off - on) / off * 100.0 if off else 0.0
+    return {
+        "steps_rows_per_sec_with": on,
+        "steps_rows_per_sec_without": off,
+        "steps_overhead_pct": round(max(0.0, pct), 2),
+        "steps_overhead_above_gate": pct > 2.0,
+        "steps_decode_rows_per_sec": decode["rows_per_sec"],
+        "steps_decode_timed_out": decode["timed_out"],
+    }
+
+
 def _bench_federation() -> dict:
     """Scatter-gather arm: the SAME total row count and the same GROUP-BY
     aggregate, answered by 1 / 2 / 4 shards. One shard is the plain local
@@ -622,6 +709,7 @@ def main() -> None:
     cpu_detail.update(_bench_packet_path())
     cpu_detail.update(_bench_ingest())
     cpu_detail.update(_bench_selfmon_overhead())
+    cpu_detail.update(_bench_steps())
     cpu_detail.update(_bench_federation())
     cpu_detail.update(_bench_extprofiler())
     # perf guards (VERDICT r03 item 5 / r04 item 8): a regression must be

@@ -359,6 +359,11 @@ class Agent:
     def send_tpu_spans(self, spans_pb: "pb.TpuSpanBatch") -> None:
         self.sender.send(MessageType.TPU_SPAN, spans_pb.SerializeToString())
 
+    def send_step_metrics(self, payload: bytes) -> bool:
+        """Per-step rollup records (pre-encoded STEP_METRICS payload —
+        JSON, not protobuf; see tpuprobe/stepmetrics.py)."""
+        return self.sender.send(MessageType.STEP_METRICS, payload)
+
     # -- self-telemetry (reference: agent/src/utils/stats.rs -> dfstats) -----
 
     def _on_wedge(self, verdict: dict) -> None:

@@ -42,6 +42,10 @@ class TpuProbeConfig:
     # per-device HBM usage sampling cadence (allocator statistics; ~free).
     # 0 disables.
     memory_poll_s: float = 5.0
+    # continuous per-step rollups (STEP_METRICS records: latency, skew,
+    # collective wait, top-K HLO self-times per (run_id, step))
+    step_metrics: bool = True
+    step_topk: int = 5
 
 
 @dataclass
@@ -181,6 +185,7 @@ class AgentConfig:
             0.01, 0.95)
         num(self.tpuprobe.steps_per_capture, "tpuprobe.steps_per_capture",
             1, 10_000)
+        num(self.tpuprobe.step_topk, "tpuprobe.step_topk", 1, 100)
         num(self.stats_interval_s, "stats_interval_s", 0.1)
         num(self.sync_interval_s, "sync_interval_s", 0.1)
         num(self.selfmon.deadman_window_s, "selfmon.deadman_window_s", 0.1)
@@ -220,6 +225,8 @@ class AgentConfig:
                 "include this host's own telemetry with exclusions off)")
         for b, name in ((self.profiler.enabled, "profiler.enabled"),
                         (self.tpuprobe.enabled, "tpuprobe.enabled"),
+                        (self.tpuprobe.step_metrics,
+                         "tpuprobe.step_metrics"),
                         (self.selfmon.enabled, "selfmon.enabled"),
                         (self.standalone, "standalone")):
             if not isinstance(b, bool):
@@ -254,6 +261,8 @@ _TEMPLATE_DOCS = {
     "tpuprobe.source": "auto | xplane | hooks | sim",
     "tpuprobe.target_coverage": "fraction of steps captured (0.01-0.95)",
     "tpuprobe.steps_per_capture": "whole steps per capture window",
+    "tpuprobe.step_metrics": "emit per-(run_id, step) STEP_METRICS rollups",
+    "tpuprobe.step_topk": "HLO self-times kept per step record",
     "flow.interface": "capture interface; empty = all",
     "flow.exclude_ports": "never capture these ports (feedback guard)",
     "sender.servers": "ingest endpoints, failover order",

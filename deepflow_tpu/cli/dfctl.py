@@ -164,6 +164,19 @@ def main(argv: list[str] | None = None) -> int:
                             help="one training step stitched across devices")
     p_step.add_argument("--run-id", type=int, default=None)
 
+    p_steps = sub.add_parser(
+        "steps", help="per-step health waterfall: latency sparkline, "
+                      "device skew, collective wait, regression verdict")
+    p_steps.add_argument("--job", default=None)
+    p_steps.add_argument("--run-id", type=int, default=None)
+    p_steps.add_argument("--limit", type=int, default=50)
+    p_steps.add_argument("--critical-path", type=int, default=None,
+                         metavar="STEP",
+                         help="attribute one step's latency against its "
+                              "rolling healthy baseline")
+    p_steps.add_argument("--json", action="store_true",
+                         help="raw endpoint JSON instead of the waterfall")
+
     p_replay = sub.add_parser("replay")
     p_replay.add_argument("pcap")
     p_replay.add_argument("--ingest", default="127.0.0.1:20033")
@@ -402,6 +415,81 @@ def main(argv: list[str] | None = None) -> int:
             print(f"  {g['collective']} {g['hlo_op']}: "
                   f"{g['latency_ns']:,}ns across "
                   f"{g['n_participants']} devices (skew {g['skew_ns']}ns)")
+    elif args.cmd == "steps":
+        body = {"limit": args.limit}
+        if args.job:
+            body["job"] = args.job
+        if args.run_id is not None:
+            body["run_id"] = args.run_id
+        if args.critical_path is not None:
+            body["step"] = args.critical_path
+            out = _api(args.server, "/v1/tpu/steps/critical_path", body)
+            if args.json:
+                print(json.dumps(out, indent=2))
+                return 0
+            r = out["result"]
+            s, att = r["step"], r["attribution"]
+            print(f"{s['job'] or '(job)'} run {s['run_id']} "
+                  f"step {s['step']}: {s['latency_ns']:,}ns "
+                  f"(baseline {att['baseline_latency_ns']:,}ns over "
+                  f"{att['baseline_steps']} healthy steps)")
+            print(f"verdict: {att['verdict']}  straggler: "
+                  f"{att['straggler_host'] or '?'}:"
+                  f"TPU{att['straggler_device']} "
+                  f"(+{att['straggler_lag_ns']:,}ns)")
+            print_table(
+                ["COMPONENT", "NS", "BASELINE_NS", "DELTA_NS"],
+                [[k, att["components_ns"][k],
+                  att["baseline_components_ns"][k],
+                  att["component_deltas_ns"][k]]
+                 for k in att["components_ns"]])
+            if att["dominant_hlos"]:
+                print("\ndominant HLOs by delta vs baseline:")
+                print_table(
+                    ["HLO_OP", "SELF_NS", "BASELINE_NS", "DELTA_NS"],
+                    [[h["hlo_op"], h["self_ns"], h["baseline_ns"],
+                      h["delta_ns"]] for h in att["dominant_hlos"]])
+            return 0
+        out = _api(args.server, "/v1/tpu/steps", body)
+        if args.json:
+            print(json.dumps(out, indent=2))
+            return 0
+        steps = out["result"]["steps"]
+        if not steps:
+            print("(no step records)")
+            return 0
+        # sparkline scaled to the window's max latency
+        blocks = "▁▂▃▄▅▆▇█"
+        peak = max(s["latency_ns"] for s in steps) or 1
+        rows = []
+        for s in steps:
+            spark = blocks[min(len(blocks) - 1,
+                               int(len(blocks) * s["latency_ns"] / peak))]
+            rows.append([
+                s["job"], s["run_id"], s["step"],
+                f"{s['latency_ns']:,}", spark,
+                f"{s['device_skew_ns']:,}", f"{s['collective_ns']:,}",
+                s["device_count"], len(s.get("hosts", [])),
+                s["verdict"] if s["regressed"] else "ok"])
+        print_table(
+            ["JOB", "RUN", "STEP", "LATENCY_NS", "", "SKEW_NS",
+             "WAIT_NS", "DEVS", "HOSTS", "VERDICT"], rows)
+        regressed = [s for s in steps if s["regressed"]]
+        for s in regressed:
+            att = s["attribution"]
+            top = att["dominant_hlos"][0] if att["dominant_hlos"] else None
+            print(f"\nstep {s['step']} (run {s['run_id']}) REGRESSED: "
+                  f"{att['verdict']} — straggler "
+                  f"{att['straggler_host'] or '?'}:"
+                  f"TPU{att['straggler_device']} "
+                  f"(+{att['straggler_lag_ns']:,}ns)"
+                  + (f", dominant HLO {top['hlo_op']} "
+                     f"(+{top['delta_ns']:,}ns)" if top else ""))
+        fed = out.get("federation")
+        if fed:
+            print(f"\n(federated over {fed['shards']} shards"
+                  + (f", MISSING {fed['missing_shards']}"
+                     if fed.get("missing_shards") else "") + ")")
     elif args.cmd == "agent-group-config":
         with open(args.file) as f:
             yaml_text = f.read()

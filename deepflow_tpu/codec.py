@@ -44,6 +44,7 @@ class MessageType(IntEnum):
     APP_LOG = 10
     PCAP = 11            # on-demand capture uploads (pcap policy)
     SHARD_RESULT = 12    # cluster scatter-gather shard responses
+    STEP_METRICS = 13    # per-(run_id, step) rollups -> tpu_step_metrics
 
 
 @dataclass(frozen=True)

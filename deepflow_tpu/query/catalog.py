@@ -20,7 +20,7 @@ _NUMERIC_TAGS = {
     "gprocess_id_0", "gprocess_id_1", "request_id", "tap_port",
     "tunnel_id", "device_id", "chip_id", "core_id", "program_id",
     "run_id", "step", "metric_id", "label_set_id", "time", "start_time",
-    "end_time",
+    "end_time", "end_ns", "straggler_device",
 }
 
 # metric name -> per-aggregate rewrite, per table family (longest prefix
@@ -114,7 +114,8 @@ def _resolve(table_name: str) -> tuple[str, list]:
     if table_name in schema.TABLES:
         return table_name, schema.TABLES[table_name]
     for cand in (f"{table_name}.1s", f"flow_metrics.{table_name}.1s",
-                 f"flow_log.{table_name}"):
+                 f"flow_log.{table_name}", f"profile.{table_name}",
+                 f"event.{table_name}"):
         if cand in schema.TABLES:
             return cand, schema.TABLES[cand]
     raise KeyError(table_name)

@@ -272,6 +272,49 @@ class TpuSpanDecoder(Decoder):
         return len(rows) + len(mem_rows)
 
 
+class StepMetricsDecoder(Decoder):
+    """STEP_METRICS JSON payloads -> profile.tpu_step_metrics.
+
+    The payload is NOT protobuf (stepmetrics.py explains why); malformed
+    frames raise ValueError and land on the decoder ledger as
+    dropped/decode_error like any other bad payload."""
+
+    MSG_TYPE = MessageType.STEP_METRICS
+
+    def handle(self, header: FrameHeader, payload: bytes) -> int:
+        from deepflow_tpu.tpuprobe.stepmetrics import decode_step_payload
+        obj = decode_step_payload(payload)
+        tags = self.platform.tags_for(header.agent_id)
+        off = self._clock_offset(header)
+        pid = int(obj.get("pid") or 0)
+        pname = str(obj.get("process_name") or "")
+        rows = []
+        for r in obj["records"]:
+            t0 = int(r.get("time") or 0)
+            t1 = int(r.get("end_ns") or 0)
+            rows.append({
+                "time": t0 + off,
+                "end_ns": t1 + off,
+                "latency_ns": int(r.get("latency_ns") or max(0, t1 - t0)),
+                "run_id": int(r.get("run_id") or 0),
+                "step": int(r.get("step") or 0),
+                "job": str(r.get("job") or ""),
+                "device_count": int(r.get("device_count") or 0),
+                "device_skew_ns": int(r.get("device_skew_ns") or 0),
+                "compute_ns": int(r.get("compute_ns") or 0),
+                "collective_ns": int(r.get("collective_ns") or 0),
+                "straggler_device": int(r.get("straggler_device") or 0),
+                "straggler_lag_ns": int(r.get("straggler_lag_ns") or 0),
+                "top_hlos": json.dumps(r.get("top_hlos") or [],
+                                       separators=(",", ":")),
+                "pid": pid,
+                "process_name": pname,
+                **tags,
+            })
+        self.write("profile.tpu_step_metrics", rows)
+        return len(rows)
+
+
 class PcapDecoder(Decoder):
     """PcapUpload -> data_dir/pcaps/<name>.pcap.gz (or memory when no
     data_dir). Reference: ingester pcap module."""
@@ -1107,5 +1150,5 @@ def _close_type_idx(name: str) -> int:
         return 0
 
 
-ALL_DECODERS = [ProfileDecoder, TpuSpanDecoder, FlowLogDecoder,
-                MetricsDecoder, StatsDecoder, EventDecoder]
+ALL_DECODERS = [ProfileDecoder, TpuSpanDecoder, StepMetricsDecoder,
+                FlowLogDecoder, MetricsDecoder, StatsDecoder, EventDecoder]

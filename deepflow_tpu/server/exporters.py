@@ -217,13 +217,49 @@ class JsonLinesExporter(BaseExporter):
 
 
 class OtlpJsonExporter(BaseExporter):
-    """l7_flow_log rows -> OTLP/HTTP JSON traces."""
+    """l7_flow_log rows -> OTLP/HTTP JSON traces; tpu_step_metrics rows
+    ride along as one span per (host, step) so a training-step waterfall
+    shows up next to the request traces in any OTLP backend."""
 
-    TABLES = ("flow_log.l7_flow_log",)
+    TABLES = ("flow_log.l7_flow_log", "profile.tpu_step_metrics")
+
+    @staticmethod
+    def _step_span(row: dict) -> dict:
+        start = int(row.get("time", 0))
+        end = int(row.get("end_ns", 0)) or start
+        rid = int(row.get("run_id", 0))
+        step = int(row.get("step", 0))
+        return {
+            "traceId": f"steprun-{rid}",
+            "spanId": f"step-{rid}-{step}-{row.get('host', '')}",
+            "parentSpanId": "",
+            "name": f"{row.get('job', '') or 'step'}/{step}",
+            "kind": 1,  # INTERNAL
+            "startTimeUnixNano": str(start),
+            "endTimeUnixNano": str(end),
+            "attributes": [
+                {"key": "tpu.run_id", "value": {"intValue": rid}},
+                {"key": "tpu.step", "value": {"intValue": step}},
+                {"key": "tpu.device_count",
+                 "value": {"intValue": int(row.get("device_count", 0))}},
+                {"key": "tpu.device_skew_ns",
+                 "value": {"intValue": int(row.get("device_skew_ns", 0))}},
+                {"key": "tpu.collective_ns",
+                 "value": {"intValue": int(row.get("collective_ns", 0))}},
+                {"key": "tpu.straggler_device",
+                 "value": {"intValue": int(row.get("straggler_device", 0))}},
+                {"key": "host.name",
+                 "value": {"stringValue": str(row.get("host", ""))}},
+            ],
+            "status": {"code": 1},
+        }
 
     def _ship(self, batch: list) -> None:
         spans = []
-        for _, row in batch:
+        for table, row in batch:
+            if table == "profile.tpu_step_metrics":
+                spans.append(self._step_span(row))
+                continue
             start = int(row.get("time", 0))
             dur = int(row.get("response_duration", 0))
             spans.append({

@@ -359,6 +359,92 @@ class QuerierAPI:
         return {"result": step_trace(
             rows, run_id=None if run_id is None else int(run_id))}
 
+    _STEP_COLS = ("time, end_ns, latency_ns, run_id, step, job, "
+                  "device_count, device_skew_ns, compute_ns, "
+                  "collective_ns, straggler_device, straggler_lag_ns, "
+                  "top_hlos, host")
+
+    def _step_rollups(self, body: dict) -> tuple[list[dict], dict | None]:
+        """Merged (job, run_id, step) rollups from per-host
+        tpu_step_metrics records, plus federation info when the query
+        scattered. The shard partial is a plain row SELECT — each host's
+        record lands on exactly one shard, so the union of shard rows is
+        the exact single-node row set and merge_host_partials on top is
+        federation-exact."""
+        from deepflow_tpu.server import stephealth
+        table = self.db.table("profile.tpu_step_metrics")
+        where = []
+        if body.get("job"):
+            job = str(body["job"]).replace("'", "")
+            where.append(f"job = '{job}'")
+        if body.get("run_id") is not None:
+            where.append(f"run_id = {int(body['run_id'])}")
+        if body.get("time_start"):
+            where.append(f"time >= {int(body['time_start'])}")
+        if body.get("time_end"):
+            where.append(f"time < {int(body['time_end'])}")
+        sql_text = f"SELECT {self._STEP_COLS} FROM t"
+        if where:
+            sql_text += f" WHERE {' AND '.join(where)}"
+        fed = self._fed()
+        info = None
+        if fed is not None:
+            res, info = fed.sql_query(table, qsql.parse(sql_text),
+                                      sql_text)
+        else:
+            res = qengine.execute(table, sql_text)
+        cols = res.columns
+        rows = [dict(zip(cols, row)) for row in res.values]
+        return stephealth.merge_host_partials(rows), info
+
+    def tpu_steps(self, body: dict) -> dict:
+        """Per-step health timeline: merged pod-level rollups annotated by
+        the same EWMA+MAD scorer the live StepRegressionDetector runs, so
+        what a human reads here agrees with the alerts that fired."""
+        from deepflow_tpu.server import stephealth
+        rollups, info = self._step_rollups(body)
+        scored = stephealth.score_timeline(rollups)
+        limit = int(body.get("limit", 500))
+        out = {"result": {"steps": scored[-limit:],
+                          "total_steps": len(scored)}}
+        if info is not None:
+            out["federation"] = info
+        return out
+
+    def tpu_step_critical_path(self, body: dict) -> dict:
+        """Critical-path attribution for ONE step: where its latency went
+        (per-device compute vs collective wait vs device skew) relative to
+        a rolling baseline of the healthy steps before it, naming the
+        straggler device/host and the dominant HLOs by delta."""
+        from deepflow_tpu.server import stephealth
+        rollups, info = self._step_rollups(body)
+        if not rollups:
+            raise qengine.QueryError("no step records in window")
+        want_run = body.get("run_id")
+        want_step = body.get("step")
+        idx = len(rollups) - 1
+        if want_step is not None:
+            idx = next(
+                (i for i, r in enumerate(rollups)
+                 if r["step"] == int(want_step)
+                 and (want_run is None or r["run_id"] == int(want_run))),
+                -1)
+            if idx < 0:
+                raise qengine.QueryError(
+                    f"step {want_step} not found in window")
+        target = rollups[idx]
+        # baseline = the healthy steps BEFORE the target, per the same
+        # streaming scorer — the target itself never pollutes it
+        sc = stephealth.EwmaMad()
+        for r in rollups[:idx]:
+            if r["job"] == target["job"]:
+                sc.feed(r)
+        att = stephealth.attribute(target, sc.baseline())
+        out = {"result": {"step": target, "attribution": att}}
+        if info is not None:
+            out["federation"] = info
+        return out
+
     def _tpu_span_rows(self, body: dict,
                        collectives_only: bool = False) -> list[dict]:
         table = self.db.table("profile.tpu_hlo_span")
@@ -1261,6 +1347,10 @@ class QuerierHTTP:
                         self._send(200, api.tpu_collectives(body))
                     elif path == "/v1/profile/TpuStepTrace":
                         self._send(200, api.tpu_step_trace(body))
+                    elif path == "/v1/tpu/steps":
+                        self._send(200, api.tpu_steps(body))
+                    elif path == "/v1/tpu/steps/critical_path":
+                        self._send(200, api.tpu_step_critical_path(body))
                     elif path == "/v1/profile/TpuMemory":
                         self._send(200, api.tpu_memory(body))
                     elif path == "/v1/tracing-adapters":

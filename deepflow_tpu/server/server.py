@@ -14,7 +14,7 @@ import time
 from deepflow_tpu.codec import MessageType
 from deepflow_tpu.server.decoders import (
     EventDecoder, FlowLogDecoder, MetricsDecoder, ProfileDecoder,
-    StatsDecoder, TpuSpanDecoder)
+    StatsDecoder, StepMetricsDecoder, TpuSpanDecoder)
 from deepflow_tpu.server.platform_info import PlatformInfoTable
 from deepflow_tpu.server.querier import QuerierAPI, QuerierHTTP
 from deepflow_tpu.server.receiver import Receiver
@@ -95,11 +95,14 @@ class Server:
                 self.controller = Controller(
                     self.platform, host=host, port=sync_port,
                     pod_index=self.pod_index)
-        from deepflow_tpu.server.alerting import AlertEngine
+        from deepflow_tpu.server.alerting import (AlertEngine,
+                                                  StepRegressionDetector)
         from deepflow_tpu.server.exporters import ExporterManager
         from deepflow_tpu.server.tracetree import TraceTreeBuilder
         self.exporters = ExporterManager()
         self.alerts = AlertEngine(self.db)
+        # step health: continuous regression watch over tpu_step_metrics
+        self.step_detector = StepRegressionDetector(self.db)
         # ingest-time trace precompute (reference: tracetree_writer.go)
         self.trace_trees = TraceTreeBuilder(self.db)
         self.api = QuerierAPI(self.db, stats_provider=self._stats,
@@ -186,6 +189,7 @@ class Server:
             (PcapDecoder, MessageType.PCAP),
             (ProfileDecoder, MessageType.PROFILE),
             (TpuSpanDecoder, MessageType.TPU_SPAN),
+            (StepMetricsDecoder, MessageType.STEP_METRICS),
             (FlowLogDecoder, MessageType.L4_LOG),
             (FlowLogDecoder, MessageType.L7_LOG),
             (MetricsDecoder, MessageType.METRICS),
@@ -229,6 +233,7 @@ class Server:
             self.api.membership = self.membership
             self.api.federation = self.federation
         self.alerts.start()
+        self.step_detector.start()
         self.deadman.start()
         if self.telemetry.enabled:
             self._selfstats_stop.clear()
@@ -309,6 +314,7 @@ class Server:
         self.http.stop()
         self._stop_singletons()
         self.alerts.stop()
+        self.step_detector.stop()
         self.exporters.stop()
         try:
             for err in self.db.flush():

@@ -18,7 +18,7 @@ from dataclasses import dataclass
 import numpy as np
 
 # bump when a saved format changes shape beyond additive columns
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 3
 
 MANIFEST = "MANIFEST.json"
 
@@ -49,6 +49,10 @@ MIGRATIONS: dict[int, list] = {
     # under the same names — no shape change shipped, so the chain is empty;
     # the machinery and tests carry the contract for future bumps.
     1: [],
+    # v2 -> v3: step health pipeline adds profile.tpu_step_metrics. A new
+    # table is purely additive (v2 dirs simply have no chunks for it), so
+    # the op chain is empty; the bump records that v3 readers may find it.
+    2: [],
 }
 
 

@@ -123,6 +123,31 @@ _table("profile.tpu_hlo_span", [
     *UNIVERSAL_TAGS,
 ])
 
+# Continuous per-step rollups (step health pipeline): one row per
+# (run_id, step) per REPORTING HOST — the agent's local-device view.
+# Cross-host/cross-shard truth is reconstructed at query time with exact
+# merges: step start = Min(time), end = Max(end_ns), skew/lag = Max,
+# compute/collective totals = Sum. That is what lets cluster federation
+# aggregate step rollups exactly (Sum/Min/Max push-down).
+_table("profile.tpu_step_metrics", [
+    C("time", "u64"),                   # step start ns (min device bound)
+    C("end_ns", "u64"),                 # step end ns (max device bound)
+    C("latency_ns", "u64"),             # end_ns - time (this host's view)
+    C("run_id", "u32"),
+    C("step", "u64"),
+    C("job", "str"),                    # hlo module of the step program
+    C("device_count", "u16"),
+    C("device_skew_ns", "u64"),         # spread of device end times
+    C("compute_ns", "u64"),             # sum of device compute self-time
+    C("collective_ns", "u64"),          # sum of device collective time
+    C("straggler_device", "u16"),       # latest-finishing local device
+    C("straggler_lag_ns", "u64"),       # its end minus median device end
+    C("top_hlos", "str"),               # json [[op, self_ns, category], ...]
+    C("pid", "u32"),
+    C("process_name", "str"),
+    *UNIVERSAL_TAGS,
+])
+
 # Per-device HBM usage timeline (reference analog: EE memory profiler
 # memory_profile.rs — here allocator-statistics polling; BASELINE config 3
 # "+ HBM")

@@ -23,6 +23,22 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+# pb.HOST_RUNTIME / pb.HOST_COMPILE as wire ints and as the store's enum
+# strings — spans arrive here in both forms
+_HOST_KINDS_INT = (4, 5)
+
+
+def _is_host_plane(get) -> bool:
+    """Host-side span (jax.monitoring hooks: compile / runtime events)?
+    Host spans carry no device timeline; a capture holding only them has
+    no device planes to bound a step with."""
+    kind = get("kind")
+    if isinstance(kind, str) and kind.startswith("host"):
+        return True
+    if isinstance(kind, int) and kind in _HOST_KINDS_INT:
+        return True
+    return str(get("hlo_category") or "") == "host"
+
 
 @dataclass
 class CollectiveGroup:
@@ -202,11 +218,18 @@ def step_trace(spans, run_id: int | None = None) -> dict:
     stitched collectives — the 'is my step bound by compute, collectives,
     or a straggler?' view. Multi-host aware: runs group by (job, run_id)
     like stitch(), and devices key by host-qualified id so worker-0's
-    TPU:0 and worker-1's TPU:0 stay distinct."""
+    TPU:0 and worker-1's TPU:0 stay distinct.
+
+    Degraded captures never raise: None / empty input, or spans with NO
+    device planes (e.g. host-only hook events from a partial capture),
+    return the zeroed dict — host spans would otherwise fabricate a
+    device-"0" plane whenever they carry a run_id."""
     by_run: dict[tuple, list] = {}
-    for s in spans:
+    for s in spans or ():
         get = s.get if isinstance(s, dict) else lambda k, d=None: getattr(
             s, k, d)
+        if _is_host_plane(get):
+            continue
         rid = int(get("run_id") or 0)
         if rid and (run_id is None or rid == run_id):
             job = str(get("tpu_pod") or get("job") or "")

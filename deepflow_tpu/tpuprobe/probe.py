@@ -16,6 +16,8 @@ from deepflow_tpu.proto import pb
 from deepflow_tpu.tpuprobe.events import TpuSpanEvent, batch_to_pb
 from deepflow_tpu.tpuprobe.sources import (
     HooksSource, MemorySource, SimMemorySource, SimSource, XPlaneSource)
+from deepflow_tpu.tpuprobe.stepmetrics import (
+    StepAggregator, encode_step_payload)
 
 log = logging.getLogger("df.tpuprobe")
 
@@ -34,6 +36,13 @@ class TpuProbe:
             telemetry = Telemetry("agent", enabled=False)
         self.telemetry = telemetry
         self._hop = telemetry.hop("tpuprobe")
+        # per-step rollups ride the span sink; their frames get their own
+        # hop so a steps-path loss never hides inside the span ledger
+        self.stepagg: StepAggregator | None = None
+        if getattr(cfg, "step_metrics", True):
+            self._steps_hop = telemetry.hop("tpuprobe.steps")
+            self.stepagg = StepAggregator(
+                self._step_sink, topk=getattr(cfg, "step_topk", 5))
 
     def start(self) -> "TpuProbe":
         mode = self.cfg.source
@@ -60,6 +69,8 @@ class TpuProbe:
             self.sources.append(src)
             src.generate()
             SimMemorySource(self._mem_sink).generate()
+            if self.stepagg:
+                self.stepagg.flush()  # sim runs end here, not at stop()
         return self
 
     def stop(self) -> None:
@@ -67,6 +78,8 @@ class TpuProbe:
             stop = getattr(s, "stop", None)
             if stop:
                 stop()
+        if self.stepagg:
+            self.stepagg.flush()  # ship the last (still-open) step
 
     def _sink(self, events: list[TpuSpanEvent]) -> None:
         if not events:
@@ -79,6 +92,23 @@ class TpuProbe:
             self.stats["batches"] += 1
         self._hop.account(emitted=1, delivered=1)
         self.agent.send_tpu_spans(batch)
+        if self.stepagg:
+            self.stepagg.feed(events)
+
+    def _step_sink(self, records: list[dict]) -> None:
+        if not records:
+            return
+        payload = encode_step_payload(
+            records, pid=os.getpid(),
+            process_name=self.agent.process_name)
+        with self._lock:
+            self.stats["steps_sent"] = \
+                self.stats.get("steps_sent", 0) + len(records)
+        self._steps_hop.account(emitted=1)
+        if self.agent.send_step_metrics(payload):
+            self._steps_hop.account(delivered=1)
+        else:
+            self._steps_hop.account(dropped=1, reason="send_queue_full")
 
     def _mem_sink(self, samples: list[dict]) -> None:
         if not samples:

@@ -1,0 +1,427 @@
+"""Step health subsystem: per-(run_id, step) rollups end to end.
+
+The e2e tests are the acceptance criteria for the step-health PR: a 2x
+slowdown injected on one device of a synthetic 4-device pod must fire a
+`step_regression` alert whose attribution names that device and its
+dominant HLO, and a federated step rollup over 3 shards must equal the
+single-node result exactly.
+"""
+
+import json
+import socket
+import time
+import urllib.request
+
+from deepflow_tpu.codec import FrameHeader, MessageType, encode_frame
+from deepflow_tpu.query import engine as qengine
+from deepflow_tpu.server import Server
+from deepflow_tpu.server import stephealth
+from deepflow_tpu.tpuprobe.events import TpuSpanEvent
+from deepflow_tpu.tpuprobe.stepmetrics import (StepAggregator,
+                                               decode_step_payload,
+                                               encode_step_payload)
+
+MS = 1_000_000
+JOB = "jit_steps_train_step"
+
+
+def _get(port: int, path: str) -> dict:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5) as resp:
+        return json.loads(resp.read())
+
+
+def _post(port: int, path: str, body: dict) -> dict:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def _step_events(run_id: int, slow_device: int | None = None,
+                 devices=range(4)) -> list:
+    """One synthetic step: each device runs fusion.1 then all-reduce.1 in
+    parallel; slow_device doubles its fusion time."""
+    t0 = run_id * 10 * MS
+    events = []
+    for dev in devices:
+        fuse = 2 * MS * (2 if dev == slow_device else 1)
+        events.append(TpuSpanEvent(
+            start_ns=t0, duration_ns=fuse, device_id=dev,
+            hlo_module=JOB, hlo_op="fusion.1",
+            hlo_category="convolution fusion", run_id=run_id,
+            step=run_id))
+        events.append(TpuSpanEvent(
+            start_ns=t0 + fuse, duration_ns=900_000, device_id=dev,
+            hlo_module=JOB, hlo_op="all-reduce.1",
+            hlo_category="all-reduce", collective="all-reduce",
+            run_id=run_id, step=run_id))
+    return events
+
+
+def _collect(agg_records: list):
+    return lambda records: agg_records.extend(records)
+
+
+# -- wire codec ---------------------------------------------------------------
+
+def test_step_payload_roundtrip_and_rejects():
+    recs = [{"run_id": 3, "step": 3, "latency_ns": 7}]
+    obj = decode_step_payload(
+        encode_step_payload(recs, pid=42, process_name="train"))
+    assert obj["records"] == recs
+    assert obj["pid"] == 42 and obj["process_name"] == "train"
+    for bad in (b"\xff\x00garbage", b"[]", b'{"v":99,"records":[]}',
+                b'{"v":1,"records":"nope"}'):
+        try:
+            decode_step_payload(bad)
+            assert False, f"payload {bad!r} should have been rejected"
+        except ValueError:
+            pass
+
+
+# -- agent-side aggregator ----------------------------------------------------
+
+def test_step_aggregator_finalizes_on_newer_run():
+    out: list = []
+    agg = StepAggregator(_collect(out))
+    agg.feed(_step_events(1))
+    assert out == []            # still open: no newer run_id yet
+    agg.feed(_step_events(2))
+    assert len(out) == 1
+    r = out[0]
+    assert (r["run_id"], r["step"], r["job"]) == (1, 1, JOB)
+    assert r["device_count"] == 4
+    assert r["latency_ns"] == 2 * MS + 900_000
+    assert r["device_skew_ns"] == 0
+    assert r["compute_ns"] == 4 * 2 * MS
+    assert r["collective_ns"] == 4 * 900_000
+    assert r["top_hlos"][0][0] == "fusion.1"
+    agg.flush()
+    assert len(out) == 2 and out[1]["run_id"] == 2
+    assert agg.stats["steps_emitted"] == 2
+
+
+def test_step_aggregator_names_straggler():
+    out: list = []
+    agg = StepAggregator(_collect(out))
+    agg.feed(_step_events(1, slow_device=2))
+    agg.flush()
+    r = out[0]
+    assert r["straggler_device"] == 2
+    assert r["device_skew_ns"] == 2 * MS
+    assert r["straggler_lag_ns"] == 2 * MS
+
+
+def test_step_aggregator_skips_host_plane_and_rid0():
+    out: list = []
+    agg = StepAggregator(_collect(out))
+    agg.feed([
+        TpuSpanEvent(start_ns=10, duration_ns=5, run_id=0,
+                     hlo_op="fusion.9", hlo_module=JOB),
+        TpuSpanEvent(start_ns=10, duration_ns=5, run_id=7, kind=4,
+                     hlo_module=JOB),                    # HOST_RUNTIME
+        TpuSpanEvent(start_ns=10, duration_ns=5, run_id=7, kind=5,
+                     hlo_module=JOB),                    # HOST_COMPILE
+        TpuSpanEvent(start_ns=10, duration_ns=5, run_id=7,
+                     hlo_category="host", hlo_module=JOB),
+    ])
+    agg.flush()
+    assert out == [] and agg.stats["spans_seen"] == 0
+
+
+# -- step_trace degraded contract (regression) --------------------------------
+
+def test_step_trace_host_only_returns_zeroed():
+    """Spans with NO device planes (host-only hook events carrying a
+    run_id) must yield the zeroed dict, not a fabricated device-0 plane
+    or a raise."""
+    from deepflow_tpu.tpuprobe.collectives import step_trace
+    zero = {"run_id": 0, "job": "", "devices": {}, "collectives": [],
+            "step_latency_ns": 0, "device_skew_ns": 0}
+    host_rows = [
+        {"time": 100, "duration_ns": 50, "run_id": 3, "kind": 4},
+        {"time": 120, "duration_ns": 10, "run_id": 3,
+         "kind": "host-compile"},
+        {"time": 150, "duration_ns": 30, "run_id": 3,
+         "hlo_category": "host"},
+    ]
+    assert step_trace(host_rows) == zero
+    assert step_trace(None) == zero
+    assert step_trace([]) == zero
+    # mixed capture: host spans are dropped, device spans still bound
+    mixed = host_rows + [
+        {"time": 200, "duration_ns": 40, "run_id": 3, "device_id": 1,
+         "hlo_op": "fusion.1", "kind": "device-compute"}]
+    tr = step_trace(mixed)
+    assert tr["run_id"] == 3 and list(tr["devices"]) == ["1"]
+
+
+# -- host-partial merge / attribution -----------------------------------------
+
+def _host_row(host: str, t0: int, t1: int, skew: int, **kw) -> dict:
+    row = {"job": JOB, "run_id": 1, "step": 1, "time": t0, "end_ns": t1,
+           "latency_ns": t1 - t0, "device_count": 4,
+           "device_skew_ns": skew, "compute_ns": 8 * MS,
+           "collective_ns": 3_600_000, "straggler_device": 0,
+           "straggler_lag_ns": 0, "host": host,
+           "top_hlos": json.dumps([["fusion.1", 8 * MS, "fusion"]])}
+    row.update(kw)
+    return row
+
+
+def test_merge_host_partials_cross_host_exact():
+    # host-a devices end at 10ms (skew 1ms -> earliest device end 9ms);
+    # host-b ends at 12ms (skew 0.5ms -> earliest 11.5ms). Global spread
+    # = 12ms - 9ms, reconstructed from the per-host pairs alone.
+    rows = [
+        _host_row("host-a", 1 * MS, 10 * MS, 1 * MS,
+                  straggler_device=3, straggler_lag_ns=123),
+        _host_row("host-b", 2 * MS, 12 * MS, 500_000,
+                  straggler_device=6, straggler_lag_ns=456),
+    ]
+    merged = stephealth.merge_host_partials(rows)
+    assert len(merged) == 1
+    m = merged[0]
+    assert m["latency_ns"] == 11 * MS            # 12ms end - 1ms start
+    assert m["device_skew_ns"] == 3 * MS         # 12ms - min(9, 11.5)ms
+    assert m["device_count"] == 8
+    assert m["compute_ns"] == 16 * MS
+    assert m["straggler_device"] == 6            # latest end wins
+    assert m["straggler_host"] == "host-b"
+    assert m["hosts"] == ["host-a", "host-b"]
+    assert m["top_hlos"] == [["fusion.1", 16 * MS, "fusion"]]
+    assert m["records"] == 2
+    # merge must not depend on arrival order
+    assert stephealth.merge_host_partials(rows[::-1])[0] == m
+
+
+def test_ewma_mad_fires_only_past_warmup_and_keeps_baseline():
+    sc = stephealth.EwmaMad()
+    healthy = {"job": JOB, "latency_ns": 3 * MS, "compute_ns": 8 * MS,
+               "collective_ns": 3_600_000, "device_skew_ns": 40_000,
+               "top_hlos": [], "device_count": 4}
+    for _ in range(8):
+        assert sc.feed(dict(healthy)) is False
+    ewma_before = sc.ewma
+    slow = dict(healthy, latency_ns=6 * MS)
+    assert sc.feed(slow) is True
+    # the regressed step must not pollute the mean or the baseline
+    assert sc.ewma == ewma_before
+    assert all(h["latency_ns"] == 3 * MS for h in sc.healthy)
+    assert sc.feed(dict(healthy)) is False
+
+
+# -- decoder: hop-ledger conservation under burst -----------------------------
+
+def test_step_decoder_ledger_balances_under_burst():
+    """A burst of STEP_METRICS frames — including malformed payloads —
+    must leave the decoder's frame ledger balanced: every frame emitted
+    is delivered or dropped(decode_error), nothing vanishes."""
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0).start()
+    try:
+        good = encode_frame(
+            FrameHeader(MessageType.STEP_METRICS, agent_id=1),
+            encode_step_payload(
+                [{"time": i * MS, "end_ns": i * MS + 500, "latency_ns": 500,
+                  "run_id": i, "step": i, "job": JOB, "device_count": 4,
+                  "device_skew_ns": 0, "compute_ns": 1, "collective_ns": 1,
+                  "straggler_device": 0, "straggler_lag_ns": 0,
+                  "top_hlos": []} for i in range(1, 9)]))
+        bad = encode_frame(
+            FrameHeader(MessageType.STEP_METRICS, agent_id=1),
+            b'{"v":99,"records":[]}')
+        s = socket.create_connection(("127.0.0.1", server.ingest_port))
+        n_good, n_bad = 40, 5
+        for i in range(n_good + n_bad):
+            s.sendall(bad if i % 9 == 8 else good)
+        s.close()
+        assert server.wait_for_rows("profile.tpu_step_metrics",
+                                    n_good * 8, timeout=10)
+
+        deadline = time.time() + 10
+        hop = None
+        while time.time() < deadline:
+            health = _get(server.query_port, "/v1/health")
+            hops = {p["hop"]: p for p in health.get("pipeline", [])}
+            hop = hops.get("decoder.STEP_METRICS")
+            if hop and hop["in_flight"] == 0 \
+                    and hop["emitted"] == n_good + n_bad:
+                break
+            time.sleep(0.1)
+        assert hop, "decoder.STEP_METRICS hop missing from /v1/health"
+        assert hop["emitted"] == \
+            hop["delivered"] + hop["dropped_total"] + hop["in_flight"], hop
+        assert hop["emitted"] == n_good + n_bad
+        assert hop["dropped"].get("decode_error") == n_bad, hop
+    finally:
+        server.stop()
+
+
+# -- e2e: slow device -> alert with attribution (acceptance) ------------------
+
+def test_e2e_slow_device_fires_step_regression():
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0).start()
+    try:
+        out: list = []
+        agg = StepAggregator(_collect(out))
+        for rid in range(1, 9):
+            agg.feed(_step_events(rid))
+        agg.feed(_step_events(9, slow_device=2))
+        agg.flush()
+        frame = encode_frame(
+            FrameHeader(MessageType.STEP_METRICS, agent_id=1),
+            encode_step_payload(out, pid=7, process_name="train"))
+        s = socket.create_connection(("127.0.0.1", server.ingest_port))
+        s.sendall(frame)
+        s.close()
+        assert server.wait_for_rows("profile.tpu_step_metrics", 9,
+                                    timeout=10)
+
+        server.step_detector.poll()      # records per-step counts
+        alerts = [a for a in server.step_detector.poll()  # counts stable
+                  if a["type"] == "alert"]
+        assert len(alerts) == 1, alerts
+        att = alerts[0]["attribution"]
+        assert alerts[0]["step"] == 9
+        assert att["straggler_device"] == 2
+        assert att["verdict"] == "skew"
+        assert att["dominant_hlos"][0]["hlo_op"] == "fusion.1"
+        assert att["dominant_hlos"][0]["delta_ns"] == 2 * MS
+
+        # the alert landed as a queryable event carrying the verdict
+        ev = server.db.table("event.event")
+        res = qengine.execute(
+            ev, "SELECT event_type, resource_name, description, attrs "
+                "FROM t WHERE resource_name = 'step_regression'")
+        rows = [dict(zip(res.columns, v)) for v in res.values]
+        fired = [r for r in rows if r["event_type"] == "alert"]
+        assert len(fired) == 1
+        assert "fusion.1" in fired[0]["description"]
+        attrs = json.loads(fired[0]["attrs"])
+        assert attrs["attribution"]["straggler_device"] == 2
+
+        # timeline endpoint agrees with the alert
+        steps = _post(server.query_port, "/v1/tpu/steps",
+                      {"job": JOB})["result"]["steps"]
+        assert [s_["step"] for s_ in steps if s_["regressed"]] == [9]
+        assert steps[-1]["verdict"] == "skew"
+
+        # DF-SQL catalog exposes the table and its dimensions
+        tags = _post(server.query_port, "/v1/query",
+                     {"sql": "SHOW tags FROM tpu_step_metrics"})["result"]
+        names = [v[0] for v in tags["values"]]
+        assert "straggler_device" in names and "job" in names
+
+        # critical-path endpoint names the same straggler
+        cp = _post(server.query_port, "/v1/tpu/steps/critical_path",
+                   {"job": JOB, "step": 9})["result"]
+        assert cp["attribution"]["straggler_device"] == 2
+        assert cp["attribution"]["verdict"] == "skew"
+        assert cp["attribution"]["baseline_steps"] == 8
+
+        # recovery: a healthy newer step resolves with hysteresis
+        server.db.table("profile.tpu_step_metrics").append_rows([
+            {"time": 100 * MS, "end_ns": 103 * MS, "latency_ns": 3 * MS,
+             "run_id": 10, "step": 10, "job": JOB, "device_count": 4,
+             "device_skew_ns": 0, "compute_ns": 8 * MS,
+             "collective_ns": 3_600_000, "top_hlos": "[]"}])
+        server.step_detector.poll()
+        resolved = [a for a in server.step_detector.poll()
+                    if a["type"] == "alert-resolved"]
+        assert len(resolved) == 1 and resolved[0]["step"] == 10
+    finally:
+        server.stop()
+
+
+# -- federation: 3-shard rollup == single node (acceptance) -------------------
+
+def _multi_host_rows(n_steps: int = 6, hosts=("h0", "h1", "h2")) -> list:
+    """Each step has one partial per host; host hi's devices end slightly
+    later than h(i-1)'s so the merged skew is cross-host."""
+    rows = []
+    for step in range(1, n_steps + 1):
+        t0 = step * 10 * MS
+        for i, host in enumerate(hosts):
+            end = t0 + 3 * MS + i * 100_000
+            rows.append(_host_row(
+                host, t0, end, 50_000, run_id=step, step=step,
+                straggler_device=i, straggler_lag_ns=i * 100_000,
+                top_hlos=json.dumps(
+                    [["fusion.1", 8 * MS, "fusion"],
+                     [f"copy.{i}", 100_000, "copy"]])))
+    return rows
+
+
+def test_federated_step_rollup_equals_single_node():
+    rows = _multi_host_rows()
+    single = Server(host="127.0.0.1", ingest_port=0, query_port=0).start()
+    shards: list = []
+    try:
+        single.db.table("profile.tpu_step_metrics").append_rows(rows)
+        want = _post(single.query_port, "/v1/tpu/steps",
+                     {"job": JOB})["result"]
+
+        seed = Server(host="127.0.0.1", ingest_port=0, query_port=0,
+                      sync_port=0, shard_id=1,
+                      cluster_advertise="").start()
+        shards.append(seed)
+        seed_addr = f"127.0.0.1:{seed.query_port}"
+        for sid in (2, 3):
+            shards.append(Server(
+                host="127.0.0.1", ingest_port=0, query_port=0,
+                sync_port=0, shard_id=sid,
+                cluster_seed=seed_addr).start())
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if len(seed.api.federation.remote_peers()) == 2:
+                break
+            time.sleep(0.1)
+        assert len(seed.api.federation.remote_peers()) == 2
+        # each host's partials land on exactly one shard
+        for i, srv in enumerate(shards):
+            srv.db.table("profile.tpu_step_metrics").append_rows(
+                [r for r in rows if r["host"] == f"h{i}"])
+
+        got = _post(seed.query_port, "/v1/tpu/steps", {"job": JOB})
+        assert got.get("federation", {}).get("shards") == 3
+        assert got["federation"].get("missing_shards") in ([], None)
+        assert got["result"] == want
+
+        # critical path federates identically
+        want_cp = _post(single.query_port, "/v1/tpu/steps/critical_path",
+                        {"job": JOB, "step": 6})["result"]
+        got_cp = _post(seed.query_port, "/v1/tpu/steps/critical_path",
+                       {"job": JOB, "step": 6})["result"]
+        assert got_cp == want_cp
+        assert got_cp["step"]["hosts"] == ["h0", "h1", "h2"]
+    finally:
+        for srv in shards:
+            srv.stop()
+        single.stop()
+
+
+# -- exporter mapping (satellite) ---------------------------------------------
+
+def test_otlp_exporter_maps_step_rows():
+    from deepflow_tpu.server.exporters import OtlpJsonExporter
+    exp = OtlpJsonExporter("http://127.0.0.1:1/otlp")
+    assert "profile.tpu_step_metrics" in exp.TABLES
+    shipped: list = []
+    exp._post = lambda data, ctype: shipped.append(json.loads(data))
+    row = {"time": 5 * MS, "end_ns": 8 * MS, "run_id": 4, "step": 4,
+           "job": JOB, "device_count": 4, "device_skew_ns": 111,
+           "collective_ns": 222, "straggler_device": 3, "host": "h7"}
+    exp._ship([("profile.tpu_step_metrics", row),
+               ("flow_log.l7_flow_log",
+                {"time": 1, "response_duration": 2, "flow_id": 9})])
+    spans = shipped[0]["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    assert len(spans) == 2
+    step_span = spans[0]
+    assert step_span["name"] == f"{JOB}/4"
+    assert step_span["startTimeUnixNano"] == str(5 * MS)
+    assert step_span["endTimeUnixNano"] == str(8 * MS)
+    attrs = {a["key"]: a["value"] for a in step_span["attributes"]}
+    assert attrs["tpu.straggler_device"]["intValue"] == 3
+    assert attrs["host.name"]["stringValue"] == "h7"
