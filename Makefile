@@ -1,7 +1,7 @@
 PYTHON ?= python
 JAX_ENV := env JAX_PLATFORMS=cpu
 
-.PHONY: test selfmon-check cluster-check steps-check bench native
+.PHONY: test selfmon-check cluster-check steps-check chaos-check bench native
 
 test:
 	timeout -k 10 870 $(JAX_ENV) $(PYTHON) -m pytest tests/ -q -m 'not slow' \
@@ -18,6 +18,13 @@ selfmon-check:
 # cluster.* fan-out hop's frame ledger fails to balance.
 cluster-check:
 	timeout -k 10 120 $(JAX_ENV) $(PYTHON) -m deepflow_tpu.cli.cluster_check
+
+# Kill-and-recover run of the durable transport under seeded fault
+# injection (conn resets + partial writes + a mid-stream server
+# restart); exits non-zero unless every high-priority frame lands in
+# the store exactly once and all hop ledgers balance.
+chaos-check:
+	timeout -k 10 120 $(JAX_ENV) $(PYTHON) -m deepflow_tpu.cli.chaos_check
 
 # Brief e2e run of the step-health pipeline: synthetic 4-device pod with
 # one injected 2x-slow device; exits non-zero unless the regression

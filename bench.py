@@ -299,6 +299,60 @@ def _bench_selfmon_overhead() -> dict:
     }
 
 
+def _run_sender_ingest(durable: bool, n_batches: int = 400) -> float:
+    """L4 batches through the REAL UniformSender (not a raw socket) into
+    the real server; returns rows/s. durable=True is the full loss-
+    bounded transport (seq ext + ack reads + retransmit window + disk
+    spool); durable=False is the legacy fire-and-forget v1 wire."""
+    import tempfile
+
+    from deepflow_tpu.agent.sender import UniformSender
+    from deepflow_tpu.agent.spool import Spool
+    from deepflow_tpu.codec import decode_frame
+    from deepflow_tpu.server import Server
+
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0).start()
+    sender = None
+    try:
+        frame, table_name, msg_type = _make_l4_frame()
+        _, payload, _ = decode_frame(frame)
+        spool = Spool(tempfile.mkdtemp(prefix="df-bench-spool-")) \
+            if durable else None
+        sender = UniformSender(
+            [("127.0.0.1", server.ingest_port)], agent_id=1,
+            durable=durable, spool=spool).start()
+        t0 = time.perf_counter()
+        for _ in range(n_batches):
+            sender.send(msg_type, payload)
+        total = n_batches * 256
+        table = server.db.table(table_name)
+        while len(table) < total and time.perf_counter() - t0 < 60:
+            time.sleep(0.01)
+        dt = time.perf_counter() - t0
+        return len(table) / dt
+    finally:
+        if sender is not None:
+            sender.flush_and_stop(timeout=10.0)
+        server.stop()
+
+
+def _bench_transport() -> dict:
+    """Durable-transport overhead gate: the at-least-once layer (per-
+    frame seq, ack channel, retransmit window, spool bookkeeping) rides
+    every frame the agent ships, so in the NO-FAULT case it must cost
+    under 3% of ingest throughput vs the v1 fire-and-forget wire.
+    Best-of-3 per arm, like the selfmon gate."""
+    durable = max(_run_sender_ingest(True) for _ in range(3))
+    v1 = max(_run_sender_ingest(False) for _ in range(3))
+    pct = (v1 - durable) / v1 * 100.0 if v1 else 0.0
+    return {
+        "transport_rows_per_sec_durable": round(durable),
+        "transport_rows_per_sec_v1": round(v1),
+        "transport_overhead_pct": round(max(0.0, pct), 2),
+        "transport_overhead_above_gate": pct > 3.0,
+    }
+
+
 def _make_steps_frame():
     from deepflow_tpu.codec import FrameHeader, MessageType, encode_frame
     from deepflow_tpu.tpuprobe.stepmetrics import encode_step_payload
@@ -709,6 +763,7 @@ def main() -> None:
     cpu_detail.update(_bench_packet_path())
     cpu_detail.update(_bench_ingest())
     cpu_detail.update(_bench_selfmon_overhead())
+    cpu_detail.update(_bench_transport())
     cpu_detail.update(_bench_steps())
     cpu_detail.update(_bench_federation())
     cpu_detail.update(_bench_extprofiler())

@@ -43,7 +43,11 @@ class Agent:
         self.sender = UniformSender(
             self.config.sender.servers, agent_id=self.config.agent_id,
             queue_size=self.config.sender.queue_size,
-            telemetry=self.telemetry)
+            telemetry=self.telemetry,
+            durable=self.config.sender.durable,
+            ack_window=self.config.sender.ack_window,
+            spool=self._build_spool(),
+            chaos=self._build_chaos())
         self.sampler: OnCpuSampler | None = None
         self.memprofiler = None
         self.extprofilers: list = []
@@ -69,6 +73,35 @@ class Agent:
         # serializes sampler/tpuprobe lifecycle across guard, synchronizer
         # and stats threads
         self._profiler_lock = threading.RLock()
+
+    def _build_spool(self):
+        sc = self.config.sender.spool
+        if not sc.enabled:
+            return None
+        import tempfile
+        from deepflow_tpu.agent.spool import Spool
+        directory = sc.dir or os.path.join(
+            tempfile.gettempdir(),
+            f"deepflow-spool-{self.config.agent_id}")
+        return Spool(directory, max_bytes=sc.max_mb << 20,
+                     segment_bytes=sc.segment_mb << 20)
+
+    def _build_chaos(self):
+        # DF_CHAOS (env) wins over the config block; the sender also
+        # falls back to the env knob itself when this returns None, so
+        # returning None here means "no config-driven injector"
+        from deepflow_tpu.chaos import ChaosConfig, ChaosInjector, \
+            chaos_from_env
+        env = chaos_from_env()
+        if env is not None:
+            return env
+        cc = self.config.chaos
+        if not cc.enabled:
+            return None
+        return ChaosInjector(ChaosConfig(
+            enabled=True, seed=cc.seed, conn_refuse=cc.conn_refuse,
+            conn_reset=cc.conn_reset, partial_write=cc.partial_write,
+            latency_ms=cc.latency_ms, disk_full=cc.disk_full))
 
     # -- lifecycle -----------------------------------------------------------
 

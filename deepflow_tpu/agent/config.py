@@ -78,9 +78,42 @@ class GuardConfig:
 
 
 @dataclass
+class SpoolConfig:
+    """On-disk overflow/replay spool for the durable sender
+    (deepflow_tpu/agent/spool.py): frames that would be dropped land in
+    CRC-framed segment files and replay on reconnect."""
+    enabled: bool = False
+    dir: str = ""                 # "" = <tmpdir>/deepflow-spool-<agent_id>
+    max_mb: int = 64              # oldest-segment eviction past this
+    segment_mb: int = 4
+
+
+@dataclass
 class SenderConfig:
     servers: list = field(default_factory=lambda: [("127.0.0.1", 20033)])
     queue_size: int = 8192
+    # durable transport: per-frame seq + server ACKs + retransmit window
+    # (at-least-once; the server dedups). False = legacy fire-and-forget
+    # v1 wire for pre-ACK servers.
+    durable: bool = True
+    # sent-but-unacked frames kept for retransmit after a reconnect
+    ack_window: int = 1024
+    spool: SpoolConfig = field(default_factory=SpoolConfig)
+
+
+@dataclass
+class ChaosConfig:
+    """Deterministic transport fault injection (deepflow_tpu/chaos.py).
+    The DF_CHAOS env knob overrides this block; both use per-call
+    probabilities in [0,1]. Never enable in production — this exists so
+    the chaos harness can prove the loss bounds hold."""
+    enabled: bool = False
+    seed: int = 0
+    conn_refuse: float = 0.0
+    conn_reset: float = 0.0
+    partial_write: float = 0.0
+    latency_ms: float = 0.0
+    disk_full: float = 0.0
 
 
 @dataclass
@@ -132,6 +165,7 @@ class AgentConfig:
     integration: IntegrationConfig = field(
         default_factory=IntegrationConfig)
     sender: SenderConfig = field(default_factory=SenderConfig)
+    chaos: ChaosConfig = field(default_factory=ChaosConfig)
     selfmon: SelfmonConfig = field(default_factory=SelfmonConfig)
     stats_interval_s: float = 10.0
     sync_interval_s: float = 10.0
@@ -155,12 +189,16 @@ class AgentConfig:
                 sd["servers"] = [
                     tuple(x) if isinstance(x, (list, tuple))
                     else _parse_addr(x) for x in sd["servers"]]
+            if isinstance(sd.get("spool"), dict):
+                sd["spool"] = SpoolConfig(**sd["spool"])
             cfg.sender = SenderConfig(**sd)
+        if isinstance(d.get("chaos"), dict):
+            cfg.chaos = ChaosConfig(**d["chaos"])
         if isinstance(d.get("selfmon"), dict):
             cfg.selfmon = SelfmonConfig(**d["selfmon"])
         for f in dataclasses.fields(cls):
             if f.name in ("profiler", "tpuprobe", "guard", "integration",
-                          "flow", "sender", "selfmon"):
+                          "flow", "sender", "chaos", "selfmon"):
                 continue
             if f.name in d:
                 setattr(cfg, f.name, d[f.name])
@@ -190,6 +228,17 @@ class AgentConfig:
         num(self.sync_interval_s, "sync_interval_s", 0.1)
         num(self.selfmon.deadman_window_s, "selfmon.deadman_window_s", 0.1)
         num(self.selfmon.check_interval_s, "selfmon.check_interval_s", 0)
+        num(self.sender.queue_size, "sender.queue_size", 1)
+        num(self.sender.ack_window, "sender.ack_window", 1)
+        num(self.sender.spool.max_mb, "sender.spool.max_mb", 1)
+        num(self.sender.spool.segment_mb, "sender.spool.segment_mb", 1)
+        if self.sender.spool.segment_mb > self.sender.spool.max_mb:
+            raise ValueError(
+                "sender.spool.segment_mb must be <= sender.spool.max_mb "
+                "(the cap must hold at least one segment)")
+        for p in ("conn_refuse", "conn_reset", "partial_write", "disk_full"):
+            num(getattr(self.chaos, p), f"chaos.{p}", 0.0, 1.0)
+        num(self.chaos.latency_ms, "chaos.latency_ms", 0)
         num(self.guard.max_cpu_pct, "guard.max_cpu_pct", 1)
         num(self.guard.max_mem_mb, "guard.max_mem_mb", 16)
         num(self.guard.check_interval_s, "guard.check_interval_s", 0.1)
@@ -227,6 +276,9 @@ class AgentConfig:
                         (self.tpuprobe.enabled, "tpuprobe.enabled"),
                         (self.tpuprobe.step_metrics,
                          "tpuprobe.step_metrics"),
+                        (self.sender.durable, "sender.durable"),
+                        (self.sender.spool.enabled, "sender.spool.enabled"),
+                        (self.chaos.enabled, "chaos.enabled"),
                         (self.selfmon.enabled, "selfmon.enabled"),
                         (self.standalone, "standalone")):
             if not isinstance(b, bool):
@@ -266,6 +318,18 @@ _TEMPLATE_DOCS = {
     "flow.interface": "capture interface; empty = all",
     "flow.exclude_ports": "never capture these ports (feedback guard)",
     "sender.servers": "ingest endpoints, failover order",
+    "sender.durable": "per-frame seq + server ACK + retransmit "
+                      "(at-least-once); false = legacy v1 fire-and-forget",
+    "sender.ack_window": "sent-but-unacked frames kept for retransmit",
+    "sender.spool.enabled": "spill overflow/unsent frames to disk and "
+                            "replay them on reconnect",
+    "sender.spool.dir": "segment directory; empty = tmpdir",
+    "sender.spool.max_mb": "spool cap; oldest segment evicted (and "
+                           "ledgered as dropped) past this",
+    "sender.spool.segment_mb": "rotate segment files at this size",
+    "chaos.enabled": "transport fault injection (tests only); the "
+                     "DF_CHAOS env spec overrides this block",
+    "chaos.seed": "PRNG seed — same seed, same fault schedule",
     "selfmon.deadman_window_s": "flag a stage wedged after this many "
                                 "seconds without a heartbeat",
     "selfmon.check_interval_s": "deadman scan cadence; 0 = window/4",

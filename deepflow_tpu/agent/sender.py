@@ -2,19 +2,61 @@
 
 Reference analog: agent/src/sender/uniform_sender.rs (Header prepend
 :149-210, batching, compression, server failover).
+
+Durable-delivery layer (this port goes beyond the reference, which is
+fire-and-forget): every frame carries a monotonically increasing
+per-agent ``seq`` (codec v2).  The server periodically writes ACK
+frames (highest contiguous seq) back down the same TCP connection; the
+sender keeps sent-but-unacked frames in a bounded retransmit window and
+replays them after a reconnect, the server's decoders dedup on
+``(agent_id, seq)`` — together: at-least-once delivery, exactly-once
+rows.  Frames that would previously be dropped (queue overflow with no
+lower-priority victim, a failed in-flight write, a dead server) spill
+into an on-disk ``Spool`` and replay on reconnect.  Under pressure the
+sender sheds by message-type class — DFSTATS/self-mon first,
+STEP_METRICS/flow/trace data last — with per-class ``dropped(reason)``
+ledger accounting, replacing the old blind drop-newest.
+
+Ledger discipline: ``emitted`` is accounted once per ``send()``,
+``delivered`` once per frame at its FIRST successful socket write
+(retransmits of unacked frames are counted in ``stats`` but not
+re-accounted), and every shed/evicted/undeliverable frame is a
+``dropped(reason)`` — so ``emitted == delivered + dropped + in_flight``
+holds exactly, spool or no spool.
 """
 
 from __future__ import annotations
 
 import logging
 import queue
+import select
 import socket
+import struct
 import threading
 import time
 
-from deepflow_tpu.codec import FrameHeader, MessageType, encode_frame
+from deepflow_tpu.codec import (
+    SEQ_EXT_FMT, FrameDecodeError, FrameHeader, MessageType, StreamDecoder,
+    encode_frame, priority_of)
 
 log = logging.getLogger("df.sender")
+
+_PRIO_NAMES = {0: "high", 1: "mid", 2: "low"}
+
+
+class _Frame:
+    """One frame's transit state. ``needs_account`` flips False at the
+    first successful write so retransmits never double-count."""
+
+    __slots__ = ("msg_type", "payload", "seq", "enq_ns", "needs_account")
+
+    def __init__(self, msg_type: MessageType, payload: bytes, seq: int,
+                 enq_ns: int | None, needs_account: bool = True) -> None:
+        self.msg_type = msg_type
+        self.payload = payload
+        self.seq = seq
+        self.enq_ns = enq_ns
+        self.needs_account = needs_account
 
 
 class UniformSender:
@@ -26,7 +68,9 @@ class UniformSender:
 
     def __init__(self, servers: list[tuple[str, int]], agent_id: int = 0,
                  org_id: int = 0, team_id: int = 0, queue_size: int = 8192,
-                 connect_timeout: float = 3.0, telemetry=None) -> None:
+                 connect_timeout: float = 3.0, telemetry=None,
+                 spool=None, ack_window: int = 1024,
+                 durable: bool = True, chaos=None) -> None:
         if not servers:
             raise ValueError("need at least one server address")
         from deepflow_tpu.agent.config import _parse_addr
@@ -41,13 +85,45 @@ class UniformSender:
         self._thread: threading.Thread | None = None
         self._sock: socket.socket | None = None
         self._server_idx = 0
+        # durable=False reverts to the seq-less v1 wire (no ack window,
+        # no retransmit) — the bench baseline arm and a compat escape
+        # hatch for pre-ACK servers
+        self.durable = durable
+        self.spool = spool
+        self.ack_window = max(1, ack_window)
+        if chaos is None:
+            from deepflow_tpu.chaos import chaos_from_env
+            chaos = chaos_from_env()
+        self._chaos = chaos
+        self._seq_lock = threading.Lock()
+        self._next_seq = 1
+        self._acked = 0                       # highest contiguous acked
+        self._unacked: dict[int, _Frame] = {}  # sent, awaiting ack
+        self._pending: list[_Frame] = []       # retransmit/replay, FIFO
+        self._inflight: _Frame | None = None
+        self._spool_replayed_through = 0
+        self._ackdec = StreamDecoder()
         self.stats = {"sent_frames": 0, "sent_bytes": 0, "dropped": 0,
-                      "reconnects": 0, "errors": 0}
+                      "reconnects": 0, "errors": 0, "retransmits": 0,
+                      "spooled": 0, "replayed": 0, "acked_seq": 0,
+                      "shed": 0, "unacked_evicted": 0}
         if telemetry is None:
             from deepflow_tpu.telemetry import Telemetry
             telemetry = Telemetry("agent", enabled=False)
         self._hop = telemetry.hop("sender")
         self._telemetry = telemetry
+        if self.spool is not None:
+            # a spool recovered from a previous process holds frames
+            # that were never emitted on THIS ledger: account them in
+            # so replay's delivered keeps the ledger balanced
+            self.spool.on_evict = self._on_spool_evict
+            recovered = self.spool.pending_records()
+            if recovered:
+                self._hop.account(emitted=recovered)
+
+    def _on_spool_evict(self, n: int, reason: str) -> None:
+        self.stats["dropped"] += n
+        self._hop.account(dropped=n, reason=reason)
 
     def start(self) -> "UniformSender":
         self._thread = threading.Thread(
@@ -62,27 +138,95 @@ class UniformSender:
         """Non-consuming sample of queued frames (debug queue tap)."""
         with self._q.mutex:
             items = list(self._q.queue)[:n]
-        return [{"type": getattr(mt, "name", str(mt)), "bytes": len(p)}
-                for mt, p, _enq in items]
+        return [{"type": getattr(f.msg_type, "name", str(f.msg_type)),
+                 "bytes": len(f.payload)} for f in items]
+
+    def _alloc_seq(self) -> int:
+        with self._seq_lock:
+            seq = self._next_seq
+            self._next_seq += 1
+            return seq
 
     def send(self, msg_type: MessageType, payload: bytes) -> bool:
         self._hop.account(emitted=1)
+        f = _Frame(msg_type, payload, self._alloc_seq(),
+                   time.monotonic_ns())
         try:
-            self._q.put_nowait((msg_type, payload, time.monotonic_ns()))
+            self._q.put_nowait(f)
             return True
         except queue.Full:
-            self.stats["dropped"] += 1
-            self._hop.account(dropped=1, reason="queue_full")
+            pass
+        # prioritized backpressure: shed the lowest-priority queued frame
+        # strictly below this one's class before giving up room
+        mine = priority_of(msg_type)
+        victim = self._shed_lower_than(mine)
+        if victim is not None:
+            self._drop(victim, "priority_shed_"
+                       + _PRIO_NAMES[priority_of(victim.msg_type)])
+            self.stats["shed"] += 1
+            try:
+                self._q.put_nowait(f)
+                return True
+            except queue.Full:
+                pass  # raced with other senders: fall through
+        if self.spool is not None and mine == 0:
+            # high-priority frames survive overflow on disk
+            if self.spool.append(int(msg_type), f.seq, f.payload):
+                self.stats["spooled"] += 1
+                return True
+            self._drop(f, "spool_error")
             return False
+        self._drop(f, f"queue_full_{_PRIO_NAMES[mine]}")
+        return False
+
+    def _drop(self, f: _Frame, reason: str) -> None:
+        self.stats["dropped"] += 1
+        self._hop.account(dropped=1, reason=reason)
+
+    def _shed_lower_than(self, prio: int) -> _Frame | None:
+        """Remove and return the oldest queued frame with a strictly
+        lower priority class than ``prio`` (higher numeric = lower)."""
+        with self._q.mutex:
+            dq = self._q.queue
+            worst_i, worst_p = -1, prio
+            for i, f in enumerate(dq):
+                p = priority_of(f.msg_type)
+                if p > worst_p:
+                    worst_i, worst_p = i, p
+                    if p == 2:
+                        break  # can't get lower
+            if worst_i < 0:
+                return None
+            victim = dq[worst_i]
+            del dq[worst_i]
+            self._q.not_full.notify()
+            return victim
+
+    # -- shutdown ------------------------------------------------------------
 
     def flush_and_stop(self, timeout: float = 5.0) -> None:
         deadline = time.monotonic() + timeout
-        while not self._q.empty() and time.monotonic() < deadline:
-            time.sleep(0.02)
+        # wait for the queue AND the retransmit/replay backlog AND the
+        # in-flight frame — _q.empty() alone used to abandon the frame
+        # the worker had already dequeued
+        while time.monotonic() < deadline:
+            if self._q.empty() and self._inflight is None \
+                    and not self._pending and not self._spool_backlog():
+                break
+            if self._stop.wait(0.02):
+                break
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=2.0)
         self._close()
+        if self.spool is not None:
+            self.spool.close()
+
+    def _spool_backlog(self) -> bool:
+        """True while the spool holds records not yet handed to replay."""
+        return (self.durable and self.spool is not None
+                and self.spool.max_seq() > max(self._acked,
+                                               self._spool_replayed_through))
 
     def _close(self) -> None:
         if self._sock:
@@ -91,6 +235,14 @@ class UniformSender:
             except OSError:
                 pass
             self._sock = None
+        self._ackdec = StreamDecoder()
+        # sent-but-unacked frames go back on the retransmit list: the
+        # server may or may not have them; dedup makes resending safe
+        if self.durable and self._unacked:
+            backlog = sorted(self._unacked.values(), key=lambda f: f.seq)
+            self._unacked.clear()
+            self._pending = sorted(self._pending + backlog,
+                                   key=lambda f: f.seq)
 
     def _connect(self) -> bool:
         """Try servers round-robin starting at the current index."""
@@ -98,16 +250,155 @@ class UniformSender:
             host, port = self.servers[(self._server_idx + i)
                                       % len(self.servers)]
             try:
+                if self._chaos is not None:
+                    self._chaos.on_connect()
                 s = socket.create_connection(
                     (host, port), timeout=self.connect_timeout)
                 s.settimeout(10.0)
                 self._sock = s
                 self._server_idx = (self._server_idx + i) % len(self.servers)
                 self.stats["reconnects"] += 1
+                if self.durable:
+                    self._load_replay()
                 return True
             except OSError:
                 continue
         return False
+
+    def _load_replay(self) -> None:
+        """Queue spooled frames (never yet sent) for delivery. Unacked
+        retransmits were already moved to _pending by _close()."""
+        if self.spool is None:
+            return
+        start = max(self._acked, self._spool_replayed_through)
+        fresh = []
+        pending_seqs = {f.seq for f in self._pending}
+        for mt, seq, payload in self.spool.replay(start):
+            if seq in pending_seqs:
+                continue
+            try:
+                msg_type = MessageType(mt)
+            except ValueError:
+                continue
+            fresh.append(_Frame(msg_type, payload, seq, None))
+            self._spool_replayed_through = max(
+                self._spool_replayed_through, seq)
+        if fresh:
+            self.stats["replayed"] += len(fresh)
+            self._pending = sorted(self._pending + fresh,
+                                   key=lambda f: f.seq)
+
+    # -- ack processing ------------------------------------------------------
+
+    def _read_acks(self) -> None:
+        """Drain any ACK frames the server wrote back (non-blocking)."""
+        sock = self._sock
+        if sock is None or not self.durable:
+            return
+        try:
+            while True:
+                r, _, _ = select.select([sock], [], [], 0)
+                if not r:
+                    return
+                data = sock.recv(4096)
+                if not data:
+                    raise OSError("server closed connection")
+                for header, payload in self._ackdec.feed(data):
+                    if header.msg_type == MessageType.ACK:
+                        self._on_ack(
+                            struct.unpack_from(SEQ_EXT_FMT, payload)[0])
+        except (OSError, FrameDecodeError, struct.error) as e:
+            log.warning("ack channel failed (%s); reconnecting", e)
+            self.stats["errors"] += 1
+            self._close()
+            self._server_idx = (self._server_idx + 1) % len(self.servers)
+
+    def _on_ack(self, seq: int) -> None:
+        if seq <= self._acked:
+            return
+        self._acked = seq
+        self.stats["acked_seq"] = seq
+        for s in [s for s in self._unacked if s <= seq]:
+            del self._unacked[s]
+        kept = []
+        for f in self._pending:
+            if f.seq > seq:
+                kept.append(f)
+            elif f.needs_account:
+                # the server acked a frame we thought undelivered (e.g.
+                # a chaos partial write that actually landed whole):
+                # it IS delivered; close its ledger entry
+                self._hop.account(delivered=1)
+                f.needs_account = False
+        self._pending = kept
+        if self.spool is not None:
+            self.spool.trim(seq)
+
+    # -- send loop -----------------------------------------------------------
+
+    def _next_frame(self) -> _Frame | None:
+        if self._pending:
+            return self._pending.pop(0)
+        try:
+            return self._q.get(timeout=0.2)
+        except queue.Empty:
+            return None
+
+    def _send_frame(self, f: _Frame) -> None:
+        self._inflight = f
+        is_retransmit = not f.needs_account
+        frame = encode_frame(
+            FrameHeader(f.msg_type, agent_id=self.agent_id,
+                        org_id=self.org_id, team_id=self.team_id,
+                        seq=f.seq if self.durable else None),
+            f.payload)
+        try:
+            if self._chaos is not None:
+                self._chaos.on_send(self._sock, frame)
+            else:
+                self._sock.sendall(frame)
+            self.stats["sent_frames"] += 1
+            self.stats["sent_bytes"] += len(frame)
+            if is_retransmit:
+                self.stats["retransmits"] += 1
+            if f.needs_account:
+                if f.enq_ns is not None:
+                    self._hop.account(
+                        delivered=1,
+                        wait_ns=time.monotonic_ns() - f.enq_ns)
+                else:
+                    self._hop.account(delivered=1)
+                f.needs_account = False
+            if self.durable:
+                self._unacked[f.seq] = f
+                self._cap_unacked()
+        except OSError as e:
+            # the frame is NOT lost: keep it at the head of the
+            # retransmit list (or spool it) before rotating servers
+            self.stats["errors"] += 1
+            log.warning("send failed (%s); reconnecting", e)
+            if self.spool is not None and f.needs_account \
+                    and f.seq > self._spool_replayed_through:
+                if self.spool.append(int(f.msg_type), f.seq, f.payload):
+                    self.stats["spooled"] += 1
+                else:
+                    self._pending.insert(0, f)
+            else:
+                self._pending.insert(0, f)
+            self._close()
+            self._server_idx = (self._server_idx + 1) % len(self.servers)
+        finally:
+            self._inflight = None
+
+    def _cap_unacked(self) -> None:
+        """Bound retransmit-window memory. Evicted frames were DELIVERED
+        (ledger-wise nothing is lost) — we only give up the ability to
+        retransmit them, so delivery degrades to at-most-once beyond the
+        window. Sized so a well-acking server never hits it."""
+        while len(self._unacked) > self.ack_window:
+            oldest = min(self._unacked)
+            del self._unacked[oldest]
+            self.stats["unacked_evicted"] += 1
 
     def _run(self) -> None:
         backoff = 0.1
@@ -116,28 +407,21 @@ class UniformSender:
             hb.beat(progress=self.stats["sent_frames"])
             if self._sock is None:
                 if not self._connect():
-                    time.sleep(min(backoff, 5.0))
-                    backoff *= 2
+                    # interruptible backoff: flush_and_stop used to eat
+                    # up to 5s of unkillable time.sleep() here
+                    if self._stop.wait(min(backoff, 5.0)):
+                        return
+                    backoff = min(backoff * 2, 5.0)
                     continue
                 backoff = 0.1
-            try:
-                msg_type, payload, enq_ns = self._q.get(timeout=0.2)
-            except queue.Empty:
+            self._read_acks()
+            if self._sock is None:
+                continue  # ack channel died; reconnect first
+            f = self._next_frame()
+            if f is None:
+                # idle: frames that overflowed into the spool while the
+                # connection was busy drain now, without a reconnect
+                if self.durable:
+                    self._load_replay()
                 continue
-            frame = encode_frame(
-                FrameHeader(msg_type, agent_id=self.agent_id,
-                            org_id=self.org_id, team_id=self.team_id),
-                payload)
-            try:
-                self._sock.sendall(frame)
-                self.stats["sent_frames"] += 1
-                self.stats["sent_bytes"] += len(frame)
-                self._hop.account(
-                    delivered=1, wait_ns=time.monotonic_ns() - enq_ns)
-            except OSError as e:
-                # the frame is lost; rotate to the next server
-                self.stats["errors"] += 1
-                self._hop.account(dropped=1, reason="send_error")
-                log.warning("send failed (%s); reconnecting", e)
-                self._close()
-                self._server_idx = (self._server_idx + 1) % len(self.servers)
+            self._send_frame(f)

@@ -1,0 +1,260 @@
+"""Segmented on-disk spool: the sender's overflow + replay buffer.
+
+Reference analog: the reference agent bounds loss with large in-memory
+queues and backpressure; this port goes further — frames that would be
+dropped (queue overflow, dead server, failed in-flight write) land in
+an append-only disk spool and replay on reconnect, so an ingest outage
+shorter than the spool's capacity loses nothing.
+
+Layout: ``<dir>/spool-<first_seq>.seg`` segment files, each a run of
+CRC-framed records::
+
+    u32 payload_len | u32 crc32(payload) | u8 msg_type | u64 seq | payload
+
+Records are immutable once written; the spool rotates to a new segment
+at ``segment_bytes`` and enforces ``max_bytes`` by deleting the OLDEST
+segment (evicted records are reported to ``on_evict`` so the sender can
+ledger them as ``dropped(spool_evict)`` — bounded loss is still loss,
+and it must be visible).  ``trim(acked)`` deletes segments the server
+has fully acknowledged.  On construction an existing directory is
+recovered: every segment is scanned, torn tail records (a crash mid
+append) are discarded, and the surviving records become replayable —
+that is what makes an agent restart lossless for spooled frames.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import struct
+import threading
+import zlib
+
+log = logging.getLogger("df.spool")
+
+_REC_FMT = ">IIBQ"
+_REC_SIZE = struct.calcsize(_REC_FMT)  # 17
+_SEG_PREFIX = "spool-"
+_SEG_SUFFIX = ".seg"
+# refuse obviously-insane records when recovering a damaged file
+_MAX_RECORD = 64 << 20
+
+
+class _Segment:
+    __slots__ = ("path", "first_seq", "last_seq", "records", "bytes")
+
+    def __init__(self, path: str, first_seq: int) -> None:
+        self.path = path
+        self.first_seq = first_seq
+        self.last_seq = first_seq
+        self.records = 0
+        self.bytes = 0
+
+
+class Spool:
+    """Thread-safe (send() callers and the sender thread both touch it)."""
+
+    def __init__(self, directory: str, max_bytes: int = 64 << 20,
+                 segment_bytes: int = 4 << 20, on_evict=None,
+                 chaos=None) -> None:
+        self.dir = directory
+        self.max_bytes = max_bytes
+        # a segment must be well under the cap or eviction (whole
+        # oldest segments, never the open writer) could not enforce it
+        self.segment_bytes = max(4096, min(segment_bytes, max_bytes // 2))
+        self.on_evict = on_evict  # callback(n_records, reason)
+        self._chaos = chaos
+        self._lock = threading.Lock()
+        self._segments: list[_Segment] = []
+        self._fh = None  # open handle on the newest segment
+        self.stats = {"appended": 0, "replayed": 0, "evicted": 0,
+                      "trimmed": 0, "corrupt": 0, "disk_errors": 0,
+                      "recovered": 0}
+        os.makedirs(self.dir, exist_ok=True)
+        self._recover()
+
+    # -- recovery ------------------------------------------------------------
+
+    def _recover(self) -> None:
+        names = sorted(n for n in os.listdir(self.dir)
+                       if n.startswith(_SEG_PREFIX)
+                       and n.endswith(_SEG_SUFFIX))
+        for name in names:
+            path = os.path.join(self.dir, name)
+            seg = _Segment(path, 0)
+            good_end = 0
+            try:
+                with open(path, "rb") as f:
+                    data = f.read()
+            except OSError:
+                self.stats["disk_errors"] += 1
+                continue
+            off = 0
+            while off + _REC_SIZE <= len(data):
+                ln, crc, _mt, seq = struct.unpack_from(_REC_FMT, data, off)
+                end = off + _REC_SIZE + ln
+                if ln > _MAX_RECORD or end > len(data):
+                    break  # torn tail: a crash mid-append
+                if zlib.crc32(data[off + _REC_SIZE:end]) & 0xFFFFFFFF != crc:
+                    self.stats["corrupt"] += 1
+                    break  # no resync marker: discard the rest
+                if seg.records == 0:
+                    seg.first_seq = seq
+                seg.last_seq = seq
+                seg.records += 1
+                good_end = end
+                off = end
+            if good_end < len(data):
+                try:  # truncate the torn tail so appends stay framed
+                    with open(path, "r+b") as f:
+                        f.truncate(good_end)
+                except OSError:
+                    self.stats["disk_errors"] += 1
+            if seg.records == 0:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                continue
+            seg.bytes = good_end
+            self._segments.append(seg)
+            self.stats["recovered"] += seg.records
+        self._segments.sort(key=lambda s: s.first_seq)
+
+    # -- append --------------------------------------------------------------
+
+    def append(self, msg_type: int, seq: int, payload: bytes) -> bool:
+        """Append one record; False on a disk error (the caller drops and
+        ledgers the frame — the spool never throws on the send path)."""
+        rec = struct.pack(_REC_FMT, len(payload),
+                          zlib.crc32(payload) & 0xFFFFFFFF,
+                          int(msg_type), seq) + payload
+        with self._lock:
+            try:
+                if self._chaos is not None:
+                    self._chaos.on_spool_write()
+                fh = self._writer(len(rec), seq)
+                fh.write(rec)
+                fh.flush()
+            except OSError as e:
+                self.stats["disk_errors"] += 1
+                log.warning("spool append failed: %s", e)
+                return False
+            seg = self._segments[-1]
+            seg.last_seq = seq
+            seg.records += 1
+            seg.bytes += len(rec)
+            self.stats["appended"] += 1
+            self._enforce_cap()
+            return True
+
+    def _writer(self, need: int, seq: int):
+        """Open segment with room for `need` bytes, rotating as needed."""
+        if (self._fh is None or not self._segments
+                or self._segments[-1].bytes + need > self.segment_bytes):
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
+            path = os.path.join(self.dir,
+                                f"{_SEG_PREFIX}{seq:020d}{_SEG_SUFFIX}")
+            self._fh = open(path, "ab")
+            if not self._segments or self._segments[-1].path != path:
+                self._segments.append(_Segment(path, seq))
+        return self._fh
+
+    def _enforce_cap(self) -> None:
+        """Oldest-segment eviction: bounded disk, bounded (visible) loss."""
+        total = sum(s.bytes for s in self._segments)
+        while total > self.max_bytes and len(self._segments) > 1:
+            victim = self._segments.pop(0)
+            total -= victim.bytes
+            self.stats["evicted"] += victim.records
+            try:
+                os.unlink(victim.path)
+            except OSError:
+                self.stats["disk_errors"] += 1
+            if self.on_evict is not None:
+                self.on_evict(victim.records, "spool_evict")
+
+    # -- replay / trim -------------------------------------------------------
+
+    def replay(self, after_seq: int) -> list[tuple[int, int, bytes]]:
+        """All surviving records with seq > after_seq, oldest first, as
+        (msg_type, seq, payload). Corrupt records are skipped+counted."""
+        with self._lock:
+            if self._fh is not None:
+                self._fh.flush()
+            segments = [s for s in self._segments
+                        if s.last_seq > after_seq]
+            paths = [s.path for s in segments]
+        out: list[tuple[int, int, bytes]] = []
+        for path in paths:
+            try:
+                with open(path, "rb") as f:
+                    data = f.read()
+            except OSError:
+                self.stats["disk_errors"] += 1
+                continue
+            off = 0
+            while off + _REC_SIZE <= len(data):
+                ln, crc, mt, seq = struct.unpack_from(_REC_FMT, data, off)
+                end = off + _REC_SIZE + ln
+                if ln > _MAX_RECORD or end > len(data):
+                    break
+                payload = data[off + _REC_SIZE:end]
+                if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+                    self.stats["corrupt"] += 1
+                    break
+                if seq > after_seq:
+                    out.append((mt, seq, payload))
+                off = end
+        self.stats["replayed"] += len(out)
+        return out
+
+    def trim(self, acked_seq: int) -> int:
+        """Delete segments fully covered by the server's ack; returns the
+        number of records released."""
+        released = 0
+        with self._lock:
+            while self._segments and \
+                    self._segments[0].last_seq <= acked_seq:
+                seg = self._segments[0]
+                # never unlink the segment the writer holds open
+                if self._fh is not None and seg is self._segments[-1]:
+                    break
+                self._segments.pop(0)
+                released += seg.records
+                try:
+                    os.unlink(seg.path)
+                except OSError:
+                    self.stats["disk_errors"] += 1
+            self.stats["trimmed"] += released
+        return released
+
+    # -- introspection -------------------------------------------------------
+
+    def max_seq(self) -> int:
+        """Highest seq ever spooled (0 when empty) — lets the sender's
+        flush path know whether unreplayed records remain."""
+        with self._lock:
+            return self._segments[-1].last_seq if self._segments else 0
+
+    def pending_records(self) -> int:
+        with self._lock:
+            return sum(s.records for s in self._segments)
+
+    def pending_bytes(self) -> int:
+        with self._lock:
+            return sum(s.bytes for s in self._segments)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None

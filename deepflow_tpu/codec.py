@@ -9,6 +9,15 @@ Frame layout (big-endian), 18-byte header followed by the payload:
     u32 frame_size | u16 magic 0xDF70 | u8 version | u8 msg_type |
     u16 agent_id | u16 org_id | u16 team_id | u32 crc32(payload)
 
+Version 2 frames carry a u64 ``seq`` extension between the header and
+the payload (frame_size covers it; the crc still covers the payload
+only).  seq is a per-agent monotonically increasing frame counter that
+powers the at-least-once delivery layer: the server acks the highest
+contiguous seq per agent (ACK frames, server->agent on the same TCP
+connection) and decoders dedup retransmits on (agent_id, seq).  v1
+frames (no seq) still decode — they simply ride outside the durable
+window.
+
 frame_size counts the whole frame including the header. Payloads are
 protobuf-encoded batches (ProfileBatch, TpuSpanBatch, ...), optionally
 zlib-compressed (flag bit in version byte).
@@ -23,9 +32,12 @@ from enum import IntEnum
 
 MAGIC = 0xDF70
 VERSION = 1
+VERSION_SEQ = 2       # header followed by a u64 seq extension
 COMPRESS_FLAG = 0x80  # or-ed into the version byte when payload is zlib'd
 HEADER_FMT = ">IHBBHHHI"
 HEADER_SIZE = struct.calcsize(HEADER_FMT)  # 18
+SEQ_EXT_FMT = ">Q"
+SEQ_EXT_SIZE = struct.calcsize(SEQ_EXT_FMT)  # 8
 MAX_FRAME_SIZE = 64 << 20
 
 
@@ -45,6 +57,36 @@ class MessageType(IntEnum):
     PCAP = 11            # on-demand capture uploads (pcap policy)
     SHARD_RESULT = 12    # cluster scatter-gather shard responses
     STEP_METRICS = 13    # per-(run_id, step) rollups -> tpu_step_metrics
+    ACK = 14             # server->agent: highest contiguous seq received
+
+
+# -- delivery priority classes ----------------------------------------------
+# Under queue/spool pressure the sender sheds by CLASS, lowest first:
+# self-monitoring is reconstructible (counters re-ship on the next tick),
+# rollup metrics can tolerate holes, but flow/trace/step data is exactly
+# what completeness-sensitive analyses (DeepProf-style pattern mining)
+# need intact — it is shed last, and spools to disk instead when a spool
+# is configured.
+PRIORITY_HIGH = 0   # never shed: spool or block-drop with accounting
+PRIORITY_MID = 1    # shed after LOW is exhausted
+PRIORITY_LOW = 2    # shed first
+
+_PRIORITY = {
+    MessageType.DFSTATS: PRIORITY_LOW,
+    MessageType.PCAP: PRIORITY_LOW,
+    MessageType.ACK: PRIORITY_LOW,
+    MessageType.METRICS: PRIORITY_MID,
+    MessageType.EVENT: PRIORITY_MID,
+    MessageType.OTEL: PRIORITY_MID,
+    MessageType.PROMETHEUS: PRIORITY_MID,
+    MessageType.APP_LOG: PRIORITY_MID,
+    MessageType.SHARD_RESULT: PRIORITY_MID,
+}
+
+
+def priority_of(msg_type: MessageType) -> int:
+    """Shed class for a message type (HIGH unless registered lower)."""
+    return _PRIORITY.get(msg_type, PRIORITY_HIGH)
 
 
 @dataclass(frozen=True)
@@ -54,24 +96,41 @@ class FrameHeader:
     org_id: int = 0
     team_id: int = 0
     compressed: bool = False
+    seq: int | None = None  # per-agent frame counter (v2 extension)
 
 
 def encode_frame(header: FrameHeader, payload: bytes, compress: bool | None = None) -> bytes:
-    """Encode one frame. If compress is None, compress payloads > 512B."""
+    """Encode one frame. If compress is None, compress payloads > 512B.
+    Headers carrying a seq produce v2 frames; seq-less headers produce
+    byte-identical v1 frames (old decoders keep working)."""
     if compress is None:
         compress = len(payload) > 512
     if compress:
         payload = zlib.compress(payload, 1)
-    ver = VERSION | (COMPRESS_FLAG if compress else 0)
+    base_ver = VERSION if header.seq is None else VERSION_SEQ
+    ver = base_ver | (COMPRESS_FLAG if compress else 0)
     crc = zlib.crc32(payload) & 0xFFFFFFFF
-    size = HEADER_SIZE + len(payload)
+    ext = b"" if header.seq is None else struct.pack(SEQ_EXT_FMT, header.seq)
+    size = HEADER_SIZE + len(ext) + len(payload)
     if size > MAX_FRAME_SIZE:
         raise ValueError(f"frame too large: {size}")
     hdr = struct.pack(
         HEADER_FMT, size, MAGIC, ver, int(header.msg_type),
         header.agent_id, header.org_id, header.team_id, crc,
     )
-    return hdr + payload
+    return hdr + ext + payload
+
+
+def encode_ack(agent_id: int, seq: int) -> bytes:
+    """Server->agent ACK: highest contiguous seq received for agent_id."""
+    return encode_frame(FrameHeader(MessageType.ACK, agent_id=agent_id),
+                        struct.pack(SEQ_EXT_FMT, seq), compress=False)
+
+
+def decode_ack(payload: bytes) -> int:
+    if len(payload) < SEQ_EXT_SIZE:
+        raise FrameDecodeError("short ACK payload")
+    return struct.unpack_from(SEQ_EXT_FMT, payload)[0]
 
 
 class FrameDecodeError(Exception):
@@ -94,12 +153,20 @@ def decode_frame(buf: bytes | memoryview) -> tuple[FrameHeader, bytes, int]:
         raise FrameDecodeError(f"bad frame size {size}")
     if len(buf) < size:
         return None, b"", 0  # type: ignore[return-value]
-    payload = bytes(buf[HEADER_SIZE:size])
+    compressed = bool(ver & COMPRESS_FLAG)
+    base_ver = ver & ~COMPRESS_FLAG
+    seq: int | None = None
+    body_off = HEADER_SIZE
+    if base_ver == VERSION_SEQ:
+        if size < HEADER_SIZE + SEQ_EXT_SIZE:
+            raise FrameDecodeError(f"bad v2 frame size {size}")
+        seq = struct.unpack_from(SEQ_EXT_FMT, buf, HEADER_SIZE)[0]
+        body_off += SEQ_EXT_SIZE
+    elif base_ver != VERSION:
+        raise FrameDecodeError(f"bad version {ver}")
+    payload = bytes(buf[body_off:size])
     if zlib.crc32(payload) & 0xFFFFFFFF != crc:
         raise FrameDecodeError("crc mismatch")
-    compressed = bool(ver & COMPRESS_FLAG)
-    if (ver & ~COMPRESS_FLAG) != VERSION:
-        raise FrameDecodeError(f"bad version {ver}")
     if compressed:
         payload = zlib.decompress(payload)
     try:
@@ -108,7 +175,7 @@ def decode_frame(buf: bytes | memoryview) -> tuple[FrameHeader, bytes, int]:
         raise FrameDecodeError(f"unknown message type {mtype}") from None
     header = FrameHeader(
         msg_type=msg_type, agent_id=agent_id, org_id=org_id,
-        team_id=team_id, compressed=compressed)
+        team_id=team_id, compressed=compressed, seq=seq)
     return header, payload, size
 
 

@@ -28,6 +28,43 @@ from deepflow_tpu.server.platform_info import PlatformInfoTable
 log = logging.getLogger("df.decoder")
 
 
+class DedupWindow:
+    """Bounded LRU of seen ``(agent_id, seq)`` pairs + per-agent floors.
+
+    The at-least-once transport retransmits frames the server may
+    already hold (unacked window replay after a reconnect, spool replay
+    racing an in-flight ack); this window is what turns at-least-once
+    frames into exactly-once rows.  A ``floor`` marks every seq at or
+    below it as seen — restored from persisted ack state on restart so
+    retransmits of pre-restart frames dedup even though the LRU is
+    empty.  One window is shared by ALL decoders (seq space is
+    per-agent, not per-type) and workers, hence the lock."""
+
+    def __init__(self, capacity: int = 65536,
+                 floors: dict[int, int] | None = None) -> None:
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._seen: dict[tuple[int, int], None] = {}  # insertion-ordered
+        self._floors: dict[int, int] = dict(floors or {})
+        self.stats = {"dups": 0, "tracked": 0}
+
+    def seen(self, agent_id: int, seq: int) -> bool:
+        """Mark (agent, seq); True if it was already marked (a dup)."""
+        key = (agent_id, seq)
+        with self._lock:
+            if seq <= self._floors.get(agent_id, 0):
+                self.stats["dups"] += 1
+                return True
+            if key in self._seen:
+                self.stats["dups"] += 1
+                return True
+            self._seen[key] = None
+            self.stats["tracked"] += 1
+            while len(self._seen) > self.capacity:
+                self._seen.pop(next(iter(self._seen)))
+            return False
+
+
 class Decoder:
     """Base: drain one queue, decode, write. Subclasses set MSG_TYPE."""
 
@@ -44,7 +81,7 @@ class Decoder:
                  platform: PlatformInfoTable, exporters=None,
                  pod_index=None, gpid_table=None,
                  workers: int | None = None, resources=None,
-                 trace_trees=None, telemetry=None) -> None:
+                 trace_trees=None, telemetry=None, dedup=None) -> None:
         self.q = q
         self.db = db
         self.platform = platform
@@ -53,6 +90,7 @@ class Decoder:
         self.resources = resources  # ResourceIndex: ip -> universal tags
         self.trace_trees = trace_trees  # TraceTreeBuilder (optional)
         self.gpid_table = gpid_table  # controller GpidAllocator (optional)
+        self.dedup = dedup  # shared DedupWindow (optional): retransmit guard
         self.workers = workers if workers is not None else self.WORKERS
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
@@ -60,7 +98,7 @@ class Decoder:
         # handle_ns: total wall time inside handle(); append_ns: the part
         # spent in store appends (handle_ns - append_ns = pure decode).
         # Exposed so the ingest bench can localize regressions per stage.
-        self.stats = {"batches": 0, "rows": 0, "errors": 0,
+        self.stats = {"batches": 0, "rows": 0, "errors": 0, "dups": 0,
                       "handle_ns": 0, "append_ns": 0}
         if telemetry is None:
             from deepflow_tpu.telemetry import Telemetry
@@ -87,6 +125,48 @@ class Decoder:
         for t in self._threads:
             t.join(timeout=2.0)
         self._threads = []
+        if self._hop is None:
+            return  # never started: nothing accepted, nothing to drain
+        # drain what's still queued: frames here were ACCEPTED (and, on
+        # the durable path, acked) — exiting with a non-empty queue
+        # would lose them on every restart even though the agent was
+        # told not to retransmit
+        drained = []
+        while True:
+            try:
+                drained.extend(self._unwrap(self.q.get_nowait()))
+            except queue.Empty:
+                break
+        if drained:
+            self._handle_items(drained)
+
+    def _handle_items(self, items: list) -> None:
+        """Decode+write a list of (header, payload); shared by the worker
+        loop and the shutdown drain."""
+        batches = rows = errors = dups = 0
+        t0 = time.perf_counter_ns()
+        for header, payload in items:
+            if (self.dedup is not None and header.seq is not None
+                    and self.dedup.seen(header.agent_id, header.seq)):
+                dups += 1
+                continue
+            try:
+                rows += self.handle(header, payload)
+                batches += 1
+            except Exception:
+                errors += 1
+                log.exception("decode error (%s)", self.MSG_TYPE.name)
+        dt = time.perf_counter_ns() - t0
+        if dups:
+            self._hop.account(dropped=dups, reason="dup")
+        self._hop.account(delivered=batches, dropped=errors,
+                          reason="decode_error" if errors else "")
+        with self._stats_lock:
+            self.stats["batches"] += batches
+            self.stats["rows"] += rows
+            self.stats["errors"] += errors
+            self.stats["dups"] += dups
+            self.stats["handle_ns"] += dt
 
     DRAIN_FRAMES = 64  # max frames one worker consumes per wakeup
 
@@ -122,24 +202,8 @@ class Decoder:
                     items = items + self._unwrap(self.q.get_nowait())
                 except queue.Empty:
                     break
-            batches = rows = errors = 0
-            t0 = time.perf_counter_ns()
-            for header, payload in items:
-                try:
-                    rows += self.handle(header, payload)
-                    batches += 1
-                except Exception:
-                    errors += 1
-                    log.exception("decode error (%s)", self.MSG_TYPE.name)
-            dt = time.perf_counter_ns() - t0
             handled += len(items)
-            self._hop.account(delivered=batches, dropped=errors,
-                              reason="decode_error" if errors else "")
-            with self._stats_lock:
-                self.stats["batches"] += batches
-                self.stats["rows"] += rows
-                self.stats["errors"] += errors
-                self.stats["handle_ns"] += dt
+            self._handle_items(items)
 
     def handle(self, header: FrameHeader, payload: bytes) -> int:
         raise NotImplementedError

@@ -3,6 +3,14 @@
 Reference analog: server/libs/receiver/receiver.go:424 (NewReceiver) and
 :448 (RegistHandler) — one listener, a registry of per-message-type queues,
 decoders consume from their queue.
+
+Durable-delivery additions (this port's transport is loss-bounded, the
+reference's is not): v2 frames carry a per-agent ``seq``; the receiver
+tracks the highest contiguous seq per agent (``SeqAckTracker``) and
+periodically writes ACK frames back down each TCP connection, which is
+what lets the agent trim its retransmit window and disk spool.  A frame
+that fails to enqueue on a full decoder queue is NOT acked — the agent
+retransmits it later, turning what used to be silent loss into a retry.
 """
 
 from __future__ import annotations
@@ -15,9 +23,71 @@ import threading
 import time
 
 from deepflow_tpu.codec import (
-    FrameDecodeError, FrameHeader, MessageType, StreamDecoder, decode_frame)
+    FrameDecodeError, FrameHeader, MessageType, StreamDecoder, decode_frame,
+    encode_ack)
 
 log = logging.getLogger("df.receiver")
+
+
+class SeqAckTracker:
+    """Per-agent highest-contiguous-seq bookkeeping.
+
+    ``observe()`` is called for every accepted v2 frame; ``contiguous()``
+    is what gets acked.  Out-of-order seqs (spool replay interleaving
+    with live traffic) park in a bounded set until the gap fills; if the
+    set overflows, the gap is declared permanent (the missing frame was
+    dropped WITH ledger accounting somewhere) and the window jumps —
+    liveness over completeness, but never silently: the drop that made
+    the hole is already on a ledger."""
+
+    MAX_OOS = 4096
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # agent_id -> [contiguous_seq, out_of_order_set]
+        self._state: dict[int, list] = {}
+
+    def seed(self, agent_id: int, contiguous: int) -> None:
+        """Restore persisted ack state (server restart with data_dir)."""
+        with self._lock:
+            st = self._state.get(agent_id)
+            if st is None or contiguous > st[0]:
+                self._state[agent_id] = [contiguous, set()]
+
+    def observe(self, agent_id: int, seq: int) -> None:
+        with self._lock:
+            st = self._state.get(agent_id)
+            if st is None:
+                # first frame this server lifetime anchors the window
+                self._state[agent_id] = [seq, set()]
+                return
+            contig, oos = st
+            if seq <= contig:
+                return  # dup/old
+            if seq == contig + 1:
+                contig += 1
+                while contig + 1 in oos:
+                    contig += 1
+                    oos.discard(contig)
+                st[0] = contig
+                return
+            oos.add(seq)
+            if len(oos) > self.MAX_OOS:
+                contig = min(oos)
+                oos.discard(contig)
+                while contig + 1 in oos:
+                    contig += 1
+                    oos.discard(contig)
+                st[0] = contig
+
+    def contiguous(self, agent_id: int) -> int | None:
+        with self._lock:
+            st = self._state.get(agent_id)
+            return st[0] if st is not None else None
+
+    def snapshot(self) -> dict[int, int]:
+        with self._lock:
+            return {a: st[0] for a, st in self._state.items()}
 
 
 class Receiver:
@@ -25,7 +95,8 @@ class Receiver:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 20033,
                  queue_size: int = 4096, enable_udp: bool = True,
-                 telemetry=None) -> None:
+                 telemetry=None, ack_enabled: bool = True,
+                 chaos=None) -> None:
         self.host = host
         self.port = port
         self._queues: dict[MessageType, queue.Queue] = {}
@@ -33,9 +104,23 @@ class Receiver:
         self._tcp: socketserver.ThreadingTCPServer | None = None
         self._udp_sock: socket.socket | None = None
         self._threads: list[threading.Thread] = []
+        # live handler (thread, socket) pairs: stop() must be able to
+        # force daemon handlers out and WAIT for them, or a handler can
+        # enqueue a frame after the decoders drained — observed (acked)
+        # but never written
+        self._handlers_lock = threading.Lock()
+        self._handlers: dict[threading.Thread, socket.socket] = {}
+        self._stopping = False
         self._enable_udp = enable_udp
+        self.ack_enabled = ack_enabled
+        self.seq_tracker = SeqAckTracker()
+        if chaos is None:
+            from deepflow_tpu.chaos import chaos_from_env
+            chaos = chaos_from_env()
+        self._chaos = chaos
         self.stats = {"frames": 0, "bytes": 0, "dropped": 0, "bad_frames": 0,
-                      "connections": 0}
+                      "connections": 0, "acks_sent": 0,
+                      "udp_trailing_garbage": 0}
         if telemetry is None:
             from deepflow_tpu.telemetry import Telemetry
             telemetry = Telemetry("server", enabled=False)
@@ -49,6 +134,11 @@ class Receiver:
             self._queues[msg_type] = q
         return q
 
+    def _observe_seqs(self, frames: list[tuple[FrameHeader, bytes]]) -> None:
+        for header, _ in frames:
+            if header.seq is not None:
+                self.seq_tracker.observe(header.agent_id, header.seq)
+
     def _dispatch(self, header: FrameHeader, payload: bytes) -> None:
         """Hand one frame to its decoder queue (UDP path: one frame per
         datagram). Queue items are (enqueue_ns, LIST of (header, payload))
@@ -61,12 +151,17 @@ class Receiver:
         if q is None:
             self.stats["dropped"] += 1
             self._hop.account(dropped=1, reason="no_handler")
+            # acked anyway: "no decoder registered" is policy, not
+            # pressure — a retransmit would meet the same fate
+            self._observe_seqs([(header, payload)])
             return
         try:
             q.put_nowait((time.monotonic_ns(), [(header, payload)]))
             self._hop.account(delivered=1)
+            self._observe_seqs([(header, payload)])
         except queue.Full:
-            # backpressure stance: drop newest, count it (reference drops too)
+            # backpressure stance: drop newest, count it — and WITHHOLD
+            # the ack so a durable sender retransmits it later
             self.stats["dropped"] += 1
             self._hop.account(dropped=1, reason="queue_full")
 
@@ -90,16 +185,34 @@ class Receiver:
             if q is None:
                 self.stats["dropped"] += len(group)
                 self._hop.account(dropped=len(group), reason="no_handler")
+                self._observe_seqs(group)
                 continue
             try:
                 q.put_nowait((enq_ns, group))
                 self._hop.account(delivered=len(group))
+                self._observe_seqs(group)
             except queue.Full:
-                # backpressure stance: drop newest, count it
+                # backpressure stance: drop newest, count it; the ack is
+                # withheld so the durable sender retransmits the group
                 self.stats["dropped"] += len(group)
                 self._hop.account(dropped=len(group), reason="queue_full")
 
     # -- TCP -----------------------------------------------------------------
+
+    def _send_acks(self, sock, agents: set[int],
+                   last_sent: dict[int, int]) -> None:
+        """Write one ACK frame per agent seen on this connection (only
+        when the contiguous watermark moved)."""
+        for agent_id in agents:
+            contig = self.seq_tracker.contiguous(agent_id)
+            if contig is None or last_sent.get(agent_id) == contig:
+                continue
+            try:
+                sock.sendall(encode_ack(agent_id, contig))
+                last_sent[agent_id] = contig
+                self.stats["acks_sent"] += 1
+            except OSError:
+                return  # peer gone; the read path will notice and close
 
     def start(self) -> "Receiver":
         recv = self
@@ -107,24 +220,63 @@ class Receiver:
         class Handler(socketserver.BaseRequestHandler):
             def handle(self) -> None:
                 recv.stats["connections"] += 1
-                dec = StreamDecoder()
                 sock = self.request
-                sock.settimeout(60.0)
-                while True:
+                with recv._handlers_lock:
+                    if recv._stopping:
+                        return
+                    recv._handlers[threading.current_thread()] = sock
+                try:
+                    self._serve(sock)
+                finally:
+                    with recv._handlers_lock:
+                        recv._handlers.pop(threading.current_thread(),
+                                           None)
+
+            def _serve(self, sock) -> None:
+                if recv._chaos is not None:
+                    recv._chaos.on_accept()
+                dec = StreamDecoder()
+                # short read timeout: the ack writer needs to run even
+                # when the peer is quiet; idle_deadline preserves the
+                # old 60s dead-connection reap
+                sock.settimeout(0.5)
+                agents: set[int] = set()
+                acks_sent: dict[int, int] = {}
+                idle_deadline = time.monotonic() + 60.0
+                while not recv._stopping:
                     try:
                         data = sock.recv(256 << 10)
-                    except (socket.timeout, OSError):
+                    except socket.timeout:
+                        if time.monotonic() > idle_deadline:
+                            return
+                        if recv.ack_enabled:
+                            recv._send_acks(sock, agents, acks_sent)
+                        continue
+                    except OSError:
                         return
                     if not data:
                         return
+                    idle_deadline = time.monotonic() + 60.0
                     try:
                         frames = list(dec.feed(data))
                         if frames:
                             recv._dispatch_many(frames)
+                            for h, _ in frames:
+                                if h.seq is not None:
+                                    agents.add(h.agent_id)
                     except FrameDecodeError as e:
                         recv.stats["bad_frames"] += 1
+                        recv._hop.account(emitted=1, dropped=1,
+                                          reason="bad_frame")
                         log.warning("dropping connection: %s", e)
                         return
+                    # ack EAGERLY (the moved-watermark check inside
+                    # rate-limits): under fault injection a connection
+                    # may only live a few ms, and an interval-gated ack
+                    # never fires — the sender's retransmit window then
+                    # never trims and every reconnect resends it all
+                    if recv.ack_enabled:
+                        recv._send_acks(sock, agents, acks_sent)
 
         # NOT beaten here: the first beat records the owning thread's
         # ident for stack snapshots, and that must be the serve loop
@@ -169,21 +321,50 @@ class Receiver:
                 try:
                     header, payload, consumed = decode_frame(data)
                     if consumed:
+                        if consumed < len(data):
+                            # a datagram is ONE frame: trailing bytes are
+                            # garbage — count them instead of silently
+                            # ignoring, but keep the good frame
+                            self.stats["bad_frames"] += 1
+                            self.stats["udp_trailing_garbage"] += 1
+                            self._hop.account(emitted=1, dropped=1,
+                                              reason="udp_trailing_garbage")
                         self._dispatch(header, payload)
                     else:
+                        # truncated datagram: header said more bytes than
+                        # arrived
                         self.stats["bad_frames"] += 1
+                        self._hop.account(emitted=1, dropped=1,
+                                          reason="bad_frame")
                 except FrameDecodeError:
                     self.stats["bad_frames"] += 1
+                    self._hop.account(emitted=1, dropped=1,
+                                      reason="bad_frame")
 
         t = threading.Thread(target=run, name="df-receiver-udp", daemon=True)
         t.start()
         self._threads.append(t)
 
     def stop(self) -> None:
+        # order matters: no new handlers, kick live ones off their
+        # sockets, then WAIT for them — only after that is it safe for
+        # the caller to drain decoder queues and snapshot ack state
+        # (a handler that dispatched after the drain would leave an
+        # acked frame that never reached a table)
+        with self._handlers_lock:
+            self._stopping = True
+            live = list(self._handlers.items())
         if self._tcp:
             self._tcp.shutdown()
             self._tcp.server_close()
             self._tcp = None
+        for _, sock in live:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        for t, _ in live:
+            t.join(timeout=2.0)
         if self._udp_sock:
             s, self._udp_sock = self._udp_sock, None
             s.close()

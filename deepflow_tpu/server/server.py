@@ -85,6 +85,7 @@ class Server:
         self.receiver = Receiver(host=host, port=ingest_port,
                                  telemetry=self.telemetry)
         self.decoders = []
+        self.dedup = None  # shared DedupWindow, built in start()
         self.controller = None
         if enable_controller:
             try:
@@ -180,9 +181,52 @@ class Server:
             self.db.table("deepflow_system.deepflow_system") \
                 .append_rows(rows)
 
+    def _ack_state_path(self) -> str | None:
+        import os
+        return (os.path.join(self.db.data_dir, "ack_state.json")
+                if self.db.data_dir else None)
+
+    def _load_ack_state(self) -> dict[int, int]:
+        """Persisted per-agent contiguous-seq watermarks. Seeding BOTH the
+        receiver's ack tracker and the decoders' dedup floors is what
+        makes retransmits of pre-restart frames exactly-once: the rows
+        are already in the (persisted) tables."""
+        path = self._ack_state_path()
+        if not path:
+            return {}
+        import os
+        if not os.path.exists(path):
+            return {}
+        try:
+            with open(path) as f:
+                raw = json.load(f)
+            return {int(k): int(v) for k, v in raw.items()}
+        except (OSError, ValueError):
+            log.warning("ack state unreadable; starting fresh", exc_info=True)
+            return {}
+
+    def _save_ack_state(self) -> None:
+        path = self._ack_state_path()
+        if not path:
+            return
+        try:
+            with open(path, "w") as f:
+                json.dump({str(k): v for k, v in
+                           self.receiver.seq_tracker.snapshot().items()}, f)
+        except OSError:
+            log.warning("ack state save failed", exc_info=True)
+
     def start(self) -> "Server":
         if self.db.data_dir:
             self.db.load()  # resume persisted tables
+        floors = self._load_ack_state()
+        for agent_id, contig in floors.items():
+            self.receiver.seq_tracker.seed(agent_id, contig)
+        from deepflow_tpu.server.decoders import DedupWindow
+        # ONE window shared by every decoder/worker: seq space is
+        # per-agent, and a retransmit must dedup no matter which decoder
+        # type it lands on
+        self.dedup = DedupWindow(floors=floors)
         # register all queues BEFORE listening: no drop window on restart
         from deepflow_tpu.server.decoders import PcapDecoder
         pairs = [
@@ -205,7 +249,7 @@ class Server:
                     pod_index=self.pod_index, resources=self.resources,
                     gpid_table=(self.controller.gpids
                                 if self.controller else None),
-                    telemetry=self.telemetry, **kw)
+                    telemetry=self.telemetry, dedup=self.dedup, **kw)
             d.MSG_TYPE = mtype  # FlowLogDecoder serves two types
             self.decoders.append(d.start())
         self.receiver.start()
@@ -306,11 +350,16 @@ class Server:
             self._selfstats_thread = None
         self.receiver.stop()
         for d in self.decoders:
-            d.stop()
+            d.stop()  # joins workers, then drains the queue: acked
+            # frames must reach the tables before the db persists
             if hasattr(d, "flush"):
                 d.flush()  # stateful reducers drain pending windows
                 # BEFORE the db persists (the file_agg tail otherwise
                 # vanishes on every restart)
+        # persist ack watermarks AFTER the drain: every acked frame is
+        # now in a table, so seeding dedup floors from this state on the
+        # next start cannot mask an undecoded frame
+        self._save_ack_state()
         self.http.stop()
         self._stop_singletons()
         self.alerts.stop()

@@ -1,0 +1,420 @@
+"""Loss-bounded transport: spool, seq/ACK, dedup, priority shedding, chaos.
+
+Every test here is about one claim: a frame handed to the durable sender
+either lands in a server table exactly once, or its loss is accounted on
+a ledger with a named reason — across queue overflow, connection faults,
+and a full server kill-and-recover.
+"""
+
+import os
+import socket
+import struct
+import tempfile
+import time
+
+import pytest
+
+from deepflow_tpu.codec import (
+    PRIORITY_HIGH, PRIORITY_LOW, PRIORITY_MID, FrameHeader, MessageType,
+    decode_ack, decode_frame, encode_ack, encode_frame, priority_of)
+from deepflow_tpu.proto import pb
+from deepflow_tpu.server import Server
+from deepflow_tpu.telemetry import Telemetry
+
+MS = 1_000_000
+
+
+@pytest.fixture
+def server():
+    s = Server(host="127.0.0.1", ingest_port=0, query_port=0).start()
+    yield s
+    s.stop()
+
+
+def _event_payload(name: str = "x") -> bytes:
+    batch = pb.EventBatch()
+    e = batch.events.add()
+    e.event_type = "chaos-test"
+    e.resource_name = name
+    e.timestamp_ns = time.time_ns()
+    return batch.SerializeToString()
+
+
+def _step_payload(i: int) -> bytes:
+    from deepflow_tpu.tpuprobe.stepmetrics import encode_step_payload
+    return encode_step_payload([{
+        "time": i * MS, "end_ns": i * MS + 500, "latency_ns": 500,
+        "run_id": 3, "step": i, "job": "t", "device_count": 4,
+        "device_skew_ns": 0, "compute_ns": 1, "collective_ns": 1,
+        "straggler_device": 0, "straggler_lag_ns": 0, "top_hlos": []}])
+
+
+def _stats_payload() -> bytes:
+    batch = pb.StatsBatch()
+    m = batch.metrics.add()
+    m.name = "noise"
+    m.timestamp_ns = time.time_ns()
+    m.values["v"] = 1.0
+    return batch.SerializeToString()
+
+
+def _ledger(telemetry, hop_name):
+    for h in telemetry.snapshot()["pipeline"]:
+        if h["hop"] == hop_name:
+            return h
+    raise AssertionError(f"no hop {hop_name!r}")
+
+
+def _assert_balanced(h):
+    assert h["emitted"] == h["delivered"] + h["dropped_total"] \
+        + h["in_flight"], h
+
+
+# -- codec: v2 seq extension + ACK frames -------------------------------------
+
+def test_codec_v2_roundtrip_and_v1_backcompat():
+    v2 = encode_frame(
+        FrameHeader(MessageType.L7_LOG, agent_id=7, seq=123456789), b"pay")
+    h, p, consumed = decode_frame(v2)
+    assert (h.seq, h.agent_id, p, consumed) == (123456789, 7, b"pay", len(v2))
+
+    v1 = encode_frame(FrameHeader(MessageType.L7_LOG, agent_id=7), b"pay")
+    h1, p1, _ = decode_frame(v1)
+    assert h1.seq is None and p1 == b"pay"
+    # a seq-less header must produce a byte-identical v1 frame: old
+    # decoders keep working, and the wire only changes when seq is used
+    assert v1[6] == 1 and v2[6] == 2
+
+
+def test_codec_v2_compressed_carries_seq():
+    big = b"z" * 4096  # above the compress threshold
+    frame = encode_frame(
+        FrameHeader(MessageType.PROFILE, agent_id=2, seq=99), big)
+    assert len(frame) < len(big)
+    h, p, _ = decode_frame(frame)
+    assert h.seq == 99 and h.compressed and p == big
+
+
+def test_ack_frame_roundtrip():
+    h, payload, _ = decode_frame(encode_ack(12, 3456))
+    assert h.msg_type == MessageType.ACK
+    assert h.agent_id == 12
+    assert decode_ack(payload) == 3456
+
+
+def test_priority_classes():
+    assert priority_of(MessageType.STEP_METRICS) == PRIORITY_HIGH
+    assert priority_of(MessageType.L7_LOG) == PRIORITY_HIGH
+    assert priority_of(MessageType.METRICS) == PRIORITY_MID
+    assert priority_of(MessageType.DFSTATS) == PRIORITY_LOW
+
+
+# -- spool: segmented on-disk overflow ----------------------------------------
+
+def test_spool_spill_replay_trim(tmp_path):
+    from deepflow_tpu.agent.spool import Spool
+    sp = Spool(str(tmp_path), max_bytes=1 << 20, segment_bytes=32 << 10)
+    for i in range(1, 201):
+        assert sp.append(int(MessageType.L7_LOG), i, b"p" * 64)
+    assert sp.pending_records() == 200
+    assert [s for _, s, _ in sp.replay(150)] == list(range(151, 201))
+    sp.trim(199)
+    sp.close()
+    # a fresh Spool over the same dir recovers what was not trimmed
+    sp2 = Spool(str(tmp_path), max_bytes=1 << 20, segment_bytes=32 << 10)
+    assert all(s > 150 for _, s, _ in sp2.replay(150))
+    assert sp2.max_seq() == 200
+    sp2.close()
+
+
+def test_spool_evicts_oldest_segment_at_cap(tmp_path):
+    from deepflow_tpu.agent.spool import Spool
+    evicted = []
+    sp = Spool(str(tmp_path), max_bytes=8 << 10, segment_bytes=2 << 10,
+               on_evict=lambda n, reason: evicted.append((n, reason)))
+    for i in range(1, 501):
+        sp.append(int(MessageType.L7_LOG), i, b"p" * 64)
+    assert sp.pending_bytes() <= 8 << 10
+    assert evicted and all(r == "spool_evict" for _, r in evicted)
+    # the survivors are the NEWEST records
+    seqs = [s for _, s, _ in sp.replay(0)]
+    assert seqs == sorted(seqs) and seqs[-1] == 500
+    assert sp.stats["evicted"] == sum(n for n, _ in evicted)
+    sp.close()
+
+
+def test_spool_recovers_through_torn_tail(tmp_path):
+    from deepflow_tpu.agent.spool import Spool
+    sp = Spool(str(tmp_path))
+    for i in range(1, 11):
+        sp.append(int(MessageType.L7_LOG), i, b"q" * 32)
+    sp.close()
+    seg = sorted(os.listdir(tmp_path))[-1]
+    path = os.path.join(str(tmp_path), seg)
+    with open(path, "r+b") as f:  # tear the last record mid-payload
+        f.truncate(os.path.getsize(path) - 7)
+    sp2 = Spool(str(tmp_path))
+    seqs = [s for _, s, _ in sp2.replay(0)]
+    assert seqs == list(range(1, 10))  # record 10 gone, 1..9 intact
+    sp2.close()
+
+
+# -- receiver: SeqAckTracker ---------------------------------------------------
+
+def test_seq_tracker_contiguous_and_out_of_order():
+    from deepflow_tpu.server.receiver import SeqAckTracker
+    t = SeqAckTracker()
+    t.observe(1, 1)
+    t.observe(1, 2)
+    assert t.contiguous(1) == 2
+    t.observe(1, 5)          # gap: 3,4 missing
+    assert t.contiguous(1) == 2
+    t.observe(1, 4)
+    t.observe(1, 3)          # gap fills -> window absorbs the parked oos
+    assert t.contiguous(1) == 5
+    t.observe(1, 2)          # stale dup: no effect
+    assert t.contiguous(1) == 5
+    assert t.contiguous(2) is None
+
+
+def test_seq_tracker_gap_jump_on_oos_overflow():
+    from deepflow_tpu.server.receiver import SeqAckTracker
+    t = SeqAckTracker()
+    t.observe(1, 1)
+    # seq 2 never arrives (it was dropped WITH accounting); the window
+    # must not stall forever behind it
+    for s in range(3, 3 + SeqAckTracker.MAX_OOS + 1):
+        t.observe(1, s)
+    assert t.contiguous(1) >= 3
+
+
+def test_seq_tracker_seed_floor():
+    from deepflow_tpu.server.receiver import SeqAckTracker
+    t = SeqAckTracker()
+    t.seed(1, 100)
+    t.observe(1, 101)
+    assert t.contiguous(1) == 101
+
+
+# -- decoders: dedup window ----------------------------------------------------
+
+def test_dedup_window_lru_and_floors():
+    from deepflow_tpu.server.decoders import DedupWindow
+    w = DedupWindow(capacity=4, floors={1: 10})
+    assert w.seen(1, 10)            # at/under the floor: dup
+    assert not w.seen(1, 11)
+    assert w.seen(1, 11)            # second sight: dup
+    for s in range(12, 17):         # push 11 out of the LRU
+        assert not w.seen(2, s)
+    assert not w.seen(1, 11)        # evicted -> no longer remembered
+    assert w.stats["dups"] == 2
+
+
+def test_dedup_under_forced_retransmit(server):
+    """The same v2 frame written twice (a retransmit whose original DID
+    land) must produce ONE row, with the dup accounted dropped(dup)."""
+    frame = encode_frame(
+        FrameHeader(MessageType.EVENT, agent_id=4, seq=1),
+        _event_payload("once"))
+    s = socket.create_connection(("127.0.0.1", server.ingest_port))
+    s.sendall(frame)
+    s.sendall(frame)
+    s.close()
+    assert server.wait_for_rows("event.event", 1)
+    dec = next(d for d in server.decoders
+               if d.MSG_TYPE == MessageType.EVENT)
+    deadline = time.time() + 5
+    while time.time() < deadline and dec.stats["dups"] < 1:
+        time.sleep(0.02)
+    assert dec.stats["dups"] == 1
+    assert len(server.db.table("event.event")) == 1
+    h = _ledger(server.telemetry, "decoder.EVENT")
+    assert h["dropped"].get("dup") == 1
+    _assert_balanced(h)
+
+
+def test_receiver_acks_flow_back(server):
+    """A raw v2 writer must get ACK frames back on the same socket."""
+    s = socket.create_connection(("127.0.0.1", server.ingest_port))
+    for seq in range(1, 6):
+        s.sendall(encode_frame(
+            FrameHeader(MessageType.EVENT, agent_id=6, seq=seq),
+            _event_payload(f"e{seq}")))
+    s.settimeout(5.0)
+    buf = b""
+    acked = 0
+    while acked < 5:
+        buf += s.recv(4096)
+        # drain EVERY complete frame before reading again: one recv can
+        # carry several concatenated ACKs
+        while True:
+            h, payload, consumed = decode_frame(buf)
+            if not consumed:
+                break
+            assert h.msg_type == MessageType.ACK and h.agent_id == 6
+            acked = decode_ack(payload)
+            buf = buf[consumed:]
+    s.close()
+    assert acked == 5
+
+
+def test_v1_sender_gets_no_acks(server):
+    """Seq-less (v1) writers must NOT be sent ACK frames: a pre-ACK
+    peer would see them as garbage on a previously write-only socket."""
+    s = socket.create_connection(("127.0.0.1", server.ingest_port))
+    s.sendall(encode_frame(FrameHeader(MessageType.EVENT, agent_id=6),
+                           _event_payload()))
+    assert server.wait_for_rows("event.event", 1)
+    time.sleep(0.3)
+    s.settimeout(0.2)
+    with pytest.raises(socket.timeout):
+        s.recv(1)
+    s.close()
+
+
+# -- sender: failover, spool spill/replay, ack trim, shedding -----------------
+
+def test_sender_failover_dead_then_live(server):
+    """In-flight frames must survive the dead first server (satellite:
+    the old sender counted an in-flight OSError frame as dropped)."""
+    from deepflow_tpu.agent.sender import UniformSender
+    tel = Telemetry("agent", enabled=True)
+    sender = UniformSender(
+        [("127.0.0.1", 1), ("127.0.0.1", server.ingest_port)],
+        agent_id=9, telemetry=tel).start()
+    for i in range(20):
+        assert sender.send(MessageType.EVENT, _event_payload(f"e{i}"))
+    assert server.wait_for_rows("event.event", 20)
+    sender.flush_and_stop()
+    h = _ledger(tel, "sender")
+    assert h["emitted"] == 20 and h["delivered"] == 20
+    assert h["dropped_total"] == 0
+    assert len(server.db.table("event.event")) == 20
+
+
+def test_sender_spools_overflow_and_replays(server):
+    """Queue overflow while the server is down: HIGH frames spill to
+    disk, replay once the server is reachable, ledger stays balanced."""
+    from deepflow_tpu.agent.sender import UniformSender
+    from deepflow_tpu.agent.spool import Spool
+    tel = Telemetry("agent", enabled=True)
+    spool_dir = tempfile.mkdtemp(prefix="df-test-spool-")
+    # port 1: nothing listening. Tiny queue so sends overflow fast.
+    sender = UniformSender(
+        [("127.0.0.1", 1)], agent_id=9, queue_size=4,
+        spool=Spool(spool_dir), telemetry=tel).start()
+    n = 50
+    for i in range(1, n + 1):
+        assert sender.send(MessageType.STEP_METRICS, _step_payload(i))
+    assert sender.stats["spooled"] >= n - 5  # almost all spilled
+    # point the sender at the live server: failover + replay
+    sender.servers.append(("127.0.0.1", server.ingest_port))
+    assert server.wait_for_rows("profile.tpu_step_metrics", n, timeout=15)
+    sender.flush_and_stop(timeout=10)
+    assert sender.stats["replayed"] >= sender.stats["spooled"] > 0
+    h = _ledger(tel, "sender")
+    assert h["emitted"] == n and h["delivered"] == n
+    assert h["dropped_total"] == 0 and h["in_flight"] == 0
+    assert len(server.db.table("profile.tpu_step_metrics")) == n
+
+
+def test_ack_trims_retransmit_window_and_spool(server):
+    from deepflow_tpu.agent.sender import UniformSender
+    from deepflow_tpu.agent.spool import Spool
+    spool_dir = tempfile.mkdtemp(prefix="df-test-spool-")
+    sender = UniformSender(
+        [("127.0.0.1", server.ingest_port)], agent_id=9,
+        spool=Spool(spool_dir)).start()
+    n = 30
+    for i in range(1, n + 1):
+        sender.send(MessageType.EVENT, _event_payload(f"e{i}"))
+    assert server.wait_for_rows("event.event", n)
+    deadline = time.time() + 5
+    while time.time() < deadline and sender.stats["acked_seq"] < n:
+        time.sleep(0.02)
+    assert sender.stats["acked_seq"] == n
+    assert not sender._unacked and not sender._pending
+    assert sender.spool.pending_records() == 0
+    sender.flush_and_stop()
+
+
+def test_priority_shed_order():
+    """On overflow the sender sheds LOW (dfstats) before MID (metrics)
+    and never HIGH — each shed accounted dropped(priority_shed_*)."""
+    from deepflow_tpu.agent.sender import UniformSender
+    tel = Telemetry("agent", enabled=True)
+    # not started: nothing drains the queue, so occupancy is exact
+    sender = UniformSender([("127.0.0.1", 1)], agent_id=9, queue_size=4,
+                           telemetry=tel)
+    for _ in range(2):
+        assert sender.send(MessageType.DFSTATS, b"low")
+    for _ in range(2):
+        assert sender.send(MessageType.METRICS, b"mid")
+    # queue full of 2 LOW + 2 MID; HIGH sends must displace LOW first
+    assert sender.send(MessageType.L7_LOG, b"high1")
+    assert sender.send(MessageType.L7_LOG, b"high2")
+    # then MID
+    assert sender.send(MessageType.L7_LOG, b"high3")
+    h = _ledger(tel, "sender")
+    assert h["dropped"] == {"priority_shed_low": 2, "priority_shed_mid": 1}
+    queued = [f.msg_type for f in sender._q.queue]
+    assert queued.count(MessageType.L7_LOG) == 3
+    assert MessageType.DFSTATS not in queued
+    # a MID send with only MID/HIGH queued: sheds nothing, drops itself
+    assert not sender.send(MessageType.METRICS, b"mid2")
+    h = _ledger(tel, "sender")
+    assert h["dropped"]["queue_full_mid"] == 1
+    _assert_balanced(h)
+
+
+def test_low_priority_drop_is_accounted_without_spool():
+    from deepflow_tpu.agent.sender import UniformSender
+    tel = Telemetry("agent", enabled=True)
+    sender = UniformSender([("127.0.0.1", 1)], agent_id=9, queue_size=2,
+                           telemetry=tel)
+    for _ in range(2):
+        assert sender.send(MessageType.DFSTATS, b"low")
+    assert not sender.send(MessageType.DFSTATS, b"low-overflow")
+    h = _ledger(tel, "sender")
+    assert h["dropped"] == {"queue_full_low": 1}
+    _assert_balanced(h)
+
+
+def test_shutdown_backoff_is_interruptible():
+    """flush_and_stop on a dead-server sender must return promptly (the
+    old backoff slept uninterruptibly for up to 5s per cycle)."""
+    from deepflow_tpu.agent.sender import UniformSender
+    sender = UniformSender([("127.0.0.1", 1)], agent_id=9).start()
+    time.sleep(0.5)  # let the backoff grow past the old 0.1s floor
+    t0 = time.monotonic()
+    sender.flush_and_stop(timeout=0.2)
+    assert time.monotonic() - t0 < 3.0
+
+
+# -- receiver: UDP trailing garbage (satellite) -------------------------------
+
+def test_udp_trailing_garbage_counted_frame_kept(server):
+    frame = encode_frame(FrameHeader(MessageType.EVENT, agent_id=3),
+                         _event_payload("udp"))
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.sendto(frame + b"\x00garbage\xff", ("127.0.0.1", server.ingest_port))
+    s.close()
+    assert server.wait_for_rows("event.event", 1)
+    deadline = time.time() + 5
+    while time.time() < deadline \
+            and server.receiver.stats["udp_trailing_garbage"] < 1:
+        time.sleep(0.02)
+    assert server.receiver.stats["udp_trailing_garbage"] == 1
+    assert server.receiver.stats["bad_frames"] == 1
+    h = _ledger(server.telemetry, "receiver")
+    assert h["dropped"].get("udp_trailing_garbage") == 1
+    _assert_balanced(h)
+
+
+# -- chaos: seeded kill-and-recover e2e ---------------------------------------
+
+def test_chaos_kill_and_recover_exactly_once():
+    """The acceptance scenario, in-process: seeded faults + a server
+    kill-and-restart, zero STEP_METRICS loss, zero duplicate rows."""
+    from deepflow_tpu.cli import chaos_check
+    assert chaos_check.main() == 0
