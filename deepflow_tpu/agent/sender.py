@@ -17,6 +17,26 @@ sender sheds by message-type class — DFSTATS/self-mon first,
 STEP_METRICS/flow/trace data last — with per-class ``dropped(reason)``
 ledger accounting, replacing the old blind drop-newest.
 
+Seq-space discipline (what keeps the server's per-agent state honest):
+
+* The counter is seeded per boot from a ~1ms wall-clock epoch in the
+  high bits (``epoch << 22 | counter``), max-ed with the recovered
+  spool's highest seq.  A restarted agent therefore always starts
+  ABOVE any watermark or dedup floor the server still holds for its
+  agent_id — without this, a restart would replay seq 1.. into a
+  server whose watermark/dedup floor sits at the old boot's high-water
+  mark, and every frame would be silently discarded as a dup.
+* A seq is allocated at a frame's FIRST wire or spool write, never at
+  ``send()``: a frame shed or dropped before reaching the wire never
+  owned a seq, so it cannot leave a permanent gap that stalls the
+  server's contiguous watermark (and with it acks, window trim and
+  spool trim).
+* The few events that DO burn an allocated seq (spool eviction at the
+  disk cap, a spool disk error) — and every (re)connect — make the
+  sender announce a ``SEQ_BASE`` control frame: "no seq below B will
+  ever be sent (again)".  The server fast-forwards its watermark to
+  B-1 instead of parking the dead gap until MAX_OOS forces a jump.
+
 Ledger discipline: ``emitted`` is accounted once per ``send()``,
 ``delivered`` once per frame at its FIRST successful socket write
 (retransmits of unacked frames are counted in ``stats`` but not
@@ -37,7 +57,7 @@ import time
 
 from deepflow_tpu.codec import (
     SEQ_EXT_FMT, FrameDecodeError, FrameHeader, MessageType, StreamDecoder,
-    encode_frame, priority_of)
+    encode_frame, encode_seq_base, priority_of)
 
 log = logging.getLogger("df.sender")
 
@@ -46,12 +66,15 @@ _PRIO_NAMES = {0: "high", 1: "mid", 2: "low"}
 
 class _Frame:
     """One frame's transit state. ``needs_account`` flips False at the
-    first successful write so retransmits never double-count."""
+    first successful write so retransmits never double-count. ``seq``
+    stays None until the frame first reaches the wire or the spool —
+    shed/dropped frames never own one."""
 
     __slots__ = ("msg_type", "payload", "seq", "enq_ns", "needs_account")
 
-    def __init__(self, msg_type: MessageType, payload: bytes, seq: int,
-                 enq_ns: int | None, needs_account: bool = True) -> None:
+    def __init__(self, msg_type: MessageType, payload: bytes,
+                 seq: int | None, enq_ns: int | None,
+                 needs_account: bool = True) -> None:
         self.msg_type = msg_type
         self.payload = payload
         self.seq = seq
@@ -96,17 +119,33 @@ class UniformSender:
             chaos = chaos_from_env()
         self._chaos = chaos
         self._seq_lock = threading.Lock()
-        self._next_seq = 1
+        # per-boot epoch above a 22-bit counter (~1ms units, unmasked so
+        # it can never wrap backward; ~2^41 * 2^22 still fits u64): a
+        # restarted agent's seqs start above anything the server
+        # remembers for this agent_id, even across a fast clean restart
+        # whose trimmed-empty spool has no max_seq to recover — the
+        # counter outgrowing 22 bits just bleeds into epoch space, which
+        # stays monotonic because real send rates are far below the
+        # 4M-frames-per-ms that region represents
+        self._next_seq = ((time.time_ns() >> 20) << 22) | 1
+        if spool is not None:
+            self._next_seq = max(self._next_seq, spool.max_seq() + 1)
+        self.seq_base = self._next_seq - 1     # seqs are seq_base+1, +2, ...
         self._acked = 0                       # highest contiguous acked
         self._unacked: dict[int, _Frame] = {}  # sent, awaiting ack
         self._pending: list[_Frame] = []       # retransmit/replay, FIFO
         self._inflight: _Frame | None = None
         self._spool_replayed_through = 0
+        self._base_dirty = False  # a seq was burned: re-announce SEQ_BASE
+        # delivered frames evicted from the retransmit window before
+        # their ack: still possibly in a decoder queue, so SEQ_BASE must
+        # never advance past them (the dedup floor would drop their rows)
+        self._evicted_unacked: set[int] = set()
         self._ackdec = StreamDecoder()
         self.stats = {"sent_frames": 0, "sent_bytes": 0, "dropped": 0,
                       "reconnects": 0, "errors": 0, "retransmits": 0,
                       "spooled": 0, "replayed": 0, "acked_seq": 0,
-                      "shed": 0, "unacked_evicted": 0}
+                      "shed": 0, "unacked_evicted": 0, "seq_bases": 0}
         if telemetry is None:
             from deepflow_tpu.telemetry import Telemetry
             telemetry = Telemetry("agent", enabled=False)
@@ -124,6 +163,9 @@ class UniformSender:
     def _on_spool_evict(self, n: int, reason: str) -> None:
         self.stats["dropped"] += n
         self._hop.account(dropped=n, reason=reason)
+        # evicted records owned seqs that will never be sent: tell the
+        # server so its contiguous watermark doesn't stall on the gap
+        self._base_dirty = True
 
     def start(self) -> "UniformSender":
         self._thread = threading.Thread(
@@ -149,8 +191,11 @@ class UniformSender:
 
     def send(self, msg_type: MessageType, payload: bytes) -> bool:
         self._hop.account(emitted=1)
-        f = _Frame(msg_type, payload, self._alloc_seq(),
-                   time.monotonic_ns())
+        # no seq yet: a seq is allocated at the frame's first wire/spool
+        # write, so a frame shed or dropped before reaching either never
+        # burns one (a burned seq is a permanent gap that stalls the
+        # server's contiguous watermark — and with it every ack)
+        f = _Frame(msg_type, payload, None, time.monotonic_ns())
         try:
             self._q.put_nowait(f)
             return True
@@ -171,10 +216,12 @@ class UniformSender:
                 pass  # raced with other senders: fall through
         if self.spool is not None and mine == 0:
             # high-priority frames survive overflow on disk
+            f.seq = self._alloc_seq()
             if self.spool.append(int(msg_type), f.seq, f.payload):
                 self.stats["spooled"] += 1
                 return True
             self._drop(f, "spool_error")
+            self._base_dirty = True  # that seq is now a permanent gap
             return False
         self._drop(f, f"queue_full_{_PRIO_NAMES[mine]}")
         return False
@@ -288,6 +335,53 @@ class UniformSender:
             self._pending = sorted(self._pending + fresh,
                                    key=lambda f: f.seq)
 
+    # -- seq-base announcements ----------------------------------------------
+
+    def _outstanding_base(self) -> int:
+        """Lowest seq this sender may still (re)send. Everything below
+        it is either acked or permanently gone (dropped with ledger
+        accounting) — safe for the server to declare dead. Conservative
+        (too-low) answers are harmless: the server only moves forward."""
+        with self._seq_lock:
+            cands = [self._next_seq]
+        f = self._inflight
+        if f is not None and f.seq is not None:
+            cands.append(f.seq)
+        cands.extend(fr.seq for fr in self._pending if fr.seq is not None)
+        if self._unacked:
+            cands.append(min(self._unacked))
+        if self._evicted_unacked:
+            # delivered but unacked and no longer retransmittable: they
+            # may still be sitting in a server decode queue, so the base
+            # (and with it the dedup floor) must stay below them
+            cands.append(min(self._evicted_unacked))
+        if self.spool is not None:
+            s = self.spool.min_pending_seq()
+            if s:
+                cands.append(max(s, self._acked + 1))
+        return min(cands)
+
+    def _send_base(self) -> None:
+        """Announce SEQ_BASE on the live connection (worker thread only).
+        Sent after every (re)connect — a restarted agent's fresh epoch
+        seq space, or any seqs burned while disconnected, fast-forward
+        the server's watermark — and whenever an event burns a seq
+        mid-connection (spool evict / spool disk error)."""
+        frame = encode_seq_base(self.agent_id, self._outstanding_base())
+        try:
+            if self._chaos is not None:
+                self._chaos.on_send(self._sock, frame)
+            else:
+                self._sock.sendall(frame)
+            self.stats["seq_bases"] += 1
+            self.stats["sent_bytes"] += len(frame)
+            self._base_dirty = False
+        except OSError as e:
+            log.warning("seq-base send failed (%s); reconnecting", e)
+            self.stats["errors"] += 1
+            self._close()
+            self._server_idx = (self._server_idx + 1) % len(self.servers)
+
     # -- ack processing ------------------------------------------------------
 
     def _read_acks(self) -> None:
@@ -331,8 +425,15 @@ class UniformSender:
                 self._hop.account(delivered=1)
                 f.needs_account = False
         self._pending = kept
+        self._evicted_unacked = {s for s in self._evicted_unacked
+                                 if s > seq}
         if self.spool is not None:
             self.spool.trim(seq)
+        # the ack may have drained everything below a dead gap (e.g. a
+        # recovered spool's old-boot records just finished): announce the
+        # jump so the server's watermark doesn't stall at the gap's edge
+        if self._outstanding_base() > seq + 1:
+            self._base_dirty = True
 
     # -- send loop -----------------------------------------------------------
 
@@ -347,6 +448,11 @@ class UniformSender:
     def _send_frame(self, f: _Frame) -> None:
         self._inflight = f
         is_retransmit = not f.needs_account
+        if self.durable and f.seq is None:
+            # first wire write: the seq is born here, in write order, so
+            # the watermark at the server stays gap-free for frames that
+            # actually travel (spooled frames got theirs at spool time)
+            f.seq = self._alloc_seq()
         frame = encode_frame(
             FrameHeader(f.msg_type, agent_id=self.agent_id,
                         org_id=self.org_id, team_id=self.team_id,
@@ -377,6 +483,8 @@ class UniformSender:
             # retransmit list (or spool it) before rotating servers
             self.stats["errors"] += 1
             log.warning("send failed (%s); reconnecting", e)
+            if f.seq is None:  # non-durable: spool still keys on seq
+                f.seq = self._alloc_seq()
             if self.spool is not None and f.needs_account \
                     and f.seq > self._spool_replayed_through:
                 if self.spool.append(int(f.msg_type), f.seq, f.payload):
@@ -398,7 +506,12 @@ class UniformSender:
         while len(self._unacked) > self.ack_window:
             oldest = min(self._unacked)
             del self._unacked[oldest]
+            self._evicted_unacked.add(oldest)
             self.stats["unacked_evicted"] += 1
+        # bound the evicted-seq floor set too; beyond it delivery was
+        # already at-most-once, so forgetting the oldest loses nothing
+        while len(self._evicted_unacked) > 4 * self.ack_window:
+            self._evicted_unacked.discard(min(self._evicted_unacked))
 
     def _run(self) -> None:
         backoff = 0.1
@@ -414,9 +527,18 @@ class UniformSender:
                     backoff = min(backoff * 2, 5.0)
                     continue
                 backoff = 0.1
+                if self.durable:
+                    # adopt this boot's seq space / skip dead gaps
+                    self._send_base()
+                    if self._sock is None:
+                        continue
             self._read_acks()
             if self._sock is None:
                 continue  # ack channel died; reconnect first
+            if self.durable and self._base_dirty:
+                self._send_base()
+                if self._sock is None:
+                    continue
             f = self._next_frame()
             if f is None:
                 # idle: frames that overflowed into the spool while the

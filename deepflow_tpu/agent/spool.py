@@ -41,6 +41,11 @@ _MAX_RECORD = 64 << 20
 
 
 class _Segment:
+    """first_seq/last_seq are the MIN/MAX seq in the segment — appends
+    are not guaranteed in seq order (the sender's OSError respool path
+    can write an older in-flight seq after newer overflow spills), so
+    trim/replay decisions must use the true range, not arrival order."""
+
     __slots__ = ("path", "first_seq", "last_seq", "records", "bytes")
 
     def __init__(self, path: str, first_seq: int) -> None:
@@ -49,6 +54,14 @@ class _Segment:
         self.last_seq = first_seq
         self.records = 0
         self.bytes = 0
+
+    def note(self, seq: int) -> None:
+        if self.records == 0:
+            self.first_seq = self.last_seq = seq
+        else:
+            self.first_seq = min(self.first_seq, seq)
+            self.last_seq = max(self.last_seq, seq)
+        self.records += 1
 
 
 class Spool:
@@ -98,10 +111,7 @@ class Spool:
                 if zlib.crc32(data[off + _REC_SIZE:end]) & 0xFFFFFFFF != crc:
                     self.stats["corrupt"] += 1
                     break  # no resync marker: discard the rest
-                if seg.records == 0:
-                    seg.first_seq = seq
-                seg.last_seq = seq
-                seg.records += 1
+                seg.note(seq)
                 good_end = end
                 off = end
             if good_end < len(data):
@@ -141,8 +151,7 @@ class Spool:
                 log.warning("spool append failed: %s", e)
                 return False
             seg = self._segments[-1]
-            seg.last_seq = seq
-            seg.records += 1
+            seg.note(seq)
             seg.bytes += len(rec)
             self.stats["appended"] += 1
             self._enforce_cap()
@@ -237,10 +246,18 @@ class Spool:
     # -- introspection -------------------------------------------------------
 
     def max_seq(self) -> int:
-        """Highest seq ever spooled (0 when empty) — lets the sender's
-        flush path know whether unreplayed records remain."""
+        """Highest seq still spooled (0 when empty) — lets the sender's
+        flush path know whether unreplayed records remain. Max across
+        ALL segments: out-of-order appends mean the newest segment does
+        not necessarily hold the highest seq."""
         with self._lock:
-            return self._segments[-1].last_seq if self._segments else 0
+            return max((s.last_seq for s in self._segments), default=0)
+
+    def min_pending_seq(self) -> int:
+        """Lowest seq still spooled (0 when empty): a safe lower bound
+        for the sender's SEQ_BASE announcement."""
+        with self._lock:
+            return min((s.first_seq for s in self._segments), default=0)
 
     def pending_records(self) -> int:
         with self._lock:

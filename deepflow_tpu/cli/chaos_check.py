@@ -1,4 +1,4 @@
-"""chaos-check: kill-and-recover e2e proving the loss-bounded transport.
+"""chaos-check: restart-and-recover e2e proving the loss-bounded transport.
 
 Scenario (seeded fault schedule, wired as `make chaos-check`):
 
@@ -7,15 +7,21 @@ Scenario (seeded fault schedule, wired as `make chaos-check`):
      injector randomly resetting connections and truncating writes)
      pumps two streams through it: STEP_METRICS (HIGH priority) and
      DFSTATS (LOW priority)
-  3. mid-stream server A is KILLED; traffic keeps flowing (frames park
-     in the retransmit window and the on-disk spool); server B then
-     restarts on the same port + data_dir and the sender reconnects
-     and replays
-  4. after quiescence the check fails unless:
+  3. mid-stream server A is stopped (graceful: decoder queues drain,
+     ack watermarks persist — the restart unit the exactly-once claim
+     covers, see docs/ROBUSTNESS.md for the hard-kill bound); traffic
+     keeps flowing, parking in the retransmit window and the on-disk
+     spool; server B then restarts on the same port + data_dir and the
+     sender reconnects and replays
+  4. later the AGENT restarts too — same agent_id, same spool dir — so
+     the check also proves a restarted agent's fresh (epoch-seeded) seq
+     space is adopted by the server instead of being discarded as dups
+     against the old boot's watermark
+  5. after quiescence the check fails unless:
        * every HIGH frame landed in the store EXACTLY once — zero
-         loss to the kill or the injected faults, zero duplicate rows
-         from the retransmits that recovered them
-       * the sender's and both servers' hop ledgers balance
+         loss to the restarts or the injected faults, zero duplicate
+         rows from the retransmits that recovered them
+       * the hop ledgers of both sender boots and server B balance
          (emitted == delivered + dropped(reason): nothing vanished
          without a named reason)
 """
@@ -29,8 +35,9 @@ import time
 MS = 1_000_000
 N_HIGH = 300            # STEP_METRICS frames, one record each
 LOW_EVERY = 3           # a DFSTATS frame every N high frames
-KILL_AT = 100           # kill server A after this many high frames
+KILL_AT = 100           # stop server A after this many high frames
 RESTART_AT = 180        # start server B after this many high frames
+AGENT_RESTART_AT = 240  # restart the sender (same agent_id + spool dir)
 
 
 def _fail(msg: str) -> None:
@@ -93,12 +100,22 @@ def main() -> int:
             if i % LOW_EVERY == 0:
                 sender.send(MessageType.DFSTATS, _stats_payload())
             if i == KILL_AT:
-                server_a.stop()   # drains decoders, persists ack state
-                print(f"chaos-check: server killed at frame {i}")
+                server_a.stop()   # graceful: drains decoders, persists
+                print(f"chaos-check: server stopped at frame {i}")
             if i == RESTART_AT:
                 server_b = Server(host="127.0.0.1", ingest_port=port,
                                   query_port=0, data_dir=data_dir).start()
                 print(f"chaos-check: server restarted at frame {i}")
+            if i == AGENT_RESTART_AT:
+                # agent restart with the SAME agent_id and spool dir:
+                # the new boot's epoch-seeded seq space must be adopted
+                # by the server (SEQ_BASE fast-forward), not discarded
+                # as dups against the old boot's watermark
+                sender.flush_and_stop(timeout=30.0)
+                sender = UniformSender(
+                    [("127.0.0.1", port)], agent_id=9, telemetry=telemetry,
+                    spool=Spool(spool_dir), chaos=chaos).start()
+                print(f"chaos-check: agent restarted at frame {i}")
             time.sleep(0.002)
 
         # drain: queue + retransmit window + spool backlog, across

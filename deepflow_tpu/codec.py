@@ -58,6 +58,10 @@ class MessageType(IntEnum):
     SHARD_RESULT = 12    # cluster scatter-gather shard responses
     STEP_METRICS = 13    # per-(run_id, step) rollups -> tpu_step_metrics
     ACK = 14             # server->agent: highest contiguous seq received
+    SEQ_BASE = 15        # agent->server: lowest seq the agent may still
+    #                      send — the server fast-forwards its watermark
+    #                      past permanently-dead gaps (agent restart,
+    #                      spool eviction) instead of stalling on them
 
 
 # -- delivery priority classes ----------------------------------------------
@@ -75,6 +79,7 @@ _PRIORITY = {
     MessageType.DFSTATS: PRIORITY_LOW,
     MessageType.PCAP: PRIORITY_LOW,
     MessageType.ACK: PRIORITY_LOW,
+    MessageType.SEQ_BASE: PRIORITY_LOW,
     MessageType.METRICS: PRIORITY_MID,
     MessageType.EVENT: PRIORITY_MID,
     MessageType.OTEL: PRIORITY_MID,
@@ -130,6 +135,26 @@ def encode_ack(agent_id: int, seq: int) -> bytes:
 def decode_ack(payload: bytes) -> int:
     if len(payload) < SEQ_EXT_SIZE:
         raise FrameDecodeError("short ACK payload")
+    return struct.unpack_from(SEQ_EXT_FMT, payload)[0]
+
+
+def encode_seq_base(agent_id: int, base: int) -> bytes:
+    """Agent->server: no frame with seq < base will ever be sent (again).
+
+    Sent on every (re)connect and after an event that permanently burns
+    seqs (spool eviction, spool disk error): the server advances its
+    contiguous watermark to base-1 (forward-only) instead of parking
+    the dead gap in the out-of-order set until MAX_OOS forces a jump.
+    A restarted agent's fresh (higher, epoch-seeded) seq space is
+    adopted the same way."""
+    return encode_frame(
+        FrameHeader(MessageType.SEQ_BASE, agent_id=agent_id),
+        struct.pack(SEQ_EXT_FMT, base), compress=False)
+
+
+def decode_seq_base(payload: bytes) -> int:
+    if len(payload) < SEQ_EXT_SIZE:
+        raise FrameDecodeError("short SEQ_BASE payload")
     return struct.unpack_from(SEQ_EXT_FMT, payload)[0]
 
 

@@ -29,40 +29,86 @@ log = logging.getLogger("df.decoder")
 
 
 class DedupWindow:
-    """Bounded LRU of seen ``(agent_id, seq)`` pairs + per-agent floors.
+    """Per-agent exactly-once guard: a contiguity-advancing ``floor``
+    plus a bounded park set of decoded seqs above it.
 
     The at-least-once transport retransmits frames the server may
     already hold (unacked window replay after a reconnect, spool replay
     racing an in-flight ack); this window is what turns at-least-once
-    frames into exactly-once rows.  A ``floor`` marks every seq at or
-    below it as seen — restored from persisted ack state on restart so
-    retransmits of pre-restart frames dedup even though the LRU is
-    empty.  One window is shared by ALL decoders (seq space is
-    per-agent, not per-type) and workers, hence the lock."""
+    frames into exactly-once rows.  Every seq at or below an agent's
+    floor is a dup; seqs above it park in a per-agent set and are
+    absorbed into the floor as the run becomes contiguous — so under
+    normal (dense) decode traffic the floor tracks the stream and the
+    park set holds only out-of-order residue.  Unlike the shared LRU
+    this replaces, one agent's traffic can never evict another agent's
+    still-live entries and reopen a dup hole.
+
+    Floors move three ways: seeded from persisted ack state on server
+    restart, advanced by ``advance_floor`` when a SEQ_BASE announcement
+    declares a gap permanently dead (safe: acked => decoded, so nothing
+    below the announced base can still be in a decoder queue), and
+    advanced by ``seen`` contiguity.  If a park set still outgrows
+    ``capacity`` (an un-announced permanent gap), the floor jumps to
+    the oldest parked seq — bounded memory over perfect dup detection
+    for seqs that old, same liveness-over-completeness stance as
+    SeqAckTracker.MAX_OOS.
+
+    One window is shared by ALL decoders (seq space is per-agent, not
+    per-type) and workers, hence the lock."""
 
     def __init__(self, capacity: int = 65536,
                  floors: dict[int, int] | None = None) -> None:
         self.capacity = capacity
         self._lock = threading.Lock()
-        self._seen: dict[tuple[int, int], None] = {}  # insertion-ordered
-        self._floors: dict[int, int] = dict(floors or {})
-        self.stats = {"dups": 0, "tracked": 0}
+        # agent_id -> [floor, set of parked seqs > floor]
+        self._state: dict[int, list] = {
+            int(a): [int(f), set()] for a, f in (floors or {}).items()}
+        self.stats = {"dups": 0, "tracked": 0, "floor_jumps": 0}
 
     def seen(self, agent_id: int, seq: int) -> bool:
         """Mark (agent, seq); True if it was already marked (a dup)."""
-        key = (agent_id, seq)
         with self._lock:
-            if seq <= self._floors.get(agent_id, 0):
+            st = self._state.get(agent_id)
+            if st is None:
+                st = self._state[agent_id] = [0, set()]
+            floor, parked = st
+            if seq <= floor or seq in parked:
                 self.stats["dups"] += 1
                 return True
-            if key in self._seen:
-                self.stats["dups"] += 1
-                return True
-            self._seen[key] = None
+            parked.add(seq)
             self.stats["tracked"] += 1
-            while len(self._seen) > self.capacity:
-                self._seen.pop(next(iter(self._seen)))
+            if seq == floor + 1:
+                while floor + 1 in parked:
+                    floor += 1
+                    parked.discard(floor)
+                st[0] = floor
+            elif len(parked) > self.capacity:
+                # un-announced permanent gap: jump to the oldest parked
+                # seq and absorb the contiguous run above it
+                floor = min(parked)
+                parked.discard(floor)
+                while floor + 1 in parked:
+                    floor += 1
+                    parked.discard(floor)
+                st[0] = floor
+                self.stats["floor_jumps"] += 1
             return False
+
+    def advance_floor(self, agent_id: int, floor: int) -> None:
+        """Forward-only floor jump (SEQ_BASE / restored ack state)."""
+        with self._lock:
+            st = self._state.get(agent_id)
+            if st is None:
+                self._state[agent_id] = [floor, set()]
+                return
+            if floor <= st[0]:
+                return
+            parked = st[1]
+            parked.difference_update({s for s in parked if s <= floor})
+            while floor + 1 in parked:
+                floor += 1
+                parked.discard(floor)
+            st[0] = floor
 
 
 class Decoder:
@@ -81,7 +127,8 @@ class Decoder:
                  platform: PlatformInfoTable, exporters=None,
                  pod_index=None, gpid_table=None,
                  workers: int | None = None, resources=None,
-                 trace_trees=None, telemetry=None, dedup=None) -> None:
+                 trace_trees=None, telemetry=None, dedup=None,
+                 seq_tracker=None) -> None:
         self.q = q
         self.db = db
         self.platform = platform
@@ -91,6 +138,10 @@ class Decoder:
         self.trace_trees = trace_trees  # TraceTreeBuilder (optional)
         self.gpid_table = gpid_table  # controller GpidAllocator (optional)
         self.dedup = dedup  # shared DedupWindow (optional): retransmit guard
+        # receiver's SeqAckTracker (optional): seqs are observed HERE,
+        # after decode+write, so an ack implies store presence — a hard
+        # server crash can only lose frames the agent will retransmit
+        self.seq_tracker = seq_tracker
         self.workers = workers if workers is not None else self.WORKERS
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
@@ -157,6 +208,12 @@ class Decoder:
                 errors += 1
                 log.exception("decode error (%s)", self.MSG_TYPE.name)
         dt = time.perf_counter_ns() - t0
+        if self.seq_tracker is not None:
+            # observed AFTER the decode/write pass: dups and decode
+            # errors count too (a retransmit would meet the same fate)
+            for header, _ in items:
+                if header.seq is not None:
+                    self.seq_tracker.observe(header.agent_id, header.seq)
         if dups:
             self._hop.account(dropped=dups, reason="dup")
         self._hop.account(delivered=batches, dropped=errors,

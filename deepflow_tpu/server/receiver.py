@@ -8,9 +8,14 @@ Durable-delivery additions (this port's transport is loss-bounded, the
 reference's is not): v2 frames carry a per-agent ``seq``; the receiver
 tracks the highest contiguous seq per agent (``SeqAckTracker``) and
 periodically writes ACK frames back down each TCP connection, which is
-what lets the agent trim its retransmit window and disk spool.  A frame
-that fails to enqueue on a full decoder queue is NOT acked — the agent
-retransmits it later, turning what used to be silent loss into a retry.
+what lets the agent trim its retransmit window and disk spool.  The
+tracker is fed by the DECODERS after a frame's rows are written (not at
+enqueue time), so an acked frame has reached the store — a frame that
+is dropped on a full decoder queue, or lost with the queue in a hard
+server crash, was never observed and is retransmitted by the agent.
+SEQ_BASE control frames ("no seq below B will ever be sent") are
+handled here inline: they fast-forward the watermark past gaps the
+agent declared permanently dead (agent restart, spool eviction).
 """
 
 from __future__ import annotations
@@ -24,7 +29,7 @@ import time
 
 from deepflow_tpu.codec import (
     FrameDecodeError, FrameHeader, MessageType, StreamDecoder, decode_frame,
-    encode_ack)
+    decode_seq_base, encode_ack)
 
 log = logging.getLogger("df.receiver")
 
@@ -53,6 +58,26 @@ class SeqAckTracker:
             st = self._state.get(agent_id)
             if st is None or contiguous > st[0]:
                 self._state[agent_id] = [contiguous, set()]
+
+    def advance(self, agent_id: int, contiguous: int) -> None:
+        """Forward-only watermark jump (SEQ_BASE): the agent declared
+        every seq <= contiguous dead-or-delivered, so stop waiting for
+        them — park-set entries below are absorbed, and parked seqs
+        just above the new watermark drain into it."""
+        with self._lock:
+            st = self._state.get(agent_id)
+            if st is None:
+                self._state[agent_id] = [contiguous, set()]
+                return
+            contig, oos = st
+            if contiguous <= contig:
+                return
+            contig = contiguous
+            oos.difference_update({s for s in oos if s <= contig})
+            while contig + 1 in oos:
+                contig += 1
+                oos.discard(contig)
+            st[0] = contig
 
     def observe(self, agent_id: int, seq: int) -> None:
         with self._lock:
@@ -114,12 +139,16 @@ class Receiver:
         self._enable_udp = enable_udp
         self.ack_enabled = ack_enabled
         self.seq_tracker = SeqAckTracker()
+        # optional DedupWindow (wired by Server.start): SEQ_BASE also
+        # advances its per-agent floor — safe, because acked => decoded,
+        # so nothing below the announced base can still sit undecoded
+        self.dedup = None
         if chaos is None:
             from deepflow_tpu.chaos import chaos_from_env
             chaos = chaos_from_env()
         self._chaos = chaos
         self.stats = {"frames": 0, "bytes": 0, "dropped": 0, "bad_frames": 0,
-                      "connections": 0, "acks_sent": 0,
+                      "connections": 0, "acks_sent": 0, "seq_bases": 0,
                       "udp_trailing_garbage": 0}
         if telemetry is None:
             from deepflow_tpu.telemetry import Telemetry
@@ -135,9 +164,31 @@ class Receiver:
         return q
 
     def _observe_seqs(self, frames: list[tuple[FrameHeader, bytes]]) -> None:
+        """Mark seqs as handled WITHOUT a decoder pass (policy drops like
+        no_handler). Normal frames are observed by their decoder after
+        the rows are written, so an ack implies store presence."""
         for header, _ in frames:
             if header.seq is not None:
                 self.seq_tracker.observe(header.agent_id, header.seq)
+
+    def _handle_seq_base(self, header: FrameHeader, payload: bytes) -> None:
+        """SEQ_BASE control frame: the agent will never (re)send a seq
+        below base — fast-forward the watermark and the dedup floor so
+        the dead gap cannot stall acks (or grow the dedup park set).
+        Advancing the dedup floor is safe because acked => decoded: any
+        frame below base is either already through a decoder or will
+        never arrive."""
+        try:
+            base = decode_seq_base(payload)
+        except FrameDecodeError:
+            self.stats["bad_frames"] += 1
+            return
+        self.stats["seq_bases"] += 1
+        if base <= 0:
+            return
+        self.seq_tracker.advance(header.agent_id, base - 1)
+        if self.dedup is not None:
+            self.dedup.advance_floor(header.agent_id, base - 1)
 
     def _dispatch(self, header: FrameHeader, payload: bytes) -> None:
         """Hand one frame to its decoder queue (UDP path: one frame per
@@ -158,7 +209,8 @@ class Receiver:
         try:
             q.put_nowait((time.monotonic_ns(), [(header, payload)]))
             self._hop.account(delivered=1)
-            self._observe_seqs([(header, payload)])
+            # NOT observed here: the decoder observes after the rows are
+            # written, so the eventual ack implies store presence
         except queue.Full:
             # backpressure stance: drop newest, count it — and WITHHOLD
             # the ack so a durable sender retransmits it later
@@ -190,7 +242,6 @@ class Receiver:
             try:
                 q.put_nowait((enq_ns, group))
                 self._hop.account(delivered=len(group))
-                self._observe_seqs(group)
             except queue.Full:
                 # backpressure stance: drop newest, count it; the ack is
                 # withheld so the durable sender retransmits the group
@@ -258,12 +309,20 @@ class Receiver:
                         return
                     idle_deadline = time.monotonic() + 60.0
                     try:
-                        frames = list(dec.feed(data))
+                        frames = []
+                        for h, p in dec.feed(data):
+                            if h.msg_type == MessageType.SEQ_BASE:
+                                # control frame: handled inline (and the
+                                # agent gets acks from now on, so its
+                                # _acked floor seeds before any data)
+                                recv._handle_seq_base(h, p)
+                                agents.add(h.agent_id)
+                                continue
+                            frames.append((h, p))
+                            if h.seq is not None:
+                                agents.add(h.agent_id)
                         if frames:
                             recv._dispatch_many(frames)
-                            for h, _ in frames:
-                                if h.seq is not None:
-                                    agents.add(h.agent_id)
                     except FrameDecodeError as e:
                         recv.stats["bad_frames"] += 1
                         recv._hop.account(emitted=1, dropped=1,
@@ -329,7 +388,10 @@ class Receiver:
                             self.stats["udp_trailing_garbage"] += 1
                             self._hop.account(emitted=1, dropped=1,
                                               reason="udp_trailing_garbage")
-                        self._dispatch(header, payload)
+                        if header.msg_type == MessageType.SEQ_BASE:
+                            self._handle_seq_base(header, payload)
+                        else:
+                            self._dispatch(header, payload)
                     else:
                         # truncated datagram: header said more bytes than
                         # arrived

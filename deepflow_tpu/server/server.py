@@ -227,6 +227,9 @@ class Server:
         # per-agent, and a retransmit must dedup no matter which decoder
         # type it lands on
         self.dedup = DedupWindow(floors=floors)
+        # SEQ_BASE announcements advance dedup floors too (receiver
+        # handles those control frames inline)
+        self.receiver.dedup = self.dedup
         # register all queues BEFORE listening: no drop window on restart
         from deepflow_tpu.server.decoders import PcapDecoder
         pairs = [
@@ -249,7 +252,8 @@ class Server:
                     pod_index=self.pod_index, resources=self.resources,
                     gpid_table=(self.controller.gpids
                                 if self.controller else None),
-                    telemetry=self.telemetry, dedup=self.dedup, **kw)
+                    telemetry=self.telemetry, dedup=self.dedup,
+                    seq_tracker=self.receiver.seq_tracker, **kw)
             d.MSG_TYPE = mtype  # FlowLogDecoder serves two types
             self.decoders.append(d.start())
         self.receiver.start()

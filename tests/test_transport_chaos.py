@@ -143,6 +143,32 @@ def test_spool_evicts_oldest_segment_at_cap(tmp_path):
     sp.close()
 
 
+def test_spool_out_of_order_append_trim_safe(tmp_path):
+    """The sender's OSError respool path can append an OLDER in-flight
+    seq after newer overflow spills; trim() must see the segment's true
+    max (the old arrival-order last_seq let an ack for the low seq
+    delete the unacked high record)."""
+    from deepflow_tpu.agent.spool import Spool
+    sp = Spool(str(tmp_path), segment_bytes=4096)
+    big = b"p" * 3000
+    assert sp.append(int(MessageType.L7_LOG), 5000, big)
+    assert sp.append(int(MessageType.L7_LOG), 100, b"respooled")
+    assert sp.append(int(MessageType.L7_LOG), 5001, big)  # rotates
+    assert sp.max_seq() == 5001
+    assert sp.min_pending_seq() == 100
+    sp.trim(100)  # ack covering only the low seq: nothing may go
+    assert sp.pending_records() == 3
+    assert sorted(s for _, s, _ in sp.replay(100)) == [5000, 5001]
+    sp.trim(5000)  # now the whole first segment is covered
+    assert [s for _, s, _ in sp.replay(0)] == [5001]
+    sp.close()
+    # recovery rebuilds true min/max from the surviving records
+    sp2 = Spool(str(tmp_path), segment_bytes=4096)
+    assert sp2.max_seq() == 5001
+    assert sp2.min_pending_seq() == 5001
+    sp2.close()
+
+
 def test_spool_recovers_through_torn_tail(tmp_path):
     from deepflow_tpu.agent.spool import Spool
     sp = Spool(str(tmp_path))
@@ -196,18 +222,66 @@ def test_seq_tracker_seed_floor():
     assert t.contiguous(1) == 101
 
 
+def test_seq_tracker_advance_forward_only():
+    """SEQ_BASE handling: a declared-dead gap fast-forwards the
+    watermark, absorbs parked seqs, and never moves backward."""
+    from deepflow_tpu.server.receiver import SeqAckTracker
+    t = SeqAckTracker()
+    t.observe(1, 1)
+    t.observe(1, 5)           # parks out of order behind the 2..4 gap
+    t.advance(1, 3)           # agent: 2..3 will never be sent
+    assert t.contiguous(1) == 3
+    t.observe(1, 4)           # gap closes -> parked 5 drains in
+    assert t.contiguous(1) == 5
+    t.advance(1, 2)           # backward announce: ignored
+    assert t.contiguous(1) == 5
+    t.advance(7, 100)         # unseen agent: seeds the window
+    assert t.contiguous(7) == 100
+
+
 # -- decoders: dedup window ----------------------------------------------------
 
-def test_dedup_window_lru_and_floors():
+def test_dedup_window_per_agent_floors_and_contiguity():
+    """Per-agent windows: one agent's traffic can never evict another
+    agent's still-live entries (the old shared LRU could, reopening a
+    dup hole under retransmit)."""
     from deepflow_tpu.server.decoders import DedupWindow
     w = DedupWindow(capacity=4, floors={1: 10})
     assert w.seen(1, 10)            # at/under the floor: dup
     assert not w.seen(1, 11)
     assert w.seen(1, 11)            # second sight: dup
-    for s in range(12, 17):         # push 11 out of the LRU
+    for s in range(12, 200):        # dense stream: floor tracks it
+        assert not w.seen(1, s)
+    # far more than `capacity` agent-2 seqs cannot evict agent 1's state
+    for s in range(1, 50):
         assert not w.seen(2, s)
-    assert not w.seen(1, 11)        # evicted -> no longer remembered
-    assert w.stats["dups"] == 2
+    assert w.seen(1, 150)           # still remembered (old LRU forgot)
+    assert w.seen(1, 199)
+    assert w.seen(2, 49)
+
+
+def test_dedup_window_floor_jump_on_unannounced_gap():
+    """An un-announced permanent gap must not grow the park set without
+    bound: past capacity the floor jumps to the oldest parked seq."""
+    from deepflow_tpu.server.decoders import DedupWindow
+    w = DedupWindow(capacity=4)
+    assert not w.seen(1, 1)
+    for s in range(3, 9):           # seq 2 never arrives
+        assert not w.seen(1, s)
+    assert w.stats["floor_jumps"] >= 1
+    assert w.seen(1, 5)             # absorbed by the jump: still a dup
+
+
+def test_dedup_window_advance_floor_forward_only():
+    from deepflow_tpu.server.decoders import DedupWindow
+    w = DedupWindow()
+    assert not w.seen(1, 5)   # parks above the floor
+    w.advance_floor(1, 4)     # SEQ_BASE: 1..4 dead -> parked 5 absorbed
+    assert w.seen(1, 3)
+    assert w.seen(1, 5)
+    w.advance_floor(1, 2)     # backward: ignored
+    assert w.seen(1, 3)
+    assert not w.seen(1, 6)
 
 
 def test_dedup_under_forced_retransmit(server):
@@ -329,10 +403,12 @@ def test_ack_trims_retransmit_window_and_spool(server):
     for i in range(1, n + 1):
         sender.send(MessageType.EVENT, _event_payload(f"e{i}"))
     assert server.wait_for_rows("event.event", n)
+    # seqs start at the boot's epoch base, not 1
+    target = sender.seq_base + n
     deadline = time.time() + 5
-    while time.time() < deadline and sender.stats["acked_seq"] < n:
+    while time.time() < deadline and sender.stats["acked_seq"] < target:
         time.sleep(0.02)
-    assert sender.stats["acked_seq"] == n
+    assert sender.stats["acked_seq"] == target
     assert not sender._unacked and not sender._pending
     assert sender.spool.pending_records() == 0
     sender.flush_and_stop()
@@ -378,6 +454,84 @@ def test_low_priority_drop_is_accounted_without_spool():
     h = _ledger(tel, "sender")
     assert h["dropped"] == {"queue_full_low": 1}
     _assert_balanced(h)
+
+
+def test_shed_and_drop_burn_no_seq():
+    """A frame dropped before reaching the wire or spool must not
+    consume a seq: a burned seq is a permanent gap that stalls the
+    server's contiguous watermark (and with it every ack)."""
+    from deepflow_tpu.agent.sender import UniformSender
+    # not started: nothing drains the queue, no wire writes happen
+    sender = UniformSender([("127.0.0.1", 1)], agent_id=9, queue_size=2)
+    first = sender._next_seq
+    for _ in range(2):
+        assert sender.send(MessageType.DFSTATS, b"low")
+    assert not sender.send(MessageType.DFSTATS, b"low")  # queue_full drop
+    assert sender.send(MessageType.L7_LOG, b"high")      # sheds a LOW
+    assert sender._next_seq == first
+
+
+def test_seq_base_fast_forwards_ack_watermark(server):
+    """A SEQ_BASE control frame (restarted agent adopting a fresh epoch
+    seq space) must jump the ack watermark past the never-sent gap —
+    without it the tracker parks the new epoch's seqs as out-of-order
+    and acks stall at the old boot's high-water mark."""
+    from deepflow_tpu.codec import encode_seq_base
+
+    def read_acks_until(s, buf, target, timeout=5.0):
+        s.settimeout(timeout)
+        acked = 0
+        deadline = time.time() + timeout
+        while acked < target and time.time() < deadline:
+            buf += s.recv(4096)
+            while True:
+                h, payload, consumed = decode_frame(buf)
+                if not consumed:
+                    break
+                assert h.msg_type == MessageType.ACK
+                acked = decode_ack(payload)
+                buf = buf[consumed:]
+        return acked, buf
+
+    s = socket.create_connection(("127.0.0.1", server.ingest_port))
+    s.sendall(encode_frame(
+        FrameHeader(MessageType.EVENT, agent_id=8, seq=1),
+        _event_payload("old-boot")))
+    acked, buf = read_acks_until(s, b"", 1)
+    assert acked == 1
+    # "restart": everything below the new epoch base is acked or dead
+    base = 1 << 32
+    s.sendall(encode_seq_base(8, base))
+    s.sendall(encode_frame(
+        FrameHeader(MessageType.EVENT, agent_id=8, seq=base),
+        _event_payload("new-boot")))
+    acked, _ = read_acks_until(s, buf, base)
+    s.close()
+    assert acked == base
+    assert server.wait_for_rows("event.event", 2)
+    assert len(server.db.table("event.event")) == 2
+
+
+def test_agent_restart_same_id_not_deduped(server):
+    """A restarted agent reuses its agent_id with a fresh epoch-seeded
+    seq space; the server must adopt it instead of dup-dropping every
+    frame against the old boot's watermark (the old always-from-1
+    counter lost ALL post-restart traffic this way)."""
+    from deepflow_tpu.agent.sender import UniformSender
+    n = 15
+    bases = []
+    for boot in range(2):
+        sender = UniformSender([("127.0.0.1", server.ingest_port)],
+                               agent_id=11).start()
+        bases.append(sender.seq_base)
+        for i in range(n):
+            assert sender.send(MessageType.EVENT,
+                               _event_payload(f"boot{boot}-{i}"))
+        assert server.wait_for_rows("event.event", n * (boot + 1),
+                                    timeout=10)
+        sender.flush_and_stop()
+    assert bases[1] > bases[0]  # the second boot's epoch is above
+    assert len(server.db.table("event.event")) == 2 * n
 
 
 def test_shutdown_backoff_is_interruptible():
