@@ -1,7 +1,8 @@
 PYTHON ?= python
 JAX_ENV := env JAX_PLATFORMS=cpu
 
-.PHONY: test selfmon-check cluster-check steps-check chaos-check bench native
+.PHONY: test selfmon-check cluster-check steps-check chaos-check ha-check \
+	bench native
 
 test:
 	timeout -k 10 870 $(JAX_ENV) $(PYTHON) -m pytest tests/ -q -m 'not slow' \
@@ -25,6 +26,13 @@ cluster-check:
 # the store exactly once and all hop ledgers balance.
 chaos-check:
 	timeout -k 10 120 $(JAX_ENV) $(PYTHON) -m deepflow_tpu.cli.chaos_check
+
+# Replicated-ingest failover run: 3 subprocess shards at R=2, a sender
+# fleet shipping to consistent-hash ring owners, one shard SIGKILLed
+# mid-stream; exits non-zero unless federated queries stay EXACT (no
+# missing shards, count equals frames sent) with zero HIGH loss.
+ha-check:
+	timeout -k 10 300 $(JAX_ENV) $(PYTHON) -m deepflow_tpu.cli.ha_check
 
 # Brief e2e run of the step-health pipeline: synthetic 4-device pod with
 # one injected 2x-slow device; exits non-zero unless the regression

@@ -40,14 +40,27 @@ class Agent:
             self.telemetry, window_s=sm.deadman_window_s,
             check_interval_s=sm.check_interval_s or None,
             on_wedge=self._on_wedge)
-        self.sender = UniformSender(
-            self.config.sender.servers, agent_id=self.config.agent_id,
-            queue_size=self.config.sender.queue_size,
-            telemetry=self.telemetry,
-            durable=self.config.sender.durable,
-            ack_window=self.config.sender.ack_window,
-            spool=self._build_spool(),
-            chaos=self._build_chaos())
+        if self.config.sender.replication > 1:
+            from deepflow_tpu.agent.sender import ReplicatedSender
+            self.sender = ReplicatedSender(
+                self.config.sender.servers,
+                replication=self.config.sender.replication,
+                agent_id=self.config.agent_id,
+                queue_size=self.config.sender.queue_size,
+                telemetry=self.telemetry,
+                durable=self.config.sender.durable,
+                ack_window=self.config.sender.ack_window,
+                spool_factory=self._build_spool_factory(),
+                chaos=self._build_chaos())
+        else:
+            self.sender = UniformSender(
+                self.config.sender.servers, agent_id=self.config.agent_id,
+                queue_size=self.config.sender.queue_size,
+                telemetry=self.telemetry,
+                durable=self.config.sender.durable,
+                ack_window=self.config.sender.ack_window,
+                spool=self._build_spool(),
+                chaos=self._build_chaos())
         self.sampler: OnCpuSampler | None = None
         self.memprofiler = None
         self.extprofilers: list = []
@@ -85,6 +98,26 @@ class Agent:
             f"deepflow-spool-{self.config.agent_id}")
         return Spool(directory, max_bytes=sc.max_mb << 20,
                      segment_bytes=sc.segment_mb << 20)
+
+    def _build_spool_factory(self):
+        """Replicated transport: one spool SUBDIRECTORY per destination
+        (each destination has its own seq space; sharing a spool would
+        interleave them and break trim/replay watermarks)."""
+        sc = self.config.sender.spool
+        if not sc.enabled:
+            return None
+        import tempfile
+        from deepflow_tpu.agent.spool import Spool
+        base = sc.dir or os.path.join(
+            tempfile.gettempdir(),
+            f"deepflow-spool-{self.config.agent_id}")
+
+        def factory(dest_key: str):
+            return Spool(os.path.join(base, dest_key),
+                         max_bytes=sc.max_mb << 20,
+                         segment_bytes=sc.segment_mb << 20)
+
+        return factory
 
     def _build_chaos(self):
         # DF_CHAOS (env) wins over the config block; the sender also

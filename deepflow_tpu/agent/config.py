@@ -98,6 +98,12 @@ class SenderConfig:
     durable: bool = True
     # sent-but-unacked frames kept for retransmit after a reconnect
     ack_window: int = 1024
+    # replication factor R: ship every HIGH/MID frame to the first R
+    # servers (independent seq/ack/spool per destination) so a dead
+    # primary's frames land durably on a replica. 1 = single-copy
+    # (plain UniformSender, pre-replication behavior). Normally pushed
+    # down from the controller's ring via analyzer_addrs.
+    replication: int = 1
     spool: SpoolConfig = field(default_factory=SpoolConfig)
 
 
@@ -230,6 +236,7 @@ class AgentConfig:
         num(self.selfmon.check_interval_s, "selfmon.check_interval_s", 0)
         num(self.sender.queue_size, "sender.queue_size", 1)
         num(self.sender.ack_window, "sender.ack_window", 1)
+        num(self.sender.replication, "sender.replication", 1, 8)
         num(self.sender.spool.max_mb, "sender.spool.max_mb", 1)
         num(self.sender.spool.segment_mb, "sender.spool.segment_mb", 1)
         if self.sender.spool.segment_mb > self.sender.spool.max_mb:
@@ -321,6 +328,9 @@ _TEMPLATE_DOCS = {
     "sender.durable": "per-frame seq + server ACK + retransmit "
                       "(at-least-once); false = legacy v1 fire-and-forget",
     "sender.ack_window": "sent-but-unacked frames kept for retransmit",
+    "sender.replication": "ship HIGH/MID frames to the first R servers "
+                          "(per-destination seq/ack/spool); 1 = "
+                          "single-copy",
     "sender.spool.enabled": "spill overflow/unsent frames to disk and "
                             "replay them on reconnect",
     "sender.spool.dir": "segment directory; empty = tmpdir",

@@ -269,6 +269,60 @@ class UniformSender:
         if self.spool is not None:
             self.spool.close()
 
+    def drain_unsent(self) -> list[tuple[MessageType, bytes]]:
+        """Stop this sender and hand back every frame NOT yet acked, in
+        seq order — the replication rebalance path: when a destination
+        loses ownership, its queued/unacked/spooled frames are re-shipped
+        to the new owners instead of being dropped with the sender.
+
+        Acked frames are excluded (they are durably at the old owner and
+        claimed there or by its replicas); an unacked frame that in fact
+        landed may be re-reported once after an ownership change —
+        delivery across rebalances is at-least-once, exactly-once within
+        a stable ring (docs/CLUSTER.md). Undelivered frames are closed
+        out on the ledger as dropped(rebalance); re-sending them through
+        a new sender re-emits them on the same hop, so the ledger stays
+        balanced end to end."""
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        frames: dict[int | None, _Frame] = {}
+        leftovers: list[_Frame] = []
+        while True:
+            try:
+                f = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if f.seq is None:
+                leftovers.append(f)
+            else:
+                frames.setdefault(f.seq, f)
+        self._close()  # moves _unacked into _pending
+        for f in self._pending:
+            if f.seq is None or f.seq > self._acked:
+                if f.seq is None:
+                    leftovers.append(f)
+                else:
+                    frames.setdefault(f.seq, f)
+        self._pending = []
+        if self.spool is not None:
+            for mt, seq, payload in self.spool.replay(self._acked):
+                if seq in frames:
+                    continue
+                try:
+                    msg_type = MessageType(mt)
+                except ValueError:
+                    continue
+                frames[seq] = _Frame(msg_type, payload, seq, None)
+            self.spool.close()
+        out = []
+        for f in sorted(frames.values(), key=lambda fr: fr.seq) + leftovers:
+            if f.needs_account:
+                self._drop(f, "rebalance")
+            out.append((f.msg_type, f.payload))
+        return out
+
     def _spool_backlog(self) -> bool:
         """True while the spool holds records not yet handed to replay."""
         return (self.durable and self.spool is not None
@@ -547,3 +601,188 @@ class UniformSender:
                     self._load_replay()
                 continue
             self._send_frame(f)
+
+
+class ReplicatedSender:
+    """Replicated shipping: one independent UniformSender per owner
+    destination, HIGH/MID frames fanned to all of them, LOW frames to
+    the primary only (sheddable data doesn't earn R copies).
+
+    Each destination gets its OWN seq space, ack window, and spool
+    subdirectory — per-server watermarks are already independent on the
+    server side, so the existing seq/ack/spool machinery applies per
+    destination unchanged: a dead primary's frames sit durably in its
+    replica senders' windows/spools and the replicas' copies are what
+    the query-time claim filter promotes when the primary dies.
+
+    ``set_destinations`` (driven by the synchronizer's analyzer_addrs
+    path on a ring-epoch bump) rebalances without dropping frames: a
+    removed destination's un-acked/spooled frames are harvested via
+    ``drain_unsent`` and re-shipped to the newly added owners (never to
+    retained ones, which already hold their own copies).
+
+    Duck-types the UniformSender surface the agent's components use:
+    send / start / flush_and_stop / servers / agent_id / stats /
+    queue_depth / peek.
+    """
+
+    def __init__(self, servers: list, replication: int = 2,
+                 agent_id: int = 0, org_id: int = 0, team_id: int = 0,
+                 queue_size: int = 8192, connect_timeout: float = 3.0,
+                 telemetry=None, spool_factory=None, ack_window: int = 1024,
+                 durable: bool = True, chaos=None) -> None:
+        if not servers:
+            raise ValueError("need at least one server address")
+        from deepflow_tpu.agent.config import _parse_addr
+        parsed = [_parse_addr(s) if isinstance(s, str) else tuple(s)
+                  for s in servers]
+        self.replication = max(1, int(replication))
+        self._agent_id = agent_id
+        self.org_id = org_id
+        self.team_id = team_id
+        self._kw = dict(queue_size=queue_size,
+                        connect_timeout=connect_timeout,
+                        telemetry=telemetry, ack_window=ack_window,
+                        durable=durable, chaos=chaos)
+        # spool_factory(dest_key) -> Spool | None: one spool dir per
+        # destination (their seq spaces are unrelated; sharing a spool
+        # would interleave them and break trim/replay watermarks)
+        self._spool_factory = spool_factory or (lambda key: None)
+        self._lock = threading.Lock()
+        self._senders: dict[tuple, UniformSender] = {}
+        self._order: list[tuple] = []
+        self._started = False
+        self.stats = {"rebalances": 0, "reshipped": 0}
+        for dest in parsed[:self.replication]:
+            self._add_dest(dest)
+
+    @staticmethod
+    def _dest_key(dest: tuple) -> str:
+        return f"{dest[0]}_{dest[1]}".replace(":", "_")
+
+    def _add_dest(self, dest: tuple) -> None:
+        s = UniformSender([dest], agent_id=self._agent_id,
+                          org_id=self.org_id, team_id=self.team_id,
+                          spool=self._spool_factory(self._dest_key(dest)),
+                          **self._kw)
+        self._senders[dest] = s
+        self._order.append(dest)
+        if self._started:
+            s.start()
+
+    # -- UniformSender surface ----------------------------------------------
+
+    @property
+    def agent_id(self) -> int:
+        return self._agent_id
+
+    @agent_id.setter
+    def agent_id(self, v: int) -> None:
+        self._agent_id = v
+        with self._lock:
+            for s in self._senders.values():
+                s.agent_id = v
+
+    @property
+    def servers(self) -> list:
+        with self._lock:
+            return list(self._order)
+
+    @servers.setter
+    def servers(self, addrs: list) -> None:
+        self.set_destinations(addrs)
+
+    def start(self) -> "ReplicatedSender":
+        with self._lock:
+            self._started = True
+            for s in self._senders.values():
+                s.start()
+        return self
+
+    def send(self, msg_type: MessageType, payload: bytes) -> bool:
+        with self._lock:
+            if not self._order:
+                return False
+            if priority_of(msg_type) >= 2:   # LOW: primary only
+                targets = [self._senders[self._order[0]]]
+            else:
+                targets = [self._senders[d] for d in self._order]
+        ok = False
+        for s in targets:
+            ok = s.send(msg_type, payload) or ok
+        return ok
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return max((s.queue_depth()
+                        for s in self._senders.values()), default=0)
+
+    def peek(self, n: int = 8) -> list:
+        with self._lock:
+            primary = self._senders.get(self._order[0]) \
+                if self._order else None
+        return primary.peek(n) if primary is not None else []
+
+    def flush_and_stop(self, timeout: float = 5.0) -> None:
+        with self._lock:
+            senders = list(self._senders.values())
+        threads = [threading.Thread(
+            target=s.flush_and_stop, kwargs={"timeout": timeout},
+            daemon=True) for s in senders]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=timeout + 2.0)
+
+    # -- rebalance -----------------------------------------------------------
+
+    def set_destinations(self, addrs: list) -> None:
+        """Adopt a new owner list (ring order, primary first). Senders
+        for retained destinations keep running untouched — their
+        windows, spools and seq spaces survive the rebalance — so no
+        spooled or un-acked frame is dropped on an epoch bump."""
+        from deepflow_tpu.agent.config import _parse_addr
+        parsed = [_parse_addr(a) if isinstance(a, str) else tuple(a)
+                  for a in addrs][:self.replication]
+        with self._lock:
+            if parsed == self._order or not parsed:
+                return
+            removed = [d for d in self._order if d not in parsed]
+            added = [d for d in parsed if d not in self._senders]
+            harvested: list[tuple] = []
+            for dest in removed:
+                s = self._senders.pop(dest)
+                harvested.extend(s.drain_unsent())
+            for dest in added:
+                self._add_dest(dest)
+            self._order = parsed
+            new_targets = [self._senders[d] for d in added]
+            self.stats["rebalances"] += 1
+        # re-ship a lost owner's outstanding frames to the NEW owners
+        # only: retained destinations already hold their own copies, and
+        # a second copy there would be a same-shard duplicate row (each
+        # boot's seq space is fresh, so the server-side dedup window
+        # cannot catch it)
+        if harvested and new_targets:
+            for mt, payload in harvested:
+                for s in new_targets:
+                    s.send(mt, payload)
+            self.stats["reshipped"] += len(harvested)
+
+    # -- diagnostics ---------------------------------------------------------
+
+    def stat_totals(self) -> dict:
+        """Summed per-destination UniformSender stats (diagnostics)."""
+        out: dict = {}
+        with self._lock:
+            senders = list(self._senders.values())
+        for s in senders:
+            for k, v in s.stats.items():
+                if isinstance(v, (int, float)):
+                    out[k] = out.get(k, 0) + v
+        return out
+
+    def per_destination(self) -> dict:
+        with self._lock:
+            return {f"{h}:{p}": dict(s.stats)
+                    for (h, p), s in self._senders.items()}

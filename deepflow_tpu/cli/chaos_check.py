@@ -24,10 +24,29 @@ Scenario (seeded fault schedule, wired as `make chaos-check`):
        * the hop ledgers of both sender boots and server B balance
          (emitted == delivered + dropped(reason): nothing vanished
          without a named reason)
+
+A second phase then validates the HARD-kill bound documented in
+docs/ROBUSTNESS.md ("Scope of the exactly-once claim"): the server
+runs as a SUBPROCESS and is SIGKILLed mid-stream — no decoder drain,
+no watermark persist, in-memory tables gone. Because acks only follow
+decode+write, the admissible loss is EXACTLY the frames the agent saw
+acked before the kill (their rows died with the process and their
+acks pruned them from the retransmit window). The phase fails unless,
+after a restart on the same port + data_dir:
+
+  * every frame UNACKED at kill time landed (retransmitted from the
+    window/spool — zero loss outside the documented bound),
+  * every frame sent AFTER the kill landed,
+  * the missing set is precisely the acked-before-kill prefix, and
+  * no frame landed twice (restart floors + dedup hold under SIGKILL).
 """
 
 from __future__ import annotations
 
+import os
+import signal
+import socket
+import subprocess
 import sys
 import tempfile
 import time
@@ -69,6 +88,108 @@ def _check_ledgers(telemetry, who: str) -> None:
         if h["emitted"] != h["delivered"] + h["dropped_total"] \
                 + h["in_flight"]:
             _fail(f"{who} hop {h['hop']!r} ledger does not balance: {h}")
+
+
+def _hard_kill_phase() -> None:
+    """SIGKILL a subprocess server mid-stream; prove the documented
+    hard-crash loss bound is tight: missing == acked-before-kill,
+    everything else exactly once."""
+    from deepflow_tpu.agent.sender import UniformSender
+    from deepflow_tpu.agent.spool import Spool
+    from deepflow_tpu.codec import MessageType
+    from deepflow_tpu.server import Server
+    from deepflow_tpu.telemetry import Telemetry
+
+    n_pre, n_post = 120, 80
+    data_dir = tempfile.mkdtemp(prefix="df-chaos-hk-data-")
+    spool_dir = tempfile.mkdtemp(prefix="df-chaos-hk-spool-")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    log = open(os.path.join(data_dir, "server.log"), "wb")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "deepflow_tpu.server.server",
+         "--host", "127.0.0.1", "--query-host", "127.0.0.1",
+         "--ingest-port", str(port), "--query-port", "0",
+         "--sync-port", "0", "--no-controller", "--data-dir", data_dir],
+        stdout=log, stderr=subprocess.STDOUT,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    deadline = time.time() + 30.0
+    while time.time() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port),
+                                     timeout=0.5).close()
+            break
+        except OSError:
+            time.sleep(0.2)
+    else:
+        _fail("hard-kill: subprocess server never listened")
+
+    telemetry = Telemetry("agent", enabled=True)
+    sender = UniformSender(
+        [("127.0.0.1", port)], agent_id=5, telemetry=telemetry,
+        spool=Spool(spool_dir)).start()
+    server = None
+    try:
+        # HIGH-only stream: frame i carries seq seq_base + i, so the
+        # agent's contiguous ack watermark translates 1:1 to step ids
+        for i in range(1, n_pre + 1):
+            sender.send(MessageType.STEP_METRICS, _step_payload(i))
+            time.sleep(0.002)
+        deadline = time.time() + 15.0
+        while time.time() < deadline and \
+                sender.stats["acked_seq"] <= sender.seq_base:
+            time.sleep(0.05)
+
+        proc.send_signal(signal.SIGKILL)   # no drain, no persist
+        proc.wait(timeout=10)
+        time.sleep(0.3)  # let the ack channel settle: watermark final
+        acked_kill = sender.stats["acked_seq"] - sender.seq_base
+        if not 0 < acked_kill <= n_pre:
+            _fail(f"hard-kill: acked watermark {acked_kill} outside "
+                  f"(0, {n_pre}] — scenario did not exercise the bound")
+        print(f"chaos-check: hard-kill at acked={acked_kill}/{n_pre}")
+
+        for i in range(n_pre + 1, n_pre + n_post + 1):
+            sender.send(MessageType.STEP_METRICS, _step_payload(i))
+            time.sleep(0.002)
+
+        # restart on the same port + data_dir (in-process: we read the
+        # store directly); the agent reconnects and replays its window
+        server = Server(host="127.0.0.1", ingest_port=port,
+                        query_port=0, data_dir=data_dir).start()
+        sender.flush_and_stop(timeout=60.0)
+        want = n_pre + n_post - acked_kill
+        server.wait_for_rows("profile.tpu_step_metrics", want,
+                             timeout=30.0)
+        time.sleep(0.5)
+        table = server.db.table("profile.tpu_step_metrics")
+        table.flush()
+        cols = table.column_concat(["step"])
+        steps = cols["step"].tolist() if len(table) else []
+        if len(steps) != len(set(steps)):
+            _fail(f"hard-kill: duplicate rows after SIGKILL recovery "
+                  f"({len(steps)} rows, {len(set(steps))} unique)")
+        missing = set(range(1, n_pre + n_post + 1)) - set(steps)
+        bound = set(range(1, acked_kill + 1))
+        if missing != bound:
+            _fail(f"hard-kill: loss outside the documented bound — "
+                  f"missing {sorted(missing)} != acked-before-kill "
+                  f"prefix 1..{acked_kill} (sender stats: "
+                  f"{sender.stats})")
+        _check_ledgers(telemetry, "hard-kill sender")
+        print(f"chaos-check: hard-kill OK — lost exactly the "
+              f"{acked_kill} acked-before-kill frames, "
+              f"{want}/{want} others exactly once")
+    finally:
+        sender.flush_and_stop(timeout=1.0)
+        if server is not None:
+            server.stop()
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+        log.close()
 
 
 def main() -> int:
@@ -147,6 +268,9 @@ def main() -> int:
               f"retransmits={sender.stats['retransmits']} "
               f"spooled={sender.stats['spooled']} "
               f"replayed={sender.stats['replayed']} faults={faults}")
+        server_b.stop()
+        server_b = None
+        _hard_kill_phase()
         return 0
     finally:
         sender.flush_and_stop(timeout=1.0)

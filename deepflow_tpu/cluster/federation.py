@@ -19,6 +19,13 @@ Merge semantics (docs/CLUSTER.md spells out the contract):
   stack-string sums.
 - Degraded mode: a dead or timed-out shard never fails the query; its
   ids land in the "missing_shards" annotation of the partial result.
+- Replicated mode (a HashRing is active): every scatter ships the ring
+  snapshot + the alive set, each shard answers from its claim-filtered
+  view (exactly one alive owner reports each row), and a shard failure
+  triggers ONE re-scatter with the shrunk alive set so a dead primary's
+  rows get promoted to the surviving replica. When every dead shard is
+  covered (dead ⊆ ring members, |dead| ≤ R−1) the result is EXACT:
+  missing_shards stays empty and the dead ids land in covered_shards.
 """
 
 from __future__ import annotations
@@ -27,6 +34,7 @@ import time
 
 import numpy as np
 
+from deepflow_tpu.cluster.hashring import ClaimDbView, HashRing
 from deepflow_tpu.cluster.membership import (DEFAULT_TTL_S,
                                              ClusterMembership, Peer)
 from deepflow_tpu.cluster.remote import FanOut, ShardCallError
@@ -72,6 +80,7 @@ class _FederatedPromDb:
         self._coord = coord
         self._db = coord.db
         self.missing_shards: set[int] = set()
+        self.fed_info: dict = {}
 
     def table(self, name: str):
         return self._db.table(name)
@@ -83,17 +92,18 @@ class _FederatedPromDb:
         return getattr(self._db, name)
 
     def promql_fetch_raw(self, vs, lo_s: float, hi_s: float):
-        local_unknown = False
-        try:
-            local = promql.fetch_raw(self._db, vs, lo_s, hi_s)
-        except promql.UnknownMetricError:
-            local, local_unknown = [], True
-        results, missing = self._coord.scatter(
+        results, info, db = self._coord.scatter_claim(
             {"op": "promql_raw", "metric": vs.metric,
              "matchers": [list(m) for m in vs.matchers],
              "lo_s": float(lo_s), "hi_s": float(hi_s)},
             hop_name="cluster.promql")
-        self.missing_shards.update(missing)
+        self.missing_shards.update(info["missing_shards"])
+        self.fed_info = info
+        local_unknown = False
+        try:
+            local = promql.fetch_raw(db, vs, lo_s, hi_s)
+        except promql.UnknownMetricError:
+            local, local_unknown = [], True
         remote_known = False
         merged: dict[tuple, promql.RawSeries] = {}
 
@@ -154,9 +164,60 @@ class FederationCoordinator:
         stays on the plain local path, zero overhead.)"""
         return bool(self.remote_peers())
 
+    def ring(self) -> HashRing | None:
+        return self.membership.ring
+
     def scatter(self, body: dict,
                 hop_name: str) -> tuple[dict[int, object], list[int]]:
         return self.fanout.scatter(self.remote_peers(), body, hop_name)
+
+    def _prune_clients(self) -> None:
+        snap = self.membership.directory.snapshot()
+        self.fanout.prune({p["addr"] for p in snap["peers"]})
+
+    def scatter_claim(self, body: dict, hop_name: str):
+        """Replica-exact scatter: -> (results, fed_info, local_db).
+
+        Without a ring this is the PR-3 degraded path (raw local db,
+        missing_shards annotated). With a ring, the op body carries the
+        ring snapshot and the alive set; every shard — including this
+        one, via the returned claim-view — reports each row exactly
+        once: the row's first alive owner claims it. A failed shard
+        triggers one re-scatter to the survivors with the shrunk alive
+        set, because the survivors' first-round answers were computed
+        assuming the dead shard would claim its own rows. The local
+        partial MUST be computed from the returned db AFTER this call,
+        so it sees the final alive set."""
+        self._prune_clients()
+        ring = self.ring()
+        peers = self.remote_peers()
+        if ring is None:
+            results, missing = self.fanout.scatter(peers, body, hop_name)
+            return results, self._info(results, missing), self.db
+        alive = {self.shard_id} | {p.shard_id for p in peers}
+        dead: set[int] = set()
+        remaining = list(peers)
+        results: dict[int, object] = {}
+        failed: list[int] = []
+        for _round in range(3):
+            b = dict(body)
+            b["ring"] = ring.snapshot()
+            b["alive"] = sorted(alive)
+            results, failed = self.fanout.scatter(remaining, b, hop_name)
+            if not failed:
+                break
+            dead.update(failed)
+            alive -= set(failed)
+            remaining = [p for p in remaining
+                         if p.shard_id not in set(failed)]
+        exact = not failed and ring.covers(dead)
+        info = {"shards": 1 + len(results) + len(dead),
+                "missing_shards": [] if exact else sorted(dead),
+                "ring_epoch": ring.epoch}
+        if exact and dead:
+            info["covered_shards"] = sorted(dead)
+        local_db = ClaimDbView(self.db, ring, self.shard_id, alive)
+        return results, info, local_db
 
     def _info(self, results: dict, missing: list[int]) -> dict:
         return {"shards": 1 + len(results) + len(missing),
@@ -176,11 +237,12 @@ class FederationCoordinator:
                 "table": table.name}
         if org_id is not None:
             body["org_id"] = org_id
-        results, missing = self.scatter(body, hop_name="cluster.sql")
-        partials = [engine.execute_partial(table, select)]
+        results, info, db = self.scatter_claim(body, hop_name="cluster.sql")
+        local = db.table(table.name) if db is not self.db else table
+        partials = [engine.execute_partial(local, select)]
         partials.extend(results[sid] for sid in sorted(results))
         res = engine.merge_partials(table, select, partials)
-        return res, self._info(results, missing)
+        return res, info
 
     # -- PromQL -------------------------------------------------------------
 
@@ -190,38 +252,43 @@ class FederationCoordinator:
     # -- Tempo / tracing ----------------------------------------------------
 
     def tempo_search(self, scan_fn, params: dict):
-        """scan_fn: the local shard's scan (querier._tempo_scan)."""
-        results, missing = self.scatter(
+        """scan_fn(params, db): the local shard's scan
+        (querier._tempo_scan), run against the claim-filtered view so
+        the local partial is computed AFTER the scatter settles the
+        alive set."""
+        results, info, db = self.scatter_claim(
             {"op": "tempo_scan", "params": params},
             hop_name="cluster.tempo")
-        parts = [scan_fn(params)]
+        parts = [scan_fn(params, db)]
         parts.extend(results[sid]["traces"] for sid in sorted(results))
-        return merge_tempo_partials(parts), self._info(results, missing)
+        return merge_tempo_partials(parts), info
 
-    def trace_spans(self, local_spans: list[dict], trace_id: str):
-        """Union span dicts across shards; build_trace_from_spans dedups
-        by (span_id, start_ns, flow_id) at assembly."""
-        results, missing = self.scatter(
+    def trace_spans(self, collect_fn, trace_id: str):
+        """collect_fn(trace_id, db) -> span dicts; union across shards,
+        build_trace_from_spans dedups by (span_id, start_ns, flow_id)
+        at assembly."""
+        results, info, db = self.scatter_claim(
             {"op": "trace_spans", "trace_id": trace_id},
             hop_name="cluster.trace")
-        spans = list(local_spans)
+        spans = list(collect_fn(trace_id, db))
         for sid in sorted(results):
             spans.extend(results[sid]["spans"])
-        return spans, self._info(results, missing)
+        return spans, info
 
     # -- flame graphs -------------------------------------------------------
 
-    def flame_stacks(self, local_part: tuple[list, list], params: dict):
-        """Sum per-shard (stacks, values) by stack string before one
-        build_flame_tree at the coordinator."""
-        results, missing = self.scatter(
+    def flame_stacks(self, flame_fn, params: dict):
+        """flame_fn(params, db) -> (stacks, values); sum per-shard
+        partials by stack string before one build_flame_tree at the
+        coordinator."""
+        results, info, db = self.scatter_claim(
             {"op": "profile_flame", "params": params},
             hop_name="cluster.flame")
-        parts = [local_part]
+        parts = [flame_fn(params, db)]
         for sid in sorted(results):
             r = results[sid]
             parts.append((r["stacks"], r["values"]))
-        return merge_stack_values(parts), self._info(results, missing)
+        return merge_stack_values(parts), info
 
     # -- dfctl / status -----------------------------------------------------
 
@@ -261,7 +328,16 @@ class FederationCoordinator:
                     entry["alive"] = False
                     entry["error"] = str(e)
             rows.append(entry)
-        return {"shard_id": self.shard_id,
-                "version": self.membership.directory.version,
-                "peers": rows,
-                "fanout": self.fanout.stats()}
+        out = {"shard_id": self.shard_id,
+               "version": self.membership.directory.version,
+               "peers": rows,
+               "fanout": self.fanout.stats()}
+        ring = self.ring()
+        if ring is not None:
+            # NOTE: per-shard "rows" above are RAW counts — with
+            # replication each HIGH/MID row exists on R shards, so the
+            # sum over peers overstates the logical row count by ~R×.
+            out["ring"] = {"epoch": ring.epoch, "token": ring.token,
+                           "replication": ring.replication,
+                           "members": sorted(ring.members)}
+        return out

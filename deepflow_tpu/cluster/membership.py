@@ -30,16 +30,19 @@ class Peer:
     addr: str                 # "host:query_port" serving /v1/shard/exec
     epoch: int                # process start time (ns) — restarts bump it
     last_seen_ns: int = 0
+    ingest_addr: str = ""     # "host:ingest_port" for agent frame traffic
 
     def to_dict(self) -> dict:
         return {"shard_id": self.shard_id, "addr": self.addr,
-                "epoch": self.epoch, "last_seen_ns": self.last_seen_ns}
+                "epoch": self.epoch, "last_seen_ns": self.last_seen_ns,
+                "ingest_addr": self.ingest_addr}
 
     @classmethod
     def from_dict(cls, d: dict) -> "Peer":
         return cls(shard_id=int(d["shard_id"]), addr=str(d["addr"]),
                    epoch=int(d.get("epoch", 0)),
-                   last_seen_ns=int(d.get("last_seen_ns", 0)))
+                   last_seen_ns=int(d.get("last_seen_ns", 0)),
+                   ingest_addr=str(d.get("ingest_addr", "")))
 
 
 @dataclass
@@ -56,7 +59,8 @@ class PeerDirectory:
         with self._lock:
             cur = self._peers.get(peer.shard_id)
             changed = (cur is None or cur.addr != peer.addr
-                       or cur.epoch != peer.epoch)
+                       or cur.epoch != peer.epoch
+                       or cur.ingest_addr != peer.ingest_addr)
             if changed:
                 self.version += 1
             peer.last_seen_ns = peer.last_seen_ns or time.time_ns()
@@ -111,7 +115,10 @@ class ClusterMembership:
         self.directory = PeerDirectory()
         self.heartbeat_s = heartbeat_s
         self.telemetry = telemetry
-        self.stats = {"joins": 0, "join_errors": 0}
+        self.stats = {"joins": 0, "join_errors": 0, "ring_adoptions": 0}
+        self.ingest_addr = ""      # set by the server once receiver binds
+        self.ring = None           # adopted/authored HashRing (replication)
+        self._ring_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -121,28 +128,72 @@ class ClusterMembership:
 
     def self_peer(self) -> Peer:
         return Peer(shard_id=self.shard_id, addr=self.advertise,
-                    epoch=self.epoch, last_seen_ns=time.time_ns())
+                    epoch=self.epoch, last_seen_ns=time.time_ns(),
+                    ingest_addr=self.ingest_addr)
+
+    # -- replication ring ---------------------------------------------
+    def adopt_ring(self, snap: dict | None) -> bool:
+        """Fenced, forward-only ring adoption: a snapshot wins only if
+        its (election token, epoch) pair is strictly newer than what we
+        hold — a deposed leader's stale ring can never clobber the
+        current one. Rings ride the join exchange in BOTH directions so
+        one heartbeat round-trip converges seed and joiner."""
+        if not snap:
+            return False
+        from deepflow_tpu.cluster.hashring import HashRing
+        ring = HashRing.from_snapshot(snap)
+        with self._ring_lock:
+            if not ring.newer_than(self.ring):
+                return False
+            self.ring = ring
+            self.stats["ring_adoptions"] += 1
+        log.info("cluster: adopted ring epoch %d (token %d, %d members)",
+                 ring.epoch, ring.token, len(ring.members))
+        return True
+
+    def publish_ring(self, ring) -> bool:
+        """Leader-side install of a freshly built ring (same fencing)."""
+        with self._ring_lock:
+            if not ring.newer_than(self.ring):
+                return False
+            self.ring = ring
+        return True
+
+    def ring_snapshot(self) -> dict | None:
+        with self._ring_lock:
+            return self.ring.snapshot() if self.ring is not None else None
 
     # -- seed side ----------------------------------------------------
     def handle_join(self, body: dict) -> dict:
-        """Register/refresh one peer, answer with the full directory."""
+        """Register/refresh one peer, answer with the full directory
+        (and the replication ring, when one is active)."""
         peer = Peer.from_dict(body)
         peer.last_seen_ns = time.time_ns()
         if self.directory.upsert(peer):
             log.info("cluster: shard %d at %s joined (epoch %d)",
                      peer.shard_id, peer.addr, peer.epoch)
+        self.adopt_ring(body.get("ring"))
         self.directory.upsert(self.self_peer())
-        return self.directory.snapshot()
+        out = self.directory.snapshot()
+        ring = self.ring_snapshot()
+        if ring is not None:
+            out["ring"] = ring
+        return out
 
     # -- joiner side --------------------------------------------------
     def _join_once(self) -> None:
+        body = self.self_peer().to_dict()
+        ring = self.ring_snapshot()
+        if ring is not None:
+            body["ring"] = ring
         req = urllib.request.Request(
             f"http://{self.seed}/v1/cluster/join",
-            data=json.dumps(self.self_peer().to_dict()).encode(),
+            data=json.dumps(body).encode(),
             headers={"Content-Type": "application/json"})
         with urllib.request.urlopen(req, timeout=3.0) as resp:
             snap = json.loads(resp.read())
         self.directory.adopt(snap)
+        self.adopt_ring(snap.get("ring"))
         self.stats["joins"] += 1
 
     def _loop(self) -> None:

@@ -131,6 +131,8 @@ class FanOut:
         self.hedge_delay_s = hedge_delay_s
         self.api_token = api_token
         self._clients: dict[str, ShardClient] = {}
+        self._last_used: dict[str, float] = {}
+        self.evicted = 0
         self._lock = threading.Lock()
         self._pool = ThreadPoolExecutor(
             max_workers=max_workers, thread_name_prefix="df-fanout")
@@ -148,7 +150,31 @@ class FanOut:
                     addr, timeout_s=self.timeout_s, retries=self.retries,
                     hedge_delay_s=self.hedge_delay_s,
                     api_token=self.api_token)
+            self._last_used[addr] = time.monotonic()
             return c
+
+    def prune(self, active_addrs: set[str] | list[str],
+              ttl_s: float = 300.0) -> int:
+        """Evict clients whose peer left the directory, or that no
+        scatter touched for ttl_s — long-lived coordinators would
+        otherwise accumulate one cached client per address ever seen
+        across rebalances. Safe mid-flight: a scatter already holds its
+        ShardClient reference, and clients keep no open sockets between
+        requests."""
+        horizon = time.monotonic() - ttl_s
+        active = set(active_addrs)
+        with self._lock:
+            stale = [a for a in self._clients
+                     if a not in active
+                     or self._last_used.get(a, 0.0) < horizon]
+            for a in stale:
+                del self._clients[a]
+                self._last_used.pop(a, None)
+            self.evicted += len(stale)
+        if stale:
+            log.info("cluster: evicted %d shard client(s): %s",
+                     len(stale), ", ".join(sorted(stale)))
+        return len(stale)
 
     def scatter(self, peers: list[Peer], body: dict,
                 hop_name: str) -> tuple[dict[int, object], list[int]]:

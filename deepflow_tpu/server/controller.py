@@ -386,9 +386,13 @@ class Controller:
 
     def __init__(self, platform_table: PlatformInfoTable,
                  host: str = "127.0.0.1", port: int = 20035,
-                 pod_index=None) -> None:
+                 pod_index=None, ring_provider=None) -> None:
         self.platform_table = platform_table
         self.pod_index = pod_index  # K8s genesis resource model (server's)
+        # zero-arg callable -> HashRing | None: when a replication ring
+        # is active its per-agent owner order (primary first) wins over
+        # the flat analyzer list below
+        self.ring_provider = ring_provider
         self.registry = AgentRegistry()
         self.gpids = GpidAllocator()
         # agent-group -> org assignment (reference: controller/db org/team
@@ -465,9 +469,11 @@ class Controller:
             self.commands.deliver_results(request.command_results)
         for rc in self.commands.take_pending(agent_id):
             resp.commands.append(rc)
+        addrs = self.assign_analyzers(agent_id)
         with self._analyzer_lock:
-            resp.analyzer_assignment = self._analyzers_managed
-        for addr in self.assign_analyzers(agent_id):
+            resp.analyzer_assignment = (self._analyzers_managed
+                                        or bool(addrs))
+        for addr in addrs:
             resp.analyzer_addrs.append(addr)
         return resp
 
@@ -493,10 +499,20 @@ class Controller:
             return list(self._analyzers)
 
     def assign_analyzers(self, agent_id: int) -> list[str]:
-        """Rendezvous hashing: per-agent preference order over analyzers —
-        even spread, minimal churn when the node set changes (reference:
+        """Per-agent ingest destinations. With a replication ring
+        active, the ring's owner order (primary first, then replicas)
+        IS the assignment — the synchronizer pushes it down
+        analyzer_addrs and the agent's ReplicatedSender adopts it on
+        the next sync, completing a leader-driven rebalance. Otherwise:
+        rendezvous hashing over the flat analyzer list — even spread,
+        minimal churn when the node set changes (reference:
         controller/monitor analyzer rebalance)."""
         import hashlib
+        ring = self.ring_provider() if self.ring_provider else None
+        if ring is not None:
+            addrs = ring.ingest_addrs(agent_id)
+            if addrs:
+                return addrs
         with self._analyzer_lock:
             addrs = list(self._analyzers)
         if not addrs:

@@ -128,7 +128,7 @@ class Decoder:
                  pod_index=None, gpid_table=None,
                  workers: int | None = None, resources=None,
                  trace_trees=None, telemetry=None, dedup=None,
-                 seq_tracker=None) -> None:
+                 seq_tracker=None, ring=None) -> None:
         self.q = q
         self.db = db
         self.platform = platform
@@ -142,6 +142,12 @@ class Decoder:
         # after decode+write, so an ack implies store presence — a hard
         # server crash can only lose frames the agent will retransmit
         self.seq_tracker = seq_tracker
+        # replication (cluster/hashring.py): zero-arg callable returning
+        # the current HashRing (or None). When set, every ingested row
+        # is tagged with its agent's ring-primary owner_shard and the
+        # ring epoch — the coordinates the query-time claim filter
+        # dedups replica copies by.
+        self.ring = ring
         self.workers = workers if workers is not None else self.WORKERS
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
@@ -265,6 +271,21 @@ class Decoder:
     def handle(self, header: FrameHeader, payload: bytes) -> int:
         raise NotImplementedError
 
+    def _agent_tags(self, header: FrameHeader) -> dict:
+        """Universal tags for this frame's agent, plus — when a ring is
+        active — the replication coordinates (owner_shard, ring_epoch).
+        Server-local sinks bypass this and stay ring_epoch 0: their rows
+        exist in one copy and must be reported unconditionally."""
+        tags = self.platform.tags_for(header.agent_id)
+        ring = self.ring() if self.ring is not None else None
+        if ring is not None:
+            owners = ring.owners(header.agent_id)
+            if owners:
+                tags = dict(tags)
+                tags["owner_shard"] = owners[0]
+                tags["ring_epoch"] = ring.epoch
+        return tags
+
     def _clock_offset(self, header: FrameHeader) -> int:
         """NTP normalization: ns to add to this agent's absolute
         timestamps (sub-ms offsets are measurement noise, not skew)."""
@@ -317,7 +338,7 @@ class ProfileDecoder(Decoder):
 
     def handle(self, header: FrameHeader, payload: bytes) -> int:
         batch = pb.ProfileBatch.FromString(payload)
-        tags = self.platform.tags_for(header.agent_id)
+        tags = self._agent_tags(header)
         off = self._clock_offset(header)
         rows = []
         for p in batch.profiles:
@@ -346,7 +367,7 @@ class TpuSpanDecoder(Decoder):
 
     def handle(self, header: FrameHeader, payload: bytes) -> int:
         batch = pb.TpuSpanBatch.FromString(payload)
-        tags = self.platform.tags_for(header.agent_id)
+        tags = self._agent_tags(header)
         off = self._clock_offset(header)
         rows = []
         for s in batch.spans:
@@ -405,7 +426,7 @@ class StepMetricsDecoder(Decoder):
     def handle(self, header: FrameHeader, payload: bytes) -> int:
         from deepflow_tpu.tpuprobe.stepmetrics import decode_step_payload
         obj = decode_step_payload(payload)
-        tags = self.platform.tags_for(header.agent_id)
+        tags = self._agent_tags(header)
         off = self._clock_offset(header)
         pid = int(obj.get("pid") or 0)
         pname = str(obj.get("process_name") or "")
@@ -664,7 +685,7 @@ class FlowLogDecoder(Decoder):
             # the rare case in TPU fleets)
             if res is not None and not res[1]["is_v6"].any():
                 n_l4, cols, l7segs, arena = res
-                tags = self.platform.tags_for(header.agent_id)
+                tags = self._agent_tags(header)
                 off = self._clock_offset(header)
                 n = 0
                 if n_l4:
@@ -690,7 +711,7 @@ class FlowLogDecoder(Decoder):
                         n += self._handle_l7_list(l7, tags, off)
                 return n
         batch = pb.FlowLogBatch.FromString(payload)
-        tags = self.platform.tags_for(header.agent_id)
+        tags = self._agent_tags(header)
         # NTP normalization: shift this agent's absolute timestamps onto
         # the controller clock (reference corrects on-agent in rpc/ntp.rs;
         # here ingest-side so every telemetry family is covered at one
@@ -1033,7 +1054,7 @@ class MetricsDecoder(Decoder):
 
     def handle(self, header: FrameHeader, payload: bytes) -> int:
         batch = pb.DocumentBatch.FromString(payload)
-        tags = self.platform.tags_for(header.agent_id)
+        tags = self._agent_tags(header)
         off_s = round(self._clock_offset(header) / 1e9)  # table is 1s-grain
         n = 0
 
@@ -1117,7 +1138,7 @@ class StatsDecoder(Decoder):
 
     def handle(self, header: FrameHeader, payload: bytes) -> int:
         batch = pb.StatsBatch.FromString(payload)
-        tags = self.platform.tags_for(header.agent_id)
+        tags = self._agent_tags(header)
         off = self._clock_offset(header)
         rows = []
         for m in batch.metrics:
@@ -1157,7 +1178,7 @@ class EventDecoder(Decoder):
 
     def handle(self, header: FrameHeader, payload: bytes) -> int:
         batch = pb.EventBatch.FromString(payload)
-        tags = self.platform.tags_for(header.agent_id)
+        tags = self._agent_tags(header)
         off = self._clock_offset(header)
         rows = [{
             "time": e.timestamp_ns + off,

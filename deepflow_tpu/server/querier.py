@@ -191,9 +191,12 @@ class QuerierAPI:
         fed = self._fed()
         if fed is not None:
             from deepflow_tpu.query.flamegraph import build_flame_tree
-            local = self._flame_stacks(params)
-            (stacks, values), info = fed.flame_stacks(
-                (local["stacks"], local["values"]), params)
+
+            def flame_fn(p, db):
+                part = self._flame_stacks(p, db)
+                return part["stacks"], part["values"]
+
+            (stacks, values), info = fed.flame_stacks(flame_fn, params)
             return {"result": build_flame_tree(stacks, values).to_dict(),
                     "federation": info}
         tree = profile_flame_tree(
@@ -206,11 +209,12 @@ class QuerierAPI:
         )
         return {"result": tree.to_dict()}
 
-    def _flame_stacks(self, params: dict) -> dict:
+    def _flame_stacks(self, params: dict, db=None) -> dict:
         """Shard-local half of a federated flame graph: aggregate by
         stack in this shard's encoded space, return DECODED stacks."""
         from deepflow_tpu.query.flamegraph import profile_stack_values
-        table = self.db.table("profile.in_process_profile")
+        table = (db if db is not None else self.db).table(
+            "profile.in_process_profile")
         stacks, values = profile_stack_values(
             table,
             time_start_ns=params.get("time_start"),
@@ -529,8 +533,13 @@ class QuerierAPI:
     @staticmethod
     def _prom_annotate(out: dict, db) -> dict:
         missing = sorted(getattr(db, "missing_shards", ()))
+        info = dict(getattr(db, "fed_info", None) or {})
+        # annotate only when there is something to say — a fully healthy
+        # federated answer stays byte-identical to a standalone one
+        if missing or info.get("covered_shards"):
+            info["missing_shards"] = missing
+            out["federation"] = info
         if missing:
-            out["federation"] = {"missing_shards": missing}
             out.setdefault("warnings", []).append(
                 f"partial result: shards {missing} did not answer")
         return out
@@ -653,11 +662,13 @@ class QuerierAPI:
     _TEMPO_TAGS = ("service.name", "endpoint", "l7.protocol",
                    "http.status_code")
 
-    def _tempo_scan(self, params: dict) -> list[dict]:
+    def _tempo_scan(self, params: dict, db=None) -> list[dict]:
         """Shard-local Tempo scan: one partial dict per trace seen HERE.
         Tags select per-SPAN, but start/end/duration are per-TRACE and a
         trace's spans may live on several shards — so duration filters
-        and the limit must NOT apply here; only at the merge/finalize."""
+        and the limit must NOT apply here; only at the merge/finalize.
+        db: an optional claim-filtered view (replication) to scan
+        instead of the raw local store."""
         import re as _re
         import time as _time
         tags = {}
@@ -683,7 +694,8 @@ class QuerierAPI:
         if params.get("end"):
             where.append(
                 f"time < {int(float(params['end'])) * 1_000_000_000}")
-        table = self.db.table("flow_log.l7_flow_log")
+        table = (db if db is not None else self.db).table(
+            "flow_log.l7_flow_log")
         res = qengine.execute(
             table,
             "SELECT time, trace_id, app_service, request_type, endpoint, "
@@ -836,19 +848,23 @@ class QuerierAPI:
         tree = self.trace_adapters.merge_into(tree, trace_id)
         return {"result": tree}
 
-    def collect_trace_spans(self, trace_id: str) -> list[dict]:
+    def collect_trace_spans(self, trace_id: str, db=None) -> list[dict]:
         """This shard's span dicts for one trace. Prefers the ingest-time
         precompute (flow_log.trace_tree rows + TraceTreeBuilder pending
         spans): touches only this trace's data. Falls back to the l7 scan
         for data ingested before the builder existed (e.g. loaded from an
-        old data_dir)."""
+        old data_dir). db: optional claim-filtered view (replication) —
+        either way replica span copies also dedup at assembly by
+        (span_id, start_ns, flow_id)."""
         import json as _json
 
         import numpy as np
 
         from deepflow_tpu.query.tracing import scan_trace_spans
+        if db is None:
+            db = self.db
         spans: list[dict] = []
-        tree_table = self.db.table("flow_log.trace_tree")
+        tree_table = db.table("flow_log.trace_tree")
         code = tree_table.dicts["trace_id"].lookup(trace_id)
         if code is not None:
             for ch in tree_table.snapshot():
@@ -861,7 +877,7 @@ class QuerierAPI:
             spans.extend(self.trace_trees.pending_spans(trace_id))
         if not spans:
             spans = scan_trace_spans(
-                self.db.table("flow_log.l7_flow_log"), trace_id)
+                db.table("flow_log.l7_flow_log"), trace_id)
         return spans
 
     def _assemble_trace(self, trace_id: str, max_spans: int = 1000) -> dict:
@@ -869,11 +885,13 @@ class QuerierAPI:
         alive — every other shard's (one trace's spans may be ingested
         anywhere; build_trace_from_spans dedups on the merged set)."""
         from deepflow_tpu.query.tracing import build_trace_from_spans
-        spans = self.collect_trace_spans(trace_id)
         fed = self._fed()
         info = None
         if fed is not None:
-            spans, info = fed.trace_spans(spans, trace_id)
+            spans, info = fed.trace_spans(self.collect_trace_spans,
+                                          trace_id)
+        else:
+            spans = self.collect_trace_spans(trace_id)
         tree = build_trace_from_spans(
             trace_id, spans,
             tpu_table=self.db.table("profile.tpu_hlo_span"),
@@ -1080,8 +1098,14 @@ class QuerierAPI:
         cycle of shards can't amplify one query."""
         self._require_token(token, "/v1/shard/exec")
         op = body.get("op", "")
+        # a replication-aware coordinator ships a ring snapshot + alive
+        # set in the body; answer from the claim-filtered view so each
+        # replicated row is reported by exactly one alive owner. A
+        # pre-replication coordinator sends no ring: raw local answer.
+        from deepflow_tpu.cluster.hashring import claim_db_from_body
+        db = claim_db_from_body(body, self.db, self.shard_id)
         if op == "sql_partial":
-            table = (self.db.table(body["table"]) if body.get("table")
+            table = (db.table(body["table"]) if body.get("table")
                      else self._resolve_table("", ""))
             select = qsql.parse_statement(body.get("sql", ""))
             if not isinstance(select, qsql.Select):
@@ -1098,7 +1122,7 @@ class QuerierAPI:
                 metric=str(body.get("metric", "")),
                 matchers=[tuple(m) for m in body.get("matchers", [])])
             try:
-                series = promql.fetch_raw(self.db, vs,
+                series = promql.fetch_raw(db, vs,
                                           float(body.get("lo_s", 0)),
                                           float(body.get("hi_s", 0)))
             except promql.UnknownMetricError:
@@ -1107,12 +1131,13 @@ class QuerierAPI:
                 {"labels": s.labels, "t": s.t.tolist(), "v": s.v.tolist(),
                  "counter": bool(s.counter)} for s in series]}
         if op == "tempo_scan":
-            return {"traces": self._tempo_scan(body.get("params") or {})}
+            return {"traces": self._tempo_scan(body.get("params") or {},
+                                               db)}
         if op == "trace_spans":
             return {"spans": self.collect_trace_spans(
-                str(body.get("trace_id", "")))}
+                str(body.get("trace_id", "")), db)}
         if op == "profile_flame":
-            return self._flame_stacks(body.get("params") or {})
+            return self._flame_stacks(body.get("params") or {}, db)
         if op == "table_counts":
             return {name: len(self.db.table(name))
                     for name in self.db.tables()}

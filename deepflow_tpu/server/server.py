@@ -39,7 +39,8 @@ class Server:
                  cluster_seed: str | None = None,
                  cluster_advertise: str | None = None,
                  fanout_timeout_s: float = 5.0,
-                 fanout_hedge_delay_s: float = 0.25) -> None:
+                 fanout_hedge_delay_s: float = 0.25,
+                 replication: int = 0) -> None:
         # flow-log decode parallelism for THIS server instance; None
         # defers to the DF_INGEST_WORKERS env knob read at import time
         self.ingest_workers = ingest_workers
@@ -60,9 +61,16 @@ class Server:
                             or cluster_advertise is not None)
         self._fanout_timeout_s = fanout_timeout_s
         self._fanout_hedge_delay_s = fanout_hedge_delay_s
+        # replicated ingest: > 0 turns on the consistent-hash ring
+        # (cluster/hashring.py). The elected leader (or the seed, when
+        # no election is configured) builds/bumps the ring from the peer
+        # directory; everyone else adopts it via the join exchange.
+        self.replication = max(0, int(replication))
         self.membership = None
         self.fanout = None
         self.federation = None
+        self._ring_stop = threading.Event()
+        self._ring_thread: threading.Thread | None = None
         self.db = Database(data_dir=data_dir, shard_id=shard_id)
         self.platform = PlatformInfoTable()
         from deepflow_tpu.server.platform_info import (PodIpIndex,
@@ -95,7 +103,8 @@ class Server:
             else:
                 self.controller = Controller(
                     self.platform, host=host, port=sync_port,
-                    pod_index=self.pod_index)
+                    pod_index=self.pod_index,
+                    ring_provider=self._current_ring)
         from deepflow_tpu.server.alerting import (AlertEngine,
                                                   StepRegressionDetector)
         from deepflow_tpu.server.exporters import ExporterManager
@@ -181,6 +190,47 @@ class Server:
             self.db.table("deepflow_system.deepflow_system") \
                 .append_rows(rows)
 
+    def _current_ring(self):
+        """The adopted replication ring, or None (handed as a zero-arg
+        callable to decoders/controller built before membership is)."""
+        m = self.membership
+        return m.ring if m is not None else None
+
+    def _ring_tick(self) -> None:
+        """Leader-only ring maintenance: rebuild the ring whenever the
+        peer DIRECTORY changes (join, address move, restart). A shard
+        merely going silent does NOT bump the epoch — failover is the
+        query-time claim shift to the surviving replica, not a
+        rebalance. Fenced: the ring carries the election token, and
+        adoption everywhere is forward-only on (token, epoch)."""
+        m = self.membership
+        if m is None:
+            return
+        if self.election is not None:
+            if not self.election.is_leader:
+                return
+            token = self.election.token
+        elif not m.is_seed:
+            return
+        else:
+            token = 0
+        from deepflow_tpu.cluster.hashring import HashRing
+        snap = m.directory.snapshot()
+        members = {p["shard_id"]: {"addr": p["addr"],
+                                   "ingest": p.get("ingest_addr", "")}
+                   for p in snap["peers"]}
+        ring = HashRing.build(m.ring, members, self.replication, token)
+        if ring is not m.ring and m.publish_ring(ring):
+            log.info("ring: epoch %d published (token %d, members %s)",
+                     ring.epoch, ring.token, sorted(ring.members))
+
+    def _ring_loop(self) -> None:
+        while not self._ring_stop.wait(1.0):
+            try:
+                self._ring_tick()
+            except Exception:
+                log.exception("ring maintenance failed")
+
     def _ack_state_path(self) -> str | None:
         import os
         return (os.path.join(self.db.data_dir, "ack_state.json")
@@ -206,15 +256,27 @@ class Server:
             return {}
 
     def _save_ack_state(self) -> None:
+        # atomic: temp file + fsync + rename. A crash mid-write must
+        # leave either the old state or the new — a truncated floors
+        # file would poison dedup/ack seeding on the next boot.
         path = self._ack_state_path()
         if not path:
             return
+        import os
+        tmp = f"{path}.tmp.{os.getpid()}"
         try:
-            with open(path, "w") as f:
+            with open(tmp, "w") as f:
                 json.dump({str(k): v for k, v in
                            self.receiver.seq_tracker.snapshot().items()}, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
         except OSError:
             log.warning("ack state save failed", exc_info=True)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
 
     def start(self) -> "Server":
         if self.db.data_dir:
@@ -253,7 +315,8 @@ class Server:
                     gpid_table=(self.controller.gpids
                                 if self.controller else None),
                     telemetry=self.telemetry, dedup=self.dedup,
-                    seq_tracker=self.receiver.seq_tracker, **kw)
+                    seq_tracker=self.receiver.seq_tracker,
+                    ring=self._current_ring, **kw)
             d.MSG_TYPE = mtype  # FlowLogDecoder serves two types
             self.decoders.append(d.start())
         self.receiver.start()
@@ -269,7 +332,12 @@ class Server:
                    or f"127.0.0.1:{self.http.port}")
             self.membership = ClusterMembership(
                 self.shard_id, adv, seed=self.cluster_seed,
-                telemetry=self.telemetry).start()
+                telemetry=self.telemetry)
+            # agents ship frames to the RECEIVER port; peers gossip it
+            # so the ring can hand agent-facing ingest addrs around
+            self.membership.ingest_addr = (
+                f"{adv.rsplit(':', 1)[0]}:{self.receiver.port}")
+            self.membership.start()
             self.fanout = FanOut(
                 telemetry=self.telemetry,
                 timeout_s=self._fanout_timeout_s,
@@ -280,6 +348,11 @@ class Server:
                 shard_id=self.shard_id)
             self.api.membership = self.membership
             self.api.federation = self.federation
+            if self.replication > 0:
+                self._ring_stop.clear()
+                self._ring_thread = threading.Thread(
+                    target=self._ring_loop, name="df-ring", daemon=True)
+                self._ring_thread.start()
         self.alerts.start()
         self.step_detector.start()
         self.deadman.start()
@@ -344,6 +417,10 @@ class Server:
         if not self._started:
             return
         self.deadman.stop()
+        self._ring_stop.set()
+        if self._ring_thread is not None:
+            self._ring_thread.join(timeout=2.0)
+            self._ring_thread = None
         if self.membership is not None:
             self.membership.stop()
         if self.fanout is not None:
@@ -429,6 +506,11 @@ def main() -> None:
     parser.add_argument("--fanout-timeout-s", type=float, default=5.0,
                         help="per-shard scatter-gather call deadline; "
                              "slower shards degrade to missing_shards")
+    parser.add_argument("--replication", type=int, default=0,
+                        help="replication factor R for ingested HIGH/MID "
+                             "frames (0 = off): each agent ships to R "
+                             "ring owners; queries stay exact through "
+                             "R-1 simultaneous shard failures")
     parser.add_argument("--data-dir", default=None)
     parser.add_argument("--ha-lease", default=None,
                         help="shared-volume lease FILE for leader election")
@@ -453,6 +535,7 @@ def main() -> None:
                     cluster_seed=args.cluster_seed,
                     cluster_advertise=args.advertise,
                     fanout_timeout_s=args.fanout_timeout_s,
+                    replication=args.replication,
                     enable_controller=not args.no_controller).start()
     try:
         while True:

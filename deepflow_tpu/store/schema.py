@@ -39,6 +39,14 @@ UNIVERSAL_TAGS = [
     # coordinator GROUP BY shard_id to audit the split, and cluster-check
     # assert federated == union-of-shards.
     C("shard_id", "u16"),
+    # replication (cluster/hashring.py): the ring-computed PRIMARY owner
+    # of this row's agent at ingest time, plus the ring epoch it was
+    # computed under. ring_epoch 0 = single-copy row (standalone server,
+    # server-local sink, or pre-replication data) — always reported by
+    # its holder; >0 = one of R replica copies, reported only by the
+    # row's query-time claimant (first alive owner).
+    C("owner_shard", "u16"),
+    C("ring_epoch", "u32"),
     C("agent_id", "u16"),
     C("host_id", "u16"),
     C("host", "str"),

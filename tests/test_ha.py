@@ -43,6 +43,45 @@ def test_second_candidate_defers_then_takes_over(tmp_path):
     assert b.is_leader
 
 
+def test_sigkilled_leader_flock_released_and_fencing_advances(tmp_path):
+    """A SIGKILLed leader never resigns — but flock is kernel-owned, so
+    the lock drops with the process and a follower acquires within one
+    renew interval, with a strictly larger fencing token (a zombie
+    holder's writes stay fenceable)."""
+    import signal
+    import subprocess
+    import sys
+
+    lease = str(tmp_path / "lease")
+    child = subprocess.Popen(
+        [sys.executable, "-c",
+         "import sys, time\n"
+         "from deepflow_tpu.server.election import LeaderElection\n"
+         "el = LeaderElection(sys.argv[1], holder='child')\n"
+         "assert el.try_acquire()\n"
+         "print(el.token, flush=True)\n"
+         "time.sleep(60)\n",
+         lease],
+        stdout=subprocess.PIPE, text=True)
+    try:
+        child_token = int(child.stdout.readline().strip())
+        assert child_token >= 1
+        follower = LeaderElection(lease, holder="follower",
+                                  renew_interval_s=0.2)
+        assert follower.try_acquire() is False    # kernel lock held
+        child.send_signal(signal.SIGKILL)          # no resign, no drain
+        child.wait(timeout=10)
+        deadline = time.time() + follower.renew_interval_s + 5.0
+        while time.time() < deadline and not follower.try_acquire():
+            time.sleep(0.05)
+        assert follower.is_leader
+        assert follower.token > child_token        # strictly increases
+    finally:
+        if child.poll() is None:
+            child.kill()
+        child.stdout.close()
+
+
 def test_graceful_resign_hands_over(tmp_path):
     lease = str(tmp_path / "lease")
     a = LeaderElection(lease, holder="a", ttl_s=30.0)

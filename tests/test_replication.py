@@ -1,0 +1,360 @@
+"""Replicated ingest: consistent-hash ownership, query-time replica
+dedup, rebalance handover, and exact failover.
+
+Reference analogs: Trisolaris node managers (controller-pushed analyzer
+ownership), ingester recv_engine (per-destination seq spaces). The
+SIGKILL variant of the failover scenario runs in `make ha-check`
+(cli/ha_check.py) — here the dead shard is an in-process stop, which
+exercises the same query path (scatter failure -> claim shift).
+"""
+
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from deepflow_tpu.cluster.hashring import (ClaimTableView, HashRing,
+                                           claim_db_from_body)
+
+MEMBERS3 = {1: {"addr": "h1:1", "ingest": "h1:2"},
+            2: {"addr": "h2:1", "ingest": "h2:2"},
+            3: {"addr": "h3:1", "ingest": "h3:2"}}
+
+MS = 1_000_000
+
+
+def _step_payload(i: int, run_id: int = 3) -> bytes:
+    from deepflow_tpu.tpuprobe.stepmetrics import encode_step_payload
+    return encode_step_payload([{
+        "time": i * MS, "end_ns": i * MS + 500, "latency_ns": 500,
+        "run_id": run_id, "step": i, "job": "t", "device_count": 4,
+        "device_skew_ns": 0, "compute_ns": 1, "collective_ns": 1,
+        "straggler_device": 0, "straggler_lag_ns": 0, "top_hlos": []}])
+
+
+def _closed_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# -- ring placement ---------------------------------------------------------
+
+def test_placement_deterministic_and_replicated():
+    """Two independently built rings agree on every owner list — the
+    property that lets agents and every server compute placement
+    without coordination."""
+    a = HashRing(MEMBERS3, replication=2)
+    b = HashRing({k: dict(v) for k, v in MEMBERS3.items()}, replication=2)
+    for aid in range(300):
+        owners = a.owners(aid)
+        assert owners == b.owners(aid)
+        assert len(owners) == 2 and len(set(owners)) == 2
+        assert a.ingest_addrs(aid) == [MEMBERS3[s]["ingest"]
+                                       for s in owners]
+    # spread: every shard is SOME agent's primary, none owns everything
+    primaries = [a.owners(aid)[0] for aid in range(300)]
+    counts = {s: primaries.count(s) for s in (1, 2, 3)}
+    assert all(20 <= c <= 260 for c in counts.values()), counts
+
+
+def test_replication_capped_by_member_count():
+    ring = HashRing({1: {"addr": "a", "ingest": "a"}}, replication=3)
+    assert ring.owners(42) == [1]
+
+
+def test_build_bumps_epoch_only_on_change_and_keeps_history():
+    r1 = HashRing.build(None, {1: MEMBERS3[1]}, replication=2, token=0)
+    assert r1.epoch == 1
+    assert HashRing.build(r1, {1: MEMBERS3[1]}, 2, token=0) is r1
+    r2 = HashRing.build(r1, MEMBERS3, replication=2, token=0)
+    assert r2.epoch == 2
+    assert r2.history[1] == [1] and r2.history[2] == [1, 2, 3]
+    # rows tagged at epoch 1 still resolve against epoch 1's members
+    for aid in range(50):
+        assert r2.owners_at(aid, 1) == [1]
+        assert r2.owners_at(aid, 2) == r2.owners(aid)
+    # unknown epoch (evicted history) approximates with current members
+    assert r2.owners_at(7, 99) == r2.owners(7)
+
+
+def test_snapshot_roundtrip():
+    ring = HashRing.build(None, MEMBERS3, replication=2, token=5)
+    back = HashRing.from_snapshot(ring.snapshot())
+    assert back.epoch == ring.epoch and back.token == ring.token
+    assert back.members == ring.members and back.history == ring.history
+    for aid in range(100):
+        assert back.owners(aid) == ring.owners(aid)
+
+
+def test_fencing_token_dominates_epoch():
+    old = HashRing(MEMBERS3, epoch=9, token=1)
+    new = HashRing(MEMBERS3, epoch=2, token=2)
+    assert new.newer_than(old)          # deposed leader's ring loses
+    assert not old.newer_than(new)
+    assert HashRing(MEMBERS3, epoch=10, token=1).newer_than(old)
+    assert old.newer_than(None)
+
+
+def test_covers_r_minus_one_failures():
+    ring = HashRing(MEMBERS3, replication=2)
+    assert ring.covers(set())
+    assert ring.covers({2})             # any single member: covered
+    assert not ring.covers({2, 3})      # R-1 = 1 simultaneous failure
+    assert not ring.covers({9})         # never a member: single-copy
+
+
+def test_claimant_is_first_alive_owner():
+    ring = HashRing(MEMBERS3, replication=2)
+    for aid in range(100):
+        first, second = ring.owners(aid)
+        assert ring.claimant(aid, ring.epoch, {1, 2, 3}) == first
+        assert ring.claimant(aid, ring.epoch, {1, 2, 3} - {first}) \
+            == second
+        assert ring.claimant(aid, ring.epoch, set()) is None
+
+
+# -- query-time replica dedup ----------------------------------------------
+
+def _replicated_dbs(ring, agents, steps):
+    """Simulate R=2 ingest: each agent's rows land on BOTH owners,
+    tagged with the ring epoch — what the decoders do."""
+    from deepflow_tpu.store.db import Database
+    dbs = {sid: Database(shard_id=sid) for sid in ring.members}
+    for aid in agents:
+        rows = [{"time": i, "agent_id": aid, "run_id": aid, "step": i,
+                 "owner_shard": ring.owners(aid)[0],
+                 "ring_epoch": ring.epoch} for i in range(steps)]
+        for sid in ring.owners(aid):
+            dbs[sid].table("profile.tpu_step_metrics").append_rows(rows)
+    return dbs
+
+
+def test_claim_filter_reports_each_row_exactly_once():
+    ring = HashRing(MEMBERS3, replication=2)
+    agents = list(range(20, 30))
+    dbs = _replicated_dbs(ring, agents, steps=5)
+    raw = sum(len(db.table("profile.tpu_step_metrics"))
+              for db in dbs.values())
+    assert raw == 2 * len(agents) * 5   # physically duplicated
+    for alive in ({1, 2, 3}, {1, 2}, {2, 3}, {1, 3}):
+        views = [ClaimTableView(dbs[s].table("profile.tpu_step_metrics"),
+                                ring, s, alive) for s in alive]
+        total = sum(len(v) for v in views)
+        assert total == len(agents) * 5, (alive, total)
+        # and no key reported twice across shards
+        keys = []
+        for v in views:
+            cols = v.column_concat(["run_id", "step"])
+            keys += list(zip(cols["run_id"].tolist(),
+                             cols["step"].tolist()))
+        assert len(keys) == len(set(keys))
+
+
+def test_epoch_zero_rows_always_pass():
+    """Single-copy rows (standalone ingest, server-local sinks) are
+    reported unconditionally — the back-compat passthrough."""
+    ring = HashRing(MEMBERS3, replication=2)
+    mask = ring.claim_mask(np.array([7, 7]), np.array([0, 0]),
+                           self_shard=3, alive={1, 2, 3})
+    assert mask.all()
+
+
+def test_claim_db_from_body_passthrough_without_ring():
+    from deepflow_tpu.store.db import Database
+    db = Database(shard_id=1)
+    assert claim_db_from_body({}, db, 1) is db
+    view = claim_db_from_body(
+        {"ring": HashRing(MEMBERS3).snapshot(), "alive": [1, 2]}, db, 1)
+    assert view is not db and view.tables() == db.tables()
+
+
+# -- membership ring adoption -----------------------------------------------
+
+def test_membership_ring_adoption_is_fenced_forward_only():
+    from deepflow_tpu.cluster.membership import ClusterMembership
+    m = ClusterMembership(1, "127.0.0.1:1")
+    assert m.adopt_ring(HashRing(MEMBERS3, epoch=2, token=1).snapshot())
+    assert m.ring.epoch == 2
+    # stale epoch under the same token: rejected
+    assert not m.adopt_ring(HashRing(MEMBERS3, epoch=1, token=1).snapshot())
+    assert m.ring.epoch == 2
+    # higher fencing token wins even at a lower epoch
+    assert m.adopt_ring(HashRing(MEMBERS3, epoch=1, token=2).snapshot())
+    assert m.ring.token == 2 and m.stats["ring_adoptions"] == 2
+
+
+# -- rebalance handover ------------------------------------------------------
+
+def test_replicated_sender_reships_unacked_on_rebalance():
+    """A removed destination's never-delivered frames are harvested and
+    re-shipped to the newly added owner — not to retained owners, which
+    already hold their copies."""
+    from deepflow_tpu.agent.sender import ReplicatedSender
+    from deepflow_tpu.codec import MessageType
+    from deepflow_tpu.server import Server
+
+    a = Server(host="127.0.0.1", ingest_port=0, query_port=0).start()
+    c = Server(host="127.0.0.1", ingest_port=0, query_port=0).start()
+    dead = _closed_port()                     # owner that never answers
+    sender = ReplicatedSender(
+        [("127.0.0.1", a.ingest_port), ("127.0.0.1", dead)],
+        replication=2, agent_id=7).start()
+    try:
+        for i in range(1, 21):
+            sender.send(MessageType.STEP_METRICS, _step_payload(i))
+        assert a.wait_for_rows("profile.tpu_step_metrics", 20,
+                               timeout=15.0)
+        # ring epoch bump: dead owner out, shard c in
+        sender.set_destinations([("127.0.0.1", a.ingest_port),
+                                 ("127.0.0.1", c.ingest_port)])
+        assert sender.stats["rebalances"] == 1
+        assert sender.stats["reshipped"] >= 20
+        assert c.wait_for_rows("profile.tpu_step_metrics", 20,
+                               timeout=15.0)
+        time.sleep(0.3)
+        for srv in (a, c):                    # each copy exactly once
+            t = srv.db.table("profile.tpu_step_metrics")
+            t.flush()
+            steps = t.column_concat(["step"])["step"].tolist()
+            assert sorted(steps) == list(range(1, 21))
+    finally:
+        sender.flush_and_stop(timeout=2.0)
+        a.stop()
+        c.stop()
+
+
+def test_replicated_sender_low_priority_single_copy():
+    """LOW frames ship to the primary only — sheddable data does not
+    earn R copies."""
+    from deepflow_tpu.agent.sender import ReplicatedSender
+    from deepflow_tpu.codec import MessageType
+    from deepflow_tpu.proto import pb
+    from deepflow_tpu.server import Server
+
+    a = Server(host="127.0.0.1", ingest_port=0, query_port=0).start()
+    b = Server(host="127.0.0.1", ingest_port=0, query_port=0).start()
+    sender = ReplicatedSender(
+        [("127.0.0.1", a.ingest_port), ("127.0.0.1", b.ingest_port)],
+        replication=2, agent_id=7).start()
+    try:
+        batch = pb.StatsBatch()
+        m = batch.metrics.add()
+        m.name = "repl_low"
+        m.timestamp_ns = 1
+        m.values["v"] = 1.0
+        sender.send(MessageType.DFSTATS, batch.SerializeToString())
+        assert a.wait_for_rows("deepflow_system.deepflow_system", 1,
+                               timeout=10.0)
+        time.sleep(0.3)
+        assert len(b.db.table("deepflow_system.deepflow_system")) == 0
+    finally:
+        sender.flush_and_stop(timeout=2.0)
+        a.stop()
+        b.stop()
+
+
+# -- end-to-end failover -----------------------------------------------------
+
+def _post(port, path, body):
+    import json
+    import urllib.request
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=15) as resp:
+        return json.loads(resp.read())
+
+
+def _fed_count(port):
+    got = _post(port, "/v1/query", {
+        "sql": "SELECT Count(*) AS n FROM tpu_step_metrics",
+        "db": "profile"})
+    values = got.get("result", {}).get("values") or []
+    n = int(values[0][0]) if values and values[0] else 0
+    return n, (got.get("federation") or {})
+
+
+def test_replicated_cluster_exact_through_shard_loss():
+    """3 shards at R=2: healthy federated counts hide the replica
+    copies exactly; losing one owner shard keeps answers EXACT (no
+    missing_shards) because the claim filter promotes the survivors'
+    copies. The rebalance is leader-driven: only the seed publishes
+    ring epochs."""
+    grpc = pytest.importorskip("grpc")  # noqa: F841 (server dep parity)
+    from deepflow_tpu.agent.sender import ReplicatedSender
+    from deepflow_tpu.codec import MessageType
+    from deepflow_tpu.server import Server
+
+    servers = {}
+    senders = {}
+    try:
+        servers[1] = Server(host="127.0.0.1", ingest_port=0,
+                            query_port=0, sync_port=0, shard_id=1,
+                            cluster_advertise="", replication=2,
+                            fanout_timeout_s=2.0).start()
+        seed_addr = f"127.0.0.1:{servers[1].query_port}"
+        for sid in (2, 3):
+            servers[sid] = Server(host="127.0.0.1", ingest_port=0,
+                                  query_port=0, sync_port=0,
+                                  shard_id=sid, cluster_seed=seed_addr,
+                                  replication=2,
+                                  fanout_timeout_s=2.0).start()
+        deadline = time.time() + 30.0
+        while time.time() < deadline:
+            rings = [s.membership.ring for s in servers.values()]
+            if all(r is not None and sorted(r.members) == [1, 2, 3]
+                   for r in rings):
+                break
+            time.sleep(0.2)
+        else:
+            pytest.fail("ring never converged on all shards")
+        # leader-driven: every shard ended on the SEED's ring, and a
+        # non-leader's tick never publishes a competing epoch
+        ring = servers[1].membership.ring
+        assert all(s.membership.ring.epoch == ring.epoch
+                   for s in servers.values())
+        before = ring.epoch
+        servers[2]._ring_tick()
+        assert servers[2].membership.ring.epoch == before
+
+        agents = (41, 42, 43, 44)
+        for aid in agents:
+            senders[aid] = ReplicatedSender(
+                ring.ingest_addrs(aid), replication=2,
+                agent_id=aid).start()
+        n_each = 15
+        for i in range(1, n_each + 1):
+            for aid in agents:
+                senders[aid].send(MessageType.STEP_METRICS,
+                                  _step_payload(i, run_id=aid))
+        want = len(agents) * n_each
+        deadline = time.time() + 30.0
+        n, fed = 0, {}
+        while time.time() < deadline:
+            n, fed = _fed_count(servers[1].query_port)
+            if n >= want:
+                break
+            time.sleep(0.3)
+        assert n == want, (n, want, fed)       # logical count, not 2x
+        assert not fed.get("missing_shards"), fed
+        raw = sum(len(s.db.table("profile.tpu_step_metrics"))
+                  for s in servers.values())
+        assert raw == 2 * want                 # every frame on 2 shards
+
+        # lose an owner shard; answers must stay exact, not partial
+        victim = next(s for s in (3, 2)
+                      if any(s in ring.owners(a) for a in agents))
+        servers.pop(victim).stop()
+        n, fed = _fed_count(servers[1].query_port)
+        assert n == want, (n, want, fed)
+        assert fed.get("missing_shards") == [], fed
+    finally:
+        for s in senders.values():
+            s.flush_and_stop(timeout=1.0)
+        for s in servers.values():
+            s.stop()
