@@ -2,7 +2,7 @@ PYTHON ?= python
 JAX_ENV := env JAX_PLATFORMS=cpu
 
 .PHONY: test selfmon-check cluster-check steps-check chaos-check ha-check \
-	bench native
+	query-check bench native
 
 test:
 	timeout -k 10 870 $(JAX_ENV) $(PYTHON) -m pytest tests/ -q -m 'not slow' \
@@ -39,6 +39,12 @@ ha-check:
 # detector fires once and names that device and its dominant HLO.
 steps-check:
 	timeout -k 10 120 $(JAX_ENV) $(PYTHON) -m deepflow_tpu.cli.steps_check
+
+# Golden parity of the three query paths (legacy / numpy / native) on a
+# seeded corpus, federated merge-equivalence vs a single node, and a
+# warm/cold cache latency report; exits non-zero on any divergence.
+query-check:
+	timeout -k 10 120 $(JAX_ENV) $(PYTHON) -m deepflow_tpu.cli.query_check
 
 bench:
 	$(JAX_ENV) $(PYTHON) bench.py

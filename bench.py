@@ -517,6 +517,122 @@ def _bench_federation() -> dict:
     return out
 
 
+def _bench_query() -> dict:
+    """query_ms arm: dictionary-encoded vs decoded execution and cold vs
+    warm cache over the SAME high-cardinality GROUP BY at 1/2/4 shards.
+    decoded = DF_QUERY_ENCODED=0 (legacy row-materialize + per-group
+    Python merge), encoded_cold = vectorized int-key path with every
+    cache disabled, encoded_warm = repeat queries against an unchanged
+    corpus (bucket partials + change-token scatter cache). All arms must
+    return byte-identical values — the speedup is only a speedup if the
+    answers match."""
+    import urllib.request
+    from deepflow_tpu.server import Server
+
+    total_rows = 48_000
+    card = 4_000
+    queries = 7
+    sql = ("SELECT app_service, Count(*) AS n, Sum(response_duration) "
+           "AS s, Avg(response_duration) AS a FROM l7_flow_log "
+           "GROUP BY app_service HAVING Count(*) > 0 "
+           "ORDER BY n DESC, app_service LIMIT 200")
+    body = json.dumps({"sql": sql, "db": "flow_log"}).encode()
+    out: dict = {"query_rows": total_rows, "query_groups": card,
+                 "query_ms": {}}
+
+    def run(url: str, n: int):
+        times = []
+        got = None
+        for _ in range(n):
+            req = urllib.request.Request(
+                url, data=body,
+                headers={"Content-Type": "application/json"})
+            t0 = time.perf_counter()
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                got = json.loads(resp.read())
+            times.append(time.perf_counter() - t0)
+        return statistics.median(times), got["result"]["values"]
+
+    matches = True
+    env_keys = ("DF_QUERY_ENCODED", "DF_QUERY_CACHE")
+    saved = {k: os.environ.get(k) for k in env_keys}
+    try:
+        for n_shards in (1, 2, 4):
+            servers = []
+            try:
+                seed = Server(
+                    host="127.0.0.1", ingest_port=0, query_port=0,
+                    sync_port=0, shard_id=1,
+                    cluster_advertise="" if n_shards > 1 else None).start()
+                servers.append(seed)
+                seed_addr = f"127.0.0.1:{seed.query_port}"
+                for sid in range(2, n_shards + 1):
+                    servers.append(Server(
+                        host="127.0.0.1", ingest_port=0, query_port=0,
+                        sync_port=0, shard_id=sid,
+                        cluster_seed=seed_addr).start())
+                deadline = time.time() + 15.0
+                while (n_shards > 1 and time.time() < deadline and
+                       len(seed.api.federation.remote_peers())
+                       < n_shards - 1):
+                    time.sleep(0.1)
+                per = total_rows // n_shards
+                for i, srv in enumerate(servers):
+                    srv.db.table("flow_log.l7_flow_log").append_rows([
+                        {"app_service":
+                         f"svc-{(i * per + j) % card:05d}",
+                         "endpoint": f"/api/{(i * per + j) % 31}",
+                         "response_duration":
+                         1_000 + (i * per + j) % 5_000,
+                         "time": 1_754_000_000_000_000_000
+                         + (i * per + j) * 1_000_000}
+                        for j in range(per)])
+                url = f"http://127.0.0.1:{seed.query_port}/v1/query"
+                os.environ["DF_QUERY_ENCODED"] = "0"
+                os.environ["DF_QUERY_CACHE"] = "0"
+                dec_ms, dec_vals = run(url, queries)
+                os.environ["DF_QUERY_ENCODED"] = "1"
+                enc_ms, enc_vals = run(url, queries)
+                os.environ["DF_QUERY_CACHE"] = "1"
+                run(url, 1)  # fill
+                warm_ms, warm_vals = run(url, queries)
+                matches = matches and dec_vals == enc_vals == warm_vals
+                out["query_ms"][f"shards_{n_shards}"] = {
+                    "decoded": round(dec_ms * 1e3, 2),
+                    "encoded_cold": round(enc_ms * 1e3, 2),
+                    "encoded_warm": round(warm_ms * 1e3, 2)}
+            finally:
+                for s in servers:
+                    s.stop()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    out["query_encoded_matches_decoded"] = matches
+    m4 = out["query_ms"]["shards_4"]
+    out["query_encoded_speedup_4shard"] = round(
+        m4["decoded"] / m4["encoded_cold"], 2) if m4["encoded_cold"] \
+        else 0.0
+    # warm target is vs the pre-PR decoded cold path (same baseline as
+    # the 5x clause): a warm repeat still pays the per-shard validation
+    # scatter (~1 loopback RTT/peer), so it can never be 10x under the
+    # now-fast encoded cold. The encoded-cold ratio ships alongside.
+    out["query_warm_speedup_4shard"] = round(
+        m4["decoded"] / m4["encoded_warm"], 2) if m4["encoded_warm"] \
+        else 0.0
+    out["query_warm_over_encoded_cold_4shard"] = round(
+        m4["encoded_cold"] / m4["encoded_warm"], 2) if m4["encoded_warm"] \
+        else 0.0
+    # perf guards, same convention as ingest/pps targets below
+    out["query_encoded_below_target"] = \
+        out["query_encoded_speedup_4shard"] < 5.0
+    out["query_warm_below_target"] = \
+        out["query_warm_speedup_4shard"] < 10.0
+    return out
+
+
 _BUSY_C = """
 static unsigned long v;
 __attribute__((noinline)) void busy_leaf(void) {
@@ -670,6 +786,16 @@ def _bench_extprofiler_python() -> dict:
         child.kill()
 
 
+# Probe fail-fast state: one TOTAL wall-clock budget across every probe
+# attempt in a run (a wedged relay should cost minutes, not the sum of
+# every per-attempt timeout), plus a memoized success so later callers
+# never re-pay a probe that already answered. DF_BENCH_DEVICE=skip
+# declares no device without spending a second; =force asserts one is
+# there (CI images where the probe subprocess is the flaky part).
+_PROBE_BUDGET_S = float(os.environ.get("DF_BENCH_PROBE_BUDGET_S", "600"))
+_probe_state = {"spent_s": 0.0, "ok": None}
+
+
 def _probe_device(timeout_s: float, probe_log: list) -> bool:
     """Probe backend init in a SUBPROCESS with a deadline. The axon TPU
     relay can wedge (observed: jax.devices() blocked 20+ min at 0% CPU);
@@ -681,6 +807,22 @@ def _probe_device(timeout_s: float, probe_log: list) -> bool:
     stderr tail in exactly the wedged case this exists to diagnose."""
     import subprocess
     import tempfile
+
+    mode = os.environ.get("DF_BENCH_DEVICE", "")
+    if mode == "skip":
+        probe_log.append({"outcome": "skipped (DF_BENCH_DEVICE=skip)"})
+        return False
+    if mode == "force":
+        probe_log.append({"outcome": "forced (DF_BENCH_DEVICE=force)"})
+        return True
+    if _probe_state["ok"]:
+        return True
+    remaining = _PROBE_BUDGET_S - _probe_state["spent_s"]
+    if remaining <= 0:
+        probe_log.append({"outcome": "probe budget exhausted "
+                          f"({_PROBE_BUDGET_S:.0f}s total)"})
+        return False
+    timeout_s = min(timeout_s, remaining)
 
     t0 = time.perf_counter()
     with tempfile.TemporaryFile() as fout, tempfile.TemporaryFile() as ferr:
@@ -715,6 +857,8 @@ def _probe_device(timeout_s: float, probe_log: list) -> bool:
         "elapsed_s": round(time.perf_counter() - t0, 1),
         "stderr": stderr,
     })
+    _probe_state["spent_s"] += time.perf_counter() - t0
+    _probe_state["ok"] = ok
     return ok
 
 
@@ -727,8 +871,12 @@ def _acquire_device_retries(probe_log: list) -> bool:
             return True
         print(f"bench: device probe retry {attempt + 1} failed: "
               f"{probe_log[-1]['outcome']}", file=sys.stderr)
+        if _PROBE_BUDGET_S - _probe_state["spent_s"] <= 0 or \
+                os.environ.get("DF_BENCH_DEVICE") == "skip":
+            break  # fail fast: no budget left to spend on another try
         if sleep_s:
             time.sleep(sleep_s)
+            _probe_state["spent_s"] += sleep_s
     return False
 
 
@@ -766,6 +914,7 @@ def main() -> None:
     cpu_detail.update(_bench_transport())
     cpu_detail.update(_bench_steps())
     cpu_detail.update(_bench_federation())
+    cpu_detail.update(_bench_query())
     cpu_detail.update(_bench_extprofiler())
     # perf guards (VERDICT r03 item 5 / r04 item 8): a regression must be
     # visible in-round, not discovered by the next judge

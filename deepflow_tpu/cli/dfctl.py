@@ -305,13 +305,17 @@ def main(argv: list[str] | None = None) -> int:
               f"directory version: {st['version']}")
         peers = sorted(st.get("peers", []), key=lambda p: p["shard_id"])
         print_table(
-            ["SHARD", "ADDR", "EPOCH", "LAST_SEEN_S", "ROWS",
+            ["SHARD", "ADDR", "EPOCH", "LAST_SEEN_S", "RAW_ROWS",
              "LATENCY_MS", "STATE"],
             [[p["shard_id"],
               p["addr"] + (" *" if p["shard_id"] == st["shard_id"]
                            else ""),
               p["epoch"], p["last_seen_s"],
-              p["rows"] if p["rows"] is not None else "-",
+              # raw physical count: replicated rows appear on R shards,
+              # so this column is NOT a logical row count (pre-rename
+              # servers still send "rows")
+              rr if (rr := p.get("raw_rows", p.get("rows"))) is not None
+              else "-",
               p["latency_ms"] if p["latency_ms"] is not None else "-",
               "alive" if p["alive"]
               else ("DEAD " + p.get("error", "")).strip()]

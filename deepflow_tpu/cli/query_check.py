@@ -1,0 +1,231 @@
+"""query-check: golden parity of the three query execution paths plus a
+warm/cold cache latency report.
+
+Runs a battery of DF-SQL over a seeded corpus through
+
+  * legacy       — decoded row pipeline (DF_QUERY_ENCODED=0),
+  * numpy        — encoded columns, pure-numpy kernels (DF_NO_NATIVE=1),
+  * native       — encoded columns through libdfnative's qexec kernels
+                   (skipped with a note when the .so is unavailable),
+
+and fails (exit 1) on any result divergence — the encoded paths must be
+byte-identical to the legacy one. Then a 3-shard in-process cluster
+proves federated ORDER BY + LIMIT + HAVING parity against a single node
+holding the same rows, and the query cache is timed cold vs warm, both
+local (per-bucket partials) and federated (coordinator scatter cache).
+
+Wired as `make query-check` — the CI gate for the encoded pipeline.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import urllib.request
+
+
+def _fail(msg: str) -> None:
+    print(f"query-check: FAIL: {msg}")
+    sys.exit(1)
+
+
+def _post(port: int, path: str, body: dict) -> dict:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def _canon(x):
+    if isinstance(x, bool):
+        return x
+    if isinstance(x, (int, float)):
+        return round(float(x), 6)
+    if isinstance(x, list):
+        return [_canon(v) for v in x]
+    if isinstance(x, dict):
+        return {k: _canon(v) for k, v in x.items()}
+    return x
+
+
+ROWS = 12_000
+GROUPS = 600
+
+BATTERY = [
+    "SELECT app_service, Count(*) AS n, Sum(response_duration) AS s, "
+    "Avg(response_duration) AS a FROM l7_flow_log GROUP BY app_service "
+    "HAVING Count(*) > 1 ORDER BY n DESC, app_service LIMIT 50",
+    "SELECT app_service, endpoint, Max(response_duration) AS mx "
+    "FROM l7_flow_log GROUP BY app_service, endpoint "
+    "ORDER BY mx DESC, app_service, endpoint LIMIT 25",
+    "SELECT l7_protocol, Count(DISTINCT app_service) AS d, "
+    "Min(response_duration) AS mn FROM l7_flow_log "
+    "GROUP BY l7_protocol ORDER BY l7_protocol",
+    "SELECT Count(*) AS n, Sum(response_duration) AS s "
+    "FROM l7_flow_log WHERE app_service LIKE 'svc-01%'",
+    "SELECT time, app_service, endpoint FROM l7_flow_log "
+    "WHERE response_code = 500 ORDER BY time DESC LIMIT 10",
+]
+
+
+def _corpus_rows(base_ns: int) -> list[dict]:
+    return [
+        {"time": base_ns + i * 1_000_000,
+         "app_service": f"svc-{i % GROUPS:05d}",
+         "endpoint": f"/api/{i % 17}",
+         "l7_protocol": 1 + (i % 3),
+         "response_code": 500 if i % 97 == 0 else 200,
+         "response_duration": (i * 37) % 5_000}
+        for i in range(ROWS)]
+
+
+def _make_table(rows: list[dict]):
+    from deepflow_tpu.store.db import Database
+    t = Database().table("flow_log.l7_flow_log")
+    t.append_rows(rows)
+    return t
+
+
+def _run_mode(t, env: dict) -> dict:
+    from deepflow_tpu.query import engine
+    saved = {k: os.environ.get(k) for k in env}
+    try:
+        for k, v in env.items():
+            os.environ[k] = v
+        out = {}
+        for sql in BATTERY:
+            r = engine.execute(t, sql)
+            out[sql] = _canon({"columns": r.columns, "values": r.values})
+        return out
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _parity(t) -> None:
+    from deepflow_tpu import native
+    legacy = _run_mode(t, {"DF_QUERY_ENCODED": "0"})
+    numpy_ = _run_mode(t, {"DF_QUERY_ENCODED": "1", "DF_NO_NATIVE": "1"})
+    for sql in BATTERY:
+        if numpy_[sql] != legacy[sql]:
+            _fail(f"numpy path diverges from legacy on: {sql}")
+    print(f"query-check: parity legacy==numpy over {len(BATTERY)} "
+          "queries: OK")
+    if native.available():
+        nat = _run_mode(t, {"DF_QUERY_ENCODED": "1"})
+        for sql in BATTERY:
+            if nat[sql] != legacy[sql]:
+                _fail(f"native path diverges from legacy on: {sql}")
+        print(f"query-check: parity legacy==native over {len(BATTERY)} "
+              "queries: OK")
+    else:
+        print("query-check: libdfnative unavailable — native arm "
+              "skipped (numpy fallback already verified)")
+
+
+def _cache_report(t) -> None:
+    from deepflow_tpu.query.cache import QueryCache
+    qc = QueryCache()
+    sql = BATTERY[0]
+    t0 = time.perf_counter()
+    qc.execute(t, sql)
+    cold_ms = (time.perf_counter() - t0) * 1e3
+    reps = 20
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        qc.execute(t, sql)
+    warm_ms = (time.perf_counter() - t0) * 1e3 / reps
+    snap = qc.snapshot()
+    if snap["hits"] != reps:
+        _fail(f"expected {reps} warm hits, counters: {snap}")
+    t.append_rows(_corpus_rows(1_700_000_000_000_000_000)[:50])
+    qc.execute(t, sql)
+    if qc.counters["stale"] != 1 or qc.counters["bucket_hits"] == 0:
+        _fail("append did not take the per-bucket refresh path: "
+              f"{qc.snapshot()}")
+    print(f"query-check: local cache cold {cold_ms:.2f}ms, "
+          f"warm {warm_ms:.3f}ms "
+          f"({cold_ms / max(warm_ms, 1e-9):.1f}x), "
+          f"bucket slices reused after append: "
+          f"{qc.counters['bucket_hits']}")
+
+
+def _federated(rows: list[dict]) -> None:
+    from deepflow_tpu.server import Server
+    servers: list = []
+    try:
+        solo = Server(host="127.0.0.1", ingest_port=0, query_port=0,
+                      sync_port=0).start()
+        servers.append(solo)
+        seed = Server(host="127.0.0.1", ingest_port=0, query_port=0,
+                      sync_port=0, shard_id=1,
+                      cluster_advertise="").start()
+        servers.append(seed)
+        addr = f"127.0.0.1:{seed.query_port}"
+        shards = [seed]
+        for sid in (2, 3):
+            s = Server(host="127.0.0.1", ingest_port=0, query_port=0,
+                       sync_port=0, shard_id=sid,
+                       cluster_seed=addr).start()
+            servers.append(s)
+            shards.append(s)
+        deadline = time.time() + 15.0
+        while time.time() < deadline:
+            if len(seed.api.federation.remote_peers()) == 2:
+                break
+            time.sleep(0.2)
+        else:
+            _fail("membership never converged")
+        solo.db.table("flow_log.l7_flow_log").append_rows(rows)
+        for i, row in enumerate(rows):
+            shards[i % 3].db.table("flow_log.l7_flow_log") \
+                .append_rows([row])
+        lat = {}
+        for sql in BATTERY[:3]:
+            body = {"sql": sql, "db": "flow_log"}
+            want = _post(solo.query_port, "/v1/query", body)["result"]
+            t0 = time.perf_counter()
+            got = _post(seed.query_port, "/v1/query", body)
+            lat.setdefault("cold", []).append(
+                (time.perf_counter() - t0) * 1e3)
+            if got["federation"]["missing_shards"]:
+                _fail(f"missing shards on: {sql}")
+            if json.dumps(_canon(got["result"]), sort_keys=True) != \
+                    json.dumps(_canon(want), sort_keys=True):
+                _fail(f"federated result diverges from single-node: "
+                      f"{sql}")
+            t0 = time.perf_counter()
+            again = _post(seed.query_port, "/v1/query", body)
+            lat.setdefault("warm", []).append(
+                (time.perf_counter() - t0) * 1e3)
+            if again["federation"].get("cache") != "warm":
+                _fail(f"repeat query did not validate warm: {sql}")
+        cold = sum(lat["cold"]) / len(lat["cold"])
+        warm = sum(lat["warm"]) / len(lat["warm"])
+        print(f"query-check: federated parity over {len(BATTERY[:3])} "
+              f"queries (3 shards vs 1 node): OK — scatter cold "
+              f"{cold:.2f}ms, warm {warm:.2f}ms")
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def main() -> int:
+    rows = _corpus_rows(1_600_000_000_000_000_000)
+    t = _make_table(rows)
+    _parity(t)
+    _cache_report(t)
+    _federated(rows[:3_000])
+    print("query-check: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,14 +31,16 @@ Merge semantics (docs/CLUSTER.md spells out the contract):
 from __future__ import annotations
 
 import time
+from collections import OrderedDict
 
 import numpy as np
 
+from deepflow_tpu.cluster.dictsync import DictSync, DictSyncError
 from deepflow_tpu.cluster.hashring import ClaimDbView, HashRing
 from deepflow_tpu.cluster.membership import (DEFAULT_TTL_S,
                                              ClusterMembership, Peer)
 from deepflow_tpu.cluster.remote import FanOut, ShardCallError
-from deepflow_tpu.query import engine, promql
+from deepflow_tpu.query import cache, engine, promql
 from deepflow_tpu.query import sql as qsql
 from deepflow_tpu.query.flamegraph import merge_stack_values
 
@@ -153,6 +155,16 @@ class FederationCoordinator:
         self.fanout = fanout
         self.shard_id = shard_id
         self.ttl_s = ttl_s
+        # int-key federation state: shard-dictionary mirrors + remap
+        # tables (cluster/dictsync.py) and the per-query scatter cache —
+        # raw shard partials keyed by each shard's own change token, plus
+        # the merged result for the all-tokens-unchanged fast path.
+        self.dict_sync = DictSync()
+        self._sql_cache: OrderedDict = OrderedDict()
+        self._sql_cache_max = 64
+        self.sql_cache_counters = {"warm_hits": 0, "shard_unchanged": 0,
+                                   "shard_refetched": 0,
+                                   "remap_failures": 0}
 
     # -- plumbing -----------------------------------------------------------
 
@@ -232,17 +244,166 @@ class FederationCoordinator:
         sql_text and org_id travel to the shards, which re-scope
         themselves (the org filter lives in the AST, not the text) —
         both sides derive the partial layout from the same normalized
-        text."""
+        text.
+
+        Protocol v2 (int-key federation, per-column version-negotiated):
+        the body carries ``"enc": 1`` plus per-shard ``if_state`` change
+        tokens and ``dict_known`` mirror prefixes. A shard whose token
+        matches replies {"kind": "unchanged"} and the coordinator reuses
+        its cached raw partial; encoded replies are id-remapped into the
+        coordinator's dictionaries before the vectorized merge. Shards
+        running pre-encoding code ignore the new keys and return decoded
+        partials, which join on the generic merge path unchanged."""
+        import os
+        cache_on = os.environ.get("DF_QUERY_CACHE", "1") != "0"
+        ck = (table.name, " ".join(sql_text.split()), org_id)
+        ent = self._sql_cache.get(ck) if cache_on else None
+        peers = self.remote_peers()
         body = {"op": "sql_partial", "sql": sql_text,
-                "table": table.name}
+                "table": table.name, "enc": 1,
+                "dict_known": {
+                    str(p.shard_id): self.dict_sync.known_state(
+                        p.shard_id, table.name) for p in peers}}
         if org_id is not None:
             body["org_id"] = org_id
+        if ent is not None:
+            # one shared scatter body: per-shard tokens keyed by id
+            body["if_state"] = {str(sid): st
+                                for sid, st in ent["states"].items()
+                                if st is not None}
+        addr_by_sid = {p.shard_id: p.addr for p in peers}
         results, info, db = self.scatter_claim(body, hop_name="cluster.sql")
         local = db.table(table.name) if db is not self.db else table
-        partials = [engine.execute_partial(local, select)]
-        partials.extend(results[sid] for sid in sorted(results))
-        res = engine.merge_partials(table, select, partials)
+        ring = self.ring()
+        # the local partial's validity depends on the claim view too:
+        # same table state under a different ring/alive set answers for
+        # different rows
+        ring_ctx = None if ring is None else [
+            ring.epoch, ring.token,
+            sorted(getattr(db, "_alive", []) or [])]
+        # change_token, not sync_state: the remap below grows local
+        # dictionaries, which must not read as "table changed"
+        local_token = [cache.change_token(table), ring_ctx]
+
+        parts_raw: dict[int, object] = {}
+        states: dict[int, object] = {}
+        unchanged: set[int] = set()
+        failed_sync: list[int] = []
+        for sid in sorted(results):
+            r = results[sid]
+            if isinstance(r, dict) and r.get("kind") == "unchanged":
+                cached = (ent["parts"].get(sid)
+                          if ent is not None else None)
+                if cached is not None and \
+                        ent["states"].get(sid) == r.get("state"):
+                    parts_raw[sid] = cached
+                    states[sid] = r.get("state")
+                    unchanged.add(sid)
+                    self.sql_cache_counters["shard_unchanged"] += 1
+                    continue
+                # shard honored a token we no longer hold the partial
+                # for (evicted/raced) — fetch it fresh, no if_state
+                r = self._shard_refetch(addr_by_sid.get(sid), body)
+                if r is None:
+                    failed_sync.append(sid)
+                    continue
+            states[sid] = (r.get("state")
+                           if isinstance(r, dict) else None)
+            parts_raw[sid] = r
+
+        if (ent is not None and not failed_sync
+                and ent["local"] == local_token
+                and set(parts_raw) == set(ent["parts"]) == unchanged
+                and ent["missing"] == info["missing_shards"]):
+            # nothing anywhere changed: skip remap + merge entirely
+            self.sql_cache_counters["warm_hits"] += 1
+            self._sql_cache.move_to_end(ck)
+            info = dict(info)
+            info["cache"] = "warm"
+            return self._copy_result(ent["result"]), info
+
+        if ent is not None and ent["local"] == local_token \
+                and ent.get("local_part") is not None:
+            local_part = ent["local_part"]
+        else:
+            local_part = engine.execute_partial(local, select,
+                                                encoded=True)
+        # dictionary snapshot: remap + merge + decode all see the same
+        # objects even if a local compaction swaps them mid-query
+        local_dicts = dict(getattr(table, "dicts", {}) or {})
+
+        def _decoder(key, _ld=local_dicts):
+            d = _ld.get(key)
+            if d is None:
+                raise engine.QueryError(
+                    f"unknown dictionary column {key!r} in partial")
+            return d
+
+        partials: list = [local_part]
+        for sid in sorted(parts_raw):
+            raw = parts_raw[sid]
+            if isinstance(raw, dict) and raw.get("dicts"):
+                try:
+                    partials.append(self.dict_sync.remap_partial(
+                        sid, table.name, raw, local_dicts))
+                    continue
+                except DictSyncError:
+                    # mirror can't cover the shard's ids (malformed
+                    # delta / gen race) — ask that shard for a decoded
+                    # partial rather than dropping its rows
+                    self.sql_cache_counters["remap_failures"] += 1
+                    raw = self._shard_refetch(addr_by_sid.get(sid),
+                                              body, decoded=True)
+                    if raw is None:
+                        failed_sync.append(sid)
+                        del parts_raw[sid]
+                        states.pop(sid, None)
+                        continue
+                    parts_raw[sid] = raw
+                    states[sid] = (raw.get("state")
+                                   if isinstance(raw, dict) else None)
+            partials.append(raw)
+        if failed_sync:
+            info = dict(info)
+            info["missing_shards"] = sorted(
+                set(info["missing_shards"]) | set(failed_sync))
+        res = engine.merge_partials(table, select, partials,
+                                    decoder=_decoder)
+        info = dict(info)
+        info["cache"] = "cold"
+        if cache_on:
+            self._sql_cache[ck] = {
+                "local": local_token, "local_part": local_part,
+                "states": states, "parts": parts_raw,
+                "missing": info["missing_shards"],
+                "result": self._copy_result(res)}
+            self._sql_cache.move_to_end(ck)
+            while len(self._sql_cache) > self._sql_cache_max:
+                self._sql_cache.popitem(last=False)
         return res, info
+
+    @staticmethod
+    def _copy_result(res: engine.QueryResult) -> engine.QueryResult:
+        return engine.QueryResult(columns=list(res.columns),
+                                  values=[list(r) for r in res.values])
+
+    def _shard_refetch(self, addr, body: dict, *, decoded: bool = False):
+        """One direct (non-scatter) retry against a single shard; None
+        on failure. decoded=True downgrades to the pre-encoding wire
+        form (the remap escape hatch)."""
+        if not addr:
+            return None
+        b = dict(body)
+        b.pop("if_state", None)
+        if decoded:
+            b.pop("enc", None)
+            b.pop("dict_known", None)
+        try:
+            out = self.fanout.client(addr).call(b)
+        except ShardCallError:
+            return None
+        self.sql_cache_counters["shard_refetched"] += 1
+        return out
 
     # -- PromQL -------------------------------------------------------------
 
@@ -305,17 +466,21 @@ class FederationCoordinator:
         snap = self.membership.directory.snapshot()
         rows = []
         for p in [Peer.from_dict(d) for d in snap["peers"]]:
+            # "raw_rows", not "rows": with replication each HIGH/MID row
+            # physically exists on R shards, so per-shard counts (and
+            # their sum) overstate the logical row count by ~R× — the
+            # label says what is actually being counted
             entry = {"shard_id": p.shard_id, "addr": p.addr,
                      "epoch": p.epoch,
                      "last_seen_s": round(
                          max(0, now_ns - p.last_seen_ns) / 1e9, 1),
-                     "alive": True, "latency_ms": None, "rows": None}
+                     "alive": True, "latency_ms": None, "raw_rows": None}
             if p.shard_id == self.shard_id:
                 t0 = time.monotonic()
                 counts = self.local_table_counts()
                 entry["latency_ms"] = round(
                     (time.monotonic() - t0) * 1e3, 2)
-                entry["rows"] = sum(counts.values())
+                entry["raw_rows"] = sum(counts.values())
             else:
                 try:
                     t0 = time.monotonic()
@@ -323,7 +488,7 @@ class FederationCoordinator:
                         {"op": "table_counts"})
                     entry["latency_ms"] = round(
                         (time.monotonic() - t0) * 1e3, 2)
-                    entry["rows"] = sum(counts.values())
+                    entry["raw_rows"] = sum(counts.values())
                 except ShardCallError as e:
                     entry["alive"] = False
                     entry["error"] = str(e)
@@ -334,7 +499,7 @@ class FederationCoordinator:
                "fanout": self.fanout.stats()}
         ring = self.ring()
         if ring is not None:
-            # NOTE: per-shard "rows" above are RAW counts — with
+            # NOTE: per-shard "raw_rows" above are RAW counts — with
             # replication each HIGH/MID row exists on R shards, so the
             # sum over peers overstates the logical row count by ~R×.
             out["ring"] = {"epoch": ring.epoch, "token": ring.token,

@@ -37,6 +37,65 @@ class WireError(Exception):
     pass
 
 
+def _has_ndarray(obj) -> bool:
+    if isinstance(obj, np.ndarray):
+        return True
+    if isinstance(obj, dict):
+        return any(_has_ndarray(v) for v in obj.values())
+    if isinstance(obj, (list, tuple)):
+        return any(_has_ndarray(v) for v in obj)
+    return False
+
+
+def _encode_jsonb(obj) -> bytes:
+    """"jsonb" kind: arbitrary JSON structure whose embedded ndarrays
+    travel as dtype-preserving raw blobs appended after the JSON body
+    ({"__bin__": i} placeholders mark the splice points). Carries the
+    encoded agg partials — uint32 dictionary-id columns stay 4 bytes per
+    group instead of JSON-quoted strings. Only emitted when a payload
+    actually contains ndarrays, so pre-encoding peers never see it."""
+    blobs: list[np.ndarray] = []
+
+    def strip(o):
+        if isinstance(o, np.ndarray):
+            blobs.append(np.ascontiguousarray(o))
+            return {"__bin__": len(blobs) - 1}
+        if isinstance(o, dict):
+            return {k: strip(v) for k, v in o.items()}
+        if isinstance(o, (list, tuple)):
+            return [strip(v) for v in o]
+        if isinstance(o, np.generic):
+            return o.item()
+        return o
+
+    body = strip(obj)
+    meta = {"kind": "jsonb", "obj": body,
+            "blobs": [[a.dtype.str, int(a.size)] for a in blobs]}
+    mb = json.dumps(meta, separators=(",", ":")).encode()
+    return _LEN.pack(len(mb)) + mb + b"".join(a.tobytes() for a in blobs)
+
+
+def _decode_jsonb(meta: dict, buf: memoryview):
+    arrays: list[np.ndarray] = []
+    off = 0
+    for dt, size in meta.get("blobs", []):
+        dtype = np.dtype(dt)
+        end = off + dtype.itemsize * int(size)
+        arrays.append(np.frombuffer(buf[off:end], dtype=dtype))
+        off = end
+
+    def restore(o):
+        if isinstance(o, dict):
+            if len(o) == 1 and "__bin__" in o:
+                return arrays[int(o["__bin__"])]
+            return {k: restore(v) for k, v in o.items()}
+        if isinstance(o, list):
+            return [restore(v) for v in o]
+        return o
+
+    return restore(meta.get("obj"))
+
+
 def _encode_table(obj: dict) -> bytes:
     columns = list(obj["columns"])
     values = obj["values"]
@@ -97,6 +156,8 @@ def encode_result(obj, shard_id: int = 0) -> bytes:
     if (isinstance(obj, dict) and "columns" in obj and "values" in obj
             and isinstance(obj.get("values"), list)):
         payload = _encode_table(obj)
+    elif _has_ndarray(obj):
+        payload = _encode_jsonb(obj)
     else:
         b = json.dumps({"kind": "json", "obj": obj},
                        separators=(",", ":")).encode()
@@ -118,4 +179,6 @@ def decode_result(frame: bytes):
     meta = json.loads(bytes(view[4:4 + mlen]))
     if meta.get("kind") == "table":
         return _decode_table(meta, view[4 + mlen:]), header.agent_id
+    if meta.get("kind") == "jsonb":
+        return _decode_jsonb(meta, view[4 + mlen:]), header.agent_id
     return meta.get("obj"), header.agent_id

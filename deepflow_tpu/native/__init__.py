@@ -16,7 +16,7 @@ log = logging.getLogger("df.native")
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_DIR, "libdfnative.so")
 _lib = None
-_ABI_VERSION = 4  # must match df_abi_version() in dfnative.cpp
+_ABI_VERSION = 5  # must match df_abi_version() in dfnative.cpp
 
 
 def _build() -> bool:
@@ -146,6 +146,16 @@ def load():
     lib.df_decode_l7_cols.restype = ctypes.c_int64
     lib.df_decode_l7_cols.argtypes = [
         ctypes.c_char_p, ctypes.c_uint64, ctypes.c_void_p]
+    # -- encoded query execution (qexec.cpp) --------------------------------
+    lib.df_qx_group.restype = ctypes.c_int64
+    lib.df_qx_group.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p), ctypes.c_uint32, ctypes.c_uint64,
+        np.ctypeslib.ndpointer(np.uint64),           # order_out
+        np.ctypeslib.ndpointer(np.uint64)]           # bounds_out
+    lib.df_qx_isin_u32.argtypes = [
+        np.ctypeslib.ndpointer(np.uint32), ctypes.c_uint64,
+        np.ctypeslib.ndpointer(np.uint32), ctypes.c_uint64,
+        np.ctypeslib.ndpointer(np.uint8)]
     _lib = lib
     return lib
 
@@ -436,3 +446,42 @@ class L7ColumnDecoder:
         n = int(n)
         cols = {k: a[:n] for k, a in self.arrays.items()}
         return n, cols, self.arena[:self._cols.arena_used]
+
+
+# -- encoded query execution kernels (qexec.cpp) ----------------------------
+
+def qx_group(key_cols: list[np.ndarray]):
+    """Hash-group rows over encoded key columns in one O(n) native pass.
+
+    Returns (order, bounds, n_groups) — rows `order[bounds[g]:bounds[g+1]]`
+    form group g, groups in FIRST-OCCURRENCE order, rows within a group in
+    original order — or None when the native lib is unavailable (caller
+    uses the numpy lexsort fallback in query/engine.py). Keys are cast to
+    int64 (dict ids, enum ids and ns timestamps all fit)."""
+    lib = load()
+    if lib is None or not key_cols:
+        return None
+    n = len(key_cols[0])
+    order = np.empty(n, dtype=np.uint64)
+    bounds = np.empty(n + 1, dtype=np.uint64)
+    cast = [np.ascontiguousarray(k, dtype=np.int64) for k in key_cols]
+    ptrs = (ctypes.c_void_p * len(cast))(
+        *[k.ctypes.data_as(ctypes.c_void_p).value for k in cast])
+    ng = lib.df_qx_group(ptrs, len(cast), n, order, bounds)
+    if ng < 0:
+        return None
+    return order.astype(np.int64), bounds[:ng + 1].astype(np.int64), int(ng)
+
+
+def qx_isin_u32(col: np.ndarray, ids: np.ndarray):
+    """mask[i] = col[i] in ids via a native hash set (O(n), vs np.isin's
+    sort-based O(n log m)) — the encoded-predicate filter for dictionary-id
+    IN sets and LIKE pushdown. Returns a bool array or None."""
+    lib = load()
+    if lib is None:
+        return None
+    col = np.ascontiguousarray(col, dtype=np.uint32)
+    ids = np.ascontiguousarray(ids, dtype=np.uint32)
+    mask = np.empty(len(col), dtype=np.uint8)
+    lib.df_qx_isin_u32(col, len(col), ids, len(ids), mask)
+    return mask.astype(bool)

@@ -1,0 +1,134 @@
+// qexec: native kernels for dictionary-encoded DF-SQL execution.
+//
+// Reference analog: ClickHouse executes GROUP BY over LowCardinality
+// columns with a hash table keyed on the small ints, never the strings
+// (SmartEncoding end-to-end). The Python engine's composite-radix
+// np.unique grouping is O(n log n) per key column; these kernels do one
+// O(n) open-addressing pass over all key columns at once.
+//
+// All entry points take pre-cast int64 key columns (dictionary ids,
+// enum ids and integer timestamps all fit; the ctypes wrapper casts).
+// Consumed via ctypes — see qx_group / qx_isin_u32 in native/__init__.py,
+// numpy fallbacks live there behind the same DF_NO_NATIVE kill-switch.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+inline uint64_t mix64(uint64_t x) {
+    // splitmix64 finalizer — good avalanche for sequential dict ids
+    x += 0x9e3779b97f4a7c15ULL;
+    x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+    x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+    return x ^ (x >> 31);
+}
+
+inline uint64_t next_pow2(uint64_t v) {
+    uint64_t p = 16;
+    while (p < v) p <<= 1;
+    return p;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Hash-group n_rows over n_keys int64 key columns.
+//   order_out:  n_rows indices, grouped (all rows of group 0, then 1, ...)
+//               in FIRST-OCCURRENCE group order
+//   bounds_out: n_groups+1 offsets into order_out (caller sizes n_rows+1)
+// Returns n_groups (>= 0), or -1 on bad args. Row order within a group is
+// the original row order (counting sort is stable), which the engine's
+// reduceat/LAST semantics rely on.
+int64_t df_qx_group(const int64_t* const* keys, uint32_t n_keys,
+                    uint64_t n_rows, uint64_t* order_out,
+                    uint64_t* bounds_out) {
+    if (n_keys == 0 || keys == nullptr) return -1;
+    if (n_rows == 0) {
+        bounds_out[0] = 0;
+        return 0;
+    }
+    const uint64_t cap = next_pow2(n_rows * 2);
+    const uint64_t mask = cap - 1;
+    // open-addressing table: slot -> representative row (+1; 0 == empty)
+    std::vector<uint64_t> slot_row(cap, 0);
+    std::vector<uint32_t> slot_gid(cap, 0);
+    std::vector<uint32_t> gids(n_rows);
+    std::vector<uint64_t> counts;
+    counts.reserve(1024);
+    uint32_t n_groups = 0;
+    for (uint64_t i = 0; i < n_rows; i++) {
+        uint64_t h = 0x243f6a8885a308d3ULL;
+        for (uint32_t k = 0; k < n_keys; k++)
+            h = mix64(h ^ (uint64_t)keys[k][i]);
+        uint64_t s = h & mask;
+        for (;;) {
+            const uint64_t rep = slot_row[s];
+            if (rep == 0) {  // new group
+                slot_row[s] = i + 1;
+                slot_gid[s] = n_groups;
+                gids[i] = n_groups;
+                counts.push_back(1);
+                n_groups++;
+                break;
+            }
+            const uint64_t r = rep - 1;
+            bool eq = true;
+            for (uint32_t k = 0; k < n_keys; k++) {
+                if (keys[k][r] != keys[k][i]) { eq = false; break; }
+            }
+            if (eq) {
+                const uint32_t g = slot_gid[s];
+                gids[i] = g;
+                counts[g]++;
+                break;
+            }
+            s = (s + 1) & mask;
+        }
+    }
+    // counting sort rows into group-contiguous order
+    bounds_out[0] = 0;
+    for (uint32_t g = 0; g < n_groups; g++)
+        bounds_out[g + 1] = bounds_out[g] + counts[g];
+    std::vector<uint64_t> cursor(bounds_out, bounds_out + n_groups);
+    for (uint64_t i = 0; i < n_rows; i++)
+        order_out[cursor[gids[i]]++] = i;
+    return (int64_t)n_groups;
+}
+
+// mask[i] = 1 iff col[i] is in `set` (hash set, O(n + n_set)) — the
+// dictionary-id IN / LIKE-pushdown filter. np.isin is sort-based
+// O(n log n_set); this is the encoded-predicate fast path.
+void df_qx_isin_u32(const uint32_t* col, uint64_t n, const uint32_t* set,
+                    uint64_t n_set, uint8_t* mask_out) {
+    if (n_set == 0) {
+        std::memset(mask_out, 0, n);
+        return;
+    }
+    const uint64_t cap = next_pow2(n_set * 2);
+    const uint64_t hmask = cap - 1;
+    // slot -> value+1 (0 == empty)
+    std::vector<uint64_t> tbl(cap, 0);
+    for (uint64_t j = 0; j < n_set; j++) {
+        uint64_t s = mix64(set[j]) & hmask;
+        while (tbl[s] != 0 && tbl[s] != (uint64_t)set[j] + 1)
+            s = (s + 1) & hmask;
+        tbl[s] = (uint64_t)set[j] + 1;
+    }
+    for (uint64_t i = 0; i < n; i++) {
+        const uint64_t v = (uint64_t)col[i] + 1;
+        uint64_t s = mix64(col[i]) & hmask;
+        uint8_t hit = 0;
+        for (;;) {
+            const uint64_t t = tbl[s];
+            if (t == 0) break;
+            if (t == v) { hit = 1; break; }
+            s = (s + 1) & hmask;
+        }
+        mask_out[i] = hit;
+    }
+}
+
+}  // extern "C"

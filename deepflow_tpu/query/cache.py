@@ -1,0 +1,272 @@
+"""Querier-level result + partial-aggregate cache with exact invalidation.
+
+Two layers, both keyed by (table name, whitespace-normalized SQL) and
+validated by monotonic change tokens — never by TTL:
+
+- whole-result cache: validated against ``table.sync_state()``
+  ([write watermark, [[dict, gen, len], ...]]). Any append, trim, load or
+  dictionary rebuild changes the token, so a hit is always exact.
+- per-time-bucket partial cache: aggregate queries are sliced into the
+  table's 60s bucket grid; each bucket's ENCODED partial
+  (engine.execute_partial(encoded=True)) is cached against that bucket's
+  write mark + the dictionary gens. An append invalidates only the
+  buckets it touched — warm repeats recompute nothing and re-scan only
+  stale buckets, then engine.combine_partials folds the slices back into
+  one exact partial.
+
+The token is read BEFORE executing: a write racing the fill can only
+make the stored token stale (harmless recompute next time), never let a
+stale entry validate.
+
+Admission goes through the learned cost hook (query/costmodel.py —
+"A Learned Performance Model for TPUs" motivates modeled rather than
+hand-tuned plan choices): queries whose observed cold cost stays under
+DF_QUERY_CACHE_MIN_NS are not worth an entry. DF_QUERY_CACHE=0 bypasses
+entirely.
+
+Self-telemetry: one ``query.cache`` hop ledger (PR 2 conventions) —
+emitted=lookups, delivered=hits, dropped{miss|stale|bypass}; evictions
+are a separate counter surfaced in /v1/health.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import OrderedDict
+
+from deepflow_tpu.query import engine
+from deepflow_tpu.query import sql as S
+from deepflow_tpu.query.costmodel import KernelCostModel
+
+
+def normalize_sql(sql: str) -> str:
+    return " ".join(sql.split())
+
+
+def change_token(table) -> list:
+    """Result-validity token: [watermark, [[dict, gen], ...]].
+
+    Deliberately NOT the full sync_state(): dictionary LENGTH is
+    excluded because dictionaries can grow without any row write — the
+    federation coordinator encodes remote shard strings into its local
+    dictionaries while remapping (cluster/dictsync.py). Growth is
+    append-only within a gen, and rows only ever reference ids minted by
+    writes (which bump the watermark), so extra entries cannot change
+    any query answer: decode of existing ids, collation order, LIKE and
+    equality pushdown all come out identical. Gen flips (compaction,
+    reload) rebind ids and DO invalidate."""
+    wm, dicts = table.sync_state()
+    return [wm, [[n, g] for n, g, _l in dicts]]
+
+
+class QueryCache:
+    def __init__(self, max_entries: int = 128, max_buckets: int = 512,
+                 telemetry=None) -> None:
+        self.max_entries = max_entries
+        self.max_buckets = max_buckets
+        self._lock = threading.Lock()
+        # (table, sql) -> (token, QueryResult)
+        self._results: OrderedDict[tuple, tuple] = OrderedDict()
+        # (table, sql) -> OrderedDict{bucket: (mark, gens, partial)}
+        self._buckets: OrderedDict[tuple, OrderedDict] = OrderedDict()
+        self.counters = {"hits": 0, "misses": 0, "stale": 0, "bypass": 0,
+                         "evictions": 0, "bucket_hits": 0,
+                         "bucket_misses": 0, "bucket_pruned": 0}
+        self._hop = telemetry.hop("query.cache") if telemetry else None
+        # learned cold-cost per cached query shape (admission hook)
+        self.cost = KernelCostModel(kernels=("cold", "warm"))
+
+    # -- helpers -------------------------------------------------------------
+
+    _OUTCOME_KEY = {"hit": "hits", "miss": "misses", "stale": "stale",
+                    "bypass": "bypass"}
+
+    def _account(self, outcome: str) -> None:
+        with self._lock:
+            self.counters[self._OUTCOME_KEY[outcome]] += 1
+        if self._hop is not None:
+            if outcome == "hit":
+                self._hop.account(emitted=1, delivered=1)
+            else:
+                self._hop.account(emitted=1, dropped=1, reason=outcome)
+
+    def _enabled(self) -> bool:
+        return os.environ.get("DF_QUERY_CACHE", "1") != "0"
+
+    def _min_ns(self) -> float:
+        try:
+            return float(os.environ.get("DF_QUERY_CACHE_MIN_NS", "0"))
+        except ValueError:
+            return 0.0
+
+    @staticmethod
+    def _copy_result(res: engine.QueryResult) -> engine.QueryResult:
+        return engine.QueryResult(columns=list(res.columns),
+                                  values=[list(r) for r in res.values])
+
+    # -- whole-result layer --------------------------------------------------
+
+    def execute(self, table, sql: str, *, select=None,
+                extra_key=None) -> engine.QueryResult:
+        """engine.execute() through the cache. `select` is an optional
+        pre-parsed (possibly rewritten — org scoping) AST to run instead
+        of parsing `sql`; any rewrite not visible in the SQL text must be
+        reflected in `extra_key` or rewritten variants would collide."""
+        if not self._enabled():
+            self._account("bypass")
+            return engine.execute(table, select if select is not None
+                                  else sql)
+        key = (table.name, normalize_sql(sql), extra_key)
+        token = change_token(table)  # BEFORE executing: stale-safe
+        with self._lock:
+            ent = self._results.get(key)
+            if ent is not None:
+                self._results.move_to_end(key)
+        if ent is not None and ent[0] == token:
+            self._account("hit")
+            self.cost.observe("warm", 1, 1.0)
+            return self._copy_result(ent[1])
+        self._account("stale" if ent is not None else "miss")
+        t0 = time.perf_counter_ns()
+        res = self._execute_cold(table, sql, key, select)
+        cold_ns = time.perf_counter_ns() - t0
+        self.cost.observe("cold", 1, cold_ns)
+        if cold_ns >= self._min_ns():
+            with self._lock:
+                self._results[key] = (token, self._copy_result(res))
+                self._results.move_to_end(key)
+                while len(self._results) > self.max_entries:
+                    self._results.popitem(last=False)
+                    self.counters["evictions"] += 1
+        return res
+
+    def _execute_cold(self, table, sql: str, key: tuple, select=None):
+        """Cold fill: bucketed partial plan when eligible, plain scan
+        otherwise. Cold AND warm both go through the bucket partials for
+        a bucketable query, so repeats are self-consistent."""
+        try:
+            query = select if select is not None else S.parse(sql)
+            parts = self._bucket_partials(table, query, key)
+            if parts is not None:
+                combined = engine.combine_partials(table, query, parts)
+                return engine.merge_partials(table, query, [combined])
+        except engine._FastUnsupported:
+            self._drop_buckets(key)
+        except engine.QueryError:
+            raise
+        except Exception:
+            self._drop_buckets(key)
+        return engine.execute(table, select if select is not None else sql)
+
+    # -- bucketed partial layer ----------------------------------------------
+
+    def _bucketable(self, table, query: S.Select) -> bool:
+        if os.environ.get("DF_QUERY_ENCODED", "1") == "0":
+            return False
+        norm = engine._normalize(table, query)
+        if not engine._is_agg_query(norm):
+            return False
+        # PERCENTILE: the local scan uses exact np.percentile while the
+        # partial form is a sketch — caching would change answers. LAST:
+        # cross-bucket timestamp ties could resolve differently.
+        if any(s.name in ("PERCENTILE", "LAST")
+               for s in engine._agg_sites(norm)):
+            return False
+        return True
+
+    def _bucket_partials(self, table, query: S.Select, key: tuple):
+        """Per-bucket encoded partials for an eligible aggregate query,
+        reusing every bucket whose (write mark, dict gens) is unchanged.
+        None when the query/table isn't bucketable."""
+        if not self._bucketable(table, query):
+            return None
+        wm, marks, wide, div = table.bucket_marks()
+        tc = getattr(table, "_time_col", None)
+        if div <= 0 or tc is None or wide or len(marks) > self.max_buckets:
+            return None
+        gens = tuple((n, g) for n, g, _l in table.sync_state()[1])
+        with self._lock:
+            store = self._buckets.get(key)
+            if store is None:
+                store = self._buckets[key] = OrderedDict()
+                self._buckets.move_to_end(key)
+                while len(self._buckets) > self.max_entries:
+                    self._buckets.popitem(last=False)
+                    self.counters["evictions"] += 1
+            # buckets trimmed off the grid can never validate again
+            for b in [b for b in store if b not in marks]:
+                del store[b]
+                self.counters["bucket_pruned"] += 1
+        parts = []
+        for b, mark in sorted(marks.items()):
+            with self._lock:
+                ent = store.get(b)
+            if ent is not None and ent[0] == mark and ent[1] == gens:
+                with self._lock:
+                    self.counters["bucket_hits"] += 1
+                parts.append(ent[2])
+                continue
+            bq = self._bucket_query(query, tc, b * div, (b + 1) * div)
+            p = engine.execute_partial(table, bq, encoded=True)
+            if p.get("kind") != "agg":
+                return None
+            with self._lock:
+                self.counters["bucket_misses"] += 1
+                store[b] = (mark, gens, p)
+            parts.append(p)
+        return parts
+
+    @staticmethod
+    def _bucket_query(query: S.Select, tc: str, lo: int,
+                      hi: int) -> S.Select:
+        rng = S.BinOp("AND",
+                      S.BinOp(">=", S.Col(tc), S.Lit(int(lo))),
+                      S.BinOp("<", S.Col(tc), S.Lit(int(hi))))
+        where = rng if query.where is None else \
+            S.BinOp("AND", query.where, rng)
+        # ORDER BY/LIMIT apply at the merge, not per slice; HAVING rides
+        # along so its aggregate sites ship in the partial (it is only
+        # APPLIED at the merge)
+        return S.Select(items=query.items, table=query.table, where=where,
+                        group_by=query.group_by, having=query.having,
+                        order_by=[], limit=None)
+
+    def partial(self, table, sql: str, *, select=None,
+                extra_key=None) -> dict:
+        """engine.execute_partial(encoded=True) through the bucket cache:
+        a warm shard answers a scatter by folding cached bucket slices
+        instead of rescanning (shard-side half of federated caching)."""
+        query = select if select is not None else sql
+        if not self._enabled():
+            return engine.execute_partial(table, query, encoded=True)
+        key = (table.name, normalize_sql(sql), extra_key)
+        try:
+            if isinstance(query, str):
+                query = S.parse(query)
+            parts = self._bucket_partials(table, query, key)
+            if parts is not None:
+                return engine.combine_partials(table, query, parts)
+        except engine._FastUnsupported:
+            self._drop_buckets(key)
+        except engine.QueryError:
+            raise
+        except Exception:
+            self._drop_buckets(key)
+        return engine.execute_partial(table, query, encoded=True)
+
+    def _drop_buckets(self, key: tuple) -> None:
+        with self._lock:
+            self._buckets.pop(key, None)
+
+    # -- introspection -------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._results),
+                    "bucket_queries": len(self._buckets),
+                    "bucket_slices": sum(len(v)
+                                         for v in self._buckets.values()),
+                    **self.counters,
+                    "cost": self.cost.snapshot()}

@@ -4,17 +4,34 @@ Reference analog: server/querier/engine/clickhouse/clickhouse.go:184
 (CHEngine.ExecuteQuery) — but instead of translating to ClickHouse SQL we
 compile the AST to numpy ops, with SmartEncoding dictionary translation
 pushed down onto the (small) dictionaries rather than the rows.
+
+Execution stays dictionary-ENCODED end to end (the ClickHouse
+LowCardinality discipline): grouping, HAVING, ORDER BY and LIMIT all run
+on int columns — grouping through the native hash-group kernel
+(native/qexec.cpp, numpy lexsort fallback, DF_NO_NATIVE kill-switch) and
+ORDER BY through collation ranks computed once per (small) dictionary.
+Only the final top-K rows are decoded to strings. DF_QUERY_ENCODED=0
+selects the legacy decode-then-Python-sort path for A/B parity checks.
 """
 
 from __future__ import annotations
 
+import os
 import re
+import time
 from dataclasses import dataclass
 
 import numpy as np
 
+from deepflow_tpu import native
 from deepflow_tpu.query import sql as S
+from deepflow_tpu.query.costmodel import KernelCostModel
 from deepflow_tpu.store.table import ColumnarTable
+
+# Shared group-kernel cost model: native hash-group vs numpy lexsort.
+# Initial overheads seed the choice before observations exist (ctypes
+# marshalling makes the native call more expensive per invocation).
+_COST = KernelCostModel(overhead_ns={"native": 15_000.0, "numpy": 2_000.0})
 
 
 @dataclass
@@ -101,6 +118,21 @@ def _like_to_pred(pattern: str):
     rx = re.compile("^" + "".join(parts) + "$", re.DOTALL)
     return lambda s: rx.match(s) is not None
 
+
+def _isin(arr: np.ndarray, vals) -> np.ndarray:
+    """np.isin, routed through the native hash-set kernel when the column
+    is dictionary-id shaped (uint32) and the literal set is pure ints —
+    the encoded-predicate fast path for IN / LIKE pushdown."""
+    if arr.dtype == np.uint32 and len(arr):
+        vl = np.asarray(vals)
+        if (vl.ndim == 1 and vl.dtype.kind in "iu" and len(vl)
+                and int(vl.min()) >= 0
+                and int(vl.max()) <= 0xFFFFFFFF):
+            m = native.qx_isin_u32(np.ascontiguousarray(arr),
+                                   vl.astype(np.uint32))
+            if m is not None:
+                return m
+    return np.isin(arr, vals)
 
 
 def _case_select(conds, vals, default, shape) -> _Val:
@@ -191,12 +223,12 @@ class _Env:
         if op == "IN":
             lv = self.eval(e.left)
             vals = [self._coerce_lit(lv, lit.value) for lit in e.right]
-            return _Val(np.isin(lv.arr, vals), "bool")
+            return _Val(_isin(lv.arr, vals), "bool")
         if op == "LIKE":
             lv = self.eval(e.left)
             if lv.kind == "str":
                 ids = lv.dict_.match_ids(_like_to_pred(e.right.value))
-                return _Val(np.isin(lv.arr, ids), "bool")
+                return _Val(_isin(lv.arr, ids), "bool")
             if lv.kind == "enum":
                 pred = _like_to_pred(e.right.value)
                 ids = [i for i, s in enumerate(lv.labels) if pred(s)]
@@ -495,22 +527,107 @@ def _materialize(table: ColumnarTable, query: S.Select,
     return _Env(table, cols), n_rows
 
 
+# -- grouping kernels -------------------------------------------------------
+
+def _sort_ranks(a: np.ndarray) -> np.ndarray:
+    """int64 view of one key column that sorts identically to its values.
+    Ints pass through; floats/objects are rank-encoded via np.unique
+    (ranks are monotone in the values, so lexicographic order over ranks
+    == lexicographic order over values — the same invariant the legacy
+    radix composition relied on)."""
+    if a.dtype.kind == "b":
+        return a.astype(np.int64)
+    if a.dtype.kind in "iu":
+        if a.dtype == np.uint64 and len(a) and int(a.max()) > 2**63 - 1:
+            _, inv = np.unique(a, return_inverse=True)
+            return inv.astype(np.int64)
+        return a.astype(np.int64)
+    _, inv = np.unique(a, return_inverse=True)
+    return inv.astype(np.int64)
+
+
+def _group_rows(arrs: list[np.ndarray], first_occurrence: bool):
+    """Group rows by the composite key over `arrs`.
+
+    -> (order, bounds_full, n_groups): order is a row permutation with
+    groups contiguous and original row order within each group;
+    bounds_full has n_groups+1 entries. Group order is first-occurrence
+    when `first_occurrence` else ascending-lexicographic over the keys.
+
+    Dispatches between the native O(n) hash-group kernel and numpy
+    lexsort via the learned cost model; both are reshaped to the
+    requested group order so callers see one deterministic layout.
+    """
+    ranks = [_sort_ranks(np.ascontiguousarray(a)) for a in arrs]
+    n = len(ranks[0]) if ranks else 0
+    if n == 0:
+        return (np.empty(0, dtype=np.int64), np.zeros(1, dtype=np.int64), 0)
+    kernel = _COST.choose(n) if native.load() is not None else "numpy"
+    if kernel == "native":
+        t0 = time.perf_counter_ns()
+        res = native.qx_group(ranks)
+        if res is not None:
+            order, bounds_full, ng = res
+            if not first_occurrence:
+                # reorder first-occurrence groups to ascending key order:
+                # lexsort the (one-per-group) representatives, then gather
+                starts = bounds_full[:-1]
+                reps = order[starts]
+                perm = np.lexsort([r[reps] for r in ranks][::-1])
+                order, bounds_full = _apply_group_perm(
+                    order, bounds_full, perm)
+            _COST.observe("native", n, time.perf_counter_ns() - t0)
+            return order, bounds_full, ng
+    t0 = time.perf_counter_ns()
+    order = np.lexsort(ranks[::-1]).astype(np.int64)
+    changed = np.zeros(n, dtype=bool)
+    changed[0] = True
+    for r in ranks:
+        sr = r[order]
+        changed[1:] |= sr[1:] != sr[:-1]
+    starts = np.flatnonzero(changed)
+    bounds_full = np.append(starts, n).astype(np.int64)
+    ng = len(starts)
+    if first_occurrence:
+        # stable argsort over each group's earliest row restores
+        # first-occurrence discovery order
+        perm = np.argsort(order[starts], kind="stable")
+        order, bounds_full = _apply_group_perm(order, bounds_full, perm)
+    _COST.observe("numpy", n, time.perf_counter_ns() - t0)
+    return order, bounds_full, ng
+
+
+def _apply_group_perm(order: np.ndarray, bounds_full: np.ndarray,
+                      perm: np.ndarray):
+    """Permute whole groups of `order` by `perm` without a Python loop:
+    gather each group's segment to its new contiguous position."""
+    starts = bounds_full[:-1]
+    lengths = (bounds_full[1:] - starts)[perm]
+    new_bounds = np.concatenate(
+        ([0], np.cumsum(lengths))).astype(np.int64)
+    offsets = starts[perm] - new_bounds[:-1]
+    idx = np.repeat(offsets, lengths) + np.arange(len(order))
+    return order[idx], new_bounds
+
+
 def _group_order(env: _Env, query: S.Select,
                  n_rows: int) -> tuple[np.ndarray, np.ndarray]:
-    """-> (order, bounds) group permutation for the aggregate path."""
+    """-> (order, bounds) group permutation for the aggregate path.
+    Groups come out in ascending key order (the legacy radix-composition
+    contract, so encoded and decoded paths emit identical row order)."""
     if query.group_by:
         key_vals = [env.eval(g) for g in query.group_by]
         if n_rows == 0:
             return (np.empty(0, dtype=np.int64),
                     np.empty(0, dtype=np.int64))
-        key = np.zeros(n_rows, dtype=np.int64)
+        arrs = []
         for kv in key_vals:
-            _, inv = np.unique(kv.arr, return_inverse=True)
-            key = key * (int(inv.max(initial=0)) + 1) + inv
-        order = np.argsort(key, kind="stable")
-        sk = key[order]
-        bounds = np.flatnonzero(np.append(True, sk[1:] != sk[:-1]))
-        return order, bounds
+            a = kv.arr
+            if a.ndim == 0:  # GROUP BY a literal: one group
+                a = np.broadcast_to(a, (n_rows,))
+            arrs.append(a)
+        order, bounds_full, _ = _group_rows(arrs, first_occurrence=False)
+        return order, bounds_full[:-1]
     # one group over all rows; zero rows -> zero groups
     return (np.arange(n_rows),
             np.zeros(1 if n_rows else 0, dtype=np.int64))
@@ -521,10 +638,127 @@ def _is_agg_query(query: S.Select) -> bool:
         S.contains_agg(i.expr) for i in query.items)
 
 
+# -- columnar ORDER BY / LIMIT ----------------------------------------------
+
+def _slice_val(v: _Val, idx) -> _Val:
+    w = _Val(v.arr[idx], v.kind, labels=v.labels, unit=v.unit)
+    w.dict_ = v.dict_
+    return w
+
+
+def _sort_key(v: _Val) -> np.ndarray:
+    """Sortable int64/float64 column matching Python-row-sort semantics.
+    Dictionary ids are NOT collation-ordered, so string columns sort by a
+    rank table built once over the (small) dictionary, never the rows."""
+    a = v.arr
+    if v.kind == "str" and v.dict_ is not None:
+        n_d = v.dict_.sync_state()[1]
+        strs = np.asarray(
+            v.dict_.decode_many(np.arange(n_d, dtype=np.uint32)),
+            dtype=object)
+        rank = np.empty(n_d, dtype=np.int64)
+        rank[np.argsort(strs, kind="stable")] = np.arange(
+            n_d, dtype=np.int64)
+        return rank.take(a.astype(np.int64), mode="clip")
+    if v.kind == "enum":
+        if not v.labels:
+            return a.astype(np.int64)
+        labs = np.asarray(v.labels, dtype=object)
+        rank = np.empty(len(labs), dtype=np.int64)
+        rank[np.argsort(labs, kind="stable")] = np.arange(
+            len(labs), dtype=np.int64)
+        return rank.take(a.astype(np.int64), mode="clip")
+    if v.kind == "obj":
+        # _case_select guarantees all-string object arrays
+        _, inv = np.unique(a, return_inverse=True)
+        return inv.astype(np.int64)
+    if a.dtype.kind == "f":
+        return a.astype(np.float64)
+    return a.astype(np.int64)
+
+
+def _order_limit_idx(query: S.Select, names: list[str],
+                     outs: list[_Val]) -> np.ndarray | None:
+    """Encoded ORDER BY + LIMIT: index array selecting/ordering the final
+    rows, or None for 'keep everything as is'. Mirrors _order_limit's
+    name resolution and reversed-stable-sort semantics via one lexsort."""
+    n = max((len(v.arr) for v in outs), default=0)
+    if not query.order_by:
+        if query.limit is not None and query.limit < n:
+            return np.arange(query.limit)
+        return None
+    keys = []
+    for e, desc in query.order_by:
+        key_name = S.expr_name(e)
+        if key_name in names:
+            idx = names.index(key_name)
+        elif isinstance(e, S.Col) and e.name in names:
+            idx = names.index(e.name)
+        else:
+            raise QueryError(f"ORDER BY {key_name!r} must appear in SELECT")
+        k = _sort_key(outs[idx])
+        if desc:
+            k = -k
+        keys.append(k)
+    order = np.lexsort(keys[::-1])
+    if query.limit is not None:
+        order = order[:query.limit]
+    return order
+
+
+def _finish_columnar(query: S.Select, names: list[str],
+                     outs: list[_Val]) -> QueryResult:
+    """Sort/limit on encoded columns, decode only the surviving rows."""
+    idx = _order_limit_idx(query, names, outs)
+    if idx is not None:
+        outs = [_slice_val(v, idx) for v in outs]
+    decoded = [v.decoded() for v in outs]
+    n_out = max((len(d) for d in decoded), default=0)
+    rows = [list(r) for r in zip(*decoded)] if n_out else []
+    return QueryResult(columns=names, values=rows)
+
+
 def execute(table: ColumnarTable, query: S.Select | str) -> QueryResult:
     if isinstance(query, str):
         query = S.parse(query)
     query = _normalize(table, query)
+    if os.environ.get("DF_QUERY_ENCODED", "1") == "0":
+        return _execute_decoded(table, query)
+    env, n_rows = _materialize(table, query)
+
+    is_agg = _is_agg_query(query)
+
+    names = [i.alias or S.expr_name(i.expr) for i in query.items]
+    if not is_agg:
+        outs = []
+        for i in query.items:
+            v = env.eval(i.expr)
+            if v.arr.ndim == 0:  # bare literal: broadcast over rows
+                v = _Val(np.full(n_rows, v.arr.item()), v.kind)
+            outs.append(v)
+        return _finish_columnar(query, names, outs)
+
+    order, bounds = _group_order(env, query, n_rows)
+    n_groups = len(bounds)
+    outs = []
+    for i in query.items:
+        v = _agg_eval(i.expr, env, order, bounds)
+        if v.arr.ndim == 0:  # bare literal: broadcast over groups
+            v = _Val(np.full(n_groups, v.arr.item()), v.kind)
+        outs.append(v)
+    if query.having is not None:
+        mask = _agg_eval(query.having, env, order, bounds).arr
+        if mask.ndim == 0:
+            mask = np.full(n_groups, bool(mask))
+        mask = mask.astype(bool)
+        outs = [_slice_val(v, mask) for v in outs]
+    return _finish_columnar(query, names, outs)
+
+
+def _execute_decoded(table: ColumnarTable, query: S.Select) -> QueryResult:
+    """Legacy decode-then-Python-sort tail (DF_QUERY_ENCODED=0). Kept as
+    the parity reference the encoded path must match byte for byte —
+    cli/query_check.py diffs the two on every golden query."""
     env, n_rows = _materialize(table, query)
 
     is_agg = _is_agg_query(query)
@@ -563,8 +797,8 @@ def execute(table: ColumnarTable, query: S.Select | str) -> QueryResult:
 
 def _order_limit(query: S.Select, names: list[str],
                  rows: list[list]) -> list[list]:
-    """ORDER BY over output columns, then LIMIT (shared by the local
-    executor and the federated merge reduce)."""
+    """ORDER BY over output columns, then LIMIT (shared by the legacy
+    executor and the generic federated merge reduce)."""
     for e, desc in reversed(query.order_by):
         key_name = S.expr_name(e)
         if key_name in names:
@@ -586,11 +820,18 @@ def _order_limit(query: S.Select, names: list[str],
 # results (its own local partial included). Both sides derive the result
 # layout from the same _normalize()d query, so the wire carries no schema.
 # Distributive aggregates (SUM/COUNT/MIN/MAX) push down exactly, AVG
-# travels as (sum, count), COUNT(DISTINCT) as per-group decoded distinct
-# values, LAST as (value, time) pairs resolved by max time, PERCENTILE as
-# a mergeable histogram sketch (the one documented-approximate merge).
-# Dictionary/enum columns are ALWAYS decoded to label strings shard-side
-# before merge — shard-local SmartEncoding ids are never comparable.
+# travels as (sum, count), COUNT(DISTINCT) as per-group distinct values,
+# LAST as (value, time) pairs resolved by max time, PERCENTILE as a
+# mergeable histogram sketch (the one documented-approximate merge).
+#
+# Column encoding on the wire is version-negotiated per column, not per
+# protocol: execute_partial(encoded=True) ships dictionary/enum columns
+# as INT id arrays plus a {"dicts": {col: [gen, len]}} manifest, and the
+# coordinator remaps ids into its local dictionaries via the dict-sync
+# deltas (cluster/dictsync.py) before merging. A shard that predates the
+# encoded forms ships plain decoded lists; _inflate_partial() lowers any
+# mix of old and new forms to decoded strings and the generic merge
+# reduces them — old and new shards interoperate in one scatter.
 
 def _agg_sites(query: S.Select) -> list[S.Func]:
     """Unique aggregate call sites (by display name) across SELECT items
@@ -636,7 +877,8 @@ def _decode_slice(v: _Val, arr: np.ndarray) -> list:
 
 def _partial_state(site: S.Func, env: _Env, order: np.ndarray,
                    starts: np.ndarray, ends: np.ndarray) -> list:
-    """Per-group mergeable state for one aggregate site (JSON-able)."""
+    """Per-group mergeable state for one aggregate site (JSON-able,
+    decoded — the cross-version compat form)."""
     n_groups = len(starts)
     if n_groups == 0:
         return []
@@ -686,11 +928,84 @@ def _partial_state(site: S.Func, env: _Env, order: np.ndarray,
     raise QueryError(f"unknown aggregate {name}")
 
 
-def execute_partial(table: ColumnarTable, query: S.Select | str) -> dict:
+def _partial_state_enc(site: S.Func, env: _Env, order: np.ndarray,
+                       starts: np.ndarray, ends: np.ndarray,
+                       dict_names: dict, used: dict):
+    """Encoded per-site state: float64 arrays for the distributive
+    aggregates, dictionary-id sets for COUNT(DISTINCT str). LAST and
+    PERCENTILE keep their decoded forms (value+timestamp pairs and
+    sketches merge on decoded/abstract state anyway)."""
+    n_groups = len(starts)
+    if n_groups == 0:
+        return []
+    name = site.name
+    if name == "COUNT" and site.distinct:
+        if len(site.args) != 1 or isinstance(site.args[0], S.Star):
+            raise QueryError("COUNT(DISTINCT) takes exactly one column")
+        v = env.eval(site.args[0])
+        key = dict_names.get(id(v.dict_)) if v.kind == "str" else None
+        if key is not None:
+            used[key] = v.dict_
+            a = v.arr[order]
+            return {"ed": key,
+                    "sets": [np.unique(a[s0:e0]).astype(np.int64).tolist()
+                             for s0, e0 in zip(starts, ends)]}
+        return _partial_state(site, env, order, starts, ends)
+    if site.distinct:
+        raise QueryError(
+            f"DISTINCT is only supported in Count(), not {name}")
+    if name == "COUNT" or not site.args or isinstance(site.args[0], S.Star):
+        return {"a": (ends - starts).astype(np.float64)}
+    if name in ("LAST", "PERCENTILE"):
+        return _partial_state(site, env, order, starts, ends)
+    v = env.eval(site.args[0])
+    if v.kind in ("str", "enum", "obj"):
+        raise QueryError(
+            f"{name} over string column {S.expr_name(site.args[0])!r}")
+    a = v.arr.astype(np.float64)[order]
+    if name == "SUM":
+        return {"a": np.add.reduceat(a, starts)}
+    if name == "AVG":
+        return {"avg": [np.add.reduceat(a, starts),
+                        (ends - starts).astype(np.float64)]}
+    if name == "MIN":
+        return {"a": np.minimum.reduceat(a, starts)}
+    if name == "MAX":
+        return {"a": np.maximum.reduceat(a, starts)}
+    raise QueryError(f"unknown aggregate {name}")
+
+
+def _enc_col(v: _Val, arr: np.ndarray, dict_names: dict, used: dict):
+    """Self-describing encoded column form for a group-key/item slice, or
+    None when only the decoded list form can represent it ('obj')."""
+    if v.kind == "str" and v.dict_ is not None:
+        key = dict_names.get(id(v.dict_))
+        if key is not None:
+            used[key] = v.dict_
+            return {"e": key,
+                    "ids": np.ascontiguousarray(arr, dtype=np.uint32)}
+        return None
+    if v.kind == "enum":
+        return {"n": arr.astype(np.int64), "labels": list(v.labels)}
+    if v.kind == "bool":
+        return {"a": arr.astype(np.uint8), "k": "bool"}
+    if v.kind == "num":
+        return {"a": np.ascontiguousarray(arr)}
+    return None
+
+
+def execute_partial(table: ColumnarTable, query: S.Select | str, *,
+                    encoded: bool = False) -> dict:
     """Shard-local half of a federated query. Row queries run fully
     (ORDER/LIMIT pushed down — a shard-local top-k is a superset of the
     global top-k's contribution); aggregate queries return per-group
-    partial states keyed by DECODED group-key values."""
+    partial states.
+
+    encoded=False keys groups by DECODED values (the cross-version wire
+    form every coordinator understands). encoded=True ships dictionary
+    ids + a {"dicts": {col: [gen, len]}} manifest instead; the caller is
+    responsible for remapping ids into its own dictionaries (dictsync)
+    before merging."""
     if isinstance(query, str):
         query = S.parse(query)
     if not _is_agg_query(_normalize(table, query)):
@@ -698,6 +1013,8 @@ def execute_partial(table: ColumnarTable, query: S.Select | str) -> dict:
         return {"kind": "rows", "columns": res.columns,
                 "values": res.values}
     query = _normalize(table, query)
+    if encoded and os.environ.get("DF_QUERY_ENCODED", "1") == "0":
+        encoded = False
     sites = _agg_sites(query)
     needs_time = (any(s.name == "LAST" for s in sites)
                   and "time" in table.columns)
@@ -707,26 +1024,51 @@ def execute_partial(table: ColumnarTable, query: S.Select | str) -> dict:
     starts = bounds
     ends = np.append(bounds[1:], len(order))
     n_groups = len(bounds)
+    dict_names = ({id(d): cn for cn, d in table.dicts.items()}
+                  if encoded else {})
+    used: dict = {}  # dict-columns actually shipped as ids
     keys = []
     for g in query.group_by:
         v = env.eval(g)
         arr = v.arr[order][bounds] if n_groups else v.arr[:0]
-        keys.append(_decode_slice(v, arr))
-    items: dict[str, list] = {}
+        col = _enc_col(v, arr, dict_names, used) if encoded else None
+        keys.append(col if col is not None else _decode_slice(v, arr))
+    items: dict[str, object] = {}
     for idx, item in enumerate(query.items):
         if S.contains_agg(item.expr):
             continue
         v = env.eval(item.expr)
         if v.arr.ndim == 0:   # bare literal: broadcast over groups
-            items[str(idx)] = [v.arr.item()] * n_groups
-        else:
-            arr = v.arr[order][bounds] if n_groups else v.arr[:0]
-            items[str(idx)] = _decode_slice(v, arr)
-    return {"kind": "agg", "n_groups": n_groups, "keys": keys,
-            "items": items,
-            "sites": {S.expr_name(s): _partial_state(s, env, order,
-                                                     starts, ends)
-                      for s in sites}}
+            if encoded and v.kind == "num":
+                items[str(idx)] = {"a": np.full(n_groups, v.arr.item())}
+            else:
+                items[str(idx)] = [v.arr.item()] * n_groups
+            continue
+        arr = v.arr[order][bounds] if n_groups else v.arr[:0]
+        col = _enc_col(v, arr, dict_names, used) if encoded else None
+        items[str(idx)] = col if col is not None else _decode_slice(v, arr)
+    if encoded:
+        site_states = {S.expr_name(s): _partial_state_enc(
+            s, env, order, starts, ends, dict_names, used) for s in sites}
+    else:
+        site_states = {S.expr_name(s): _partial_state(s, env, order,
+                                                      starts, ends)
+                       for s in sites}
+    out = {"kind": "agg", "n_groups": n_groups, "keys": keys,
+           "items": items, "sites": site_states}
+    if used:
+        # The gen/len manifest is read AFTER building: the dictionary only
+        # grows in place, so len covers every id shipped above. If
+        # compaction swapped the dictionary object out mid-build, the ids
+        # we encoded belong to the retired object — recompute decoded.
+        dicts = {}
+        for key, d in used.items():
+            if table.dicts.get(key) is not d:
+                return execute_partial(table, query, encoded=False)
+            g, ln, _ver = d.sync_state()
+            dicts[key] = [g, ln]
+        out["dicts"] = dicts
+    return out
 
 
 def _merge_site(site: S.Func, states: list) -> object:
@@ -823,11 +1165,377 @@ def _scalar_eval(e, agg_vals: dict, named: dict):
     raise QueryError(f"cannot merge-evaluate {e!r}")
 
 
+# -- encoded merge: vectorized fast path with decoded fallback --------------
+
+class _FastUnsupported(Exception):
+    """Internal: the vectorized merge/combine can't represent this query
+    or partial form exactly — fall back to the decoded generic path."""
+
+
+def _table_dict(table: ColumnarTable, key: str):
+    d = table.dicts.get(key)
+    if d is None:
+        raise QueryError(f"unknown dictionary column {key!r} in partial")
+    return d
+
+
+def _col_form(c, size: int):
+    """-> (values_arr, int64_key_arr, meta) for an encoded partial column;
+    raises _FastUnsupported for decoded lists / float keys / unknown
+    forms (those take the generic merge)."""
+    if isinstance(c, dict):
+        if "e" in c:
+            a = np.asarray(c["ids"])
+            if len(a) != size:
+                raise _FastUnsupported
+            return a, a.astype(np.int64), ("e", c["e"])
+        if "n" in c:
+            a = np.asarray(c["n"])
+            if len(a) != size:
+                raise _FastUnsupported
+            return a, a.astype(np.int64), ("n", tuple(c["labels"]))
+        if "a" in c:
+            a = np.asarray(c["a"])
+            if len(a) != size or a.dtype.kind not in "iub":
+                raise _FastUnsupported
+            return a, a.astype(np.int64), ("a", c.get("k", "num"))
+    raise _FastUnsupported
+
+
+def _form_val(cat: np.ndarray, meta: tuple, sel, decoder) -> _Val:
+    """Rebuild a _Val from a concatenated encoded column at `sel`."""
+    kindm, info = meta
+    a = cat[sel]
+    if kindm == "e":
+        v = _Val(a.astype(np.uint32), "str")
+        v.dict_ = decoder(info)
+        return v
+    if kindm == "n":
+        return _Val(a, "enum", labels=tuple(info))
+    if info == "bool":
+        return _Val(a, "bool")
+    return _Val(a)
+
+
+def _as_bool(a: np.ndarray, n: int) -> np.ndarray:
+    a = np.asarray(a)
+    if a.ndim == 0:
+        return np.full(n, bool(a))
+    return a.astype(bool)
+
+
+def _vec_eval(e, aggs: dict, named: dict, n: int) -> _Val:
+    """Vectorized mirror of _scalar_eval over merged group columns.
+    Raises _FastUnsupported wherever array semantics could diverge from
+    the scalar path (CASE without vectorizable shape, cross-dictionary
+    string compares, LIKE over non-strings) so exactness is preserved by
+    falling back rather than approximating."""
+    if isinstance(e, S.Lit):
+        if isinstance(e.value, str):
+            raise _FastUnsupported
+        return _Val(np.asarray(e.value, dtype=np.float64))
+    if isinstance(e, S.Func) and e.name in S.AGG_FUNCS:
+        k = S.expr_name(e)
+        if k not in aggs:
+            raise _FastUnsupported
+        return _Val(aggs[k])
+    if not S.contains_agg(e):
+        k = S.expr_name(e)
+        if k in named:
+            return named[k]
+        if isinstance(e, (S.Col, S.Func)):
+            raise QueryError(
+                f"federated merge cannot evaluate {k!r}: "
+                "not a group key or aggregate")
+    if isinstance(e, S.Not):
+        v = _vec_eval(e.expr, aggs, named, n)
+        return _Val(~_as_bool(v.arr, n), "bool")
+    if isinstance(e, S.BinOp):
+        op = e.op
+        if op in ("AND", "OR"):
+            l = _as_bool(_vec_eval(e.left, aggs, named, n).arr, n)
+            r = _as_bool(_vec_eval(e.right, aggs, named, n).arr, n)
+            return _Val(l & r if op == "AND" else l | r, "bool")
+        if op == "IN":
+            lv = _vec_eval(e.left, aggs, named, n)
+            vals = tuple(lit.value for lit in e.right)
+            if lv.kind == "str":
+                ids = [lv.dict_.lookup(s) for s in vals
+                       if isinstance(s, str)]
+                ids = np.asarray([i for i in ids if i is not None],
+                                 dtype=np.uint32)
+                return _Val(_isin(lv.arr, ids), "bool")
+            if lv.kind == "enum":
+                ids = [i for i, s in enumerate(lv.labels) if s in vals]
+                return _Val(np.isin(lv.arr, ids), "bool")
+            if lv.kind == "obj":
+                raise _FastUnsupported
+            return _Val(np.isin(lv.arr, vals), "bool")
+        if op == "LIKE":
+            lv = _vec_eval(e.left, aggs, named, n)
+            pred = _like_to_pred(e.right.value)
+            if lv.kind == "str":
+                return _Val(_isin(lv.arr, lv.dict_.match_ids(pred)),
+                            "bool")
+            if lv.kind == "enum":
+                ids = [i for i, s in enumerate(lv.labels) if pred(s)]
+                return _Val(np.isin(lv.arr, ids), "bool")
+            raise _FastUnsupported
+        if op in _CMP:
+            rv_raw = e.right
+            if isinstance(rv_raw, S.Lit) and isinstance(rv_raw.value, str):
+                if op not in ("=", "!="):
+                    raise _FastUnsupported
+                lv = _vec_eval(e.left, aggs, named, n)
+                if lv.kind == "str":
+                    sid = lv.dict_.lookup(rv_raw.value)
+                    code = (np.uint32(sid) if sid is not None
+                            else np.uint32(0xFFFFFFFF))
+                elif lv.kind == "enum":
+                    try:
+                        code = lv.labels.index(rv_raw.value)
+                    except ValueError:
+                        code = -1
+                else:
+                    raise _FastUnsupported
+                res = lv.arr == code if op == "=" else lv.arr != code
+                return _Val(np.asarray(res), "bool")
+            lv = _vec_eval(e.left, aggs, named, n)
+            rv = _vec_eval(rv_raw, aggs, named, n)
+            if (lv.kind in ("str", "enum", "obj")
+                    or rv.kind in ("str", "enum", "obj")):
+                if (lv.kind == "str" and rv.kind == "str"
+                        and lv.dict_ is rv.dict_ and op in ("=", "!=")):
+                    res = (lv.arr == rv.arr if op == "="
+                           else lv.arr != rv.arr)
+                    return _Val(res, "bool")
+                raise _FastUnsupported
+            return _Val(np.asarray(_CMP[op](lv.arr, rv.arr)), "bool")
+        lv = _vec_eval(e.left, aggs, named, n)
+        rv = _vec_eval(e.right, aggs, named, n)
+        if lv.kind not in ("num", "bool") or rv.kind not in ("num", "bool"):
+            raise _FastUnsupported
+        l = lv.arr.astype(np.float64)
+        r = rv.arr.astype(np.float64)
+        if op == "+":
+            return _Val(l + r)
+        if op == "-":
+            return _Val(l - r)
+        if op == "*":
+            return _Val(l * r)
+        if op == "/":
+            with np.errstate(divide="ignore", invalid="ignore"):
+                return _Val(np.where(r != 0, l / np.where(r == 0, 1, r),
+                                     0.0))
+        raise _FastUnsupported
+    raise _FastUnsupported
+
+
+def _merge_fast(table: ColumnarTable, query: S.Select, names: list[str],
+                sites: list, site_keys: list[str], parts: list[dict],
+                decoder) -> QueryResult:
+    """Vectorized merge over fully-encoded partials: concatenate group-key
+    int columns, one hash-group pass, reduceat the site arrays. Any form
+    it can't fold exactly raises _FastUnsupported (caller falls back)."""
+    live = [p for p in parts if int(p.get("n_groups", 0)) > 0]
+    if not live:
+        return QueryResult(columns=names, values=[])
+    sizes = [int(p["n_groups"]) for p in live]
+    K = len(query.group_by)
+    # group-key columns, concatenated across partials
+    key_vals: list[tuple[np.ndarray, tuple]] = []
+    key_ints: list[np.ndarray] = []
+    for ki in range(K):
+        vals, ints, metas = [], [], set()
+        for p, sz in zip(live, sizes):
+            cols = p.get("keys", [])
+            if ki >= len(cols):
+                raise _FastUnsupported
+            a, ia, m = _col_form(cols[ki], sz)
+            vals.append(a)
+            ints.append(ia)
+            metas.add(m)
+        if len(metas) != 1:
+            raise _FastUnsupported  # mixed forms across shard versions
+        key_vals.append((np.concatenate(vals), metas.pop()))
+        key_ints.append(np.concatenate(ints))
+    # shipped non-aggregate item columns
+    item_cols: dict[str, tuple[np.ndarray, tuple]] = {}
+    for idx, item in enumerate(query.items):
+        if S.contains_agg(item.expr):
+            continue
+        si = str(idx)
+        vals, metas = [], set()
+        for p, sz in zip(live, sizes):
+            c = p.get("items", {}).get(si)
+            if c is None:
+                raise _FastUnsupported
+            a, _ia, m = _col_form(c, sz)
+            vals.append(a)
+            metas.add(m)
+        if len(metas) != 1:
+            raise _FastUnsupported
+        item_cols[si] = (np.concatenate(vals), metas.pop())
+    # site states: only plain-array and (sum,count) forms vectorize
+    site_states: dict[str, tuple[str, list]] = {}
+    for s, sk in zip(sites, site_keys):
+        form = None
+        acc = []
+        for p, sz in zip(live, sizes):
+            st = p.get("sites", {}).get(sk)
+            if not isinstance(st, dict):
+                raise _FastUnsupported
+            if "a" in st:
+                f = "a"
+                a = np.asarray(st["a"], dtype=np.float64)
+                if len(a) != sz:
+                    raise _FastUnsupported
+                acc.append((a,))
+            elif "avg" in st:
+                f = "avg"
+                ss = np.asarray(st["avg"][0], dtype=np.float64)
+                cc = np.asarray(st["avg"][1], dtype=np.float64)
+                if len(ss) != sz or len(cc) != sz:
+                    raise _FastUnsupported
+                acc.append((ss, cc))
+            else:
+                raise _FastUnsupported
+            if form is None:
+                form = f
+            elif form != f:
+                raise _FastUnsupported
+        site_states[sk] = (form, acc)
+
+    total = sum(sizes)
+    if K == 0:
+        order = np.arange(total, dtype=np.int64)
+        bounds_full = np.array([0, total], dtype=np.int64)
+        ng = 1
+    else:
+        # first-occurrence order == the generic merge's discovery order
+        order, bounds_full, ng = _group_rows(key_ints,
+                                             first_occurrence=True)
+    starts = bounds_full[:-1]
+    rep = order[starts]
+
+    aggs: dict[str, np.ndarray] = {}
+    for s, sk in zip(sites, site_keys):
+        form, acc = site_states[sk]
+        if form == "a":
+            cat = np.concatenate([a for (a,) in acc])[order]
+            if s.name in ("COUNT", "SUM"):
+                aggs[sk] = np.add.reduceat(cat, starts)
+            elif s.name == "MIN":
+                aggs[sk] = np.minimum.reduceat(cat, starts)
+            elif s.name == "MAX":
+                aggs[sk] = np.maximum.reduceat(cat, starts)
+            else:
+                raise _FastUnsupported
+        else:
+            if s.name != "AVG":
+                raise _FastUnsupported
+            ssum = np.concatenate([x for x, _c in acc])[order]
+            scnt = np.concatenate([c for _x, c in acc])[order]
+            ms = np.add.reduceat(ssum, starts)
+            mc = np.add.reduceat(scnt, starts)
+            aggs[sk] = ms / np.maximum(mc, 1)
+
+    named: dict[str, _Val] = {}
+    for gexpr, (cat, meta) in zip(query.group_by, key_vals):
+        named[S.expr_name(gexpr)] = _form_val(cat, meta, rep, decoder)
+    item_vals: dict[str, _Val] = {}
+    for idx, item in enumerate(query.items):
+        si = str(idx)
+        if si in item_cols:
+            cat, meta = item_cols[si]
+            v = _form_val(cat, meta, rep, decoder)
+            item_vals[si] = v
+            named[S.expr_name(item.expr)] = v
+            if item.alias:
+                named[item.alias] = v
+    n_cur = ng
+    if query.having is not None:
+        hv = _vec_eval(query.having, aggs, named, n_cur)
+        mask = _as_bool(hv.arr, n_cur)
+        aggs = {k: v[mask] for k, v in aggs.items()}
+        named = {k: _slice_val(v, mask) for k, v in named.items()}
+        item_vals = {k: _slice_val(v, mask) for k, v in item_vals.items()}
+        n_cur = int(mask.sum())
+    outs = []
+    for idx, item in enumerate(query.items):
+        if not S.contains_agg(item.expr):
+            outs.append(item_vals[str(idx)])
+            continue
+        v = _vec_eval(item.expr, aggs, named, n_cur)
+        if v.arr.ndim == 0:
+            v = _Val(np.full(n_cur, v.arr.item()), v.kind)
+        outs.append(v)
+    return _finish_columnar(query, names, outs)
+
+
+def _col_decoded(c, decoder) -> list:
+    """Lower any partial column form to the decoded list form."""
+    if isinstance(c, list):
+        return c
+    if isinstance(c, dict):
+        if "e" in c:
+            ids = np.asarray(c["ids"], dtype=np.uint32)
+            return decoder(c["e"]).decode_many(ids)
+        if "n" in c:
+            lab = list(c["labels"])
+            return [lab[int(i)] for i in np.asarray(c["n"]).tolist()]
+        if "a" in c:
+            a = np.asarray(c["a"])
+            if c.get("k") == "bool":
+                return a.astype(bool).tolist()
+            return a.tolist()
+    raise QueryError("unrecognized partial column form")
+
+
+def _inflate_partial(p: dict, decoder) -> dict:
+    """Lower an encoded partial to the decoded compat form so the generic
+    merge can join it against partials from any shard version."""
+    if not p or p.get("kind") != "agg":
+        return p
+    q = dict(p)
+    q["keys"] = [_col_decoded(c, decoder) for c in p.get("keys", [])]
+    q["items"] = {k: _col_decoded(v, decoder)
+                  for k, v in p.get("items", {}).items()}
+    sites = {}
+    for sk, st in p.get("sites", {}).items():
+        if isinstance(st, dict):
+            if "a" in st:
+                sites[sk] = np.asarray(st["a"], dtype=np.float64).tolist()
+            elif "avg" in st:
+                s_arr = np.asarray(st["avg"][0], dtype=np.float64)
+                c_arr = np.asarray(st["avg"][1], dtype=np.float64)
+                sites[sk] = [[float(x), int(c)]
+                             for x, c in zip(s_arr.tolist(),
+                                             c_arr.tolist())]
+            elif "ed" in st:
+                d = decoder(st["ed"])
+                sites[sk] = [d.decode_many(np.asarray(g, dtype=np.uint32))
+                             for g in st["sets"]]
+            else:
+                raise QueryError("unrecognized partial site form")
+        else:
+            sites[sk] = st
+    q["sites"] = sites
+    return q
+
+
 def merge_partials(table: ColumnarTable, query: S.Select | str,
-                   partials: list[dict]) -> QueryResult:
+                   partials: list[dict], *, decoder=None) -> QueryResult:
     """Coordinator reduce step over execute_partial() results (the
-    local shard's partial included). Groups join on DECODED key tuples;
-    HAVING / ORDER BY / LIMIT apply only here, at the top."""
+    local shard's partial included). HAVING / ORDER BY / LIMIT apply only
+    here, at the top.
+
+    Fully-encoded partials (whose ids the caller already remapped into
+    the decoder's dictionary space — cluster/dictsync.py) merge on the
+    vectorized int-key fast path; anything else, including partials from
+    pre-encoding shards, is lowered to decoded values and joins on the
+    generic per-group path. decoder maps a dict column name to a
+    Dictionary; defaults to this table's own dictionaries."""
     if isinstance(query, str):
         query = S.parse(query)
     query = _normalize(table, query)
@@ -841,13 +1549,23 @@ def merge_partials(table: ColumnarTable, query: S.Select | str,
             rows.extend(list(r) for r in p.get("values", []))
         return QueryResult(columns=names,
                            values=_order_limit(query, names, rows))
-    sites = _agg_sites(query)
-    site_keys = [S.expr_name(s) for s in sites]
-    groups: dict[tuple, dict] = {}
-    group_seq: list[tuple] = []
     for p in parts:
         if p.get("kind") != "agg":
             raise QueryError("shard returned mismatched partial kind")
+    sites = _agg_sites(query)
+    site_keys = [S.expr_name(s) for s in sites]
+    if decoder is None:
+        decoder = lambda key: _table_dict(table, key)  # noqa: E731
+    if os.environ.get("DF_QUERY_ENCODED", "1") != "0":
+        try:
+            return _merge_fast(table, query, names, sites, site_keys,
+                               parts, decoder)
+        except _FastUnsupported:
+            pass
+    parts = [_inflate_partial(p, decoder) for p in parts]
+    groups: dict[tuple, dict] = {}
+    group_seq: list[tuple] = []
+    for p in parts:
         keys = p.get("keys", [])
         for gi in range(int(p.get("n_groups", 0))):
             kt = tuple(col[gi] for col in keys)
@@ -884,3 +1602,159 @@ def merge_partials(table: ColumnarTable, query: S.Select | str,
             for idx, item in enumerate(query.items)])
     return QueryResult(columns=names,
                        values=_order_limit(query, names, rows))
+
+
+def combine_partials(table: ColumnarTable, query: S.Select | str,
+                     parts: list[dict]) -> dict:
+    """Fold several ENCODED partials over disjoint row sets (per-time-
+    bucket cache slices) into ONE partial equal to a single scan of
+    their union. Exact for every supported site form — including
+    PERCENTILE, whose histogram-sketch merge is bin-exact (only the
+    percentile() readout approximates). LAST is excluded: cross-bucket
+    timestamp ties could resolve differently than a single scan.
+    Raises _FastUnsupported for anything it can't fold exactly."""
+    if isinstance(query, str):
+        query = S.parse(query)
+    query = _normalize(table, query)
+    if not _is_agg_query(query):
+        raise _FastUnsupported
+    sites = _agg_sites(query)
+    site_keys = [S.expr_name(s) for s in sites]
+    if any(s.name == "LAST" for s in sites):
+        raise _FastUnsupported
+    for p in parts:
+        if not p or p.get("kind") != "agg":
+            raise _FastUnsupported
+    live = [p for p in parts if int(p.get("n_groups", 0)) > 0]
+    K = len(query.group_by)
+    item_ids = [str(i) for i, it in enumerate(query.items)
+                if not S.contains_agg(it.expr)]
+    if not live:
+        return {"kind": "agg", "n_groups": 0,
+                "keys": [[] for _ in range(K)],
+                "items": {si: [] for si in item_ids},
+                "sites": {sk: [] for sk in site_keys}}
+    sizes = [int(p["n_groups"]) for p in live]
+    key_vals, key_ints = [], []
+    for ki in range(K):
+        vals, ints, metas = [], [], set()
+        for p, sz in zip(live, sizes):
+            a, ia, m = _col_form(p.get("keys", [])[ki], sz)
+            vals.append(a)
+            ints.append(ia)
+            metas.add(m)
+        if len(metas) != 1:
+            raise _FastUnsupported
+        key_vals.append((np.concatenate(vals), metas.pop()))
+        key_ints.append(np.concatenate(ints))
+    item_cols = {}
+    for si in item_ids:
+        vals, metas = [], set()
+        for p, sz in zip(live, sizes):
+            c = p.get("items", {}).get(si)
+            if c is None:
+                raise _FastUnsupported
+            a, _ia, m = _col_form(c, sz)
+            vals.append(a)
+            metas.add(m)
+        if len(metas) != 1:
+            raise _FastUnsupported
+        item_cols[si] = (np.concatenate(vals), metas.pop())
+
+    total = sum(sizes)
+    if K == 0:
+        order = np.arange(total, dtype=np.int64)
+        bounds_full = np.array([0, total], dtype=np.int64)
+        ng = 1
+    else:
+        order, bounds_full, ng = _group_rows(key_ints,
+                                             first_occurrence=True)
+    starts = bounds_full[:-1]
+    ends = bounds_full[1:]
+    rep = order[starts]
+
+    def rebuild(cat, meta):
+        kindm, info = meta
+        a = cat[rep]
+        if kindm == "e":
+            return {"e": info, "ids": a.astype(np.uint32)}
+        if kindm == "n":
+            return {"n": a.astype(np.int64), "labels": list(info)}
+        if info == "bool":
+            return {"a": a, "k": "bool"}
+        return {"a": a}
+
+    out_keys = [rebuild(cat, meta) for cat, meta in key_vals]
+    out_items = {si: rebuild(cat, meta)
+                 for si, (cat, meta) in item_cols.items()}
+    out_sites = {}
+    for s, sk in zip(sites, site_keys):
+        states = [p["sites"].get(sk) for p in live]
+        if s.name == "PERCENTILE":
+            if not all(isinstance(st, list) for st in states):
+                raise _FastUnsupported
+            from deepflow_tpu.cluster.sketch import HistogramSketch
+            cat = [d for st in states for d in st]
+            merged = []
+            for s0, e0 in zip(starts.tolist(), ends.tolist()):
+                hs = HistogramSketch()
+                for m in order[s0:e0].tolist():
+                    hs.merge(HistogramSketch.from_dict(cat[m]))
+                merged.append(hs.to_dict())
+            out_sites[sk] = merged
+            continue
+        if all(isinstance(st, dict) and "a" in st for st in states):
+            cat = np.concatenate(
+                [np.asarray(st["a"], dtype=np.float64)
+                 for st in states])[order]
+            if s.name in ("COUNT", "SUM"):
+                out_sites[sk] = {"a": np.add.reduceat(cat, starts)}
+            elif s.name == "MIN":
+                out_sites[sk] = {"a": np.minimum.reduceat(cat, starts)}
+            elif s.name == "MAX":
+                out_sites[sk] = {"a": np.maximum.reduceat(cat, starts)}
+            else:
+                raise _FastUnsupported
+            continue
+        if all(isinstance(st, dict) and "avg" in st for st in states):
+            if s.name != "AVG":
+                raise _FastUnsupported
+            ssum = np.concatenate(
+                [np.asarray(st["avg"][0], dtype=np.float64)
+                 for st in states])[order]
+            scnt = np.concatenate(
+                [np.asarray(st["avg"][1], dtype=np.float64)
+                 for st in states])[order]
+            out_sites[sk] = {"avg": [np.add.reduceat(ssum, starts),
+                                     np.add.reduceat(scnt, starts)]}
+            continue
+        if all(isinstance(st, dict) and "ed" in st for st in states):
+            ed_keys = {st["ed"] for st in states}
+            if len(ed_keys) != 1:
+                raise _FastUnsupported
+            cat = [g for st in states for g in st["sets"]]
+            merged = []
+            for s0, e0 in zip(starts.tolist(), ends.tolist()):
+                u: set = set()
+                for m in order[s0:e0].tolist():
+                    u.update(int(x) for x in cat[m])
+                merged.append(sorted(u))
+            out_sites[sk] = {"ed": ed_keys.pop(), "sets": merged}
+            continue
+        raise _FastUnsupported
+
+    out = {"kind": "agg", "n_groups": int(ng), "keys": out_keys,
+           "items": out_items, "sites": out_sites}
+    dicts: dict[str, list] = {}
+    for p in live:
+        for key, (g, ln) in (p.get("dicts") or {}).items():
+            cur = dicts.get(key)
+            if cur is None:
+                dicts[key] = [int(g), int(ln)]
+            elif cur[0] != int(g):
+                raise _FastUnsupported  # gen flip between slices
+            else:
+                cur[1] = max(cur[1], int(ln))
+    if dicts:
+        out["dicts"] = dicts
+    return out

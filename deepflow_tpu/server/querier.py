@@ -68,6 +68,10 @@ class QuerierAPI:
         self.mcp = McpServer(self)
         from deepflow_tpu.query.tracing_adapter import AdapterRegistry
         self.trace_adapters = AdapterRegistry()
+        # result + partial-aggregate cache (query/cache.py): serves the
+        # local /v1/query path and the shard half of federated scatters
+        from deepflow_tpu.query.cache import QueryCache
+        self.query_cache = QueryCache(telemetry=telemetry)
 
     def alerts_api(self, method: str, body: dict) -> dict:
         if self.alerts is None:
@@ -178,7 +182,11 @@ class QuerierAPI:
             return {"result": result.to_dict(),
                     "debug": {"table": table.name},
                     "federation": info}
-        result = qengine.execute(table, select)
+        # org scoping rewrote the AST, not the text — fold it into the
+        # cache key so scoped variants of one SQL string don't collide
+        result = self.query_cache.execute(
+            table, sql_text, select=select,
+            extra_key=None if org is None else ("org", org))
         return {"result": result.to_dict(), "debug": {"table": table.name}}
 
     def profile_tracing(self, body: dict) -> dict:
@@ -933,8 +941,12 @@ class QuerierAPI:
             return empty
         names = ("time", "app_service", "app_instance", "severity_number",
                  "severity_text", "body", "trace_id", "span_id", "attrs")
-        out: list[dict] = []
-        for ch in reversed(t.snapshot()):    # chunks are time-ordered
+        # chunks are NOT globally time-ordered: concurrent HTTP handler
+        # threads write through per-thread stripes, so newest-first needs
+        # an explicit sort over the matches, not reversed chunk order
+        chunks = t.snapshot()
+        cand: list[tuple[int, int, int]] = []
+        for ci, ch in enumerate(chunks):
             if not ch:
                 continue
             mask = np.ones(len(ch["time"]), dtype=bool)
@@ -950,15 +962,19 @@ class QuerierAPI:
                 mask &= ch["severity_number"] >= min_sev
             if body_ids is not None:
                 mask &= np.isin(ch["body"], body_ids)
-            for i in np.flatnonzero(mask).tolist()[::-1]:
-                row = {}
-                for n in names:
-                    v = ch[n][i]
-                    row[n] = (t.dicts[n].decode(int(v)) if n in t.dicts
-                              else int(v))
-                out.append(row)
-                if len(out) >= limit:
-                    return {"result": {"logs": out, "count": len(out)}}
+            times = ch["time"]
+            for i in np.flatnonzero(mask).tolist():
+                cand.append((int(times[i]), ci, i))
+        cand.sort(key=lambda c: (-c[0], -c[1], -c[2]))
+        out: list[dict] = []
+        for _tm, ci, i in cand[:limit]:
+            ch = chunks[ci]
+            row = {}
+            for n in names:
+                v = ch[n][i]
+                row[n] = (t.dicts[n].decode(int(v)) if n in t.dicts
+                          else int(v))
+            out.append(row)
         return {"result": {"logs": out, "count": len(out)}}
 
     def trace_search(self, body: dict) -> dict:
@@ -1115,7 +1131,10 @@ class QuerierAPI:
                 # the coordinator's org filter lives in its AST, not the
                 # SQL text — re-inject it here from the op body
                 self._org_scope(select, table, org)
-            return qengine.execute_partial(table, select)
+            if not body.get("enc"):
+                # pre-encoding coordinator: decoded partial, old wire form
+                return qengine.execute_partial(table, select)
+            return self._sql_partial_enc(body, table, select, org)
         if op == "promql_raw":
             from deepflow_tpu.query import promql
             vs = promql.VectorSelector(
@@ -1142,6 +1161,41 @@ class QuerierAPI:
             return {name: len(self.db.table(name))
                     for name in self.db.tables()}
         raise qengine.QueryError(f"unknown shard op {op!r}")
+
+    def _sql_partial_enc(self, body: dict, table, select: qsql.Select,
+                         org) -> dict:
+        """Encoded half of a v2 sql_partial: change-token short-circuit,
+        bucket-cached encoded partial, and the dictionary delta the
+        coordinator needs to remap our ids (cluster/dictsync.py)."""
+        # claim filtering answers for different rows under a different
+        # ring/alive set even when the table itself is unchanged — fold
+        # the ring context into both the change token and the cache key
+        ring = body.get("ring") or {}
+        ring_ctx = None if not ring else [
+            ring.get("epoch"), ring.get("token"),
+            sorted(int(s) for s in body.get("alive") or [])]
+        from deepflow_tpu.query.cache import change_token
+        tok = [change_token(table), ring_ctx]  # read BEFORE computing
+        if_state = (body.get("if_state") or {}).get(str(self.shard_id))
+        if if_state is not None and if_state == tok:
+            return {"kind": "unchanged", "state": tok}
+        extra = ("fed", org, repr(ring_ctx))
+        part = dict(self.query_cache.partial(
+            table, body.get("sql", ""), select=select, extra_key=extra))
+        dicts = part.get("dicts")
+        if dicts:
+            from deepflow_tpu.cluster.dictsync import build_sync
+            known = (body.get("dict_known") or {}).get(
+                str(self.shard_id)) or {}
+            sync = build_sync(table, dicts, known)
+            if sync is None:
+                # a dictionary gen flipped between the partial build and
+                # now — ids in the partial are unremappable; re-run in
+                # the decoded wire form instead of shipping garbage
+                return qengine.execute_partial(table, select)
+            part["dict_sync"] = sync
+        part["state"] = tok
+        return part
 
     def cluster_join(self, body: dict) -> dict:
         if self.membership is None:
@@ -1174,12 +1228,17 @@ class QuerierAPI:
                        for name in self.db.tables()},
             "stats": self.stats_provider(),
         }
+        out["query_cache"] = self.query_cache.snapshot()
         if self.membership is not None:
             out["cluster"] = {
                 "shard_id": self.shard_id,
                 "version": self.membership.directory.version,
                 "peers_alive": len(self.membership.peers()),
             }
+        if self.federation is not None:
+            out["dict_sync"] = self.federation.dict_sync.snapshot()
+            out["federation_cache"] = dict(
+                self.federation.sql_cache_counters)
         wedged_stages: list[str] = []
         if self.telemetry is not None:
             selfmon = self.telemetry.snapshot()

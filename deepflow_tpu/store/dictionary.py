@@ -22,6 +22,15 @@ class Dictionary:
         self._lock = threading.Lock()
         self._str_to_id: dict[str, int] = {"": 0}
         self._strings: list[str] = [""]
+        # Monotonic change counters for exact cache invalidation and
+        # cross-shard delta sync (query/cache.py, cluster/dictsync.py):
+        #   version — bumped on every insert; equal versions => equal content.
+        #   gen     — bumped when existing id->string bindings are REPLACED
+        #             (table compaction rebuilds, load). Same gen + longer
+        #             dict is a pure append: previously shipped ids stay
+        #             valid and only strings[known:] need to travel.
+        self.version = 0
+        self.gen = 0
 
     def __len__(self) -> int:
         return len(self._strings)
@@ -36,6 +45,7 @@ class Dictionary:
                 sid = len(self._strings)
                 self._strings.append(s)
                 self._str_to_id[s] = sid
+                self.version += 1
             return sid
 
     def encode_many(self, values: list[str]) -> np.ndarray:
@@ -61,6 +71,7 @@ class Dictionary:
                             sid = len(self._strings)
                             self._strings.append(s)
                             self._str_to_id[s] = sid
+                            self.version += 1
                         out[i] = sid
         return out
 
@@ -84,6 +95,17 @@ class Dictionary:
         with self._lock:
             return list(self._strings)
 
+    def sync_state(self) -> tuple[int, int, int]:
+        """(gen, len, version) — the id-validity token used by the query
+        cache and the federation dict-sync protocol."""
+        with self._lock:
+            return (self.gen, len(self._strings), self.version)
+
+    def strings_slice(self, start: int, end: int) -> list[str]:
+        """Entries [start:end) — a dict-sync delta. The list is append-only
+        within a gen, so a bounded slice needs no lock."""
+        return self._strings[start:end]
+
     def match_ids(self, predicate) -> np.ndarray:
         """Ids of all entries satisfying predicate(str) — used to push LIKE /
         regex filters down onto the (small) dictionary instead of the rows."""
@@ -104,4 +126,6 @@ class Dictionary:
             strings = json.load(f)
         d._strings = strings
         d._str_to_id = {s: i for i, s in enumerate(strings)}
+        d.version = len(strings)
+        d.gen = 1  # ids from any pre-load process are not comparable
         return d

@@ -48,12 +48,17 @@ class _Stripe:
     through); segment buffering lets the columnar ingest path hand over
     numpy arrays without a tolist/extend/asarray round trip."""
 
-    __slots__ = ("lock", "buf", "rows")
+    __slots__ = ("lock", "buf", "rows", "seq", "mat")
 
     def __init__(self, names) -> None:
         self.lock = threading.Lock()
         self.buf: dict[str, list] = {n: [] for n in names}
         self.rows = 0
+        # snapshot memo: (seq at materialization, chunk dict). seq is a
+        # monotonic mutation counter — rows alone can repeat across a
+        # seal/refill cycle and would validate a stale memo.
+        self.seq = 0
+        self.mat: tuple[int, dict] | None = None
 
 
 class ColumnarTable:
@@ -92,9 +97,74 @@ class ColumnarTable:
         # wiring time — e.g. Database(shard_id=N) stamps every row this
         # node ingests with its cluster shard identity.
         self.fills: dict[str, object] = {}
+        # Write watermark: monotonic counter bumped on every mutation that
+        # can change a query answer (append, trim, load). Query caches key
+        # on it for exact invalidation (query/cache.py). Alongside it, a
+        # per-TIME-BUCKET mark map (bucket index -> watermark at last write
+        # into that bucket) lets the partial-aggregate cache re-scan only
+        # the buckets that actually changed. _wide_mark is the fallback for
+        # writes spanning too many buckets to mark individually — any
+        # bucket's effective mark is max(bucket mark, _wide_mark).
+        self.watermark = 0
+        self._bucket_marks: dict[int, int] = {}
+        self._wide_mark = 0
+        self._time_col = "time" if any(c.name == "time" for c in columns) \
+            else None
+        # bucket width in the time column's native unit (ns for u64, s
+        # otherwise); 60 s buckets match dashboard refresh granularity
+        if self._time_col is not None:
+            ns = self.columns[self._time_col].kind == "u64"
+            self._bucket_div = 60 * 1_000_000_000 if ns else 60
+        else:
+            self._bucket_div = 0
 
     def _fill(self, name: str, spec: ColumnSpec):
         return self.fills.get(name, spec.default)
+
+    # -- change tracking (query-cache invalidation) --------------------------
+
+    def _note_span(self, tmin: int, tmax: int) -> None:
+        """Mark the time buckets covered by [tmin, tmax] with the current
+        watermark. Caller holds self._lock (watermark already bumped)."""
+        if not self._bucket_div:
+            return
+        b0, b1 = int(tmin) // self._bucket_div, int(tmax) // self._bucket_div
+        if b1 - b0 >= 512:  # absurd span (poisoned clock): invalidate all
+            self._wide_mark = self.watermark
+            return
+        for b in range(b0, b1 + 1):
+            self._bucket_marks[b] = self.watermark
+
+    def _note_segment(self, seg) -> None:
+        """Watermark bump + bucket marking for one appended time segment.
+        Caller holds self._lock."""
+        self.watermark += 1
+        if not self._bucket_div or seg is None:
+            return
+        try:
+            if isinstance(seg, np.ndarray):
+                if not len(seg):
+                    return
+                self._note_span(int(seg.min()), int(seg.max()))
+            elif seg:
+                self._note_span(int(min(seg)), int(max(seg)))
+        except (TypeError, ValueError, OverflowError):
+            self._wide_mark = self.watermark  # unparseable time: play safe
+
+    def bucket_marks(self) -> tuple[int, dict[int, int], int, int]:
+        """(watermark, {bucket: mark}, wide_mark, bucket_div) snapshot."""
+        with self._lock:
+            return (self.watermark, dict(self._bucket_marks),
+                    self._wide_mark, self._bucket_div)
+
+    def sync_state(self) -> list:
+        """JSON-able change token: [watermark, [[dict name, gen, len], ...]].
+        Two equal tokens guarantee byte-identical query answers AND that
+        previously shipped dictionary ids are still valid (dictionary
+        VERSION is implied: dict growth requires a table write, which bumps
+        the watermark)."""
+        dicts = sorted((n, *d.sync_state()[:2]) for n, d in self.dicts.items())
+        return [self.watermark, [list(t) for t in dicts]]
 
     # -- write path ----------------------------------------------------------
 
@@ -198,8 +268,11 @@ class ColumnarTable:
             for name, seg in segs.items():
                 s.buf[name].append(seg)
             s.rows += n
+            s.seq += 1
             with self._lock:
                 self.rows_written += n
+                self._note_segment(
+                    segs.get(self._time_col) if self._time_col else None)
             if s.rows >= self.chunk_rows:
                 self._seal_stripe(s)
 
@@ -227,6 +300,8 @@ class ColumnarTable:
             for name in self.columns:
                 s.buf[name] = []
             s.rows = 0
+            s.seq += 1
+            s.mat = None
             with self._lock:
                 self.rows_written -= dropped
             raise ValueError(
@@ -235,6 +310,8 @@ class ColumnarTable:
         for name in self.columns:
             s.buf[name] = []
         s.rows = 0
+        s.seq += 1
+        s.mat = None
         with self._lock:
             self._chunks.append(chunk)
 
@@ -256,10 +333,20 @@ class ColumnarTable:
             with self._lock:
                 chunks = list(self._chunks)
             for s in stripes:
-                if s.rows:
-                    chunks.append({
-                        name: self._materialize(s.buf[name], spec)
-                        for name, spec in self.columns.items()})
+                if not s.rows:
+                    continue
+                if s.mat is not None and s.mat[0] == s.seq:
+                    chunks.append(s.mat[1])
+                    continue
+                chunk = {}
+                for name, spec in self.columns.items():
+                    arr = self._materialize(s.buf[name], spec)
+                    # collapse converted segments so the next snapshot
+                    # pays asarray only for rows appended since this one
+                    s.buf[name] = [arr]
+                    chunk[name] = arr
+                s.mat = (s.seq, chunk)
+                chunks.append(chunk)
         return chunks
 
     def column_concat(self, names: list[str],
@@ -308,6 +395,15 @@ class ColumnarTable:
                     kept.append(ch)
             self._chunks = kept
             self.rows_written -= dropped  # keep __len__ = live rows
+            if dropped:
+                self.watermark += 1
+                if self._bucket_div and time_col == self._time_col:
+                    cut_b = int(cutoff) // self._bucket_div
+                    for b in list(self._bucket_marks):
+                        if b <= cut_b:
+                            self._bucket_marks[b] = self.watermark
+                else:
+                    self._wide_mark = self.watermark
         return dropped
 
     def compact_dictionaries(self, min_entries: int = 4096,
@@ -362,9 +458,17 @@ class ColumnarTable:
                             lut[seg] if isinstance(seg, np.ndarray)
                             else [int(lut[i]) for i in seg]
                             for seg in s.buf[name]]
+                        s.seq += 1
+                        s.mat = None
                     nd = Dictionary(d.name)
                     nd._strings = strings
                     nd._str_to_id = {s: i for i, s in enumerate(strings)}
+                    # id->string bindings changed: bump gen so cached
+                    # encoded partials / shipped id deltas are invalidated
+                    # exactly (decoded answers are unchanged, so the table
+                    # watermark is NOT bumped)
+                    nd.version = d.version + 1
+                    nd.gen = d.gen + 1
                     self.dicts[name] = nd
                     stats[name] = {"before": old_n, "after": len(strings)}
         return stats
@@ -429,6 +533,8 @@ class ColumnarTable:
                 stack.enter_context(s.lock)
                 s.buf = {name: [] for name in self.columns}
                 s.rows = 0
+                s.seq += 1
+                s.mat = None
             stack.enter_context(self._lock)
             self._chunks = []
             for fn in sorted(os.listdir(dirpath)):
@@ -459,3 +565,9 @@ class ColumnarTable:
                     self.dicts[name] = Dictionary.load(p, name)
             self.rows_written = sum(
                 len(next(iter(ch.values()))) for ch in self._chunks if ch)
+            self.watermark += 1
+            if self._time_col:
+                for ch in self._chunks:
+                    self._note_segment(ch.get(self._time_col))
+            else:
+                self._wide_mark = self.watermark

@@ -432,7 +432,11 @@ def test_cluster_federation_end_to_end():
         assert status["shard_id"] == 1
         by_id = {p["shard_id"]: p for p in status["peers"]}
         assert all(by_id[s]["alive"] for s in (1, 2, 3))
-        assert by_id[2]["rows"] and by_id[2]["latency_ms"] is not None
+        # "raw_rows": physical per-shard counts (replicated rows counted
+        # once per replica), renamed so the column says what it is
+        assert by_id[2]["raw_rows"] and \
+            by_id[2]["latency_ms"] is not None
+        assert "rows" not in by_id[2]
         health = _get(fp, "/v1/health")
         assert health["cluster"]["peers_alive"] == 3
 

@@ -1,0 +1,332 @@
+"""PR 7 — encoded execution, int-key federation and the query cache.
+
+Covers the merge-equivalence acceptance criteria: federated ORDER BY +
+LIMIT + HAVING equals the single-node answer, a dict-keyed GROUP BY is
+byte-identical whether the corpus lives on 1 shard or 3, a mixed-version
+shard (pre-feature, decoded partials) still merges correctly, the query
+cache invalidates exactly per bucket, dictionary sync deltas/gen flips,
+and the jsonb wire kind that carries encoded partials.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+# this file tests the encoded pipeline itself; under the legacy
+# kill-switch there is nothing to test
+pytestmark = pytest.mark.skipif(
+    os.environ.get("DF_QUERY_ENCODED") == "0",
+    reason="encoded execution disabled via DF_QUERY_ENCODED=0")
+
+import test_cluster as tc
+from deepflow_tpu.cluster import wire
+from deepflow_tpu.cluster.dictsync import DictSync, build_sync
+from deepflow_tpu.query import engine
+from deepflow_tpu.query.cache import QueryCache, change_token
+from deepflow_tpu.store.table import ColumnSpec, ColumnarTable
+
+
+def _make_table(n=90, chunk_rows=1000):
+    """3 time buckets (60s grid), dict + enum keys, some buffered rows."""
+    t = ColumnarTable("flow", [
+        ColumnSpec("time", "u32"),
+        ColumnSpec("svc", "str"),
+        ColumnSpec("proto", "enum", ("unknown", "tcp", "udp")),
+        ColumnSpec("bytes", "u64"),
+        ColumnSpec("latency", "f64"),
+    ], chunk_rows=chunk_rows)
+    t.append_rows([
+        {"time": (i % 3) * 60 + (i % 7), "svc": f"svc-{i % 11}",
+         "proto": 1 + (i % 2), "bytes": 10 * i, "latency": 0.5 * i}
+        for i in range(n)])
+    return t
+
+
+_BATTERY = [
+    "SELECT svc, Count(*) AS n, Sum(bytes) AS s, Avg(latency) AS a "
+    "FROM flow GROUP BY svc ORDER BY n DESC, svc LIMIT 5",
+    "SELECT svc, proto, Sum(bytes) AS s FROM flow "
+    "GROUP BY svc, proto HAVING Sum(bytes) > 100 "
+    "ORDER BY s DESC, svc, proto LIMIT 7",
+    "SELECT svc, Count(DISTINCT proto) AS d FROM flow "
+    "GROUP BY svc ORDER BY svc",
+    "SELECT Min(latency) AS mn, Max(latency) AS mx, Count(*) AS n "
+    "FROM flow WHERE svc LIKE 'svc-1%'",
+]
+
+
+def _res(r):
+    return tc._canon({"columns": r.columns, "values": r.values})
+
+
+def test_encoded_matches_legacy_and_numpy_fallback(monkeypatch):
+    t = _make_table()
+    want = {}
+    monkeypatch.setenv("DF_QUERY_ENCODED", "0")
+    for sql in _BATTERY:
+        want[sql] = _res(engine.execute(t, sql))
+    for env in ({"DF_QUERY_ENCODED": "1"},
+                {"DF_QUERY_ENCODED": "1", "DF_NO_NATIVE": "1"}):
+        for k, v in env.items():
+            monkeypatch.setenv(k, v)
+        for sql in _BATTERY:
+            assert _res(engine.execute(t, sql)) == want[sql], (env, sql)
+
+
+def _cluster(n_joiners=2):
+    from deepflow_tpu.server import Server
+    seed = Server(host="127.0.0.1", ingest_port=0, query_port=0,
+                  sync_port=0, shard_id=1, cluster_advertise="").start()
+    shards = [seed]
+    addr = f"127.0.0.1:{seed.query_port}"
+    for sid in range(2, 2 + n_joiners):
+        shards.append(Server(host="127.0.0.1", ingest_port=0,
+                             query_port=0, sync_port=0, shard_id=sid,
+                             cluster_seed=addr).start())
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if len(seed.api.federation.remote_peers()) == n_joiners:
+            break
+        time.sleep(0.05)
+    assert len(seed.api.federation.remote_peers()) == n_joiners
+    return shards
+
+
+_FED_SQL = [
+    "SELECT app_service, Count(*) AS n, Sum(response_duration) AS s "
+    "FROM l7_flow_log GROUP BY app_service "
+    "HAVING Count(*) > 2 ORDER BY n DESC, app_service LIMIT 4",
+    "SELECT app_service, endpoint, Avg(response_duration) AS a "
+    "FROM l7_flow_log GROUP BY app_service, endpoint "
+    "ORDER BY a DESC, app_service, endpoint LIMIT 6",
+    "SELECT l7_protocol, Count(DISTINCT endpoint) AS d "
+    "FROM l7_flow_log GROUP BY l7_protocol ORDER BY l7_protocol",
+]
+
+
+def test_federated_encoded_merge_equivalence():
+    """ORDER BY + LIMIT + HAVING through the encoded int-key scatter is
+    byte-identical to the same corpus on a single node, and a repeat
+    query validates warm out of the coordinator cache."""
+    from deepflow_tpu.server import Server
+    corpus = tc._corpus()
+    solo = Server(host="127.0.0.1", ingest_port=0, query_port=0,
+                  sync_port=0).start()
+    shards = _cluster()
+    try:
+        for name, rows in corpus.items():
+            solo.db.table(name).append_rows(rows)
+            for i, row in enumerate(rows):
+                shards[i % 3].db.table(name).append_rows([row])
+        sp, fp = solo.query_port, shards[0].query_port
+        for sql in _FED_SQL:
+            body = {"sql": sql, "db": "flow_log"}
+            want = tc._post(sp, "/v1/query", body)["result"]
+            got = tc._post(fp, "/v1/query", body)
+            assert got["federation"]["missing_shards"] == [], sql
+            # byte-identical: serialized forms match, order included
+            assert json.dumps(got["result"], sort_keys=True) == \
+                json.dumps(want, sort_keys=True), sql
+            again = tc._post(fp, "/v1/query", body)
+            assert again["federation"].get("cache") == "warm", sql
+            assert json.dumps(again["result"], sort_keys=True) == \
+                json.dumps(want, sort_keys=True), sql
+        fed = shards[0].api.federation
+        assert fed.sql_cache_counters["warm_hits"] >= len(_FED_SQL)
+        assert fed.dict_sync.snapshot()["ids_remapped"] > 0, \
+            "encoded int-key merge never engaged"
+    finally:
+        solo.stop()
+        for s in shards:
+            s.stop()
+
+
+def test_mixed_version_shard_decoded_fallback():
+    """A shard that predates encoded partials (simulated by pinning its
+    handler to the legacy decoded path) still merges into the exact
+    answer — the compat fallback decodes strings at the coordinator."""
+    from deepflow_tpu.query import engine as qengine
+    from deepflow_tpu.server import Server
+    corpus = tc._corpus()
+    solo = Server(host="127.0.0.1", ingest_port=0, query_port=0,
+                  sync_port=0).start()
+    shards = _cluster()
+    try:
+        # shard 3 behaves like a pre-PR build: decoded partial, no
+        # state token, no dict manifest
+        shards[2].api._sql_partial_enc = \
+            lambda body, table, select, org: \
+            qengine.execute_partial(table, select)
+        for name, rows in corpus.items():
+            solo.db.table(name).append_rows(rows)
+            for i, row in enumerate(rows):
+                shards[i % 3].db.table(name).append_rows([row])
+        for sql in _FED_SQL:
+            body = {"sql": sql, "db": "flow_log"}
+            want = tc._post(solo.query_port, "/v1/query", body)["result"]
+            got = tc._post(shards[0].query_port, "/v1/query", body)
+            assert got["federation"]["missing_shards"] == [], sql
+            assert tc._canon(got["result"]) == tc._canon(want), sql
+    finally:
+        solo.stop()
+        for s in shards:
+            s.stop()
+
+
+def test_one_vs_three_shard_byte_identical():
+    """Dict-keyed GROUP BY over the same rows: 1-node answer and 3-shard
+    federated answer serialize to identical bytes."""
+    from deepflow_tpu.server import Server
+    corpus = tc._corpus()
+    solo = Server(host="127.0.0.1", ingest_port=0, query_port=0,
+                  sync_port=0).start()
+    shards = _cluster()
+    try:
+        for name, rows in corpus.items():
+            solo.db.table(name).append_rows(rows)
+            for i, row in enumerate(rows):
+                shards[i % 3].db.table(name).append_rows([row])
+        sql = ("SELECT app_service, endpoint, Count(*) AS n, "
+               "Sum(response_duration) AS s FROM l7_flow_log "
+               "GROUP BY app_service, endpoint "
+               "ORDER BY app_service, endpoint")
+        body = {"sql": sql, "db": "flow_log"}
+        one = tc._post(solo.query_port, "/v1/query", body)["result"]
+        three = tc._post(shards[0].query_port, "/v1/query", body)
+        assert three["federation"]["missing_shards"] == []
+        assert json.dumps(one, sort_keys=True) == \
+            json.dumps(three["result"], sort_keys=True)
+    finally:
+        solo.stop()
+        for s in shards:
+            s.stop()
+
+
+# -- query cache ------------------------------------------------------------
+
+
+def test_cache_exact_bucket_invalidation():
+    t = _make_table()
+    qc = QueryCache()
+    sql = ("SELECT svc, Count(*) AS n, Sum(bytes) AS s FROM flow "
+           "GROUP BY svc ORDER BY n DESC, svc")
+    r1 = qc.execute(t, sql)
+    assert qc.counters["misses"] == 1
+    assert qc.counters["bucket_misses"] == 3  # 3 buckets, all cold
+    r2 = qc.execute(t, sql)
+    assert qc.counters["hits"] == 1 and r2.values == r1.values
+    # append into bucket 1 only -> whole-result token stale, bucket
+    # layer re-scans EXACTLY that bucket
+    t.append_rows([{"time": 65, "svc": "svc-0", "proto": 1,
+                    "bytes": 7, "latency": 1.0}])
+    r3 = qc.execute(t, sql)
+    assert qc.counters["stale"] == 1
+    assert qc.counters["bucket_misses"] == 4, \
+        "append to one bucket must re-scan exactly one bucket"
+    assert qc.counters["bucket_hits"] == 2
+    by_svc = {v[0]: v for v in r3.values}
+    old = {v[0]: v for v in r1.values}
+    assert by_svc["svc-0"][1] == old["svc-0"][1] + 1
+    assert by_svc["svc-0"][2] == old["svc-0"][2] + 7
+
+
+def test_cache_bypass_and_change_token(monkeypatch):
+    t = _make_table()
+    qc = QueryCache()
+    tok = change_token(t)
+    monkeypatch.setenv("DF_QUERY_CACHE", "0")
+    qc.execute(t, "SELECT Count(*) AS n FROM flow")
+    assert qc.counters["bypass"] == 1 and qc.snapshot()["entries"] == 0
+    monkeypatch.delenv("DF_QUERY_CACHE")
+    # dictionary growth without a row write must NOT change the token
+    # (federation remap grows local dicts while merging)
+    t.dicts["svc"].encode("never-written-to-a-row")
+    assert change_token(t) == tok
+    t.append_rows([{"time": 0, "svc": "x", "proto": 1, "bytes": 1,
+                    "latency": 1.0}])
+    assert change_token(t) != tok
+
+
+def test_snapshot_memo_reuses_buffered_chunks():
+    t = _make_table(chunk_rows=10_000)  # everything stays buffered
+    c1 = t.snapshot()
+    c2 = t.snapshot()
+    assert len(c1) == 1 and c1[0] is c2[0], \
+        "unchanged stripe buffer must not re-materialize"
+    t.append_rows([{"time": 1, "svc": "a", "proto": 1, "bytes": 1,
+                    "latency": 1.0}])
+    c3 = t.snapshot()
+    assert c3[0] is not c2[0] and len(c3[0]["time"]) == 91
+    # earlier snapshot untouched by the append (immutability)
+    assert len(c2[0]["time"]) == 90
+
+
+# -- dictionary sync --------------------------------------------------------
+
+
+def test_dict_sync_delta_then_incremental_then_gen_flip():
+    shard_t = _make_table()
+    d = shard_t.dicts["svc"]
+    gen, ln, _ = d.sync_state()
+    # full sync when the coordinator knows nothing
+    sync = build_sync(shard_t, {"svc": [gen, ln]}, {})
+    assert sync["svc"]["base"] == 0 and len(sync["svc"]["delta"]) == ln
+    ds = DictSync()
+    assert ds.apply_sync(7, "flow", "svc", sync["svc"])
+    assert ds.known_state(7, "flow") == {"svc": [gen, ln]}
+    # incremental: new strings on the shard ship as a tail delta
+    shard_t.append_rows([{"time": 0, "svc": "svc-new", "proto": 1,
+                          "bytes": 1, "latency": 1.0}])
+    gen2, ln2, _ = d.sync_state()
+    sync2 = build_sync(shard_t, {"svc": [gen2, ln2]},
+                       ds.known_state(7, "flow"))
+    assert sync2["svc"]["base"] == ln and \
+        sync2["svc"]["delta"] == ["svc-new"]
+    assert ds.apply_sync(7, "flow", "svc", sync2["svc"])
+    assert ds.counters["strings_synced"] == ln + 1
+    # gen flip between partial build and reply -> shard signals a
+    # decoded re-run by returning None
+    assert build_sync(shard_t, {"svc": [gen2 + 1, ln2]}, {}) is None
+
+
+def test_dict_sync_remap_partial_round_trip():
+    shard_t = _make_table()
+    local_t = _make_table(n=5)  # different id assignment locally
+    sql = ("SELECT svc, Count(*) AS n, Sum(bytes) AS s FROM flow "
+           "GROUP BY svc")
+    part = engine.execute_partial(shard_t, sql, encoded=True)
+    assert part.get("dicts"), "encoded partial must carry a manifest"
+    sync = build_sync(shard_t, part["dicts"], {})
+    part = dict(part, dict_sync=sync)
+    ds = DictSync()
+    local_dicts = dict(local_t.dicts)
+    mapped = ds.remap_partial(9, "flow", part, local_dicts)
+    assert "dicts" not in mapped and "dict_sync" not in mapped
+    assert ds.counters["ids_remapped"] > 0
+    merged = engine.merge_partials(local_t, sql, [mapped],
+                                   decoder=lambda col: local_dicts[col])
+    want = engine.execute(shard_t, sql)
+    assert _res(merged) == _res(want)
+
+
+# -- wire -------------------------------------------------------------------
+
+
+def test_wire_jsonb_roundtrip_encoded_partial():
+    part = {"kind": "agg",
+            "keys": [{"e": "svc", "ids": np.arange(5, dtype=np.uint32)}],
+            "items": {"n": np.asarray([3, 1, 4, 1, 5], dtype=np.int64)},
+            "sites": {"Sum(bytes)": np.linspace(0, 1, 5)},
+            "dicts": {"svc": [2, 5]}}
+    obj, sid = wire.decode_result(wire.encode_result(part, shard_id=4))
+    assert sid == 4 and obj["kind"] == "agg"
+    got = obj["keys"][0]["ids"]
+    assert isinstance(got, np.ndarray) and got.dtype == np.uint32
+    np.testing.assert_array_equal(got, part["keys"][0]["ids"])
+    np.testing.assert_array_equal(obj["items"]["n"], part["items"]["n"])
+    np.testing.assert_allclose(obj["sites"]["Sum(bytes)"],
+                               part["sites"]["Sum(bytes)"])
+    assert obj["dicts"] == {"svc": [2, 5]}
