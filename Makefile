@@ -2,7 +2,7 @@ PYTHON ?= python
 JAX_ENV := env JAX_PLATFORMS=cpu
 
 .PHONY: test selfmon-check cluster-check steps-check chaos-check ha-check \
-	query-check bench native
+	query-check ingest-check bench native
 
 test:
 	timeout -k 10 870 $(JAX_ENV) $(PYTHON) -m pytest tests/ -q -m 'not slow' \
@@ -46,8 +46,20 @@ steps-check:
 query-check:
 	timeout -k 10 120 $(JAX_ENV) $(PYTHON) -m deepflow_tpu.cli.query_check
 
+# Native ingest throughput gate: same L4 frames through the native
+# columnar path and the DF_NO_NATIVE pb fallback; exits non-zero unless
+# native sustains >= 2.5x the fallback's rows/s (relative gate — a slow
+# CI host can't fail a fast code path) with zero drops on both arms.
+ingest-check:
+	timeout -k 10 300 $(JAX_ENV) $(PYTHON) -m deepflow_tpu.cli.ingest_check
+
 bench:
 	$(JAX_ENV) $(PYTHON) bench.py
 
+# Build every native library, then fail loudly if the freshly-built
+# libdfnative.so does not load at the ABI the python bindings expect —
+# a stale .so must break the build here, not silently fall back at
+# runtime.
 native:
-	$(MAKE) -C deepflow_tpu/native libdfmemhook.so
+	$(MAKE) -C deepflow_tpu/native
+	$(PYTHON) -m deepflow_tpu.native --verify-abi

@@ -1,0 +1,81 @@
+"""ingest-check: throughput gate for the native ingest hot path.
+
+Wired as `make ingest-check`. Ships the same L4 flow-log frames through
+a real Server twice — once on the native columnar path (zero-copy frame
+decode -> C++ column decode -> batched C++ dictionary encode) and once
+with DF_NO_NATIVE=1 forcing the per-field python protobuf fallback —
+and exits non-zero unless:
+
+  * the native arm sustains >= 2.5x the fallback's rows/s.  The gate is
+    RELATIVE so a slow CI host can't fail a fast code path; on
+    production-grade hardware the same path clears the absolute 1M
+    rows/s target tracked by bench.py.
+  * neither arm drops frames or times out waiting for rows to land
+    (a throughput win that loses data would be no win).
+
+Each arm is best-of-N to keep a one-off scheduler stall from failing a
+healthy build.  The per-stage breakdown (recv/decode/dict/write) is
+printed either way so a regression is attributable to a stage, not
+just visible in the ratio.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+# bench.py lives at the repo root, above the deepflow_tpu package
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import bench  # noqa: E402
+
+MIN_SPEEDUP = 2.5
+RUNS = 2  # best-of per arm
+
+
+def _fail(msg: str) -> None:
+    print(f"ingest-check: FAIL — {msg}")
+    sys.exit(1)
+
+
+def _best(no_native: bool) -> dict:
+    runs = [bench._run_ingest(bench._make_l4_frame, no_native=no_native)
+            for _ in range(RUNS)]
+    return max(runs, key=lambda r: r["rows_per_sec"])
+
+
+def _stages(r: dict) -> str:
+    return (f"recv={r['recv_ms']:.0f}ms decode={r['decode_ms']:.0f}ms "
+            f"dict={r['dict_ms']:.0f}ms write={r['write_ms']:.0f}ms")
+
+
+def main() -> int:
+    from deepflow_tpu import native
+    if native.load() is None:
+        _fail("libdfnative.so not loaded — nothing to gate "
+              "(run `make native`; DF_NO_NATIVE must be unset)")
+
+    nat = _best(no_native=False)
+    pb = _best(no_native=True)
+
+    for name, r in (("native", nat), ("pb-fallback", pb)):
+        print(f"ingest-check: {name:<11} {r['rows_per_sec']:>9,} rows/s  "
+              f"{_stages(r)}")
+        if r["timed_out"]:
+            _fail(f"{name} arm timed out: {r['rows']}/{r['rows_expected']} "
+                  f"rows landed")
+        if r["frames_dropped"]:
+            _fail(f"{name} arm dropped {r['frames_dropped']} frames")
+
+    speedup = nat["rows_per_sec"] / max(1, pb["rows_per_sec"])
+    if speedup < MIN_SPEEDUP:
+        _fail(f"native speedup {speedup:.2f}x < required {MIN_SPEEDUP}x "
+              f"({nat['rows_per_sec']:,} vs {pb['rows_per_sec']:,} rows/s)")
+    print(f"ingest-check: OK — native {speedup:.2f}x over pb fallback "
+          f"(>= {MIN_SPEEDUP}x), zero drops on both arms")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

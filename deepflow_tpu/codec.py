@@ -162,34 +162,47 @@ class FrameDecodeError(Exception):
     pass
 
 
-def decode_frame(buf: bytes | memoryview) -> tuple[FrameHeader, bytes, int]:
-    """Decode one frame from buf. Returns (header, payload, consumed_bytes).
+def decode_frame(buf: bytes | memoryview, off: int = 0,
+                 copy: bool = True) -> tuple[FrameHeader, bytes, int]:
+    """Decode one frame starting at buf[off]. Returns
+    (header, payload, consumed_bytes).
+
+    copy=False returns the payload of an UNCOMPRESSED frame as a
+    memoryview over buf — the zero-copy ingest hand-off: the only copy of
+    payload bytes between the socket recv buffer and the native decoder's
+    column blocks. The caller guarantees buf is immutable (bytes) for the
+    payload's lifetime. Compressed payloads decompress into fresh bytes
+    either way.
 
     Raises FrameDecodeError on corruption; returns consumed=0 when buf does
     not yet hold a complete frame (streaming use).
     """
-    if len(buf) < HEADER_SIZE:
+    avail = len(buf) - off
+    if avail < HEADER_SIZE:
         return None, b"", 0  # type: ignore[return-value]
     size, magic, ver, mtype, agent_id, org_id, team_id, crc = struct.unpack_from(
-        HEADER_FMT, buf)
+        HEADER_FMT, buf, off)
     if magic != MAGIC:
         raise FrameDecodeError(f"bad magic {magic:#x}")
     if size > MAX_FRAME_SIZE or size < HEADER_SIZE:
         raise FrameDecodeError(f"bad frame size {size}")
-    if len(buf) < size:
+    if avail < size:
         return None, b"", 0  # type: ignore[return-value]
     compressed = bool(ver & COMPRESS_FLAG)
     base_ver = ver & ~COMPRESS_FLAG
     seq: int | None = None
-    body_off = HEADER_SIZE
+    body_off = off + HEADER_SIZE
     if base_ver == VERSION_SEQ:
         if size < HEADER_SIZE + SEQ_EXT_SIZE:
             raise FrameDecodeError(f"bad v2 frame size {size}")
-        seq = struct.unpack_from(SEQ_EXT_FMT, buf, HEADER_SIZE)[0]
+        seq = struct.unpack_from(SEQ_EXT_FMT, buf, off + HEADER_SIZE)[0]
         body_off += SEQ_EXT_SIZE
     elif base_ver != VERSION:
         raise FrameDecodeError(f"bad version {ver}")
-    payload = bytes(buf[body_off:size])
+    if copy:
+        payload = bytes(buf[body_off:off + size])
+    else:
+        payload = memoryview(buf)[body_off:off + size]
     if zlib.crc32(payload) & 0xFFFFFFFF != crc:
         raise FrameDecodeError("crc mismatch")
     if compressed:
@@ -205,7 +218,15 @@ def decode_frame(buf: bytes | memoryview) -> tuple[FrameHeader, bytes, int]:
 
 
 class StreamDecoder:
-    """Incremental frame decoder over a TCP byte stream."""
+    """Incremental frame decoder over a TCP byte stream.
+
+    Zero-copy: when a recv chunk starts frame-aligned (the steady state —
+    no partial tail buffered), frames are parsed IN PLACE over the
+    immutable recv bytes and uncompressed payloads come back as
+    memoryviews into it. Payload bytes are then copied exactly once, from
+    the socket buffer into native column blocks. Only a frame spanning
+    two recv calls costs a merge: the buffered tail and the new chunk are
+    snapped into one bytes object and parsing resumes over that."""
 
     def __init__(self) -> None:
         self._buf = bytearray()
@@ -215,20 +236,25 @@ class StreamDecoder:
         and FrameDecodeError raised — the owner must drop the connection
         (there is no resync marker mid-stream, same stance as the
         reference's receiver)."""
-        self._buf.extend(data)
+        if self._buf or not isinstance(data, bytes):
+            # spanning frame (or a mutable buffer we must not alias):
+            # merge into ONE immutable snapshot and view over that
+            self._buf.extend(data)
+            data = bytes(self._buf)
+            self._buf.clear()
         out = []
-        while True:
-            mv = memoryview(self._buf)
-            try:
-                header, payload, consumed = decode_frame(mv)
-            except FrameDecodeError:
-                mv.release()
-                self._buf.clear()
-                raise
-            finally:
-                mv.release()
-            if consumed == 0:
-                break
-            del self._buf[:consumed]
-            out.append((header, payload))
+        off = 0
+        try:
+            while True:
+                header, payload, consumed = decode_frame(
+                    data, off, copy=False)
+                if consumed == 0:
+                    break
+                off += consumed
+                out.append((header, payload))
+        except FrameDecodeError:
+            self._buf.clear()
+            raise
+        if off < len(data):  # partial tail: buffer until the next recv
+            self._buf.extend(memoryview(data)[off:])
         return out

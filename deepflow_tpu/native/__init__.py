@@ -16,7 +16,7 @@ log = logging.getLogger("df.native")
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_DIR, "libdfnative.so")
 _lib = None
-_ABI_VERSION = 5  # must match df_abi_version() in dfnative.cpp
+_ABI_VERSION = 6  # must match df_abi_version() in dfnative.cpp
 
 
 def _build() -> bool:
@@ -76,6 +76,12 @@ def load():
                                 ctypes.c_char_p, ctypes.c_uint32]
     lib.df_dict_get.restype = ctypes.c_int32
     lib.df_dict_load.argtypes = lib.df_dict_encode_batch.argtypes[:4]
+    lib.df_dict_encode_arena.restype = ctypes.c_uint64
+    lib.df_dict_encode_arena.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p,            # handle, arena ptr
+        np.ctypeslib.ndpointer(np.uint32),           # offs
+        np.ctypeslib.ndpointer(np.uint32),           # lens
+        ctypes.c_uint32, np.ctypeslib.ndpointer(np.uint32)]  # n, out
     lib.df_decode_eth.argtypes = [ctypes.c_char_p, ctypes.c_uint32,
                                   ctypes.c_void_p]
     lib.df_decode_eth.restype = ctypes.c_int32
@@ -137,15 +143,24 @@ def load():
     lib.df_ring_promisc.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                     ctypes.c_int32]
     # -- columnar protobuf decode (ingest hot path) -------------------------
+    # data pointers are c_void_p (not c_char_p) so the zero-copy receiver
+    # hand-off can pass raw addresses of read-only memoryviews over the
+    # socket recv buffer — see _payload_buf()
     lib.df_decode_l4_cols.restype = ctypes.c_int64
     lib.df_decode_l4_cols.argtypes = [
-        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p,
         np.ctypeslib.ndpointer(np.uint32),           # l7_off
         np.ctypeslib.ndpointer(np.uint32),           # l7_len
         ctypes.c_uint32, ctypes.POINTER(ctypes.c_uint32)]  # n_l7
     lib.df_decode_l7_cols.restype = ctypes.c_int64
     lib.df_decode_l7_cols.argtypes = [
-        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_void_p]
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p]
+    lib.df_decode_doc_cols.restype = ctypes.c_int64
+    lib.df_decode_doc_cols.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p]
+    lib.df_decode_span_cols.restype = ctypes.c_int64
+    lib.df_decode_span_cols.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p]
     # -- encoded query execution (qexec.cpp) --------------------------------
     lib.df_qx_group.restype = ctypes.c_int64
     lib.df_qx_group.argtypes = [
@@ -226,9 +241,11 @@ def decode_eth_batch(frames: list[bytes]):
 
 
 class NativeDict:
-    """C++-backed string dictionary. NOT wired into the store hot path:
-    measured slower than CPython's dict through ctypes marshalling (see
-    dfnative.cpp header); kept for the future all-native decode pipeline."""
+    """C++-backed string dictionary (standalone handle). For PYTHON-string
+    inputs CPython's dict wins through ctypes marshalling (see dfnative.cpp
+    header) — the store's hot path instead drives the same C++ table
+    through Dictionary.encode_arena (store/dictionary.py), where inputs
+    are (arena, off, len) cells that never become Python strings."""
 
     def __init__(self) -> None:
         lib = load()
@@ -290,6 +307,71 @@ def available() -> bool:
     return load() is not None
 
 
+def _payload_buf(payload):
+    """(address, nbytes, keepalive) for a bytes-like payload. Accepts the
+    read-only memoryviews the zero-copy receiver hand-off produces as
+    well as plain bytes — np.frombuffer shares memory in both cases, so
+    nothing is copied here. The keepalive array must stay referenced for
+    the duration of the native call."""
+    a = np.frombuffer(payload, dtype=np.uint8)
+    return a.ctypes.data, a.nbytes, a
+
+
+class ArenaStrings:
+    """A string column that has not been materialized: (arena, off, len)
+    triples straight out of a native columnar decoder. The store's
+    dictionary encoder consumes this form natively (one batched
+    intern under one lock, Dictionary.encode_arena) so hot-path string
+    cells never become Python objects; every other consumer (exporters,
+    trace trees, the pb fallback) gets lazy decode via tolist()/[i].
+
+    The constructor COPIES the three arrays — decoder buffers are reused
+    per batch, while a column handed to the store must stay stable."""
+
+    __slots__ = ("arena", "off", "lens", "_list")
+
+    def __init__(self, arena: np.ndarray, off: np.ndarray,
+                 lens: np.ndarray) -> None:
+        self.arena = np.ascontiguousarray(arena, dtype=np.uint8).copy()
+        self.off = np.ascontiguousarray(off, dtype=np.uint32).copy()
+        self.lens = np.ascontiguousarray(lens, dtype=np.uint32).copy()
+        self._list: list[str] | None = None
+
+    def __len__(self) -> int:
+        return len(self.off)
+
+    def __getitem__(self, i):
+        if self._list is not None:
+            return self._list[i]
+        o, ln = int(self.off[i]), int(self.lens[i])
+        if not ln:
+            return ""
+        return bytes(self.arena[o:o + ln]).decode("utf-8", "replace")
+
+    def tolist(self) -> list[str]:
+        """Materialize (memoized; decodes each DISTINCT value once —
+        real traffic repeats a bounded string set per batch)."""
+        if self._list is None:
+            ab = self.arena.tobytes()
+            memo: dict[bytes, str] = {}
+            get = memo.get
+            out = []
+            for o, ln in zip(self.off.tolist(), self.lens.tolist()):
+                if not ln:
+                    out.append("")
+                    continue
+                b = ab[o:o + ln]
+                s = get(b)
+                if s is None:
+                    s = memo[b] = b.decode("utf-8", "replace")
+                out.append(s)
+            self._list = out
+        return self._list
+
+    def __iter__(self):
+        return iter(self.tolist())
+
+
 # -- columnar L4 protobuf decode (must mirror DfL4Cols in pbcols.cpp) -------
 
 class _DfL4Cols(ctypes.Structure):
@@ -349,9 +431,10 @@ class L4ColumnDecoder:
         self._cols.arena_cap = arena_cap
         self._cols.cap = cap
 
-    def decode(self, payload: bytes):
+    def decode(self, payload):
+        ptr, nbytes, _keep = _payload_buf(payload)
         n = self._lib.df_decode_l4_cols(
-            payload, len(payload), ctypes.byref(self._cols),
+            ptr, nbytes, ctypes.byref(self._cols),
             self._l7_off, self._l7_len, self._l7_cap,
             ctypes.byref(self._n_l7))
         if n < 0:
@@ -438,14 +521,180 @@ class L7ColumnDecoder:
         self._cols.arena_cap = arena_cap
         self._cols.cap = cap
 
-    def decode(self, payload: bytes):
-        n = self._lib.df_decode_l7_cols(payload, len(payload),
+    def decode(self, payload):
+        ptr, nbytes, _keep = _payload_buf(payload)
+        n = self._lib.df_decode_l7_cols(ptr, nbytes,
                                         ctypes.byref(self._cols))
         if n < 0:
             return None
         n = int(n)
         cols = {k: a[:n] for k, a in self.arrays.items()}
         return n, cols, self.arena[:self._cols.arena_used]
+
+
+# -- columnar DocumentBatch decode (must mirror DfDocCols in ingest.cpp) ----
+
+# ip_flags bits (must match the enum in ingest.cpp)
+IP_SRC_EMPTY = 1
+IP_DST_EMPTY = 2
+IP_FALLBACK = 4
+
+
+class _DfDocCols(ctypes.Structure):
+    _pack_ = 1
+    _fields_ = (
+        [(n, ctypes.c_void_p) for n in (
+            "timestamp_s",
+            "packet_tx", "packet_rx", "byte_tx", "byte_rx", "flow_count",
+            "new_flow", "closed_flow", "rtt_sum", "rtt_count", "retrans",
+            "syn_count", "synack_count",
+            "request", "response", "rrt_sum", "rrt_count", "rrt_max",
+            "error_client", "error_server", "timeout",
+            "ip4_src", "ip4_dst", "proto", "l7_protocol",
+            "app_service_off", "app_service_len",
+            "port", "direction", "has_flow", "has_app", "ip_flags",
+            "arena")]
+        + [("arena_cap", ctypes.c_uint32),
+           ("arena_used", ctypes.c_uint32),
+           ("cap", ctypes.c_uint32)])
+
+
+class DocColumnDecoder:
+    """Reusable buffers for df_decode_doc_cols: DocumentBatch bytes ->
+    numpy column views for everything MetricsDecoder consumes (FlowMeter
+    and AppMeter fields already under their flow_metrics column names).
+    decode() returns (n, cols dict, arena bytes-view) or None when the
+    native path can't take the batch — caller falls back to pb."""
+
+    U64 = ("timestamp_s",
+           "packet_tx", "packet_rx", "byte_tx", "byte_rx", "flow_count",
+           "new_flow", "closed_flow", "rtt_sum", "rtt_count", "retrans",
+           "syn_count", "synack_count",
+           "request", "response", "rrt_sum", "rrt_count", "rrt_max",
+           "error_client", "error_server", "timeout")
+    U32 = ("ip4_src", "ip4_dst", "proto", "l7_protocol",
+           "app_service_off", "app_service_len")
+    U16 = ("port",)
+    U8 = ("direction", "has_flow", "has_app", "ip_flags")
+
+    def __init__(self, cap: int = 65536, arena_cap: int = 1 << 20) -> None:
+        lib = load()
+        if lib is None:
+            raise RuntimeError("libdfnative.so unavailable")
+        self._lib = lib
+        self.cap = cap
+        self.arrays: dict[str, np.ndarray] = {}
+        for names, dt in ((self.U64, np.uint64), (self.U32, np.uint32),
+                          (self.U16, np.uint16), (self.U8, np.uint8)):
+            for n in names:
+                self.arrays[n] = np.zeros(cap, dtype=dt)
+        self.arena = np.zeros(arena_cap, dtype=np.uint8)
+        self._cols = _DfDocCols()
+        for n, a in self.arrays.items():
+            setattr(self._cols, n, a.ctypes.data)
+        self._cols.arena = self.arena.ctypes.data
+        self._cols.arena_cap = arena_cap
+        self._cols.cap = cap
+
+    def decode(self, payload):
+        ptr, nbytes, _keep = _payload_buf(payload)
+        n = self._lib.df_decode_doc_cols(ptr, nbytes,
+                                         ctypes.byref(self._cols))
+        if n < 0:
+            return None
+        n = int(n)
+        cols = {k: a[:n] for k, a in self.arrays.items()}
+        return n, cols, self.arena[:self._cols.arena_used]
+
+
+# -- columnar TpuSpanBatch decode (must mirror DfSpanCols in ingest.cpp) ----
+
+# span string-column slot order; must match span_str_slot() in ingest.cpp
+SPAN_STRS = ("hlo_module", "hlo_op", "hlo_category", "collective",
+             "process_name")
+
+
+class _DfSpanCols(ctypes.Structure):
+    _pack_ = 1
+    _fields_ = (
+        [(n, ctypes.c_void_p) for n in (
+            "start_ns", "duration_ns", "flops", "bytes_accessed",
+            "bytes_transferred", "step",
+            "device_id", "chip_id", "core_id", "slice_id", "kind",
+            "program_id", "run_id", "replica_group_size", "pid")]
+        + [("str_off", ctypes.c_void_p * len(SPAN_STRS)),
+           ("str_len", ctypes.c_void_p * len(SPAN_STRS))]
+        + [(n, ctypes.c_void_p) for n in (
+            "m_timestamp_ns", "m_bytes_in_use", "m_peak_bytes_in_use",
+            "m_bytes_limit", "m_largest_free_block",
+            "m_device_id", "m_num_allocs", "m_pid",
+            "m_pname_off", "m_pname_len", "arena")]
+        + [("arena_cap", ctypes.c_uint32),
+           ("arena_used", ctypes.c_uint32),
+           ("cap", ctypes.c_uint32),
+           ("mem_cap", ctypes.c_uint32),
+           ("n_mem", ctypes.c_uint32)])
+
+
+class SpanColumnDecoder:
+    """Reusable buffers for df_decode_span_cols: TpuSpanBatch bytes ->
+    numpy column views for spans AND memory samples (m_* columns).
+    decode() returns (n_spans, cols dict, n_mem, arena bytes-view) or
+    None when the native path can't take the batch — caller falls back
+    to pb."""
+
+    U64 = ("start_ns", "duration_ns", "flops", "bytes_accessed",
+           "bytes_transferred", "step")
+    U32 = ("device_id", "chip_id", "core_id", "slice_id", "kind",
+           "program_id", "run_id", "replica_group_size", "pid")
+    M_U64 = ("m_timestamp_ns", "m_bytes_in_use", "m_peak_bytes_in_use",
+             "m_bytes_limit", "m_largest_free_block")
+    M_U32 = ("m_device_id", "m_num_allocs", "m_pid",
+             "m_pname_off", "m_pname_len")
+
+    def __init__(self, cap: int = 65536, mem_cap: int = 16384,
+                 arena_cap: int = 1 << 22) -> None:
+        lib = load()
+        if lib is None:
+            raise RuntimeError("libdfnative.so unavailable")
+        self._lib = lib
+        self.cap = cap
+        self.mem_cap = mem_cap
+        self.arrays: dict[str, np.ndarray] = {}
+        for names, dt in ((self.U64, np.uint64), (self.U32, np.uint32)):
+            for n in names:
+                self.arrays[n] = np.zeros(cap, dtype=dt)
+        for s in SPAN_STRS:
+            self.arrays[f"{s}_off"] = np.zeros(cap, dtype=np.uint32)
+            self.arrays[f"{s}_len"] = np.zeros(cap, dtype=np.uint32)
+        for names, dt in ((self.M_U64, np.uint64), (self.M_U32, np.uint32)):
+            for n in names:
+                self.arrays[n] = np.zeros(mem_cap, dtype=dt)
+        self.arena = np.zeros(arena_cap, dtype=np.uint8)
+        self._cols = _DfSpanCols()
+        for names in (self.U64, self.U32, self.M_U64, self.M_U32):
+            for n in names:
+                setattr(self._cols, n, self.arrays[n].ctypes.data)
+        for i, s in enumerate(SPAN_STRS):
+            self._cols.str_off[i] = self.arrays[f"{s}_off"].ctypes.data
+            self._cols.str_len[i] = self.arrays[f"{s}_len"].ctypes.data
+        self._cols.arena = self.arena.ctypes.data
+        self._cols.arena_cap = arena_cap
+        self._cols.cap = cap
+        self._cols.mem_cap = mem_cap
+
+    def decode(self, payload):
+        ptr, nbytes, _keep = _payload_buf(payload)
+        n = self._lib.df_decode_span_cols(ptr, nbytes,
+                                          ctypes.byref(self._cols))
+        if n < 0:
+            return None
+        n = int(n)
+        n_mem = int(self._cols.n_mem)
+        cols = {}
+        for k, a in self.arrays.items():
+            cols[k] = a[:n_mem] if k.startswith("m_") else a[:n]
+        return n, cols, n_mem, self.arena[:self._cols.arena_used]
 
 
 # -- encoded query execution kernels (qexec.cpp) ----------------------------

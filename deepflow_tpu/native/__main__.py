@@ -1,6 +1,7 @@
 """Self-check CLI: report which native fast paths are live.
 
     python -m deepflow_tpu.native --selfcheck
+    python -m deepflow_tpu.native --verify-abi
 
 Builds (or loads) libdfnative.so the same way the server does, then
 probes each fast path with a tiny synthetic input so the report shows
@@ -8,12 +9,20 @@ what will ACTUALLY run — a present-but-ABI-stale .so, a set
 DF_NO_NATIVE, or a missing compiler all show up here as the fallback
 they cause, instead of surfacing later as silently degraded ingest
 throughput.
+
+--verify-abi is the CI gate: exit non-zero unless the library loads at
+the expected ABI version AND every ingest-hot-path probe passes — a
+stale .so must fail the build loudly, not fall back silently. The only
+exemption is an explicit DF_NO_NATIVE=1 (the operator asked for the
+fallback).
 """
 
 from __future__ import annotations
 
 import os
 import sys
+
+import numpy as np
 
 
 def _probe_l4(native) -> bool:
@@ -32,12 +41,65 @@ def _probe_l7(native) -> bool:
         return False
 
 
+def _probe_doc(native) -> bool:
+    try:
+        dec = native.DocColumnDecoder(cap=16)
+        return dec.decode(b"") is not None
+    except Exception:
+        return False
+
+
+def _probe_span(native) -> bool:
+    try:
+        dec = native.SpanColumnDecoder(cap=16, mem_cap=16)
+        return dec.decode(b"") is not None
+    except Exception:
+        return False
+
+
+def _probe_dict_arena() -> bool:
+    try:
+        from deepflow_tpu.store.dictionary import Dictionary
+        d = Dictionary("selfcheck")
+        arena = np.frombuffer(b"ab", dtype=np.uint8)
+        ids = d.encode_arena(arena,
+                             np.array([0, 0], dtype=np.uint32),
+                             np.array([2, 0], dtype=np.uint32))
+        return ids is not None and ids.tolist() == [1, 0]
+    except Exception:
+        return False
+
+
 def _probe_eth(native) -> bool:
     try:
         outs, ok = native.decode_eth_batch([b"\x00" * 60])
         return outs is not None and len(ok) == 1
     except Exception:
         return False
+
+
+def _ingest_paths(native, lib) -> list[tuple[str, bool, str]]:
+    """(name, live, fallback) for every path --verify-abi gates on."""
+    return [
+        ("L4 flow-log columnar decode",
+         lib is not None and _probe_l4(native),
+         "per-field python protobuf parse"),
+        ("L7 flow-log columnar decode",
+         lib is not None and _probe_l7(native),
+         "per-field python protobuf parse"),
+        ("metrics doc columnar decode",
+         lib is not None and _probe_doc(native),
+         "per-field python protobuf parse"),
+        ("tpu-span columnar decode",
+         lib is not None and _probe_span(native),
+         "per-field python protobuf parse"),
+        ("dictionary arena encode",
+         lib is not None and _probe_dict_arena(),
+         "per-batch python interning"),
+        ("ethernet/IPv4 batch decode",
+         lib is not None and _probe_eth(native),
+         "python struct unpack per header"),
+    ]
 
 
 def selfcheck() -> int:
@@ -61,13 +123,7 @@ def selfcheck() -> int:
         print(f"  library             : loaded, ABI {lib.df_abi_version()}"
               f" (expected {native._ABI_VERSION})")
 
-    paths = [
-        ("L4 flow-log columnar decode", lib is not None and _probe_l4(native),
-         "per-field python protobuf parse"),
-        ("L7 flow-log columnar decode", lib is not None and _probe_l7(native),
-         "per-field python protobuf parse"),
-        ("ethernet/IPv4 batch decode", lib is not None and _probe_eth(native),
-         "python struct unpack per header"),
+    paths = _ingest_paths(native, lib) + [
         ("native FlowMap", lib is not None and hasattr(lib, "df_fm_new"),
          "python FlowMap"),
         ("AF_PACKET ring capture", lib is not None and
@@ -88,7 +144,36 @@ def selfcheck() -> int:
     return 0
 
 
+def verify_abi() -> int:
+    """CI gate: non-zero exit unless the native ingest hot path is FULLY
+    live (or DF_NO_NATIVE explicitly disables it)."""
+    from deepflow_tpu import native
+
+    if os.environ.get("DF_NO_NATIVE"):
+        print("verify-abi: DF_NO_NATIVE set — fallback explicitly "
+              "requested, skipping")
+        return 0
+    lib = native.load()
+    if lib is None:
+        print("verify-abi: FAIL — libdfnative.so did not load "
+              "(missing build or ABI mismatch; run `make -C "
+              "deepflow_tpu/native` and see load warnings above)")
+        return 1
+    got, want = lib.df_abi_version(), native._ABI_VERSION
+    if got != want:
+        print(f"verify-abi: FAIL — ABI {got}, bindings expect {want}")
+        return 1
+    bad = [name for name, ok, _ in _ingest_paths(native, lib) if not ok]
+    if bad:
+        print("verify-abi: FAIL — probes failed: " + ", ".join(bad))
+        return 1
+    print(f"verify-abi: OK — ABI {got}, all ingest hot paths live")
+    return 0
+
+
 def main(argv: list[str]) -> int:
+    if "--verify-abi" in argv:
+        return verify_abi()
     if "--selfcheck" in argv or not argv:
         return selfcheck()
     print(__doc__)

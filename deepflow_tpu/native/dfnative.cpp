@@ -26,7 +26,7 @@ extern "C" {
 // native/__init__.py. Bump on ANY change to exported signatures or packed
 // struct layouts (L7Event, DfPacketOut, flow records); load() refuses a
 // library whose version differs instead of silently corrupting memory.
-int32_t df_abi_version() { return 5; }
+int32_t df_abi_version() { return 6; }
 
 // ---------------------------------------------------------------------------
 // Dictionary: string <-> uint32 id, id 0 reserved for ""
@@ -64,6 +64,36 @@ void df_dict_encode_batch(DfDict* d, const char* data,
             out[i] = id;
         }
     }
+}
+
+// Batch-encode n string cells given as (off,len) pairs into a shared
+// arena — the shape the native columnar decoders (pbcols.cpp,
+// ingest.cpp) produce, so interning never materializes Python strings.
+// Writes ids into out (n entries) and returns the dictionary length
+// AFTER the batch; the caller diffs against the length BEFORE to learn
+// which ids are new and fetch them back via df_dict_get. NOT
+// thread-safe: the caller (store/dictionary.py) holds the Python-side
+// dictionary lock across the call — one lock acquisition per batch.
+uint64_t df_dict_encode_arena(DfDict* d, const uint8_t* arena,
+                              const uint32_t* offs, const uint32_t* lens,
+                              uint32_t n, uint32_t* out) {
+    for (uint32_t i = 0; i < n; i++) {
+        if (lens[i] == 0) {
+            out[i] = 0;  // id 0 is always ""
+            continue;
+        }
+        std::string s((const char*)arena + offs[i], lens[i]);
+        auto it = d->map.find(s);
+        if (it != d->map.end()) {
+            out[i] = it->second;
+        } else {
+            uint32_t id = (uint32_t)d->strings.size();
+            d->strings.push_back(s);
+            d->map.emplace(std::move(s), id);
+            out[i] = id;
+        }
+    }
+    return d->strings.size();
 }
 
 // Lookup without insert; returns UINT32_MAX when absent.

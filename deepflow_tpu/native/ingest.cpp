@@ -1,0 +1,536 @@
+// Native columnar decode for the non-flow ingest hot path: DocumentBatch
+// (metrics) and TpuSpanBatch (device spans + HBM samples) protobuf wire
+// -> struct-of-arrays, no Python objects until the store append.
+//
+// Companion to pbcols.cpp (FlowLogBatch): same caller-owned packed-struct
+// ABI, same shared string arena with (offset,len) cells, same -1-on-any-
+// trouble contract so Python can always fall back to the protobuf path.
+// Layouts must match the ctypes bindings in native/__init__.py; bump
+// DF_ABI_VERSION in dfnative.cpp on ANY change here.
+//
+// Wire schema parsed here must match deepflow_tpu/proto/messages.proto:
+//   DocumentBatch{ repeated Document docs = 1; }
+//   Document{ timestamp_s=1, MetricTag tag=2, FlowMeter flow_meter=3,
+//             AppMeter app_meter=4, interval_s=5 }
+//   TpuSpanBatch{ repeated TpuSpan spans = 1;
+//                 repeated TpuMemorySample memory = 2; }
+// Unknown fields are skipped by wire type so proto ADDITIONS stay
+// compatible.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+struct Reader {
+    const uint8_t* p;
+    const uint8_t* end;
+    bool ok = true;
+
+    uint64_t varint() {
+        uint64_t v = 0;
+        int shift = 0;
+        while (p < end && shift < 64) {
+            uint8_t b = *p++;
+            v |= (uint64_t)(b & 0x7F) << shift;
+            if (!(b & 0x80)) return v;
+            shift += 7;
+        }
+        ok = false;
+        return 0;
+    }
+
+    bool skip(uint32_t wire) {
+        switch (wire) {
+            case 0: varint(); return ok;
+            case 1: if (end - p < 8) return ok = false; p += 8; return true;
+            case 2: {
+                uint64_t n = varint();
+                if (!ok || (uint64_t)(end - p) < n) return ok = false;
+                p += n;
+                return true;
+            }
+            case 5: if (end - p < 4) return ok = false; p += 4; return true;
+            default: return ok = false;
+        }
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// DocumentBatch (METRICS frames) -> DfDocCols
+// ---------------------------------------------------------------------------
+
+// ip_flags bits: decoders.py's _ip_decode maps empty bytes to "" (NOT
+// "0.0.0.0"), and v6/odd-length addresses take the python formatting
+// path — the flags let Python reproduce that exactly or bail out.
+enum {
+    DF_IP_SRC_EMPTY = 1,  // tag.ip_src was absent/empty -> ""
+    DF_IP_DST_EMPTY = 2,  // tag.ip_dst was absent/empty -> ""
+    DF_IP_FALLBACK = 4,   // length not in {0,4}: batch needs the pb path
+};
+
+#pragma pack(push, 1)
+struct DfDocCols {
+    uint64_t* timestamp_s;
+    // FlowMeter (column names match flow_metrics.network.1s)
+    uint64_t* packet_tx;
+    uint64_t* packet_rx;
+    uint64_t* byte_tx;
+    uint64_t* byte_rx;
+    uint64_t* flow_count;
+    uint64_t* new_flow;
+    uint64_t* closed_flow;
+    uint64_t* rtt_sum;
+    uint64_t* rtt_count;
+    uint64_t* retrans;
+    uint64_t* syn_count;
+    uint64_t* synack_count;
+    // AppMeter (column names match flow_metrics.application.1s)
+    uint64_t* request;
+    uint64_t* response;
+    uint64_t* rrt_sum;
+    uint64_t* rrt_count;
+    uint64_t* rrt_max;
+    uint64_t* error_client;
+    uint64_t* error_server;
+    uint64_t* timeout;
+    // MetricTag
+    uint32_t* ip4_src;         // host byte order; see ip_flags
+    uint32_t* ip4_dst;
+    uint32_t* proto;
+    uint32_t* l7_protocol;
+    uint32_t* app_svc_off;     // tag.app_service in the arena
+    uint32_t* app_svc_len;
+    uint16_t* port;
+    uint8_t*  direction;
+    uint8_t*  has_flow;        // wire presence == pb HasField
+    uint8_t*  has_app;
+    uint8_t*  ip_flags;        // DF_IP_* bits
+    // shared string arena
+    uint8_t*  arena;
+    uint32_t  arena_cap;
+    uint32_t  arena_used;
+    uint32_t  cap;
+};
+#pragma pack(pop)
+
+static bool doc_arena_put(uint8_t* arena, uint32_t cap, uint32_t* used,
+                          const uint8_t* s, uint64_t n, uint32_t* off_out,
+                          uint32_t* len_out) {
+    if (*used + n > cap) return false;
+    memcpy(arena + *used, s, n);
+    *off_out = *used;
+    *len_out = (uint32_t)n;
+    *used += (uint32_t)n;
+    return true;
+}
+
+// Parse FlowMeter / AppMeter submessages: all fields are varints, so one
+// loop with a field->slot table per meter keeps them branch-cheap.
+static bool parse_flow_meter(const uint8_t* sub, uint64_t n, DfDocCols* c,
+                             uint32_t r) {
+    Reader rd{sub, sub + n};
+    while (rd.ok && rd.p < rd.end) {
+        uint64_t tag = rd.varint();
+        if (!rd.ok) return false;
+        uint32_t field = (uint32_t)(tag >> 3), wire = (uint32_t)(tag & 7);
+        if (wire != 0) {
+            if (!rd.skip(wire)) return false;
+            continue;
+        }
+        uint64_t v = rd.varint();
+        if (!rd.ok) return false;
+        switch (field) {
+            case 1: c->packet_tx[r] = v; break;
+            case 2: c->packet_rx[r] = v; break;
+            case 3: c->byte_tx[r] = v; break;
+            case 4: c->byte_rx[r] = v; break;
+            case 5: c->flow_count[r] = v; break;
+            case 6: c->new_flow[r] = v; break;
+            case 7: c->closed_flow[r] = v; break;
+            case 8: c->rtt_sum[r] = v; break;
+            case 9: c->rtt_count[r] = v; break;
+            case 10: c->retrans[r] = v; break;
+            case 11: c->syn_count[r] = v; break;
+            case 12: c->synack_count[r] = v; break;
+            default: break;
+        }
+    }
+    return rd.ok;
+}
+
+static bool parse_app_meter(const uint8_t* sub, uint64_t n, DfDocCols* c,
+                            uint32_t r) {
+    Reader rd{sub, sub + n};
+    while (rd.ok && rd.p < rd.end) {
+        uint64_t tag = rd.varint();
+        if (!rd.ok) return false;
+        uint32_t field = (uint32_t)(tag >> 3), wire = (uint32_t)(tag & 7);
+        if (wire != 0) {
+            if (!rd.skip(wire)) return false;
+            continue;
+        }
+        uint64_t v = rd.varint();
+        if (!rd.ok) return false;
+        switch (field) {
+            case 1: c->request[r] = v; break;
+            case 2: c->response[r] = v; break;
+            case 3: c->rrt_sum[r] = v; break;
+            case 4: c->rrt_count[r] = v; break;
+            case 5: c->rrt_max[r] = v; break;
+            case 6: c->error_client[r] = v; break;
+            case 7: c->error_server[r] = v; break;
+            case 8: c->timeout[r] = v; break;
+            default: break;
+        }
+    }
+    return rd.ok;
+}
+
+static bool parse_metric_tag(const uint8_t* sub, uint64_t n, DfDocCols* c,
+                             uint32_t r) {
+    Reader rd{sub, sub + n};
+    while (rd.ok && rd.p < rd.end) {
+        uint64_t tag = rd.varint();
+        if (!rd.ok) return false;
+        uint32_t field = (uint32_t)(tag >> 3), wire = (uint32_t)(tag & 7);
+        if (wire == 0) {
+            uint64_t v = rd.varint();
+            if (!rd.ok) return false;
+            switch (field) {
+                case 3: c->port[r] = (uint16_t)v; break;
+                case 4: c->proto[r] = (uint32_t)v; break;
+                case 5: c->l7_protocol[r] = (uint32_t)v; break;
+                case 10: c->direction[r] = (uint8_t)v; break;
+                default: break;  // 6 agent_id, 8/9 gpids unused by rows
+            }
+            continue;
+        }
+        if (wire == 2) {
+            uint64_t kn = rd.varint();
+            if (!rd.ok || (uint64_t)(rd.end - rd.p) < kn) return false;
+            const uint8_t* ks = rd.p;
+            rd.p += kn;
+            if (field == 1 || field == 2) {
+                if (kn == 4) {
+                    uint32_t ip = (uint32_t)ks[0] << 24 |
+                                  (uint32_t)ks[1] << 16 |
+                                  (uint32_t)ks[2] << 8 | ks[3];
+                    (field == 1 ? c->ip4_src : c->ip4_dst)[r] = ip;
+                    // field may repeat on the wire: last value wins, so
+                    // clear a previously set empty/fallback bit
+                    c->ip_flags[r] &= (uint8_t)~(
+                        field == 1 ? DF_IP_SRC_EMPTY : DF_IP_DST_EMPTY);
+                } else if (kn == 0) {
+                    c->ip_flags[r] |= (uint8_t)(
+                        field == 1 ? DF_IP_SRC_EMPTY : DF_IP_DST_EMPTY);
+                } else {
+                    c->ip_flags[r] |= DF_IP_FALLBACK;  // v6 / malformed
+                }
+            } else if (field == 7 && kn) {  // app_service
+                if (!doc_arena_put(c->arena, c->arena_cap, &c->arena_used,
+                                   ks, kn, &c->app_svc_off[r],
+                                   &c->app_svc_len[r]))
+                    return false;
+            }
+            continue;
+        }
+        if (!rd.skip(wire)) return false;
+    }
+    return rd.ok;
+}
+
+static bool parse_doc(const uint8_t* sub, uint64_t n, DfDocCols* c,
+                      uint32_t r) {
+    // zero the row (batches reuse arrays)
+    c->timestamp_s[r] = 0;
+    c->packet_tx[r] = c->packet_rx[r] = c->byte_tx[r] = c->byte_rx[r] = 0;
+    c->flow_count[r] = c->new_flow[r] = c->closed_flow[r] = 0;
+    c->rtt_sum[r] = c->rtt_count[r] = c->retrans[r] = 0;
+    c->syn_count[r] = c->synack_count[r] = 0;
+    c->request[r] = c->response[r] = 0;
+    c->rrt_sum[r] = c->rrt_count[r] = c->rrt_max[r] = 0;
+    c->error_client[r] = c->error_server[r] = c->timeout[r] = 0;
+    c->ip4_src[r] = c->ip4_dst[r] = 0;
+    c->proto[r] = c->l7_protocol[r] = 0;
+    c->app_svc_off[r] = c->app_svc_len[r] = 0;
+    c->port[r] = 0;
+    c->direction[r] = 0;
+    c->has_flow[r] = c->has_app[r] = 0;
+    // absent bytes fields decode as empty in pb, so start from "empty"
+    c->ip_flags[r] = DF_IP_SRC_EMPTY | DF_IP_DST_EMPTY;
+
+    Reader rd{sub, sub + n};
+    while (rd.ok && rd.p < rd.end) {
+        uint64_t tag = rd.varint();
+        if (!rd.ok) return false;
+        uint32_t field = (uint32_t)(tag >> 3), wire = (uint32_t)(tag & 7);
+        if (wire == 0) {
+            uint64_t v = rd.varint();
+            if (!rd.ok) return false;
+            if (field == 1) c->timestamp_s[r] = v;
+            // 5 interval_s: unused by the row build
+            continue;
+        }
+        if (wire == 2) {
+            uint64_t sn = rd.varint();
+            if (!rd.ok || (uint64_t)(rd.end - rd.p) < sn) return false;
+            const uint8_t* sp = rd.p;
+            rd.p += sn;
+            switch (field) {
+                case 2:
+                    if (!parse_metric_tag(sp, sn, c, r)) return false;
+                    break;
+                case 3:
+                    // wire presence == pb HasField (an explicitly set but
+                    // default-valued submessage still serializes its tag)
+                    c->has_flow[r] = 1;
+                    if (!parse_flow_meter(sp, sn, c, r)) return false;
+                    break;
+                case 4:
+                    c->has_app[r] = 1;
+                    if (!parse_app_meter(sp, sn, c, r)) return false;
+                    break;
+                default:
+                    break;
+            }
+            continue;
+        }
+        if (!rd.skip(wire)) return false;
+    }
+    return rd.ok;
+}
+
+// Decode a DocumentBatch columnar. Returns the number of docs decoded,
+// or -1 on malformed input / capacity overflow (caller falls back to the
+// Python pb path).
+int64_t df_decode_doc_cols(const uint8_t* data, uint64_t len,
+                           DfDocCols* cols) {
+    Reader rd{data, data + len};
+    uint32_t n = 0;
+    cols->arena_used = 0;
+    while (rd.ok && rd.p < rd.end) {
+        uint64_t tag = rd.varint();
+        if (!rd.ok) return -1;
+        uint32_t field = (uint32_t)(tag >> 3), wire = (uint32_t)(tag & 7);
+        if (field == 1 && wire == 2) {
+            uint64_t sublen = rd.varint();
+            if (!rd.ok || (uint64_t)(rd.end - rd.p) < sublen) return -1;
+            if (n >= cols->cap) return -1;
+            const uint8_t* sub = rd.p;
+            rd.p += sublen;
+            if (!parse_doc(sub, sublen, cols, n)) return -1;
+            n++;
+        } else if (!rd.skip(wire)) {
+            return -1;
+        }
+    }
+    if (!rd.ok) return -1;
+    return n;
+}
+
+// ---------------------------------------------------------------------------
+// TpuSpanBatch (TPU_SPAN frames) -> DfSpanCols
+// ---------------------------------------------------------------------------
+
+// Span string slots (order matches SpanColumnDecoder.STRS in
+// native/__init__.py): 0 hlo_module(7) 1 hlo_op(8) 2 hlo_category(9)
+// 3 collective(15) 4 process_name(20)
+#define DF_SPAN_NSTR 5
+
+#pragma pack(push, 1)
+struct DfSpanCols {
+    // spans
+    uint64_t* start_ns;
+    uint64_t* duration_ns;
+    uint64_t* flops;
+    uint64_t* bytes_accessed;
+    uint64_t* bytes_transferred;
+    uint64_t* step;
+    uint32_t* device_id;
+    uint32_t* chip_id;
+    uint32_t* core_id;
+    uint32_t* slice_id;
+    uint32_t* kind;
+    uint32_t* program_id;
+    uint32_t* run_id;
+    uint32_t* replica_group_size;
+    uint32_t* pid;
+    uint32_t* str_off[DF_SPAN_NSTR];
+    uint32_t* str_len[DF_SPAN_NSTR];
+    // memory samples
+    uint64_t* m_timestamp_ns;
+    uint64_t* m_bytes_in_use;
+    uint64_t* m_peak_bytes_in_use;
+    uint64_t* m_bytes_limit;
+    uint64_t* m_largest_free_block;
+    uint32_t* m_device_id;
+    uint32_t* m_num_allocs;
+    uint32_t* m_pid;
+    uint32_t* m_pname_off;
+    uint32_t* m_pname_len;
+    // shared string arena
+    uint8_t*  arena;
+    uint32_t  arena_cap;
+    uint32_t  arena_used;
+    uint32_t  cap;       // span rows
+    uint32_t  mem_cap;   // memory rows
+    uint32_t  n_mem;     // OUT: memory rows decoded
+};
+#pragma pack(pop)
+
+static int span_str_slot(uint32_t field) {
+    switch (field) {
+        case 7: return 0; case 8: return 1; case 9: return 2;
+        case 15: return 3; case 20: return 4;
+        default: return -1;
+    }
+}
+
+static bool parse_span(const uint8_t* sub, uint64_t n, DfSpanCols* c,
+                       uint32_t r) {
+    c->start_ns[r] = c->duration_ns[r] = c->flops[r] = 0;
+    c->bytes_accessed[r] = c->bytes_transferred[r] = c->step[r] = 0;
+    c->device_id[r] = c->chip_id[r] = c->core_id[r] = 0;
+    c->slice_id[r] = c->kind[r] = c->program_id[r] = 0;
+    c->run_id[r] = c->replica_group_size[r] = c->pid[r] = 0;
+    for (int i = 0; i < DF_SPAN_NSTR; i++)
+        c->str_off[i][r] = c->str_len[i][r] = 0;
+
+    Reader rd{sub, sub + n};
+    while (rd.ok && rd.p < rd.end) {
+        uint64_t tag = rd.varint();
+        if (!rd.ok) return false;
+        uint32_t field = (uint32_t)(tag >> 3), wire = (uint32_t)(tag & 7);
+        if (wire == 0) {
+            uint64_t v = rd.varint();
+            if (!rd.ok) return false;
+            switch (field) {
+                case 1: c->start_ns[r] = v; break;
+                case 2: c->duration_ns[r] = v; break;
+                case 3: c->device_id[r] = (uint32_t)v; break;
+                case 4: c->chip_id[r] = (uint32_t)v; break;
+                case 5: c->core_id[r] = (uint32_t)v; break;
+                case 6: c->slice_id[r] = (uint32_t)v; break;
+                case 10: c->kind[r] = (uint32_t)v; break;
+                case 11: c->flops[r] = v; break;
+                case 12: c->bytes_accessed[r] = v; break;
+                case 13: c->program_id[r] = (uint32_t)v; break;
+                case 14: c->run_id[r] = (uint32_t)v; break;
+                case 16: c->bytes_transferred[r] = v; break;
+                case 17: c->replica_group_size[r] = (uint32_t)v; break;
+                case 18: c->step[r] = v; break;
+                case 19: c->pid[r] = (uint32_t)v; break;
+                default: break;
+            }
+            continue;
+        }
+        if (wire == 2) {
+            uint64_t kn = rd.varint();
+            if (!rd.ok || (uint64_t)(rd.end - rd.p) < kn) return false;
+            const uint8_t* ks = rd.p;
+            rd.p += kn;
+            int slot = span_str_slot(field);
+            if (slot >= 0 && kn) {
+                if (!doc_arena_put(c->arena, c->arena_cap, &c->arena_used,
+                                   ks, kn, &c->str_off[slot][r],
+                                   &c->str_len[slot][r]))
+                    return false;
+            }
+            continue;
+        }
+        if (!rd.skip(wire)) return false;
+    }
+    return rd.ok;
+}
+
+static bool parse_mem_sample(const uint8_t* sub, uint64_t n, DfSpanCols* c,
+                             uint32_t r) {
+    c->m_timestamp_ns[r] = c->m_bytes_in_use[r] = 0;
+    c->m_peak_bytes_in_use[r] = c->m_bytes_limit[r] = 0;
+    c->m_largest_free_block[r] = 0;
+    c->m_device_id[r] = c->m_num_allocs[r] = c->m_pid[r] = 0;
+    c->m_pname_off[r] = c->m_pname_len[r] = 0;
+
+    Reader rd{sub, sub + n};
+    while (rd.ok && rd.p < rd.end) {
+        uint64_t tag = rd.varint();
+        if (!rd.ok) return false;
+        uint32_t field = (uint32_t)(tag >> 3), wire = (uint32_t)(tag & 7);
+        if (wire == 0) {
+            uint64_t v = rd.varint();
+            if (!rd.ok) return false;
+            switch (field) {
+                case 1: c->m_timestamp_ns[r] = v; break;
+                case 2: c->m_device_id[r] = (uint32_t)v; break;
+                case 3: c->m_bytes_in_use[r] = v; break;
+                case 4: c->m_peak_bytes_in_use[r] = v; break;
+                case 5: c->m_bytes_limit[r] = v; break;
+                case 6: c->m_largest_free_block[r] = v; break;
+                case 7: c->m_num_allocs[r] = (uint32_t)v; break;
+                case 8: c->m_pid[r] = (uint32_t)v; break;
+                default: break;
+            }
+            continue;
+        }
+        if (wire == 2) {
+            uint64_t kn = rd.varint();
+            if (!rd.ok || (uint64_t)(rd.end - rd.p) < kn) return false;
+            const uint8_t* ks = rd.p;
+            rd.p += kn;
+            if (field == 9 && kn) {
+                if (!doc_arena_put(c->arena, c->arena_cap, &c->arena_used,
+                                   ks, kn, &c->m_pname_off[r],
+                                   &c->m_pname_len[r]))
+                    return false;
+            }
+            continue;
+        }
+        if (!rd.skip(wire)) return false;
+    }
+    return rd.ok;
+}
+
+// Decode a TpuSpanBatch columnar. Returns the number of SPAN rows (memory
+// rows are counted in cols->n_mem), or -1 on malformed input / capacity
+// overflow (caller falls back to the Python pb path).
+int64_t df_decode_span_cols(const uint8_t* data, uint64_t len,
+                            DfSpanCols* cols) {
+    Reader rd{data, data + len};
+    uint32_t n = 0, nm = 0;
+    cols->arena_used = 0;
+    cols->n_mem = 0;
+    while (rd.ok && rd.p < rd.end) {
+        uint64_t tag = rd.varint();
+        if (!rd.ok) return -1;
+        uint32_t field = (uint32_t)(tag >> 3), wire = (uint32_t)(tag & 7);
+        if (field == 1 && wire == 2) {
+            uint64_t sublen = rd.varint();
+            if (!rd.ok || (uint64_t)(rd.end - rd.p) < sublen) return -1;
+            if (n >= cols->cap) return -1;
+            const uint8_t* sub = rd.p;
+            rd.p += sublen;
+            if (!parse_span(sub, sublen, cols, n)) return -1;
+            n++;
+        } else if (field == 2 && wire == 2) {
+            uint64_t sublen = rd.varint();
+            if (!rd.ok || (uint64_t)(rd.end - rd.p) < sublen) return -1;
+            if (nm >= cols->mem_cap) return -1;
+            const uint8_t* sub = rd.p;
+            rd.p += sublen;
+            if (!parse_mem_sample(sub, sublen, cols, nm)) return -1;
+            nm++;
+        } else if (!rd.skip(wire)) {
+            return -1;
+        }
+    }
+    if (!rd.ok) return -1;
+    cols->n_mem = nm;
+    return n;
+}
+
+}  // extern "C"

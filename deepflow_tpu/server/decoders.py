@@ -18,6 +18,8 @@ import time
 import numpy as np
 
 from deepflow_tpu.codec import FrameHeader, MessageType
+from deepflow_tpu.native import (
+    ArenaStrings, IP_FALLBACK, IP_SRC_EMPTY, IP_DST_EMPTY)
 from deepflow_tpu.proto import pb
 from deepflow_tpu.store.db import Database
 from deepflow_tpu.store.schema import (
@@ -323,7 +325,8 @@ class Decoder:
             # ndarray -> tolist(): exported cells must be PYTHON numbers
             # (np scalars would json-serialize via default=str as strings,
             # silently changing the export wire format)
-            expanded = [v.tolist() if isinstance(v, np.ndarray)
+            expanded = [v.tolist()
+                        if isinstance(v, (np.ndarray, ArenaStrings))
                         else v if isinstance(v, list) else [v] * n
                         for v in cols.values()]
             self.exporters.feed(
@@ -361,11 +364,104 @@ class ProfileDecoder(Decoder):
 
 
 class TpuSpanDecoder(Decoder):
-    """TpuSpanBatch -> profile.tpu_hlo_span."""
+    """TpuSpanBatch -> profile.tpu_hlo_span.
+
+    Hot path: native columnar decode (native/ingest.cpp
+    df_decode_span_cols) — span and memory-sample fields land in numpy
+    arrays with the GIL released, string cells stay (arena, off, len)
+    until the dictionary interns them in C++. Malformed/overflow batches
+    ride the protobuf fallback; both paths must write identical rows
+    (golden parity test)."""
 
     MSG_TYPE = MessageType.TPU_SPAN
 
+    def __init__(self, *a, **kw) -> None:
+        super().__init__(*a, **kw)
+        self._tl = threading.local()  # per-worker native decode buffers
+
+    def _fast_decoder(self):
+        dec = getattr(self._tl, "spancols", False)
+        if dec is False:
+            try:
+                from deepflow_tpu.native import SpanColumnDecoder
+                dec = SpanColumnDecoder()
+            except Exception:
+                dec = None
+            self._tl.spancols = dec
+        return dec
+
+    def _handle_cols(self, header: FrameHeader, n: int, cols: dict,
+                     n_mem: int, arena) -> int:
+        tags = self._agent_tags(header)
+        off = self._clock_offset(header)
+
+        def lazy(name: str):
+            lens = cols[f"{name}_len"]
+            if not lens.any():
+                return ""
+            return ArenaStrings(arena, cols[f"{name}_off"], lens)
+
+        def shifted(t: np.ndarray) -> np.ndarray:
+            if not off:
+                return t
+            return (t.astype(np.int64) + off).astype(np.uint64)
+
+        if n:
+            pname = lazy("process_name")
+            out = {
+                "time": shifted(cols["start_ns"]),
+                "duration_ns": cols["duration_ns"],
+                "device_id": cols["device_id"],
+                "chip_id": cols["chip_id"],
+                "core_id": cols["core_id"],
+                "kind": cols["kind"],
+                "hlo_module": lazy("hlo_module"),
+                "hlo_op": lazy("hlo_op"),
+                "hlo_category": lazy("hlo_category"),
+                "flops": cols["flops"],
+                "bytes_accessed": cols["bytes_accessed"],
+                "program_id": cols["program_id"],
+                "run_id": cols["run_id"],
+                "collective": lazy("collective"),
+                "bytes_transferred": cols["bytes_transferred"],
+                "replica_group_size": cols["replica_group_size"],
+                "step": cols["step"],
+                "pid": cols["pid"],
+                "process_name": pname,
+                "app_service": pname,
+            }
+            out.update(tags)
+            # span-labeled slice wins; the agent's universal tag fills
+            # the rest (same precedence as the pb path)
+            sl = cols["slice_id"]
+            out["slice_id"] = np.where(sl != 0, sl,
+                                       np.uint32(tags.get("slice_id", 0)))
+            self.write_columns("profile.tpu_hlo_span", out, n)
+        if n_mem:
+            mem = {
+                "time": shifted(cols["m_timestamp_ns"]),
+                "device_id": cols["m_device_id"],
+                "bytes_in_use": cols["m_bytes_in_use"],
+                "peak_bytes_in_use": cols["m_peak_bytes_in_use"],
+                "bytes_limit": cols["m_bytes_limit"],
+                "largest_free_block": cols["m_largest_free_block"],
+                "num_allocs": cols["m_num_allocs"],
+                "pid": cols["m_pid"],
+                "process_name": lazy("m_pname"),
+            }
+            mem.update(tags)
+            self.write_columns("profile.tpu_memory", mem, n_mem)
+        return n + n_mem
+
     def handle(self, header: FrameHeader, payload: bytes) -> int:
+        fast = self._fast_decoder()
+        if fast is not None:
+            try:
+                res = fast.decode(payload)
+            except Exception:
+                res = None
+            if res is not None:
+                return self._handle_cols(header, *res)
         batch = pb.TpuSpanBatch.FromString(payload)
         tags = self._agent_tags(header)
         off = self._clock_offset(header)
@@ -846,15 +942,20 @@ class FlowLogDecoder(Decoder):
         once per DISTINCT value (request types, domains, endpoints repeat
         heavily in real traffic). Must stay row-identical to
         _handle_l7_list — the golden parity test enforces it."""
-        ab = arena.tobytes()
+        ab_cell: list = []  # arena.tobytes() computed only if strs() needs it
         smemo: dict[bytes, str] = {}
 
         def strs(name: str):
             """Arena (off,len) pairs -> python strings; scalar "" when the
-            whole column is empty (constant broadcast, store-supported)."""
+            whole column is empty (constant broadcast, store-supported).
+            Only for columns that MUST be python strings (the resolution
+            ladder, kname merge) — store-bound columns use lazy() below."""
             lens = cols[f"{name}_len"]
             if not lens.any():
                 return ""
+            if not ab_cell:
+                ab_cell.append(arena.tobytes())
+            ab = ab_cell[0]
             get = smemo.get
             out = []
             for o, ln in zip(cols[f"{name}_off"].tolist(), lens.tolist()):
@@ -867,6 +968,16 @@ class FlowLogDecoder(Decoder):
                     s = smemo[b] = b.decode("utf-8", "replace")
                 out.append(s)
             return out
+
+        def lazy(name: str):
+            """Store-bound string column: stays (arena, off, len) all the
+            way into Dictionary.encode_arena, so cells are interned in C++
+            under one lock and never become Python strings on the hot
+            path. Scalar "" broadcast when the whole column is empty."""
+            lens = cols[f"{name}_len"]
+            if not lens.any():
+                return ""
+            return ArenaStrings(arena, cols[f"{name}_off"], lens)
 
         ip4s, ip4d = cols["ip4_src"], cols["ip4_dst"]
         src_s, dst_s, ipb0, ipb1 = self._ip_views(ip4s, ip4d)
@@ -905,21 +1016,21 @@ class FlowLogDecoder(Decoder):
             "tunnel_type": np.minimum(cols["tunnel_type"], 4),
             "tunnel_id": cols["tunnel_id"],
             "l7_protocol": cols["l7_protocol"],
-            "version": strs("version"),
-            "request_type": strs("request_type"),
-            "request_domain": strs("request_domain"),
-            "request_resource": strs("request_resource"),
-            "endpoint": strs("endpoint"),
+            "version": lazy("version"),
+            "request_type": lazy("request_type"),
+            "request_domain": lazy("request_domain"),
+            "request_resource": lazy("request_resource"),
+            "endpoint": lazy("endpoint"),
             "request_id": cols["request_id"],
             "response_status": cols["response_status"],
             "response_code": cols["response_code"],
-            "response_exception": strs("response_exception"),
-            "response_result": strs("response_result"),
+            "response_exception": lazy("response_exception"),
+            "response_result": lazy("response_result"),
             "response_duration": dur,
-            "trace_id": strs("trace_id"),
-            "span_id": strs("span_id"),
-            "parent_span_id": strs("parent_span_id"),
-            "x_request_id": strs("x_request_id"),
+            "trace_id": lazy("trace_id"),
+            "span_id": lazy("span_id"),
+            "parent_span_id": lazy("parent_span_id"),
+            "x_request_id": lazy("x_request_id"),
             "syscall_trace_id_request": cols["syscall_trace_id_request"],
             "syscall_trace_id_response": cols["syscall_trace_id_response"],
             "syscall_thread_0": cols["syscall_thread_0"],
@@ -931,7 +1042,7 @@ class FlowLogDecoder(Decoder):
                                            ep["process_kname_0"]),
             "process_kname_1": kname_merge(strs("process_kname_1"),
                                            ep["process_kname_1"]),
-            "attrs": strs("attrs_json"),
+            "attrs": lazy("attrs_json"),
         }
         out.update(tags)
         self.write_columns("flow_log.l7_flow_log", out, n)
@@ -1005,11 +1116,17 @@ class FlowLogDecoder(Decoder):
         from deepflow_tpu.server.tracetree import span_from_l7
 
         def at(col, i):
-            """Columns may be scalars (constant broadcast), lists, or
-            ndarrays (native columnar path)."""
-            return col[i] if isinstance(col, (list, np.ndarray)) else col
+            """Columns may be scalars (constant broadcast), lists,
+            ndarrays, or lazy ArenaStrings (native columnar path)."""
+            if isinstance(col, (list, np.ndarray, ArenaStrings)):
+                return col[i]
+            return col
         tids = cols["trace_id"]
-        if isinstance(tids, str):
+        if isinstance(tids, ArenaStrings):
+            if not tids.lens.any():
+                return  # no row is traced: skip the scan entirely
+            tids = tids.tolist()
+        elif isinstance(tids, str):
             if not tids:
                 return  # all-empty broadcast: nothing is traced
             tids = [tids] * n
@@ -1048,11 +1165,141 @@ class FlowLogDecoder(Decoder):
 
 class MetricsDecoder(Decoder):
     """DocumentBatch -> flow_metrics.network/application 1s tables.
-    1m rollups are produced by the datasource rollup job, not here."""
+    1m rollups are produced by the datasource rollup job, not here.
+
+    Hot path: native columnar decode (native/ingest.cpp
+    df_decode_doc_cols) — FlowMeter/AppMeter fields land in numpy arrays
+    under their store column names with the GIL released; HasField
+    presence rides has_flow/has_app flag columns, ip emptiness rides
+    ip_flags bits. Batches with non-v4 addresses (IP_FALLBACK bit) take
+    the protobuf fallback whole, keeping v6 formatting parity exact."""
 
     MSG_TYPE = MessageType.METRICS
 
+    _IP_MEMO_MAX = 1 << 20
+
+    def __init__(self, *a, **kw) -> None:
+        super().__init__(*a, **kw)
+        self._tl = threading.local()  # per-worker native decode buffers
+        self._ip_memo: dict[int, str] = {}  # u32 -> dotted, across batches
+
+    def _fast_decoder(self):
+        dec = getattr(self._tl, "doccols", False)
+        if dec is False:
+            try:
+                from deepflow_tpu.native import DocColumnDecoder
+                dec = DocColumnDecoder()
+            except Exception:
+                dec = None
+            self._tl.doccols = dec
+        return dec
+
+    def _dotted(self, u32s: np.ndarray, flags: np.ndarray,
+                empty_bit: int) -> list:
+        """u32 addresses -> dotted strings; rows whose ip_flags carry
+        empty_bit render "" (pb parity: absent/empty wire bytes decode
+        to the empty string, not 0.0.0.0)."""
+        memo = self._ip_memo
+        out = []
+        for u, fl in zip(u32s.tolist(), flags.tolist()):
+            if fl & empty_bit:
+                out.append("")
+                continue
+            s = memo.get(u)
+            if s is None:
+                if len(memo) >= self._IP_MEMO_MAX:
+                    memo.clear()
+                s = memo[u] = "%d.%d.%d.%d" % (
+                    u >> 24 & 255, u >> 16 & 255, u >> 8 & 255, u & 255)
+            out.append(s)
+        return out
+
+    def _handle_cols(self, header: FrameHeader, n: int, cols: dict,
+                     arena) -> int:
+        tags = self._agent_tags(header)
+        off_s = round(self._clock_offset(header) / 1e9)
+        flags = cols["ip_flags"]
+        src_all = self._dotted(cols["ip4_src"], flags, IP_SRC_EMPTY)
+        dst_all = self._dotted(cols["ip4_dst"], flags, IP_DST_EMPTY)
+        if off_s:
+            time_all = (cols["timestamp_s"].astype(np.int64)
+                        + off_s).astype(np.uint64)
+        else:
+            time_all = cols["timestamp_s"]
+        resolver = None
+        if self.resources is not None and not self.resources.is_empty():
+            resolver = self.resources.batch_resolver()
+
+        def base_cols(idx: np.ndarray) -> tuple[dict, int]:
+            ii = idx.tolist()
+            src_s = [src_all[i] for i in ii]
+            dst_s = [dst_all[i] for i in ii]
+            out = {
+                "time": time_all[idx],
+                "ip_src": src_s,
+                "ip_dst": dst_s,
+                "server_port": cols["port"][idx],
+            }
+            if resolver is not None:
+                t0 = [resolver(s) for s in src_s]
+                t1 = [resolver(s) for s in dst_s]
+                out["pod_0"] = [t.pod for t in t0]
+                out["pod_1"] = [t.pod for t in t1]
+                for name in SIDE_RESOLVE_NAMES:
+                    out[f"{name}_0"] = [getattr(t, name) for t in t0]
+                    out[f"{name}_1"] = [getattr(t, name) for t in t1]
+            elif self.resources is not None:
+                out["pod_0"] = ""
+                out["pod_1"] = ""
+                for name in SIDE_RESOLVE_NAMES:
+                    out[f"{name}_0"] = ""
+                    out[f"{name}_1"] = ""
+            out.update(tags)
+            return out, len(ii)
+
+        n_rows = 0
+        net_idx = np.flatnonzero(cols["has_flow"])
+        if len(net_idx):
+            c, k = base_cols(net_idx)
+            c.update({
+                "protocol": cols["proto"][net_idx],
+                "direction": cols["direction"][net_idx],
+            })
+            for name in ("packet_tx", "packet_rx", "byte_tx", "byte_rx",
+                         "flow_count", "new_flow", "closed_flow",
+                         "rtt_sum", "rtt_count", "retrans", "syn_count",
+                         "synack_count"):
+                c[name] = cols[name][net_idx]
+            self.write_columns("flow_metrics.network.1s", c, k)
+            n_rows += k
+        app_idx = np.flatnonzero(cols["has_app"])
+        if len(app_idx):
+            c, k = base_cols(app_idx)
+            lens = cols["app_service_len"][app_idx]
+            c["l7_protocol"] = cols["l7_protocol"][app_idx]
+            c["app_service"] = (
+                ArenaStrings(arena, cols["app_service_off"][app_idx],
+                             lens) if lens.any() else "")
+            for name in ("request", "response", "rrt_sum", "rrt_count",
+                         "rrt_max", "error_client", "error_server",
+                         "timeout"):
+                c[name] = cols[name][app_idx]
+            self.write_columns("flow_metrics.application.1s", c, k)
+            n_rows += k
+        return n_rows
+
     def handle(self, header: FrameHeader, payload: bytes) -> int:
+        fast = self._fast_decoder()
+        if fast is not None:
+            try:
+                res = fast.decode(payload)
+            except Exception:
+                res = None
+            # any non-{empty,v4} address (v6) -> pb path for the whole
+            # batch: printable v6 formatting stays in one place
+            if res is not None and \
+                    not (res[1]["ip_flags"] & IP_FALLBACK).any():
+                return self._handle_cols(header, res[0], res[1], res[2])
         batch = pb.DocumentBatch.FromString(payload)
         tags = self._agent_tags(header)
         off_s = round(self._clock_offset(header) / 1e9)  # table is 1s-grain
@@ -1247,6 +1494,8 @@ class EventDecoder(Decoder):
 def _aslist(v, n: int) -> list:
     """Scalar column broadcast -> per-row list (store columns may be
     scalars meaning 'this value for every row')."""
+    if isinstance(v, ArenaStrings):
+        return v.tolist()
     return v if isinstance(v, list) else [v] * n
 
 

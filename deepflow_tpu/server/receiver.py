@@ -147,9 +147,12 @@ class Receiver:
             from deepflow_tpu.chaos import chaos_from_env
             chaos = chaos_from_env()
         self._chaos = chaos
+        # recv_ns: wall time spent parsing frames out of recv chunks and
+        # enqueueing them (the "recv" stage of the ingest bench's
+        # per-stage breakdown; decode/dict/write are measured downstream)
         self.stats = {"frames": 0, "bytes": 0, "dropped": 0, "bad_frames": 0,
                       "connections": 0, "acks_sent": 0, "seq_bases": 0,
-                      "udp_trailing_garbage": 0}
+                      "udp_trailing_garbage": 0, "recv_ns": 0}
         if telemetry is None:
             from deepflow_tpu.telemetry import Telemetry
             telemetry = Telemetry("server", enabled=False)
@@ -308,6 +311,7 @@ class Receiver:
                     if not data:
                         return
                     idle_deadline = time.monotonic() + 60.0
+                    t0 = time.perf_counter_ns()
                     try:
                         frames = []
                         for h, p in dec.feed(data):
@@ -329,6 +333,9 @@ class Receiver:
                                           reason="bad_frame")
                         log.warning("dropping connection: %s", e)
                         return
+                    finally:
+                        recv.stats["recv_ns"] += (
+                            time.perf_counter_ns() - t0)
                     # ack EAGERLY (the moved-watermark check inside
                     # rate-limits): under fault injection a connection
                     # may only live a few ms, and an interval-gated ack

@@ -12,10 +12,12 @@ from __future__ import annotations
 import contextlib
 import os
 import threading
+import time
 from dataclasses import dataclass, field
 
 import numpy as np
 
+from deepflow_tpu.native import ArenaStrings
 from deepflow_tpu.store.dictionary import Dictionary
 
 _DTYPES = {
@@ -91,6 +93,7 @@ class ColumnarTable:
         self._lock = threading.Lock()  # guards _chunks, rows_written,
         # dicts swap (compaction) and stripe creation
         self.rows_written = 0
+        self.dict_ns = 0  # ns spent dictionary-encoding (bench stage stat)
         # per-table fill overrides: the value a column takes when a write
         # omits it (and when load() backfills chunks persisted before the
         # column existed), instead of the schema default. Set once at
@@ -187,9 +190,21 @@ class ColumnarTable:
         a buffer segment. Returns (dictionary used, segment) — the caller
         re-encodes if a compaction swapped the dictionary in between."""
         d = self.dicts[name]
-        if isinstance(v, (list, np.ndarray)):
-            return d, d.encode_batch(v)
-        return d, np.full(n, d.encode(v), dtype=np.uint32)
+        t0 = time.perf_counter_ns()
+        if isinstance(v, ArenaStrings):
+            # native decoder output: intern (arena, off, len) cells in C++
+            # without materializing Python strings
+            seg = d.encode_arena(v.arena, v.off, v.lens)
+            if seg is None:  # native unavailable / mirror retired
+                seg = d.encode_batch(v.tolist())
+        elif isinstance(v, (list, np.ndarray)):
+            seg = d.encode_batch(v)
+        else:
+            seg = np.full(n, d.encode(v), dtype=np.uint32)
+        # bench stat (per-stage ingest breakdown); plain add — a lost
+        # update under contention skews a counter, not data
+        self.dict_ns += time.perf_counter_ns() - t0
+        return d, seg
 
     def append_rows(self, rows: list[dict]) -> None:
         """Append a batch of row dicts. Missing columns take the default."""
@@ -220,7 +235,7 @@ class ColumnarTable:
         if n is None:
             n = len(next(iter(cols.values())))
         for name, v in cols.items():
-            if isinstance(v, (list, np.ndarray)) and len(v) != n:
+            if isinstance(v, (list, np.ndarray, ArenaStrings)) and len(v) != n:
                 raise ValueError(
                     f"{self.name}: column {name!r} has {len(v)} values, "
                     f"expected {n}")

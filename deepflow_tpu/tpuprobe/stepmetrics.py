@@ -41,7 +41,10 @@ def decode_step_payload(payload: bytes) -> dict:
     """Raises ValueError on malformed payloads (decode_error for the
     decoder's ledger)."""
     try:
-        obj = json.loads(payload)
+        # zero-copy receive hands decoders memoryviews; json wants bytes
+        obj = json.loads(payload if isinstance(payload, (bytes, bytearray,
+                                                         str))
+                         else bytes(payload))
     except (UnicodeDecodeError, json.JSONDecodeError) as e:
         raise ValueError(f"bad STEP_METRICS payload: {e}") from None
     if not isinstance(obj, dict) or obj.get("v") != STEP_PAYLOAD_VERSION:

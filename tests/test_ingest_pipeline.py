@@ -197,3 +197,350 @@ def test_multi_worker_ingest_no_loss_no_dup():
         assert dec.stats["rows"] == total
     finally:
         server.stop()
+
+
+# -- round 11: whole-hot-path golden parity -----------------------------------
+# Every decoder migrated to native columnar decode (L4 flow logs, metrics
+# documents, TPU spans) gets the same treatment the L7 path got above: the
+# SAME payload through the native path and the DF_NO_NATIVE pb fallback
+# must store identical rows. The native arm poisons the pb parser so a
+# silent fallback can't make the comparison vacuous (pb vs pb).
+
+
+def _poison(monkeypatch, batch_cls):
+    """Make the pb fallback parser blow up: proves the native arm really
+    decoded natively instead of quietly comparing pb against pb."""
+    def boom(_payload):
+        raise AssertionError("pb fallback used on the native arm")
+    monkeypatch.setattr(batch_cls, "FromString", staticmethod(boom))
+
+
+def _rich_l4_batch() -> pb.FlowLogBatch:
+    """L4 rows exercising every parity-sensitive field: close_type
+    strings, tunnel keys, agent pods, zero and maxed counters."""
+    batch = pb.FlowLogBatch()
+    closes = ["fin", "rst", "timeout", "forced", ""]
+    for i in range(5):
+        f = batch.l4.add()
+        f.flow_id = 500 + i
+        f.key.ip_src = socket.inet_aton(f"10.3.0.{i + 1}")
+        f.key.ip_dst = socket.inet_aton("10.4.0.7")
+        f.key.port_src = 50000 + i
+        f.key.port_dst = 443
+        f.key.proto = 1
+        f.key.tap_port = i
+        f.key.tunnel_type = 2 if i == 1 else 0
+        f.key.tunnel_id = 77 if i == 1 else 0
+        f.start_time_ns = 10**18 + i * 1000
+        f.end_time_ns = 10**18 + i * 1000 + 5_000_000
+        f.packet_tx = 10 + i
+        f.packet_rx = 20 + i
+        f.byte_tx = (1 << 40) + i  # >u32: column must be u64 end to end
+        f.byte_rx = 2000 + i
+        f.l7_request = i
+        f.l7_response = i
+        f.rtt_us = 150 + i
+        f.art_us = 90 + i
+        f.retrans_tx = i
+        f.retrans_rx = 0
+        f.zero_win_tx = 1 if i == 2 else 0
+        f.zero_win_rx = 0
+        f.close_type = closes[i]
+        f.tcp_flags_bit_tx = 0b10110
+        f.tcp_flags_bit_rx = 0b10010
+        f.syn_count = 1
+        f.synack_count = 1
+        f.gpid_0 = 600 + i
+        f.gpid_1 = 601 + i
+        if i == 3:
+            f.pod_0 = "client-pod"
+            f.pod_1 = "server-pod"
+    return batch
+
+
+@pytest.mark.skipif(not native.available(), reason="libdfnative.so required")
+def test_l4_native_fallback_parity(monkeypatch):
+    from deepflow_tpu.server.decoders import FlowLogDecoder
+    payload = _rich_l4_batch().SerializeToString()
+
+    def run(kill_native: bool) -> list[dict]:
+        if kill_native:
+            monkeypatch.setenv("DF_NO_NATIVE", "1")
+        else:
+            monkeypatch.delenv("DF_NO_NATIVE", raising=False)
+            _poison(monkeypatch, pb.FlowLogBatch)
+        db = Database()
+        dec = FlowLogDecoder(queue.Queue(), db, PlatformInfoTable())
+        n = dec.handle(FrameHeader(MessageType.L4_LOG, agent_id=3), payload)
+        assert n == 5
+        monkeypatch.undo()
+        return _dump_rows(db, "flow_log.l4_flow_log")
+
+    rows_native = run(False)
+    rows_pb = run(True)
+    assert len(rows_native) == 5
+    assert rows_native == rows_pb
+    by_id = {r["flow_id"]: r for r in rows_native}
+    assert by_id[500]["close_type"] == 1  # enum column: fin
+    assert by_id[504]["close_type"] == 0  # "" -> unknown
+    assert by_id[501]["tunnel_type"] == 2
+    assert by_id[500]["byte_tx"] == (1 << 40)
+    assert by_id[503]["pod_0"] == "client-pod"
+
+
+def _rich_doc_batch() -> pb.DocumentBatch:
+    """Documents exercising the metrics parity surface: flow-only,
+    app-only and both-meter docs, empty ip bytes (must store "", not
+    0.0.0.0), empty vs set app_service, zero and large meter values."""
+    batch = pb.DocumentBatch()
+    for i in range(7):
+        d = batch.docs.add()
+        d.timestamp_s = 1_700_000_000 + i
+        if i != 3:  # doc3: absent ip_src stays "" in the store
+            d.tag.ip_src = socket.inet_aton(f"10.5.0.{i + 1}")
+        d.tag.ip_dst = socket.inet_aton("10.6.0.2")
+        d.tag.port = 8080 + i
+        d.tag.proto = 1
+        d.tag.direction = i % 2
+        d.tag.gpid_0 = 300 + i
+        d.tag.gpid_1 = 301 + i
+        if i % 3 != 1:  # flow meter on docs 0,2,3,5,6
+            m = d.flow_meter
+            m.packet_tx = 100 + i
+            m.packet_rx = 200 + i
+            m.byte_tx = (1 << 41) + i
+            m.byte_rx = 4000 + i
+            m.flow_count = 3
+            m.new_flow = 1
+            m.closed_flow = 1
+            m.rtt_sum_us = 900 + i
+            m.rtt_count = 2
+            m.retrans = i
+            m.syn_count = 1
+            m.synack_count = 1
+        if i % 3 != 2:  # app meter on docs 0,1,3,4,6
+            d.tag.l7_protocol = pb.HTTP1
+            d.tag.app_service = f"svc-{i}" if i % 2 else ""
+            a = d.app_meter
+            a.request = 50 + i
+            a.response = 49 + i
+            a.rrt_sum_us = 7_000 + i
+            a.rrt_count = 49 + i
+            a.rrt_max_us = 800 + i
+            a.error_client = i
+            a.error_server = 0
+            a.timeout = 1 if i == 4 else 0
+    return batch
+
+
+def _decode_metrics(payload, kill_native: bool, monkeypatch,
+                    poison: bool = True):
+    from deepflow_tpu.server.decoders import MetricsDecoder
+    if kill_native:
+        monkeypatch.setenv("DF_NO_NATIVE", "1")
+    else:
+        monkeypatch.delenv("DF_NO_NATIVE", raising=False)
+        if poison:
+            _poison(monkeypatch, pb.DocumentBatch)
+    db = Database()
+    dec = MetricsDecoder(queue.Queue(), db, PlatformInfoTable())
+    n = dec.handle(FrameHeader(MessageType.METRICS, agent_id=5), payload)
+    monkeypatch.undo()
+    return (n, _dump_rows(db, "flow_metrics.network.1s"),
+            _dump_rows(db, "flow_metrics.application.1s"))
+
+
+@pytest.mark.skipif(not native.available(), reason="libdfnative.so required")
+def test_metrics_native_fallback_parity(monkeypatch):
+    payload = _rich_doc_batch().SerializeToString()
+    n_nat, net_nat, app_nat = _decode_metrics(payload, False, monkeypatch)
+    n_pb, net_pb, app_pb = _decode_metrics(payload, True, monkeypatch)
+    assert n_nat == n_pb == 5 + 5  # flow docs + app docs
+    assert net_nat == net_pb
+    assert app_nat == app_pb
+    # spot-check the parity traps actually landed
+    empties = [r for r in net_nat if r["ip_src"] == ""]
+    assert len(empties) == 1  # doc3: "" (absent bytes), never "0.0.0.0"
+    assert not any(r["ip_src"] == "0.0.0.0" for r in net_nat)
+    assert {r["app_service"] for r in app_nat} == \
+        {"", "svc-1", "svc-3"}  # empty AND set services
+    assert any(r["byte_tx"] == (1 << 41) for r in net_nat)
+    assert any(r["timeout"] == 1 for r in app_nat)
+
+
+@pytest.mark.skipif(not native.available(), reason="libdfnative.so required")
+def test_metrics_v6_batch_takes_fallback_identically(monkeypatch):
+    """A single v6 address routes the WHOLE batch down the pb path on
+    both arms (IP_FALLBACK gate) — v6 formatting parity stays exact by
+    staying in one implementation."""
+    batch = _rich_doc_batch()
+    d = batch.docs.add()
+    d.timestamp_s = 1_700_000_100
+    d.tag.ip_src = socket.inet_pton(socket.AF_INET6, "2001:db8::1")
+    d.tag.ip_dst = socket.inet_aton("10.6.0.2")
+    d.tag.port = 9999
+    d.flow_meter.packet_tx = 1
+    payload = batch.SerializeToString()
+    n_nat, net_nat, app_nat = _decode_metrics(payload, False, monkeypatch,
+                                              poison=False)
+    n_pb, net_pb, app_pb = _decode_metrics(payload, True, monkeypatch)
+    assert n_nat == n_pb
+    assert net_nat == net_pb and app_nat == app_pb
+    assert any(r["ip_src"] == "2001:db8::1" for r in net_nat)
+
+
+def _rich_span_batch() -> pb.TpuSpanBatch:
+    """Spans + memory samples: empty vs set strings, slice_id 0 (agent
+    tag fills) vs labeled, collectives, u64-range counters."""
+    batch = pb.TpuSpanBatch()
+    for i in range(4):
+        s = batch.spans.add()
+        s.start_ns = 10**18 + i * 10_000
+        s.duration_ns = 5_000 + i
+        s.device_id = i
+        s.chip_id = i // 2
+        s.core_id = i % 2
+        s.slice_id = 2 if i == 1 else 0
+        s.hlo_module = "jit_train_step" if i != 2 else ""
+        s.hlo_op = f"fusion.{i}"
+        s.hlo_category = "convolution" if i % 2 else ""
+        s.kind = pb.DEVICE_COLLECTIVE if i == 3 else pb.DEVICE_COMPUTE
+        s.flops = (1 << 42) + i
+        s.bytes_accessed = 1 << 33
+        s.program_id = 9
+        s.run_id = 40 + i
+        if i == 3:
+            s.collective = "all-reduce"
+            s.bytes_transferred = 1 << 30
+            s.replica_group_size = 8
+        s.step = 1000 + i
+        s.pid = 4242
+        s.process_name = "train.py" if i != 2 else ""
+    for j in range(2):
+        m = batch.memory.add()
+        m.timestamp_ns = 10**18 + j
+        m.device_id = j
+        m.bytes_in_use = (1 << 34) + j
+        m.peak_bytes_in_use = 1 << 35
+        m.bytes_limit = 1 << 36
+        m.largest_free_block = 1 << 20
+        m.num_allocs = 17 + j
+        m.pid = 4242
+        m.process_name = "train.py" if j == 0 else ""
+    return batch
+
+
+@pytest.mark.skipif(not native.available(), reason="libdfnative.so required")
+def test_tpuspan_native_fallback_parity(monkeypatch):
+    from deepflow_tpu.server.decoders import TpuSpanDecoder
+    payload = _rich_span_batch().SerializeToString()
+
+    def run(kill_native: bool):
+        if kill_native:
+            monkeypatch.setenv("DF_NO_NATIVE", "1")
+        else:
+            monkeypatch.delenv("DF_NO_NATIVE", raising=False)
+            _poison(monkeypatch, pb.TpuSpanBatch)
+        db = Database()
+        dec = TpuSpanDecoder(queue.Queue(), db, PlatformInfoTable())
+        n = dec.handle(FrameHeader(MessageType.TPU_SPAN, agent_id=4),
+                       payload)
+        assert n == 4 + 2
+        monkeypatch.undo()
+        return (_dump_rows(db, "profile.tpu_hlo_span"),
+                _dump_rows(db, "profile.tpu_memory"))
+
+    spans_nat, mem_nat = run(False)
+    spans_pb, mem_pb = run(True)
+    assert len(spans_nat) == 4 and len(mem_nat) == 2
+    assert spans_nat == spans_pb
+    assert mem_nat == mem_pb
+    by_op = {r["hlo_op"]: r for r in spans_nat}
+    assert by_op["fusion.1"]["slice_id"] == 2  # span label wins
+    assert by_op["fusion.2"]["hlo_module"] == ""
+    assert by_op["fusion.3"]["collective"] == "all-reduce"
+    assert by_op["fusion.3"]["app_service"] == "train.py"
+    assert {r["process_name"] for r in mem_nat} == {"train.py", ""}
+
+
+def test_stepmetrics_payload_bytes_vs_memoryview():
+    """The zero-copy receiver hands decoders memoryview payloads; the
+    STEP_METRICS stage is deliberately python/JSON (docs/INGEST.md) and
+    must decode a view byte-identically to the bytes it views."""
+    from deepflow_tpu.server.decoders import StepMetricsDecoder
+    from deepflow_tpu.tpuprobe.stepmetrics import (decode_step_payload,
+                                                   encode_step_payload)
+    payload = encode_step_payload([{
+        "time": 10**18, "end_ns": 10**18 + 900, "latency_ns": 900,
+        "run_id": 11, "step": 7, "job": "mv", "device_count": 4,
+        "device_skew_ns": 5, "compute_ns": 600, "collective_ns": 300,
+        "straggler_device": 2, "straggler_lag_ns": 5,
+        "top_hlos": [["fusion.9", 400]]}])
+    assert decode_step_payload(memoryview(payload)) == \
+        decode_step_payload(payload)
+
+    def run(p):
+        db = Database()
+        dec = StepMetricsDecoder(queue.Queue(), db, PlatformInfoTable())
+        assert dec.handle(
+            FrameHeader(MessageType.STEP_METRICS, agent_id=2), p) == 1
+        return _dump_rows(db, "profile.tpu_step_metrics")
+
+    assert run(memoryview(payload)) == run(bytes(payload))
+
+
+@pytest.mark.skipif(not native.available(), reason="libdfnative.so required")
+def test_zero_copy_chaos_exactly_once_high():
+    """Chaos arm over the zero-copy receiver: seeded connection resets
+    and partial frame writes force retransmits and recv-boundary frame
+    splits (the StreamDecoder tail-merge path), yet every HIGH
+    STEP_METRICS frame must land exactly once and the sender ledger
+    must balance — the zero-copy rework cannot weaken the delivery
+    contract the pb-era receiver honored."""
+    import tempfile
+
+    from deepflow_tpu.agent.sender import UniformSender
+    from deepflow_tpu.agent.spool import Spool
+    from deepflow_tpu.chaos import ChaosConfig, ChaosInjector
+    from deepflow_tpu.server.server import Server
+    from deepflow_tpu.telemetry import Telemetry
+    from deepflow_tpu.tpuprobe.stepmetrics import encode_step_payload
+
+    chaos = ChaosInjector(ChaosConfig(
+        enabled=True, seed=11, conn_reset=0.05, partial_write=0.10))
+    tel = Telemetry("agent", enabled=True)
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0).start()
+    n = 150
+    try:
+        sender = UniformSender(
+            [("127.0.0.1", server.ingest_port)], agent_id=21,
+            spool=Spool(tempfile.mkdtemp(prefix="df-test-zc-spool-")),
+            telemetry=tel, chaos=chaos).start()
+        for i in range(1, n + 1):
+            assert sender.send(MessageType.STEP_METRICS, encode_step_payload(
+                [{"time": i * 1000, "end_ns": i * 1000 + 10,
+                  "latency_ns": 10, "run_id": 9, "step": i, "job": "zc",
+                  "device_count": 1, "device_skew_ns": 0, "compute_ns": 1,
+                  "collective_ns": 1, "straggler_device": 0,
+                  "straggler_lag_ns": 0, "top_hlos": []}]))
+        # drain THROUGH the chaos schedule first: retransmit timers and
+        # spool replays converge inside flush, not on the server side
+        sender.flush_and_stop(timeout=60.0)
+        assert server.wait_for_rows("profile.tpu_step_metrics", n,
+                                    timeout=30.0)
+        rows = _dump_rows(server.db, "profile.tpu_step_metrics")
+        keys = [(r["run_id"], r["step"]) for r in rows]
+        assert len(keys) == n and len(set(keys)) == n  # exactly once
+        # the chaos schedule really exercised the recovery machinery
+        faults = chaos.stats["conn_reset"] + chaos.stats["partial_writes"]
+        assert faults > 0 and sender.stats["retransmits"] > 0
+        for h in tel.snapshot()["pipeline"]:
+            if h["hop"] == "sender":
+                assert h["emitted"] == h["delivered"] \
+                    + h["dropped_total"] + h["in_flight"], h
+                assert h["emitted"] == n and h["dropped_total"] == 0
+                break
+        else:
+            raise AssertionError("no sender hop ledger")
+    finally:
+        server.stop()

@@ -12,7 +12,8 @@ def test_dictionary():
     assert d.encode("alpha") == a != b
     assert d.decode(b) == "beta"
     assert d.lookup("nope") is None
-    ids = d.encode_many(["alpha", "beta", "alpha"])
+    ids = d.encode_batch(["alpha", "beta", "alpha"])
+    assert isinstance(ids, np.ndarray) and ids.dtype == np.uint32
     assert ids.tolist() == [a, b, a]
     assert d.decode_many(ids) == ["alpha", "beta", "alpha"]
     m = d.match_ids(lambda s: s.startswith("a"))
