@@ -2,7 +2,7 @@ PYTHON ?= python
 JAX_ENV := env JAX_PLATFORMS=cpu
 
 .PHONY: test selfmon-check cluster-check steps-check chaos-check ha-check \
-	query-check ingest-check bench native
+	query-check ingest-check storage-check bench native
 
 test:
 	timeout -k 10 870 $(JAX_ENV) $(PYTHON) -m pytest tests/ -q -m 'not slow' \
@@ -52,6 +52,15 @@ query-check:
 # CI host can't fail a fast code path) with zero drops on both arms.
 ingest-check:
 	timeout -k 10 300 $(JAX_ENV) $(PYTHON) -m deepflow_tpu.cli.ingest_check
+
+# Durable-write SIGKILL gate for the tiered store: a subprocess server
+# with --storage is killed mid-stream; exits non-zero unless every
+# pre-kill ACKED frame survives the crash from on-disk segments and all
+# frames land exactly once after a restart on the same data_dir, then
+# a TTL sweep must evict the aged segments with every dropped row
+# ledgered under segment_evict (drops observed, never silent).
+storage-check:
+	timeout -k 10 300 $(JAX_ENV) $(PYTHON) -m deepflow_tpu.cli.storage_check
 
 bench:
 	$(JAX_ENV) $(PYTHON) bench.py

@@ -213,7 +213,8 @@ def _make_l7_frame():
 def _run_ingest(make_frame, n_batches: int = 400,
                 workers: int | None = None,
                 selfmon: bool | None = None,
-                no_native: bool = False) -> dict:
+                no_native: bool = False,
+                storage_dir: str | None = None) -> dict:
     """Send n_batches pre-serialized frames through the real receiver ->
     decoder -> columnar store; returns rows/s plus the per-stage split
     (recv parse, payload decode, dictionary encode, store write) so the
@@ -228,7 +229,10 @@ def _run_ingest(make_frame, n_batches: int = 400,
         os.environ["DF_NO_NATIVE"] = "1"
     try:
         server = Server(host="127.0.0.1", ingest_port=0, query_port=0,
-                        ingest_workers=workers, selfmon=selfmon)
+                        ingest_workers=workers, selfmon=selfmon,
+                        data_dir=storage_dir,
+                        storage=storage_dir is not None,
+                        flush_interval_s=0.2)
         server.start()
         try:
             frame, table_name, msg_type = make_frame()
@@ -660,6 +664,131 @@ def _bench_query() -> dict:
     return out
 
 
+def _bench_storage() -> dict:
+    """Tiered-storage arm: flush throughput into on-disk columnar
+    segments, cold-mmap vs warm scans over a recovered tier, the
+    long-range rollup-datasource speedup (gated >= 10x AND
+    byte-identical vs the raw scan — a wrong fast answer fails, not
+    ships), and the ingest cost of running with flushing on (gated
+    < 5% vs the same-process no-storage arm)."""
+    import shutil
+    import tempfile
+
+    from deepflow_tpu.query import datasource as qds
+    from deepflow_tpu.query import execute
+    from deepflow_tpu.query import sql as S
+    from deepflow_tpu.server.datasource import RollupJob
+    from deepflow_tpu.store import Database
+
+    out: dict = {}
+    data_dir = tempfile.mkdtemp(prefix="dfbench-storage-")
+    ingest_dir = tempfile.mkdtemp(prefix="dfbench-storage-ing-")
+    # 6 hours of raw 1s rows, 8 per second: long-range enough that the
+    # 1h rollup answer (8 hosts x 6 buckets) scans ~3600x fewer rows,
+    # so the >= 10x gate measures the tier, not parse/plan overhead
+    t0 = 1_754_000_000 // 3600 * 3600
+    span = 6 * 3600
+    per_sec = 8
+    raw_name = "flow_metrics.network.1s"
+    try:
+        db = Database(data_dir=data_dir, storage=True)
+        table = db.table(raw_name)
+        rows = [{"ip_src": f"10.0.{h}.1", "ip_dst": "10.9.9.9",
+                 "server_port": 443, "protocol": 1, "host": f"host-{h}",
+                 "byte_tx": 100 + (s + h) % 1000,
+                 "packet_tx": 1 + s % 7,
+                 "rtt_sum": 10 + s % 50, "rtt_count": 1,
+                 "time": t0 + s}
+                for s in range(span)
+                for h in range(per_sec)]
+        for i in range(0, len(rows), 10_000):
+            table.append_rows(rows[i:i + 10_000])
+        t_flush = time.perf_counter()
+        flushed = db.flush_to_tier()
+        flush_dt = time.perf_counter() - t_flush
+        snap = db.tier_store.snapshot()["tables"][raw_name]
+        out["storage_flush_rows_per_sec"] = round(flushed / flush_dt) \
+            if flush_dt else 0
+        out["storage_flush_rows"] = flushed
+        out["storage_segments"] = snap["segments"]
+        out["storage_segment_bytes"] = snap["bytes"]
+
+        # recovery + scans: a FRESH db over the same dir re-opens the
+        # manifest's segments; the first scan pays the mmap page-ins and
+        # chunk-cache build, repeats ride the warm mapping
+        sql = (f"SELECT host, Sum(byte_tx) AS b, Sum(packet_tx) AS p "
+               f"FROM t WHERE time >= {t0} AND time < {t0 + span} "
+               f"GROUP BY host ORDER BY host")
+        db2 = Database(data_dir=data_dir, storage=True)
+        db2.load()  # adopt the recovered segments into table scans
+        raw = db2.table(raw_name)
+        t_cold = time.perf_counter()
+        cold_vals = execute(raw, sql).values
+        cold_ms = (time.perf_counter() - t_cold) * 1e3
+        warm = []
+        for _ in range(5):
+            t1 = time.perf_counter()
+            warm_vals = execute(raw, sql).values
+            warm.append((time.perf_counter() - t1) * 1e3)
+        warm_ms = statistics.median(warm)
+        out["storage_scan_cold_ms"] = round(cold_ms, 2)
+        out["storage_scan_warm_ms"] = round(warm_ms, 2)
+        out["storage_scan_rows"] = len(raw)
+
+        # long-range rollup datasource: the SAME sql answered from the
+        # 1h tier via transparent selection, gated on a >= 10x speedup
+        # over the warm raw scan AND byte-identical values
+        job = RollupJob(db2, lateness_s=0)
+        job.roll(now_s=t0 + span)
+        picked = qds.select_rollup(db2, raw, S.parse(sql),
+                                   job.horizons())
+        if picked is None:
+            out["storage_rollup_speedup"] = 0.0
+            out["storage_rollup_matches_raw"] = False
+            out["storage_rollup_below_target"] = True
+            out["storage_rollup_tier"] = None
+        else:
+            rtable, info = picked
+            roll = []
+            roll_vals = None
+            for _ in range(7):
+                t1 = time.perf_counter()
+                roll_vals = execute(rtable, sql).values
+                roll.append((time.perf_counter() - t1) * 1e3)
+            roll_ms = statistics.median(roll)
+            out["storage_rollup_tier"] = info["tier"]
+            out["storage_rollup_ms"] = round(roll_ms, 3)
+            out["storage_rollup_speedup"] = round(warm_ms / roll_ms, 1) \
+                if roll_ms else 0.0
+            out["storage_rollup_matches_raw"] = \
+                roll_vals == warm_vals == cold_vals
+            out["storage_rollup_below_target"] = (
+                out["storage_rollup_speedup"] < 10.0
+                or not out["storage_rollup_matches_raw"])
+
+        # ingest cost of flushing: same frames, same process, the only
+        # delta is --storage (durability gate + background flusher).
+        # Best-of-2 per arm to damp scheduler noise; relative gate.
+        base = max(_run_ingest(_make_l4_frame)["rows_per_sec"]
+                   for _ in range(2))
+        stor = 0
+        for _ in range(2):
+            # fresh dir per run: recovering the previous run's segments
+            # would pre-fill the table and fake the throughput
+            d = tempfile.mkdtemp(prefix="dfbench-", dir=ingest_dir)
+            stor = max(stor, _run_ingest(
+                _make_l4_frame, storage_dir=d)["rows_per_sec"])
+        pct = (1.0 - stor / base) * 100.0 if base else 0.0
+        out["storage_ingest_rows_per_sec"] = stor
+        out["storage_ingest_baseline_rows_per_sec"] = base
+        out["storage_ingest_overhead_pct"] = round(pct, 1)
+        out["storage_ingest_overhead_above_gate"] = pct > 5.0
+    finally:
+        shutil.rmtree(data_dir, ignore_errors=True)
+        shutil.rmtree(ingest_dir, ignore_errors=True)
+    return out
+
+
 _BUSY_C = """
 static unsigned long v;
 __attribute__((noinline)) void busy_leaf(void) {
@@ -954,6 +1083,7 @@ def main() -> None:
     cpu_detail.update(_bench_steps())
     cpu_detail.update(_bench_federation())
     cpu_detail.update(_bench_query())
+    cpu_detail.update(_bench_storage())
     cpu_detail.update(_bench_extprofiler())
     # perf guards (VERDICT r03 item 5 / r04 item 8): a regression must be
     # visible in-round, not discovered by the next judge

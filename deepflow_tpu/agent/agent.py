@@ -97,7 +97,8 @@ class Agent:
             tempfile.gettempdir(),
             f"deepflow-spool-{self.config.agent_id}")
         return Spool(directory, max_bytes=sc.max_mb << 20,
-                     segment_bytes=sc.segment_mb << 20)
+                     segment_bytes=sc.segment_mb << 20,
+                     max_age_s=sc.max_age_s)
 
     def _build_spool_factory(self):
         """Replicated transport: one spool SUBDIRECTORY per destination
@@ -115,7 +116,8 @@ class Agent:
         def factory(dest_key: str):
             return Spool(os.path.join(base, dest_key),
                          max_bytes=sc.max_mb << 20,
-                         segment_bytes=sc.segment_mb << 20)
+                         segment_bytes=sc.segment_mb << 20,
+                         max_age_s=sc.max_age_s)
 
         return factory
 

@@ -86,6 +86,10 @@ class SpoolConfig:
     dir: str = ""                 # "" = <tmpdir>/deepflow-spool-<agent_id>
     max_mb: int = 64              # oldest-segment eviction past this
     segment_mb: int = 4
+    # age retention: closed segments older than this are evicted (0 =
+    # size-only). Bounds how stale a replayed backlog can be after a
+    # long server outage; evictions ledger as dropped(spool_age_evict).
+    max_age_s: float = 0.0
 
 
 @dataclass
@@ -337,6 +341,9 @@ _TEMPLATE_DOCS = {
     "sender.spool.max_mb": "spool cap; oldest segment evicted (and "
                            "ledgered as dropped) past this",
     "sender.spool.segment_mb": "rotate segment files at this size",
+    "sender.spool.max_age_s": "evict closed segments older than this "
+                              "(dropped(spool_age_evict)); 0 = "
+                              "size-only retention",
     "chaos.enabled": "transport fault injection (tests only); the "
                      "DF_CHAOS env spec overrides this block",
     "chaos.seed": "PRNG seed — same seed, same fault schedule",

@@ -28,6 +28,7 @@ import logging
 import os
 import struct
 import threading
+import time
 import zlib
 
 log = logging.getLogger("df.spool")
@@ -46,7 +47,8 @@ class _Segment:
     can write an older in-flight seq after newer overflow spills), so
     trim/replay decisions must use the true range, not arrival order."""
 
-    __slots__ = ("path", "first_seq", "last_seq", "records", "bytes")
+    __slots__ = ("path", "first_seq", "last_seq", "records", "bytes",
+                 "mtime")
 
     def __init__(self, path: str, first_seq: int) -> None:
         self.path = path
@@ -54,6 +56,7 @@ class _Segment:
         self.last_seq = first_seq
         self.records = 0
         self.bytes = 0
+        self.mtime = time.time()  # wall clock of the last append
 
     def note(self, seq: int) -> None:
         if self.records == 0:
@@ -69,9 +72,14 @@ class Spool:
 
     def __init__(self, directory: str, max_bytes: int = 64 << 20,
                  segment_bytes: int = 4 << 20, on_evict=None,
-                 chaos=None) -> None:
+                 chaos=None, max_age_s: float = 0) -> None:
         self.dir = directory
         self.max_bytes = max_bytes
+        # age-based retention (0 = size-only): whole CLOSED segments
+        # older than this are evicted — stale spooled frames describe a
+        # past the operator may no longer want replayed after a long
+        # outage. Checked on append and trim; visible as spool_age_evict.
+        self.max_age_s = max(0.0, float(max_age_s))
         # a segment must be well under the cap or eviction (whole
         # oldest segments, never the open writer) could not enforce it
         self.segment_bytes = max(4096, min(segment_bytes, max_bytes // 2))
@@ -127,6 +135,10 @@ class Spool:
                     pass
                 continue
             seg.bytes = good_end
+            try:  # restart: age continues from the file's last write
+                seg.mtime = os.path.getmtime(path)
+            except OSError:
+                pass
             self._segments.append(seg)
             self.stats["recovered"] += seg.records
         self._segments.sort(key=lambda s: s.first_seq)
@@ -153,6 +165,7 @@ class Spool:
             seg = self._segments[-1]
             seg.note(seq)
             seg.bytes += len(rec)
+            seg.mtime = time.time()
             self.stats["appended"] += 1
             self._enforce_cap()
             return True
@@ -178,15 +191,28 @@ class Spool:
         """Oldest-segment eviction: bounded disk, bounded (visible) loss."""
         total = sum(s.bytes for s in self._segments)
         while total > self.max_bytes and len(self._segments) > 1:
-            victim = self._segments.pop(0)
-            total -= victim.bytes
-            self.stats["evicted"] += victim.records
-            try:
-                os.unlink(victim.path)
-            except OSError:
-                self.stats["disk_errors"] += 1
-            if self.on_evict is not None:
-                self.on_evict(victim.records, "spool_evict")
+            total -= self._evict_oldest("spool_evict")
+        self._enforce_age()
+
+    def _enforce_age(self) -> None:
+        if not self.max_age_s:
+            return
+        cutoff = time.time() - self.max_age_s
+        # never the open writer (last segment): its mtime still moves
+        while len(self._segments) > 1 and \
+                self._segments[0].mtime < cutoff:
+            self._evict_oldest("spool_age_evict")
+
+    def _evict_oldest(self, reason: str) -> int:
+        victim = self._segments.pop(0)
+        self.stats["evicted"] += victim.records
+        try:
+            os.unlink(victim.path)
+        except OSError:
+            self.stats["disk_errors"] += 1
+        if self.on_evict is not None:
+            self.on_evict(victim.records, reason)
+        return victim.bytes
 
     # -- replay / trim -------------------------------------------------------
 
@@ -241,6 +267,9 @@ class Spool:
                 except OSError:
                     self.stats["disk_errors"] += 1
             self.stats["trimmed"] += released
+            # acks arrive while appends may have stopped (idle agent):
+            # trim is the other periodic touch point for age retention
+            self._enforce_age()
         return released
 
     # -- introspection -------------------------------------------------------

@@ -124,6 +124,13 @@ def main(argv: list[str] | None = None) -> int:
     p_query.add_argument("--org", type=int, default=None,
                          help="scope results to this org id")
 
+    p_ds = sub.add_parser(
+        "datasources", help="tiered storage view: per-table segment "
+                            "counts, on-disk bytes, time spans and "
+                            "rollup completeness horizons")
+    p_ds.add_argument("--json", action="store_true",
+                      help="raw /v1/health storage block JSON")
+
     p_org = sub.add_parser("org", help="org/team scoping: assign agent "
                                        "groups to orgs, list assignments")
     p_org.add_argument("--assign", nargs=2, metavar=("GROUP", "ORG_ID"),
@@ -507,6 +514,42 @@ def main(argv: list[str] | None = None) -> int:
         out = _api(args.server, "/v1/query/", body)
         r = out["result"]
         print_table(r["columns"], r["values"])
+    elif args.cmd == "datasources":
+        h = _api(args.server, "/v1/health")
+        st = h.get("storage")
+        if st is None:
+            print("(storage tier disabled — start the server with "
+                  "--storage)")
+            return 0
+        if args.json:
+            print(json.dumps(st, indent=2))
+            return 0
+        print(f"root: {st['root']}  flush_gen: {st['flush_gen']}  "
+              f"evict_gen: {st['evict_gen']}  "
+              f"gate_pending: {st.get('gate_pending', 0)}")
+        tables = st.get("tables", {})
+        if tables:
+            # tier = trailing datasource suffix; everything else is a
+            # raw event table (flow logs, profiles, ...)
+            tiers = ("1s", "1m", "1h", "1d")
+            rows = []
+            for name, v in sorted(tables.items()):
+                sfx = name.rsplit(".", 1)[-1]
+                rows.append([
+                    name, sfx if sfx in tiers else "raw",
+                    v["segments"], v["rows"], v["bytes"],
+                    v["tmin"] if v["tmin"] is not None else "-",
+                    v["tmax"] if v["tmax"] is not None else "-"])
+            print()
+            print_table(["TABLE", "TIER", "SEGMENTS", "ROWS", "BYTES",
+                         "TMIN", "TMAX"], rows)
+        else:
+            print("(no segments on disk yet)")
+        horizons = st.get("rollup_horizons", {})
+        if horizons:
+            print("\nrollup completeness horizons (exclusive, epoch s):")
+            print_table(["DATASOURCE", "COMPLETE_BEFORE"],
+                        [[k, v] for k, v in sorted(horizons.items())])
     elif args.cmd == "flame":
         body = {"event_type": args.event_type}
         if args.service:

@@ -18,6 +18,16 @@ The token is read BEFORE executing: a write racing the fill can only
 make the stored token stale (harmless recompute next time), never let a
 stale entry validate.
 
+Tiered storage keeps these tokens exact through persistence events by
+bumping the SAME table watermark the token reads: attaching a restored
+tier, confirming a flush (RAM chunks swapped for mmap'd segments — the
+result set is unchanged but the backing store is not), and evicting
+segments (note_tier_evict, which also marks the evicted time span so
+bucket partials over it invalidate) all advance it. Rollup appends are
+ordinary writes to the rollup TABLE, and datasource selection swaps the
+table object before the cache lookup — raw and rollup entries key
+separately, so a coarser answer can never serve a raw-table hit.
+
 Admission goes through the learned cost hook (query/costmodel.py —
 "A Learned Performance Model for TPUs" motivates modeled rather than
 hand-tuned plan choices): queries whose observed cold cost stays under

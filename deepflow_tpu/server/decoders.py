@@ -130,8 +130,14 @@ class Decoder:
                  pod_index=None, gpid_table=None,
                  workers: int | None = None, resources=None,
                  trace_trees=None, telemetry=None, dedup=None,
-                 seq_tracker=None, ring=None) -> None:
-        self.q = q
+                 seq_tracker=None, ring=None, durability=None) -> None:
+        # q: one Queue, or a LIST of lane queues (receiver connection
+        # affinity — see Receiver.register(lanes=)). With N lanes and N
+        # workers each worker owns one lane exclusively, so one hot
+        # agent's connection can never serialize its siblings.
+        self.queues: list[queue.Queue] = (
+            list(q) if isinstance(q, (list, tuple)) else [q])
+        self.q = self.queues[0]  # single-queue contract for tests/tools
         self.db = db
         self.platform = platform
         self.exporters = exporters
@@ -144,6 +150,11 @@ class Decoder:
         # after decode+write, so an ack implies store presence — a hard
         # server crash can only lose frames the agent will retransmit
         self.seq_tracker = seq_tracker
+        # DurabilityGate (optional, storage mode): seqs are PARKED here
+        # after decode+write instead of observed — the flusher releases
+        # them into seq_tracker only once the rows' tier commit landed,
+        # so an ack then implies the rows survive SIGKILL
+        self.durability = durability
         # replication (cluster/hashring.py): zero-arg callable returning
         # the current HashRing (or None). When set, every ingested row
         # is tagged with its agent's ring-primary owner_shard and the
@@ -186,16 +197,17 @@ class Decoder:
         self._threads = []
         if self._hop is None:
             return  # never started: nothing accepted, nothing to drain
-        # drain what's still queued: frames here were ACCEPTED (and, on
-        # the durable path, acked) — exiting with a non-empty queue
-        # would lose them on every restart even though the agent was
-        # told not to retransmit
+        # drain what's still queued (every lane): frames here were
+        # ACCEPTED (and, on the durable path, acked) — exiting with a
+        # non-empty queue would lose them on every restart even though
+        # the agent was told not to retransmit
         drained = []
-        while True:
-            try:
-                drained.extend(self._unwrap(self.q.get_nowait()))
-            except queue.Empty:
-                break
+        for lane_q in self.queues:
+            while True:
+                try:
+                    drained.extend(self._unwrap(lane_q.get_nowait()))
+                except queue.Empty:
+                    break
         if drained:
             self._handle_items(drained)
 
@@ -216,7 +228,14 @@ class Decoder:
                 errors += 1
                 log.exception("decode error (%s)", self.MSG_TYPE.name)
         dt = time.perf_counter_ns() - t0
-        if self.seq_tracker is not None:
+        if self.durability is not None:
+            # storage mode: park AFTER the decode/write pass; the
+            # flusher observes into the tracker post-commit (dups and
+            # decode errors park too — a retransmit meets the same fate)
+            for header, _ in items:
+                if header.seq is not None:
+                    self.durability.add(header.agent_id, header.seq)
+        elif self.seq_tracker is not None:
             # observed AFTER the decode/write pass: dups and decode
             # errors count too (a retransmit would meet the same fate)
             for header, _ in items:
@@ -251,11 +270,15 @@ class Decoder:
     def _run(self, worker_idx: int = 0) -> None:
         hb = self.telemetry.heartbeat(
             f"decoder.{self.MSG_TYPE.name}.{worker_idx}")
+        # lane affinity: worker i owns queue i (mod lanes). With
+        # lanes == workers each lane has exactly one consumer, so frame
+        # order within a connection is preserved end to end.
+        lane_q = self.queues[worker_idx % len(self.queues)]
         handled = 0
         while not self._stop.is_set():
             hb.beat(progress=handled)
             try:
-                items = self._unwrap(self.q.get(timeout=0.2))
+                items = self._unwrap(lane_q.get(timeout=0.2))
             except queue.Empty:
                 continue
             # greedy drain: the receiver enqueues LISTS of frames (one per
@@ -264,7 +287,7 @@ class Decoder:
             # siblings under WORKERS > 1
             while len(items) < self.DRAIN_FRAMES:
                 try:
-                    items = items + self._unwrap(self.q.get_nowait())
+                    items = items + self._unwrap(lane_q.get_nowait())
                 except queue.Empty:
                     break
             handled += len(items)

@@ -1,0 +1,164 @@
+"""Background tier flusher + the durability gate for ack release.
+
+This is what makes "ack after durable write" (PR 5's transport contract)
+actually mean DURABLE when persistent storage is on. Decoders stop
+observing seqs into the receiver's SeqAckTracker directly; instead they
+park them in the DurabilityGate after decode+write. Each flush cycle:
+
+  1. drain the gate (every parked seq's rows are in stripes/chunks by
+     now — the decoder parked it only after its table writes returned)
+  2. fold the drained seqs into a private floor tracker -> candidate
+     per-agent contiguous floors
+  3. db.flush_to_tier(ack_floors=floors): ONE atomic manifest commit
+     persists the rows AND the floors (store/tiered.py ordering)
+  4. only then observe the seqs into the receiver's tracker — the acks
+     that now go out describe state that survives SIGKILL
+
+A crash between any two steps is safe: rows committed but seqs not yet
+released -> the floors in the manifest already cover them, so the restart
+seeds dedup above the retransmit; rows not committed -> seqs never
+released, agent retransmits, rows are written again (the lost copy was
+RAM-only). Exactly-once either way.
+
+Without a gate (storage off) decoders keep the old direct-observe path —
+zero behavior change for in-memory servers.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+
+from deepflow_tpu.server.receiver import SeqAckTracker
+
+log = logging.getLogger("df.flusher")
+
+
+class DurabilityGate:
+    """Seqs written to RAM tables but not yet durable on disk."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._pending: list[tuple[int, int]] = []  # (agent_id, seq)
+
+    def add(self, agent_id: int, seq: int) -> None:
+        with self._lock:
+            self._pending.append((agent_id, seq))
+
+    def drain(self) -> list[tuple[int, int]]:
+        with self._lock:
+            out, self._pending = self._pending, []
+            return out
+
+    def requeue(self, items: list[tuple[int, int]]) -> None:
+        """A flush commit failed (disk full, ...): the seqs stay gated —
+        releasing them would ack rows that are not durable."""
+        with self._lock:
+            self._pending = items + self._pending
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+
+class Flusher:
+    """Periodic tier flush; owns the durable-ack release ordering."""
+
+    def __init__(self, db, gate: DurabilityGate | None = None,
+                 seq_tracker=None, interval_s: float = 1.0,
+                 telemetry=None) -> None:
+        self.db = db
+        self.gate = gate
+        self.seq_tracker = seq_tracker  # the receiver's (release target)
+        self.interval_s = interval_s
+        # private floor bookkeeping: same contiguity algebra as the
+        # receiver's tracker, but advanced BEFORE the commit so the
+        # manifest can carry the floors the release will create
+        self._floors = SeqAckTracker()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._flush_lock = threading.Lock()  # run loop vs final flush
+        # spare-core policy: zlib in the flusher thread only pays when a
+        # core is free to run it — on a single-core host the deflate
+        # serializes straight against the ingest hot path
+        self.compress = (os.cpu_count() or 1) > 1
+        self.stats = {"flushes": 0, "rows_flushed": 0, "seqs_released": 0,
+                      "errors": 0, "flush_ns": 0}
+        if telemetry is None:
+            from deepflow_tpu.telemetry import Telemetry
+            telemetry = Telemetry("server", enabled=False)
+        self._telemetry = telemetry
+
+    def seed_floors(self, floors: dict[int, int]) -> None:
+        for agent_id, contig in floors.items():
+            self._floors.seed(agent_id, contig)
+
+    def flush_once(self, seal: bool | None = None) -> int:
+        """One gate-drain + commit + release cycle (also the final drain
+        on stop). Returns rows committed.
+
+        ``seal`` controls whether open stripe buffers are force-sealed
+        into the commit. Default (None) is group-commit: seal only when
+        drained acks are actually waiting on durability — idle cycles
+        then flush naturally-sealed chunks without chopping the ingest
+        hot path's open buffers into per-interval slivers. stop() and
+        explicit callers force True."""
+        with self._flush_lock:
+            pend = self.gate.drain() if self.gate is not None else []
+            t0 = time.perf_counter_ns()
+            floors = None
+            if pend:
+                for agent_id, seq in pend:
+                    self._floors.observe(agent_id, seq)
+                floors = self._floors.snapshot()
+            if seal is None:
+                seal = bool(pend)
+            try:
+                rows = self.db.flush_to_tier(ack_floors=floors, seal=seal,
+                                             compress=self.compress)
+            except Exception:
+                self.stats["errors"] += 1
+                if pend and self.gate is not None:
+                    self.gate.requeue(pend)
+                raise
+            # release: the acks now describe durable state
+            if self.seq_tracker is not None:
+                for agent_id, seq in pend:
+                    self.seq_tracker.observe(agent_id, seq)
+            self.stats["flushes"] += 1
+            self.stats["rows_flushed"] += rows
+            self.stats["seqs_released"] += len(pend)
+            self.stats["flush_ns"] += time.perf_counter_ns() - t0
+            return rows
+
+    def start(self) -> "Flusher":
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run,
+                                        name="df-flusher", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Final flush AFTER the decoders drained: everything they wrote
+        (and parked) becomes durable and acked before the server exits."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        try:
+            self.flush_once(seal=True)
+        except Exception:
+            log.exception("final tier flush failed")
+
+    def _run(self) -> None:
+        hb = self._telemetry.heartbeat(
+            "flusher", interval_hint_s=max(1.0, self.interval_s))
+        hb.beat()
+        while not self._stop.wait(self.interval_s):
+            hb.beat(progress=self.stats["flushes"])
+            try:
+                self.flush_once()
+            except Exception:
+                log.exception("tier flush failed")

@@ -38,15 +38,21 @@ DEFAULT_TTL_S = {
 
 class Janitor:
     def __init__(self, db, ttl_s: dict | None = None,
-                 interval_s: float = 300.0, telemetry=None) -> None:
+                 interval_s: float = 300.0, telemetry=None,
+                 tier_max_bytes: int = 0) -> None:
         self.db = db
         self.ttl_s = dict(DEFAULT_TTL_S)
         if ttl_s:
             self.ttl_s.update(ttl_s)
         self.interval_s = interval_s
+        # on-disk tier size budget for the whole node (0 = TTL only);
+        # past it the globally-oldest segments go first
+        self.tier_max_bytes = max(0, int(tier_max_bytes))
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
-        self.stats = {"sweeps": 0, "rows_trimmed": 0}
+        self.stats = {"sweeps": 0, "rows_trimmed": 0,
+                      "tier_rows_evicted": 0, "tier_segments_evicted": 0,
+                      "tier_bytes_evicted": 0}
         if telemetry is None:
             from deepflow_tpu.telemetry import Telemetry
             telemetry = Telemetry("server", enabled=False)
@@ -104,9 +110,76 @@ class Janitor:
                     self.stats["dicts_compacted"] = \
                         self.stats.get("dicts_compacted", 0) + len(compacted)
             trimmed += n
+        trimmed += self.sweep_tier(now)
         self.stats["sweeps"] += 1
         self.stats["rows_trimmed"] += trimmed
         return trimmed
+
+    def _tier_drop(self, name: str, dropped: dict) -> int:
+        """Fold one tier eviction into table bookkeeping + the ledger.
+        Drops are never silent: every evicted row is accounted under
+        ``segment_evict`` so the pipeline ledger stays conserved."""
+        if not dropped["rows"] and not dropped["segments"]:
+            return 0
+        try:
+            self.db.table(name).note_tier_evict(
+                dropped["rows"], dropped["tmin"], dropped["tmax"])
+        except KeyError:
+            pass  # segments for a table this build no longer has
+        self._telemetry.hop("storage").account(
+            emitted=dropped["rows"], dropped=dropped["rows"],
+            reason="segment_evict")
+        self.stats["tier_rows_evicted"] += dropped["rows"]
+        self.stats["tier_segments_evicted"] += dropped["segments"]
+        self.stats["tier_bytes_evicted"] += dropped["bytes"]
+        log.info("janitor: evicted %d segments (%d rows, %d bytes) "
+                 "from tier %s", dropped["segments"], dropped["rows"],
+                 dropped["bytes"], name)
+        return dropped["rows"]
+
+    def sweep_tier(self, now: float) -> int:
+        """On-disk tier retention: per-table TTL (whole-segment drops —
+        the CK partition-drop analog; segments are immutable so rows are
+        never deleted in place), then the node-wide size budget taking
+        globally-oldest segments first."""
+        ts = getattr(self.db, "tier_store", None)
+        if ts is None:
+            return 0
+        evicted = 0
+        for name, ttl in self.ttl_s.items():
+            try:
+                table = self.db.table(name)
+            except KeyError:
+                continue
+            if table.tier is None or "time" not in table.columns:
+                continue
+            # same native-unit convention as the RAM trim above
+            if table.columns["time"].kind == "u64":
+                cutoff = int((now - ttl) * 1e9)
+            else:
+                cutoff = int(now - ttl)
+            evicted += self._tier_drop(name, ts.evict(name, cutoff=cutoff))
+        if self.tier_max_bytes:
+            # node budget: repeatedly drop the oldest segment of the
+            # table holding the globally-oldest data until we fit
+            while True:
+                tables = ts.snapshot()["tables"]
+                total = sum(v["bytes"] for v in tables.values())
+                if total <= self.tier_max_bytes:
+                    break
+                cand = [(v["tmin"] is None, v["tmin"], n, v["bytes"])
+                        for n, v in tables.items() if v["segments"]]
+                if not cand:
+                    break
+                cand.sort()
+                _, _, name, nbytes = cand[0]
+                # max_bytes just under the current size forces exactly
+                # the oldest segment(s) out of THIS table
+                dropped = ts.evict(name, max_bytes=max(0, nbytes - 1))
+                if not dropped["segments"]:
+                    break
+                evicted += self._tier_drop(name, dropped)
+        return evicted
 
     def _run(self) -> None:
         # interval_hint: the janitor legitimately sleeps interval_s

@@ -36,9 +36,14 @@ class QuerierAPI:
                  trace_trees=None, telemetry=None,
                  api_token: str | None = None,
                  membership=None, federation=None,
-                 shard_id: int = 0) -> None:
+                 shard_id: int = 0, storage_provider=None,
+                 rollup=None) -> None:
         self.db = db
         self.stats_provider = stats_provider or (lambda: {})
+        # tiered storage health block (server._storage_stats) + the
+        # rollup job whose horizons gate transparent datasource selection
+        self.storage_provider = storage_provider
+        self.rollup = rollup
         self.controller = controller
         self.exporters = exporters
         self.alerts = alerts
@@ -182,12 +187,31 @@ class QuerierAPI:
             return {"result": result.to_dict(),
                     "debug": {"table": table.name},
                     "federation": info}
+        debug: dict = {"table": table.name}
+        # transparent rollup datasource selection: when the query is an
+        # aligned aggregate a coarser tier answers exactly, swap the
+        # table (rollup tables share column names — the SQL text itself
+        # is reusable verbatim, and the cache keys on the table object)
+        if self.rollup is not None:
+            from deepflow_tpu.query import datasource as qds
+            sk = qds.sketch_percentile(self.db, table, select,
+                                       self.rollup.horizons())
+            if sk is not None:
+                result, info = sk
+                debug["datasource"] = info
+                return {"result": result.to_dict(), "debug": debug}
+            picked = qds.select_rollup(self.db, table, select,
+                                       self.rollup.horizons())
+            if picked is not None:
+                table, info = picked
+                debug["datasource"] = info
+                debug["table"] = table.name
         # org scoping rewrote the AST, not the text — fold it into the
         # cache key so scoped variants of one SQL string don't collide
         result = self.query_cache.execute(
             table, sql_text, select=select,
             extra_key=None if org is None else ("org", org))
-        return {"result": result.to_dict(), "debug": {"table": table.name}}
+        return {"result": result.to_dict(), "debug": debug}
 
     def profile_tracing(self, body: dict) -> dict:
         table = self.db.table("profile.in_process_profile")
@@ -1229,6 +1253,10 @@ class QuerierAPI:
             "stats": self.stats_provider(),
         }
         out["query_cache"] = self.query_cache.snapshot()
+        if self.storage_provider is not None:
+            storage = self.storage_provider()
+            if storage is not None:
+                out["storage"] = storage
         if self.membership is not None:
             out["cluster"] = {
                 "shard_id": self.shard_id,

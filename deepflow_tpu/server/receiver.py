@@ -136,6 +136,8 @@ class Receiver:
         self._handlers_lock = threading.Lock()
         self._handlers: dict[threading.Thread, socket.socket] = {}
         self._stopping = False
+        # round-robin connection -> lane assignment (register lanes > 1)
+        self._lane_counter = 0
         self._enable_udp = enable_udp
         self.ack_enabled = ack_enabled
         self.seq_tracker = SeqAckTracker()
@@ -159,12 +161,31 @@ class Receiver:
         self.telemetry = telemetry
         self._hop = telemetry.hop("receiver")
 
-    def register(self, msg_type: MessageType) -> queue.Queue:
+    def register(self, msg_type: MessageType, lanes: int = 1):
+        """Register the decoder queue(s) for one message type.
+
+        lanes > 1 returns a LIST of queues and spreads CONNECTIONS
+        across them round-robin (UDP spreads by agent id): with one
+        decoder worker pinned per lane, a single hot agent saturating
+        its connection can no longer serialize every other agent behind
+        one queue (ROADMAP item 5's multi-connection recv lever).
+        Per-agent ordering survives because one agent speaks over one
+        connection at a time, and one connection maps to one lane —
+        reconnects may switch lanes, which the seq/dedup machinery
+        already absorbs (same contract as a decoder-worker handoff)."""
         q = self._queues.get(msg_type)
         if q is None:
-            q = queue.Queue(maxsize=self._queue_size)
+            if lanes > 1:
+                q = [queue.Queue(maxsize=self._queue_size)
+                     for _ in range(lanes)]
+            else:
+                q = queue.Queue(maxsize=self._queue_size)
             self._queues[msg_type] = q
         return q
+
+    @staticmethod
+    def _lane_q(q, lane: int):
+        return q[lane % len(q)] if isinstance(q, list) else q
 
     def _observe_seqs(self, frames: list[tuple[FrameHeader, bytes]]) -> None:
         """Mark seqs as handled WITHOUT a decoder pass (policy drops like
@@ -209,6 +230,8 @@ class Receiver:
             # pressure — a retransmit would meet the same fate
             self._observe_seqs([(header, payload)])
             return
+        # UDP lane affinity is per AGENT (no connection to pin to)
+        q = self._lane_q(q, header.agent_id)
         try:
             q.put_nowait((time.monotonic_ns(), [(header, payload)]))
             self._hop.account(delivered=1)
@@ -220,11 +243,14 @@ class Receiver:
             self.stats["dropped"] += 1
             self._hop.account(dropped=1, reason="queue_full")
 
-    def _dispatch_many(self, frames: list[tuple[FrameHeader, bytes]]) -> None:
+    def _dispatch_many(self, frames: list[tuple[FrameHeader, bytes]],
+                       lane: int = 0) -> None:
         """Hand all frames parsed out of one recv() to their decoder queues
         with ONE queue.put per message type — a TCP read that carried 30
         flow-log frames used to cost 30 put_nowait round trips (and 30
-        queue.get wakeups on the decoder side); now it costs one."""
+        queue.get wakeups on the decoder side); now it costs one.
+        ``lane`` is the calling connection's affinity index (register
+        with lanes > 1 to spread connections over distinct queues)."""
         by_type: dict[MessageType, list] = {}
         for header, payload in frames:
             self.stats["frames"] += 1
@@ -242,6 +268,7 @@ class Receiver:
                 self._hop.account(dropped=len(group), reason="no_handler")
                 self._observe_seqs(group)
                 continue
+            q = self._lane_q(q, lane)
             try:
                 q.put_nowait((enq_ns, group))
                 self._hop.account(delivered=len(group))
@@ -289,6 +316,9 @@ class Receiver:
             def _serve(self, sock) -> None:
                 if recv._chaos is not None:
                     recv._chaos.on_accept()
+                with recv._handlers_lock:
+                    lane = recv._lane_counter
+                    recv._lane_counter += 1
                 dec = StreamDecoder()
                 # short read timeout: the ack writer needs to run even
                 # when the peer is quiet; idle_deadline preserves the
@@ -326,7 +356,7 @@ class Receiver:
                             if h.seq is not None:
                                 agents.add(h.agent_id)
                         if frames:
-                            recv._dispatch_many(frames)
+                            recv._dispatch_many(frames, lane)
                     except FrameDecodeError as e:
                         recv.stats["bad_frames"] += 1
                         recv._hop.account(emitted=1, dropped=1,

@@ -40,7 +40,10 @@ class Server:
                  cluster_advertise: str | None = None,
                  fanout_timeout_s: float = 5.0,
                  fanout_hedge_delay_s: float = 0.25,
-                 replication: int = 0) -> None:
+                 replication: int = 0,
+                 storage: bool = False,
+                 flush_interval_s: float = 1.0,
+                 storage_max_bytes: int = 0) -> None:
         # flow-log decode parallelism for THIS server instance; None
         # defers to the DF_INGEST_WORKERS env knob read at import time
         self.ingest_workers = ingest_workers
@@ -71,7 +74,19 @@ class Server:
         self.federation = None
         self._ring_stop = threading.Event()
         self._ring_thread: threading.Thread | None = None
-        self.db = Database(data_dir=data_dir, shard_id=shard_id)
+        # persistent tiered storage (store/tiered.py): sealed chunks are
+        # flushed into mmap-able columnar segments, and acks are released
+        # only after the manifest commit that makes their rows durable
+        self.storage = bool(storage and data_dir)
+        self.flush_interval_s = flush_interval_s
+        self.storage_max_bytes = max(0, int(storage_max_bytes))
+        self.db = Database(data_dir=data_dir, shard_id=shard_id,
+                           storage=self.storage)
+        self.flusher = None
+        self.durability = None
+        if self.storage:
+            from deepflow_tpu.server.flusher import DurabilityGate
+            self.durability = DurabilityGate()
         self.platform = PlatformInfoTable()
         from deepflow_tpu.server.platform_info import (PodIpIndex,
                                                        ResourceIndex)
@@ -128,7 +143,11 @@ class Server:
         from deepflow_tpu.server.datasource import RollupJob
         from deepflow_tpu.server.janitor import Janitor
         self.rollup = RollupJob(self.db)
-        self.janitor = Janitor(self.db, telemetry=self.telemetry)
+        self.janitor = Janitor(self.db, telemetry=self.telemetry,
+                               tier_max_bytes=self.storage_max_bytes)
+        # built after the api (rollup needs the db the api already holds)
+        self.api.rollup = self.rollup
+        self.api.storage_provider = self._storage_stats
         self._started = False
 
     def start_genesis(self, api_base: str | None = None, token: str = "",
@@ -158,9 +177,23 @@ class Server:
             "decoders": {d.MSG_TYPE.name: dict(d.stats)
                          for d in self.decoders},
             "janitor": dict(self.janitor.stats),
+            "flusher": (dict(self.flusher.stats)
+                        if self.flusher is not None else None),
             "genesis": (dict(self.genesis.stats)
                         if self.genesis is not None else None),
         }
+
+    def _storage_stats(self) -> dict | None:
+        """The /v1/health storage block: tier state + rollup horizons."""
+        if self.db.tier_store is None:
+            return None
+        snap = self.db.tier_store.snapshot()
+        snap["gate_pending"] = (len(self.durability)
+                                if self.durability is not None else 0)
+        snap["rollup_horizons"] = {
+            f"{fam}.{sfx}": wm
+            for (fam, sfx), wm in self.rollup.horizons().items()}
+        return snap
 
     def _selfstats_loop(self) -> None:
         """Write the server's OWN telemetry into deepflow_system — the
@@ -282,6 +315,14 @@ class Server:
         if self.db.data_dir:
             self.db.load()  # resume persisted tables
         floors = self._load_ack_state()
+        if self.storage:
+            # the tier manifest carries floors committed ATOMICALLY with
+            # the rows they cover — after a SIGKILL it is ahead of
+            # ack_state.json (which only a clean stop writes). Max-wins
+            # merge: both floors describe rows that are durable.
+            for agent_id, contig in self.db.tier_store.ack_floors.items():
+                if contig > floors.get(agent_id, -1):
+                    floors[agent_id] = contig
         for agent_id, contig in floors.items():
             self.receiver.seq_tracker.seed(agent_id, contig)
         from deepflow_tpu.server.decoders import DedupWindow
@@ -306,19 +347,35 @@ class Server:
             (EventDecoder, MessageType.EVENT),
         ]
         for cls, mtype in pairs:
-            q = self.receiver.register(mtype)
             kw = {}
-            if self.ingest_workers and cls is FlowLogDecoder:
-                kw["workers"] = self.ingest_workers
+            lanes = 1
+            if cls is FlowLogDecoder:
+                workers = self.ingest_workers or FlowLogDecoder.WORKERS
+                if self.ingest_workers:
+                    kw["workers"] = self.ingest_workers
+                # one lane queue per decode worker: each TCP connection
+                # pins to a lane, so N agents decode on N workers and a
+                # single hot agent cannot serialize the native path
+                lanes = workers
+            q = self.receiver.register(mtype, lanes=lanes)
             d = cls(q, self.db, self.platform, exporters=self.exporters,
                     pod_index=self.pod_index, resources=self.resources,
                     gpid_table=(self.controller.gpids
                                 if self.controller else None),
                     telemetry=self.telemetry, dedup=self.dedup,
                     seq_tracker=self.receiver.seq_tracker,
-                    ring=self._current_ring, **kw)
+                    ring=self._current_ring,
+                    durability=self.durability, **kw)
             d.MSG_TYPE = mtype  # FlowLogDecoder serves two types
             self.decoders.append(d.start())
+        if self.storage:
+            from deepflow_tpu.server.flusher import Flusher
+            self.flusher = Flusher(self.db, gate=self.durability,
+                                   seq_tracker=self.receiver.seq_tracker,
+                                   interval_s=self.flush_interval_s,
+                                   telemetry=self.telemetry)
+            self.flusher.seed_floors(floors)
+            self.flusher.start()
         self.receiver.start()
         self.http.start()
         if self._cluster_on:
@@ -437,6 +494,12 @@ class Server:
                 d.flush()  # stateful reducers drain pending windows
                 # BEFORE the db persists (the file_agg tail otherwise
                 # vanishes on every restart)
+        if self.flusher is not None:
+            # after the decoder drain: the final flush commits everything
+            # they wrote (and parked) and releases the last gated seqs,
+            # so the ack state written below matches durable rows
+            self.flusher.stop()
+            self.flusher = None
         # persist ack watermarks AFTER the drain: every acked frame is
         # now in a table, so seeding dedup floors from this state on the
         # next start cannot mask an undecoded frame
@@ -512,6 +575,18 @@ def main() -> None:
                              "ring owners; queries stay exact through "
                              "R-1 simultaneous shard failures")
     parser.add_argument("--data-dir", default=None)
+    parser.add_argument("--storage", action="store_true",
+                        help="persistent tiered storage: flush sealed "
+                             "chunks into on-disk columnar segments "
+                             "under <data-dir>/segments and release "
+                             "ingest acks only after the commit that "
+                             "makes their rows durable")
+    parser.add_argument("--flush-interval-s", type=float, default=1.0,
+                        help="tier flush cadence (storage mode)")
+    parser.add_argument("--storage-max-mb", type=int, default=0,
+                        help="on-disk tier size budget per node; the "
+                             "janitor evicts oldest segments past it "
+                             "(0 = TTL-only eviction)")
     parser.add_argument("--ha-lease", default=None,
                         help="shared-volume lease FILE for leader election")
     parser.add_argument("--ha-k8s-lease", default=None,
@@ -536,6 +611,9 @@ def main() -> None:
                     cluster_advertise=args.advertise,
                     fanout_timeout_s=args.fanout_timeout_s,
                     replication=args.replication,
+                    storage=args.storage,
+                    flush_interval_s=args.flush_interval_s,
+                    storage_max_bytes=args.storage_max_mb << 20,
                     enable_controller=not args.no_controller).start()
     try:
         while True:

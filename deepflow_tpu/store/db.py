@@ -2,18 +2,29 @@
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 
 from deepflow_tpu.store import schema
 from deepflow_tpu.store.table import ColumnarTable, ColumnSpec
 
+log = logging.getLogger("df.db")
+
 
 class Database:
-    """A set of named ColumnarTables (the ClickHouse analog, embedded)."""
+    """A set of named ColumnarTables (the ClickHouse analog, embedded).
+
+    With ``storage=True`` (and a data_dir) every table gets an on-disk
+    tier under ``<data_dir>/segments/`` (store/tiered.py): sealed chunks
+    are flushed into mmap-able columnar segments by flush_to_tier(), and
+    the npz save/load path is bypassed for rows the tier owns — a row
+    lives in exactly one place, so a crash can never double-load it.
+    """
 
     def __init__(self, data_dir: str | None = None,
-                 chunk_rows: int = 1 << 16, shard_id: int = 0) -> None:
+                 chunk_rows: int = 1 << 16, shard_id: int = 0,
+                 storage: bool = False) -> None:
         self.data_dir = data_dir
         self.chunk_rows = chunk_rows
         # cluster shard identity: every ingested row that has a shard_id
@@ -22,6 +33,12 @@ class Database:
         self.shard_id = shard_id
         self._tables: dict[str, ColumnarTable] = {}
         self._lock = threading.Lock()
+        self.tier_store = None
+        if storage and data_dir:
+            from deepflow_tpu.store.tiered import TieredStore
+            self.tier_store = TieredStore(os.path.join(data_dir,
+                                                       "segments"))
+            self.tier_store.recover()
         for name, cols in schema.TABLES.items():
             self.create_table(name, cols)
 
@@ -57,10 +74,78 @@ class Database:
                 errors.append(str(e))
         return errors
 
+    # -- on-disk tier --------------------------------------------------------
+
+    def _ensure_tier(self, name: str, t: ColumnarTable) -> None:
+        if t.tier is None:
+            t.attach_tier(self.tier_store.tier(name))
+
+    def flush_to_tier(self, ack_floors: dict[int, int] | None = None,
+                      seal: bool = True, compress: bool = True) -> int:
+        """Drain every table's sealed RAM chunks into one atomic tier
+        commit. Returns rows committed. ``ack_floors`` ride the same
+        manifest rename that persists the rows (see store/tiered.py).
+        ``seal=False`` is the flusher's group-commit fast path: take
+        only naturally-sealed chunks, leave open stripe buffers alone
+        (no acks are waiting, so nothing owes durability yet);
+        ``compress=False`` skips the zlib codec (segment const-column
+        detection still applies)."""
+        if self.tier_store is None:
+            return 0
+        writes: dict[str, dict] = {}
+        for name, t in list(self._tables.items()):
+            self._ensure_tier(name, t)
+            try:
+                payload = t.take_flushable(seal=seal)
+            except ValueError as e:
+                log.error("flush_to_tier %s: %s", name, e)
+                continue
+            if payload is not None:
+                writes[name] = payload
+        if not writes and not ack_floors:
+            return 0
+        rows = self.tier_store.commit(writes, ack_floors=ack_floors,
+                                      mark_imported=True,
+                                      compress=compress)
+        for name, payload in writes.items():
+            self._tables[name].confirm_flush(payload)
+        return rows
+
+    def _attach_tiers(self) -> None:
+        """Restart recovery: merge persisted dictionaries (append-only —
+        the longest dump is a superset), drop segments no dictionary can
+        decode, and adopt each table's tier."""
+        from deepflow_tpu.store.dictionary import Dictionary
+        for name, t in self._tables.items():
+            tt = self.tier_store.tier(name)
+            for col in t.dicts:
+                p = tt.dict_path(col)
+                if not os.path.exists(p):
+                    continue
+                try:
+                    d2 = Dictionary.load(p, col)
+                except (OSError, ValueError, KeyError):
+                    log.warning("tier dict %s unreadable", p,
+                                exc_info=True)
+                    continue
+                if len(d2) > len(t.dicts[col]):
+                    t.dicts[col] = d2
+            if tt.segment_count():
+                self.tier_store.validate_dicts(name, t.dicts)
+            t.attach_tier(tt)
+
+    # -- persistence ---------------------------------------------------------
+
     def save(self) -> None:
         if not self.data_dir:
             return
         from deepflow_tpu.store import migration
+        if self.tier_store is not None:
+            # the tier IS the persistence: a save is a full flush-commit
+            # (npz chunk dirs are not written — a row lives in one tier)
+            self.flush_to_tier()
+            migration.write_manifest(self.data_dir)
+            return
         for name, t in self._tables.items():
             t.save(os.path.join(self.data_dir, name.replace(".", "/")))
         migration.write_manifest(self.data_dir)
@@ -71,7 +156,16 @@ class Database:
         from deepflow_tpu.store import migration
         migration.validate_loadable(self.data_dir)
         version = migration.read_manifest_version(self.data_dir)
-        for name, t in self._tables.items():
-            d = os.path.join(self.data_dir, name.replace(".", "/"))
-            if os.path.isdir(d) or os.path.isdir(d + ".old"):
-                t.load(d, from_version=version)
+        # once the tier has imported the npz state, the chunk dirs are
+        # stale duplicates of what the segments hold — skip them. Until
+        # then (first run after enabling storage) load them normally;
+        # the first flush commit moves them into the tier atomically.
+        skip_npz = (self.tier_store is not None
+                    and self.tier_store.npz_imported)
+        if not skip_npz:
+            for name, t in self._tables.items():
+                d = os.path.join(self.data_dir, name.replace(".", "/"))
+                if os.path.isdir(d) or os.path.isdir(d + ".old"):
+                    t.load(d, from_version=version)
+        if self.tier_store is not None:
+            self._attach_tiers()

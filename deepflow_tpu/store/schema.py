@@ -325,9 +325,14 @@ _APP_COLS = [
     *UNIVERSAL_TAGS,
 ]
 _table("flow_metrics.application.1s", list(_APP_COLS))
-_table("flow_metrics.application.1m", list(_APP_COLS))
-_table("flow_metrics.application.1h", list(_APP_COLS))
-_table("flow_metrics.application.1d", list(_APP_COLS))
+# rollup tiers additionally carry a mergeable latency-distribution state
+# (DDSketch JSON, cluster/sketch.py) built from the raw rrt_max values —
+# PERCENTILE() over long ranges answers from the rollup within the
+# sketch's relative-error bound instead of scanning raw rows
+_APP_ROLLUP_COLS = list(_APP_COLS) + [C("rrt_max_sketch", "str")]
+_table("flow_metrics.application.1m", list(_APP_ROLLUP_COLS))
+_table("flow_metrics.application.1h", list(_APP_ROLLUP_COLS))
+_table("flow_metrics.application.1d", list(_APP_ROLLUP_COLS))
 
 # -- events ----------------------------------------------------------------
 _table("event.event", [

@@ -89,6 +89,14 @@ class ColumnarTable:
             c.name: Dictionary(f"{name}.{c.name}")
             for c in columns if c.kind == "str"}
         self._chunks: list[dict[str, np.ndarray]] = []
+        # on-disk tier (store/tiered.py TableTier), attached by
+        # Database when persistent storage is enabled. Tier chunks are
+        # mmap-backed and come FIRST in snapshot() (they are the oldest
+        # rows); _pending_flush holds merged chunks staged for a tier
+        # commit — still served from RAM until confirm_flush() so no
+        # snapshot ever misses rows mid-flush.
+        self.tier = None
+        self._pending_flush: list[dict[str, np.ndarray]] = []
         self._stripes: dict[int, _Stripe] = {}  # thread id -> stripe
         self._lock = threading.Lock()  # guards _chunks, rows_written,
         # dicts swap (compaction) and stripe creation
@@ -335,6 +343,97 @@ class ColumnarTable:
             with s.lock:
                 self._seal_stripe(s)
 
+    # -- on-disk tier (store/tiered.py) --------------------------------------
+
+    def attach_tier(self, tier) -> None:
+        """Adopt an on-disk tier (restart recovery path): its rows join
+        the table's row count and its time span marks the cache buckets,
+        so change tokens move exactly as if the rows were (re)loaded."""
+        with self._lock:
+            self.tier = tier
+            tier._columns = self.columns
+            tier._fills = self.fills
+            self.rows_written += tier.rows
+            self.watermark += 1
+            tmin, tmax = tier.span()
+            if tmin is not None and tmax is not None:
+                self._note_span(tmin, tmax)
+            elif tier.rows:
+                self._wide_mark = self.watermark
+
+    def take_flushable(self, seal: bool = True) -> dict | None:
+        """Stage every sealed RAM chunk for a tier commit.
+
+        The chunks move atomically into _pending_flush (still visible to
+        snapshot()), then merge into ONE chunk outside the table lock —
+        heavy concatenation must not stall the append hot path. Returns
+        the commit payload for TieredStore.commit(), or None when there
+        is nothing to flush. Single flusher thread assumed (the staged
+        list is private to it between take and confirm).
+
+        ``seal=False`` takes only chunks that already sealed naturally:
+        the group-commit fast path for cycles with no acks waiting on
+        durability — open stripe buffers keep filling instead of being
+        chopped into per-interval slivers (and their copy cost stays
+        off the ingest hot path)."""
+        if seal:
+            self.flush()  # seal stripe buffers: durability covers them
+        with self._lock:
+            if self._chunks:
+                self._pending_flush.extend(self._chunks)
+                self._chunks = []
+            parts = list(self._pending_flush)
+        if not parts:
+            return None
+        merged = ({name: self._materialize([ch[name] for ch in parts],
+                                           spec)
+                   for name, spec in self.columns.items()}
+                  if len(parts) > 1 else parts[0])
+        with self._lock:
+            self._pending_flush = [merged]
+        rows = len(next(iter(merged.values()))) if merged else 0
+        if rows == 0:
+            with self._lock:
+                self._pending_flush = []
+            return None
+        return {
+            "chunk": merged, "rows": rows, "time_col": self._time_col,
+            "dicts": dict(self.dicts),
+            "dict_state": {n: d.sync_state()[:2]
+                           for n, d in self.dicts.items()},
+        }
+
+    def confirm_flush(self, payload: dict) -> None:
+        """The tier committed the staged chunk: adopt its segment into
+        the scan set and stop serving the RAM copy — BOTH under this one
+        lock, which snapshot() also holds while assembling its chunk
+        list, so a concurrent reader sees the rows exactly once (never
+        zero, never twice; rows_written is unchanged). Bumps the
+        watermark — ISSUE contract: change tokens cover segment flushes
+        — but leaves bucket marks alone: the answer content did not
+        change, so cached per-bucket partials stay valid."""
+        with self._lock:
+            seg = payload.get("segment")
+            if seg is not None and self.tier is not None:
+                self.tier._add(seg)
+            self._pending_flush = [
+                ch for ch in self._pending_flush
+                if ch is not payload["chunk"]]
+            self.watermark += 1
+
+    def note_tier_evict(self, rows: int, tmin=None, tmax=None) -> None:
+        """Tier eviction bookkeeping: dropped rows leave the row count
+        and invalidate the evicted time range (satellite fix: eviction
+        must move the QueryCache change token, or a cached whole-result
+        over the evicted range would keep serving dropped rows)."""
+        with self._lock:
+            self.rows_written -= rows
+            self.watermark += 1
+            if self._bucket_div and tmin is not None and tmax is not None:
+                self._note_span(int(tmin), int(tmax))
+            else:
+                self._wide_mark = self.watermark
+
     # -- read path -----------------------------------------------------------
 
     def snapshot(self) -> list[dict[str, np.ndarray]]:
@@ -346,7 +445,14 @@ class ColumnarTable:
             for s in stripes:
                 stack.enter_context(s.lock)
             with self._lock:
-                chunks = list(self._chunks)
+                # tier chunks read under the TABLE lock: confirm_flush
+                # adopts a segment and drops its _pending_flush copy
+                # under the same lock, so this list can never hold both
+                # (or neither) view of a flushed chunk. Lock order is
+                # stripes -> table -> tier everywhere.
+                tier_chunks = (self.tier.chunks()
+                               if self.tier is not None else [])
+                chunks = tier_chunks + self._pending_flush + self._chunks
             for s in stripes:
                 if not s.rows:
                     continue
@@ -439,6 +545,13 @@ class ColumnarTable:
         swap and decodes via self.dicts after it may mis-render strings
         for that one scan; the janitor runs this rarely (post-trim) to
         keep the window negligible."""
+        if self.tier is not None:
+            # on-disk segments carry dictionary ids verbatim — rebinding
+            # them would corrupt every persisted chunk. Tiered tables
+            # reclaim dictionary space the ClickHouse way instead: whole
+            # segments (and eventually their ids' referents) age out via
+            # TTL eviction.
+            return {}
         stats: dict[str, dict] = {}
         stripes = self._all_stripes()
         with contextlib.ExitStack() as stack:

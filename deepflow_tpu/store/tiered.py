@@ -1,0 +1,453 @@
+"""Tiered segment store: the on-disk tier under every ColumnarTable.
+
+Reference analog: server/ingester writing ClickHouse parts + the ckdb
+TTL/partition-drop retention model. Embedded redesign: one TieredStore per
+Database owns a directory of per-table segment files plus ONE manifest —
+the single atomic commit point (tmp + fsync + rename, the ack_state.json
+pattern) for everything durable:
+
+    segments/
+      MANIFEST.json                     <- the commit point
+      <table.name>/
+        seg_00000001.seg                <- store/segment.py format
+        dict_<col>.json                 <- dictionary dumps (append-only)
+
+Commit protocol (the order IS the crash-safety argument):
+
+  1. dictionary dumps for changed dictionaries (append-only: a dump taken
+     after a chunk was encoded is a superset of every id the chunk uses)
+  2. segment files written + fsync'd
+  3. MANIFEST.json replaced atomically (lists the new segments AND the
+     per-agent ack floors that become releasable once the data is down)
+  4. only now does ColumnarTable.confirm_flush swap each table's staged
+     RAM copy for the tier's mmap view — under one table lock, so a
+     concurrent snapshot sees the rows exactly once — and acks release
+
+A SIGKILL at any point leaves either the old manifest (new segment files
+are unlisted -> deleted as torn tail on recovery, their frames unacked ->
+retransmitted) or the new one (segments + covering dictionaries + floors
+all present). Ack floors living INSIDE the manifest is what closes the
+two-file commit race: a frame is acked only if the same rename that
+persisted its rows persisted its floor.
+
+Eviction is whole-segment (CK partition drops, not row deletes), manifest
+first, unlink after — and every dropped row is ledgered as
+``segment_evict`` by the caller (janitor), never silent.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+
+from deepflow_tpu.store.segment import Segment, SegmentError, write_segment
+
+log = logging.getLogger("df.tiered")
+
+MANIFEST = "MANIFEST.json"
+_FORMAT_VERSION = 1
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+class TableTier:
+    """One table's slice of the tier: its live Segment list + counters.
+
+    Attached to a ColumnarTable (table.tier); ``chunks()`` is called from
+    inside snapshot() so it must stay cheap — the column-map list is
+    cached and only rebuilt when the segment set changes."""
+
+    def __init__(self, name: str, dirpath: str, next_id: int = 1) -> None:
+        self.name = name
+        self.dir = dirpath
+        self.next_id = next_id
+        self._lock = threading.Lock()
+        self._segments: list[Segment] = []
+        # committed to the manifest but not yet adopted into scans: the
+        # table still serves these rows from its _pending_flush chunk
+        # until confirm_flush() swaps tier view and RAM copy atomically
+        self._staged: list[Segment] = []
+        self._chunk_cache: list[dict] | None = None
+        # set at attach time so chunks() can backfill additively-new
+        # columns exactly like ColumnarTable.load() does
+        self._columns = None
+        self._fills: dict = {}
+        # (gen, version) of the last dictionary dump per column — dumps
+        # are skipped when nothing changed
+        self._dict_dumped: dict[str, tuple[int, int]] = {}
+
+    # -- read side ----------------------------------------------------------
+
+    def segments(self) -> list[Segment]:
+        with self._lock:
+            return list(self._segments)
+
+    def chunks(self) -> list[dict]:
+        with self._lock:
+            if self._chunk_cache is None:
+                self._chunk_cache = [
+                    s.chunk(self._columns, self._fills)
+                    for s in self._segments if s.rows]
+            return list(self._chunk_cache)
+
+    @property
+    def rows(self) -> int:
+        with self._lock:
+            return sum(s.rows for s in self._segments)
+
+    @property
+    def bytes(self) -> int:
+        with self._lock:
+            return sum(s.nbytes for s in self._segments)
+
+    def segment_count(self) -> int:
+        with self._lock:
+            return len(self._segments)
+
+    def span(self) -> tuple[int | None, int | None]:
+        with self._lock:
+            tmins = [s.tmin for s in self._segments if s.tmin is not None]
+            tmaxs = [s.tmax for s in self._segments if s.tmax is not None]
+        return (min(tmins) if tmins else None,
+                max(tmaxs) if tmaxs else None)
+
+    def manifest_names(self) -> list[str]:
+        """Segment filenames the manifest must vouch for: adopted AND
+        staged — a staged segment's file is already fsync'd and its rows
+        are only acked because this list persists them."""
+        with self._lock:
+            return [os.path.basename(s.path)
+                    for s in self._segments + self._staged]
+
+    # -- mutation (TieredStore holds its own lock around these) -------------
+
+    def _stage(self, seg: Segment) -> None:
+        with self._lock:
+            self._staged.append(seg)
+
+    def _add(self, seg: Segment) -> None:
+        with self._lock:
+            self._staged = [s for s in self._staged if s is not seg]
+            self._segments.append(seg)
+            self._chunk_cache = None
+
+    def _remove(self, victims: list[Segment]) -> None:
+        ids = {id(s) for s in victims}
+        with self._lock:
+            self._segments = [s for s in self._segments
+                              if id(s) not in ids]
+            self._chunk_cache = None
+
+    def persist_dicts(self, dicts: dict) -> int:
+        """Dump changed dictionaries (atomic per file). MUST run before
+        the manifest commit that lists segments encoded against them."""
+        n = 0
+        for col, d in dicts.items():
+            state = (d.gen, d.version)
+            if self._dict_dumped.get(col) == state:
+                continue
+            os.makedirs(self.dir, exist_ok=True)
+            path = os.path.join(self.dir, f"dict_{col}.json")
+            tmp = f"{path}.tmp.{os.getpid()}"
+            d.dump(tmp)
+            with open(tmp, "rb+") as f:
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            self._dict_dumped[col] = state
+            n += 1
+        if n:
+            _fsync_dir(self.dir)
+        return n
+
+    def dict_path(self, col: str) -> str:
+        return os.path.join(self.dir, f"dict_{col}.json")
+
+
+class TieredStore:
+    """Database-level tier: per-table TableTiers + the atomic manifest."""
+
+    def __init__(self, root: str) -> None:
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self._lock = threading.RLock()
+        self._tables: dict[str, TableTier] = {}
+        # True once any commit has run with the in-memory (npz) tables
+        # already imported — from then on the npz chunk dirs are dead
+        # weight and are NOT loaded (a row lives in exactly one tier).
+        self.npz_imported = False
+        self.ack_floors: dict[int, int] = {}
+        self.flush_gen = 0
+        self.evict_gen = 0
+        self.stats = {"commits": 0, "segments_written": 0,
+                      "rows_flushed": 0, "torn_dropped": 0,
+                      "segments_evicted": 0, "rows_evicted": 0,
+                      "bytes_evicted": 0}
+
+    def tier(self, name: str) -> TableTier:
+        with self._lock:
+            tt = self._tables.get(name)
+            if tt is None:
+                tt = self._tables[name] = TableTier(
+                    name, os.path.join(self.root, name))
+            return tt
+
+    def tables(self) -> dict[str, TableTier]:
+        with self._lock:
+            return dict(self._tables)
+
+    # -- manifest ------------------------------------------------------------
+
+    def _manifest_path(self) -> str:
+        return os.path.join(self.root, MANIFEST)
+
+    def _write_manifest(self) -> None:
+        """Atomic replace; caller holds self._lock."""
+        doc = {
+            "version": _FORMAT_VERSION,
+            "npz_imported": self.npz_imported,
+            "flush_gen": self.flush_gen,
+            "evict_gen": self.evict_gen,
+            "ack_floors": {str(k): v for k, v in self.ack_floors.items()},
+            "tables": {
+                name: {"next_id": tt.next_id,
+                       "segments": tt.manifest_names()}
+                for name, tt in self._tables.items()},
+        }
+        path = self._manifest_path()
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        _fsync_dir(self.root)
+
+    # -- recovery ------------------------------------------------------------
+
+    def recover(self) -> None:
+        """Load the manifest, open every listed segment, and delete
+        anything on disk the manifest does not vouch for (torn tail from
+        a crash mid-commit). Unreadable listed segments are dropped too
+        — recovery always converges to a state where manifest == disk."""
+        with self._lock:
+            path = self._manifest_path()
+            doc = {}
+            if os.path.exists(path):
+                try:
+                    with open(path) as f:
+                        doc = json.load(f)
+                except (OSError, ValueError):
+                    log.warning("tier manifest unreadable; starting empty",
+                                exc_info=True)
+                    doc = {}
+            self.npz_imported = bool(doc.get("npz_imported", False))
+            self.flush_gen = int(doc.get("flush_gen", 0))
+            self.evict_gen = int(doc.get("evict_gen", 0))
+            self.ack_floors = {int(k): int(v) for k, v in
+                               doc.get("ack_floors", {}).items()}
+            dropped = False
+            for name, ent in doc.get("tables", {}).items():
+                tt = self.tier(name)
+                tt.next_id = int(ent.get("next_id", 1))
+                for fn in ent.get("segments", []):
+                    p = os.path.join(tt.dir, fn)
+                    try:
+                        tt._add(Segment.open(p))
+                    except SegmentError as e:
+                        log.warning("dropping torn segment: %s", e)
+                        self.stats["torn_dropped"] += 1
+                        dropped = True
+                        try:
+                            os.unlink(p)
+                        except OSError:
+                            pass
+            # torn tail: segment files the manifest never committed
+            listed = {name: {os.path.basename(s.path)
+                             for s in tt.segments()}
+                      for name, tt in self._tables.items()}
+            for entry in os.listdir(self.root):
+                tdir = os.path.join(self.root, entry)
+                if not os.path.isdir(tdir):
+                    continue
+                keep = listed.get(entry, set())
+                for fn in os.listdir(tdir):
+                    if fn.endswith(".seg") and fn not in keep \
+                            or ".tmp." in fn:
+                        log.warning("deleting uncommitted file %s/%s",
+                                    entry, fn)
+                        self.stats["torn_dropped"] += 1
+                        try:
+                            os.unlink(os.path.join(tdir, fn))
+                        except OSError:
+                            pass
+            if dropped:
+                self._write_manifest()
+
+    def validate_dicts(self, name: str, dicts: dict) -> list[Segment]:
+        """Drop segments whose recorded dict generations exceed what the
+        loaded dictionaries can decode (a dump went missing). Returns the
+        dropped segments; the caller re-commits the manifest via the next
+        flush. Normal operation never trips this — dumps are committed
+        before the segments that need them."""
+        bad: list[Segment] = []
+        tt = self.tier(name)
+        for seg in tt.segments():
+            for col, gens in seg.dict_gens.items():
+                d = dicts.get(col)
+                dlen = gens[1] if len(gens) > 1 else 0
+                if d is not None and len(d) < dlen:
+                    bad.append(seg)
+                    break
+        if bad:
+            with self._lock:
+                tt._remove(bad)
+                self.stats["torn_dropped"] += len(bad)
+                self._write_manifest()
+            for seg in bad:
+                log.warning("dropping segment with undecodable ids: %r",
+                            seg)
+                try:
+                    os.unlink(seg.path)
+                except OSError:
+                    pass
+        return bad
+
+    # -- commit --------------------------------------------------------------
+
+    def commit(self, writes: dict[str, dict],
+               ack_floors: dict[int, int] | None = None,
+               mark_imported: bool = False,
+               compress: bool = True) -> int:
+        """One atomic flush commit. ``writes`` maps table name ->
+        payload from ColumnarTable.take_flushable():
+        {chunk, rows, time_col, dicts, dict_state}. Returns rows
+        committed. See the module docstring for the ordering argument.
+
+        mark_imported: only Database.flush_to_tier passes True — it has
+        drained EVERY table's RAM chunks into ``writes``, so from this
+        commit on the npz chunk dirs hold nothing the tier doesn't."""
+        with self._lock:
+            rows = 0
+            nseg = 0
+            dirty_dirs: set[str] = set()
+            for name, payload in writes.items():
+                tt = self.tier(name)
+                tt.persist_dicts(payload.get("dicts") or {})
+                os.makedirs(tt.dir, exist_ok=True)
+                fn = f"seg_{tt.next_id:08d}.seg"
+                tt.next_id += 1
+                p = os.path.join(tt.dir, fn)
+                write_segment(p, payload["chunk"],
+                              time_col=payload.get("time_col"),
+                              dict_gens=payload.get("dict_state"),
+                              compress=compress)
+                dirty_dirs.add(tt.dir)
+                seg = Segment.open(p)
+                tt._stage(seg)
+                # handed back so ColumnarTable.confirm_flush can swap
+                # the tier view for the RAM copy under ONE table lock
+                payload["segment"] = seg
+                nseg += 1
+                rows += payload["rows"]
+            for d in dirty_dirs:
+                _fsync_dir(d)
+            if ack_floors:
+                for a, s in ack_floors.items():
+                    if s > self.ack_floors.get(a, -1):
+                        self.ack_floors[a] = s
+            self.flush_gen += 1
+            if mark_imported:
+                self.npz_imported = True
+            # the manifest lists the staged segments (manifest_names):
+            # this rename is the durability point; scan visibility flips
+            # per table at confirm_flush
+            self._write_manifest()
+            self.stats["commits"] += 1
+            self.stats["segments_written"] += nseg
+            self.stats["rows_flushed"] += rows
+            return rows
+
+    # -- eviction ------------------------------------------------------------
+
+    def evict(self, name: str, cutoff: int | None = None,
+              max_bytes: int | None = None) -> dict:
+        """Whole-segment TTL + size-budget eviction for one table.
+        Segments with tmax < cutoff go first; then oldest-first until the
+        table fits max_bytes. Manifest commits BEFORE the unlink (a crash
+        in between leaves unlisted files that recovery deletes).
+
+        Returns {rows, segments, bytes, tmin, tmax} of what was dropped —
+        the caller owns the ``segment_evict`` ledger entry and the table
+        watermark/rows bookkeeping."""
+        with self._lock:
+            tt = self._tables.get(name)
+            if tt is None:
+                return {"rows": 0, "segments": 0, "bytes": 0,
+                        "tmin": None, "tmax": None}
+            segs = tt.segments()
+            victims = []
+            if cutoff is not None:
+                victims = [s for s in segs
+                           if s.tmax is not None and s.tmax < cutoff]
+            if max_bytes is not None:
+                keep = [s for s in segs if s not in victims]
+                total = sum(s.nbytes for s in keep)
+                # oldest first = commit order (ids are monotonic)
+                for s in keep:
+                    if total <= max_bytes:
+                        break
+                    victims.append(s)
+                    total -= s.nbytes
+            if not victims:
+                return {"rows": 0, "segments": 0, "bytes": 0,
+                        "tmin": None, "tmax": None}
+            tt._remove(victims)
+            self.evict_gen += 1
+            self._write_manifest()
+            for s in victims:
+                try:
+                    os.unlink(s.path)
+                except OSError:
+                    pass
+            out = {
+                "rows": sum(s.rows for s in victims),
+                "segments": len(victims),
+                "bytes": sum(s.nbytes for s in victims),
+                "tmin": min((s.tmin for s in victims
+                             if s.tmin is not None), default=None),
+                "tmax": max((s.tmax for s in victims
+                             if s.tmax is not None), default=None),
+            }
+            self.stats["segments_evicted"] += out["segments"]
+            self.stats["rows_evicted"] += out["rows"]
+            self.stats["bytes_evicted"] += out["bytes"]
+            return out
+
+    def persist_ack_floors(self, floors: dict[int, int]) -> None:
+        """Commit ack floors with no segment writes (final drain)."""
+        self.commit({}, ack_floors=floors)
+
+    def snapshot(self) -> dict:
+        """Ops/health view: per-table tier stats + generations."""
+        with self._lock:
+            tables = {}
+            for name, tt in self._tables.items():
+                tmin, tmax = tt.span()
+                tables[name] = {"segments": tt.segment_count(),
+                                "rows": tt.rows, "bytes": tt.bytes,
+                                "tmin": tmin, "tmax": tmax}
+            return {"root": self.root, "flush_gen": self.flush_gen,
+                    "evict_gen": self.evict_gen,
+                    "npz_imported": self.npz_imported,
+                    "stats": dict(self.stats), "tables": tables}

@@ -1,0 +1,537 @@
+"""Tiered on-disk storage: segments, crash recovery, eviction, rollup
+datasource selection, and the durability gate (ISSUE 9)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deepflow_tpu.query import execute
+from deepflow_tpu.query import datasource as qds
+from deepflow_tpu.query import sql as S
+from deepflow_tpu.query.cache import QueryCache
+from deepflow_tpu.server.datasource import RollupJob
+from deepflow_tpu.server.flusher import DurabilityGate, Flusher
+from deepflow_tpu.server.janitor import Janitor
+from deepflow_tpu.server.receiver import Receiver, SeqAckTracker
+from deepflow_tpu.store import Database
+from deepflow_tpu.store.segment import Segment, SegmentError, write_segment
+from deepflow_tpu.store.tiered import TieredStore
+from deepflow_tpu.telemetry import Telemetry
+
+
+# -- segment file format ----------------------------------------------------
+
+def _chunk(n=100, t0=1000):
+    return {"time": np.arange(t0, t0 + n, dtype=np.uint32),
+            "v": np.arange(n, dtype=np.uint64),
+            # single-valued -> takes the const codec path
+            "tag": np.zeros(n, dtype=np.uint32)}
+
+
+def test_segment_roundtrip(tmp_path):
+    p = str(tmp_path / "seg_00000001.seg")
+    ch = _chunk()
+    write_segment(p, ch, time_col="time",
+                  dict_gens={"tag": (0, 17)})
+    seg = Segment.open(p)
+    assert seg.rows == 100
+    assert (seg.tmin, seg.tmax) == (1000, 1099)
+    assert seg.dict_gens == {"tag": (0, 17)}
+    out = seg.chunk()
+    for name in ch:
+        assert np.array_equal(out[name], ch[name]), name
+    # raw blocks are zero-copy views over the mapping, not copies
+    assert not out["time"].flags.writeable
+
+
+def test_segment_codecs(tmp_path):
+    """Per-column codec choice: const for single-valued columns (one
+    element on disk), zlib only when it pays, raw otherwise — and
+    compress=False keeps const but never deflates."""
+    rng = np.random.default_rng(7)
+    ch = {"const64": np.full(4096, 0xDEAD, dtype=np.uint64),
+          "repeat": np.arange(4096, dtype=np.uint64) % 4,   # compressible
+          "noise": rng.integers(0, 2**63, 4096, dtype=np.uint64)}
+    p = str(tmp_path / "seg.seg")
+    footer = write_segment(p, ch)
+    codecs = {k: v["codec"] for k, v in footer["cols"].items()}
+    assert codecs == {"const64": "const", "repeat": "zlib",
+                      "noise": "raw"}
+    assert footer["cols"]["const64"]["nbytes"] == 8  # one element
+    seg = Segment.open(p)
+    out = seg.chunk()
+    for name in ch:
+        assert np.array_equal(out[name], ch[name]), name
+    # const reads are stride-0 broadcast views: no materialized copy
+    assert out["const64"].strides == (0,)
+    assert not out["const64"].flags.writeable
+
+    p2 = str(tmp_path / "seg2.seg")
+    footer2 = write_segment(p2, ch, compress=False)
+    codecs2 = {k: v["codec"] for k, v in footer2["cols"].items()}
+    assert codecs2 == {"const64": "const", "repeat": "raw",
+                       "noise": "raw"}
+    out2 = Segment.open(p2).chunk()
+    for name in ch:
+        assert np.array_equal(out2[name], ch[name]), name
+
+
+def test_segment_const_block_validated(tmp_path):
+    """A const block whose size disagrees with its dtype is torn."""
+    p = str(tmp_path / "seg.seg")
+    write_segment(p, {"c": np.full(64, 5, dtype=np.uint64)})
+    import struct
+    import zlib as _z
+    with open(p, "rb") as f:
+        buf = bytearray(f.read())
+    flen, fcrc, magic = struct.unpack("<II8s", buf[-16:])
+    foot = json.loads(bytes(buf[-16 - flen:-16]))
+    foot["cols"]["c"]["nbytes"] = 4  # lies about the block size
+    fb = json.dumps(foot, sort_keys=True).encode()
+    buf = buf[:len(buf) - 16 - flen] + fb + struct.pack(
+        "<II8s", len(fb), _z.crc32(fb) & 0xFFFFFFFF, magic)
+    with open(p, "wb") as f:
+        f.write(buf)
+    with pytest.raises(SegmentError, match="const block"):
+        Segment.open(p)
+
+
+def test_segment_torn_tail_detected(tmp_path):
+    p = str(tmp_path / "seg.seg")
+    write_segment(p, _chunk(), time_col="time")
+    size = os.path.getsize(p)
+    for cut in (size - 4, size // 2, 10):
+        with open(p, "r+b") as f:
+            f.truncate(cut)
+        with pytest.raises(SegmentError):
+            Segment.open(p)
+        write_segment(p, _chunk(), time_col="time")
+    # flipped footer byte -> crc mismatch
+    with open(p, "r+b") as f:
+        f.seek(size - 30)
+        b = f.read(1)
+        f.seek(size - 30)
+        f.write(bytes([b[0] ^ 0xFF]))
+    with pytest.raises(SegmentError):
+        Segment.open(p)
+
+
+def test_tiered_recover_drops_uncommitted(tmp_path):
+    root = str(tmp_path / "segments")
+    ts = TieredStore(root)
+    ts.commit({"t": {"chunk": _chunk(), "rows": 100, "time_col": "time",
+                     "dicts": {}, "dict_state": {}}})
+    # crash mid-commit artifacts: a written-but-unlisted segment and a
+    # tmp file must both be deleted on recovery
+    orphan = os.path.join(root, "t", "seg_00000099.seg")
+    write_segment(orphan, _chunk(50))
+    open(os.path.join(root, "t", f"seg_x.seg.tmp.{os.getpid()}"),
+         "wb").close()
+    ts2 = TieredStore(root)
+    ts2.recover()
+    assert not os.path.exists(orphan)
+    assert ts2.tier("t").rows == 100
+    assert ts2.stats["torn_dropped"] == 2
+
+
+def test_torn_listed_segment_dropped_on_recovery(tmp_path):
+    root = str(tmp_path / "segments")
+    ts = TieredStore(root)
+    ts.commit({"t": {"chunk": _chunk(), "rows": 100, "time_col": "time",
+                     "dicts": {}, "dict_state": {}}})
+    # still staged (no table confirmed it) but manifest-listed
+    tt = ts.tier("t")
+    path = os.path.join(tt.dir, tt.manifest_names()[0])
+    with open(path, "r+b") as f:
+        f.truncate(os.path.getsize(path) - 8)
+    ts2 = TieredStore(root)
+    ts2.recover()  # listed but torn: dropped, manifest re-committed
+    assert ts2.tier("t").segment_count() == 0
+    assert not os.path.exists(path)
+    ts3 = TieredStore(root)
+    ts3.recover()
+    assert ts3.stats["torn_dropped"] == 0  # converged
+
+
+# -- flush -> restart -> query equality -------------------------------------
+
+_NET_ROW = {"ip_src": "1.1.1.1", "ip_dst": "2.2.2.2", "server_port": 80,
+            "protocol": 1, "host": "h1"}
+
+
+def _fill_net(db, n=120, t0=6000):
+    t = db.table("flow_metrics.network.1s")
+    t.append_rows([dict(_NET_ROW, time=t0 + i, byte_tx=i, packet_tx=1)
+                   for i in range(n)])
+    return t
+
+
+def test_flush_restart_query_equality(tmp_path):
+    d = str(tmp_path)
+    db = Database(data_dir=d, storage=True)
+    _fill_net(db)
+    sql = ("SELECT ip_src, Sum(byte_tx) AS b, Count() AS c FROM t "
+           "GROUP BY ip_src")
+    before = execute(db.table("flow_metrics.network.1s"), sql).values
+    assert db.flush_to_tier() == 120
+    # flushed rows still answer identically from the mmap'd tier
+    assert execute(db.table("flow_metrics.network.1s"),
+                   sql).values == before
+    db2 = Database(data_dir=d, storage=True)
+    db2.load()
+    t2 = db2.table("flow_metrics.network.1s")
+    assert len(t2) == 120
+    assert execute(t2, sql).values == before
+    # string columns decode through the persisted dictionaries
+    assert execute(t2, "SELECT host, Count() AS c FROM t GROUP BY host"
+                   ).values == [["h1", 120.0]]
+
+
+def test_flush_restart_torn_tail(tmp_path):
+    d = str(tmp_path)
+    db = Database(data_dir=d, storage=True)
+    _fill_net(db, n=60)
+    db.flush_to_tier()
+    _fill_net(db, n=60, t0=7000)
+    db.flush_to_tier()
+    segs = db.tier_store.tier("flow_metrics.network.1s").segments()
+    assert len(segs) == 2
+    # tear the SECOND commit's segment: restart must keep the first
+    with open(segs[1].path, "r+b") as f:
+        f.truncate(os.path.getsize(segs[1].path) - 8)
+    db2 = Database(data_dir=d, storage=True)
+    db2.load()
+    t2 = db2.table("flow_metrics.network.1s")
+    assert len(t2) == 60
+    r = execute(t2, "SELECT Min(time) AS a, Max(time) AS b FROM t")
+    assert r.values == [[6000.0, 6059.0]]
+
+
+# -- eviction: ledger conservation + cache invalidation ---------------------
+
+def test_ttl_eviction_ledger_conserved(tmp_path):
+    db = Database(data_dir=str(tmp_path), storage=True)
+    _fill_net(db, n=100, t0=6000)
+    db.flush_to_tier()
+    tele = Telemetry("server")
+    jan = Janitor(db, ttl_s={"flow_metrics.network.1s": 100},
+                  telemetry=tele)
+    t = db.table("flow_metrics.network.1s")
+    assert len(t) == 100
+    # now - ttl is far past every row: the whole segment ages out
+    assert jan.sweep_tier(now=1_000_000.0) == 100
+    assert len(t) == 0
+    assert db.tier_store.snapshot()["tables"][t.name]["segments"] == 0
+    hop = tele.hop("storage").snapshot()
+    assert hop["dropped"] == {"segment_evict": 100}
+    assert hop["emitted"] == 100  # conserved: every drop was emitted
+    assert jan.stats["tier_rows_evicted"] == 100
+    assert jan.stats["tier_segments_evicted"] == 1
+
+
+def test_size_budget_evicts_oldest_first(tmp_path):
+    db = Database(data_dir=str(tmp_path), storage=True)
+    _fill_net(db, n=50, t0=6000)
+    db.flush_to_tier()
+    _fill_net(db, n=50, t0=9000)
+    db.flush_to_tier()
+    snap = db.tier_store.snapshot()["tables"]["flow_metrics.network.1s"]
+    assert snap["segments"] == 2
+    jan = Janitor(db, ttl_s={}, tier_max_bytes=snap["bytes"] - 1)
+    assert jan.sweep_tier(now=9100.0) == 50
+    snap = db.tier_store.snapshot()["tables"]["flow_metrics.network.1s"]
+    assert snap["segments"] == 1
+    assert snap["tmin"] == 9000  # the older segment went first
+    assert len(db.table("flow_metrics.network.1s")) == 50
+
+
+def test_cache_invalidated_by_segment_evict(tmp_path):
+    """Satellite regression: evicting a segment must invalidate cached
+    results whose answers included its rows."""
+    db = Database(data_dir=str(tmp_path), storage=True)
+    t = _fill_net(db, n=100, t0=6000)
+    db.flush_to_tier()
+    cache = QueryCache()
+    sql = "SELECT Sum(byte_tx) AS b FROM t"
+    full = sum(range(100))
+    assert cache.execute(t, sql).values == [[float(full)]]
+    assert cache.execute(t, sql).values == [[float(full)]]
+    assert cache.counters["hits"] == 1
+    jan = Janitor(db, ttl_s={t.name: 100})
+    assert jan.sweep_tier(now=1_000_000.0) == 100
+    # the token moved: no stale hit, and the answer reflects the drop
+    res = cache.execute(t, sql)
+    assert cache.counters["hits"] == 1
+    assert res.values in ([[None]], [[0.0]], [])
+
+
+def test_flush_gen_moves_cache_token(tmp_path):
+    from deepflow_tpu.query.cache import change_token
+    db = Database(data_dir=str(tmp_path), storage=True)
+    t = _fill_net(db, n=30)
+    tok = change_token(t)
+    db.flush_to_tier()  # same rows, different backing store
+    assert change_token(t) != tok
+
+
+# -- rollup datasources -----------------------------------------------------
+
+def _horizons(db, now_s):
+    job = RollupJob(db, lateness_s=0)
+    job.roll(now_s=now_s)
+    return job.horizons()
+
+
+def test_rollup_selection_equals_raw():
+    db = Database()
+    raw = db.table("flow_metrics.network.1s")
+    rows = []
+    for minute in (100, 101, 102):
+        for s in range(0, 60, 7):
+            rows.append(dict(_NET_ROW, time=minute * 60 + s,
+                             byte_tx=minute + s, packet_tx=2,
+                             ip_src=f"10.0.0.{s % 2}"))
+    raw.append_rows(rows)
+    horizons = _horizons(db, now_s=103 * 60)
+    sql = ("SELECT time(time, 60) AS m, ip_src, Sum(byte_tx) AS b, "
+           "Sum(packet_tx) AS p FROM t "
+           "WHERE time >= 6000 AND time < 6180 "
+           "GROUP BY time(time, 60), ip_src ORDER BY m, ip_src")
+    picked = qds.select_rollup(db, raw, S.parse(sql), horizons)
+    assert picked is not None
+    rtable, info = picked
+    assert info["tier"] == "1m"
+    assert rtable.name == "flow_metrics.network.1m"
+    # byte-identical: the decomposable algebra re-aggregates exactly
+    assert execute(rtable, sql).values == execute(raw, sql).values
+
+
+def test_rollup_selection_rejections():
+    db = Database()
+    raw = db.table("flow_metrics.network.1s")
+    raw.append_rows([dict(_NET_ROW, time=6000 + s, byte_tx=1)
+                     for s in range(0, 120, 5)])
+    horizons = _horizons(db, now_s=6180)
+
+    def sel(sql):
+        return qds.select_rollup(db, raw, S.parse(sql), horizons)
+
+    # eligible baseline
+    assert sel("SELECT Sum(byte_tx) AS b FROM t "
+               "WHERE time >= 6000 AND time < 6120") is not None
+    # no upper time bound: the window never closes under any horizon
+    assert sel("SELECT Sum(byte_tx) AS b FROM t "
+               "WHERE time >= 6000") is None
+    # mid-bucket bound would slice rolled buckets
+    assert sel("SELECT Sum(byte_tx) AS b FROM t "
+               "WHERE time >= 6000 AND time < 6090") is None
+    # upper bound past the completeness horizon: late rows missing
+    assert sel("SELECT Sum(byte_tx) AS b FROM t "
+               "WHERE time >= 6000 AND time < 9999960") is None
+    # Count() is not a rollup aggregator (rows collapse)
+    assert sel("SELECT Count() AS c FROM t "
+               "WHERE time >= 6000 AND time < 6120") is None
+    # org scoping: org_id is NOT a rollup tag, so scoped queries
+    # auto-reject (rolled rows collapse across orgs)
+    assert sel("SELECT Sum(byte_tx) AS b FROM t WHERE org_id = 3 "
+               "AND time >= 6000 AND time < 6120") is None
+    # row-level query: raw timestamps must survive
+    assert sel("SELECT time, byte_tx FROM t "
+               "WHERE time >= 6000 AND time < 6120") is None
+    # Avg's denominator is the ROW count, which rolling collapses
+    assert sel("SELECT Avg(byte_tx) AS a FROM t "
+               "WHERE time >= 6000 AND time < 6120") is None
+    # the decomposable ratio spelling stays selectable
+    assert sel("SELECT Sum(rtt_sum) / Sum(rtt_count) AS r FROM t "
+               "WHERE time >= 6000 AND time < 6120") is not None
+
+
+def test_rollup_1h_equals_raw_recompute():
+    db = Database()
+    raw = db.table("flow_metrics.network.1s")
+    rows = []
+    for h in (10, 11):
+        for m in range(0, 60, 13):
+            rows.append(dict(_NET_ROW, time=h * 3600 + m * 60,
+                             byte_tx=h * m + 1))
+    raw.append_rows(rows)
+    horizons = _horizons(db, now_s=13 * 3600)
+    sql = ("SELECT time(time, 3600) AS h, Sum(byte_tx) AS b FROM t "
+           "WHERE time >= 36000 AND time < 43200 "
+           "GROUP BY time(time, 3600) ORDER BY h")
+    picked = qds.select_rollup(db, raw, S.parse(sql), horizons)
+    assert picked is not None and picked[1]["tier"] == "1h"
+    assert execute(picked[0], sql).values == execute(raw, sql).values
+
+
+def test_sketch_percentile_within_gamma():
+    db = Database()
+    raw = db.table("flow_metrics.application.1s")
+    rng = np.random.default_rng(7)
+    vals = rng.integers(100, 1_000_000, size=300)
+    raw.append_rows([
+        {"time": 6000 + i // 3, "ip_src": "1.1.1.1", "ip_dst": "2.2.2.2",
+         "server_port": 443, "l7_protocol": 1, "app_service": "shop",
+         "request": 1, "rrt_sum": int(v), "rrt_count": 1,
+         "rrt_max": int(v)} for i, v in enumerate(vals)])
+    horizons = _horizons(db, now_s=6180)
+    sql = ("SELECT PERCENTILE(rrt_max, 95) AS p FROM t "
+           "WHERE time >= 6000 AND time < 6120")
+    got = qds.sketch_percentile(db, raw, S.parse(sql), horizons)
+    assert got is not None
+    res, info = got
+    assert info["approx"] == "ddsketch" and info["tier"] == "1m"
+    assert res.columns == ["p"]
+    exact = execute(raw, sql).values[0][0]
+    # DDSketch gamma=1.02 relative-error bound (plus rank-interp slack)
+    assert abs(res.values[0][0] - exact) / exact < 0.05
+    # grouped variant keys correctly
+    sql_g = ("SELECT app_service, PERCENTILE(rrt_max, 50) AS p FROM t "
+             "WHERE time >= 6000 AND time < 6120 GROUP BY app_service")
+    got = qds.sketch_percentile(db, raw, S.parse(sql_g), horizons)
+    assert got is not None
+    assert got[0].values[0][0] == "shop"
+
+
+def test_rollup_sketch_merges_upward():
+    """1m sketches merge into the 1h tier; the merged state answers the
+    same percentile the 1m states do (merge is exact on the sketch)."""
+    db = Database()
+    raw = db.table("flow_metrics.application.1s")
+    raw.append_rows([
+        {"time": 36000 + i * 60, "ip_src": "1.1.1.1", "ip_dst": "2.2.2.2",
+         "server_port": 443, "l7_protocol": 1, "app_service": "s",
+         "request": 1, "rrt_max": 1000 * (i + 1)} for i in range(60)])
+    job = RollupJob(db, lateness_s=0)
+    job.roll(now_s=14 * 3600)
+    h1 = db.table("flow_metrics.application.1h")
+    states = [v for v in
+              execute(h1, "SELECT rrt_max_sketch FROM t").values
+              if v[0]]
+    assert states, "1h tier carries merged sketch state"
+    from deepflow_tpu.cluster.sketch import HistogramSketch
+    sk = HistogramSketch.from_dict(json.loads(states[0][0]))
+    assert sk.count == 60
+
+
+# -- durability gate --------------------------------------------------------
+
+def test_gate_release_only_after_commit(tmp_path):
+    db = Database(data_dir=str(tmp_path), storage=True)
+    _fill_net(db, n=10)
+    gate = DurabilityGate()
+    tracker = SeqAckTracker()
+    tracker.seed(7, -1)
+    for seq in range(3):
+        gate.add(7, seq)
+    fl = Flusher(db, gate=gate, seq_tracker=tracker)
+    assert tracker.contiguous(7) == -1  # parked, not acked
+    assert fl.flush_once() == 10
+    assert tracker.contiguous(7) == 2  # released after the commit
+    assert len(gate) == 0
+    # the same rename persisted the floors: a SIGKILL now re-acks
+    ts = TieredStore(os.path.join(str(tmp_path), "segments"))
+    ts.recover()
+    assert ts.ack_floors == {7: 2}
+
+
+def test_group_commit_seals_only_for_pending_acks(tmp_path):
+    """The flusher's group-commit fast path: a cycle with no acks
+    waiting must not chop the open stripe buffers into per-interval
+    sliver chunks — the rows stay in RAM until a chunk seals naturally
+    or durability is actually owed."""
+    db = Database(data_dir=str(tmp_path), storage=True)
+    t = _fill_net(db, n=50)
+    fl = Flusher(db, gate=DurabilityGate())
+    assert fl.flush_once() == 0  # empty gate: nothing owed, no seal
+    assert len(t) == 50          # rows still served from RAM
+    snap = db.tier_store.snapshot()["tables"]
+    assert snap.get("flow_metrics.network.1s", {}).get("rows", 0) == 0
+    fl.gate.add(9, 0)            # now an ack waits on durability
+    assert fl.flush_once() == 50
+    snap = db.tier_store.snapshot()["tables"]
+    assert snap["flow_metrics.network.1s"]["rows"] == 50
+    assert len(t) == 50
+
+
+def test_gate_requeues_on_commit_failure(tmp_path, monkeypatch):
+    db = Database(data_dir=str(tmp_path), storage=True)
+    _fill_net(db, n=5)
+    gate = DurabilityGate()
+    tracker = SeqAckTracker()
+    tracker.seed(3, -1)
+    gate.add(3, 0)
+    fl = Flusher(db, gate=gate, seq_tracker=tracker)
+    monkeypatch.setattr(db, "flush_to_tier",
+                        lambda ack_floors=None, seal=True,
+                        compress=True: 1 / 0)
+    with pytest.raises(ZeroDivisionError):
+        fl.flush_once()
+    assert len(gate) == 1  # stays gated: the rows are not durable
+    assert tracker.contiguous(3) == -1
+
+
+# -- multi-lane receiver ----------------------------------------------------
+
+def test_receiver_lane_fanout():
+    from deepflow_tpu.codec import MessageType
+
+    recv = Receiver(port=0, enable_udp=False)
+    qs = recv.register(MessageType.L4_LOG, lanes=3)
+    assert isinstance(qs, list) and len(qs) == 3
+    # connection lanes round-robin; one connection -> one queue
+    assert recv._lane_q(qs, 0) is qs[0]
+    assert recv._lane_q(qs, 1) is qs[1]
+    assert recv._lane_q(qs, 4) is qs[1]
+    # single-lane registration keeps the scalar contract
+    q = recv.register(MessageType.PROFILE, lanes=1)
+    assert not isinstance(q, list)
+    assert recv._lane_q(q, 9) is q
+
+
+def test_receiver_lane_dispatch_preserves_order():
+    from deepflow_tpu.codec import FrameHeader, MessageType
+
+    recv = Receiver(port=0, enable_udp=False)
+    qs = recv.register(MessageType.L4_LOG, lanes=2)
+
+    def hdr(agent, seq):
+        return FrameHeader(MessageType.L4_LOG, agent_id=agent, seq=seq)
+
+    # two connections, one per agent, pinned to different lanes
+    recv._dispatch_many([(hdr(1, s), b"a%d" % s) for s in range(4)],
+                        lane=0)
+    recv._dispatch_many([(hdr(2, s), b"b%d" % s) for s in range(4)],
+                        lane=1)
+    _, group0 = qs[0].get_nowait()
+    _, group1 = qs[1].get_nowait()
+    assert [h.seq for h, _ in group0] == [0, 1, 2, 3]
+    assert all(h.agent_id == 1 for h, _ in group0)
+    assert [h.seq for h, _ in group1] == [0, 1, 2, 3]
+    assert all(h.agent_id == 2 for h, _ in group1)
+    assert qs[0].empty() and qs[1].empty()
+
+
+# -- spool age retention ----------------------------------------------------
+
+def test_spool_age_eviction(tmp_path):
+    from deepflow_tpu.agent.spool import Spool
+
+    evicted = []
+    sp = Spool(str(tmp_path), segment_bytes=4096, max_age_s=100,
+               on_evict=lambda n, reason: evicted.append((n, reason)))
+    payload = b"x" * 2000
+    for seq in range(1, 7):  # rotates across several segments
+        sp.append(1, seq, payload)
+    assert len(sp._segments) > 2
+    # age the closed segments far past the cutoff
+    for seg in sp._segments[:-1]:
+        seg.mtime -= 10_000
+    sp.append(1, 7, payload)
+    assert evicted and all(r == "spool_age_evict" for _, r in evicted)
+    # the open writer survives regardless of age
+    assert sp.pending_records() >= 1
+    assert sp.max_seq() == 7
+    sp.close()
