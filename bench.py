@@ -664,6 +664,65 @@ def _bench_query() -> dict:
     return out
 
 
+def _bench_query_parallel() -> dict:
+    """query_parallel arm: the SAME aggregate GROUP BY serial vs
+    morsel-parallel on the shared scan pool (GIL-released native
+    kernels carry the concurrency). Byte-identity is asserted — the
+    speedup only counts if the answers match — and the >=3x floor is
+    gated only where the hardware can express it (>=4 cores); on
+    smaller hosts the ratio ships ungated for trend tracking."""
+    import numpy as np
+    from deepflow_tpu.query import engine
+    from deepflow_tpu.store.db import Database
+
+    n = 1_200_000
+    t = Database().table("flow_log.l7_flow_log")
+    i = np.arange(n, dtype=np.uint64)
+    t.append_columns(
+        {"time": 1_754_000_000_000_000_000 + i * 1_000_000,
+         "l7_protocol": (i % 7).astype(np.uint8),
+         "response_duration": (i * 37) % 5_000}, n=n)
+    sql = ("SELECT l7_protocol, Sum(response_duration) AS s, "
+           "Count(*) AS c, Max(response_duration) AS mx "
+           "FROM l7_flow_log GROUP BY l7_protocol ORDER BY l7_protocol")
+    threads = os.cpu_count() or 1
+
+    def timed(env: dict):
+        saved = {k: os.environ.get(k) for k in env}
+        try:
+            for k, v in env.items():
+                os.environ[k] = v
+            times, vals = [], None
+            for _ in range(5):
+                t0 = time.perf_counter()
+                vals = engine.execute(t, sql).values
+                times.append(time.perf_counter() - t0)
+            return min(times), vals
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    serial_s, serial_vals = timed({"DF_QUERY_PARALLEL": "0",
+                                   "DF_QUERY_THREADS": "1"})
+    par_s, par_vals = timed({"DF_QUERY_PARALLEL": "1",
+                             "DF_QUERY_THREADS": str(threads)})
+    speedup = round(serial_s / max(par_s, 1e-9), 2)
+    return {
+        "query_parallel": {
+            "rows": n, "threads": threads,
+            "serial_ms": round(serial_s * 1e3, 2),
+            "parallel_ms": round(par_s * 1e3, 2),
+            "speedup": speedup},
+        "query_parallel_matches_serial": par_vals == serial_vals,
+        "query_parallel_below_target":
+            (not (par_vals == serial_vals))
+            or (threads >= 4 and speedup < 3.0),
+    }
+
+
 def _bench_storage() -> dict:
     """Tiered-storage arm: flush throughput into on-disk columnar
     segments, cold-mmap vs warm scans over a recovered tier, the
@@ -1083,6 +1142,7 @@ def main() -> None:
     cpu_detail.update(_bench_steps())
     cpu_detail.update(_bench_federation())
     cpu_detail.update(_bench_query())
+    cpu_detail.update(_bench_query_parallel())
     cpu_detail.update(_bench_storage())
     cpu_detail.update(_bench_extprofiler())
     # perf guards (VERDICT r03 item 5 / r04 item 8): a regression must be

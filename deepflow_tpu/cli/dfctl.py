@@ -130,6 +130,10 @@ def main(argv: list[str] | None = None) -> int:
                             "rollup completeness horizons")
     p_ds.add_argument("--json", action="store_true",
                       help="raw /v1/health storage block JSON")
+    p_ds.add_argument("--zones", action="store_true",
+                      help="add a ZONES column: segments carrying "
+                           "zone-map footers (prunable), as "
+                           "zoned/total")
 
     p_org = sub.add_parser("org", help="org/team scoping: assign agent "
                                        "groups to orgs, list assignments")
@@ -535,14 +539,23 @@ def main(argv: list[str] | None = None) -> int:
             rows = []
             for name, v in sorted(tables.items()):
                 sfx = name.rsplit(".", 1)[-1]
-                rows.append([
+                row = [
                     name, sfx if sfx in tiers else "raw",
                     v["segments"], v["rows"], v["bytes"],
                     v["tmin"] if v["tmin"] is not None else "-",
-                    v["tmax"] if v["tmax"] is not None else "-"])
+                    v["tmax"] if v["tmax"] is not None else "-"]
+                if args.zones:
+                    # pre-zone-map segments stay readable but never
+                    # prune; the ratio shows rewrite progress
+                    row.append(f"{v.get('zoned_segments', 0)}"
+                               f"/{v['segments']}")
+                rows.append(row)
+            hdr = ["TABLE", "TIER", "SEGMENTS", "ROWS", "BYTES",
+                   "TMIN", "TMAX"]
+            if args.zones:
+                hdr.append("ZONES")
             print()
-            print_table(["TABLE", "TIER", "SEGMENTS", "ROWS", "BYTES",
-                         "TMIN", "TMAX"], rows)
+            print_table(hdr, rows)
         else:
             print("(no segments on disk yet)")
         horizons = st.get("rollup_horizons", {})

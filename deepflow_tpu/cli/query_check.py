@@ -157,6 +157,111 @@ def _cache_report(t) -> None:
           f"{qc.counters['bucket_hits']}")
 
 
+def _pruning() -> None:
+    """Zone-map gate: a 5% time slice of a 100-segment table must prune
+    >=90% of the segments, proven by the scan ledger, and still answer
+    exactly."""
+    import tempfile
+
+    import numpy as np
+
+    from deepflow_tpu.query import engine
+    from deepflow_tpu.store.db import Database
+
+    nseg, per = 100, 400
+    with tempfile.TemporaryDirectory() as d:
+        db = Database(data_dir=d, storage=True)
+        t = db.table("flow_log.l7_flow_log")
+        for k in range(nseg):
+            t.append_columns(
+                {"time": np.arange(per, dtype=np.uint64) + k * 1000,
+                 "app_service": f"svc-{k:03d}",
+                 "response_duration": np.full(per, k, dtype=np.uint64)},
+                n=per)
+            if db.flush_to_tier() == 0:
+                _fail("pruning arm: flush wrote no rows")
+        # 5 of 100 segment spans overlap [90_000, 95_000)
+        sql = ("SELECT Sum(response_duration) AS s, Count(*) AS c "
+               "FROM l7_flow_log WHERE time >= 90000 AND time < 95000")
+        before = engine.scan_stats()
+        res = engine.execute(t, sql)
+        after = engine.scan_stats()
+        pruned = after["pruned_segments"] - before["pruned_segments"]
+        scanned = after["scanned_segments"] - before["scanned_segments"]
+        if scanned + pruned != nseg:
+            _fail(f"pruning arm: ledger saw {scanned + pruned} segments, "
+                  f"expected {nseg}")
+        if pruned < int(0.9 * nseg):
+            _fail(f"pruning arm: only {pruned}/{nseg} segments pruned "
+                  f"for a 5% time slice (need >=90)")
+        want = [[float(sum(k * per for k in range(90, 95))),
+                 float(5 * per)]]
+        if _canon(res.values) != _canon(want):
+            _fail(f"pruning arm: wrong answer {res.values} != {want}")
+        print(f"query-check: pruning {pruned}/{nseg} segments skipped "
+              f"on a 5% time slice, answer exact: OK")
+
+
+def _parallel() -> None:
+    """Morsel-parallel gate: byte-identity always; the >=3x speedup
+    floor only where the hardware can express it (>=4 cores)."""
+    import numpy as np
+
+    from deepflow_tpu.query import engine
+    from deepflow_tpu.store.db import Database
+
+    n = 1_200_000
+    t = Database().table("flow_log.l7_flow_log")
+    i = np.arange(n, dtype=np.uint64)
+    t.append_columns(
+        {"time": 1_600_000_000_000_000_000 + i * 1_000_000,
+         "l7_protocol": (i % 7).astype(np.uint8),
+         "response_code": np.where(i % 97 == 0, 500, 200).astype(np.uint16),
+         "response_duration": (i * 37) % 5_000}, n=n)
+    sql = ("SELECT l7_protocol, Sum(response_duration) AS s, "
+           "Count(*) AS c, Max(response_duration) AS mx "
+           "FROM l7_flow_log GROUP BY l7_protocol ORDER BY l7_protocol")
+
+    def _timed(env: dict) -> tuple[float, dict]:
+        saved = {k: os.environ.get(k) for k in env}
+        try:
+            for k, v in env.items():
+                os.environ[k] = v
+            best, out = float("inf"), None
+            for _ in range(5):
+                t0 = time.perf_counter()
+                r = engine.execute(t, sql)
+                best = min(best, time.perf_counter() - t0)
+                out = _canon({"columns": r.columns, "values": r.values})
+            return best, out
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    serial_s, serial = _timed({"DF_QUERY_PARALLEL": "0",
+                               "DF_QUERY_THREADS": "1"})
+    threads = os.cpu_count() or 1
+    par_s, par = _timed({"DF_QUERY_PARALLEL": "1",
+                         "DF_QUERY_THREADS": str(threads)})
+    if par != serial:
+        _fail("parallel path diverges from serial (byte-identity)")
+    speedup = serial_s / max(par_s, 1e-9)
+    if threads >= 4:
+        if speedup < 3.0:
+            _fail(f"parallel speedup {speedup:.2f}x < 3x floor on "
+                  f"{threads} cores (serial {serial_s * 1e3:.1f}ms, "
+                  f"parallel {par_s * 1e3:.1f}ms)")
+        verdict = "OK (>=3x floor)"
+    else:
+        verdict = f"floor skipped ({threads} cores < 4)"
+    print(f"query-check: parallel byte-identity over {n} rows: OK — "
+          f"serial {serial_s * 1e3:.1f}ms, parallel {par_s * 1e3:.1f}ms "
+          f"({speedup:.2f}x, {verdict})")
+
+
 def _federated(rows: list[dict]) -> None:
     from deepflow_tpu.server import Server
     servers: list = []
@@ -222,6 +327,8 @@ def main() -> int:
     t = _make_table(rows)
     _parity(t)
     _cache_report(t)
+    _pruning()
+    _parallel()
     _federated(rows[:3_000])
     print("query-check: PASS")
     return 0

@@ -234,18 +234,26 @@ class ClaimTableView:
         self._shard = int(self_shard)
         self._alive = set(alive)
 
+    def _claim(self, ch):
+        agents = ch.get("agent_id") if ch else None
+        epochs = ch.get("ring_epoch") if ch else None
+        if agents is None or epochs is None:
+            return ch
+        m = self._ring.claim_mask(agents, epochs, self._shard,
+                                  self._alive)
+        return ch if m.all() else {k: v[m] for k, v in ch.items()}
+
     def snapshot(self) -> list:
-        out = []
-        for ch in self._table.snapshot():
-            agents = ch.get("agent_id") if ch else None
-            epochs = ch.get("ring_epoch") if ch else None
-            if agents is None or epochs is None:
-                out.append(ch)
-                continue
-            m = self._ring.claim_mask(agents, epochs, self._shard,
-                                      self._alive)
-            out.append(ch if m.all() else {k: v[m] for k, v in ch.items()})
-        return out
+        return [self._claim(ch) for ch in self._table.snapshot()]
+
+    def scan_units(self) -> list:
+        """Claim-filtered scan units. MUST be overridden here, not left
+        to __getattr__ delegation: the engine scans through scan_units,
+        and the raw table's units would leak replica copies. A segment's
+        zone map stays attached — zones are necessary conditions over
+        the full chunk, so they remain sound for the claimed subset."""
+        return [(self._claim(ch), z)
+                for ch, z in self._table.scan_units()]
 
     def column_concat(self, names, mask_chunks=None, chunks=None):
         if chunks is None:

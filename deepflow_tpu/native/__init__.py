@@ -16,7 +16,7 @@ log = logging.getLogger("df.native")
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_DIR, "libdfnative.so")
 _lib = None
-_ABI_VERSION = 6  # must match df_abi_version() in dfnative.cpp
+_ABI_VERSION = 7  # must match df_abi_version() in dfnative.cpp
 
 
 def _build() -> bool:
@@ -171,6 +171,12 @@ def load():
         np.ctypeslib.ndpointer(np.uint32), ctypes.c_uint64,
         np.ctypeslib.ndpointer(np.uint32), ctypes.c_uint64,
         np.ctypeslib.ndpointer(np.uint8)]
+    lib.df_qx_agg_f64.argtypes = [
+        np.ctypeslib.ndpointer(np.float64),
+        np.ctypeslib.ndpointer(np.uint64),           # order
+        np.ctypeslib.ndpointer(np.uint64),           # bounds (n_groups+1)
+        ctypes.c_uint64, ctypes.c_int32,
+        np.ctypeslib.ndpointer(np.float64)]          # out
     _lib = lib
     return lib
 
@@ -720,6 +726,31 @@ def qx_group(key_cols: list[np.ndarray]):
     if ng < 0:
         return None
     return order.astype(np.int64), bounds[:ng + 1].astype(np.int64), int(ng)
+
+
+def qx_agg_f64(vals: np.ndarray, order: np.ndarray, bounds: np.ndarray,
+               op: int):
+    """Fused gather + segmented reduce: out[g] = op(vals[order[i]]) over
+    [bounds[g], bounds[g+1]). op: 0=sum, 1=min, 2=max. Accumulates
+    sequentially per group — bit-identical to ufunc.reduceat over the
+    gathered array — and releases the GIL, so the morsel scan pool gets
+    real concurrency out of it. Returns None when unavailable (caller
+    falls back to numpy)."""
+    lib = load()
+    if lib is None:
+        return None
+    n_groups = len(bounds) - 1
+    if n_groups < 0:
+        return None
+    order64 = (order.view(np.uint64)
+               if order.dtype == np.int64 and order.flags.c_contiguous
+               else np.ascontiguousarray(order, dtype=np.uint64))
+    bounds64 = (bounds.view(np.uint64)
+                if bounds.dtype == np.int64 and bounds.flags.c_contiguous
+                else np.ascontiguousarray(bounds, dtype=np.uint64))
+    out = np.empty(n_groups, dtype=np.float64)
+    lib.df_qx_agg_f64(vals, order64, bounds64, n_groups, op, out)
+    return out
 
 
 def qx_isin_u32(col: np.ndarray, ids: np.ndarray):

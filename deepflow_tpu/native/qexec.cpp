@@ -98,6 +98,38 @@ int64_t df_qx_group(const int64_t* const* keys, uint32_t n_keys,
     return (int64_t)n_groups;
 }
 
+// Fused gather + segmented reduce: out[g] = op over vals[order[i]] for
+// i in [bounds[g], bounds[g+1]). op: 0=sum, 1=min, 2=max. Replaces the
+// engine's gather-copy + ufunc.reduceat; accumulation is sequential in
+// group order, so results are bit-identical to the numpy path (sum is
+// left-to-right, min/max propagate NaN exactly like np.minimum/maximum).
+// Releases the GIL via ctypes — the morsel pool's scan workers run this
+// concurrently.
+void df_qx_agg_f64(const double* vals, const uint64_t* order,
+                   const uint64_t* bounds, uint64_t n_groups,
+                   int32_t op, double* out) {
+    for (uint64_t g = 0; g < n_groups; g++) {
+        const uint64_t s = bounds[g], e = bounds[g + 1];
+        if (s >= e) { out[g] = 0.0; continue; }
+        double acc = vals[order[s]];
+        if (op == 0) {
+            for (uint64_t i = s + 1; i < e; i++) acc += vals[order[i]];
+        } else if (op == 1) {
+            for (uint64_t i = s + 1; i < e; i++) {
+                const double v = vals[order[i]];
+                // mirror np.minimum: NaN in either operand propagates
+                if (v < acc || v != v) acc = v;
+            }
+        } else {
+            for (uint64_t i = s + 1; i < e; i++) {
+                const double v = vals[order[i]];
+                if (v > acc || v != v) acc = v;
+            }
+        }
+        out[g] = acc;
+    }
+}
+
 // mask[i] = 1 iff col[i] is in `set` (hash set, O(n + n_set)) — the
 // dictionary-id IN / LIKE-pushdown filter. np.isin is sort-based
 // O(n log n_set); this is the encoded-predicate fast path.

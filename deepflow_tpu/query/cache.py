@@ -47,6 +47,7 @@ import time
 from collections import OrderedDict
 
 from deepflow_tpu.query import engine
+from deepflow_tpu.query import pool as qpool
 from deepflow_tpu.query import sql as S
 from deepflow_tpu.query.costmodel import KernelCostModel
 
@@ -209,24 +210,39 @@ class QueryCache:
             for b in [b for b in store if b not in marks]:
                 del store[b]
                 self.counters["bucket_pruned"] += 1
-        parts = []
-        for b, mark in sorted(marks.items()):
+        ordered = sorted(marks.items())
+        slot: dict[int, dict] = {}
+        stale: list[tuple[int, int]] = []
+        for b, mark in ordered:
             with self._lock:
                 ent = store.get(b)
             if ent is not None and ent[0] == mark and ent[1] == gens:
                 with self._lock:
                     self.counters["bucket_hits"] += 1
-                parts.append(ent[2])
-                continue
-            bq = self._bucket_query(query, tc, b * div, (b + 1) * div)
-            p = engine.execute_partial(table, bq, encoded=True)
-            if p.get("kind") != "agg":
-                return None
-            with self._lock:
-                self.counters["bucket_misses"] += 1
-                store[b] = (mark, gens, p)
-            parts.append(p)
-        return parts
+                slot[b] = ent[2]
+            else:
+                stale.append((b, mark))
+        if stale:
+            def _scan(bm):
+                b, _mark = bm
+                bq = self._bucket_query(query, tc, b * div, (b + 1) * div)
+                return engine.execute_partial(table, bq, encoded=True)
+            # stale buckets recompute on the shared scan pool (each
+            # bucket's execute_partial runs serially inside its worker —
+            # the in_worker guard stops nested fan-out)
+            p = qpool.get_pool()
+            if p is not None and len(stale) > 1:
+                outs = p.map(_scan, stale)
+            else:
+                outs = [_scan(bm) for bm in stale]
+            for (b, mark), part in zip(stale, outs):
+                if part.get("kind") != "agg":
+                    return None
+                with self._lock:
+                    self.counters["bucket_misses"] += 1
+                    store[b] = (mark, gens, part)
+                slot[b] = part
+        return [slot[b] for b, _m in ordered]
 
     @staticmethod
     def _bucket_query(query: S.Select, tc: str, lo: int,

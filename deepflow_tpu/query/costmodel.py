@@ -10,6 +10,10 @@ cost per kernel and pick the cheapest prediction. Two consumers:
   cardinality-dependent, so it is learned, not guessed).
 - query/cache.py: cache admission — a query whose observed cold cost is
   below the admission floor is not worth an entry.
+- query/engine.py again: serial vs morsel-parallel scan degree — the
+  parallel kernel's fixed overhead term is seeded with the pool dispatch
+  cost, so small queries keep choosing the serial plan without a
+  hand-tuned row threshold.
 
 Deliberately tiny: EWMA ns/row + a fixed per-call overhead term per
 kernel, with periodic exploration so a kernel whose relative cost
@@ -72,4 +76,6 @@ class KernelCostModel:
             return {"calls": self.calls,
                     "ns_per_row": {k: (round(v, 2) if v is not None
                                        else None)
-                                   for k, v in self.coef.items()}}
+                                   for k, v in self.coef.items()},
+                    "overhead_ns": {k: round(v, 1)
+                                    for k, v in self.overhead.items()}}

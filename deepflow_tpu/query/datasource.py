@@ -92,10 +92,10 @@ def _collect_nonagg_cols(e, out: set) -> None:
             _collect_nonagg_cols(e.default, out)
 
 
-def _conjuncts(e) -> list:
-    if isinstance(e, S.BinOp) and e.op == "AND":
-        return _conjuncts(e.left) + _conjuncts(e.right)
-    return [e]
+# top-level AND flattening is shared with the engine's zone-map
+# constraint extraction — one definition of "conjunct" for both the
+# rollup classifier and segment pruning
+_conjuncts = qengine.split_conjuncts
 
 
 def _time_bound(e):

@@ -18,12 +18,14 @@ from __future__ import annotations
 
 import os
 import re
+import threading
 import time
 from dataclasses import dataclass
 
 import numpy as np
 
 from deepflow_tpu import native
+from deepflow_tpu.query import pool as qpool
 from deepflow_tpu.query import sql as S
 from deepflow_tpu.query.costmodel import KernelCostModel
 from deepflow_tpu.store.table import ColumnarTable
@@ -32,6 +34,25 @@ from deepflow_tpu.store.table import ColumnarTable
 # Initial overheads seed the choice before observations exist (ctypes
 # marshalling makes the native call more expensive per invocation).
 _COST = KernelCostModel(overhead_ns={"native": 15_000.0, "numpy": 2_000.0})
+
+# Serial vs morsel-parallel scan degree. The parallel kernel pays pool
+# dispatch plus a partial-combine pass, seeded here as fixed overhead so
+# small queries keep choosing the serial plan before any observation
+# exists; the coefficients are then learned per machine like _COST's.
+_DEGREE = KernelCostModel(kernels=("serial", "parallel"),
+                          overhead_ns={"parallel": 500_000.0})
+
+_MORSEL_ROWS = 1 << 16  # fixed-row morsel size (docs/QUERY.md)
+
+
+def _morsel_rows() -> int:
+    env = os.environ.get("DF_QUERY_MORSEL_ROWS", "").strip()
+    if env:
+        try:
+            return max(256, int(env))
+        except ValueError:
+            pass
+    return _MORSEL_ROWS
 
 
 @dataclass
@@ -315,6 +336,27 @@ class _Env:
 
 # -- aggregation ------------------------------------------------------------
 
+_SEG_OPS = {"SUM": 0, "MIN": 1, "MAX": 2}
+
+
+def _group_reduce(name: str, af: np.ndarray, order: np.ndarray,
+                  bounds_full: np.ndarray) -> np.ndarray:
+    """Fused gather + segmented reduce over float64 values. The native
+    kernel (df_qx_agg_f64) accumulates sequentially within each group —
+    exactly what ufunc.reduceat over the gathered array does — so the
+    two paths are bit-identical, and the native one releases the GIL,
+    which is where the morsel pool's parallelism actually comes from."""
+    if len(bounds_full) <= 1:
+        return np.empty(0, dtype=np.float64)
+    out = native.qx_agg_f64(np.ascontiguousarray(af, dtype=np.float64),
+                            order, bounds_full, _SEG_OPS[name])
+    if out is not None:
+        return out
+    g = af.astype(np.float64)[order]
+    ufn = {"SUM": np.add, "MIN": np.minimum, "MAX": np.maximum}[name]
+    return ufn.reduceat(g, bounds_full[:-1])
+
+
 def _agg_eval(e, env: _Env, order: np.ndarray, bounds: np.ndarray) -> _Val:
     """Evaluate expr containing aggregates; per-group output.
 
@@ -353,17 +395,19 @@ def _agg_eval(e, env: _Env, order: np.ndarray, bounds: np.ndarray) -> _Val:
         if v.kind in ("str", "enum", "obj") and e.name != "LAST":
             raise QueryError(
                 f"{e.name} over string column {S.expr_name(arg)!r}")
-        a = v.arr.astype(np.float64)[order]
+        af = v.arr.astype(np.float64)
+        bounds_full = np.append(starts, len(order))
         if e.name == "SUM":
-            return _Val(np.add.reduceat(a, starts) if len(a) else a)
+            return _Val(_group_reduce("SUM", af, order, bounds_full))
         if e.name == "AVG":
-            s = np.add.reduceat(a, starts) if len(a) else a
+            s = _group_reduce("SUM", af, order, bounds_full)
             n = (ends - starts)
             return _Val(s / np.maximum(n, 1))
         if e.name == "MIN":
-            return _Val(np.minimum.reduceat(a, starts) if len(a) else a)
+            return _Val(_group_reduce("MIN", af, order, bounds_full))
         if e.name == "MAX":
-            return _Val(np.maximum.reduceat(a, starts) if len(a) else a)
+            return _Val(_group_reduce("MAX", af, order, bounds_full))
+        a = af[order]
         if e.name == "LAST":
             out = a[ends - 1] if len(a) else a
             v2 = _Val(out, v.kind, labels=v.labels)
@@ -475,10 +519,156 @@ def _normalize(table: ColumnarTable, query: S.Select) -> S.Select:
                     limit=query.limit)
 
 
-def _materialize(table: ColumnarTable, query: S.Select,
-                 extra_cols: set[str] | None = None) -> tuple[_Env, int]:
-    """WHERE-filter the chunks and materialize every referenced column
-    into one _Env. extra_cols: additional columns the caller needs (the
+# -- zone-map segment pruning ------------------------------------------------
+#
+# Segment footers carry per-column [zmin, zmax] over the ENCODED values
+# (store/segment.py). A WHERE clause is lowered to per-column closed
+# intervals over that same encoded space — string literals via
+# dictionary lookup, enum labels via index — and a segment whose zone is
+# disjoint from any interval provably holds no matching row, so its
+# mmap is never touched. Only top-level AND conjuncts of the forms
+# `col <op> literal` / `col IN (...)` yield intervals; anything else
+# simply doesn't prune, which is always sound.
+
+_SCAN_LOCK = threading.Lock()
+_SCAN_STATS = {"scanned_segments": 0, "pruned_segments": 0}
+_SCAN_HOP = None
+
+
+def set_scan_telemetry(telemetry) -> None:
+    """Wire the query.scan hop ledger (emitted=candidate segments,
+    delivered=scanned, dropped=pruned): pruning must be observable from
+    /v1/health, never inferred from timings."""
+    global _SCAN_HOP
+    _SCAN_HOP = telemetry.hop("query.scan") if telemetry else None
+
+
+def scan_stats() -> dict:
+    with _SCAN_LOCK:
+        return dict(_SCAN_STATS)
+
+
+def _note_scan(candidates: int, pruned: int) -> None:
+    if not candidates:
+        return
+    with _SCAN_LOCK:
+        _SCAN_STATS["scanned_segments"] += candidates - pruned
+        _SCAN_STATS["pruned_segments"] += pruned
+    hop = _SCAN_HOP
+    if hop is not None:
+        hop.account(emitted=candidates, delivered=candidates - pruned,
+                    dropped=pruned, reason="pruned")
+
+
+def split_conjuncts(e) -> list:
+    """Flatten top-level ANDs into conjuncts (shared with the rollup
+    datasource's time-window classifier)."""
+    if isinstance(e, S.BinOp) and e.op == "AND":
+        return split_conjuncts(e.left) + split_conjuncts(e.right)
+    return [e]
+
+
+_NO_ROW = object()   # literal provably matches no row (absent string)
+_NEVER_CON = (None, 0, 0)  # constraint entry: WHERE matches nothing
+
+
+def _zone_coerce(table: ColumnarTable, col: str, value):
+    """Literal -> the column's encoded number space. None = not
+    comparable against zones; _NO_ROW = provably matches no row. Ints
+    stay ints (u64 timestamps exceed float53 precision — a rounded
+    bound could prune a segment that holds matching rows)."""
+    spec = table.columns[col]
+    if isinstance(value, str):
+        if spec.kind == "str":
+            sid = table.dicts[col].lookup(value)
+            return _NO_ROW if sid is None else int(sid)
+        if spec.kind == "enum":
+            try:
+                return spec.enum_values.index(value)
+            except ValueError:
+                return _NO_ROW
+        return None
+    if isinstance(value, bool):
+        return int(value)
+    if isinstance(value, (int, float)):
+        # numeric literals compare against encoded ids numerically in
+        # _Env._coerce_lit, so the raw value is the encoded-space bound
+        return value
+    return None
+
+
+def _zone_constraints(table: ColumnarTable, where) -> list[tuple]:
+    """-> [(col, lo, hi)] closed-interval NECESSARY conditions; lo/hi
+    None = unbounded on that side; col None = the WHERE provably
+    matches nothing (equality against an absent dictionary string).
+    `<` / `>` widen to `<=` / `>=` — conservative, still sound."""
+    cons: list[tuple] = []
+    for c in split_conjuncts(where):
+        if not (isinstance(c, S.BinOp) and isinstance(c.left, S.Col)
+                and c.left.name in table.columns):
+            continue
+        col = c.left.name
+        if c.op == "IN" and isinstance(c.right, tuple) and c.right:
+            vals, dead, skip = [], False, False
+            for lit in c.right:
+                if not isinstance(lit, S.Lit):
+                    skip = True
+                    break
+                v = _zone_coerce(table, col, lit.value)
+                if v is None:
+                    skip = True
+                    break
+                if v is _NO_ROW:
+                    dead = True
+                else:
+                    vals.append(v)
+            if skip:
+                continue
+            if vals:
+                cons.append((col, min(vals), max(vals)))
+            elif dead:
+                cons.append(_NEVER_CON)
+            continue
+        if (c.op not in ("=", "<", "<=", ">", ">=")
+                or not isinstance(c.right, S.Lit)):
+            continue
+        v = _zone_coerce(table, col, c.right.value)
+        if v is None:
+            continue
+        if v is _NO_ROW:
+            if c.op == "=":
+                cons.append(_NEVER_CON)
+            continue
+        if c.op == "=":
+            cons.append((col, v, v))
+        elif c.op in ("<", "<="):
+            cons.append((col, None, v))
+        else:
+            cons.append((col, v, None))
+    return cons
+
+
+def _zone_pruned(zones: dict | None, cons: list) -> bool:
+    """True when the unit provably holds no matching row. Units without
+    zones (live RAM chunks, pre-zone segments sans time span) only prune
+    on the WHERE-matches-nothing sentinel."""
+    for col, lo, hi in cons:
+        if col is None:
+            return True
+        zb = (zones or {}).get(col)
+        if zb is None:
+            continue
+        zmin, zmax = zb
+        if (lo is not None and zmax < lo) or \
+                (hi is not None and zmin > hi):
+            return True
+    return False
+
+
+def _needed_cols(table: ColumnarTable, query: S.Select,
+                 extra_cols: set[str] | None = None) -> set[str]:
+    """Every store column the query references, validated against the
+    schema. extra_cols: additional columns the caller needs (the
     federated LAST merge wants `time` alongside the value)."""
     needed: set[str] = set(extra_cols or ())
     for item in query.items:
@@ -499,9 +689,38 @@ def _materialize(table: ColumnarTable, query: S.Select,
     unknown = needed - set(table.columns)
     if unknown:
         raise QueryError(f"unknown columns {sorted(unknown)} in {table.name}")
+    return needed
+
+
+def _scan_plan(table: ColumnarTable, query: S.Select) -> list[dict]:
+    """One scan's chunk list, zone-pruned and accounted to the ledger.
+    Shared by the serial and morsel-parallel paths, so both skip the
+    same segments and the pruning counters mean the same thing."""
+    units = table.scan_units()
+    cons = (_zone_constraints(table, query.where)
+            if query.where is not None else [])
+    chunks = []
+    zoned = pruned = 0
+    for ch, zones in units:
+        if zones is not None:
+            zoned += 1
+        if cons and _zone_pruned(zones, cons):
+            if zones is not None:
+                pruned += 1
+            continue
+        chunks.append(ch)
+    _note_scan(zoned, pruned)
+    return chunks
+
+
+def _materialize(table: ColumnarTable, query: S.Select,
+                 extra_cols: set[str] | None = None) -> tuple[_Env, int]:
+    """WHERE-filter the (zone-pruned) chunks and materialize every
+    referenced column into one _Env."""
+    needed = _needed_cols(table, query, extra_cols)
 
     # filter per chunk, then materialize needed columns
-    chunks = table.snapshot()
+    chunks = _scan_plan(table, query)
     chunk_sizes = [len(next(iter(ch.values()))) if ch else 0 for ch in chunks]
     if query.where is not None:
         masks = []
@@ -718,12 +937,157 @@ def _finish_columnar(query: S.Select, names: list[str],
     return QueryResult(columns=names, values=rows)
 
 
+# -- morsel-parallel scan ----------------------------------------------------
+
+def _int_exact(table: ColumnarTable, e) -> bool:
+    """True when the expression is guaranteed integer-valued, so
+    re-adding per-morsel float64 partial sums is bit-exact regardless
+    of the split (the argument PR 7 made for federated SUM/AVG)."""
+    if isinstance(e, S.Lit):
+        return isinstance(e.value, (bool, int))
+    if isinstance(e, S.Col):
+        spec = table.columns.get(e.name)
+        return spec is not None and spec.kind[0] in "iu"
+    if isinstance(e, S.Func) and e.name == "TIME":
+        return True
+    if isinstance(e, S.BinOp) and e.op in ("+", "-", "*"):
+        return (not isinstance(e.right, tuple)
+                and _int_exact(table, e.left)
+                and _int_exact(table, e.right))
+    return False
+
+
+def _parallel_sites_ok(table: ColumnarTable, query: S.Select,
+                       sites: list) -> bool:
+    """Aggregates whose morsel split is provably byte-identical to the
+    serial scan. LAST is out (cross-morsel timestamp ties), PERCENTILE
+    is out (the combine folds sketches, the serial path np.percentile),
+    SUM/AVG over float-valued expressions are out (re-association)."""
+    for s in sites:
+        if s.name == "COUNT" and s.distinct:
+            if len(s.args) != 1 or not isinstance(s.args[0], S.Col):
+                return False
+            spec = table.columns.get(s.args[0].name)
+            if spec is None or spec.kind != "str":
+                return False  # only dict-id sets union encoded-exactly
+            continue
+        if s.name in ("COUNT", "MIN", "MAX"):
+            continue
+        if s.name in ("SUM", "AVG"):
+            if (s.args and not isinstance(s.args[0], S.Star)
+                    and not _int_exact(table, s.args[0])):
+                return False
+            continue
+        return False
+    return True
+
+
+def _plan_parallel(table: ColumnarTable, query: S.Select):
+    """-> (kernel, sites, est_rows) when the morsel path applies to this
+    query, else None. DF_QUERY_PARALLEL=1/0 forces the choice; otherwise
+    the learned degree model decides, behind a hard floor so queries
+    smaller than two morsels never pay pool dispatch."""
+    if qpool.in_worker():
+        return None
+    force = os.environ.get("DF_QUERY_PARALLEL", "").strip()
+    if force == "0" or qpool.configured_threads() <= 1:
+        return None
+    if not _is_agg_query(query):
+        return None
+    try:
+        sites = _agg_sites(query)
+    except QueryError:
+        return None
+    if not _parallel_sites_ok(table, query, sites):
+        return None
+    est = len(table)
+    if force != "1":
+        if est < 2 * _morsel_rows():
+            return None
+        kernel = _DEGREE.choose(est)
+    else:
+        kernel = "parallel"
+    return kernel, sites, est
+
+
+def _execute_parallel(table: ColumnarTable, query: S.Select,
+                      sites: list) -> QueryResult | None:
+    """Morsel-parallel aggregate scan. Fixed-row morsels over the
+    zone-pruned chunk list fan out on the shared pool; each worker
+    filters, groups and reduces its slice into an encoded partial
+    (the GIL-released native kernels run concurrently), and the
+    partials fold through the cache's exact combine machinery.
+
+    Byte-identity: morsels preserve row order, so the per-group state
+    each one emits starts from the same row order the serial scan sees;
+    combine_partials(ascending=True) yields ONE partial whose groups
+    are ascending-unique — re-grouping that in merge_partials is a
+    fixed point, its per-site folds run over single-element groups
+    (identity), and ascending group order is exactly the serial
+    executor's _group_order contract. Returns None to fall back when
+    the pool is unavailable or a dictionary compacted mid-scan."""
+    p = qpool.get_pool()
+    if p is None:
+        return None
+    needed = _needed_cols(table, query)
+    chunks = _scan_plan(table, query)
+    mrows = _morsel_rows()
+    morsels: list[tuple[dict, int, int]] = []
+    for ch in chunks:
+        sz = len(next(iter(ch.values()))) if ch else 0
+        for lo in range(0, sz, mrows):
+            morsels.append((ch, lo, min(lo + mrows, sz)))
+    dict_names = {id(d): cn for cn, d in table.dicts.items()}
+    where = query.where
+
+    def scan_one(m):
+        ch, lo, hi = m
+        cols = {name: ch[name][lo:hi] for name in needed}
+        n = hi - lo
+        if where is not None:
+            mask = _Env(table, cols).eval(where).arr
+            if mask.ndim == 0:  # no column refs: scalar condition
+                mask = np.full(n, bool(mask))
+            mask = mask.astype(bool)
+            cols = {k: v[mask] for k, v in cols.items()}
+            n = int(mask.sum())
+        used_m: dict = {}
+        part = _partial_from_env(table, query, sites, _Env(table, cols),
+                                 n, encoded=True, dict_names=dict_names,
+                                 used=used_m)
+        return part, used_m
+
+    results = p.map(scan_one, morsels) if morsels else []
+    used: dict = {}
+    for _part, u in results:
+        used.update(u)
+    combined = combine_partials(table, query,
+                                [part for part, _u in results],
+                                ascending=True)
+    for key, d in used.items():
+        if table.dicts.get(key) is not d:
+            return None  # dictionary compacted mid-scan: redo serially
+    return merge_partials(table, query, [combined])
+
+
 def execute(table: ColumnarTable, query: S.Select | str) -> QueryResult:
     if isinstance(query, str):
         query = S.parse(query)
     query = _normalize(table, query)
     if os.environ.get("DF_QUERY_ENCODED", "1") == "0":
         return _execute_decoded(table, query)
+    plan = _plan_parallel(table, query)
+    t0 = time.perf_counter_ns() if plan is not None else 0
+    if plan is not None and plan[0] == "parallel":
+        try:
+            res = _execute_parallel(table, query, plan[1])
+        except _FastUnsupported:
+            res = None
+        if res is not None:
+            _DEGREE.observe("parallel", plan[2],
+                            time.perf_counter_ns() - t0)
+            return res
+        plan = None  # fell back; don't skew the serial coefficient
     env, n_rows = _materialize(table, query)
 
     is_agg = _is_agg_query(query)
@@ -752,7 +1116,10 @@ def execute(table: ColumnarTable, query: S.Select | str) -> QueryResult:
             mask = np.full(n_groups, bool(mask))
         mask = mask.astype(bool)
         outs = [_slice_val(v, mask) for v in outs]
-    return _finish_columnar(query, names, outs)
+    res = _finish_columnar(query, names, outs)
+    if plan is not None:
+        _DEGREE.observe("serial", plan[2], time.perf_counter_ns() - t0)
+    return res
 
 
 def _execute_decoded(table: ColumnarTable, query: S.Select) -> QueryResult:
@@ -962,16 +1329,17 @@ def _partial_state_enc(site: S.Func, env: _Env, order: np.ndarray,
     if v.kind in ("str", "enum", "obj"):
         raise QueryError(
             f"{name} over string column {S.expr_name(site.args[0])!r}")
-    a = v.arr.astype(np.float64)[order]
+    af = v.arr.astype(np.float64)
+    bounds_full = np.append(starts, len(order))
     if name == "SUM":
-        return {"a": np.add.reduceat(a, starts)}
+        return {"a": _group_reduce("SUM", af, order, bounds_full)}
     if name == "AVG":
-        return {"avg": [np.add.reduceat(a, starts),
+        return {"avg": [_group_reduce("SUM", af, order, bounds_full),
                         (ends - starts).astype(np.float64)]}
     if name == "MIN":
-        return {"a": np.minimum.reduceat(a, starts)}
+        return {"a": _group_reduce("MIN", af, order, bounds_full)}
     if name == "MAX":
-        return {"a": np.maximum.reduceat(a, starts)}
+        return {"a": _group_reduce("MAX", af, order, bounds_full)}
     raise QueryError(f"unknown aggregate {name}")
 
 
@@ -992,6 +1360,48 @@ def _enc_col(v: _Val, arr: np.ndarray, dict_names: dict, used: dict):
     if v.kind == "num":
         return {"a": np.ascontiguousarray(arr)}
     return None
+
+
+def _partial_from_env(table: ColumnarTable, query: S.Select, sites: list,
+                      env: _Env, n_rows: int, *, encoded: bool,
+                      dict_names: dict, used: dict) -> dict:
+    """Group one materialized scope (a whole table scan or a single
+    morsel) and build its per-group partial states. The dicts manifest
+    is NOT attached here — the caller reads gen/len once after every
+    scope it built is done (see execute_partial)."""
+    order, bounds = _group_order(env, query, n_rows)
+    starts = bounds
+    ends = np.append(bounds[1:], len(order))
+    n_groups = len(bounds)
+    keys = []
+    for g in query.group_by:
+        v = env.eval(g)
+        arr = v.arr[order][bounds] if n_groups else v.arr[:0]
+        col = _enc_col(v, arr, dict_names, used) if encoded else None
+        keys.append(col if col is not None else _decode_slice(v, arr))
+    items: dict[str, object] = {}
+    for idx, item in enumerate(query.items):
+        if S.contains_agg(item.expr):
+            continue
+        v = env.eval(item.expr)
+        if v.arr.ndim == 0:   # bare literal: broadcast over groups
+            if encoded and v.kind == "num":
+                items[str(idx)] = {"a": np.full(n_groups, v.arr.item())}
+            else:
+                items[str(idx)] = [v.arr.item()] * n_groups
+            continue
+        arr = v.arr[order][bounds] if n_groups else v.arr[:0]
+        col = _enc_col(v, arr, dict_names, used) if encoded else None
+        items[str(idx)] = col if col is not None else _decode_slice(v, arr)
+    if encoded:
+        site_states = {S.expr_name(s): _partial_state_enc(
+            s, env, order, starts, ends, dict_names, used) for s in sites}
+    else:
+        site_states = {S.expr_name(s): _partial_state(s, env, order,
+                                                      starts, ends)
+                       for s in sites}
+    return {"kind": "agg", "n_groups": n_groups, "keys": keys,
+            "items": items, "sites": site_states}
 
 
 def execute_partial(table: ColumnarTable, query: S.Select | str, *,
@@ -1020,42 +1430,12 @@ def execute_partial(table: ColumnarTable, query: S.Select | str, *,
                   and "time" in table.columns)
     env, n_rows = _materialize(
         table, query, extra_cols={"time"} if needs_time else None)
-    order, bounds = _group_order(env, query, n_rows)
-    starts = bounds
-    ends = np.append(bounds[1:], len(order))
-    n_groups = len(bounds)
     dict_names = ({id(d): cn for cn, d in table.dicts.items()}
                   if encoded else {})
     used: dict = {}  # dict-columns actually shipped as ids
-    keys = []
-    for g in query.group_by:
-        v = env.eval(g)
-        arr = v.arr[order][bounds] if n_groups else v.arr[:0]
-        col = _enc_col(v, arr, dict_names, used) if encoded else None
-        keys.append(col if col is not None else _decode_slice(v, arr))
-    items: dict[str, object] = {}
-    for idx, item in enumerate(query.items):
-        if S.contains_agg(item.expr):
-            continue
-        v = env.eval(item.expr)
-        if v.arr.ndim == 0:   # bare literal: broadcast over groups
-            if encoded and v.kind == "num":
-                items[str(idx)] = {"a": np.full(n_groups, v.arr.item())}
-            else:
-                items[str(idx)] = [v.arr.item()] * n_groups
-            continue
-        arr = v.arr[order][bounds] if n_groups else v.arr[:0]
-        col = _enc_col(v, arr, dict_names, used) if encoded else None
-        items[str(idx)] = col if col is not None else _decode_slice(v, arr)
-    if encoded:
-        site_states = {S.expr_name(s): _partial_state_enc(
-            s, env, order, starts, ends, dict_names, used) for s in sites}
-    else:
-        site_states = {S.expr_name(s): _partial_state(s, env, order,
-                                                      starts, ends)
-                       for s in sites}
-    out = {"kind": "agg", "n_groups": n_groups, "keys": keys,
-           "items": items, "sites": site_states}
+    out = _partial_from_env(table, query, sites, env, n_rows,
+                            encoded=encoded, dict_names=dict_names,
+                            used=used)
     if used:
         # The gen/len manifest is read AFTER building: the dictionary only
         # grows in place, so len covers every id shipped above. If
@@ -1605,14 +1985,19 @@ def merge_partials(table: ColumnarTable, query: S.Select | str,
 
 
 def combine_partials(table: ColumnarTable, query: S.Select | str,
-                     parts: list[dict]) -> dict:
+                     parts: list[dict], *, ascending: bool = False) -> dict:
     """Fold several ENCODED partials over disjoint row sets (per-time-
-    bucket cache slices) into ONE partial equal to a single scan of
-    their union. Exact for every supported site form — including
-    PERCENTILE, whose histogram-sketch merge is bin-exact (only the
-    percentile() readout approximates). LAST is excluded: cross-bucket
-    timestamp ties could resolve differently than a single scan.
-    Raises _FastUnsupported for anything it can't fold exactly."""
+    bucket cache slices, per-morsel scan results) into ONE partial equal
+    to a single scan of their union. Exact for every supported site form
+    — including PERCENTILE, whose histogram-sketch merge is bin-exact
+    (only the percentile() readout approximates). LAST is excluded:
+    cross-bucket timestamp ties could resolve differently than a single
+    scan. Raises _FastUnsupported for anything it can't fold exactly.
+
+    ascending=True emits groups in ascending key order instead of
+    first-occurrence — the morsel-parallel path needs the combined
+    partial to match the serial executor's _group_order layout so the
+    final merge is byte-identical."""
     if isinstance(query, str):
         query = S.parse(query)
     query = _normalize(table, query)
@@ -1667,8 +2052,8 @@ def combine_partials(table: ColumnarTable, query: S.Select | str,
         bounds_full = np.array([0, total], dtype=np.int64)
         ng = 1
     else:
-        order, bounds_full, ng = _group_rows(key_ints,
-                                             first_occurrence=True)
+        order, bounds_full, ng = _group_rows(
+            key_ints, first_occurrence=not ascending)
     starts = bounds_full[:-1]
     ends = bounds_full[1:]
     rep = order[starts]

@@ -1,0 +1,118 @@
+"""Shared morsel scan worker pool (query/engine.py + server RollupJob).
+
+Reference analog: ClickHouse's query thread pool scanning MergeTree
+parts in parallel. One process-wide pool, sized from ``os.cpu_count()``
+with a ``DF_QUERY_THREADS`` override re-read on every acquisition — the
+stress sweep (and an operator tuning a live server) can change the
+degree between queries and the pool resizes in place. 1 means "no pool":
+callers get None and run today's serial path.
+
+Nested-parallelism guard: work dispatched through the pool runs with a
+thread-local ``in_worker`` flag set. The engine checks it before
+planning a parallel scan, so a pool task that itself executes a query
+(RollupJob stages, query-cache bucket refills) degrades to the serial
+path instead of deadlocking on the pool it is occupying.
+
+The actual parallelism comes from the GIL-released native kernels
+(qexec.cpp group/aggregate, zlib decompress, numpy ufuncs over mmap'd
+blocks) — pure-Python morsels would serialize on the GIL and this pool
+would only add overhead, which is exactly what the engine's degree cost
+model learns and avoids.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+_LOCAL = threading.local()
+_LOCK = threading.Lock()
+_POOL: ThreadPoolExecutor | None = None
+_POOL_THREADS = 0
+_BUSY = 0
+_DISPATCHED = 0
+
+
+def configured_threads() -> int:
+    """Pool size: DF_QUERY_THREADS override, else os.cpu_count()."""
+    env = os.environ.get("DF_QUERY_THREADS", "").strip()
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass
+    return os.cpu_count() or 1
+
+
+def in_worker() -> bool:
+    return getattr(_LOCAL, "in_worker", False)
+
+
+def get_pool() -> "ScanPool | None":
+    """The shared pool at the currently-configured size, or None when
+    the configuration says serial (1 thread) or the caller is already a
+    pool worker (nested fan-out would deadlock)."""
+    n = configured_threads()
+    if n <= 1 or in_worker():
+        return None
+    global _POOL, _POOL_THREADS
+    with _LOCK:
+        if _POOL is None or _POOL_THREADS != n:
+            if _POOL is not None:
+                # in-flight tasks finish on the old threads; new work
+                # lands on the resized pool
+                _POOL.shutdown(wait=False)
+            _POOL = ThreadPoolExecutor(
+                max_workers=n, thread_name_prefix="df-scan")
+            _POOL_THREADS = n
+    return ScanPool(_POOL, n)
+
+
+def stats() -> dict:
+    """Health view: configured size + live occupancy."""
+    with _LOCK:
+        return {"threads": _POOL_THREADS, "busy": _BUSY,
+                "dispatched": _DISPATCHED}
+
+
+class ScanPool:
+    """Thin ordered-map facade over the shared executor."""
+
+    __slots__ = ("_ex", "threads")
+
+    def __init__(self, ex: ThreadPoolExecutor, threads: int) -> None:
+        self._ex = ex
+        self.threads = threads
+
+    @staticmethod
+    def _run(fn, item):
+        global _BUSY
+        _LOCAL.in_worker = True
+        with _LOCK:
+            _BUSY += 1
+        try:
+            return fn(item)
+        finally:
+            with _LOCK:
+                _BUSY -= 1
+            _LOCAL.in_worker = False
+
+    def map(self, fn, items: list) -> list:
+        """fn over items on the pool; results in input order. The first
+        task raising propagates (after every future resolves, so no task
+        outlives the call and touches freed state)."""
+        global _DISPATCHED
+        with _LOCK:
+            _DISPATCHED += len(items)
+        futs = [self._ex.submit(self._run, fn, it) for it in items]
+        out, err = [], None
+        for f in futs:
+            try:
+                out.append(f.result())
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                if err is None:
+                    err = e
+        if err is not None:
+            raise err
+        return out

@@ -24,6 +24,7 @@ import threading
 import time
 
 from deepflow_tpu.query import engine as qengine
+from deepflow_tpu.query import pool as qpool
 from deepflow_tpu.store.db import Database
 
 log = logging.getLogger("df.datasource")
@@ -98,6 +99,7 @@ class RollupJob:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.stats = {"rollups": 0, "rows": 0, "sketch_rows": 0}
+        self._stats_lock = threading.Lock()  # families roll concurrently
 
     def start(self) -> "RollupJob":
         if self.running():
@@ -156,12 +158,26 @@ class RollupJob:
         return out
 
     def roll(self, now_s: int) -> int:
-        """Run every rollup stage: complete buckets older than now-lateness."""
-        total = 0
-        for family, spec in FAMILIES.items():
+        """Run every rollup stage: complete buckets older than now-lateness.
+
+        Families roll concurrently on the shared scan pool (they touch
+        disjoint src/dst tables); the stages WITHIN a family stay serial
+        because each feeds the next (1s -> 1m -> 1h -> 1d). Queries a
+        stage runs inside a pool worker degrade to the serial engine
+        path via the in_worker guard — no nested fan-out."""
+        def _roll_family(item):
+            family, spec = item
+            n = 0
             for src_sfx, dst_sfx, bucket in _STAGES:
-                total += self._roll_stage(
+                n += self._roll_stage(
                     now_s, family, src_sfx, dst_sfx, bucket, spec)
+            return n
+        fams = list(FAMILIES.items())
+        pool = qpool.get_pool()
+        if pool is not None and len(fams) > 1:
+            total = sum(pool.map(_roll_family, fams))
+        else:
+            total = sum(_roll_family(f) for f in fams)
         if total:
             self.stats["rollups"] += 1
             self.stats["rows"] += total
@@ -243,7 +259,8 @@ class RollupJob:
                     vals.append("" if sk is None
                                 else json.dumps(sk.to_dict()))
                 cols[sc] = vals
-                self.stats["sketch_rows"] += len(vals)
+                with self._stats_lock:
+                    self.stats["sketch_rows"] += len(vals)
             cols["time"] = [int(t) for t in cols.pop("tmin")]
             for c in meters:
                 cols[c] = [int(v) for v in cols[c]]

@@ -77,6 +77,10 @@ class QuerierAPI:
         # local /v1/query path and the shard half of federated scatters
         from deepflow_tpu.query.cache import QueryCache
         self.query_cache = QueryCache(telemetry=telemetry)
+        # zone-map pruning accounting flows into the same hop ledger the
+        # rest of the pipeline reports through (query.scan hop)
+        from deepflow_tpu.query import engine as _qengine
+        _qengine.set_scan_telemetry(telemetry)
 
     def alerts_api(self, method: str, body: dict) -> dict:
         if self.alerts is None:
@@ -1253,6 +1257,16 @@ class QuerierAPI:
             "stats": self.stats_provider(),
         }
         out["query_cache"] = self.query_cache.snapshot()
+        from deepflow_tpu.query import engine as _qengine
+        from deepflow_tpu.query import pool as _qpool
+        pool_stats = _qpool.stats()
+        out["query"] = {
+            **_qengine.scan_stats(),  # scanned/pruned segment counters
+            "pool_busy": pool_stats["busy"],
+            "pool_threads": pool_stats["threads"],
+            "pool_dispatched": pool_stats["dispatched"],
+            "degree": _qengine._DEGREE.snapshot(),
+        }
         if self.storage_provider is not None:
             storage = self.storage_provider()
             if storage is not None:

@@ -34,6 +34,13 @@ class Database:
         self._tables: dict[str, ColumnarTable] = {}
         self._lock = threading.Lock()
         self.tier_store = None
+        # lazy persistence adoption: a storage-backed Database serves its
+        # recovered segments on FIRST table access even if the caller
+        # never ran load() — the PR 9 footgun was constructing
+        # Database(data_dir, storage=True) and silently querying zero
+        # tier rows until an explicit load.
+        self._loaded = False
+        self._load_lock = threading.Lock()
         if storage and data_dir:
             from deepflow_tpu.store.tiered import TieredStore
             self.tier_store = TieredStore(os.path.join(data_dir,
@@ -53,7 +60,19 @@ class Database:
             self._tables[name] = t
             return t
 
+    def _ensure_loaded(self) -> None:
+        """Implicit load() for storage-backed databases: the first table
+        access adopts the recovered tier (double-checked under a
+        dedicated lock — load() itself takes table locks, so it must not
+        run under self._lock)."""
+        if self._loaded or self.tier_store is None:
+            return
+        with self._load_lock:
+            if not self._loaded:
+                self.load()
+
     def table(self, name: str) -> ColumnarTable:
+        self._ensure_loaded()
         try:
             return self._tables[name]
         except KeyError:
@@ -61,6 +80,7 @@ class Database:
                 f"no such table {name!r}; known: {sorted(self._tables)}")
 
     def tables(self) -> list[str]:
+        self._ensure_loaded()
         return sorted(self._tables)
 
     def flush(self) -> list[str]:
@@ -92,6 +112,7 @@ class Database:
         detection still applies)."""
         if self.tier_store is None:
             return 0
+        self._ensure_loaded()  # adopt recovered tiers before committing
         writes: dict[str, dict] = {}
         for name, t in list(self._tables.items()):
             self._ensure_tier(name, t)
@@ -117,6 +138,10 @@ class Database:
         decode, and adopt each table's tier."""
         from deepflow_tpu.store.dictionary import Dictionary
         for name, t in self._tables.items():
+            if t.tier is not None:
+                # already adopted (lazy load raced an explicit one) —
+                # attach_tier would double-count tier.rows
+                continue
             tt = self.tier_store.tier(name)
             for col in t.dicts:
                 p = tt.dict_path(col)
@@ -151,6 +176,9 @@ class Database:
         migration.write_manifest(self.data_dir)
 
     def load(self) -> None:
+        if self._loaded:
+            return  # lazy load already ran; re-running would re-read npz
+        self._loaded = True
         if not self.data_dir or not os.path.isdir(self.data_dir):
             return
         from deepflow_tpu.store import migration

@@ -80,10 +80,27 @@ def _pad(f, align: int = _ALIGN) -> int:
     return pos
 
 
+def _zone(arr: np.ndarray):
+    """(zmin, zmax) zone-map bounds for a column, or None when the dtype
+    has no total order the planner can prune against. Integer columns
+    (including uint32 dictionary ids and enum codes) always qualify;
+    floats qualify only when every value is finite — a NaN poisons
+    comparisons, and Infinity does not round-trip through strict JSON."""
+    if not arr.size:
+        return None
+    k = arr.dtype.kind
+    if k in "iu":
+        return int(arr.min()), int(arr.max())
+    if k == "f" and bool(np.isfinite(arr).all()):
+        return float(arr.min()), float(arr.max())
+    return None
+
+
 def write_segment(path: str, chunk: dict[str, np.ndarray],
                   time_col: str | None = None,
                   dict_gens: dict[str, tuple[int, int]] | None = None,
-                  fsync: bool = True, compress: bool = True) -> dict:
+                  fsync: bool = True, compress: bool = True,
+                  codec_hints: dict[str, bool] | None = None) -> dict:
     """Write one sealed chunk as a segment file. Returns the footer dict.
 
     The file is fsync'd before return (crash safety: the manifest commit
@@ -91,6 +108,13 @@ def write_segment(path: str, chunk: dict[str, np.ndarray],
     DIRECTORY fsync is the caller's job, batched across a commit.
     ``compress=False`` skips the zlib codec (const detection always
     runs — it is practically free and pays the most).
+
+    ``codec_hints`` is a mutable {column -> worth_compressing} memo owned
+    by the caller (the tier keeps one per table): on first sight of a
+    column the 8 KiB probe decides and the verdict is recorded; later
+    flushes reuse it instead of re-probing. The full-block saving check
+    still runs on every compress, so a hint can only skip the probe,
+    never admit a block that stopped paying its 25%.
     """
     rows = len(next(iter(chunk.values()))) if chunk else 0
     cols: dict[str, dict] = {}
@@ -107,11 +131,16 @@ def write_segment(path: str, chunk: dict[str, np.ndarray],
             if arr.size and bool((arr == arr[0]).all()):
                 codec, blob = "const", raw[:arr.dtype.itemsize]
             elif compress and raw.nbytes >= 256:
-                worth = True
-                if raw.nbytes > 2 * _ZLIB_PROBE:
-                    probe = zlib.compress(raw[:_ZLIB_PROBE], 1)
-                    worth = len(probe) <= _ZLIB_PROBE \
-                        * (1.0 - _ZLIB_MIN_SAVING)
+                worth = None if codec_hints is None \
+                    else codec_hints.get(name)
+                if worth is None:
+                    worth = True
+                    if raw.nbytes > 2 * _ZLIB_PROBE:
+                        probe = zlib.compress(raw[:_ZLIB_PROBE], 1)
+                        worth = len(probe) <= _ZLIB_PROBE \
+                            * (1.0 - _ZLIB_MIN_SAVING)
+                    if codec_hints is not None:
+                        codec_hints[name] = worth
                 if worth:
                     comp = zlib.compress(raw, 1)
                     if len(comp) <= raw.nbytes * (1.0 - _ZLIB_MIN_SAVING):
@@ -123,6 +152,9 @@ def write_segment(path: str, chunk: dict[str, np.ndarray],
                           if isinstance(blob, memoryview) else len(blob),
                           "dtype": arr.dtype.str, "codec": codec,
                           "raw_nbytes": raw.nbytes}
+            z = _zone(arr)
+            if z is not None:
+                cols[name]["zmin"], cols[name]["zmax"] = z
         footer = {"rows": rows, "cols": cols,
                   "dict_gens": {k: list(v)
                                 for k, v in (dict_gens or {}).items()}}
@@ -156,7 +188,7 @@ class Segment:
     """
 
     __slots__ = ("path", "rows", "tmin", "tmax", "dict_gens", "nbytes",
-                 "_mm", "_cols", "_cache")
+                 "zones", "_mm", "_cols", "_cache")
 
     def __init__(self, path: str, footer: dict, mm, nbytes: int) -> None:
         self.path = path
@@ -166,6 +198,17 @@ class Segment:
         self.dict_gens = {k: tuple(v)
                           for k, v in footer.get("dict_gens", {}).items()}
         self.nbytes = nbytes
+        # per-column (zmin, zmax) over the ENCODED values (uint32 dict
+        # ids for string columns). Segments from before zone maps fall
+        # back to the footer's time min/max, so time pruning keeps
+        # working across the format generations.
+        self.zones = {name: (c["zmin"], c["zmax"])
+                      for name, c in footer["cols"].items()
+                      if "zmin" in c and "zmax" in c}
+        tc = footer.get("time_col")
+        if (tc is not None and tc not in self.zones
+                and self.tmin is not None and self.tmax is not None):
+            self.zones[tc] = (self.tmin, self.tmax)
         self._mm = mm
         self._cols = footer["cols"]
         self._cache: dict[str, np.ndarray] = {}

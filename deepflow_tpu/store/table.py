@@ -440,7 +440,15 @@ class ColumnarTable:
         """Chunk list incl. every stripe's current buffer (sealed copies).
         All stripe locks are held while reading so no seal can move rows
         between the chunk list and a buffer mid-snapshot."""
+        return [ch for ch, _z in self.scan_units()]
+
+    def scan_units(self) -> list[tuple[dict, dict | None]]:
+        """snapshot() with pruning metadata: (chunk, zones) pairs under
+        the same locking, where zones is the backing segment's per-column
+        (zmin, zmax) map for tier chunks and None for RAM chunks (live
+        stripes and pending flushes mutate too often to keep bounds)."""
         stripes = self._all_stripes()
+        units: list[tuple[dict, dict | None]] = []
         with contextlib.ExitStack() as stack:
             for s in stripes:
                 stack.enter_context(s.lock)
@@ -450,14 +458,15 @@ class ColumnarTable:
                 # under the same lock, so this list can never hold both
                 # (or neither) view of a flushed chunk. Lock order is
                 # stripes -> table -> tier everywhere.
-                tier_chunks = (self.tier.chunks()
-                               if self.tier is not None else [])
-                chunks = tier_chunks + self._pending_flush + self._chunks
+                if self.tier is not None:
+                    units.extend(self.tier.units())
+                units.extend((ch, None) for ch in self._pending_flush)
+                units.extend((ch, None) for ch in self._chunks)
             for s in stripes:
                 if not s.rows:
                     continue
                 if s.mat is not None and s.mat[0] == s.seq:
-                    chunks.append(s.mat[1])
+                    units.append((s.mat[1], None))
                     continue
                 chunk = {}
                 for name, spec in self.columns.items():
@@ -467,8 +476,8 @@ class ColumnarTable:
                     s.buf[name] = [arr]
                     chunk[name] = arr
                 s.mat = (s.seq, chunk)
-                chunks.append(chunk)
-        return chunks
+                units.append((chunk, None))
+        return units
 
     def column_concat(self, names: list[str],
                       mask_chunks: list[np.ndarray] | None = None,

@@ -48,6 +48,8 @@ log = logging.getLogger("df.tiered")
 
 MANIFEST = "MANIFEST.json"
 _FORMAT_VERSION = 1
+# flush generations between zlib probe re-runs (TableTier.codec_hints)
+_CODEC_REPROBE_GENS = 32
 
 
 def _fsync_dir(path: str) -> None:
@@ -79,6 +81,9 @@ class TableTier:
         # until confirm_flush() swaps tier view and RAM copy atomically
         self._staged: list[Segment] = []
         self._chunk_cache: list[dict] | None = None
+        # zone maps aligned 1:1 with _chunk_cache (same segment order) so
+        # the scan planner can pair every chunk with its pruning bounds
+        self._zone_cache: list[dict] | None = None
         # set at attach time so chunks() can backfill additively-new
         # columns exactly like ColumnarTable.load() does
         self._columns = None
@@ -86,6 +91,11 @@ class TableTier:
         # (gen, version) of the last dictionary dump per column — dumps
         # are skipped when nothing changed
         self._dict_dumped: dict[str, tuple[int, int]] = {}
+        # zlib worth-compressing verdicts memoized per column; cleared
+        # every _CODEC_REPROBE_GENS flush generations so a column whose
+        # entropy drifts gets re-probed (see segment.write_segment)
+        self._codec_memo: dict[str, bool] = {}
+        self._codec_memo_gen: int | None = None
 
     # -- read side ----------------------------------------------------------
 
@@ -93,13 +103,32 @@ class TableTier:
         with self._lock:
             return list(self._segments)
 
+    def _fill_caches(self) -> None:
+        live = [s for s in self._segments if s.rows]
+        self._chunk_cache = [s.chunk(self._columns, self._fills)
+                             for s in live]
+        self._zone_cache = [s.zones for s in live]
+
     def chunks(self) -> list[dict]:
         with self._lock:
             if self._chunk_cache is None:
-                self._chunk_cache = [
-                    s.chunk(self._columns, self._fills)
-                    for s in self._segments if s.rows]
+                self._fill_caches()
             return list(self._chunk_cache)
+
+    def units(self) -> list[tuple[dict, dict]]:
+        """(chunk, zones) pairs for the scan planner — zones is the
+        segment's per-column (zmin, zmax) map (possibly just the time
+        column for pre-zone-map segments)."""
+        with self._lock:
+            if self._chunk_cache is None:
+                self._fill_caches()
+            return list(zip(self._chunk_cache, self._zone_cache))
+
+    def zoned_count(self) -> int:
+        """Segments carrying per-column zone maps (vs time-only/none)."""
+        with self._lock:
+            return sum(1 for s in self._segments
+                       if any("zmin" in c for c in s._cols.values()))
 
     @property
     def rows(self) -> int:
@@ -141,6 +170,7 @@ class TableTier:
             self._staged = [s for s in self._staged if s is not seg]
             self._segments.append(seg)
             self._chunk_cache = None
+            self._zone_cache = None
 
     def _remove(self, victims: list[Segment]) -> None:
         ids = {id(s) for s in victims}
@@ -148,6 +178,18 @@ class TableTier:
             self._segments = [s for s in self._segments
                               if id(s) not in ids]
             self._chunk_cache = None
+            self._zone_cache = None
+
+    def codec_hints(self, gen: int) -> dict[str, bool]:
+        """The per-table compress/skip memo write_segment consults.
+        Cleared every _CODEC_REPROBE_GENS generations: the 8 KiB probe
+        runs once per column per memo generation, not once per flush."""
+        with self._lock:
+            if (self._codec_memo_gen is None
+                    or gen - self._codec_memo_gen >= _CODEC_REPROBE_GENS):
+                self._codec_memo.clear()
+                self._codec_memo_gen = gen
+            return self._codec_memo
 
     def persist_dicts(self, dicts: dict) -> int:
         """Dump changed dictionaries (atomic per file). MUST run before
@@ -351,7 +393,8 @@ class TieredStore:
                 write_segment(p, payload["chunk"],
                               time_col=payload.get("time_col"),
                               dict_gens=payload.get("dict_state"),
-                              compress=compress)
+                              compress=compress,
+                              codec_hints=tt.codec_hints(self.flush_gen))
                 dirty_dirs.add(tt.dir)
                 seg = Segment.open(p)
                 tt._stage(seg)
@@ -445,6 +488,7 @@ class TieredStore:
             for name, tt in self._tables.items():
                 tmin, tmax = tt.span()
                 tables[name] = {"segments": tt.segment_count(),
+                                "zoned_segments": tt.zoned_count(),
                                 "rows": tt.rows, "bytes": tt.bytes,
                                 "tmin": tmin, "tmax": tmax}
             return {"root": self.root, "flush_gen": self.flush_gen,

@@ -1,0 +1,238 @@
+"""Morsel-parallel query execution + zone-map segment pruning (ISSUE 10).
+
+The contract under test is BYTE-IDENTITY: for any thread count, the
+parallel path must return exactly the rows the serial engine returns —
+morsels preserve row order, per-morsel encoded partials combine
+ascending, and the merge re-groups first-occurrence to a fixed point.
+Queries the parallel planner rejects (PERCENTILE, LAST, float SUM args)
+silently run serial and must ALSO be identical, which these sweeps
+check for free.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from deepflow_tpu.query import execute
+from deepflow_tpu.query import engine
+from deepflow_tpu.query import pool as qpool
+from deepflow_tpu.server.datasource import RollupJob
+from deepflow_tpu.store import Database
+
+_ROW = {"ip_src": "1.1.1.1", "ip_dst": "2.2.2.2", "server_port": 80,
+        "protocol": 1, "host": "h1"}
+
+
+def _mixed_db(tmp_path, seed=11, live=900, flushed=3, per_flush=700):
+    """Raw 1s table backed by `flushed` mmap'd segments plus `live`
+    RAM rows, with the 1m rollup tier populated — every storage layer
+    a scan can cross, deterministically seeded."""
+    rng = np.random.default_rng(seed)
+    db = Database(data_dir=str(tmp_path), storage=True)
+    t = db.table("flow_metrics.network.1s")
+    t0 = 6000
+
+    def _batch(t_start, n):
+        return [dict(_ROW, time=t_start + i,
+                     ip_src=f"10.0.0.{int(rng.integers(0, 7))}",
+                     server_port=int(rng.integers(1, 5)) * 1000,
+                     byte_tx=int(rng.integers(0, 10_000)),
+                     packet_tx=int(rng.integers(1, 64)))
+                for i in range(n)]
+
+    for k in range(flushed):
+        t.append_rows(_batch(t0 + k * per_flush, per_flush))
+        db.flush_to_tier()
+    t.append_rows(_batch(t0 + flushed * per_flush, live))
+    job = RollupJob(db, lateness_s=0)
+    job.roll(now_s=t0 + flushed * per_flush + live + 120)
+    return db, t
+
+
+_SWEEP_SQL = [
+    # GROUP BY over str + enum keys, multi-agg
+    "SELECT ip_src, Sum(byte_tx) AS b, Count() AS c, Max(packet_tx) "
+    "AS mx FROM t GROUP BY ip_src",
+    # HAVING repeats the aggregate (alias refs unsupported by design)
+    "SELECT server_port, Avg(packet_tx) AS p FROM t "
+    "GROUP BY server_port HAVING Avg(packet_tx) > 30 ORDER BY "
+    "server_port",
+    # ORDER BY agg DESC + LIMIT applied at the merge
+    "SELECT ip_src, Sum(byte_tx) AS b FROM t GROUP BY ip_src "
+    "ORDER BY b DESC, ip_src LIMIT 3",
+    # time bucketing + WHERE string equality (dict-id pushdown)
+    "SELECT time(time, 60) AS m, Count() AS c, Min(byte_tx) AS lo "
+    "FROM t WHERE ip_src = '10.0.0.3' GROUP BY time(time, 60) "
+    "ORDER BY m",
+    # COUNT DISTINCT over a str column (dict-id set union per morsel)
+    "SELECT server_port, Count(DISTINCT ip_src) AS u FROM t "
+    "GROUP BY server_port ORDER BY server_port",
+    # PERCENTILE: planner-rejected (sketch != np.percentile) -> serial,
+    # still byte-identical
+    "SELECT ip_src, PERCENTILE(byte_tx, 95) AS p95 FROM t "
+    "GROUP BY ip_src ORDER BY ip_src",
+    # time-range WHERE spanning the segment/live boundary
+    "SELECT ip_src, Sum(packet_tx) AS p FROM t "
+    "WHERE time >= 6300 AND time < 8500 GROUP BY ip_src ORDER BY "
+    "ip_src",
+]
+
+
+def test_thread_sweep_byte_identical(tmp_path, monkeypatch):
+    """DF_QUERY_THREADS in {1, 2, 8}: identical bytes across live
+    stripes, mmap'd segments and the rollup tier."""
+    db, t = _mixed_db(tmp_path)
+    roll = db.table("flow_metrics.network.1m")
+    assert len(roll) > 0  # the rollup tier actually participates
+    monkeypatch.setenv("DF_QUERY_PARALLEL", "1")
+    monkeypatch.setenv("DF_QUERY_MORSEL_ROWS", "256")  # force many morsels
+    cases = [(t, sql) for sql in _SWEEP_SQL] + [
+        (roll, "SELECT ip_src, Sum(byte_tx) AS b, Count() AS c FROM t "
+               "GROUP BY ip_src ORDER BY ip_src")]
+    baseline = None
+    for threads in ("1", "2", "8"):
+        monkeypatch.setenv("DF_QUERY_THREADS", threads)
+        got = [(execute(tab, sql).columns, execute(tab, sql).values)
+               for tab, sql in cases]
+        if baseline is None:
+            baseline = got
+            assert any(len(v) > 1 for _c, v in got)  # non-trivial answers
+        else:
+            assert got == baseline, f"threads={threads} diverged"
+
+
+def test_parallel_path_actually_taken(tmp_path, monkeypatch):
+    """The sweep above proves identity; this proves the pool RAN (a
+    planner that silently always picked serial would pass identity)."""
+    db, t = _mixed_db(tmp_path, live=400, flushed=1, per_flush=400)
+    monkeypatch.setenv("DF_QUERY_PARALLEL", "1")
+    monkeypatch.setenv("DF_QUERY_THREADS", "2")
+    monkeypatch.setenv("DF_QUERY_MORSEL_ROWS", "256")
+    before = qpool.stats()["dispatched"]
+    execute(t, "SELECT ip_src, Sum(byte_tx) AS b FROM t GROUP BY ip_src")
+    assert qpool.stats()["dispatched"] > before
+
+
+def test_flush_during_query_race(tmp_path, monkeypatch):
+    """Concurrent flush_to_tier swaps RAM chunks for mmap'd segments
+    mid-stream. A time-bounded query over already-written rows must
+    answer identically on every iteration while later rows are appended
+    and flushed underneath it."""
+    db = Database(data_dir=str(tmp_path), storage=True)
+    t = db.table("flow_metrics.network.1s")
+    t.append_rows([dict(_ROW, time=6000 + i, byte_tx=i, packet_tx=1,
+                        ip_src=f"10.0.0.{i % 3}") for i in range(1200)])
+    sql = ("SELECT ip_src, Sum(byte_tx) AS b, Count() AS c FROM t "
+           "WHERE time < 7200 GROUP BY ip_src ORDER BY ip_src")
+    expected = execute(t, sql).values
+    monkeypatch.setenv("DF_QUERY_PARALLEL", "1")
+    monkeypatch.setenv("DF_QUERY_THREADS", "4")
+    monkeypatch.setenv("DF_QUERY_MORSEL_ROWS", "256")
+
+    stop = threading.Event()
+    errs: list = []
+
+    def _churn():
+        try:
+            k = 0
+            while not stop.is_set():
+                t.append_rows([dict(_ROW, time=8000 + k * 50 + i,
+                                    byte_tx=1) for i in range(50)])
+                db.flush_to_tier()
+                k += 1
+        except Exception as e:  # surfaced in the main thread
+            errs.append(e)
+
+    th = threading.Thread(target=_churn)
+    th.start()
+    try:
+        for _ in range(60):
+            assert execute(t, sql).values == expected
+    finally:
+        stop.set()
+        th.join(timeout=10)
+    assert not errs
+
+
+# -- zone-map pruning -------------------------------------------------------
+
+def _segmented_db(tmp_path, nseg=6, per=100):
+    db = Database(data_dir=str(tmp_path), storage=True)
+    t = db.table("flow_metrics.network.1s")
+    for k in range(nseg):
+        t.append_rows([dict(_ROW, time=6000 + k * 1000 + i, byte_tx=k,
+                            ip_src=f"10.0.{k}.1") for i in range(per)])
+        db.flush_to_tier()
+    return db, t
+
+
+def _stats_delta(fn):
+    before = engine.scan_stats()
+    fn()
+    after = engine.scan_stats()
+    return {k: after[k] - before[k] for k in before}
+
+
+def test_zone_pruning_time_slice(tmp_path):
+    db, t = _segmented_db(tmp_path)
+    sql = ("SELECT Sum(byte_tx) AS b FROM t "
+           "WHERE time >= 8000 AND time < 8100")
+    d = _stats_delta(lambda: execute(t, sql))
+    # 6 disjoint-span segments, 1 overlaps the slice
+    assert d["pruned_segments"] == 5
+    assert d["scanned_segments"] == 1
+    assert execute(t, sql).values == [[2.0 * 100]]
+
+
+def test_zone_pruning_absent_string(tmp_path):
+    """Equality against a string the dictionary never interned prunes
+    every segment AND the live chunks — the id cannot exist anywhere."""
+    db, t = _segmented_db(tmp_path)
+    t.append_rows([dict(_ROW, time=99000 + i) for i in range(50)])  # live
+    sql = "SELECT Count() AS c FROM t WHERE ip_src = 'never-seen'"
+    d = _stats_delta(lambda: execute(t, sql))
+    assert d["scanned_segments"] == 0
+    assert d["pruned_segments"] == 6
+    assert execute(t, sql).values == []
+
+
+def test_zone_pruning_is_sound(tmp_path):
+    """A predicate overlapping every zone prunes nothing and answers
+    exactly — pruning is a pure necessary-condition filter."""
+    db, t = _segmented_db(tmp_path)
+    sql = "SELECT Count() AS c FROM t WHERE byte_tx >= 0"
+    d = _stats_delta(lambda: execute(t, sql))
+    assert d["pruned_segments"] == 0
+    assert d["scanned_segments"] == 6
+    assert execute(t, sql).values == [[600.0]]
+
+
+# -- lazy load (PR 9 footgun) ----------------------------------------------
+
+def test_lazy_load_serves_tier_without_explicit_load(tmp_path):
+    d = str(tmp_path)
+    db = Database(data_dir=d, storage=True)
+    t = db.table("flow_metrics.network.1s")
+    t.append_rows([dict(_ROW, time=6000 + i, byte_tx=i)
+                   for i in range(120)])
+    db.flush_to_tier()
+    expected = execute(t, "SELECT Sum(byte_tx) AS b, Count() AS c "
+                          "FROM t").values
+
+    # a fresh process that forgets .load() used to silently answer
+    # from an empty table; table() now attaches tiers on first touch
+    db2 = Database(data_dir=d, storage=True)
+    t2 = db2.table("flow_metrics.network.1s")
+    assert len(t2) == 120
+    assert execute(t2, "SELECT Sum(byte_tx) AS b, Count() AS c "
+                       "FROM t").values == expected
+
+    # explicit load() after lazy access is idempotent: no double-attach
+    db2.load()
+    assert len(db2.table("flow_metrics.network.1s")) == 120
+
+    # tables() also triggers the lazy path
+    db3 = Database(data_dir=d, storage=True)
+    assert "flow_metrics.network.1s" in db3.tables()
+    assert len(db3.table("flow_metrics.network.1s")) == 120
