@@ -848,6 +848,105 @@ def _bench_storage() -> dict:
     return out
 
 
+def _bench_scan_selective() -> dict:
+    """scan_selective arm (format v2): needle trace_id lookups over a
+    fragmented format-v1 tier vs the same data compacted into sorted v2
+    runs (bloom indexes + native filter/gather), and native vs the
+    DF_NO_NATIVE pure-numpy fallback on both tiers. Every arm must
+    return byte-identical answers — the >= 3x gate compares v2-native
+    against v1-native on the same host, so a slow CI box can't fail a
+    fast code path. Trace ids recur later in the stream (spans of one
+    trace arrive minutes apart), which de-correlates dictionary ids
+    from time and makes the bloom index, not the id zone maps, carry
+    the pruning."""
+    import shutil
+    import tempfile
+
+    from deepflow_tpu.query import engine
+    from deepflow_tpu.store.db import Database
+
+    n_segments, rows_per_seg, n_needles = 160, 600, 15
+    total = n_segments * rows_per_seg
+    n_unique = total // 2
+    hour_ns = 3_600_000_000_000
+
+    def tid(i: int) -> str:
+        i = i if i < n_unique else (i - n_unique) * 7919 % n_unique
+        return f"{i * 2654435761 % (1 << 32):08x}{i:08x}"
+
+    data_dir = tempfile.mkdtemp(prefix="dfbench-scansel-")
+    os.environ["DF_SEG_FORMAT"] = "1"
+    try:
+        db = Database(data_dir=data_dir, storage=True,
+                      chunk_rows=rows_per_seg)
+        t = db.table("application_log.log")
+        for s in range(n_segments):
+            base = s * rows_per_seg
+            t.append_rows([
+                {"time": (base + j) * (6 * hour_ns // total),
+                 "app_service": f"svc-{(base + j) % 10}",
+                 "severity_number": (base + j) % 24 + 1,
+                 "body": f"request path=/api/v{(base + j) % 50}",
+                 "trace_id": tid(base + j)}
+                for j in range(rows_per_seg)])
+            t.flush()
+            db.flush_to_tier()
+    finally:
+        os.environ.pop("DF_SEG_FORMAT", None)
+
+    needles = [tid((j * 7001 + 13) % n_unique) for j in range(n_needles)]
+
+    def sweep():
+        vals = []
+        best = float("inf")
+        for _ in range(3):
+            got = []
+            t0 = time.perf_counter()
+            for ndl in needles:
+                got.append(engine.execute(
+                    t, "SELECT Count(*) AS c, Sum(severity_number) AS s "
+                       f"FROM log WHERE trace_id = '{ndl}'").values)
+            best = min(best, time.perf_counter() - t0)
+            vals = got
+        return best, vals
+
+    def fallback_sweep():
+        os.environ["DF_NO_NATIVE"] = "1"
+        try:
+            return sweep()
+        finally:
+            os.environ.pop("DF_NO_NATIVE", None)
+
+    out: dict = {}
+    try:
+        v1_s, v1_vals = sweep()
+        v1_nn_s, v1_nn_vals = fallback_sweep()
+        db.compact_tier()
+        v2_s, v2_vals = sweep()
+        v2_nn_s, v2_nn_vals = fallback_sweep()
+        segs = db.tier_store.tier("application_log.log").segment_count()
+    finally:
+        shutil.rmtree(data_dir, ignore_errors=True)
+    matches = v1_vals == v1_nn_vals == v2_vals == v2_nn_vals
+    speedup = round(v1_s / max(v2_s, 1e-9), 2)
+    out.update({
+        "scan_selective_ms": {
+            "v1_native": round(v1_s * 1e3, 2),
+            "v1_fallback": round(v1_nn_s * 1e3, 2),
+            "v2_native": round(v2_s * 1e3, 2),
+            "v2_fallback": round(v2_nn_s * 1e3, 2)},
+        "scan_selective_rows": total,
+        "scan_selective_segments_v1": n_segments,
+        "scan_selective_segments_v2": segs,
+        "scan_selective_matches": matches,
+        "scan_selective_speedup": speedup,
+        "scan_selective_native_speedup_v2": round(
+            v2_nn_s / max(v2_s, 1e-9), 2),
+        "scan_selective_below_target": (not matches) or speedup < 3.0,
+    })
+    return out
+
+
 _BUSY_C = """
 static unsigned long v;
 __attribute__((noinline)) void busy_leaf(void) {
@@ -1144,6 +1243,7 @@ def main() -> None:
     cpu_detail.update(_bench_query())
     cpu_detail.update(_bench_query_parallel())
     cpu_detail.update(_bench_storage())
+    cpu_detail.update(_bench_scan_selective())
     cpu_detail.update(_bench_extprofiler())
     # perf guards (VERDICT r03 item 5 / r04 item 8): a regression must be
     # visible in-round, not discovered by the next judge

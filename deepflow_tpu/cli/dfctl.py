@@ -135,6 +135,18 @@ def main(argv: list[str] | None = None) -> int:
                            "zone-map footers (prunable), as "
                            "zoned/total")
 
+    p_seg = sub.add_parser(
+        "segments", help="per-segment inspector: format version, rows, "
+                         "codecs, zone/bloom index presence and "
+                         "sorted-run membership")
+    p_seg.add_argument("table", nargs="?", default=None,
+                       help="limit to one table (default: all)")
+    p_seg.add_argument("--v1", action="store_true",
+                       help="only segments still on format v1 "
+                            "(awaiting migrate-on-compact)")
+    p_seg.add_argument("--json", action="store_true",
+                       help="raw /v1/segments JSON")
+
     p_org = sub.add_parser("org", help="org/team scoping: assign agent "
                                        "groups to orgs, list assignments")
     p_org.add_argument("--assign", nargs=2, metavar=("GROUP", "ORG_ID"),
@@ -563,6 +575,45 @@ def main(argv: list[str] | None = None) -> int:
             print("\nrollup completeness horizons (exclusive, epoch s):")
             print_table(["DATASOURCE", "COMPLETE_BEFORE"],
                         [[k, v] for k, v in sorted(horizons.items())])
+    elif args.cmd == "segments":
+        path = "/v1/segments"
+        q = []
+        if args.table:
+            q.append(f"table={args.table}")
+        if args.v1:
+            q.append("v1=1")
+        if q:
+            path += "?" + "&".join(q)
+        out = _api(args.server, path)
+        if not out.get("storage"):
+            print("(storage tier disabled — start the server with "
+                  "--storage)")
+            return 0
+        if args.json:
+            print(json.dumps(out, indent=2))
+            return 0
+        rows = []
+        for name, segs in sorted(out.get("tables", {}).items()):
+            for s in segs:
+                codecs = s.get("codecs", {})
+                # codec histogram beats per-column spam at a glance
+                counts: dict[str, int] = {}
+                for c in codecs.values():
+                    counts[c] = counts.get(c, 0) + 1
+                codec_s = ",".join(f"{k}:{v}" for k, v
+                                   in sorted(counts.items()))
+                idx = s.get("indexed_cols", [])
+                rows.append([
+                    name, s["file"], f"v{s['format']}",
+                    s["rows"], s["bytes"],
+                    s["run"] if s["run"] is not None else "-",
+                    s.get("sorted_by") or "-",
+                    s.get("zoned_cols", 0),
+                    ",".join(idx) if idx else "-",
+                    codec_s or "-"])
+        print_table(["TABLE", "SEGMENT", "FMT", "ROWS", "BYTES", "RUN",
+                     "SORTED_BY", "ZONES", "INDEXED", "CODECS"], rows)
+        print(f"\ncompact_gen: {out.get('compact_gen', 0)}")
     elif args.cmd == "flame":
         body = {"event_type": args.event_type}
         if args.service:

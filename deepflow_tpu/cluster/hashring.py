@@ -250,10 +250,11 @@ class ClaimTableView:
         """Claim-filtered scan units. MUST be overridden here, not left
         to __getattr__ delegation: the engine scans through scan_units,
         and the raw table's units would leak replica copies. A segment's
-        zone map stays attached — zones are necessary conditions over
-        the full chunk, so they remain sound for the claimed subset."""
-        return [(self._claim(ch), z)
-                for ch, z in self._table.scan_units()]
+        zone map and skip indexes stay attached — both are necessary
+        conditions over the full chunk, so they remain sound for the
+        claimed subset."""
+        return [(self._claim(ch), z, seg)
+                for ch, z, seg in self._table.scan_units()]
 
     def column_concat(self, names, mask_chunks=None, chunks=None):
         if chunks is None:

@@ -16,7 +16,7 @@ log = logging.getLogger("df.native")
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_DIR, "libdfnative.so")
 _lib = None
-_ABI_VERSION = 7  # must match df_abi_version() in dfnative.cpp
+_ABI_VERSION = 8  # must match df_abi_version() in dfnative.cpp
 
 
 def _build() -> bool:
@@ -177,6 +177,21 @@ def load():
         np.ctypeslib.ndpointer(np.uint64),           # bounds (n_groups+1)
         ctypes.c_uint64, ctypes.c_int32,
         np.ctypeslib.ndpointer(np.float64)]          # out
+    lib.df_qx_sel_cmp.restype = ctypes.c_int64
+    lib.df_qx_sel_cmp.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint32,
+        ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64,
+        np.ctypeslib.ndpointer(np.uint64)]           # out_idx
+    lib.df_qx_sel_isin_u32.restype = ctypes.c_int64
+    lib.df_qx_sel_isin_u32.argtypes = [
+        np.ctypeslib.ndpointer(np.uint32), ctypes.c_uint64,
+        np.ctypeslib.ndpointer(np.uint32), ctypes.c_uint64,
+        np.ctypeslib.ndpointer(np.uint64)]           # out_idx
+    lib.df_qx_gather.restype = ctypes.c_int32
+    lib.df_qx_gather.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint32,
+        np.ctypeslib.ndpointer(np.uint64), ctypes.c_uint64,
+        ctypes.c_void_p]
     _lib = lib
     return lib
 
@@ -750,6 +765,66 @@ def qx_agg_f64(vals: np.ndarray, order: np.ndarray, bounds: np.ndarray,
                 else np.ascontiguousarray(bounds, dtype=np.uint64))
     out = np.empty(n_groups, dtype=np.float64)
     lib.df_qx_agg_f64(vals, order64, bounds64, n_groups, op, out)
+    return out
+
+
+def qx_sel_range(col: np.ndarray, lo, hi):
+    """Ascending index list of rows where lo <= col[i] <= hi (both
+    inclusive; lo/hi must already be representable in col's dtype). The
+    selective-filter fast path over encoded segment columns: survivors
+    come back as positions, never as a full bool mask. Returns a uint64
+    index array or None when the native lib is unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    if col.dtype.kind not in "iu" or col.itemsize not in (1, 2, 4, 8):
+        return None
+    col = np.ascontiguousarray(col)
+    n = len(col)
+    udt = np.dtype(f"u{col.itemsize}")
+    lo_bits = int(np.asarray(lo, dtype=col.dtype).view(udt))
+    hi_bits = int(np.asarray(hi, dtype=col.dtype).view(udt))
+    out = np.empty(n, dtype=np.uint64)
+    m = lib.df_qx_sel_cmp(col.ctypes.data_as(ctypes.c_void_p),
+                          col.itemsize, 1 if col.dtype.kind == "i" else 0,
+                          n, lo_bits, hi_bits, out)
+    if m < 0:
+        return None
+    return out[:m]
+
+
+def qx_sel_isin(col: np.ndarray, ids: np.ndarray):
+    """Ascending index list of rows where col[i] is in ids (native hash
+    set) — the dictionary-id IN / LIKE filter as positions instead of a
+    mask. Returns a uint64 index array or None when unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    col = np.ascontiguousarray(col, dtype=np.uint32)
+    ids = np.ascontiguousarray(ids, dtype=np.uint32)
+    out = np.empty(len(col), dtype=np.uint64)
+    m = lib.df_qx_sel_isin_u32(col, len(col), ids, len(ids), out)
+    if m < 0:
+        return None
+    return out[:m]
+
+
+def qx_gather(src: np.ndarray, idx: np.ndarray):
+    """out[j] = src[idx[j]] natively (idx uint64, any 1/2/4/8-byte
+    dtype). Returns the gathered array or None when unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    if src.itemsize not in (1, 2, 4, 8) or src.dtype.hasobject:
+        return None
+    src = np.ascontiguousarray(src)
+    idx = np.ascontiguousarray(idx, dtype=np.uint64)
+    out = np.empty(len(idx), dtype=src.dtype)
+    rc = lib.df_qx_gather(src.ctypes.data_as(ctypes.c_void_p),
+                          src.itemsize, idx, len(idx),
+                          out.ctypes.data_as(ctypes.c_void_p))
+    if rc != 0:
+        return None
     return out
 
 

@@ -26,7 +26,7 @@ extern "C" {
 // native/__init__.py. Bump on ANY change to exported signatures or packed
 // struct layouts (L7Event, DfPacketOut, flow records); load() refuses a
 // library whose version differs instead of silently corrupting memory.
-int32_t df_abi_version() { return 7; }
+int32_t df_abi_version() { return 8; }
 
 // ---------------------------------------------------------------------------
 // Dictionary: string <-> uint32 id, id 0 reserved for ""

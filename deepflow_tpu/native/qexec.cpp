@@ -163,4 +163,97 @@ void df_qx_isin_u32(const uint32_t* col, uint64_t n, const uint32_t* set,
     }
 }
 
+// -- selective filter + gather (segment format v2 fast path) ----------------
+//
+// Selective predicates over encoded columns produce INDEX LISTS instead of
+// full boolean masks: out_idx holds the ascending row positions that pass,
+// so downstream gathers touch only survivors. All three release the GIL via
+// ctypes; the morsel pool runs them concurrently across scan units.
+
+// out_idx[j] = ascending positions i where lo <= col[i] <= hi (inclusive
+// both ends; caller encodes one-sided ranges with dtype min/max). Bounds
+// arrive as raw 64-bit patterns (lo_bits/hi_bits) reinterpreted per
+// esize/is_signed — the ctypes wrapper packs them from the column dtype.
+// Returns the match count, or -1 on unsupported esize.
+int64_t df_qx_sel_cmp(const void* vals, uint32_t esize, uint32_t is_signed,
+                      uint64_t n, uint64_t lo_bits, uint64_t hi_bits,
+                      uint64_t* out_idx) {
+    uint64_t m = 0;
+    switch ((esize << 1) | (is_signed & 1)) {
+#define DF_SEL_CASE(sz, sgn, T)                                         \
+    case ((sz << 1) | sgn): {                                           \
+        const T* v = (const T*)vals;                                    \
+        const T lo = (T)lo_bits, hi = (T)hi_bits;                       \
+        for (uint64_t i = 0; i < n; i++)                                \
+            if (v[i] >= lo && v[i] <= hi) out_idx[m++] = i;             \
+        break;                                                          \
+    }
+        DF_SEL_CASE(1, 0, uint8_t)
+        DF_SEL_CASE(1, 1, int8_t)
+        DF_SEL_CASE(2, 0, uint16_t)
+        DF_SEL_CASE(2, 1, int16_t)
+        DF_SEL_CASE(4, 0, uint32_t)
+        DF_SEL_CASE(4, 1, int32_t)
+        DF_SEL_CASE(8, 0, uint64_t)
+        DF_SEL_CASE(8, 1, int64_t)
+#undef DF_SEL_CASE
+        default:
+            return -1;
+    }
+    return (int64_t)m;
+}
+
+// Index-list sibling of df_qx_isin_u32: out_idx[j] = ascending positions
+// where col[i] is in `set` (hash set, O(n + n_set)). Returns match count.
+int64_t df_qx_sel_isin_u32(const uint32_t* col, uint64_t n,
+                           const uint32_t* set, uint64_t n_set,
+                           uint64_t* out_idx) {
+    if (n_set == 0) return 0;
+    const uint64_t cap = next_pow2(n_set * 2);
+    const uint64_t hmask = cap - 1;
+    std::vector<uint64_t> tbl(cap, 0);  // slot -> value+1 (0 == empty)
+    for (uint64_t j = 0; j < n_set; j++) {
+        uint64_t s = mix64(set[j]) & hmask;
+        while (tbl[s] != 0 && tbl[s] != (uint64_t)set[j] + 1)
+            s = (s + 1) & hmask;
+        tbl[s] = (uint64_t)set[j] + 1;
+    }
+    uint64_t m = 0;
+    for (uint64_t i = 0; i < n; i++) {
+        const uint64_t v = (uint64_t)col[i] + 1;
+        uint64_t s = mix64(col[i]) & hmask;
+        for (;;) {
+            const uint64_t t = tbl[s];
+            if (t == 0) break;
+            if (t == v) { out_idx[m++] = i; break; }
+            s = (s + 1) & hmask;
+        }
+    }
+    return (int64_t)m;
+}
+
+// out[j] = src[idx[j]] for any element size — the survivor gather that
+// replaces numpy fancy indexing (which allocates an intermediate bool
+// mask first on the python path). Returns 0, or -1 on unsupported esize.
+int32_t df_qx_gather(const void* src, uint32_t esize, const uint64_t* idx,
+                     uint64_t n_idx, void* out) {
+    switch (esize) {
+#define DF_GATHER_CASE(sz, T)                                           \
+    case sz: {                                                          \
+        const T* s = (const T*)src;                                     \
+        T* o = (T*)out;                                                 \
+        for (uint64_t j = 0; j < n_idx; j++) o[j] = s[idx[j]];          \
+        break;                                                          \
+    }
+        DF_GATHER_CASE(1, uint8_t)
+        DF_GATHER_CASE(2, uint16_t)
+        DF_GATHER_CASE(4, uint32_t)
+        DF_GATHER_CASE(8, uint64_t)
+#undef DF_GATHER_CASE
+        default:
+            return -1;
+    }
+    return 0;
+}
+
 }  // extern "C"

@@ -260,10 +260,27 @@ class _Env:
             rv_raw = e.right
             if isinstance(rv_raw, S.Lit) and isinstance(rv_raw.value, str):
                 if op not in ("=", "!="):
-                    # dictionary codes reflect insertion order, not collation
+                    # dictionary codes reflect insertion order, not
+                    # collation — resolve the predicate over the (small)
+                    # dictionary in STRING space, then membership-test
+                    # the ids (same pushdown shape as LIKE). The v2
+                    # zstr zones prune segments for these before any
+                    # column decodes.
+                    val = rv_raw.value
+                    pred = {"<": lambda s: s < val,
+                            "<=": lambda s: s <= val,
+                            ">": lambda s: s > val,
+                            ">=": lambda s: s >= val}[op]
+                    if lv.kind == "str":
+                        ids = lv.dict_.match_ids(pred)
+                        return _Val(_isin(lv.arr, ids), "bool")
+                    if lv.kind == "enum":
+                        ids = [i for i, s in enumerate(lv.labels)
+                               if pred(s)]
+                        return _Val(np.isin(lv.arr, ids), "bool")
                     raise QueryError(
-                        "ordered comparison against a string is not "
-                        "supported (dictionary-encoded column)")
+                        "ordered comparison against a string requires "
+                        "a string or enum column")
                 code = self._coerce_lit(lv, rv_raw.value)
                 l, r = lv.arr, np.asarray(code)
             else:
@@ -531,7 +548,8 @@ def _normalize(table: ColumnarTable, query: S.Select) -> S.Select:
 # simply doesn't prune, which is always sound.
 
 _SCAN_LOCK = threading.Lock()
-_SCAN_STATS = {"scanned_segments": 0, "pruned_segments": 0}
+_SCAN_STATS = {"scanned_segments": 0, "pruned_segments": 0,
+               "bloom_checked": 0, "bloom_pruned": 0}
 _SCAN_HOP = None
 
 
@@ -548,16 +566,24 @@ def scan_stats() -> dict:
         return dict(_SCAN_STATS)
 
 
-def _note_scan(candidates: int, pruned: int) -> None:
+def _note_scan(candidates: int, pruned: int, bloom_checked: int = 0,
+               bloom_pruned: int = 0) -> None:
     if not candidates:
         return
+    scanned = candidates - pruned - bloom_pruned
     with _SCAN_LOCK:
-        _SCAN_STATS["scanned_segments"] += candidates - pruned
+        _SCAN_STATS["scanned_segments"] += scanned
         _SCAN_STATS["pruned_segments"] += pruned
+        _SCAN_STATS["bloom_checked"] += bloom_checked
+        _SCAN_STATS["bloom_pruned"] += bloom_pruned
     hop = _SCAN_HOP
     if hop is not None:
-        hop.account(emitted=candidates, delivered=candidates - pruned,
+        # two reasons, one conserved ledger: emitted == delivered +
+        # dropped[pruned] + dropped[bloom_pruned] per scan
+        hop.account(emitted=candidates, delivered=scanned,
                     dropped=pruned, reason="pruned")
+        if bloom_pruned:
+            hop.account(dropped=bloom_pruned, reason="bloom_pruned")
 
 
 def split_conjuncts(e) -> list:
@@ -632,6 +658,14 @@ def _zone_constraints(table: ColumnarTable, where) -> list[tuple]:
         if (c.op not in ("=", "<", "<=", ">", ">=")
                 or not isinstance(c.right, S.Lit)):
             continue
+        if (c.op != "=" and isinstance(c.right.value, str)
+                and table.columns[col].kind in ("str", "enum")):
+            # ordered string predicates live in COLLATION order;
+            # dictionary/enum ids reflect insertion order, so an
+            # id-space interval here would prune segments that DO hold
+            # matching rows. String-order pruning happens against the
+            # v2 zstr index in _str_pruned instead.
+            continue
         v = _zone_coerce(table, col, c.right.value)
         if v is None:
             continue
@@ -646,6 +680,73 @@ def _zone_constraints(table: ColumnarTable, where) -> list[tuple]:
         else:
             cons.append((col, v, None))
     return cons
+
+
+def _index_constraints(table: ColumnarTable, where) -> tuple[list, list]:
+    """Skip-index NECESSARY conditions from top-level AND conjuncts:
+
+    -> (idcons, strcons) where idcons is [(col, [encoded ids])] from
+    `col = 'lit'` / `col IN (...)` over dictionary/enum columns (checked
+    against the segment's inline id list or bloom filter) and strcons is
+    [(col, op, value)] from ordered string predicates over dictionary
+    columns (checked against the segment's zstr collation-order zone).
+    Anything else contributes nothing, which is always sound."""
+    idcons: list[tuple] = []
+    strcons: list[tuple] = []
+    for c in split_conjuncts(where):
+        if not (isinstance(c, S.BinOp) and isinstance(c.left, S.Col)
+                and c.left.name in table.columns):
+            continue
+        col = c.left.name
+        spec = table.columns[col]
+        if spec.kind not in ("str", "enum"):
+            continue
+        if c.op == "IN" and isinstance(c.right, tuple) and c.right:
+            ids = []
+            ok = True
+            for lit in c.right:
+                if not isinstance(lit, S.Lit):
+                    ok = False
+                    break
+                v = _zone_coerce(table, col, lit.value)
+                if v is None or isinstance(v, float):
+                    ok = False
+                    break
+                if v is not _NO_ROW:
+                    ids.append(int(v))
+            if ok:
+                idcons.append((col, ids))
+            continue
+        if not isinstance(c.right, S.Lit):
+            continue
+        if c.op == "=":
+            v = _zone_coerce(table, col, c.right.value)
+            if v is _NO_ROW:
+                idcons.append((col, []))
+            elif v is not None and not isinstance(v, float):
+                idcons.append((col, [int(v)]))
+        elif c.op in ("<", "<=", ">", ">=") and spec.kind == "str" \
+                and isinstance(c.right.value, str):
+            strcons.append((col, c.op, c.right.value))
+    return idcons, strcons
+
+
+def _str_pruned(seg, strcons: list) -> bool:
+    """True when the segment's zstr (collation-order) zone proves no row
+    satisfies an ordered string predicate. A truncated upper bound is
+    stored as None = unbounded, so absence never prunes."""
+    for col, op, val in strcons:
+        z = seg.str_zone(col)
+        if z is None:
+            continue
+        lo, hi = z
+        if op in (">", ">=") and hi is not None:
+            if hi < val or (op == ">" and hi <= val):
+                return True
+        elif op in ("<", "<="):
+            if lo > val or (op == "<" and lo >= val):
+                return True
+    return False
 
 
 def _zone_pruned(zones: dict | None, cons: list) -> bool:
@@ -692,24 +793,227 @@ def _needed_cols(table: ColumnarTable, query: S.Select,
     return needed
 
 
+def _chunk_rows(ch) -> int:
+    """Row count of a scan chunk WITHOUT decoding any column: segment
+    LazyChunks carry .rows; plain RAM dicts pay one len()."""
+    rows = getattr(ch, "rows", None)
+    if rows is not None:
+        return rows
+    return len(next(iter(ch.values()))) if ch else 0
+
+
+# Index-list filtering vs full-mask evaluation. The native kernels win on
+# selective predicates (survivors come back as positions, later conjuncts
+# touch only them); numpy wins on tiny chunks where ctypes dispatch
+# dominates. Seeded overheads keep small scans on numpy until the model
+# has real observations for this machine.
+_FILT = KernelCostModel(overhead_ns={"native": 15_000.0, "numpy": 1_000.0})
+
+_ORD_PREDS = {
+    "<": lambda val: lambda s: s < val,
+    "<=": lambda val: lambda s: s <= val,
+    ">": lambda val: lambda s: s > val,
+    ">=": lambda val: lambda s: s >= val,
+}
+
+
+def _filter_prims(table: ColumnarTable, where) -> list[tuple] | None:
+    """Compile the WHERE into filter primitives, or None when any
+    conjunct falls outside the primitive forms (the generic mask path
+    then evaluates the whole WHERE — never a partial split, so both
+    paths always agree).
+
+    Primitive forms, each provably equivalent to its _Env evaluation:
+      ("range", col, lo, hi)  — integer column between two in-dtype
+                                bounds (= / < / <= / > / >= with an int
+                                literal; one-sided ops use dtype min/max)
+      ("isin",  col, ids, _)  — dict/enum column id in a resolved set
+                                (= / IN / LIKE / ordered string literal,
+                                same id resolution as _eval_binop)
+      ("never", col, _, _)    — literal provably out of the column's
+                                value space: no row matches
+    Float literals are NOT compiled: numpy compares int columns to float
+    literals in float space, and mirroring that with integer bounds would
+    diverge at the float64-precision edge for u64 timestamps."""
+    prims: list[tuple] = []
+    for c in split_conjuncts(where):
+        if not (isinstance(c, S.BinOp) and isinstance(c.left, S.Col)
+                and c.left.name in table.columns):
+            return None
+        col = c.left.name
+        spec = table.columns[col]
+        if c.op == "IN":
+            if spec.kind not in ("str", "enum") \
+                    or not isinstance(c.right, tuple):
+                return None
+            ids = []
+            for lit in c.right:
+                if not isinstance(lit, S.Lit) \
+                        or not isinstance(lit.value, str):
+                    return None
+                v = _zone_coerce(table, col, lit.value)
+                if v is not _NO_ROW:
+                    ids.append(int(v))
+            prims.append(("isin", col,
+                          np.asarray(sorted(set(ids)), dtype=np.uint32),
+                          None))
+            continue
+        if c.op == "LIKE":
+            if not (isinstance(c.right, S.Lit)
+                    and isinstance(c.right.value, str)):
+                return None
+            pred = _like_to_pred(c.right.value)
+            if spec.kind == "str":
+                ids = table.dicts[col].match_ids(pred)
+            elif spec.kind == "enum":
+                ids = [i for i, s in enumerate(spec.enum_values)
+                       if pred(s)]
+            else:
+                return None
+            prims.append(("isin", col, np.asarray(ids, dtype=np.uint32),
+                          None))
+            continue
+        if c.op not in ("=", "<", "<=", ">", ">=") \
+                or not isinstance(c.right, S.Lit):
+            return None
+        val = c.right.value
+        if spec.kind in ("str", "enum"):
+            if not isinstance(val, str):
+                return None
+            if c.op == "=":
+                v = _zone_coerce(table, col, val)
+                ids = [] if v is _NO_ROW else [int(v)]
+            else:
+                pred = _ORD_PREDS[c.op](val)
+                if spec.kind == "str":
+                    ids = table.dicts[col].match_ids(pred)
+                else:
+                    ids = [i for i, s in enumerate(spec.enum_values)
+                           if pred(s)]
+            prims.append(("isin", col, np.asarray(ids, dtype=np.uint32),
+                          None))
+            continue
+        dt = np.dtype(spec.np_dtype)
+        if dt.kind not in "iu":
+            return None
+        if isinstance(val, bool):
+            val = int(val)
+        if not isinstance(val, int):
+            return None
+        info = np.iinfo(dt)
+        lo, hi = int(info.min), int(info.max)
+        if c.op == "=":
+            lo = hi = val
+        elif c.op == "<":
+            hi = val - 1
+        elif c.op == "<=":
+            hi = val
+        elif c.op == ">":
+            lo = val + 1
+        else:
+            lo = val
+        if lo > int(info.max) or hi < int(info.min):
+            prims.append(("never", col, 0, 0))
+            continue
+        lo = max(lo, int(info.min))
+        hi = min(hi, int(info.max))
+        prims.append(("range", col, lo, hi))
+    return prims
+
+
+def _select_rows(get_col, sz: int, prims: list[tuple]) -> np.ndarray:
+    """Ascending survivor positions for an all-primitive WHERE. The
+    first primitive selects over the full column; each later one gathers
+    only the current survivors and refines (`idx = idx[sub_idx]`), so a
+    selective leading conjunct makes the rest near-free — and an empty
+    survivor set short-circuits before later columns ever decode.
+    Ascending positions make `arr[idx]` byte-identical to `arr[mask]`
+    on the generic path. Kernel choice (native index kernels vs numpy
+    nonzero) is learned per size class by _FILT."""
+    idx = None  # None = every row still alive
+    for kind, col, a, b in prims:
+        if kind == "never":
+            return np.empty(0, dtype=np.uint64)
+        if idx is not None and not len(idx):
+            return idx
+        arr = get_col(col)
+        if arr.ndim == 1 and len(arr) and arr.strides[0] == 0:
+            # broadcast fill column: one value answers for every row
+            one = arr[:1]
+            ok = bool((_isin(one, a) if kind == "isin"
+                       else (one >= a) & (one <= b))[0])
+            if ok:
+                continue
+            return np.empty(0, dtype=np.uint64)
+        n = sz if idx is None else len(idx)
+        kern = _FILT.choose(n) if native.available() else "numpy"
+        t0 = time.perf_counter_ns()
+        if idx is None:
+            sub = arr
+        else:
+            sub = native.qx_gather(arr, idx) if kern == "native" else None
+            if sub is None:
+                sub = arr[idx]
+        out = None
+        if kern == "native":
+            out = (native.qx_sel_range(sub, a, b) if kind == "range"
+                   else native.qx_sel_isin(sub, a))
+        if out is None:
+            kern = "numpy"
+            m = (_isin(sub, a) if kind == "isin"
+                 else (sub >= a) & (sub <= b))
+            out = np.nonzero(m)[0].astype(np.uint64)
+        _FILT.observe(kern, n, time.perf_counter_ns() - t0)
+        idx = out if idx is None else idx[out]
+    if idx is None:
+        idx = np.arange(sz, dtype=np.uint64)
+    return idx
+
+
 def _scan_plan(table: ColumnarTable, query: S.Select) -> list[dict]:
-    """One scan's chunk list, zone-pruned and accounted to the ledger.
+    """One scan's chunk list, pruned and accounted to the ledger.
     Shared by the serial and morsel-parallel paths, so both skip the
-    same segments and the pruning counters mean the same thing."""
+    same segments and the pruning counters mean the same thing.
+
+    Two pruning stages, cheapest first: zone maps (min/max in the
+    encoded space, plus zstr collation-order bounds), then the v2
+    per-segment skip indexes (inline id list / bloom filter) for
+    equality and IN over dictionary columns. Every skipped segment is
+    a LazyChunk that never decodes a byte."""
     units = table.scan_units()
-    cons = (_zone_constraints(table, query.where)
-            if query.where is not None else [])
+    cons = idcons = strcons = ()
+    if query.where is not None:
+        cons = _zone_constraints(table, query.where)
+        idcons, strcons = _index_constraints(table, query.where)
     chunks = []
-    zoned = pruned = 0
-    for ch, zones in units:
+    zoned = pruned = bchecked = bpruned = 0
+    for ch, zones, seg in units:
         if zones is not None:
             zoned += 1
         if cons and _zone_pruned(zones, cons):
             if zones is not None:
                 pruned += 1
             continue
+        if seg is not None and (idcons or strcons):
+            if strcons and _str_pruned(seg, strcons):
+                pruned += 1
+                continue
+            hit = True
+            checked = False
+            for col, ids in idcons:
+                if not seg.has_index(col):
+                    continue
+                checked = True
+                if not seg.maybe_contains(col, ids):
+                    hit = False
+                    break
+            if checked:
+                bchecked += 1
+            if not hit:
+                bpruned += 1
+                continue
         chunks.append(ch)
-    _note_scan(zoned, pruned)
+    _note_scan(zoned, pruned, bchecked, bpruned)
     return chunks
 
 
@@ -721,8 +1025,24 @@ def _materialize(table: ColumnarTable, query: S.Select,
 
     # filter per chunk, then materialize needed columns
     chunks = _scan_plan(table, query)
-    chunk_sizes = [len(next(iter(ch.values()))) if ch else 0 for ch in chunks]
+    chunk_sizes = [_chunk_rows(ch) for ch in chunks]
     if query.where is not None:
+        prims = _filter_prims(table, query.where)
+        if prims is not None:
+            # index-list path: survivors come back as ascending
+            # positions; chunks with zero survivors never decode the
+            # remaining needed columns at all
+            idxs = [_select_rows(ch.__getitem__, sz, prims)
+                    for ch, sz in zip(chunks, chunk_sizes)]
+            n_rows = int(sum(len(i) for i in idxs))
+            cols = {}
+            for name in needed:
+                parts = [ch[name][i] for ch, i in zip(chunks, idxs)
+                         if len(i)]
+                cols[name] = (np.concatenate(parts) if parts else
+                              np.empty(0,
+                                       dtype=table.columns[name].np_dtype))
+            return _Env(table, cols), n_rows
         masks = []
         for ch, sz in zip(chunks, chunk_sizes):
             env = _Env(table, ch)
@@ -1034,23 +1354,30 @@ def _execute_parallel(table: ColumnarTable, query: S.Select,
     mrows = _morsel_rows()
     morsels: list[tuple[dict, int, int]] = []
     for ch in chunks:
-        sz = len(next(iter(ch.values()))) if ch else 0
+        sz = _chunk_rows(ch)
         for lo in range(0, sz, mrows):
             morsels.append((ch, lo, min(lo + mrows, sz)))
     dict_names = {id(d): cn for cn, d in table.dicts.items()}
     where = query.where
+    prims = _filter_prims(table, where) if where is not None else None
 
     def scan_one(m):
         ch, lo, hi = m
-        cols = {name: ch[name][lo:hi] for name in needed}
         n = hi - lo
-        if where is not None:
+        if prims is not None:
+            idx = _select_rows(lambda c: ch[c][lo:hi], n, prims)
+            cols = {name: ch[name][lo:hi][idx] for name in needed}
+            n = len(idx)
+        elif where is not None:
+            cols = {name: ch[name][lo:hi] for name in needed}
             mask = _Env(table, cols).eval(where).arr
             if mask.ndim == 0:  # no column refs: scalar condition
                 mask = np.full(n, bool(mask))
             mask = mask.astype(bool)
             cols = {k: v[mask] for k, v in cols.items()}
             n = int(mask.sum())
+        else:
+            cols = {name: ch[name][lo:hi] for name in needed}
         used_m: dict = {}
         part = _partial_from_env(table, query, sites, _Env(table, cols),
                                  n, encoded=True, dict_names=dict_names,

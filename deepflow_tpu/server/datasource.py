@@ -98,7 +98,8 @@ class RollupJob:
         self._watermark: dict[tuple, int] = {}
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
-        self.stats = {"rollups": 0, "rows": 0, "sketch_rows": 0}
+        self.stats = {"rollups": 0, "rows": 0, "sketch_rows": 0,
+                      "compact_runs": 0}
         self._stats_lock = threading.Lock()  # families roll concurrently
 
     def start(self) -> "RollupJob":
@@ -181,7 +182,27 @@ class RollupJob:
         if total:
             self.stats["rollups"] += 1
             self.stats["rows"] += total
+            self._compact_destinations()
         return total
+
+    def _compact_destinations(self) -> None:
+        """Rollup destinations accumulate one tiny flushed segment per
+        completed bucket; fold them into sorted format-v2 runs so
+        long-range queries over the coarse tiers scan a handful of runs
+        instead of hundreds of slivers. No-op without tiered storage,
+        and cheap when there is nothing to merge (single-run groups are
+        skipped by the compaction planner)."""
+        if getattr(self.db, "tier_store", None) is None:
+            return
+        for family in FAMILIES:
+            for _src_sfx, dst_sfx, _bucket in _STAGES:
+                try:
+                    res = self.db.compact_tier(f"{family}.{dst_sfx}")
+                except Exception:
+                    log.exception("rollup compaction failed")
+                    continue
+                with self._stats_lock:
+                    self.stats["compact_runs"] += res.get("runs_built", 0)
 
     def _sketch_map(self, src, spec: RollupSpec, sketch_col: str,
                     wm: int, horizon: int, bucket: int) -> dict:

@@ -162,3 +162,65 @@ class Flusher:
                 self.flush_once()
             except Exception:
                 log.exception("tier flush failed")
+
+
+class Compactor:
+    """Periodic tier compaction: merges the flusher's small sealed
+    segments into time-sorted format-v2 runs (store/tiered.py compact).
+    Runs well below the flush cadence — each cycle is one crash-safe
+    manifest commit per merge group, and any v1 segments it meets are
+    migrated to v2 as a side effect (online migrate-on-compact), so a
+    long-lived server converges to all-v2 with zero downtime."""
+
+    def __init__(self, db, interval_s: float = 60.0,
+                 telemetry=None) -> None:
+        self.db = db
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.stats = {"cycles": 0, "runs_built": 0,
+                      "segments_replaced": 0, "rows": 0,
+                      "segments_migrated": 0, "errors": 0,
+                      "compact_ns": 0}
+        if telemetry is None:
+            from deepflow_tpu.telemetry import Telemetry
+            telemetry = Telemetry("server", enabled=False)
+        self._telemetry = telemetry
+
+    def compact_once(self) -> dict:
+        """One full-database compaction pass (also the dfctl/test entry
+        point). Builds on the shared query pool when one is configured."""
+        from deepflow_tpu.query.pool import get_pool
+        t0 = time.perf_counter_ns()
+        res = self.db.compact_tier(pool=get_pool())
+        self.stats["cycles"] += 1
+        for k in ("runs_built", "segments_replaced", "rows",
+                  "segments_migrated"):
+            self.stats[k] += res.get(k, 0)
+        self.stats["compact_ns"] += time.perf_counter_ns() - t0
+        return res
+
+    def start(self) -> "Compactor":
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run,
+                                        name="df-compactor", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def _run(self) -> None:
+        hb = self._telemetry.heartbeat(
+            "compactor", interval_hint_s=max(1.0, self.interval_s))
+        hb.beat()
+        while not self._stop.wait(self.interval_s):
+            hb.beat(progress=self.stats["cycles"])
+            try:
+                self.compact_once()
+            except Exception:
+                self.stats["errors"] += 1
+                log.exception("tier compaction failed")

@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -1244,6 +1245,44 @@ class QuerierAPI:
                     "fanout": {}}
         return self.federation.cluster_status()
 
+    def segments(self, table: str | None = None,
+                 v1_only: bool = False) -> dict:
+        """Per-segment inspector (the `dfctl segments` backend): format
+        version, rows, per-column codecs, zone/skip-index presence and
+        sorted-run membership for every on-disk segment of a table (or
+        all tables). ``v1_only`` filters to segments still awaiting
+        format migration."""
+        store = getattr(self.db, "tier_store", None)
+        if store is None:
+            return {"tables": {}, "storage": False}
+        self.db._ensure_loaded()
+        names = [table] if table else sorted(store.tables())
+        tables: dict[str, list] = {}
+        for name in names:
+            tt = store.tier(name)
+            rows = []
+            for seg in tt.segments():
+                if v1_only and seg.fmt >= 2:
+                    continue
+                codecs = seg.codecs()
+                rows.append({
+                    "file": os.path.basename(seg.path),
+                    "format": seg.fmt,
+                    "rows": seg.rows,
+                    "bytes": seg.nbytes,
+                    "tmin": seg.tmin, "tmax": seg.tmax,
+                    "run": seg.run,
+                    "sorted_by": seg.sorted_by,
+                    "codecs": codecs,
+                    "zoned_cols": len(seg.zones),
+                    "indexed_cols": sorted(
+                        c for c in codecs if seg.has_index(c)),
+                })
+            if rows or not v1_only:
+                tables[name] = rows
+        return {"tables": tables, "storage": True,
+                "compact_gen": store.compact_gen}
+
     def health(self) -> dict:
         """Liveness + the self-telemetry spine: per-stage heartbeat
         status, the per-hop frame ledger (with imbalance), and wedge
@@ -1378,6 +1417,10 @@ class QuerierHTTP:
                         self._send(200, api.cluster_status())
                     elif path == "/v1/agents":
                         self._send(200, api.agents())
+                    elif path == "/v1/segments":
+                        self._send(200, api.segments(
+                            table=params.get("table") or None,
+                            v1_only=params.get("v1") in ("1", "true")))
                     elif path == "/v1/alerts":
                         self._send(200, api.alerts_api("list", {}))
                     elif path == "/v1/exporters":

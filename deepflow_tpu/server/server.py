@@ -43,6 +43,7 @@ class Server:
                  replication: int = 0,
                  storage: bool = False,
                  flush_interval_s: float = 1.0,
+                 compact_interval_s: float = 60.0,
                  storage_max_bytes: int = 0) -> None:
         # flow-log decode parallelism for THIS server instance; None
         # defers to the DF_INGEST_WORKERS env knob read at import time
@@ -79,10 +80,12 @@ class Server:
         # only after the manifest commit that makes their rows durable
         self.storage = bool(storage and data_dir)
         self.flush_interval_s = flush_interval_s
+        self.compact_interval_s = compact_interval_s
         self.storage_max_bytes = max(0, int(storage_max_bytes))
         self.db = Database(data_dir=data_dir, shard_id=shard_id,
                            storage=self.storage)
         self.flusher = None
+        self.compactor = None
         self.durability = None
         if self.storage:
             from deepflow_tpu.server.flusher import DurabilityGate
@@ -179,6 +182,8 @@ class Server:
             "janitor": dict(self.janitor.stats),
             "flusher": (dict(self.flusher.stats)
                         if self.flusher is not None else None),
+            "compactor": (dict(self.compactor.stats)
+                          if self.compactor is not None else None),
             "genesis": (dict(self.genesis.stats)
                         if self.genesis is not None else None),
         }
@@ -369,13 +374,17 @@ class Server:
             d.MSG_TYPE = mtype  # FlowLogDecoder serves two types
             self.decoders.append(d.start())
         if self.storage:
-            from deepflow_tpu.server.flusher import Flusher
+            from deepflow_tpu.server.flusher import Compactor, Flusher
             self.flusher = Flusher(self.db, gate=self.durability,
                                    seq_tracker=self.receiver.seq_tracker,
                                    interval_s=self.flush_interval_s,
                                    telemetry=self.telemetry)
             self.flusher.seed_floors(floors)
             self.flusher.start()
+            if self.compact_interval_s > 0:
+                self.compactor = Compactor(
+                    self.db, interval_s=self.compact_interval_s,
+                    telemetry=self.telemetry).start()
         self.receiver.start()
         self.http.start()
         if self._cluster_on:
@@ -494,6 +503,11 @@ class Server:
                 d.flush()  # stateful reducers drain pending windows
                 # BEFORE the db persists (the file_agg tail otherwise
                 # vanishes on every restart)
+        if self.compactor is not None:
+            # before the final flush: a mid-commit compaction and the
+            # flush both rename the manifest; stop the race first
+            self.compactor.stop()
+            self.compactor = None
         if self.flusher is not None:
             # after the decoder drain: the final flush commits everything
             # they wrote (and parked) and releases the last gated seqs,
@@ -583,6 +597,10 @@ def main() -> None:
                              "makes their rows durable")
     parser.add_argument("--flush-interval-s", type=float, default=1.0,
                         help="tier flush cadence (storage mode)")
+    parser.add_argument("--compact-interval-s", type=float, default=60.0,
+                        help="tier compaction cadence (storage mode): "
+                             "merge small sealed segments into sorted "
+                             "format-v2 runs; 0 disables")
     parser.add_argument("--storage-max-mb", type=int, default=0,
                         help="on-disk tier size budget per node; the "
                              "janitor evicts oldest segments past it "
@@ -613,6 +631,7 @@ def main() -> None:
                     replication=args.replication,
                     storage=args.storage,
                     flush_interval_s=args.flush_interval_s,
+                    compact_interval_s=args.compact_interval_s,
                     storage_max_bytes=args.storage_max_mb << 20,
                     enable_controller=not args.no_controller).start()
     try:

@@ -132,6 +132,32 @@ class Database:
             self._tables[name].confirm_flush(payload)
         return rows
 
+    def compact_tier(self, name: str | None = None, *,
+                     min_merge: int = 2, pool=None, **kw) -> dict:
+        """Compact one table's tier (or every table when name is None)
+        into sorted format-v2 runs. Hands the table's live dictionaries
+        to the compactor (dict-order rewrite + string skip indexes) and
+        owns the post-compaction bookkeeping the store can't do: the
+        table watermark/change-token bump. Returns aggregate counters.
+        """
+        out = {"runs_built": 0, "segments_replaced": 0, "rows": 0,
+               "bytes_before": 0, "bytes_after": 0,
+               "segments_migrated": 0}
+        if self.tier_store is None:
+            return out
+        self._ensure_loaded()
+        names = [name] if name is not None else list(self._tables)
+        for n in names:
+            t = self._tables.get(n)
+            res = self.tier_store.compact(
+                n, dicts=dict(t.dicts) if t is not None else None,
+                min_merge=min_merge, pool=pool, **kw)
+            if res["runs_built"] and t is not None:
+                t.note_tier_compact()
+            for k in out:
+                out[k] += res.get(k, 0)
+        return out
+
     def _attach_tiers(self) -> None:
         """Restart recovery: merge persisted dictionaries (append-only —
         the longest dump is a superset), drop segments no dictionary can

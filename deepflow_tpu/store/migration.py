@@ -105,6 +105,69 @@ def validate_loadable(data_dir: str) -> None:
             f"understands <= v{SCHEMA_VERSION} (downgrade-unsafe)")
 
 
-__all__ = ["SCHEMA_VERSION", "MIGRATIONS", "Rename", "Retype", "Drop",
-           "migrate_chunk", "write_manifest", "read_manifest_version",
-           "validate_loadable", "np"]
+# -- segment FORMAT migration (ckissu-style, online) -------------------------
+#
+# Orthogonal to the SCHEMA chain above: SCHEMA_VERSION covers column
+# shapes, SEGMENT_FORMAT covers the on-disk byte layout of one segment
+# file (store/segment.py). The upgrade is ONLINE and idempotent —
+# never a boot-time rewrite pass:
+#
+#   V1-LIVE    a DFSEG001 file listed in the tier manifest. Readable
+#              forever (Segment.open handles both magics); counted by
+#              migrate_v1_remaining in /v1/health.
+#   STAGED     compaction wrote its rows into a v2 run file; the
+#              manifest still lists only the v1 segment. Crash here:
+#              recovery deletes the unlisted run, state = V1-LIVE.
+#   COMMITTED  the manifest rename listed the run and dropped the v1
+#              segment. Crash here: recovery deletes the v1 FILE as
+#              unlisted torn tail, state = V2-LIVE.
+#   V2-LIVE    only the v2 run remains.
+#
+# Every crash point converges through TieredStore.recover()'s single
+# rule (manifest == disk), which is the restart-mid-migrate chaos arm's
+# whole proof obligation. Downgrade safety: a pre-v2 build refuses
+# DFSEG002 files by magic, and SEGMENT_FORMAT > its known max is the
+# same "downgrade-unsafe" contract as validate_loadable.
+
+SEGMENT_FORMAT = 2
+
+
+def segment_format_counts(store) -> dict[int, int]:
+    """{format_version -> live segment count} across a TieredStore."""
+    out: dict[int, int] = {}
+    for tt in store.tables().values():
+        for s in tt.segments():
+            out[s.fmt] = out.get(s.fmt, 0) + 1
+    return out
+
+
+def migrate_segments(db, tables: list[str] | None = None, *,
+                     pool=None) -> dict:
+    """Drive migrate-on-compact for a Database with an attached tier:
+    compact every table still holding v1 segments (compaction always
+    emits format-v2 runs, even for a lone v1 segment). Returns the
+    aggregate compaction counters plus ``v1_remaining``. Safe to call
+    repeatedly; a fully-migrated store is a no-op."""
+    store = getattr(db, "tier_store", None)
+    out = {"tables": 0, "runs_built": 0, "segments_migrated": 0,
+           "v1_remaining": 0}
+    if store is None:
+        return out
+    names = tables if tables is not None else [
+        name for name, tt in store.tables().items()
+        if any(s.fmt < 2 for s in tt.segments())]
+    for name in names:
+        res = db.compact_tier(name, min_merge=1, pool=pool) \
+            if hasattr(db, "compact_tier") else \
+            store.compact(name, min_merge=1, pool=pool)
+        out["tables"] += 1
+        out["runs_built"] += res.get("runs_built", 0)
+        out["segments_migrated"] += res.get("segments_migrated", 0)
+    out["v1_remaining"] = store.migrate_v1_remaining()
+    return out
+
+
+__all__ = ["SCHEMA_VERSION", "SEGMENT_FORMAT", "MIGRATIONS", "Rename",
+           "Retype", "Drop", "migrate_chunk", "write_manifest",
+           "read_manifest_version", "validate_loadable",
+           "segment_format_counts", "migrate_segments", "np"]

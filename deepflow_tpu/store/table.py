@@ -434,21 +434,34 @@ class ColumnarTable:
             else:
                 self._wide_mark = self.watermark
 
+    def note_tier_compact(self) -> None:
+        """Tier compaction bookkeeping: rows and answers are unchanged
+        (merge + stable time sort preserves every aggregate), but the
+        scan-unit set was rebuilt, so conservatively move the change
+        token — a cached plan keyed on the old segment list must not
+        pin decoded chunks of unlinked files forever."""
+        with self._lock:
+            self.watermark += 1
+            self._wide_mark = self.watermark
+
     # -- read path -----------------------------------------------------------
 
     def snapshot(self) -> list[dict[str, np.ndarray]]:
         """Chunk list incl. every stripe's current buffer (sealed copies).
         All stripe locks are held while reading so no seal can move rows
         between the chunk list and a buffer mid-snapshot."""
-        return [ch for ch, _z in self.scan_units()]
+        return [ch for ch, _z, _s in self.scan_units()]
 
-    def scan_units(self) -> list[tuple[dict, dict | None]]:
-        """snapshot() with pruning metadata: (chunk, zones) pairs under
-        the same locking, where zones is the backing segment's per-column
-        (zmin, zmax) map for tier chunks and None for RAM chunks (live
-        stripes and pending flushes mutate too often to keep bounds)."""
+    def scan_units(self) -> list[tuple[dict, dict | None, object]]:
+        """snapshot() with pruning metadata: (chunk, zones, segment)
+        triples under the same locking, where zones is the backing
+        segment's per-column (zmin, zmax) map for tier chunks and None
+        for RAM chunks (live stripes and pending flushes mutate too
+        often to keep bounds); segment is the backing store Segment for
+        tier chunks (its v2 bloom/bitmap skip indexes feed the planner)
+        and None for RAM chunks."""
         stripes = self._all_stripes()
-        units: list[tuple[dict, dict | None]] = []
+        units: list[tuple[dict, dict | None, object]] = []
         with contextlib.ExitStack() as stack:
             for s in stripes:
                 stack.enter_context(s.lock)
@@ -460,13 +473,14 @@ class ColumnarTable:
                 # stripes -> table -> tier everywhere.
                 if self.tier is not None:
                     units.extend(self.tier.units())
-                units.extend((ch, None) for ch in self._pending_flush)
-                units.extend((ch, None) for ch in self._chunks)
+                units.extend((ch, None, None)
+                             for ch in self._pending_flush)
+                units.extend((ch, None, None) for ch in self._chunks)
             for s in stripes:
                 if not s.rows:
                     continue
                 if s.mat is not None and s.mat[0] == s.seq:
-                    units.append((s.mat[1], None))
+                    units.append((s.mat[1], None, None))
                     continue
                 chunk = {}
                 for name, spec in self.columns.items():
@@ -476,7 +490,7 @@ class ColumnarTable:
                     s.buf[name] = [arr]
                     chunk[name] = arr
                 s.mat = (s.seq, chunk)
-                units.append((chunk, None))
+                units.append((chunk, None, None))
         return units
 
     def column_concat(self, names: list[str],

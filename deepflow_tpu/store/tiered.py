@@ -42,6 +42,8 @@ import logging
 import os
 import threading
 
+import numpy as np
+
 from deepflow_tpu.store.segment import Segment, SegmentError, write_segment
 
 log = logging.getLogger("df.tiered")
@@ -50,6 +52,10 @@ MANIFEST = "MANIFEST.json"
 _FORMAT_VERSION = 1
 # flush generations between zlib probe re-runs (TableTier.codec_hints)
 _CODEC_REPROBE_GENS = 32
+# compaction defaults: merge sealed segments into 1-hour sorted runs,
+# splitting a run into pieces of at most this many rows
+_PARTITION_NS = 3_600_000_000_000
+_TARGET_ROWS = 1 << 20
 
 
 def _fsync_dir(path: str) -> None:
@@ -84,6 +90,9 @@ class TableTier:
         # zone maps aligned 1:1 with _chunk_cache (same segment order) so
         # the scan planner can pair every chunk with its pruning bounds
         self._zone_cache: list[dict] | None = None
+        # live Segment objects aligned with the two caches above (the
+        # planner consults their bloom/bitmap skip indexes)
+        self._seg_cache: list[Segment] | None = None
         # set at attach time so chunks() can backfill additively-new
         # columns exactly like ColumnarTable.load() does
         self._columns = None
@@ -96,6 +105,10 @@ class TableTier:
         # entropy drifts gets re-probed (see segment.write_segment)
         self._codec_memo: dict[str, bool] = {}
         self._codec_memo_gen: int | None = None
+        # chosen-codec tally across every block this tier wrote (flush
+        # AND compaction) — surfaced in the tier snapshot so ops can see
+        # what choose_codec actually picked (ISSUE 11 satellite)
+        self.codec_counts: dict[str, int] = {}
 
     # -- read side ----------------------------------------------------------
 
@@ -105,9 +118,12 @@ class TableTier:
 
     def _fill_caches(self) -> None:
         live = [s for s in self._segments if s.rows]
+        # LAZY chunks: a column block decodes on first touch, so a
+        # segment the planner prunes (zones/bloom) never pays a decode
         self._chunk_cache = [s.chunk(self._columns, self._fills)
                              for s in live]
         self._zone_cache = [s.zones for s in live]
+        self._seg_cache = live
 
     def chunks(self) -> list[dict]:
         with self._lock:
@@ -115,14 +131,17 @@ class TableTier:
                 self._fill_caches()
             return list(self._chunk_cache)
 
-    def units(self) -> list[tuple[dict, dict]]:
-        """(chunk, zones) pairs for the scan planner — zones is the
-        segment's per-column (zmin, zmax) map (possibly just the time
-        column for pre-zone-map segments)."""
+    def units(self) -> list[tuple[dict, dict, Segment]]:
+        """(chunk, zones, segment) triples for the scan planner — zones
+        is the segment's per-column (zmin, zmax) map (possibly just the
+        time column for pre-zone-map segments); the Segment itself rides
+        along so the planner can consult its v2 skip indexes
+        (maybe_contains / str_zone) before touching any column."""
         with self._lock:
             if self._chunk_cache is None:
                 self._fill_caches()
-            return list(zip(self._chunk_cache, self._zone_cache))
+            return list(zip(self._chunk_cache, self._zone_cache,
+                            self._seg_cache))
 
     def zoned_count(self) -> int:
         """Segments carrying per-column zone maps (vs time-only/none)."""
@@ -231,10 +250,20 @@ class TieredStore:
         self.ack_floors: dict[int, int] = {}
         self.flush_gen = 0
         self.evict_gen = 0
+        # one compaction run id per merged group; persisted in the
+        # manifest so run ids stay unique across restarts
+        self.compact_gen = 0
         self.stats = {"commits": 0, "segments_written": 0,
                       "rows_flushed": 0, "torn_dropped": 0,
                       "segments_evicted": 0, "rows_evicted": 0,
-                      "bytes_evicted": 0}
+                      "bytes_evicted": 0,
+                      "runs_built": 0, "segments_replaced": 0,
+                      "compact_rows": 0, "bytes_before": 0,
+                      "bytes_after": 0, "segments_migrated": 0}
+        # observed write-cost of each codec choice (deferred import:
+        # query.costmodel must not be imported at store import time —
+        # query/__init__ imports the engine which imports the store)
+        self._codec_cost = None
 
     def tier(self, name: str) -> TableTier:
         with self._lock:
@@ -260,6 +289,7 @@ class TieredStore:
             "npz_imported": self.npz_imported,
             "flush_gen": self.flush_gen,
             "evict_gen": self.evict_gen,
+            "compact_gen": self.compact_gen,
             "ack_floors": {str(k): v for k, v in self.ack_floors.items()},
             "tables": {
                 name: {"next_id": tt.next_id,
@@ -296,6 +326,7 @@ class TieredStore:
             self.npz_imported = bool(doc.get("npz_imported", False))
             self.flush_gen = int(doc.get("flush_gen", 0))
             self.evict_gen = int(doc.get("evict_gen", 0))
+            self.compact_gen = int(doc.get("compact_gen", 0))
             self.ack_floors = {int(k): int(v) for k, v in
                                doc.get("ack_floors", {}).items()}
             dropped = False
@@ -394,7 +425,9 @@ class TieredStore:
                               time_col=payload.get("time_col"),
                               dict_gens=payload.get("dict_state"),
                               compress=compress,
-                              codec_hints=tt.codec_hints(self.flush_gen))
+                              codec_hints=tt.codec_hints(self.flush_gen),
+                              codec_counts=tt.codec_counts,
+                              observe=self._codec_observe)
                 dirty_dirs.add(tt.dir)
                 seg = Segment.open(p)
                 tt._stage(seg)
@@ -420,6 +453,191 @@ class TieredStore:
             self.stats["segments_written"] += nseg
             self.stats["rows_flushed"] += rows
             return rows
+
+    def _codec_observe(self, codec: str, n: int, ns: float) -> None:
+        """Feed every codec choice's measured encode cost into a learned
+        cost model (query/costmodel.py, imported lazily to keep the
+        store importable without the query package)."""
+        m = self._codec_cost
+        if m is None:
+            from deepflow_tpu.query.costmodel import KernelCostModel
+            m = self._codec_cost = KernelCostModel(
+                ("const", "for", "delta", "dictrank", "zlib", "raw"))
+        m.observe(codec, n, ns)
+
+    # -- compaction (segment format v2) --------------------------------------
+
+    def _compact_groups(self, tt: TableTier,
+                        partition_ns: int, min_merge: int) -> list[list]:
+        """Partition the table's sealed segments into time buckets and
+        return the groups worth compacting: >= min_merge segments in one
+        bucket, or any bucket still holding a format-v1 segment
+        (migrate-on-compact — a lone v1 file gets rewritten as a v2 run
+        so ``migrate_v1_remaining`` drains to zero)."""
+        buckets: dict[object, list] = {}
+        for s in tt.segments():
+            if not s.rows:
+                continue
+            key = None if s.tmin is None else int(s.tmin) // partition_ns
+            buckets.setdefault(key, []).append(s)
+        out = []
+        for key, group in sorted(buckets.items(),
+                                 key=lambda kv: (kv[0] is None,
+                                                 kv[0] or 0)):
+            if any(s.fmt < 2 for s in group):
+                out.append(group)
+                continue
+            runs = {s.run for s in group}
+            if None not in runs and len(runs) == 1:
+                # the bucket is already exactly one compacted run
+                # (possibly split into pieces) — recompacting it would
+                # churn bytes forever without changing anything
+                continue
+            if len(group) >= min_merge:
+                out.append(group)
+        return out
+
+    @staticmethod
+    def _build_run(victims: list[Segment], columns, fills,
+                   target_rows: int) -> dict:
+        """Merge a group's rows into ONE time-sorted chunk, split into
+        <= target_rows pieces. Pure read work — runs OUTSIDE the store
+        lock (and on the shared scan pool when one is available)."""
+        time_col = next((s.time_col for s in victims
+                         if s.time_col is not None), None)
+        chunks = [s.chunk(columns, fills) for s in victims]
+        names: dict[str, np.dtype] = {}
+        for ch in chunks:
+            for name in ch:
+                if name not in names:
+                    names[name] = np.asarray(ch[name]).dtype
+        merged: dict[str, np.ndarray] = {}
+        for name, dt in names.items():
+            parts = [np.asarray(ch[name]) if name in ch
+                     else np.zeros(s.rows, dtype=dt)
+                     for s, ch in zip(victims, chunks)]
+            merged[name] = np.concatenate(parts) if parts \
+                else np.empty(0, dtype=dt)
+        rows = len(next(iter(merged.values()))) if merged else 0
+        if time_col is not None and time_col in merged and rows:
+            t = merged[time_col]
+            if not bool(np.all(t[:-1] <= t[1:])):
+                # stable: equal-time rows keep their pre-compaction
+                # relative order, so LAST-by-max-time answers hold
+                order = np.argsort(t, kind="stable")
+                merged = {k: np.ascontiguousarray(v[order])
+                          for k, v in merged.items()}
+        dict_gens: dict[str, tuple] = {}
+        for s in victims:
+            for col, g in s.dict_gens.items():
+                cur = dict_gens.get(col)
+                dict_gens[col] = tuple(g) if cur is None else \
+                    tuple(max(a, b) for a, b in zip(cur, g))
+        pieces = [{k: v[lo:lo + target_rows] for k, v in merged.items()}
+                  for lo in range(0, max(rows, 1), target_rows)]
+        return {"victims": victims, "pieces": pieces, "rows": rows,
+                "time_col": time_col, "dict_gens": dict_gens,
+                "bytes_before": sum(s.nbytes for s in victims),
+                "migrated": sum(1 for s in victims if s.fmt < 2)}
+
+    def compact(self, name: str, dicts: dict | None = None, *,
+                partition_ns: int = _PARTITION_NS, min_merge: int = 2,
+                target_rows: int = _TARGET_ROWS, pool=None) -> dict:
+        """Merge one table's small sealed segments into sorted,
+        time-partitioned format-v2 runs behind the ONE manifest commit
+        point. Crash-safe by the same argument as commit()/evict():
+
+          build     new run files written + fsync'd, NOT in the manifest
+                    (crash here: recovery deletes them as torn tail, the
+                    old segments still serve every row)
+          commit    MANIFEST.json rename lists the runs and drops the
+                    victims (crash after: recovery deletes the victim
+                    FILES as unlisted; every row already lives in a run)
+          unlink    victim files removed
+
+        No row exists in zero or two live manifests at any crash point,
+        which is what the restart-mid-compaction chaos arm proves.
+        ``dicts`` (the table's live dictionaries) enables the dict-order
+        rewrite + zstr/bloom string indexes; merging stays correct
+        without them. Build work runs on ``pool`` (the PR 10 shared scan
+        pool) when given. Returns a counters dict; the CALLER owns the
+        table watermark bump and the hop-ledger entry for replaced rows.
+        """
+        tt = self.tables().get(name)
+        out = {"groups": 0, "runs_built": 0, "segments_replaced": 0,
+               "rows": 0, "bytes_before": 0, "bytes_after": 0,
+               "segments_migrated": 0, "new_segments": []}
+        if tt is None:
+            return out
+        groups = self._compact_groups(tt, partition_ns, min_merge)
+        if not groups:
+            return out
+        crash = os.environ.get("DF_COMPACT_CRASH", "")
+        build = lambda g: self._build_run(g, tt._columns, tt._fills,
+                                          target_rows)
+        if pool is None:
+            try:
+                from deepflow_tpu.query.pool import get_pool
+                pool = get_pool()
+            except ImportError:  # store used without the query package
+                pool = None
+        built = pool.map(build, groups) if pool is not None \
+            else [build(g) for g in groups]
+        for plan in built:
+            victims = plan["victims"]
+            with self._lock:
+                live = {id(s) for s in tt.segments()}
+                if not all(id(v) in live for v in victims):
+                    # a victim was evicted while we were building —
+                    # drop this group, its rows are gone on purpose
+                    continue
+                self.compact_gen += 1
+                run_id = self.compact_gen
+                os.makedirs(tt.dir, exist_ok=True)
+                new_segs = []
+                for piece in plan["pieces"]:
+                    fn = f"seg_{tt.next_id:08d}.seg"
+                    tt.next_id += 1
+                    p = os.path.join(tt.dir, fn)
+                    write_segment(
+                        p, piece, time_col=plan["time_col"],
+                        dict_gens=plan["dict_gens"], fmt=2, level=1,
+                        run=run_id, sorted_by=plan["time_col"],
+                        dicts=dicts,
+                        codec_hints=tt.codec_hints(self.flush_gen),
+                        codec_counts=tt.codec_counts,
+                        observe=self._codec_observe)
+                    new_segs.append(Segment.open(p))
+                _fsync_dir(tt.dir)
+                if crash == "after_stage":
+                    os._exit(43)
+                tt._remove(victims)
+                for s in new_segs:
+                    tt._add(s)
+                self._write_manifest()
+                if crash == "after_commit":
+                    os._exit(43)
+                for v in victims:
+                    try:
+                        os.unlink(v.path)
+                    except OSError:
+                        pass
+                bytes_after = sum(s.nbytes for s in new_segs)
+                out["groups"] += 1
+                out["runs_built"] += 1
+                out["segments_replaced"] += len(victims)
+                out["rows"] += plan["rows"]
+                out["bytes_before"] += plan["bytes_before"]
+                out["bytes_after"] += bytes_after
+                out["segments_migrated"] += plan["migrated"]
+                out["new_segments"].extend(new_segs)
+                self.stats["runs_built"] += 1
+                self.stats["segments_replaced"] += len(victims)
+                self.stats["compact_rows"] += plan["rows"]
+                self.stats["bytes_before"] += plan["bytes_before"]
+                self.stats["bytes_after"] += bytes_after
+                self.stats["segments_migrated"] += plan["migrated"]
+        return out
 
     # -- eviction ------------------------------------------------------------
 
@@ -481,17 +699,35 @@ class TieredStore:
         """Commit ack floors with no segment writes (final drain)."""
         self.commit({}, ack_floors=floors)
 
+    def migrate_v1_remaining(self) -> int:
+        """Format-v1 segments still live — the migrate-on-compact drain
+        gauge (zero once every byte on disk is format v2)."""
+        return sum(1 for tt in self.tables().values()
+                   for s in tt.segments() if s.fmt < 2)
+
     def snapshot(self) -> dict:
         """Ops/health view: per-table tier stats + generations."""
         with self._lock:
             tables = {}
             for name, tt in self._tables.items():
                 tmin, tmax = tt.span()
+                segs = tt.segments()
                 tables[name] = {"segments": tt.segment_count(),
                                 "zoned_segments": tt.zoned_count(),
                                 "rows": tt.rows, "bytes": tt.bytes,
-                                "tmin": tmin, "tmax": tmax}
-            return {"root": self.root, "flush_gen": self.flush_gen,
-                    "evict_gen": self.evict_gen,
-                    "npz_imported": self.npz_imported,
-                    "stats": dict(self.stats), "tables": tables}
+                                "tmin": tmin, "tmax": tmax,
+                                "v1_segments": sum(1 for s in segs
+                                                   if s.fmt < 2),
+                                "runs": len({s.run for s in segs
+                                             if s.run is not None}),
+                                "codec_counts": dict(tt.codec_counts)}
+            out = {"root": self.root, "flush_gen": self.flush_gen,
+                   "evict_gen": self.evict_gen,
+                   "compact_gen": self.compact_gen,
+                   "npz_imported": self.npz_imported,
+                   "stats": dict(self.stats), "tables": tables,
+                   "migrate_v1_remaining": sum(t["v1_segments"]
+                                               for t in tables.values())}
+            if self._codec_cost is not None:
+                out["codec_cost"] = self._codec_cost.snapshot()
+            return out

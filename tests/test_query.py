@@ -198,10 +198,20 @@ def test_percentile_arity_error():
         execute(t, "SELECT Percentile(latency) FROM flow")
 
 
-def test_ordered_string_comparison_rejected():
+def test_ordered_string_comparison():
     t = make_table()
+    # resolved over the dictionary in STRING space (ids carry insertion
+    # order, not collation): 'api' < 'banana' < 'cache' < 'db'
+    r = execute(t, "SELECT svc FROM flow WHERE svc < 'banana'")
+    assert set(r.column("svc")) == {"api"}
+    r = execute(t, "SELECT bytes FROM flow WHERE svc >= 'cache'")
+    assert sorted(r.column("bytes")) == [10, 25, 50]
+    # enum labels compare in string space too
+    r = execute(t, "SELECT bytes FROM flow WHERE proto > 'tcp'")
+    assert sorted(r.column("bytes")) == [10, 400]
+    # ordered comparison between two string COLUMNS stays rejected
     with pytest.raises(QueryError):
-        execute(t, "SELECT svc FROM flow WHERE svc < 'banana'")
+        execute(t, "SELECT svc FROM flow WHERE svc < svc")
     # NOT IN / NOT LIKE still parse through the shared tail
     r = execute(t, "SELECT bytes FROM flow WHERE svc NOT IN ('api')")
     assert sorted(r.column("bytes")) == [10, 25, 50]

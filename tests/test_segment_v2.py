@@ -1,0 +1,395 @@
+"""Segment format v2 (ISSUE 11): cross-version golden read matrix,
+delta/FoR/dictrank codecs, bloom + inline-id skip indexes, native
+filter/gather parity, and crash-restart convergence of the
+migrate-on-compact path."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from deepflow_tpu.query import execute
+from deepflow_tpu.store import Database
+from deepflow_tpu.store.dictionary import Dictionary
+from deepflow_tpu.store.segment import (
+    Segment, _bloom_build, _bloom_maybe, _bloom_params, choose_codec,
+    write_segment)
+
+TABLE = "application_log.log"
+
+
+# -- codecs ------------------------------------------------------------------
+
+def test_delta_codec_roundtrip(tmp_path):
+    """Monotone u64 ns timestamps pack as zigzag deltas and round-trip
+    byte-identically — including a backwards step (late row)."""
+    t = np.cumsum(np.full(4096, 1_000_000, dtype=np.uint64)) \
+        + np.uint64(1_754_000_000_000_000_000)
+    t[100] -= np.uint64(2_000_000)  # non-monotone wrinkle
+    p = str(tmp_path / "seg.seg")
+    footer = write_segment(p, {"time": t}, fmt=2)
+    assert footer["cols"]["time"]["codec"] == "delta"
+    assert footer["cols"]["time"]["nbytes"] < t.nbytes // 2
+    out = Segment.open(p).chunk()["time"]
+    assert out.dtype == np.uint64
+    assert np.array_equal(out, t)
+
+
+def test_for_codec_roundtrip_signed_and_extremes(tmp_path):
+    """Frame-of-reference narrows a tight range at any offset; extreme
+    u64 values and wide ranges fall back to raw/zlib, never corrupt."""
+    rng = np.random.default_rng(3)
+    # offsets span 60k (FoR width 2) but jump wildly row to row
+    # (zigzag deltas need width 4), so frame-of-reference must win
+    near_max = (np.uint64(2**64 - 70_000)
+                + rng.integers(0, 60_000, 4096).astype(np.uint64))
+    neg = rng.integers(-5_000_000, -4_940_000, 4096).astype(np.int64)
+    wide = rng.integers(0, 2**63, 4096, dtype=np.uint64)
+    p = str(tmp_path / "seg.seg")
+    footer = write_segment(
+        p, {"near_max": near_max, "neg": neg, "wide": wide}, fmt=2)
+    assert footer["cols"]["near_max"]["codec"] == "for"
+    assert footer["cols"]["neg"]["codec"] == "for"
+    assert footer["cols"]["wide"]["codec"] in ("raw", "zlib")
+    out = Segment.open(p).chunk()
+    assert np.array_equal(out["near_max"], near_max)
+    assert np.array_equal(out["neg"], neg)
+    assert np.array_equal(out["wide"], wide)
+
+
+def test_choose_codec_is_observable():
+    """Satellite 3: ONE codec decision point, and it reports what it
+    chose — counts + timing flow to the tier snapshot / cost model."""
+    arr = np.cumsum(np.full(2048, 7, dtype=np.uint64))
+    raw = memoryview(np.ascontiguousarray(arr)).cast("B")
+    codec, meta, blob = choose_codec(
+        "t", arr, raw, fmt=2, compress=True,
+        zone=(int(arr.min()), int(arr.max())), codec_hints=None)
+    assert codec == "delta"
+    # the writer threads counts/observe through for every column
+    counts = {}
+    seen = []
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        write_segment(os.path.join(d, "s.seg"),
+                      {"t": arr, "c": np.zeros(2048, dtype=np.uint32)},
+                      fmt=2, codec_counts=counts,
+                      observe=lambda c, n, ns: seen.append((c, n)))
+    assert counts == {"delta": 1, "const": 1}
+    assert sorted(seen) == [("const", 2048), ("delta", 2048)]
+
+
+def test_dictrank_rewrite_and_zstr(tmp_path):
+    """Compaction-grade writes rewrite dictionary columns to collation
+    rank order: ids decode back unchanged, zone maps become real string
+    ranges (zstr), and the zmin/zmax of the stored ids are ranks."""
+    d = Dictionary()
+    words = ["pear", "apple", "zebra", "mango", "kiwi"]
+    ids = np.array([d.encode(w) for w in words] * 40, dtype=np.uint32)
+    p = str(tmp_path / "seg.seg")
+    footer = write_segment(p, {"svc": ids}, fmt=2, level=1,
+                           dict_gens={"svc": (0, 1)}, dicts={"svc": d})
+    ent = footer["cols"]["svc"]
+    assert ent["codec"] == "dictrank"
+    assert ent["zstr"][0] == "apple" and ent["zstr"][1] == "zebra"
+    seg = Segment.open(p)
+    assert seg.str_zone("svc") == ("apple", "zebra")
+    out = seg.chunk()["svc"]
+    assert np.array_equal(out, ids)  # decode restores ORIGINAL ids
+    assert [d.decode(int(s)) for s in out[:5]] == words
+
+
+# -- skip indexes ------------------------------------------------------------
+
+def test_inline_id_index_exact(tmp_path):
+    """<= 64 distinct ids stores the exact sorted id list: membership
+    answers are never wrong in either direction."""
+    ids = np.array([3, 9, 9, 3, 17] * 100, dtype=np.uint32)
+    p = str(tmp_path / "seg.seg")
+    footer = write_segment(p, {"svc": ids}, fmt=2, level=1,
+                           dict_gens={"svc": (0, 1)})
+    assert footer["cols"]["svc"]["ids"] == [3, 9, 17]
+    seg = Segment.open(p)
+    assert seg.has_index("svc")
+    assert seg.maybe_contains("svc", [9])
+    assert seg.maybe_contains("svc", [2, 17])
+    assert not seg.maybe_contains("svc", [2, 4, 1000])
+
+
+def test_bloom_index_sound_and_tight(tmp_path):
+    """Bloom soundness: NEVER a false negative for a present id (that
+    would drop rows from answers); false-positive rate stays well under
+    1% at 12 bits/key, k=6."""
+    present = np.arange(0, 20_000, 2, dtype=np.uint32)  # 10k even ids
+    bits = np.frombuffer(_bloom_build(present), dtype=np.uint8)
+    m = _bloom_params(len(present))
+    assert all(_bloom_maybe(bits, m, int(s)) for s in present[:2000])
+    absent = np.arange(1, 20_001, 2, dtype=np.uint32)[:4000]  # odd ids
+    fp = sum(_bloom_maybe(bits, m, int(s)) for s in absent)
+    assert fp / len(absent) < 0.01
+
+    # and end to end: a high-cardinality column gets the bloom entry
+    ids = np.arange(5000, dtype=np.uint32)
+    p = str(tmp_path / "seg.seg")
+    footer = write_segment(p, {"trace": ids}, fmt=2, level=1,
+                           dict_gens={"trace": (0, 1)})
+    assert "bloom" in footer["cols"]["trace"]
+    seg = Segment.open(p)
+    assert seg.maybe_contains("trace", [4999])
+    assert sum(seg.maybe_contains("trace", [i])
+               for i in range(6000, 7000)) < 10
+
+
+def test_flush_grade_skips_indexes(tmp_path):
+    """level 0 (flusher, beside the ingest hot path) builds no skip
+    indexes; columns report no index and maybe_contains stays True."""
+    ids = np.arange(5000, dtype=np.uint32)
+    p = str(tmp_path / "seg.seg")
+    footer = write_segment(p, {"trace": ids}, fmt=2, level=0,
+                           dict_gens={"trace": (0, 1)})
+    assert "bloom" not in footer["cols"]["trace"]
+    seg = Segment.open(p)
+    assert not seg.has_index("trace")
+    assert seg.maybe_contains("trace", [999_999])
+
+
+def test_lazy_chunk_decodes_on_touch(tmp_path):
+    """A LazyChunk decodes only the columns a scan reads — a pruned or
+    empty-survivor segment costs zero decode for the rest."""
+    p = str(tmp_path / "seg.seg")
+    write_segment(p, {"a": np.arange(1000, dtype=np.uint64),
+                      "b": (np.arange(1000, dtype=np.uint64) * 37) % 11,
+                      "c": np.arange(1000, dtype=np.uint32)}, fmt=2)
+    seg = Segment.open(p)
+    ch = seg.chunk()
+    assert ch.rows == 1000
+    assert not seg._cache  # opening decodes nothing
+    np.testing.assert_array_equal(ch["a"], np.arange(1000))
+    assert set(seg._cache) == {"a"}  # touching a decoded ONLY a
+
+
+# -- cross-version golden read matrix ----------------------------------------
+
+def _chunk(n=500, t0=1_754_000_000_000_000_000):
+    i = np.arange(n, dtype=np.uint64)
+    return {"time": t0 + i * 1_000_000,
+            "svc": (i % 7).astype(np.uint32),
+            "dur": (1000 + i * 37 % 5000).astype(np.uint64)}
+
+
+def test_v1_written_v2_read_byte_identical(tmp_path):
+    """The frozen v1 writer's output reads back byte-identical to the
+    same chunk through the v2 writer — v1 stays readable forever."""
+    ch = _chunk()
+    p1, p2 = str(tmp_path / "v1.seg"), str(tmp_path / "v2.seg")
+    write_segment(p1, ch, time_col="time", fmt=1)
+    write_segment(p2, ch, time_col="time", fmt=2)
+    s1, s2 = Segment.open(p1), Segment.open(p2)
+    assert (s1.fmt, s2.fmt) == (1, 2)
+    assert (s1.tmin, s1.tmax) == (s2.tmin, s2.tmax)
+    c1, c2 = s1.chunk(), s2.chunk()
+    for name in ch:
+        assert np.array_equal(c1[name], ch[name]), name
+        assert np.array_equal(c2[name], ch[name]), name
+
+
+def test_env_pin_yields_to_explicit_fmt(tmp_path, monkeypatch):
+    """DF_SEG_FORMAT only steers fmt=None callers (whole-process pin);
+    an explicit fmt wins — this is what makes migrate-on-compact
+    converge even in a v1-pinned process."""
+    monkeypatch.setenv("DF_SEG_FORMAT", "1")
+    ch = _chunk(50)
+    pd, pe = str(tmp_path / "d.seg"), str(tmp_path / "e.seg")
+    write_segment(pd, ch)            # fmt=None -> env pin -> v1
+    write_segment(pe, ch, fmt=2)     # explicit -> v2 regardless
+    assert Segment.open(pd).fmt == 1
+    assert Segment.open(pe).fmt == 2
+
+
+def _seed_db(data_dir, n_flushes=6, rows=200, v1=False):
+    if v1:
+        os.environ["DF_SEG_FORMAT"] = "1"
+    try:
+        db = Database(data_dir=data_dir, storage=True, chunk_rows=rows)
+        t = db.table(TABLE)
+        for s in range(n_flushes):
+            base = s * rows
+            t.append_rows([
+                {"time": (base + j) * 1_000_000,
+                 "app_service": f"svc-{(base + j) % 5}",
+                 "severity_number": (base + j) % 24 + 1,
+                 "trace_id": f"{(base + j) * 2654435761 % 2**32:08x}",
+                 "body": f"m{(base + j) % 9}"}
+                for j in range(rows)])
+            t.flush()
+            db.flush_to_tier()
+    finally:
+        os.environ.pop("DF_SEG_FORMAT", None)
+    return db
+
+
+_GOLDEN_SQL = [
+    "SELECT app_service, Count(*) AS c, Sum(severity_number) AS s "
+    "FROM log GROUP BY app_service ORDER BY app_service",
+    "SELECT Count(*) AS c FROM log WHERE trace_id = '9908b100'",
+    "SELECT Count(*) AS c FROM log WHERE app_service >= 'svc-3'",
+    "SELECT Max(time) AS t FROM log WHERE severity_number = 7",
+]
+
+
+def _answers(db):
+    t = db.table(TABLE)
+    return [execute(t, s).values for s in _GOLDEN_SQL]
+
+
+def test_mixed_manifest_and_migration_equality(tmp_path):
+    """v1-only, mixed v1+v2, and fully-migrated tiers all answer the
+    golden queries byte-identically; compaction leaves zero v1
+    segments and the manifest survives a reopen."""
+    d = str(tmp_path / "db")
+    db = _seed_db(d, n_flushes=4, v1=True)
+    golden = _answers(db)
+
+    # mixed manifest: append v2 flushes beside the v1 segments
+    t = db.table(TABLE)
+    t.append_rows([
+        {"time": 10**15 + j, "app_service": f"svc-{j % 5}",
+         "severity_number": j % 24 + 1, "trace_id": f"x{j:07d}",
+         "body": "late"} for j in range(100)])
+    t.flush()
+    db.flush_to_tier()
+    fmts = {s.fmt for s in db.tier_store.tier(TABLE).segments()}
+    assert fmts == {1, 2}
+    mixed = _answers(db)
+    assert mixed[0][0][1] > golden[0][0][1]  # new rows visible
+
+    res = db.compact_tier()
+    assert res["runs_built"] >= 1
+    assert db.tier_store.migrate_v1_remaining() == 0
+    assert {s.fmt for s in db.tier_store.tier(TABLE).segments()} == {2}
+    assert _answers(db) == mixed  # byte-identical across the migration
+
+    db2 = Database(data_dir=d, storage=True)
+    assert _answers(db2) == mixed  # and across a restart
+
+
+def test_compacted_runs_are_sorted_and_ranked(tmp_path):
+    """Compaction output: time-sorted runs with delta-coded time,
+    dictrank string columns, and skip indexes the planner can use."""
+    db = _seed_db(str(tmp_path / "db"), n_flushes=5, v1=True)
+    db.compact_tier()
+    segs = db.tier_store.tier(TABLE).segments()
+    assert segs
+    for s in segs:
+        assert s.fmt == 2 and s.run is not None
+        assert s.sorted_by == "time"
+        ch = s.chunk()
+        tcol = np.asarray(ch["time"])
+        assert (tcol[1:] >= tcol[:-1]).all()
+        codecs = s.codecs()
+        assert codecs["time"] in ("delta", "for")
+        assert codecs["app_service"] == "dictrank"
+        assert s.has_index("trace_id")
+        assert s.str_zone("app_service") is not None
+
+
+def _crash_compact(data_dir, mode, pin_v1=False):
+    env = {k: v for k, v in os.environ.items() if k != "DF_SEG_FORMAT"}
+    env["DF_COMPACT_CRASH"] = mode
+    env["JAX_PLATFORMS"] = "cpu"
+    if pin_v1:
+        env["DF_SEG_FORMAT"] = "1"
+    child = ("from deepflow_tpu.store.db import Database\n"
+             f"Database({data_dir!r}, storage=True).compact_tier()\n")
+    return subprocess.run([sys.executable, "-c", child], env=env,
+                          capture_output=True, timeout=120)
+
+
+def test_restart_mid_compaction_converges(tmp_path):
+    """Crash AFTER the new run files are staged but BEFORE the manifest
+    commit: reopen serves the old segments byte-identically (the staged
+    run is garbage-collected) and the next compaction converges."""
+    d = str(tmp_path / "db")
+    golden = _answers(_seed_db(d, v1=True))
+    proc = _crash_compact(d, "after_stage")
+    assert proc.returncode == 43, proc.stderr.decode()[-500:]
+    db = Database(data_dir=d, storage=True)
+    assert db.tier_store.migrate_v1_remaining() > 0  # commit never ran
+    assert _answers(db) == golden
+    db.compact_tier()
+    assert db.tier_store.migrate_v1_remaining() == 0
+    assert _answers(db) == golden
+
+
+def test_restart_mid_migration_converges(tmp_path):
+    """Crash AFTER the manifest commit but BEFORE the replaced v1
+    segments unlink: reopen serves the new runs, deletes the orphaned
+    victims, and answers stay byte-identical — even when the retrying
+    process is pinned to DF_SEG_FORMAT=1."""
+    d = str(tmp_path / "db")
+    golden = _answers(_seed_db(d, v1=True))
+    proc = _crash_compact(d, "after_commit", pin_v1=True)
+    assert proc.returncode == 43, proc.stderr.decode()[-500:]
+    db = Database(data_dir=d, storage=True)
+    assert db.tier_store.migrate_v1_remaining() == 0  # commit landed
+    assert _answers(db) == golden
+    res = db.compact_tier()  # idempotent: nothing left to migrate
+    assert res["segments_replaced"] == 0
+    assert _answers(db) == golden
+
+
+# -- native filter/gather kernels --------------------------------------------
+
+@pytest.fixture
+def nat():
+    from deepflow_tpu import native
+    if not native.available():
+        pytest.skip("native library unavailable")
+    return native
+
+
+def test_native_sel_range_parity(nat):
+    """df_qx_sel_cmp matches the numpy mask for every int width and
+    signedness, including negative bounds and u64 extremes."""
+    rng = np.random.default_rng(11)
+    for dt in (np.uint8, np.int8, np.uint16, np.int16,
+               np.uint32, np.int32, np.uint64, np.int64):
+        info = np.iinfo(dt)
+        col = rng.integers(info.min, info.max, 10_000,
+                           dtype=dt, endpoint=True)
+        for lo, hi in ((info.min, info.max),
+                       (info.min, info.min),
+                       (int(col[5]), int(col[5])),
+                       (info.max // 2, info.max)):
+            idx = nat.qx_sel_range(col, lo, hi)
+            assert idx is not None, dt
+            ref = np.nonzero((col >= dt(lo)) & (col <= dt(hi)))[0]
+            assert np.array_equal(idx, ref.astype(np.uint64)), (dt, lo, hi)
+
+
+def test_native_sel_isin_and_gather_parity(nat):
+    rng = np.random.default_rng(12)
+    col = rng.integers(0, 5000, 50_000).astype(np.uint32)
+    wanted = np.array([3, 999, 4999, 7777], dtype=np.uint32)
+    idx = nat.qx_sel_isin(col, wanted)
+    ref = np.nonzero(np.isin(col, wanted))[0].astype(np.uint64)
+    assert np.array_equal(idx, ref)
+    assert np.array_equal(np.diff(idx.astype(np.int64)) > 0,
+                          np.full(len(idx) - 1, True))  # ascending
+    for dt in (np.uint8, np.uint16, np.uint32, np.uint64, np.int64):
+        src = rng.integers(0, 200, 50_000).astype(dt)
+        out = nat.qx_gather(src, idx)
+        assert np.array_equal(out, src[idx])
+
+
+def test_selective_filter_matches_fallback(tmp_path, monkeypatch):
+    """The index-list filter path (native kernels) and the DF_NO_NATIVE
+    numpy mask path return byte-identical answers over a compacted
+    tier."""
+    db = _seed_db(str(tmp_path / "db"), v1=True)
+    db.compact_tier()
+    fast = _answers(db)
+    monkeypatch.setenv("DF_NO_NATIVE", "1")
+    assert _answers(db) == fast

@@ -31,10 +31,13 @@ def _chunk(n=100, t0=1000):
 
 
 def test_segment_roundtrip(tmp_path):
+    # pinned to the frozen v1 writer: the zero-copy-view guarantee below
+    # is a v1 raw-block property (v2 codecs decode into fresh arrays and
+    # are covered by tests/test_segment_v2.py)
     p = str(tmp_path / "seg_00000001.seg")
     ch = _chunk()
     write_segment(p, ch, time_col="time",
-                  dict_gens={"tag": (0, 17)})
+                  dict_gens={"tag": (0, 17)}, fmt=1)
     seg = Segment.open(p)
     assert seg.rows == 100
     assert (seg.tmin, seg.tmax) == (1000, 1099)
@@ -47,15 +50,17 @@ def test_segment_roundtrip(tmp_path):
 
 
 def test_segment_codecs(tmp_path):
-    """Per-column codec choice: const for single-valued columns (one
-    element on disk), zlib only when it pays, raw otherwise — and
-    compress=False keeps const but never deflates."""
+    """Per-column codec choice in the frozen v1 writer: const for
+    single-valued columns (one element on disk), zlib only when it pays,
+    raw otherwise — and compress=False keeps const but never deflates.
+    The v2 codec set (delta/for/dictrank) is covered by
+    tests/test_segment_v2.py."""
     rng = np.random.default_rng(7)
     ch = {"const64": np.full(4096, 0xDEAD, dtype=np.uint64),
           "repeat": np.arange(4096, dtype=np.uint64) % 4,   # compressible
           "noise": rng.integers(0, 2**63, 4096, dtype=np.uint64)}
     p = str(tmp_path / "seg.seg")
-    footer = write_segment(p, ch)
+    footer = write_segment(p, ch, fmt=1)
     codecs = {k: v["codec"] for k, v in footer["cols"].items()}
     assert codecs == {"const64": "const", "repeat": "zlib",
                       "noise": "raw"}
@@ -69,7 +74,7 @@ def test_segment_codecs(tmp_path):
     assert not out["const64"].flags.writeable
 
     p2 = str(tmp_path / "seg2.seg")
-    footer2 = write_segment(p2, ch, compress=False)
+    footer2 = write_segment(p2, ch, compress=False, fmt=1)
     codecs2 = {k: v["codec"] for k, v in footer2["cols"].items()}
     assert codecs2 == {"const64": "const", "repeat": "raw",
                        "noise": "raw"}
