@@ -2,7 +2,8 @@ PYTHON ?= python
 JAX_ENV := env JAX_PLATFORMS=cpu
 
 .PHONY: test selfmon-check cluster-check steps-check chaos-check ha-check \
-	query-check ingest-check storage-check compaction-check bench native
+	query-check ingest-check storage-check compaction-check readtier-check \
+	bench native
 
 test:
 	timeout -k 10 870 $(JAX_ENV) $(PYTHON) -m pytest tests/ -q -m 'not slow' \
@@ -19,6 +20,15 @@ selfmon-check:
 # cluster.* fan-out hop's frame ledger fails to balance.
 cluster-check:
 	timeout -k 10 120 $(JAX_ENV) $(PYTHON) -m deepflow_tpu.cli.cluster_check
+
+# Disaggregated read tier: 1 ingest shard + 4 stateless querier
+# subprocesses over a shared object store; exits non-zero if any
+# replica's answer differs from the ingest node's, the distributed
+# partial-aggregate cache rescans a warm bucket or its ledgers don't
+# conserve, read throughput fails to scale (multi-core hosts), or the
+# ingest write p99 moves under the query storm.
+readtier-check:
+	timeout -k 10 300 $(JAX_ENV) $(PYTHON) -m deepflow_tpu.cli.readtier_check
 
 # Kill-and-recover run of the durable transport under seeded fault
 # injection (conn resets + partial writes + a mid-stream server

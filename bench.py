@@ -990,6 +990,74 @@ def _build_fp_omitted_target() -> str | None:
     return exe
 
 
+def _bench_read_scaling() -> dict:
+    """read_scaling arm: query throughput of the disaggregated read
+    tier at 1/2/4 stateless querier replicas (real subprocesses over a
+    shared object store), plus the ingest append p99 while the
+    4-replica storm runs. The >= 3x linear-scaling target only means
+    anything when the host can actually run the fleet in parallel, so
+    `read_scaling_below_target` is gated on cpu count — on smaller
+    hosts the arm still reports the measured curve and holds a
+    no-collapse floor (4 replicas >= half of one)."""
+    import shutil
+    import tempfile
+
+    from deepflow_tpu.cli.readtier_check import (
+        _IngestWriter, _p99, STORM_SQLS, seed_ingest, spawn_querier,
+        storm, wait_adopted)
+
+    root = tempfile.mkdtemp(prefix="dfbench-readtier-")
+    procs, ports = [], []
+    srv = None
+    try:
+        srv = seed_ingest(root, n_sealed=3000, n_live=200)
+        seed_addr = f"127.0.0.1:{srv.query_port}"
+        for i in range(4):
+            proc, port = spawn_querier(root, i, seed_addr)
+            procs.append(proc)
+            ports.append(port)
+        wait_adopted(ports, 3000)
+        storm(ports, STORM_SQLS, duration_s=0.5)    # warm every cache
+        writer = _IngestWriter(srv)
+        p99_base = _p99(writer.run_for(1.5))
+        qps = {}
+        for n in (1, 2, 4):
+            writer.start()
+            qps[n] = storm(ports[:n], STORM_SQLS, duration_s=2.0)
+            samples = writer.stop()
+            if n == 4:
+                p99_storm = _p99(samples)
+        speedup = qps[4] / max(qps[1], 1e-9)
+        ncores = os.cpu_count() or 1
+        out = {
+            "read_scaling_qps_1": round(qps[1], 1),
+            "read_scaling_qps_2": round(qps[2], 1),
+            "read_scaling_qps_4": round(qps[4], 1),
+            "read_scaling_speedup_4": round(speedup, 3),
+            "read_scaling_ingest_p99_ms_quiet": round(p99_base, 3),
+            "read_scaling_ingest_p99_ms_storm": round(p99_storm, 3),
+            "read_scaling_below_target": (
+                (speedup < 3.0 and ncores >= 4)
+                or qps[4] < 0.5 * qps[1]),
+        }
+        print(f"bench: read_scaling 1r={qps[1]:.0f} 2r={qps[2]:.0f} "
+              f"4r={qps[4]:.0f} q/s (speedup {speedup:.2f}x, "
+              f"{ncores} cores) ingest p99 {p99_base:.2f}ms -> "
+              f"{p99_storm:.2f}ms")
+        return out
+    finally:
+        for proc in procs:
+            proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except Exception:
+                proc.kill()
+        if srv is not None:
+            srv.stop()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def _bench_extprofiler() -> dict:
     """Out-of-process profiler: observer-side CPU cost while sampling a
     busy non-cooperating FP-OMITTED process at 99 Hz (targets: <10% of a
@@ -1244,6 +1312,7 @@ def main() -> None:
     cpu_detail.update(_bench_query_parallel())
     cpu_detail.update(_bench_storage())
     cpu_detail.update(_bench_scan_selective())
+    cpu_detail.update(_bench_read_scaling())
     cpu_detail.update(_bench_extprofiler())
     # perf guards (VERDICT r03 item 5 / r04 item 8): a regression must be
     # visible in-round, not discovered by the next judge

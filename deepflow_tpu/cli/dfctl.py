@@ -147,6 +147,15 @@ def main(argv: list[str] | None = None) -> int:
     p_seg.add_argument("--json", action="store_true",
                        help="raw /v1/segments JSON")
 
+    p_rt = sub.add_parser(
+        "readtier", help="stateless querier view: adopted publish gens "
+                         "per ingest shard, per-table adopted "
+                         "segments/rows, segment-cache hit/evict "
+                         "ledger and distributed partial-cache "
+                         "counters")
+    p_rt.add_argument("--json", action="store_true",
+                      help="raw readtier + partial_cache health JSON")
+
     p_org = sub.add_parser("org", help="org/team scoping: assign agent "
                                        "groups to orgs, list assignments")
     p_org.add_argument("--assign", nargs=2, metavar=("GROUP", "ORG_ID"),
@@ -614,6 +623,51 @@ def main(argv: list[str] | None = None) -> int:
         print_table(["TABLE", "SEGMENT", "FMT", "ROWS", "BYTES", "RUN",
                      "SORTED_BY", "ZONES", "INDEXED", "CODECS"], rows)
         print(f"\ncompact_gen: {out.get('compact_gen', 0)}")
+    elif args.cmd == "readtier":
+        h = _api(args.server, "/v1/health")
+        rt = h.get("readtier")
+        if rt is None:
+            print("(no read tier — this server is not a "
+                  "--role=querier replica)")
+            return 0
+        if args.json:
+            print(json.dumps({"readtier": rt,
+                              "partial_cache": h.get("partial_cache"),
+                              "query_cache": h.get("query_cache")},
+                             indent=2))
+            return 0
+        adopted = rt.get("adopted", {})
+        print("adopted manifests (ingest shard -> publish gen): "
+              + (", ".join(f"{s}->{g}" for s, g
+                           in sorted(adopted.items())) or "(none)"))
+        print_table(
+            ["TABLE", "SEGMENTS", "ROWS", "BYTES", "PUB_TOKEN"],
+            [[name, t["segments"], t["rows"], t["bytes"],
+              (t.get("pub_token") or "-")[:12]]
+             for name, t in sorted(rt.get("tables", {}).items())])
+        sc = rt.get("segcache", {})
+        print(f"\nsegment cache ({sc.get('segments', 0)} segments, "
+              f"{sc.get('bytes', 0)}/{sc.get('max_bytes', 0)} bytes):")
+        print_table(
+            ["HITS", "MISSES", "FETCH_ERRS", "EVICTIONS",
+             "ROWS_EVICTED", "DEFERRED_UNLINKS"],
+            [[sc.get("hits", 0), sc.get("misses", 0),
+              sc.get("fetch_errors", 0), sc.get("evictions", 0),
+              sc.get("rows_evicted", 0),
+              sc.get("deferred_unlinks", 0)]])
+        pc = h.get("partial_cache") or {}
+        qc = h.get("query_cache") or {}
+        if pc:
+            print("\ndistributed partial cache:")
+            print_table(
+                ["DIST_HITS", "FETCHES", "FETCHED_BKTS", "SERVED_BKTS",
+                 "FETCH_ERRS", "REMAP_FAILS", "ADVERTISED"],
+                [[qc.get("dist_hits", 0), pc.get("fetches", 0),
+                  pc.get("fetched_buckets", 0),
+                  pc.get("served_buckets", 0),
+                  pc.get("fetch_errors", 0),
+                  pc.get("remap_failures", 0),
+                  pc.get("advertised", 0)]])
     elif args.cmd == "flame":
         body = {"event_type": args.event_type}
         if args.service:

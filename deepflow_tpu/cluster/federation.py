@@ -165,11 +165,22 @@ class FederationCoordinator:
         self.sql_cache_counters = {"warm_hits": 0, "shard_unchanged": 0,
                                    "shard_refetched": 0,
                                    "remap_failures": 0}
+        # read-tier wiring (server/server.py, querier role): the
+        # adopted-segment tier this coordinator serves sealed history
+        # from, and the QueryCache the local partial routes through so
+        # bucket slices (and the distributed partial cache) warm up.
+        self.readtier = None
+        self.query_cache = None
 
     # -- plumbing -----------------------------------------------------------
 
     def remote_peers(self) -> list[Peer]:
-        return self.membership.peers(include_self=False, ttl_s=self.ttl_s)
+        # scatter targets are INGEST shards only: a querier replica
+        # holds no rows of its own (its table is the same published
+        # history this coordinator adopted) — scattering to one would
+        # double-count every sealed row
+        return self.membership.peers(include_self=False, ttl_s=self.ttl_s,
+                                     role="ingest")
 
     def active(self) -> bool:
         """Any alive remote peer right now? (Single node: every query
@@ -239,6 +250,18 @@ class FederationCoordinator:
 
     def sql_query(self, table, select: qsql.Select, sql_text: str,
                   org_id=None):
+        """Entry point; with a read tier attached, adoption is frozen
+        across the scatter AND the local partial so a manifest pointer
+        swap mid-query cannot move segments between the shard's answer
+        and the local read-tier scan (both sides see one consistent
+        snapshot)."""
+        if self.readtier is None:
+            return self._sql_query(table, select, sql_text, org_id)
+        with self.readtier.freeze():
+            return self._sql_query(table, select, sql_text, org_id)
+
+    def _sql_query(self, table, select: qsql.Select, sql_text: str,
+                   org_id=None):
         """table/select: the coordinator's locally-resolved table and
         (org-scoped) AST. The exact resolved table NAME, the original
         sql_text and org_id travel to the shards, which re-scope
@@ -264,6 +287,17 @@ class FederationCoordinator:
                 "dict_known": {
                     str(p.shard_id): self.dict_sync.known_state(
                         p.shard_id, table.name) for p in peers}}
+        rt = self.readtier
+        if rt is not None:
+            # publish-gen handshake: tell each shard which of its
+            # pointer generations we adopted. A shard whose current gen
+            # matches answers WITHOUT its published segments (we serve
+            # them from the read tier); any other shard answers in full
+            # and we drop its adopted segments from the local scan.
+            adopted = {str(p.shard_id): rt.gen_for(p.shard_id)
+                       for p in peers if rt.gen_for(p.shard_id)}
+            if adopted:
+                body["readtier"] = adopted
         if org_id is not None:
             body["org_id"] = org_id
         if ent is not None:
@@ -281,9 +315,6 @@ class FederationCoordinator:
         ring_ctx = None if ring is None else [
             ring.epoch, ring.token,
             sorted(getattr(db, "_alive", []) or [])]
-        # change_token, not sync_state: the remap below grows local
-        # dictionaries, which must not read as "table changed"
-        local_token = [cache.change_token(table), ring_ctx]
 
         parts_raw: dict[int, object] = {}
         states: dict[int, object] = {}
@@ -311,6 +342,31 @@ class FederationCoordinator:
                            if isinstance(r, dict) else None)
             parts_raw[sid] = r
 
+        rt_excluded: set[int] = set()
+        if rt is not None:
+            def _acked(sid: int) -> bool:
+                for src in (parts_raw.get(sid), results.get(sid)):
+                    if isinstance(src, dict) and \
+                            (src.get("rt") or {}).get("excluded"):
+                        return True
+                return False
+            # an ANSWERING shard that did not apply the exclusion (gen
+            # raced ahead, pre-readtier build, decoded fallback) covered
+            # its published rows itself — drop its adopted segments from
+            # the local scan or they would count twice. Dead shards stay
+            # IN: the read tier is what keeps their history queryable.
+            rt_excluded = {sid for sid in parts_raw
+                           if rt.gen_for(sid) and not _acked(sid)}
+            if rt_excluded:
+                from deepflow_tpu.store.segcache import ShardExcludeView
+                local = ShardExcludeView(local, frozenset(rt_excluded))
+        # change_token, not sync_state: the remap below grows local
+        # dictionaries, which must not read as "table changed". The
+        # read-tier exclusion set joins the token: the same table state
+        # answers for different rows under a different exclusion.
+        local_token = [cache.change_token(table), ring_ctx] + \
+            ([sorted(rt_excluded)] if rt is not None else [])
+
         if (ent is not None and not failed_sync
                 and ent["local"] == local_token
                 and set(parts_raw) == set(ent["parts"]) == unchanged
@@ -325,6 +381,16 @@ class FederationCoordinator:
         if ent is not None and ent["local"] == local_token \
                 and ent.get("local_part") is not None:
             local_part = ent["local_part"]
+        elif rt is not None and self.query_cache is not None \
+                and ring is None:
+            # querier coordinator: the local read-tier partial goes
+            # through the bucket cache so repeats recompute only stale
+            # buckets — and cold buckets can come from a warm replica
+            # via the distributed partial cache (QueryCache.dist)
+            extra = (("rt", org_id) if not rt_excluded
+                     else ("rt", org_id, tuple(sorted(rt_excluded))))
+            local_part = self.query_cache.partial(
+                local, sql_text, select=select, extra_key=extra)
         else:
             local_part = engine.execute_partial(local, select,
                                                 encoded=True)

@@ -31,18 +31,26 @@ class Peer:
     epoch: int                # process start time (ns) — restarts bump it
     last_seen_ns: int = 0
     ingest_addr: str = ""     # "host:ingest_port" for agent frame traffic
+    # "ingest" owns a slice of the agent fleet (hash ring + scatter
+    # target); "querier" is a stateless read replica — it answers
+    # coordinator queries but must NEVER be placed in the ingest ring
+    # or scattered to for shard partials (satellite fix: every joiner
+    # used to be assumed to own ingest). Peers from pre-role nodes
+    # deserialize as ingest, preserving old behavior.
+    role: str = "ingest"
 
     def to_dict(self) -> dict:
         return {"shard_id": self.shard_id, "addr": self.addr,
                 "epoch": self.epoch, "last_seen_ns": self.last_seen_ns,
-                "ingest_addr": self.ingest_addr}
+                "ingest_addr": self.ingest_addr, "role": self.role}
 
     @classmethod
     def from_dict(cls, d: dict) -> "Peer":
         return cls(shard_id=int(d["shard_id"]), addr=str(d["addr"]),
                    epoch=int(d.get("epoch", 0)),
                    last_seen_ns=int(d.get("last_seen_ns", 0)),
-                   ingest_addr=str(d.get("ingest_addr", "")))
+                   ingest_addr=str(d.get("ingest_addr", "")),
+                   role=str(d.get("role") or "ingest"))
 
 
 @dataclass
@@ -60,7 +68,8 @@ class PeerDirectory:
             cur = self._peers.get(peer.shard_id)
             changed = (cur is None or cur.addr != peer.addr
                        or cur.epoch != peer.epoch
-                       or cur.ingest_addr != peer.ingest_addr)
+                       or cur.ingest_addr != peer.ingest_addr
+                       or cur.role != peer.role)
             if changed:
                 self.version += 1
             peer.last_seen_ns = peer.last_seen_ns or time.time_ns()
@@ -107,11 +116,12 @@ class ClusterMembership:
     def __init__(self, shard_id: int, advertise: str,
                  seed: str | None = None,
                  heartbeat_s: float = DEFAULT_HEARTBEAT_S,
-                 telemetry=None) -> None:
+                 telemetry=None, role: str = "ingest") -> None:
         self.shard_id = shard_id
         self.advertise = advertise
         self.seed = (seed or "").strip() or None
         self.epoch = time.time_ns()
+        self.role = role
         self.directory = PeerDirectory()
         self.heartbeat_s = heartbeat_s
         self.telemetry = telemetry
@@ -119,6 +129,14 @@ class ClusterMembership:
         self.ingest_addr = ""      # set by the server once receiver binds
         self.ring = None           # adopted/authored HashRing (replication)
         self._ring_lock = threading.Lock()
+        # distributed partial-aggregate cache gossip: local warm-key
+        # digests ride the join exchange in both directions; the seed
+        # merges every joiner's adverts and the merged map rides every
+        # join response, so any node can ask "who has (table, sql, org)
+        # warm?" after one heartbeat round-trip.
+        self.cache_adv_local = None      # zero-arg -> list[str] digests
+        self._cache_advs: dict[str, tuple[int, str]] = {}
+        self._adv_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -129,7 +147,48 @@ class ClusterMembership:
     def self_peer(self) -> Peer:
         return Peer(shard_id=self.shard_id, addr=self.advertise,
                     epoch=self.epoch, last_seen_ns=time.time_ns(),
-                    ingest_addr=self.ingest_addr)
+                    ingest_addr=self.ingest_addr, role=self.role)
+
+    # -- distributed partial-cache adverts ----------------------------
+    def _local_advs(self) -> dict:
+        fn = self.cache_adv_local
+        if fn is None:
+            return {}
+        try:
+            return {str(d): [self.shard_id, self.advertise]
+                    for d in (fn() or [])}
+        except Exception:
+            return {}
+
+    def _merge_advs(self, advs: dict | None) -> None:
+        if not advs:
+            return
+        with self._adv_lock:
+            for digest, ent in advs.items():
+                try:
+                    sid, addr = int(ent[0]), str(ent[1])
+                except (TypeError, ValueError, IndexError):
+                    continue
+                if sid != self.shard_id:
+                    self._cache_advs[str(digest)] = (sid, addr)
+
+    def cache_adverts(self) -> dict:
+        """Everything known warm, local keys included (seed view)."""
+        out = {d: [s, a] for d, (s, a) in self._cache_advs.items()}
+        out.update(self._local_advs())
+        return out
+
+    def advert_for(self, digest: str,
+                   ttl_s: float = DEFAULT_TTL_S) -> tuple | None:
+        """(shard_id, addr) of an ALIVE peer advertising this cache key
+        digest, or None. Own adverts are excluded — a local miss is a
+        local miss."""
+        with self._adv_lock:
+            ent = self._cache_advs.get(digest)
+        if ent is None:
+            return None
+        alive = {p.shard_id for p in self.directory.alive(ttl_s=ttl_s)}
+        return ent if ent[0] in alive else None
 
     # -- replication ring ---------------------------------------------
     def adopt_ring(self, snap: dict | None) -> bool:
@@ -173,11 +232,15 @@ class ClusterMembership:
             log.info("cluster: shard %d at %s joined (epoch %d)",
                      peer.shard_id, peer.addr, peer.epoch)
         self.adopt_ring(body.get("ring"))
+        self._merge_advs(body.get("cache_adv"))
         self.directory.upsert(self.self_peer())
         out = self.directory.snapshot()
         ring = self.ring_snapshot()
         if ring is not None:
             out["ring"] = ring
+        advs = self.cache_adverts()
+        if advs:
+            out["cache_adv"] = advs
         return out
 
     # -- joiner side --------------------------------------------------
@@ -186,6 +249,9 @@ class ClusterMembership:
         ring = self.ring_snapshot()
         if ring is not None:
             body["ring"] = ring
+        advs = self._local_advs()
+        if advs:
+            body["cache_adv"] = advs
         req = urllib.request.Request(
             f"http://{self.seed}/v1/cluster/join",
             data=json.dumps(body).encode(),
@@ -194,6 +260,7 @@ class ClusterMembership:
             snap = json.loads(resp.read())
         self.directory.adopt(snap)
         self.adopt_ring(snap.get("ring"))
+        self._merge_advs(snap.get("cache_adv"))
         self.stats["joins"] += 1
 
     def _loop(self) -> None:
@@ -229,9 +296,12 @@ class ClusterMembership:
         self.directory.upsert(self.self_peer())
 
     def peers(self, include_self: bool = True,
-              ttl_s: float = DEFAULT_TTL_S) -> list[Peer]:
+              ttl_s: float = DEFAULT_TTL_S,
+              role: str | None = None) -> list[Peer]:
         self.refresh_self()
         alive = self.directory.alive(ttl_s=ttl_s)
+        if role is not None:
+            alive = [p for p in alive if p.role == role]
         if include_self:
             return alive
         return [p for p in alive if p.shard_id != self.shard_id]

@@ -182,3 +182,29 @@ def decode_result(frame: bytes):
     if meta.get("kind") == "jsonb":
         return _decode_jsonb(meta, view[4 + mlen:]), header.agent_id
     return meta.get("obj"), header.agent_id
+
+
+def encode_cache_partial(obj, shard_id: int = 0) -> bytes:
+    """Serialize one distributed partial-cache exchange (request ack or
+    warm bucket response) into a CACHE_PARTIAL frame. Always the jsonb
+    form — encoded per-bucket partials are exactly the ndarray-bearing
+    payloads jsonb exists for, and the kind doubles as the type check
+    (a stray SHARD_RESULT on this path must fail loudly)."""
+    return encode_frame(
+        FrameHeader(MessageType.CACHE_PARTIAL, agent_id=shard_id & 0xFFFF),
+        _encode_jsonb(obj))
+
+
+def decode_cache_partial(frame: bytes):
+    """Inverse of encode_cache_partial -> (obj, shard_id)."""
+    header, payload, consumed = decode_frame(frame)
+    if consumed == 0:
+        raise WireError("short cache-partial frame")
+    if header.msg_type != MessageType.CACHE_PARTIAL:
+        raise WireError(f"unexpected frame type {header.msg_type}")
+    view = memoryview(payload)
+    (mlen,) = _LEN.unpack(view[:4])
+    meta = json.loads(bytes(view[4:4 + mlen]))
+    if meta.get("kind") != "jsonb":
+        raise WireError(f"unexpected cache-partial kind {meta.get('kind')!r}")
+    return _decode_jsonb(meta, view[4 + mlen:]), header.agent_id

@@ -62,6 +62,9 @@ class MessageType(IntEnum):
     #                      send — the server fast-forwards its watermark
     #                      past permanently-dead gaps (agent restart,
     #                      spool eviction) instead of stalling on them
+    CACHE_PARTIAL = 16   # peer<->peer: distributed partial-aggregate
+    #                      cache exchange (warm per-bucket encoded
+    #                      partials keyed by change token)
 
 
 # -- delivery priority classes ----------------------------------------------

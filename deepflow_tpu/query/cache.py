@@ -84,8 +84,15 @@ class QueryCache:
         self._buckets: OrderedDict[tuple, OrderedDict] = OrderedDict()
         self.counters = {"hits": 0, "misses": 0, "stale": 0, "bypass": 0,
                          "evictions": 0, "bucket_hits": 0,
-                         "bucket_misses": 0, "bucket_pruned": 0}
+                         "bucket_misses": 0, "bucket_pruned": 0,
+                         "dist_hits": 0}
         self._hop = telemetry.hop("query.cache") if telemetry else None
+        # distributed partial-cache hook (cluster/partialcache.py):
+        # dist(table, key, [bucket, ...], gens) -> {bucket: partial} of
+        # slices a warm peer already computed, remapped into LOCAL
+        # dictionary ids — they slot into the bucket store exactly like
+        # a local scan's output. None = single-node, zero overhead.
+        self.dist = None
         # learned cold-cost per cached query shape (admission hook)
         self.cost = KernelCostModel(kernels=("cold", "warm"))
 
@@ -222,6 +229,27 @@ class QueryCache:
                 slot[b] = ent[2]
             else:
                 stale.append((b, mark))
+        if stale and self.dist is not None:
+            # ask a warm peer before scanning: each (mark, gens) was
+            # captured BEFORE the fetch, so a write racing the network
+            # round-trip can only make the stored entry stale (same
+            # safety argument as the local fill path)
+            try:
+                got = self.dist(table, key, [b for b, _m in stale], gens)
+            except Exception:
+                got = {}
+            if got:
+                still = []
+                for b, mark in stale:
+                    part = got.get(b)
+                    if part is not None and part.get("kind") == "agg":
+                        with self._lock:
+                            self.counters["dist_hits"] += 1
+                            store[b] = (mark, gens, part)
+                        slot[b] = part
+                    else:
+                        still.append((b, mark))
+                stale = still
         if stale:
             def _scan(bm):
                 b, _mark = bm
@@ -285,6 +313,43 @@ class QueryCache:
     def _drop_buckets(self, key: tuple) -> None:
         with self._lock:
             self._buckets.pop(key, None)
+
+    # -- distributed partial-cache surface ------------------------------------
+
+    def warm_keys(self) -> list[tuple]:
+        """Bucket-store keys holding at least one slice — the advert
+        source for the cluster-wide partial cache (membership gossips
+        digests of the shareable ones)."""
+        with self._lock:
+            return [k for k, v in self._buckets.items() if v]
+
+    def peek_buckets(self, table, sql: str, extra_keys: list,
+                     wanted: list) -> dict:
+        """CURRENTLY-valid cached slices for the wanted buckets, under
+        any of the candidate cache-key variants (the org-equivalent
+        extra_key forms). Validation is against this node's own marks
+        and dictionary gens — the caller (cluster/partialcache.py)
+        established content equivalence with the requester separately,
+        via the read-tier publish token."""
+        wm, marks, wide, div = table.bucket_marks()
+        if div <= 0 or wide:
+            return {}
+        gens = tuple((n, g) for n, g, _l in table.sync_state()[1])
+        norm = normalize_sql(sql)
+        out: dict[int, dict] = {}
+        with self._lock:
+            for ek in extra_keys:
+                store = self._buckets.get((table.name, norm, ek))
+                if not store:
+                    continue
+                for b in wanted:
+                    if b in out or b not in marks:
+                        continue
+                    ent = store.get(b)
+                    if ent is not None and ent[0] == marks[b] \
+                            and ent[1] == gens:
+                        out[b] = ent[2]
+        return out
 
     # -- introspection -------------------------------------------------------
 

@@ -78,6 +78,13 @@ class QuerierAPI:
         # local /v1/query path and the shard half of federated scatters
         from deepflow_tpu.query.cache import QueryCache
         self.query_cache = QueryCache(telemetry=telemetry)
+        # read-tier wiring (set by server/server.py when storage is
+        # disaggregated): the shard-side SegmentPublisher (for the
+        # publish-gen exclusion handshake), the querier-side ReadTier,
+        # and the cluster-wide partial-aggregate cache
+        self.publisher = None
+        self.readtier = None
+        self.partial_cache = None
         # zone-map pruning accounting flows into the same hop ledger the
         # rest of the pipeline reports through (query.scan hop)
         from deepflow_tpu.query import engine as _qengine
@@ -1203,12 +1210,42 @@ class QuerierAPI:
         ring_ctx = None if not ring else [
             ring.get("epoch"), ring.get("token"),
             sorted(int(s) for s in body.get("alive") or [])]
+        # publish-gen handshake: a read-tier coordinator names the
+        # pointer generation it adopted from us. On a gen match, answer
+        # WITHOUT the published segments — the coordinator serves those
+        # from the object store — so each sealed row is counted exactly
+        # once. On a mismatch (it adopted an older pointer, or none)
+        # answer in full; the coordinator drops our adopted segments
+        # from its own scan instead.
+        rt_req = (body.get("readtier") or {}).get(str(self.shard_id))
+        rt_ack = None
+        if rt_req is not None and self.publisher is not None:
+            gen, fn_sets = self.publisher.current
+            if int(rt_req) == gen:
+                fns = fn_sets.get(table.name)
+                if fns:
+                    from deepflow_tpu.store.segcache import \
+                        PublishedExcludeView
+                    table = PublishedExcludeView(table, fns)
+                rt_ack = gen
         from deepflow_tpu.query.cache import change_token
-        tok = [change_token(table), ring_ctx]  # read BEFORE computing
+        # read BEFORE computing; the exclusion context joins the token —
+        # the same table state answers for different rows at a
+        # different publish gen
+        tok = [change_token(table), ring_ctx] + \
+            ([["pub", rt_ack]] if rt_ack is not None else [])
+        rt_reply = (None if rt_req is None else
+                    {"gen": (self.publisher.current[0]
+                             if self.publisher is not None else 0),
+                     "excluded": rt_ack is not None})
         if_state = (body.get("if_state") or {}).get(str(self.shard_id))
         if if_state is not None and if_state == tok:
-            return {"kind": "unchanged", "state": tok}
-        extra = ("fed", org, repr(ring_ctx))
+            out = {"kind": "unchanged", "state": tok}
+            if rt_reply is not None:
+                out["rt"] = rt_reply
+            return out
+        extra = ("fed", org, repr(ring_ctx)) + \
+            ((("pub", rt_ack),) if rt_ack is not None else ())
         part = dict(self.query_cache.partial(
             table, body.get("sql", ""), select=select, extra_key=extra))
         dicts = part.get("dicts")
@@ -1221,10 +1258,27 @@ class QuerierAPI:
                 # a dictionary gen flipped between the partial build and
                 # now — ids in the partial are unremappable; re-run in
                 # the decoded wire form instead of shipping garbage
-                return qengine.execute_partial(table, select)
+                # (against the SAME exclusion view, and still carrying
+                # the rt ack so the coordinator's accounting holds)
+                part = dict(qengine.execute_partial(table, select))
+                if rt_reply is not None:
+                    part["rt"] = rt_reply
+                return part
             part["dict_sync"] = sync
         part["state"] = tok
+        if rt_reply is not None:
+            part["rt"] = rt_reply
         return part
+
+    def cache_partial(self, body: dict, token: str | None = None) -> dict:
+        """POST /v1/cache/partial — the serve side of the cluster-wide
+        partial-aggregate cache (cluster/partialcache.py): hand a peer
+        replica whatever warm, currently-valid bucket slices we hold
+        for its (table, sql, org, pub_token) claim."""
+        self._require_token(token, "/v1/cache/partial")
+        if self.partial_cache is None:
+            return {"buckets": {}}
+        return self.partial_cache.serve(body)
 
     def cluster_join(self, body: dict) -> dict:
         if self.membership is None:
@@ -1320,6 +1374,15 @@ class QuerierAPI:
             out["dict_sync"] = self.federation.dict_sync.snapshot()
             out["federation_cache"] = dict(
                 self.federation.sql_cache_counters)
+        if self.readtier is not None:
+            # adopted publish state + the segment cache's fetch/hit/
+            # miss/evict ledger (the readtier-check conservation input)
+            out["readtier"] = self.readtier.snapshot()
+        if self.partial_cache is not None:
+            out["partial_cache"] = self.partial_cache.snapshot()
+        if self.publisher is not None:
+            out["publish"] = dict(self.publisher.stats)
+            out["publish"]["publish_gen"] = self.publisher.publish_gen
         wedged_stages: list[str] = []
         if self.telemetry is not None:
             selfmon = self.telemetry.snapshot()
@@ -1495,6 +1558,22 @@ class QuerierHTTP:
                         from deepflow_tpu.cluster import wire
                         obj = api.shard_exec(body, token=self._token(body))
                         payload = wire.encode_result(
+                            obj, shard_id=api.shard_id)
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "application/octet-stream")
+                        self.send_header("Content-Length",
+                                         str(len(payload)))
+                        self.end_headers()
+                        self.wfile.write(payload)
+                        return
+                    if path == "/v1/cache/partial":
+                        # binary CACHE_PARTIAL frame: bucket slices
+                        # carry ndarray id columns, jsonb keeps them raw
+                        from deepflow_tpu.cluster import wire
+                        obj = api.cache_partial(body,
+                                                token=self._token(body))
+                        payload = wire.encode_cache_partial(
                             obj, shard_id=api.shard_id)
                         self.send_response(200)
                         self.send_header("Content-Type",

@@ -44,7 +44,37 @@ class Server:
                  storage: bool = False,
                  flush_interval_s: float = 1.0,
                  compact_interval_s: float = 60.0,
-                 storage_max_bytes: int = 0) -> None:
+                 storage_max_bytes: int = 0,
+                 role: str = "ingest",
+                 objstore: str | None = None,
+                 segcache_max_bytes: int = 256 << 20,
+                 publish_interval_s: float = 2.0,
+                 readtier_poll_s: float = 2.0) -> None:
+        # disaggregated storage (store/objstore.py + store/segcache.py):
+        # - role="ingest" (+ --objstore): after every tier commit the
+        #   SegmentPublisher mirrors adopted segments + dict dumps into
+        #   the shared object store and swaps this shard's pointer.
+        # - role="querier": a STATELESS read replica — no receiver, no
+        #   decoders, no flusher, no local durability. It polls shard
+        #   pointers, adopts published segments into RemoteTableTiers
+        #   and serves sealed history; ingest shards answer only their
+        #   live/unpublished rows via the publish-gen handshake.
+        self.role = role if role in ("ingest", "querier") else "ingest"
+        self.objstore_path = objstore
+        self.segcache_max_bytes = max(1 << 20, int(segcache_max_bytes))
+        self.publish_interval_s = publish_interval_s
+        self.readtier_poll_s = readtier_poll_s
+        self.objstore = None
+        self.publisher = None
+        self.segcache = None
+        self.readtier = None
+        self.partial_cache = None
+        self._pub_stop = threading.Event()
+        self._pub_thread: threading.Thread | None = None
+        self._poll_stop = threading.Event()
+        self._poll_thread: threading.Thread | None = None
+        if self.role == "querier" and not objstore:
+            raise ValueError("role=querier requires an --objstore path")
         # flow-log decode parallelism for THIS server instance; None
         # defers to the DF_INGEST_WORKERS env knob read at import time
         self.ingest_workers = ingest_workers
@@ -78,12 +108,18 @@ class Server:
         # persistent tiered storage (store/tiered.py): sealed chunks are
         # flushed into mmap-able columnar segments, and acks are released
         # only after the manifest commit that makes their rows durable
-        self.storage = bool(storage and data_dir)
+        self.storage = bool(storage and data_dir
+                            and self.role == "ingest")
         self.flush_interval_s = flush_interval_s
         self.compact_interval_s = compact_interval_s
         self.storage_max_bytes = max(0, int(storage_max_bytes))
-        self.db = Database(data_dir=data_dir, shard_id=shard_id,
-                           storage=self.storage)
+        # a querier's tables are pure views over adopted remote
+        # segments: no local persistence, no recovery — its data_dir
+        # (when given) only roots the mmap segment cache
+        self._cache_root = data_dir if self.role == "querier" else None
+        self.db = Database(
+            data_dir=None if self.role == "querier" else data_dir,
+            shard_id=shard_id, storage=self.storage)
         self.flusher = None
         self.compactor = None
         self.durability = None
@@ -256,7 +292,9 @@ class Server:
         snap = m.directory.snapshot()
         members = {p["shard_id"]: {"addr": p["addr"],
                                    "ingest": p.get("ingest_addr", "")}
-                   for p in snap["peers"]}
+                   for p in snap["peers"]
+                   # queriers take no agent traffic: never ring owners
+                   if p.get("role", "ingest") == "ingest"}
         ring = HashRing.build(m.ring, members, self.replication, token)
         if ring is not m.ring and m.publish_ring(ring):
             log.info("ring: epoch %d published (token %d, members %s)",
@@ -319,6 +357,58 @@ class Server:
     def start(self) -> "Server":
         if self.db.data_dir:
             self.db.load()  # resume persisted tables
+        if self.objstore_path is not None:
+            from deepflow_tpu.store.objstore import ObjStore
+            self.objstore = ObjStore(self.objstore_path)
+        if self.role == "querier":
+            self._start_readtier()
+        else:
+            self._start_ingest()
+        self.http.start()
+        if self._cluster_on:
+            self._start_cluster()
+        if self.role == "ingest":
+            self.alerts.start()
+            self.step_detector.start()
+        self.deadman.start()
+        if self.telemetry.enabled:
+            self._selfstats_stop.clear()
+            self._selfstats_thread = threading.Thread(
+                target=self._selfstats_loop, name="df-selfstats",
+                daemon=True)
+            self._selfstats_thread.start()
+        if self.ha_k8s_lease:
+            import os as _os_e
+            from deepflow_tpu.server.election import K8sLeaseElection
+            try:
+                self.election = K8sLeaseElection(
+                    self.ha_k8s_lease,
+                    namespace=_os_e.environ.get("POD_NAMESPACE",
+                                                "default"),
+                    on_elected=self._start_singletons,
+                    on_deposed=self._stop_singletons).start()
+            except (RuntimeError, ValueError) as e:
+                log.warning("k8s lease election unavailable (%s); "
+                            "running singletons locally", e)
+                self._start_singletons()
+        elif self.ha_lease_path:
+            from deepflow_tpu.server.election import LeaderElection
+            self.election = LeaderElection(
+                self.ha_lease_path,
+                on_elected=self._start_singletons,
+                on_deposed=self._stop_singletons).start()
+        else:
+            self._start_singletons()
+        import os as _os
+        if _os.environ.get("KUBERNETES_SERVICE_HOST"):
+            self.start_genesis()  # in-cluster: watch automatically
+        self._started = True
+        log.info("server up: role %s ingest :%d query :%d", self.role,
+                 self.receiver.port if self.role == "ingest" else 0,
+                 self.http.port)
+        return self
+
+    def _start_ingest(self) -> None:
         floors = self._load_ack_state()
         if self.storage:
             # the tier manifest carries floors committed ATOMICALLY with
@@ -386,81 +476,107 @@ class Server:
                     self.db, interval_s=self.compact_interval_s,
                     telemetry=self.telemetry).start()
         self.receiver.start()
-        self.http.start()
-        if self._cluster_on:
-            # after http.start(): with --query-port 0 the advertise
-            # address needs the REAL bound port
-            from deepflow_tpu.cluster.federation import (
-                FederationCoordinator)
-            from deepflow_tpu.cluster.membership import ClusterMembership
-            from deepflow_tpu.cluster.remote import FanOut
-            adv = (self.cluster_advertise
-                   or f"127.0.0.1:{self.http.port}")
-            self.membership = ClusterMembership(
-                self.shard_id, adv, seed=self.cluster_seed,
-                telemetry=self.telemetry)
+        if self.objstore is not None and self.storage:
+            # publish sealed state to the shared store so stateless
+            # querier replicas can adopt it (see store/objstore.py)
+            from deepflow_tpu.store.objstore import SegmentPublisher
+            self.publisher = SegmentPublisher(self.objstore,
+                                              self.shard_id)
+            self.api.publisher = self.publisher
+            self._pub_stop.clear()
+            self._pub_thread = threading.Thread(
+                target=self._publish_loop, name="df-publish",
+                daemon=True)
+            self._pub_thread.start()
+
+    def _start_readtier(self) -> None:
+        """Querier role: no receiver/decoders/flusher. The node adopts
+        published segments from the object store into a byte-budgeted
+        local cache and serves sealed history over them."""
+        import tempfile
+        from deepflow_tpu.store.segcache import ReadTier, SegmentCache
+        root = (self._cache_root
+                or tempfile.mkdtemp(prefix="df-segcache-"))
+        self.segcache = SegmentCache(
+            root, self.objstore, max_bytes=self.segcache_max_bytes,
+            telemetry=self.telemetry)
+        self.readtier = ReadTier(self.db, self.objstore, self.segcache,
+                                 shard_id=self.shard_id)
+        self.api.readtier = self.readtier
+        try:
+            self.readtier.poll()  # first adoption before serving
+        except Exception:
+            log.exception("initial read-tier poll failed")
+        self._poll_stop.clear()
+        self._poll_thread = threading.Thread(
+            target=self._poll_loop, name="df-readtier", daemon=True)
+        self._poll_thread.start()
+
+    def _publish_loop(self) -> None:
+        while not self._pub_stop.wait(self.publish_interval_s):
+            try:
+                self.publisher.maybe_publish(self.db.tier_store)
+            except Exception:
+                log.exception("segment publish failed")
+
+    def _poll_loop(self) -> None:
+        while not self._poll_stop.wait(self.readtier_poll_s):
+            try:
+                self.readtier.poll()
+            except Exception:
+                log.exception("read-tier poll failed")
+
+    def _start_cluster(self) -> None:
+        # after http.start(): with --query-port 0 the advertise
+        # address needs the REAL bound port
+        from deepflow_tpu.cluster.federation import FederationCoordinator
+        from deepflow_tpu.cluster.membership import ClusterMembership
+        from deepflow_tpu.cluster.remote import FanOut
+        adv = (self.cluster_advertise
+               or f"127.0.0.1:{self.http.port}")
+        self.membership = ClusterMembership(
+            self.shard_id, adv, seed=self.cluster_seed,
+            role=self.role, telemetry=self.telemetry)
+        if self.role == "ingest":
             # agents ship frames to the RECEIVER port; peers gossip it
-            # so the ring can hand agent-facing ingest addrs around
+            # so the ring can hand agent-facing ingest addrs around.
+            # Queriers take no agent traffic and stay out of the ring.
             self.membership.ingest_addr = (
                 f"{adv.rsplit(':', 1)[0]}:{self.receiver.port}")
-            self.membership.start()
-            self.fanout = FanOut(
-                telemetry=self.telemetry,
-                timeout_s=self._fanout_timeout_s,
-                hedge_delay_s=self._fanout_hedge_delay_s,
+        self.membership.start()
+        self.fanout = FanOut(
+            telemetry=self.telemetry,
+            timeout_s=self._fanout_timeout_s,
+            hedge_delay_s=self._fanout_hedge_delay_s,
+            api_token=self.api.api_token or None)
+        self.federation = FederationCoordinator(
+            self.db, self.membership, self.fanout,
+            shard_id=self.shard_id)
+        self.api.membership = self.membership
+        self.api.federation = self.federation
+        if self.readtier is not None:
+            # read-tier coordinator: freeze adopted snapshots across the
+            # scatter, send the publish-gen handshake, and join the
+            # cluster-wide partial-aggregate cache
+            self.federation.readtier = self.readtier
+            self.federation.query_cache = self.api.query_cache
+            from deepflow_tpu.cluster.partialcache import PartialCache
+            self.partial_cache = PartialCache(
+                self.api.query_cache, self.membership,
+                self.federation.dict_sync, self.db,
+                shard_id=self.shard_id, telemetry=self.telemetry,
                 api_token=self.api.api_token or None)
-            self.federation = FederationCoordinator(
-                self.db, self.membership, self.fanout,
-                shard_id=self.shard_id)
-            self.api.membership = self.membership
-            self.api.federation = self.federation
-            if self.replication > 0:
-                self._ring_stop.clear()
-                self._ring_thread = threading.Thread(
-                    target=self._ring_loop, name="df-ring", daemon=True)
-                self._ring_thread.start()
-        self.alerts.start()
-        self.step_detector.start()
-        self.deadman.start()
-        if self.telemetry.enabled:
-            self._selfstats_stop.clear()
-            self._selfstats_thread = threading.Thread(
-                target=self._selfstats_loop, name="df-selfstats",
-                daemon=True)
-            self._selfstats_thread.start()
-        if self.ha_k8s_lease:
-            import os as _os_e
-            from deepflow_tpu.server.election import K8sLeaseElection
-            try:
-                self.election = K8sLeaseElection(
-                    self.ha_k8s_lease,
-                    namespace=_os_e.environ.get("POD_NAMESPACE",
-                                                "default"),
-                    on_elected=self._start_singletons,
-                    on_deposed=self._stop_singletons).start()
-            except (RuntimeError, ValueError) as e:
-                log.warning("k8s lease election unavailable (%s); "
-                            "running singletons locally", e)
-                self._start_singletons()
-        elif self.ha_lease_path:
-            from deepflow_tpu.server.election import LeaderElection
-            self.election = LeaderElection(
-                self.ha_lease_path,
-                on_elected=self._start_singletons,
-                on_deposed=self._stop_singletons).start()
-        else:
-            self._start_singletons()
-        import os as _os
-        if _os.environ.get("KUBERNETES_SERVICE_HOST"):
-            self.start_genesis()  # in-cluster: watch automatically
-        self._started = True
-        log.info("server up: ingest :%d query :%d",
-                 self.receiver.port, self.http.port)
-        return self
+            self.partial_cache.readtier = self.readtier
+            self.api.partial_cache = self.partial_cache
+        if self.replication > 0 and self.role == "ingest":
+            self._ring_stop.clear()
+            self._ring_thread = threading.Thread(
+                target=self._ring_loop, name="df-ring", daemon=True)
+            self._ring_thread.start()
 
     def _start_singletons(self) -> None:
         """Leader-only components (no-op when already running)."""
-        if not self.rollup.running():
+        if self.role == "ingest" and not self.rollup.running():
             self.rollup.start()
         if not self.janitor.running():
             self.janitor.start()
@@ -483,6 +599,14 @@ class Server:
         if not self._started:
             return
         self.deadman.stop()
+        self._pub_stop.set()
+        if self._pub_thread is not None:
+            self._pub_thread.join(timeout=2.0)
+            self._pub_thread = None
+        self._poll_stop.set()
+        if self._poll_thread is not None:
+            self._poll_thread.join(timeout=2.0)
+            self._poll_thread = None
         self._ring_stop.set()
         if self._ring_thread is not None:
             self._ring_thread.join(timeout=2.0)
@@ -495,7 +619,8 @@ class Server:
         if self._selfstats_thread is not None:
             self._selfstats_thread.join(timeout=2.0)
             self._selfstats_thread = None
-        self.receiver.stop()
+        if self.role == "ingest":
+            self.receiver.stop()
         for d in self.decoders:
             d.stop()  # joins workers, then drains the queue: acked
             # frames must reach the tables before the db persists
@@ -514,6 +639,13 @@ class Server:
             # so the ack state written below matches durable rows
             self.flusher.stop()
             self.flusher = None
+        if self.publisher is not None and self.db.tier_store is not None:
+            # after the final flush: publish whatever it sealed so
+            # queriers see the full history across a clean restart
+            try:
+                self.publisher.maybe_publish(self.db.tier_store)
+            except Exception:
+                log.exception("final segment publish failed")
         # persist ack watermarks AFTER the drain: every acked frame is
         # now in a table, so seeding dedup floors from this state on the
         # next start cannot mask an undecoded frame
@@ -605,6 +737,25 @@ def main() -> None:
                         help="on-disk tier size budget per node; the "
                              "janitor evicts oldest segments past it "
                              "(0 = TTL-only eviction)")
+    parser.add_argument("--role", default="ingest",
+                        choices=("ingest", "querier"),
+                        help="ingest: full write path (receiver + "
+                             "decoders + flusher); querier: stateless "
+                             "read replica serving sealed history "
+                             "fetched on demand from --objstore")
+    parser.add_argument("--objstore", default=None,
+                        help="shared object-store directory. Ingest "
+                             "nodes publish sealed segments + manifest "
+                             "pointers there; queriers adopt them "
+                             "(required for --role querier)")
+    parser.add_argument("--segcache-max-mb", type=int, default=256,
+                        help="querier local segment-cache byte budget; "
+                             "least-recently-used segments past it are "
+                             "evicted (refetched on demand)")
+    parser.add_argument("--publish-interval-s", type=float, default=2.0,
+                        help="ingest publish cadence to --objstore")
+    parser.add_argument("--readtier-poll-s", type=float, default=2.0,
+                        help="querier manifest-pointer poll cadence")
     parser.add_argument("--ha-lease", default=None,
                         help="shared-volume lease FILE for leader election")
     parser.add_argument("--ha-k8s-lease", default=None,
@@ -633,7 +784,12 @@ def main() -> None:
                     flush_interval_s=args.flush_interval_s,
                     compact_interval_s=args.compact_interval_s,
                     storage_max_bytes=args.storage_max_mb << 20,
-                    enable_controller=not args.no_controller).start()
+                    role=args.role, objstore=args.objstore,
+                    segcache_max_bytes=args.segcache_max_mb << 20,
+                    publish_interval_s=args.publish_interval_s,
+                    readtier_poll_s=args.readtier_poll_s,
+                    enable_controller=(not args.no_controller
+                                       and args.role != "querier")).start()
     try:
         while True:
             time.sleep(3600)

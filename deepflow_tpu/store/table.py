@@ -421,6 +421,20 @@ class ColumnarTable:
                 if ch is not payload["chunk"]]
             self.watermark += 1
 
+    def note_tier_publish(self, rows: int, tmin=None, tmax=None) -> None:
+        """Read-tier adoption bookkeeping (store/segcache.py): rows a
+        remote shard published join the row count and mark the covered
+        time range exactly like a local flush commit — a segment
+        published at gen G moves the change token the same way
+        confirm_flush + attach_tier would have."""
+        with self._lock:
+            self.rows_written += rows
+            self.watermark += 1
+            if self._bucket_div and tmin is not None and tmax is not None:
+                self._note_span(int(tmin), int(tmax))
+            else:
+                self._wide_mark = self.watermark
+
     def note_tier_evict(self, rows: int, tmin=None, tmax=None) -> None:
         """Tier eviction bookkeeping: dropped rows leave the row count
         and invalidate the evicted time range (satellite fix: eviction
