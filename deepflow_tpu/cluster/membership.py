@@ -174,7 +174,11 @@ class ClusterMembership:
 
     def cache_adverts(self) -> dict:
         """Everything known warm, local keys included (seed view)."""
-        out = {d: [s, a] for d, (s, a) in self._cache_advs.items()}
+        with self._adv_lock:
+            # copy under the lock: _merge_advs mutates from the
+            # heartbeat/join threads while a join response reads this
+            snap = dict(self._cache_advs)
+        out = {d: [s, a] for d, (s, a) in snap.items()}
         out.update(self._local_advs())
         return out
 

@@ -126,7 +126,9 @@ class PartialCache:
             org = share_org(extra)
             if org is _UNSHARED:
                 continue
-            if self.readtier.pub_token(tname) is None:
+            if not self.readtier.pub_token(tname):
+                # pub_token returns "" (not None) before any adoption:
+                # nothing shareable to advertise for this table yet
                 continue
             out.add(digest_of(tname, sql, org))
         with self._lock:
@@ -151,7 +153,9 @@ class PartialCache:
         if org is _UNSHARED or not buckets or self.readtier is None:
             return {}
         tok = self.readtier.pub_token(tname)
-        if tok is None or not self._pure(table):
+        if not tok or not self._pure(table):
+            # "" = no adopted state: a fetch would ship an empty token
+            # the server side always rejects — skip the round-trip
             return {}
         adv = self.membership.advert_for(digest_of(tname, sql, org))
         if adv is None:

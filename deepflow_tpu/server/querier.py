@@ -1226,8 +1226,20 @@ class QuerierAPI:
                 if fns:
                     from deepflow_tpu.store.segcache import \
                         PublishedExcludeView
-                    table = PublishedExcludeView(table, fns)
-                rt_ack = gen
+                    view = PublishedExcludeView(table, fns)
+                    # a compaction/eviction may have retired published
+                    # fns before the next publish tick moves `current`;
+                    # excluding then would leave the replacement run
+                    # (same rows) in our answer while the coordinator
+                    # also serves the published blobs. Ack only while
+                    # every published fn is still live — otherwise
+                    # answer in full and let the coordinator drop our
+                    # adopted segments (same path as a gen mismatch).
+                    if view.complete:
+                        table = view
+                        rt_ack = gen
+                else:
+                    rt_ack = gen
         from deepflow_tpu.query.cache import change_token
         # read BEFORE computing; the exclusion context joins the token —
         # the same table state answers for different rows at a

@@ -115,7 +115,8 @@ class Server:
         self.storage_max_bytes = max(0, int(storage_max_bytes))
         # a querier's tables are pure views over adopted remote
         # segments: no local persistence, no recovery — its data_dir
-        # (when given) only roots the mmap segment cache
+        # (when given) only roots the mmap segment cache, which lives
+        # in a <data_dir>/segcache subdirectory it wipes on startup
         self._cache_root = data_dir if self.role == "querier" else None
         self.db = Database(
             data_dir=None if self.role == "querier" else data_dir,
@@ -493,10 +494,16 @@ class Server:
         """Querier role: no receiver/decoders/flusher. The node adopts
         published segments from the object store into a byte-budgeted
         local cache and serves sealed history over them."""
+        import os
         import tempfile
         from deepflow_tpu.store.segcache import ReadTier, SegmentCache
-        root = (self._cache_root
-                or tempfile.mkdtemp(prefix="df-segcache-"))
+        # a dedicated subdirectory, NEVER data_dir itself: the cache
+        # wipes its root on startup, and a --data-dir pointing at an
+        # existing tier (e.g. an ingest node's) must survive a querier
+        # started against it by mistake
+        root = (os.path.join(self._cache_root, "segcache")
+                if self._cache_root
+                else tempfile.mkdtemp(prefix="df-segcache-"))
         self.segcache = SegmentCache(
             root, self.objstore, max_bytes=self.segcache_max_bytes,
             telemetry=self.telemetry)

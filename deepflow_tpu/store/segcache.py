@@ -37,7 +37,7 @@ import logging
 import os
 import threading
 import weakref
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from collections.abc import Mapping
 
 log = logging.getLogger("df.segcache")
@@ -71,6 +71,9 @@ class SegmentCache:
         self._lock = threading.Lock()
         self._entries: "OrderedDict[tuple, dict]" = OrderedDict()
         self._inflight: dict[tuple, threading.Event] = {}
+        # pin releases that could not take _lock (finalizer fired in a
+        # thread already holding it); drained by pin/discard/snapshot
+        self._pending: "deque[dict]" = deque()
         self._hop = (telemetry.hop("readtier.segcache")
                      if telemetry else None)
         self.stats = {"fetches": 0, "hits": 0, "misses": 0,
@@ -101,6 +104,7 @@ class SegmentCache:
         """Fetch-if-needed and pin rseg's segment for ``holder``'s
         lifetime (a weakref finalizer on holder releases the pin).
         Returns the cache entry; entry["seg"] is the open Segment."""
+        self._drain_releases()
         key = rseg.key
         while True:
             with self._lock:
@@ -186,6 +190,7 @@ class SegmentCache:
         """Drop a segment the manifest no longer vouches for (publisher
         compacted/evicted it). Row accounting is the ReadTier's job
         (note_tier_evict) — no eviction ledger here."""
+        self._drain_releases()
         with self._lock:
             ent = self._entries.pop(key, None)
             if ent is None:
@@ -200,15 +205,47 @@ class SegmentCache:
             self._unlink(ent)
 
     def _release(self, ent: dict) -> None:
-        with self._lock:
-            ent["refs"] -= 1
-            dead = ent["condemned"] and ent["refs"] <= 0
-        if dead:
-            self._unlink(ent)
+        # weakref.finalize callback: can fire during GC at any
+        # allocation point — including in a thread that currently holds
+        # _lock inside pin()/discard() — and _lock is non-reentrant, so
+        # blocking on it here would self-deadlock. Enqueue the release
+        # (deque.append is atomic) and drain opportunistically: the
+        # try-acquire fails exactly in the dangerous re-entrant case,
+        # where the next pin/discard/snapshot drains instead.
+        self._pending.append(ent)
+        self._drain_releases(blocking=False)
+
+    def _drain_releases(self, blocking: bool = True) -> None:
+        if not self._pending:
+            return
+        if not self._lock.acquire(blocking=blocking):
+            return
+        doomed = []
+        try:
+            while True:
+                try:
+                    ent = self._pending.popleft()
+                except IndexError:
+                    break
+                ent["refs"] -= 1
+                if ent["condemned"] and ent["refs"] <= 0:
+                    doomed.append(ent)
+        finally:
+            self._lock.release()
+        for e in doomed:
+            self._unlink(e)
 
     def _unlink(self, ent: dict) -> None:
         with self._lock:
             if ent["unlinked"]:
+                return
+            cur = self._entries.get(ent["key"])
+            if cur is not None and cur is not ent \
+                    and cur["path"] == ent["path"]:
+                # the key was re-fetched to the same destination after
+                # this entry was condemned — the file on disk now
+                # belongs to the live entry, not this one
+                ent["unlinked"] = True
                 return
             ent["unlinked"] = True
         try:
@@ -217,6 +254,7 @@ class SegmentCache:
             pass
 
     def snapshot(self) -> dict:
+        self._drain_releases()
         with self._lock:
             out = dict(self.stats)
         out["max_bytes"] = self.max_bytes
@@ -749,15 +787,35 @@ class PublishedExcludeView(_FilterTableView):
     shard answers WITHOUT its published sealed segments — the read
     tier serves those rows — keeping live-stripe + unflushed +
     not-yet-published data only. Federation stitches the two halves
-    byte-identically (disjoint row sets, same dictionaries)."""
+    byte-identically (disjoint row sets, same dictionaries).
+
+    Scan units are snapshotted at construction, and ``complete``
+    reports whether EVERY published fn is still among them. A
+    compaction (or eviction) can retire published segments before the
+    next publish tick refreshes ``publisher.current``; in that window
+    the exclusion set matches nothing while the replacement run —
+    holding the same rows — would still be scanned, so an incomplete
+    view must never back an ack: the rows it fails to exclude would be
+    served a second time by the coordinator's read tier."""
 
     def __init__(self, table, fns: frozenset) -> None:
         super().__init__(table)
         self._fns = fns
+        units = table.scan_units()
+        live = {os.path.basename(p) for _ch, _z, seg in units
+                if (p := (getattr(seg, "path", None)
+                          if seg is not None else None)) is not None}
+        self.complete = fns <= live
+        self._units = [u for u in units if self._keep(u[2])]
 
     def _keep(self, seg) -> bool:
         p = getattr(seg, "path", None) if seg is not None else None
         return p is None or os.path.basename(p) not in self._fns
+
+    def scan_units(self) -> list:
+        # the construction-time snapshot: the completeness check and
+        # every scan over this view see the same unit list
+        return list(self._units)
 
 
 class PublishedExcludeDb:

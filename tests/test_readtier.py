@@ -135,6 +135,36 @@ def test_eviction_defers_unlink_until_last_ref_drops(tmp_path):
     assert not os.path.exists(path1)
 
 
+def test_release_never_blocks_on_held_cache_lock(tmp_path):
+    """_release runs from weakref.finalize callbacks, which GC can fire
+    at any allocation point — including in a thread that currently
+    holds the cache lock inside pin()/discard(). It must never block on
+    the (non-reentrant) lock: the release is deferred and drained by
+    the next cache operation."""
+    import os
+    store, segs = _published(tmp_path, [_rows(0, 8)])
+    cache = SegmentCache(str(tmp_path / "cache"), store)
+    rs = SimpleNamespace(key=(1, TBL, segs[0]["fn"]), shard=1,
+                         table=TBL, fn=segs[0]["fn"])
+    h = _Holder()
+    ent = cache.pin(rs, h)
+    cache.discard(rs.key)          # condemned while still pinned
+    assert ent["condemned"] and not ent["unlinked"]
+    assert cache._lock.acquire()   # the GC-interrupted thread's state
+    try:
+        done = []
+        t = threading.Thread(target=lambda: (cache._release(ent),
+                                             done.append(True)))
+        t.start()
+        t.join(timeout=5)
+        assert done, "finalizer release blocked on the held cache lock"
+    finally:
+        cache._lock.release()
+    # the deferred release unlinks on the next cache operation
+    cache.snapshot()
+    assert ent["unlinked"] and not os.path.exists(ent["path"])
+
+
 def test_publisher_noop_when_tier_unchanged(tmp_path):
     db = Database(data_dir=str(tmp_path / "ing"), shard_id=1,
                   storage=True)
@@ -324,3 +354,85 @@ def test_manifest_swap_mid_query_consistent_snapshot(tmp_path):
         q.stop()
         ingest.stop()
         solo.stop()
+
+
+def test_handshake_refuses_stale_exclusion_after_compaction(tmp_path):
+    """Between a compaction commit and the next publish tick the
+    shard's publisher.current still names the retired fns: the adopted
+    gen matches but the exclusion set matches nothing while the
+    replacement run holds the same rows. The shard must NOT ack in that
+    window (it answers in full, the coordinator drops its adopted
+    segments) or every compacted row is counted twice."""
+    from deepflow_tpu.server import Server
+    solo = Server(host="127.0.0.1", ingest_port=0, query_port=0,
+                  sync_port=0).start()
+    ingest, qs = _cluster(tmp_path, n_queriers=1)
+    q = qs[0]
+    body = {"sql": "SELECT app_service, Count(*) AS n, "
+                   "Sum(response_duration) AS s FROM l7_flow_log "
+                   "GROUP BY app_service ORDER BY app_service",
+            "db": "flow_log"}
+    try:
+        solo.db.table(TBL).append_rows(_rows(0, 16))
+        want = _post(solo.query_port, body)["result"]
+        # two small sealed segments, published at gen 1 and adopted
+        for lo in (0, 8):
+            ingest.db.table(TBL).append_rows(_rows(lo, 8))
+            assert ingest.db.flush_to_tier() == 8
+        assert ingest.publisher.maybe_publish(ingest.db.tier_store)
+        q.readtier.poll()
+        assert q.readtier.snapshot()["adopted"] == {"1": 1}
+        assert _canon(_post(q.query_port, body)["result"]) \
+            == _canon(want)
+
+        # compaction replaces both published fns with one sorted run;
+        # publish_interval_s=60 keeps publisher.current stale at gen 1
+        import os
+        res = ingest.db.compact_tier(min_merge=2)
+        assert res["segments_replaced"] == 2
+        gen, fn_sets = ingest.publisher.current
+        assert gen == 1 and fn_sets[TBL]
+        live = {os.path.basename(s.path)
+                for s in ingest.db.table(TBL).tier.segments()}
+        assert not (fn_sets[TBL] & live), "compaction kept published fns"
+
+        # the querier still holds gen 1; the shard must answer in full
+        # (no ack) and the answer must stay exact — not doubled
+        got = _post(q.query_port, body)
+        assert got["federation"]["missing_shards"] == []
+        assert _canon(got["result"]) == _canon(want)
+
+        # the next publish tick re-arms the handshake at gen 2
+        assert ingest.publisher.maybe_publish(ingest.db.tier_store)
+        q.readtier.poll()
+        assert q.readtier.snapshot()["adopted"] == {"1": 2}
+        assert _canon(_post(q.query_port, body)["result"]) \
+            == _canon(want)
+    finally:
+        q.stop()
+        ingest.stop()
+        solo.stop()
+
+
+def test_querier_cache_rooted_in_subdir_preserves_data_dir(tmp_path):
+    """The segment cache wipes its root at startup, so a querier must
+    root it in <data_dir>/segcache — pointing --data-dir at an existing
+    directory (e.g. an ingest node's tier) must not destroy it."""
+    import os
+    from deepflow_tpu.server import Server
+    data = tmp_path / "data"
+    (data / "tier").mkdir(parents=True)
+    keep = data / "tier" / "seg-000001.bin"
+    keep.write_bytes(b"precious segment bytes")
+    manifest = data / "MANIFEST.json"
+    manifest.write_text("{}")
+    q = Server(host="127.0.0.1", ingest_port=0, query_port=0,
+               sync_port=0, shard_id=9, role="querier",
+               objstore=str(tmp_path / "obj"),
+               data_dir=str(data)).start()
+    try:
+        assert q.segcache.root == os.path.join(str(data), "segcache")
+        assert keep.read_bytes() == b"precious segment bytes"
+        assert manifest.exists()
+    finally:
+        q.stop()
