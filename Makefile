@@ -3,7 +3,7 @@ JAX_ENV := env JAX_PLATFORMS=cpu
 
 .PHONY: test selfmon-check cluster-check steps-check chaos-check ha-check \
 	query-check ingest-check storage-check compaction-check readtier-check \
-	bench native
+	trace-check bench native
 
 test:
 	timeout -k 10 870 $(JAX_ENV) $(PYTHON) -m pytest tests/ -q -m 'not slow' \
@@ -81,6 +81,15 @@ storage-check:
 # exactly and converge to v2 on the next cycle.
 compaction-check:
 	timeout -k 10 600 $(JAX_ENV) $(PYTHON) -m deepflow_tpu.cli.compaction_check
+
+# Dogfooded query tracing gate: a federated 3-shard query must stitch
+# into exactly one trace readable through the system's own Tempo API
+# (coordinator + every shard exec + prune decisions, shard spans
+# parented under their own shard.call), with byte-identical results
+# tracing on/off, EXPLAIN ANALYZE stage sums within 20% of e2e, and a
+# conserved query.trace hop ledger on every node.
+trace-check:
+	timeout -k 10 120 $(JAX_ENV) $(PYTHON) -m deepflow_tpu.cli.trace_check
 
 bench:
 	$(JAX_ENV) $(PYTHON) bench.py

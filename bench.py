@@ -330,6 +330,124 @@ def _bench_selfmon_overhead() -> dict:
     }
 
 
+def _bench_query_trace_overhead() -> dict:
+    """query_trace arm: dogfooded query tracing rides the whole query
+    hot path (spans around plan/execute/scan/prune + the span sink), so
+    its cost at DEFAULT sampling (1/8 bulk + tail-keep, the shipped
+    default) must stay under 2% of query throughput. Same query, same
+    server, cache off so every run pays the full scan. The arms
+    alternate PER QUERY and compare per-query thread-CPU MEDIANS: the
+    adaptive kernel cost model, allocator growth and CPU frequency all
+    drift over seconds, so adjacent queries share drift state while
+    block-vs-block comparisons absorb it as a fake delta; the median
+    additionally discards the rare queries that pay a deferred span
+    flush or a cost-model re-probe. Results must also stay
+    byte-identical -- the gate is meaningless if the traced arm
+    computed something else."""
+    import gc
+    import os
+    import statistics
+    from deepflow_tpu.server import Server
+
+    # a representative analytic scan, not a toy: tracing cost is a
+    # fixed ~tens-of-us per query, so the corpus must look like the
+    # flow-log windows the store actually serves for the percentage to
+    # mean anything (the absolute us delta is reported alongside)
+    total_rows = 192_000
+    trials = 5
+    queries_per_trial = 160   # alternating -> 80 per arm per trial
+    body = {"sql": "SELECT app_service, Count(*) AS n, "
+                   "Avg(response_duration) AS d FROM l7_flow_log "
+                   "GROUP BY app_service ORDER BY app_service",
+            "db": "flow_log"}
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0,
+                    sync_port=0).start()
+    prev_cache = os.environ.get("DF_QUERY_CACHE")
+    prev_trace = os.environ.get("DF_QUERY_TRACE")
+    prev_par = os.environ.get("DF_QUERY_PARALLEL")
+    try:
+        server.db.table("flow_log.l7_flow_log").append_rows([
+            {"app_service": f"svc-{j % 8}",
+             "response_duration": 1_000 + j % 5_000,
+             "time": 1_754_000_000_000_000_000 + j * 1_000_000}
+            for j in range(total_rows)])
+        os.environ["DF_QUERY_CACHE"] = "0"
+        # pin the degree cost model to the SERIAL path: its
+        # serial<->parallel regime flips move per-query CPU by far more
+        # than the tracing delta under test, and the serial path keeps
+        # the whole scan on the measuring thread so thread_time sees
+        # every cycle tracing adds to it
+        os.environ["DF_QUERY_PARALLEL"] = "0"
+        api = server.api
+
+        # in-process calls: the gate is about the QUERY PATH's cost, and
+        # at ~4ms/query the HTTP+scheduler jitter alone exceeds 2%
+        vals = {True: None, False: None}
+        def timed_query(traced: bool) -> int:
+            os.environ["DF_QUERY_TRACE"] = "1" if traced else "0"
+            b = dict(body)
+            c0 = time.thread_time_ns()
+            got = api.query(b)
+            dt = time.thread_time_ns() - c0
+            vals[traced] = got["result"]["values"]
+            return dt
+
+        for _ in range(12):          # warm code paths, caches, dicts
+            timed_query(True)
+        for _ in range(12):
+            timed_query(False)
+        trial_deltas: list[float] = []
+        trial_offs: list[float] = []
+        gc_was_enabled = gc.isenabled()
+        gc.disable()
+        try:
+            for _ in range(trials):
+                gc.collect()
+                on_ns: list[int] = []
+                off_ns: list[int] = []
+                for i in range(queries_per_trial):
+                    traced = i % 2 == 0
+                    (on_ns if traced else off_ns).append(
+                        timed_query(traced))
+                # deferred span-sink work drains outside the timers on
+                # purpose: it runs on a background thread in production,
+                # and billing a 128-row columnar append to one unlucky
+                # query would gate on sink throughput, not path overhead
+                api.qtracer.flush()
+                on_med = statistics.median(on_ns)
+                off_med = statistics.median(off_ns)
+                trial_deltas.append(on_med - off_med)
+                trial_offs.append(off_med)
+        finally:
+            if gc_was_enabled:
+                gc.enable()
+        # median across trials: single-trial medians still wobble by
+        # tens of us on a busy host; the cross-trial median is stable
+        delta_ns = statistics.median(trial_deltas)
+        off_ns_med = statistics.median(trial_offs)
+    finally:
+        for key, prev in (("DF_QUERY_CACHE", prev_cache),
+                          ("DF_QUERY_TRACE", prev_trace),
+                          ("DF_QUERY_PARALLEL", prev_par)):
+            if prev is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = prev
+        server.stop()
+    off_ms = off_ns_med / 1e6
+    on_ms = (off_ns_med + delta_ns) / 1e6
+    pct = (delta_ns / off_ns_med * 100.0) if off_ns_med else 0.0
+    return {
+        "query_trace_ms_on": round(on_ms, 3),
+        "query_trace_ms_off": round(off_ms, 3),
+        "query_trace_overhead_us": round(delta_ns / 1e3, 1),
+        "query_trace_overhead_pct": round(max(0.0, pct), 2),
+        "query_trace_results_match": vals[True] == vals[False],
+        # perf guard in the same spirit as selfmon_overhead_above_gate
+        "query_trace_overhead_above_gate": pct > 2.0,
+    }
+
+
 def _run_sender_ingest(durable: bool, n_batches: int = 400) -> float:
     """L4 batches through the REAL UniformSender (not a raw socket) into
     the real server; returns rows/s. durable=True is the full loss-
@@ -1310,6 +1428,7 @@ def main() -> None:
     cpu_detail.update(_bench_federation())
     cpu_detail.update(_bench_query())
     cpu_detail.update(_bench_query_parallel())
+    cpu_detail.update(_bench_query_trace_overhead())
     cpu_detail.update(_bench_storage())
     cpu_detail.update(_bench_scan_selective())
     cpu_detail.update(_bench_read_scaling())

@@ -216,6 +216,25 @@ def main(argv: list[str] | None = None) -> int:
     p_trace = sub.add_parser("trace")
     p_trace.add_argument("trace_id")
 
+    p_explain = sub.add_parser(
+        "explain", help="EXPLAIN ANALYZE a DF-SQL statement: plan "
+                        "(tier, segments pruned, morsel degree, cache "
+                        "layer) + observed per-stage wall/CPU time")
+    p_explain.add_argument("sql")
+    p_explain.add_argument("--db", default="")
+    p_explain.add_argument("--no-analyze", action="store_true",
+                           help="plan only, don't execute")
+    p_explain.add_argument("--json", action="store_true",
+                           help="raw explain JSON")
+
+    p_qtrace = sub.add_parser(
+        "query-trace", help="span waterfall for one query trace id "
+                            "(from EXPLAIN ANALYZE or trace-search)")
+    p_qtrace.add_argument("trace_id")
+    p_qtrace.add_argument("--flame", action="store_true",
+                          help="render as a flame graph (self-time "
+                               "weighted) instead of a waterfall")
+
     p_promql = sub.add_parser(
         "promql", help="evaluate a PromQL expression (instant by default; "
                        "--start/--end for a range)")
@@ -768,6 +787,61 @@ def main(argv: list[str] | None = None) -> int:
                  t["durationMs"], t["startTimeUnixNano"]]
                 for t in out["traces"]]
         print_table(["TRACE_ID", "SERVICE", "NAME", "MS", "START_NS"], rows)
+    elif args.cmd == "explain":
+        sql = args.sql.strip()
+        if sql[:7].upper() != "EXPLAIN":
+            kw = "EXPLAIN" if args.no_analyze else "EXPLAIN ANALYZE"
+            sql = f"{kw} {sql}"
+        out = _api(args.server, "/v1/query/", {"db": args.db, "sql": sql})
+        ex = out.get("explain")
+        if ex is None:
+            raise SystemExit("server returned no explain block "
+                             "(old server?)")
+        if args.json:
+            print(json.dumps(ex, indent=2))
+            return 0
+        plan = ex.get("plan", {})
+        print(f"trace_id: {ex.get('trace_id', '')}")
+        for k in sorted(plan):
+            print(f"  {k}: {plan[k]}")
+        r = out["result"]
+        print_table(r["columns"], r["values"])
+        if ex.get("analyze"):
+            print(f"total: {ex.get('total_ms', 0):.3f}ms over "
+                  f"{ex.get('spans', 0)} spans")
+    elif args.cmd == "query-trace":
+        out = _api(args.server, "/v1/trace/Tracing",
+                   {"trace_id": args.trace_id})
+        tree = out["result"]
+        if not tree["spans"]:
+            raise SystemExit(f"no spans for trace {args.trace_id}")
+        if args.flame:
+            from deepflow_tpu.query.flamegraph import (build_flame_tree,
+                                                       trace_flame_stacks)
+            stacks, values = trace_flame_stacks(tree)
+            print_flame(build_flame_tree(
+                stacks, values, root_name=args.trace_id).to_dict())
+            return 0
+        t0 = min(int(s["start_ns"]) for s in tree["spans"])
+        t1 = max(int(s["end_ns"]) for s in tree["spans"])
+        total = max(1, t1 - t0)
+        width = 40
+        print(f"trace {tree['trace_id']}: {tree['span_count']} spans, "
+              f"{total / 1e6:.2f}ms")
+
+        def waterfall(node, depth=0):
+            off = int(node["start_ns"]) - t0
+            lead = min(width - 1, int(width * off / total))
+            w = max(1, int(width * int(node["duration_ns"]) / total))
+            bar = " " * lead + "▇" * min(w, width - lead)
+            label = "  " * depth + node["name"]
+            print(f"{label:<34.34} {node['duration_ns'] / 1e6:>9.3f}ms "
+                  f"|{bar:<{width}}| {node['service']} {node['status']}")
+            for c in node["children"]:
+                waterfall(c, depth + 1)
+
+        for root in sorted(tree["spans"], key=lambda s: s["start_ns"]):
+            waterfall(root)
     elif args.cmd == "trace":
         out = _api(args.server, "/v1/trace/Tracing",
                    {"trace_id": args.trace_id})

@@ -40,7 +40,7 @@ from deepflow_tpu.cluster.hashring import ClaimDbView, HashRing
 from deepflow_tpu.cluster.membership import (DEFAULT_TTL_S,
                                              ClusterMembership, Peer)
 from deepflow_tpu.cluster.remote import FanOut, ShardCallError
-from deepflow_tpu.query import cache, engine, promql
+from deepflow_tpu.query import cache, engine, promql, qtrace
 from deepflow_tpu.query import sql as qsql
 from deepflow_tpu.query.flamegraph import merge_stack_values
 
@@ -306,7 +306,11 @@ class FederationCoordinator:
                                 for sid, st in ent["states"].items()
                                 if st is not None}
         addr_by_sid = {p.shard_id: p.addr for p in peers}
-        results, info, db = self.scatter_claim(body, hop_name="cluster.sql")
+        with qtrace.span("scatter", peers=len(peers)) as sc:
+            results, info, db = self.scatter_claim(body,
+                                                   hop_name="cluster.sql")
+            sc.annotate(answered=len(results),
+                        missing=len(info.get("missing_shards", [])))
         local = db.table(table.name) if db is not self.db else table
         ring = self.ring()
         # the local partial's validity depends on the claim view too:
@@ -406,12 +410,15 @@ class FederationCoordinator:
             return d
 
         partials: list = [local_part]
+        remap_sp = qtrace.span("dictsync.remap")
+        remapped = 0
         for sid in sorted(parts_raw):
             raw = parts_raw[sid]
             if isinstance(raw, dict) and raw.get("dicts"):
                 try:
                     partials.append(self.dict_sync.remap_partial(
                         sid, table.name, raw, local_dicts))
+                    remapped += 1
                     continue
                 except DictSyncError:
                     # mirror can't cover the shard's ids (malformed
@@ -429,12 +436,15 @@ class FederationCoordinator:
                     states[sid] = (raw.get("state")
                                    if isinstance(raw, dict) else None)
             partials.append(raw)
+        remap_sp.annotate(shards_remapped=remapped)
+        remap_sp.finish()
         if failed_sync:
             info = dict(info)
             info["missing_shards"] = sorted(
                 set(info["missing_shards"]) | set(failed_sync))
-        res = engine.merge_partials(table, select, partials,
-                                    decoder=_decoder)
+        with qtrace.span("merge", partials=len(partials)):
+            res = engine.merge_partials(table, select, partials,
+                                        decoder=_decoder)
         info = dict(info)
         info["cache"] = "cold"
         if cache_on:

@@ -47,6 +47,7 @@ import urllib.request
 
 from deepflow_tpu.cluster import wire
 from deepflow_tpu.cluster.dictsync import DictSyncError, build_sync
+from deepflow_tpu.query import qtrace
 from deepflow_tpu.query.cache import normalize_sql
 
 log = logging.getLogger("df.partialcache")
@@ -167,6 +168,8 @@ class PartialCache:
                 "dict_known": self.dict_sync.known_state(sid, tname)}
         with self._lock:
             self.counters["fetches"] += 1
+        fetch_sp = qtrace.span("partialcache.fetch", peer=sid, addr=addr,
+                               buckets=len(buckets))
         try:
             resp, _rsid = self._call(addr, body)
         except Exception as e:
@@ -174,6 +177,8 @@ class PartialCache:
                 self.counters["fetch_errors"] += 1
             if self._hop is not None:
                 self._hop.account(emitted=1, dropped=1, reason="error")
+            fetch_sp.annotate(outcome="error")
+            fetch_sp.finish()
             log.debug("partialcache fetch from %s failed: %s", addr, e)
             return {}
         got = (resp or {}).get("buckets") or {}
@@ -213,6 +218,8 @@ class PartialCache:
             self.counters["fetched_buckets"] += len(out)
         if self._hop is not None:
             self._hop.account(emitted=1, delivered=1)
+        fetch_sp.annotate(outcome="ok", fetched=len(out))
+        fetch_sp.finish()
         return out
 
     def _call(self, addr: str, body: dict):

@@ -186,8 +186,28 @@ class FanOut:
         if hop is not None:
             hop.account(emitted=len(peers))
         t0 = time.monotonic_ns()
-        futs = {self._pool.submit(self.client(p.addr).call, body,
-                                  self._attempt_pool): p for p in peers}
+        # trace propagation: the coordinator's context rides the shared
+        # body; each peer call gets a client span recorded from the
+        # fan-out worker thread (re-attached to the submitting query's
+        # trace buffer — pool threads don't inherit thread-locals)
+        from deepflow_tpu.query import qtrace
+        tbuf = qtrace.current_buf()
+        tsid = qtrace.current_span_id()
+
+        def _traced_call(client, b, peer):
+            if tbuf is None:
+                return client.call(b, self._attempt_pool)
+            with qtrace.use_buf(tbuf, tsid):
+                with qtrace.span("shard.call", shard=peer.shard_id,
+                                 addr=peer.addr, op=str(b.get("op", ""))):
+                    # inject inside the client span so the shard-side
+                    # root parents under ITS OWN shard.call, not the
+                    # shared scatter span
+                    return client.call(wire.inject_ctx(b),
+                                       self._attempt_pool)
+
+        futs = {self._pool.submit(_traced_call, self.client(p.addr),
+                                  body, p): p for p in peers}
         results: dict[int, object] = {}
         missing: list[int] = []
         for fut, peer in futs.items():

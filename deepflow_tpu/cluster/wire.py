@@ -37,6 +37,34 @@ class WireError(Exception):
     pass
 
 
+# -- query-trace context propagation -----------------------------------------
+# The trace context of a federated query rides the scatter body as one
+# small JSON dict under this key; shards that predate query tracing
+# ignore it (unknown body keys were always tolerated), shards that know
+# it adopt the context so their spans stitch into the coordinator's
+# trace (query/qtrace.py).
+
+QTRACE_KEY = "qtrace"
+
+
+def inject_ctx(body: dict) -> dict:
+    """Return ``body`` with the calling thread's active trace context
+    attached (a copy — scatter bodies are shared across peers); the
+    body passes through untouched when no trace is active."""
+    from deepflow_tpu.query import qtrace
+    ctx = qtrace.ctx_for_wire()
+    if ctx is None:
+        return body
+    out = dict(body)
+    out[QTRACE_KEY] = ctx
+    return out
+
+
+def extract_ctx(body: dict) -> dict | None:
+    ctx = body.get(QTRACE_KEY) if isinstance(body, dict) else None
+    return ctx if isinstance(ctx, dict) else None
+
+
 def _has_ndarray(obj) -> bool:
     if isinstance(obj, np.ndarray):
         return True

@@ -16,6 +16,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from deepflow_tpu.parallel.mesh import shard_map
+
 
 def init_moe_params(key, d_model: int, d_ff: int, n_experts: int,
                     dtype=jnp.bfloat16) -> dict:
@@ -74,7 +76,7 @@ def moe_ffn(params: dict, x: jax.Array, mesh: Mesh,
     """Expert-parallel top-1 MoE FFN. Experts (leading dim of w_up/w_down)
     must divide by the ep axis size; router stays replicated."""
     specs = {"router": P(), "w_up": P(axis), "w_down": P(axis)}
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(_moe_local, axis_name=axis),
         mesh=mesh, in_specs=(specs, P()), out_specs=P(),
         check_vma=False)

@@ -611,7 +611,11 @@ static void offcpu_drain_ring(DfOffCpu* p, CpuRing& r) {
         } else if (h->type == PERF_RECORD_SWITCH_TYPE) {
             bool out_bit = (h->misc & PERF_RECORD_MISC_SWITCH_OUT) != 0;
             if (!out_bit) p->n_switch_in++;
-            if (size >= sizeof(perf_event_header) + 16) {
+            // Only switch-OUT marks a departure. A switch-IN lands just
+            // before the resume sample; treating it as a departure
+            // candidate would overwrite block_start with the resume time
+            // and collapse every real blocked span to ~0.
+            if (out_bit && size >= sizeof(perf_event_header) + 16) {
                 // sample_id trailer = pid u32, tid u32, time u64
                 const uint8_t* q = rec.data() + sizeof(perf_event_header);
                 uint32_t spid, tid;

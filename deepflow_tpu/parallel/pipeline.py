@@ -15,6 +15,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from deepflow_tpu.parallel.mesh import shard_map
+
 
 def _pipeline_local(stage_params, micro_in, *, axis_name: str, stage_fn,
                     n_micro: int):
@@ -75,7 +77,7 @@ def pipeline_forward(params, x, stage_fn, mesh: Mesh, axis: str = "pp",
     micro = x.reshape(n_micro, batch // n_micro, *x.shape[1:])
 
     param_specs = jax.tree.map(lambda _: P(axis), params)
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(_pipeline_local, axis_name=axis, stage_fn=stage_fn,
                 n_micro=n_micro),
         mesh=mesh,

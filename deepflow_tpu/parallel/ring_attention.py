@@ -19,6 +19,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from deepflow_tpu.parallel.mesh import shard_map
+
 
 def _ring_attn_local(q, k, v, axis_name: str, causal: bool, scale: float):
     """Per-device body under shard_map.
@@ -83,7 +85,7 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "data",
     """
     scale = 1.0 / (q.shape[-1] ** 0.5)
     spec = P(None, axis, None, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(_ring_attn_local, axis_name=axis, causal=causal, scale=scale),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)

@@ -48,6 +48,7 @@ from collections import OrderedDict
 
 from deepflow_tpu.query import engine
 from deepflow_tpu.query import pool as qpool
+from deepflow_tpu.query import qtrace
 from deepflow_tpu.query import sql as S
 from deepflow_tpu.query.costmodel import KernelCostModel
 
@@ -134,6 +135,8 @@ class QueryCache:
         reflected in `extra_key` or rewritten variants would collide."""
         if not self._enabled():
             self._account("bypass")
+            qtrace.span("cache.lookup", layer="result",
+                        outcome="bypass").finish()
             return engine.execute(table, select if select is not None
                                   else sql)
         key = (table.name, normalize_sql(sql), extra_key)
@@ -145,8 +148,13 @@ class QueryCache:
         if ent is not None and ent[0] == token:
             self._account("hit")
             self.cost.observe("warm", 1, 1.0)
+            qtrace.span("cache.lookup", layer="result",
+                        outcome="hit").finish()
             return self._copy_result(ent[1])
-        self._account("stale" if ent is not None else "miss")
+        outcome = "stale" if ent is not None else "miss"
+        self._account(outcome)
+        qtrace.span("cache.lookup", layer="result",
+                    outcome=outcome).finish()
         t0 = time.perf_counter_ns()
         res = self._execute_cold(table, sql, key, select)
         cold_ns = time.perf_counter_ns() - t0
@@ -229,6 +237,7 @@ class QueryCache:
                 slot[b] = ent[2]
             else:
                 stale.append((b, mark))
+        qtrace.annotate(buckets=len(ordered), bucket_stale=len(stale))
         if stale and self.dist is not None:
             # ask a warm peer before scanning: each (mark, gens) was
             # captured BEFORE the fetch, so a write racing the network

@@ -26,6 +26,7 @@ import numpy as np
 
 from deepflow_tpu import native
 from deepflow_tpu.query import pool as qpool
+from deepflow_tpu.query import qtrace
 from deepflow_tpu.query import sql as S
 from deepflow_tpu.query.costmodel import KernelCostModel
 from deepflow_tpu.store.table import ColumnarTable
@@ -980,6 +981,10 @@ def _scan_plan(table: ColumnarTable, query: S.Select) -> list[dict]:
     per-segment skip indexes (inline id list / bloom filter) for
     equality and IN over dictionary columns. Every skipped segment is
     a LazyChunk that never decodes a byte."""
+    # prune decisions become trace spans: WHY a query was fast (segments
+    # skipped, and by which index) is part of its trace, not just a
+    # counter in the scan ledger
+    prune_sp = qtrace.span(f"prune {table.name}")
     units = table.scan_units()
     cons = idcons = strcons = ()
     if query.where is not None:
@@ -1014,6 +1019,10 @@ def _scan_plan(table: ColumnarTable, query: S.Select) -> list[dict]:
                 continue
         chunks.append(ch)
     _note_scan(zoned, pruned, bchecked, bpruned)
+    prune_sp.annotate(candidates=zoned, zone_pruned=pruned,
+                      bloom_checked=bchecked, bloom_pruned=bpruned,
+                      scanned=len(chunks))
+    prune_sp.finish()
     return chunks
 
 
@@ -1358,6 +1367,7 @@ def _execute_parallel(table: ColumnarTable, query: S.Select,
         for lo in range(0, sz, mrows):
             morsels.append((ch, lo, min(lo + mrows, sz)))
     dict_names = {id(d): cn for cn, d in table.dicts.items()}
+    qtrace.annotate(morsels=len(morsels), degree=p.threads)
     where = query.where
     prims = _filter_prims(table, where) if where is not None else None
 
@@ -1401,7 +1411,15 @@ def execute(table: ColumnarTable, query: S.Select | str) -> QueryResult:
     if isinstance(query, str):
         query = S.parse(query)
     query = _normalize(table, query)
+    with qtrace.span(f"scan {table.name}") as tsp:
+        res = _execute_traced(table, query, tsp)
+    return res
+
+
+def _execute_traced(table: ColumnarTable, query: S.Select,
+                    tsp) -> QueryResult:
     if os.environ.get("DF_QUERY_ENCODED", "1") == "0":
+        tsp.annotate(mode="decoded")
         return _execute_decoded(table, query)
     plan = _plan_parallel(table, query)
     t0 = time.perf_counter_ns() if plan is not None else 0
@@ -1413,8 +1431,10 @@ def execute(table: ColumnarTable, query: S.Select | str) -> QueryResult:
         if res is not None:
             _DEGREE.observe("parallel", plan[2],
                             time.perf_counter_ns() - t0)
+            tsp.annotate(mode="parallel", est_rows=plan[2])
             return res
         plan = None  # fell back; don't skew the serial coefficient
+    tsp.annotate(mode="serial")
     env, n_rows = _materialize(table, query)
 
     is_agg = _is_agg_query(query)
@@ -1755,8 +1775,17 @@ def execute_partial(table: ColumnarTable, query: S.Select | str, *,
     sites = _agg_sites(query)
     needs_time = (any(s.name == "LAST" for s in sites)
                   and "time" in table.columns)
+    with qtrace.span(f"scan.partial {table.name}", encoded=encoded) as sp:
+        return _execute_partial_traced(table, query, sites, needs_time,
+                                       encoded, sp)
+
+
+def _execute_partial_traced(table: ColumnarTable, query: S.Select,
+                            sites, needs_time: bool, encoded: bool,
+                            sp) -> dict:
     env, n_rows = _materialize(
         table, query, extra_cols={"time"} if needs_time else None)
+    sp.annotate(rows=n_rows)
     dict_names = ({id(d): cn for cn, d in table.dicts.items()}
                   if encoded else {})
     used: dict = {}  # dict-columns actually shipped as ids
@@ -2243,6 +2272,14 @@ def merge_partials(table: ColumnarTable, query: S.Select | str,
     pre-encoding shards, is lowered to decoded values and joins on the
     generic per-group path. decoder maps a dict column name to a
     Dictionary; defaults to this table's own dictionaries."""
+    with qtrace.span("merge.partials", partials=len(partials)):
+        return _merge_partials_impl(table, query, partials,
+                                    decoder=decoder)
+
+
+def _merge_partials_impl(table: ColumnarTable, query: S.Select | str,
+                         partials: list[dict], *,
+                         decoder=None) -> QueryResult:
     if isinstance(query, str):
         query = S.parse(query)
     query = _normalize(table, query)

@@ -54,6 +54,33 @@ def build_flame_tree(stacks: list[str], values: list[int],
     return root
 
 
+def trace_flame_stacks(tree: dict) -> tuple[list[str], list[int]]:
+    """An assembled trace tree (build_trace_from_spans output) as folded
+    stacks weighted by SELF time ns — each span's duration minus the
+    time covered by its children, so the flame graph shows where a
+    query (or request) actually spent its wall clock. Feed the result
+    to build_flame_tree."""
+    stacks: list[str] = []
+    values: list[int] = []
+
+    def walk(node: dict, prefix: str) -> None:
+        label = f"{node['service']}:{node['name']}" \
+            if node.get("service") else node["name"]
+        path = f"{prefix}{SEP}{label}" if prefix else label
+        child_ns = sum(int(c.get("duration_ns", 0))
+                       for c in node.get("children", []))
+        self_ns = max(0, int(node.get("duration_ns", 0)) - child_ns)
+        if self_ns:
+            stacks.append(path)
+            values.append(self_ns)
+        for c in node.get("children", []):
+            walk(c, path)
+
+    for root in tree.get("spans", []):
+        walk(root, "")
+    return stacks, values
+
+
 def profile_stack_values(table: ColumnarTable,
                          time_start_ns: int | None = None,
                          time_end_ns: int | None = None,

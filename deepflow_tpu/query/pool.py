@@ -32,6 +32,17 @@ _POOL: ThreadPoolExecutor | None = None
 _POOL_THREADS = 0
 _BUSY = 0
 _DISPATCHED = 0
+_QTRACE = None
+
+
+def _qtrace():
+    """qtrace module, bound once (the import-inside-the-hot-loop lookup
+    was measurable at one call per morsel)."""
+    global _QTRACE
+    if _QTRACE is None:
+        from deepflow_tpu.query import qtrace
+        _QTRACE = qtrace
+    return _QTRACE
 
 
 def configured_threads() -> int:
@@ -86,13 +97,31 @@ class ScanPool:
         self.threads = threads
 
     @staticmethod
-    def _run(fn, item):
+    def _run(fn, item, tbuf=None, anchor=None):
         global _BUSY
         _LOCAL.in_worker = True
         with _LOCK:
             _BUSY += 1
         try:
-            return fn(item)
+            if tbuf is None:
+                return fn(item)
+            # re-attach the submitting query's trace buffer: segcache
+            # fetches and prune decisions inside a morsel/bucket scan
+            # then land in the right span tree.  Inlined thread-local
+            # swap rather than qtrace.use_buf — this runs once per
+            # MORSEL, and at default morsel sizing the ctx-manager +
+            # per-task anchor allocation alone were a measurable slice
+            # of the query-path overhead budget.
+            tls = _qtrace()._tls
+            prev_buf = getattr(tls, "buf", None)
+            prev_span = getattr(tls, "span", None)
+            tls.buf = tbuf
+            tls.span = anchor
+            try:
+                return fn(item)
+            finally:
+                tls.buf = prev_buf
+                tls.span = prev_span
         finally:
             with _LOCK:
                 _BUSY -= 1
@@ -105,7 +134,21 @@ class ScanPool:
         global _DISPATCHED
         with _LOCK:
             _DISPATCHED += len(items)
-        futs = [self._ex.submit(self._run, fn, it) for it in items]
+        qtrace = _qtrace()
+        tbuf = qtrace.current_buf()
+        anchor = None
+        if tbuf is not None:
+            tsid = qtrace.current_span_id()
+            if tsid:
+                # one anchor shared by every task of this map call:
+                # span() only reads .span_id off it for parenting, and
+                # stray annotate()/bump() land in an attrs dict nobody
+                # records
+                anchor = qtrace.Span.__new__(qtrace.Span)
+                anchor.span_id = tsid
+                anchor.attrs = {}
+        futs = [self._ex.submit(self._run, fn, it, tbuf, anchor)
+                for it in items]
         out, err = [], None
         for f in futs:
             try:

@@ -136,6 +136,17 @@ class Show:
     table: str | None = None
 
 
+@dataclass
+class Explain:
+    """EXPLAIN [ANALYZE] <select>.  Carries the parsed inner select AND
+    its original text slice — federation and the query cache key on the
+    SQL text, so the explain path must hand them the text the planner
+    would have seen for a plain query."""
+    select: Select
+    analyze: bool = False
+    sql: str = ""             # inner SELECT text, sliced from the input
+
+
 class _Parser:
     def __init__(self, tokens: list[Token]):
         self.toks = tokens
@@ -366,11 +377,25 @@ def parse(sql: str) -> Select:
     return _Parser(tokenize(sql)).parse_select()
 
 
-def parse_statement(sql: str) -> Select | Show:
-    """Entry point that also accepts SHOW statements."""
+def parse_statement(sql: str) -> Select | Show | Explain:
+    """Entry point that also accepts SHOW and EXPLAIN statements."""
     toks = tokenize(sql)
     if toks and toks[0].kind == "kw" and toks[0].value == "SHOW":
         return _Parser(toks).parse_show()
+    # EXPLAIN/ANALYZE are not reserved words (they tokenize as idents so
+    # columns may use the names); only the statement head position is
+    # sniffed, exactly like real dialects treat soft keywords
+    if (toks and toks[0].kind == "ident"
+            and toks[0].value.upper() == "EXPLAIN"):
+        k = 1
+        analyze = (len(toks) > 1 and toks[1].kind == "ident"
+                   and toks[1].value.upper() == "ANALYZE")
+        if analyze:
+            k = 2
+        if k >= len(toks) or toks[k].kind == "eof":
+            raise SqlError("EXPLAIN needs a SELECT statement")
+        inner = _Parser(toks[k:]).parse_select()
+        return Explain(inner, analyze=analyze, sql=sql[toks[k].pos:])
     return _Parser(toks).parse_select()
 
 

@@ -139,6 +139,12 @@ def build_trace_from_spans(trace_id: str, span_dicts: list[dict],
         if key in seen:  # straggler rows can duplicate a span
             continue
         seen.add(key)
+        # query-trace spans (kind="query") carry their own attrs dict;
+        # flow spans get the classic flow identity pair
+        attrs = d.get("attrs")
+        if not isinstance(attrs, dict):
+            attrs = {"flow_id": d.get("flow_id", 0),
+                     "x_request_id": d.get("x_request_id", "")}
         spans.append(TraceSpan(
             span_id=d.get("span_id", ""),
             parent_span_id=d.get("parent_span_id", ""),
@@ -150,8 +156,8 @@ def build_trace_from_spans(trace_id: str, span_dicts: list[dict],
             status=str(d.get("status", "unknown")),
             response_code=int(d.get("response_code", 0)),
             ip_src=d.get("ip_src", ""), ip_dst=d.get("ip_dst", ""),
-            attrs={"flow_id": d.get("flow_id", 0),
-                   "x_request_id": d.get("x_request_id", "")},
+            kind=str(d.get("kind", "network")),
+            attrs=attrs,
         ))
     return _assemble(trace_id, spans, tpu_table, max_spans)
 

@@ -10,11 +10,13 @@ import json
 import logging
 import os
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import yaml
 
 from deepflow_tpu.query import engine as qengine
+from deepflow_tpu.query import qtrace
 from deepflow_tpu.query import sql as qsql
 from deepflow_tpu.query.flamegraph import profile_flame_tree
 from deepflow_tpu.store.db import Database
@@ -89,6 +91,24 @@ class QuerierAPI:
         # rest of the pipeline reports through (query.scan hop)
         from deepflow_tpu.query import engine as _qengine
         _qengine.set_scan_telemetry(telemetry)
+        # dogfooded query tracing: every served query writes its span
+        # tree into deepflow_system.query_trace through this tracer
+        # (query/qtrace.py); the sink is the system table itself, so the
+        # Tempo API + flame assembler render the querier's own internals
+        from deepflow_tpu.query import qtrace as _qtrace
+        self.qtracer = _qtrace.QueryTracer(
+            telemetry, service=f"deepflow-querier-{shard_id}",
+            shard_id=shard_id, sink=self._qtrace_sink)
+        # per-stage observed costs from EXPLAIN ANALYZE runs feed the
+        # same EWMA cost-model machinery the kernel/degree choosers use
+        from deepflow_tpu.query.costmodel import KernelCostModel
+        self.stage_cost = KernelCostModel(
+            ("parse", "plan", "execute", "scatter", "merge"))
+
+    def _qtrace_sink(self, spans: list[dict]) -> None:
+        from deepflow_tpu.query import qtrace as _qtrace
+        self.db.table("deepflow_system.query_trace") \
+            .append_rows(_qtrace.rows_from_spans(spans))
 
     def alerts_api(self, method: str, body: dict) -> dict:
         if self.alerts is None:
@@ -179,7 +199,12 @@ class QuerierAPI:
     def query(self, body: dict) -> dict:
         sql_text = body.get("sql", "")
         db_name = body.get("db", "")
+        # parse before the trace opens: it decides WHICH trace to open
+        # (EXPLAIN runs captured; SHOW is catalog introspection, never
+        # traced).  The parse cost is re-attributed as a span below.
+        t0, c0 = time.time_ns(), time.thread_time_ns()
         select = qsql.parse_statement(sql_text)
+        parse_t1, parse_c1 = time.time_ns(), time.thread_time_ns()
         if isinstance(select, qsql.Show):
             from deepflow_tpu.query import catalog
             try:
@@ -188,42 +213,188 @@ class QuerierAPI:
                 raise qengine.QueryError(
                     f"no such table {e.args[0]!r} for SHOW") from None
             return {"result": result, "debug": {"show": select.what}}
-        table = self._resolve_table(select.table, db_name)
+        if isinstance(select, qsql.Explain):
+            return self._explain(body, select, db_name)
+        with self.qtracer.start_trace("query", kind="sql",
+                                      sql=sql_text[:200]):
+            self._parse_span(t0, c0, parse_t1, parse_c1)
+            return self._run_select(body, select, sql_text, db_name)
+
+    @staticmethod
+    def _parse_span(t0: int, c0: int, t1: int, c1: int) -> None:
+        """Re-attribute a parse that happened just before the trace
+        opened (statement routing needs the AST first)."""
+        sp = qtrace.span("parse")
+        if isinstance(sp, qtrace.Span):
+            sp.start_ns, sp.cpu_start_ns = t0, c0
+            sp.end_ns = t1
+            sp.cpu_ns = c1 - c0
+            sp._buf.add(sp)
+
+    def _run_select(self, body: dict, select: qsql.Select, sql_text: str,
+                    db_name: str) -> dict:
+        """Plan + execute one SELECT (the body of ``query()``, shared
+        with the EXPLAIN path, running under whatever trace is open)."""
         org = body.get("org_id")
-        if org is not None:
-            self._org_scope(select, table, org)
-        fed = self._fed()
+        debug: dict = {}
+        with qtrace.span("plan") as pl:
+            table = self._resolve_table(select.table, db_name)
+            if org is not None:
+                self._org_scope(select, table, org)
+            fed = self._fed()
+            debug["table"] = table.name
+            sketch = None
+            if fed is None and self.rollup is not None:
+                # transparent rollup datasource selection: when the
+                # query is an aligned aggregate a coarser tier answers
+                # exactly, swap the table (rollup tables share column
+                # names — the SQL text itself is reusable verbatim, and
+                # the cache keys on the table object)
+                from deepflow_tpu.query import datasource as qds
+                sketch = qds.sketch_percentile(self.db, table, select,
+                                               self.rollup.horizons())
+                if sketch is None:
+                    picked = qds.select_rollup(self.db, table, select,
+                                               self.rollup.horizons())
+                    if picked is not None:
+                        table, info = picked
+                        debug["datasource"] = info
+                        debug["table"] = table.name
+            pl.annotate(table=debug["table"], federated=fed is not None,
+                        **({"datasource": str(debug["datasource"])}
+                           if "datasource" in debug else {}))
         if fed is not None:
-            result, info = fed.sql_query(table, select, sql_text,
-                                         org_id=org)
-            return {"result": result.to_dict(),
-                    "debug": {"table": table.name},
+            with qtrace.span("execute", path="federation") as ex:
+                result, info = fed.sql_query(table, select, sql_text,
+                                             org_id=org)
+                if isinstance(info, dict):
+                    ex.annotate(shards=int(info.get("shards", 1)),
+                                cache=str(info.get("cache", "")))
+            return {"result": result.to_dict(), "debug": debug,
                     "federation": info}
-        debug: dict = {"table": table.name}
-        # transparent rollup datasource selection: when the query is an
-        # aligned aggregate a coarser tier answers exactly, swap the
-        # table (rollup tables share column names — the SQL text itself
-        # is reusable verbatim, and the cache keys on the table object)
-        if self.rollup is not None:
-            from deepflow_tpu.query import datasource as qds
-            sk = qds.sketch_percentile(self.db, table, select,
-                                       self.rollup.horizons())
-            if sk is not None:
-                result, info = sk
-                debug["datasource"] = info
-                return {"result": result.to_dict(), "debug": debug}
-            picked = qds.select_rollup(self.db, table, select,
-                                       self.rollup.horizons())
-            if picked is not None:
-                table, info = picked
-                debug["datasource"] = info
-                debug["table"] = table.name
+        if sketch is not None:
+            result, info = sketch
+            debug["datasource"] = info
+            qtrace.annotate(datasource=str(info))
+            return {"result": result.to_dict(), "debug": debug}
         # org scoping rewrote the AST, not the text — fold it into the
         # cache key so scoped variants of one SQL string don't collide
-        result = self.query_cache.execute(
-            table, sql_text, select=select,
-            extra_key=None if org is None else ("org", org))
+        with qtrace.span("execute", path="local") as ex:
+            result = self.query_cache.execute(
+                table, sql_text, select=select,
+                extra_key=None if org is None else ("org", org))
+            ex.annotate(rows=len(result.values))
         return {"result": result.to_dict(), "debug": debug}
+
+    # -- EXPLAIN [ANALYZE] ---------------------------------------------------
+
+    def _explain(self, body: dict, stmt: qsql.Explain,
+                 db_name: str) -> dict:
+        """EXPLAIN: plan only (table/tier/datasource/federation route).
+        EXPLAIN ANALYZE: run the query under a CAPTURED trace and
+        annotate the plan with observed wall/CPU per stage; the observed
+        stage costs feed the stage cost model (query/costmodel.py)."""
+        root = self.qtracer.start_trace(
+            "query", kind="explain", sql=stmt.sql[:200], capture=True)
+        out = None
+        with root:
+            if stmt.analyze:
+                out = self._run_select(body, stmt.select, stmt.sql,
+                                       db_name)
+            else:
+                with qtrace.span("plan") as pl:
+                    table = self._resolve_table(stmt.select.table, db_name)
+                    org = body.get("org_id")
+                    if org is not None:
+                        self._org_scope(stmt.select, table, org)
+                    fed = self._fed()
+                    pl.annotate(table=table.name,
+                                federated=fed is not None)
+                    if fed is None and self.rollup is not None:
+                        from deepflow_tpu.query import datasource as qds
+                        picked = qds.select_rollup(self.db, table,
+                                                   stmt.select,
+                                                   self.rollup.horizons())
+                        if picked is not None:
+                            pl.annotate(datasource=str(picked[1]),
+                                        table=picked[0].name)
+        # E2E = the root span's wall (query work only; excludes the
+        # trace's own sink flush) — the number stage walls must sum to
+        total_ns = root.duration_ns
+        spans = root.trace_spans()
+        root_id = root.span_id
+        stages = []
+        for d in spans:
+            if d["parent_span_id"] != root_id:
+                continue
+            stages.append({"stage": d["name"],
+                           "wall_ms": round(d["duration_ns"] / 1e6, 3),
+                           "cpu_ms": round(d["cpu_ns"] / 1e6, 3),
+                           "status": d["status"],
+                           "detail": d["attrs"]})
+        stages.sort(key=lambda s: -s["wall_ms"])
+        plan: dict = {"analyze": stmt.analyze}
+        rows_out = 0
+        for d in spans:
+            a = d["attrs"]
+            nm = d["name"]
+            if nm == "plan":
+                plan.update({k: a[k] for k in
+                             ("table", "federated", "datasource")
+                             if k in a})
+            elif nm == "execute":
+                plan["path"] = a.get("path", "")
+                rows_out = int(a.get("rows", 0) or 0)
+                if "shards" in a:
+                    plan["shards"] = a["shards"]
+                if a.get("cache"):
+                    plan["scatter_cache"] = a["cache"]
+            elif nm.startswith("prune"):
+                pr = plan.setdefault("prune", {"candidates": 0,
+                                               "zone_pruned": 0,
+                                               "bloom_checked": 0,
+                                               "bloom_pruned": 0,
+                                               "scanned": 0})
+                for src, dst in (("candidates", "candidates"),
+                                 ("zone_pruned", "zone_pruned"),
+                                 ("bloom_checked", "bloom_checked"),
+                                 ("bloom_pruned", "bloom_pruned"),
+                                 ("scanned", "scanned")):
+                    pr[dst] += int(a.get(src, 0) or 0)
+            elif nm.startswith("scan"):
+                if "mode" in a:
+                    plan["scan_mode"] = a["mode"]
+                if "degree" in a:
+                    plan["morsel_degree"] = a["degree"]
+                if "morsels" in a:
+                    plan["morsels"] = a["morsels"]
+            elif nm == "cache.lookup":
+                plan["cache_layer"] = a.get("layer", "")
+                if "outcome" in a:
+                    plan["cache_outcome"] = a["outcome"]
+        if stmt.analyze:
+            # observed per-stage costs feed the same EWMA machinery the
+            # kernel/degree choosers learn from
+            for s in stages:
+                self.stage_cost.observe(s["stage"], max(rows_out, 1),
+                                        s["wall_ms"] * 1e6)
+        result_rows = [[s["stage"], s["wall_ms"], s["cpu_ms"],
+                        json.dumps(s["detail"], sort_keys=True,
+                                   default=str)]
+                       for s in stages]
+        explain = {"analyze": stmt.analyze, "trace_id": root.trace_id,
+                   "plan": plan, "stages": stages,
+                   "total_ms": round(total_ns / 1e6, 3),
+                   "spans": len(spans)}
+        if stmt.analyze:
+            explain["rows_returned"] = rows_out
+            if out is not None and "federation" in out:
+                explain["federation"] = out["federation"]
+        return {"result": {"columns": ["stage", "wall_ms", "cpu_ms",
+                                       "detail"],
+                           "values": result_rows},
+                "explain": explain,
+                "debug": {"explain": True, "table": plan.get("table", "")}}
 
     def profile_tracing(self, body: dict) -> dict:
         table = self.db.table("profile.in_process_profile")
@@ -600,14 +771,18 @@ class QuerierAPI:
         except ValueError as e:
             raise qengine.QueryError(f"bad time param: {e}")
         db = self._prom_db()
-        try:
-            ast = promql.parse(q)
-            if params.get("org_id") is not None:
-                promql.scope_to_org(ast, int(params["org_id"]))
-            result = promql.evaluate(db, ast, start, end, step)
-        except promql.PromqlError as e:
-            return {"status": "error", "errorType": "bad_data",
-                    "error": str(e)}
+        with self.qtracer.start_trace("query", kind="promql",
+                                      promql=q[:200]):
+            try:
+                ast = promql.parse(q)
+                if params.get("org_id") is not None:
+                    promql.scope_to_org(ast, int(params["org_id"]))
+                with qtrace.span("execute", path="promql_range",
+                                 step=step):
+                    result = promql.evaluate(db, ast, start, end, step)
+            except promql.PromqlError as e:
+                return {"status": "error", "errorType": "bad_data",
+                        "error": str(e)}
         return self._prom_annotate(
             {"status": "success",
              "data": {"resultType": "matrix", "result": result}}, db)
@@ -624,14 +799,17 @@ class QuerierAPI:
         except ValueError as e:
             raise qengine.QueryError(f"bad time param: {e}")
         db = self._prom_db()
-        try:
-            ast = promql.parse(q)
-            if params.get("org_id") is not None:
-                promql.scope_to_org(ast, int(params["org_id"]))
-            data = promql.evaluate_instant(db, ast, t)
-        except promql.PromqlError as e:
-            return {"status": "error", "errorType": "bad_data",
-                    "error": str(e)}
+        with self.qtracer.start_trace("query", kind="promql",
+                                      promql=q[:200]):
+            try:
+                ast = promql.parse(q)
+                if params.get("org_id") is not None:
+                    promql.scope_to_org(ast, int(params["org_id"]))
+                with qtrace.span("execute", path="promql_instant"):
+                    data = promql.evaluate_instant(db, ast, t)
+            except promql.PromqlError as e:
+                return {"status": "error", "errorType": "bad_data",
+                        "error": str(e)}
         return self._prom_annotate({"status": "success", "data": data}, db)
 
     def _prom_meta_args(self, params: dict) -> tuple:
@@ -769,6 +947,41 @@ class QuerierAPI:
                     tr["_root_t"] = t
                     tr["rootServiceName"] = svc or ""
                     tr["rootTraceName"] = f"{rtype} {ep}".strip() or tid
+        # dogfood: the querier's own query traces (self-monitoring
+        # store) surface through the SAME search API as workload traces
+        self.qtracer.flush()
+        qt = self.db.table("deepflow_system.query_trace")
+        if len(qt):
+            qres = qengine.execute(
+                qt, "SELECT time, trace_id, parent_span_id, name, "
+                    "service, duration_ns, status FROM t "
+                    "WHERE " + " AND ".join(where))
+            for t, tid, psid, name, svc, dur, status in qres.values:
+                t, dur = int(t), int(dur)
+                span_tags = {"service.name": svc or "", "endpoint": "",
+                             "l7.protocol": "query",
+                             "http.status_code": str(status)}
+                matched = all(span_tags.get(k) == v
+                              for k, v in tags.items())
+                tr = traces.get(tid)
+                if tr is None:
+                    tr = traces[tid] = {
+                        "traceID": tid, "_start_ns": t,
+                        "_end_ns": t + dur, "spanCount": 1,
+                        "rootServiceName": svc or "",
+                        "rootTraceName": name or tid,
+                        "_root_t": t, "_matched": matched}
+                else:
+                    tr["_start_ns"] = min(tr["_start_ns"], t)
+                    tr["_end_ns"] = max(tr["_end_ns"], t + dur)
+                    tr["spanCount"] += 1
+                    tr["_matched"] = tr["_matched"] or matched
+                if psid == "":
+                    # the coordinator root names the trace regardless
+                    # of span arrival order
+                    tr["_root_t"] = t
+                    tr["rootServiceName"] = svc or ""
+                    tr["rootTraceName"] = name or tid
         return list(traces.values())
 
     def tempo_search(self, params: dict) -> dict:
@@ -787,10 +1000,15 @@ class QuerierAPI:
                   if params.get("maxDuration") else 0)
         fed = self._fed()
         info = None
-        if fed is not None:
-            traces, info = fed.tempo_search(self._tempo_scan, params)
-        else:
-            traces = self._tempo_scan(params)
+        with self.qtracer.start_trace("query", kind="tempo",
+                                      tags=params.get("tags", "")):
+            if fed is not None:
+                with qtrace.span("execute", path="federation"):
+                    traces, info = fed.tempo_search(
+                        self._tempo_scan, params)
+            else:
+                with qtrace.span("execute", path="local"):
+                    traces = self._tempo_scan(params)
         out = []
         for tr in traces:
             if not tr["_matched"]:
@@ -922,6 +1140,38 @@ class QuerierAPI:
         if not spans:
             spans = scan_trace_spans(
                 db.table("flow_log.l7_flow_log"), trace_id)
+        # dogfooded query traces live in the self-monitoring store, NOT
+        # the flow store — union them so /api/traces and /v1/trace
+        # render the querier's own spans like any workload's
+        spans.extend(self._query_trace_spans(trace_id))
+        return spans
+
+    def _query_trace_spans(self, trace_id: str) -> list[dict]:
+        """This node's deepflow_system.query_trace span dicts for one
+        trace (+ the tracer's unflushed pending rows: read-your-writes
+        for a trace completed microseconds ago)."""
+        import numpy as np
+        qt = self.db.table("deepflow_system.query_trace")
+        code = qt.dicts["trace_id"].lookup(trace_id)
+        rows: list[dict] = []
+        if code is not None:
+            for ch in qt.snapshot():
+                if not ch:
+                    continue
+                for i in np.flatnonzero(ch["trace_id"] == code).tolist():
+                    row = {}
+                    for name, arr in ch.items():
+                        spec = qt.columns[name]
+                        v = arr[i]
+                        if spec.kind == "str":
+                            row[name] = qt.dicts[name].decode(int(v))
+                        elif spec.kind == "enum":
+                            row[name] = spec.enum_values[int(v)]
+                        else:
+                            row[name] = int(v)
+                    rows.append(row)
+        spans = qtrace.spans_from_rows(rows)
+        spans.extend(self.qtracer.pending_spans(trace_id))
         return spans
 
     def _assemble_trace(self, trace_id: str, max_spans: int = 1000) -> dict:
@@ -1156,6 +1406,16 @@ class QuerierAPI:
         # pre-replication coordinator sends no ring: raw local answer.
         from deepflow_tpu.cluster.hashring import claim_db_from_body
         db = claim_db_from_body(body, self.db, self.shard_id)
+        # a traced coordinator ships its trace context in the body: this
+        # shard's spans join the SAME trace, parented under the
+        # coordinator's scatter span, and land in the shard-local
+        # query_trace table — read-time trace assembly unions them
+        from deepflow_tpu.cluster import wire as _wire
+        with self.qtracer.adopt(_wire.extract_ctx(body), "shard.exec",
+                                op=op, shard=self.shard_id):
+            return self._shard_exec_op(body, db, op)
+
+    def _shard_exec_op(self, body: dict, db, op: str) -> dict:
         if op == "sql_partial":
             table = (db.table(body["table"]) if body.get("table")
                      else self._resolve_table("", ""))
@@ -1392,6 +1652,10 @@ class QuerierAPI:
             out["readtier"] = self.readtier.snapshot()
         if self.partial_cache is not None:
             out["partial_cache"] = self.partial_cache.snapshot()
+        # dogfooded query tracing: span counters + the query.trace hop
+        # ledger (emitted == delivered + dropped + pending holds, same
+        # conservation law as every frame hop)
+        out["query_trace"] = self.qtracer.snapshot()
         if self.publisher is not None:
             out["publish"] = dict(self.publisher.stats)
             out["publish"]["publish_gen"] = self.publisher.publish_gen

@@ -413,6 +413,23 @@ _table("deepflow_system.deepflow_system", [
     *UNIVERSAL_TAGS,
 ])
 
+# dogfooded query tracing: every query the querier serves writes its own
+# span tree here (query/qtrace.py), so the Tempo API and flame-graph
+# assembler render the querier's internals like any traced workload
+_table("deepflow_system.query_trace", [
+    C("time", "u64"),               # span start, epoch ns
+    C("trace_id", "str"),
+    C("span_id", "str"),
+    C("parent_span_id", "str"),
+    C("name", "str"),               # operation: query/scan/segcache.fetch...
+    C("service", "str"),            # deepflow-querier / deepflow-shard-N
+    C("duration_ns", "u64"),
+    C("cpu_ns", "u64"),
+    C("status", "str"),             # ok | error
+    C("attr_json", "str"),          # prune counts, cache layer, degree...
+    *UNIVERSAL_TAGS,
+])
+
 # -- telegraf / external metrics -------------------------------------------
 # reference: ingester/ext_metrics (telegraf influx line protocol ->
 # ext_metrics table); same shape as deepflow_system so the PromQL layer

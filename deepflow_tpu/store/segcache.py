@@ -40,6 +40,8 @@ import weakref
 from collections import OrderedDict, deque
 from collections.abc import Mapping
 
+from deepflow_tpu.query import qtrace
+
 log = logging.getLogger("df.segcache")
 
 
@@ -114,6 +116,7 @@ class SegmentCache:
                     ent["refs"] += 1
                     weakref.finalize(holder, _unpin, self, ent)
                     self.stats["hits"] += 1
+                    qtrace.bump("segcache_hits")
                     return ent
                 ev = self._inflight.get(key)
                 leader = ev is None
@@ -125,7 +128,11 @@ class SegmentCache:
                 ev.wait(timeout=60.0)
                 continue
             try:
-                ent = self._fetch(rseg)
+                # a miss is an objstore round-trip + mmap open: that
+                # latency belongs on the query's trace, named
+                with qtrace.span("segcache.fetch", table=rseg.table,
+                                 shard=rseg.shard, fn=rseg.fn):
+                    ent = self._fetch(rseg)
             except Exception:
                 with self._lock:
                     self._inflight.pop(key, None)

@@ -135,6 +135,13 @@ class HopLedger:
             if wait_ns is not None:
                 self.wait.observe(wait_ns, max(1, delivered or emitted))
 
+    def observe_wait(self, wait_ns: int, weight: int = 1) -> None:
+        """Record queue wait without moving the frame counters — for
+        callers that batch emitted/delivered accounting separately (the
+        query tracer defers its ledger off the query hot path)."""
+        with self._lock:
+            self.wait.observe(wait_ns, max(1, weight))
+
     def snapshot(self) -> dict:
         with self._lock:
             dropped_total = sum(self.dropped.values())
@@ -199,6 +206,9 @@ class _NullHop:
     def account(self, emitted: int = 0, delivered: int = 0,
                 dropped: int = 0, reason: str = "",
                 wait_ns: int | None = None) -> None:
+        pass
+
+    def observe_wait(self, wait_ns: int, weight: int = 1) -> None:
         pass
 
     def snapshot(self) -> dict:
