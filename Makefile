@@ -3,7 +3,7 @@ JAX_ENV := env JAX_PLATFORMS=cpu
 
 .PHONY: test selfmon-check cluster-check steps-check chaos-check ha-check \
 	query-check ingest-check storage-check compaction-check readtier-check \
-	trace-check bench native
+	trace-check overload-check bench native
 
 test:
 	timeout -k 10 870 $(JAX_ENV) $(PYTHON) -m pytest tests/ -q -m 'not slow' \
@@ -90,6 +90,14 @@ compaction-check:
 # conserved query.trace hop ledger on every node.
 trace-check:
 	timeout -k 10 120 $(JAX_ENV) $(PYTHON) -m deepflow_tpu.cli.trace_check
+
+# Closed-loop QoS gate: 3 tenants offer 10x their bulk quota through
+# the real server; exits non-zero on any HIGH-class loss, a tenant
+# starved or outside 2x of its weighted share, unbounded ingest p99,
+# an unattributed drop, an unbalanced hop ledger, or a pressure spike
+# that fails to raise-then-decay the advertised level.
+overload-check:
+	timeout -k 10 300 $(JAX_ENV) $(PYTHON) -m deepflow_tpu.cli.overload_check
 
 bench:
 	$(JAX_ENV) $(PYTHON) bench.py

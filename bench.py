@@ -214,7 +214,8 @@ def _run_ingest(make_frame, n_batches: int = 400,
                 workers: int | None = None,
                 selfmon: bool | None = None,
                 no_native: bool = False,
-                storage_dir: str | None = None) -> dict:
+                storage_dir: str | None = None,
+                qos: bool | None = None) -> dict:
     """Send n_batches pre-serialized frames through the real receiver ->
     decoder -> columnar store; returns rows/s plus the per-stage split
     (recv parse, payload decode, dictionary encode, store write) so the
@@ -228,11 +229,18 @@ def _run_ingest(make_frame, n_batches: int = 400,
     if no_native:
         os.environ["DF_NO_NATIVE"] = "1"
     try:
+        qos_config = None
+        if qos is False:
+            # explicit off arm: QoS is attached by default, so the
+            # overhead gate's baseline must disable the admission tier
+            from deepflow_tpu.qos import QosConfig
+            qos_config = QosConfig()
+            qos_config.enabled = False
         server = Server(host="127.0.0.1", ingest_port=0, query_port=0,
                         ingest_workers=workers, selfmon=selfmon,
                         data_dir=storage_dir,
                         storage=storage_dir is not None,
-                        flush_interval_s=0.2)
+                        flush_interval_s=0.2, qos_config=qos_config)
         server.start()
         try:
             frame, table_name, msg_type = make_frame()
@@ -327,6 +335,68 @@ def _bench_selfmon_overhead() -> dict:
         # perf guard in the same spirit as ingest/pps_below_target:
         # a telemetry-cost regression must be visible in-round
         "selfmon_overhead_above_gate": pct > 2.0,
+    }
+
+
+def _bench_qos_overhead() -> dict:
+    """QoS admission-tier overhead gate (deepflow_tpu/qos): with the
+    closed loop attached but NO pressure — no quotas, level 0, sample
+    rate 1.0 — the per-(org, class) fair-queuing tier between frame
+    parse and the decoder queues must cost <2% of ingest throughput.
+    Best-of-3 per arm, like the selfmon gate.
+
+    An overload arm rides along: raw frames/s through the real
+    AdmissionQueues with one uncontended tenant vs three weighted
+    tenants (4/2/1) fighting over the same drain — the DRR scheduling
+    cost under contention, isolated from decode/store."""
+    on = max(_run_ingest(_make_l4_frame, qos=True)["rows_per_sec"]
+             for _ in range(3))
+    off = max(_run_ingest(_make_l4_frame, qos=False)["rows_per_sec"]
+              for _ in range(3))
+    pct = (off - on) / off * 100.0 if off else 0.0
+
+    import threading
+
+    from deepflow_tpu.codec import MessageType
+    from deepflow_tpu.qos import AdmissionQueues, QosConfig, TenantQos
+
+    def admission_fps(orgs: dict[int, int]) -> float:
+        cfg = QosConfig(queue_frames=1 << 20)
+        for org, w in orgs.items():
+            cfg.set_tenant(TenantQos(org_id=org, weight=w))
+        done = threading.Event()
+        n_groups, group = 4000, [(None, b"")] * 8
+        total = len(orgs) * n_groups * len(group)
+        seen = [0]
+
+        def deliver(msg_type, lane, enq_ns, g):
+            seen[0] += len(g)
+            if seen[0] >= total:
+                done.set()
+            return True
+
+        aq = AdmissionQueues(cfg, deliver)
+        for g in range(n_groups):  # interleave tenants like real recv
+            for org in orgs:
+                aq.submit(org, 1, MessageType.METRICS, org, group, 0)
+        t0 = time.perf_counter()
+        aq.start()
+        done.wait(timeout=60)
+        dt = time.perf_counter() - t0
+        aq.stop()
+        return seen[0] / dt if dt else 0.0
+
+    solo = max(admission_fps({1: 1}) for _ in range(3))
+    contended = max(admission_fps({1: 4, 2: 2, 3: 1}) for _ in range(3))
+    return {
+        "qos_rows_per_sec_on": on,
+        "qos_rows_per_sec_off": off,
+        "qos_overhead_pct": round(max(0.0, pct), 2),
+        # the ISSUE's no-pressure gate: admission + pressure threads
+        # idling must be invisible at ingest rates
+        "qos_overhead_above_gate": pct > 2.0,
+        "qos_admission_fps_solo": round(solo),
+        "qos_admission_fps_contended": round(contended),
     }
 
 
@@ -1423,6 +1493,7 @@ def main() -> None:
     cpu_detail.update(_bench_packet_path())
     cpu_detail.update(_bench_ingest())
     cpu_detail.update(_bench_selfmon_overhead())
+    cpu_detail.update(_bench_qos_overhead())
     cpu_detail.update(_bench_transport())
     cpu_detail.update(_bench_steps())
     cpu_detail.update(_bench_federation())

@@ -86,6 +86,9 @@ class Agent:
         # serializes sampler/tpuprobe lifecycle across guard, synchronizer
         # and stats threads
         self._profiler_lock = threading.RLock()
+        # server-directed backpressure (qos/): last pressure level
+        # applied from a SyncResponse.qos directive (0 = nominal)
+        self.pressure_level = 0
 
     def _build_spool(self):
         sc = self.config.sender.spool
@@ -245,6 +248,45 @@ class Agent:
             if self.config.tpuprobe.enabled:
                 self.start_tpuprobe()
         self.start_extprofilers()
+
+    def apply_backpressure(self, level: int) -> None:
+        """Degrade gracefully under server-reported ingest pressure
+        (SyncResponse.qos): sampler hz shrinks, profile emit windows
+        widen (fewer, larger frames), HLO top-K narrows, trace captures
+        thin out — per-level factors from config.qos. Idempotent per
+        level; scales apply to the CONFIGURED values (never compounded),
+        so level 0 restores the baselines exactly."""
+        cfg = self.config
+        if not cfg.qos.enabled:
+            return
+        level = max(0, min(3, int(level)))
+        if level == self.pressure_level:
+            return
+        prev, self.pressure_level = self.pressure_level, level
+        trace_scale = cfg.qos.trace_scale[level]
+        with self._profiler_lock:
+            sampler = self.sampler
+            if sampler is not None:
+                hz = max(1.0, cfg.profiler.sample_hz
+                         * cfg.qos.hz_scale[level])
+                sampler.period_s = 1.0 / hz
+                sampler.period_us = int(1_000_000 / hz)
+                sampler.emit_interval_s = (cfg.profiler.emit_interval_s
+                                           * cfg.qos.emit_scale[level])
+            probe = self.tpuprobe
+            if probe is not None:
+                if probe.stepagg is not None:
+                    base = getattr(cfg.tpuprobe, "step_topk", 5)
+                    probe.stepagg.topk = max(
+                        1, int(base * cfg.qos.topk_scale[level]))
+                for src in probe.sources:
+                    if hasattr(src, "interval_s"):
+                        src.interval_s = (cfg.tpuprobe.trace_interval_s
+                                          * trace_scale)
+                    if hasattr(src, "steps_per_capture"):
+                        src.steps_per_capture = max(1, int(
+                            cfg.tpuprobe.steps_per_capture * trace_scale))
+        log.info("backpressure level %d -> %d", prev, level)
 
     def start(self) -> "Agent":
         plugins = getattr(self.config, "plugins", [])
@@ -490,6 +532,8 @@ class Agent:
                 "rss_mb": self.guard.rss_mb,
                 "degraded": int(self.guard.degraded),
                 **self.guard.stats})
+        if self.pressure_level:
+            metric("agent.qos", {"pressure_level": self.pressure_level})
         sync = getattr(self, "synchronizer", None)
         if sync is not None and sync.stats.get("ntp_syncs"):
             metric("agent.clock", {

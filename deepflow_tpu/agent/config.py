@@ -127,6 +127,26 @@ class ChaosConfig:
 
 
 @dataclass
+class BackpressureConfig:
+    """Server-directed degradation (deepflow_tpu/qos): each Sync/Push
+    response carries the ingest tier's pressure level for this agent's
+    org (0 nominal .. 3 critical); the agent scales its own emission
+    down by the level-indexed factors below. Level 0 restores the
+    configured baselines exactly."""
+    enabled: bool = True
+    # one factor per pressure level 0..3, applied to the CONFIGURED
+    # value (never compounded)
+    hz_scale: list = field(
+        default_factory=lambda: [1.0, 0.5, 0.25, 0.1])
+    emit_scale: list = field(
+        default_factory=lambda: [1.0, 1.0, 2.0, 4.0])
+    topk_scale: list = field(
+        default_factory=lambda: [1.0, 1.0, 0.5, 0.2])
+    trace_scale: list = field(
+        default_factory=lambda: [1.0, 1.0, 2.0, 4.0])
+
+
+@dataclass
 class SelfmonConfig:
     """Self-telemetry spine: frame ledger + heartbeats + deadman
     (deepflow_tpu/telemetry.py). Also disabled globally by
@@ -177,6 +197,7 @@ class AgentConfig:
     sender: SenderConfig = field(default_factory=SenderConfig)
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
     selfmon: SelfmonConfig = field(default_factory=SelfmonConfig)
+    qos: BackpressureConfig = field(default_factory=BackpressureConfig)
     stats_interval_s: float = 10.0
     sync_interval_s: float = 10.0
 
@@ -206,9 +227,11 @@ class AgentConfig:
             cfg.chaos = ChaosConfig(**d["chaos"])
         if isinstance(d.get("selfmon"), dict):
             cfg.selfmon = SelfmonConfig(**d["selfmon"])
+        if isinstance(d.get("qos"), dict):
+            cfg.qos = BackpressureConfig(**d["qos"])
         for f in dataclasses.fields(cls):
             if f.name in ("profiler", "tpuprobe", "guard", "integration",
-                          "flow", "sender", "chaos", "selfmon"):
+                          "flow", "sender", "chaos", "selfmon", "qos"):
                 continue
             if f.name in d:
                 setattr(cfg, f.name, d[f.name])
@@ -247,6 +270,18 @@ class AgentConfig:
             raise ValueError(
                 "sender.spool.segment_mb must be <= sender.spool.max_mb "
                 "(the cap must hold at least one segment)")
+        if not isinstance(self.qos.enabled, bool):
+            raise ValueError(
+                f"qos.enabled must be a bool, got {self.qos.enabled!r}")
+        for sname in ("hz_scale", "emit_scale", "topk_scale",
+                      "trace_scale"):
+            scales = getattr(self.qos, sname)
+            if not isinstance(scales, (list, tuple)) or len(scales) != 4:
+                raise ValueError(
+                    f"qos.{sname} must be 4 factors (levels 0..3), "
+                    f"got {scales!r}")
+            for i, v in enumerate(scales):
+                num(v, f"qos.{sname}[{i}]", 0.001, 1000)
         for p in ("conn_refuse", "conn_reset", "partial_write", "disk_full"):
             num(getattr(self.chaos, p), f"chaos.{p}", 0.0, 1.0)
         num(self.chaos.latency_ms, "chaos.latency_ms", 0)
@@ -350,6 +385,14 @@ _TEMPLATE_DOCS = {
     "selfmon.deadman_window_s": "flag a stage wedged after this many "
                                 "seconds without a heartbeat",
     "selfmon.check_interval_s": "deadman scan cadence; 0 = window/4",
+    "qos.enabled": "honor server backpressure directives "
+                   "(SyncResponse.qos pressure level 0..3)",
+    "qos.hz_scale": "profiler sample_hz factor per pressure level 0..3",
+    "qos.emit_scale": "profile emit-interval factor per level (bigger "
+                      "window = fewer, larger frames)",
+    "qos.topk_scale": "step-metrics HLO top-K factor per level",
+    "qos.trace_scale": "tpuprobe trace interval / steps-per-capture "
+                       "factor per level",
 }
 
 

@@ -338,12 +338,17 @@ class UniformSender:
             self._sock = None
         self._ackdec = StreamDecoder()
         # sent-but-unacked frames go back on the retransmit list: the
-        # server may or may not have them; dedup makes resending safe
+        # server may or may not have them; dedup makes resending safe.
+        # Class-major order (HIGH, then MID, then LOW; seq within a
+        # class): after an outage the profiles/spans the operator is
+        # debugging with arrive before bulk stats — dedup + per-frame
+        # seqs make out-of-seq delivery safe
         if self.durable and self._unacked:
-            backlog = sorted(self._unacked.values(), key=lambda f: f.seq)
+            backlog = list(self._unacked.values())
             self._unacked.clear()
-            self._pending = sorted(self._pending + backlog,
-                                   key=lambda f: f.seq)
+            self._pending = sorted(
+                self._pending + backlog,
+                key=lambda f: (priority_of(f.msg_type), f.seq))
 
     def _connect(self) -> bool:
         """Try servers round-robin starting at the current index."""
@@ -386,8 +391,11 @@ class UniformSender:
                 self._spool_replayed_through, seq)
         if fresh:
             self.stats["replayed"] += len(fresh)
-            self._pending = sorted(self._pending + fresh,
-                                   key=lambda f: f.seq)
+            # HIGH replays before MID/LOW (see _close): an outage must
+            # not make bulk stats queue ahead of profile/span frames
+            self._pending = sorted(
+                self._pending + fresh,
+                key=lambda f: (priority_of(f.msg_type), f.seq))
 
     # -- seq-base announcements ----------------------------------------------
 

@@ -274,6 +274,16 @@ class Synchronizer:
                 self.platform_version = resp.platform_version
             if resp.analyzer_assignment:
                 self._apply_analyzers(list(resp.analyzer_addrs))
+            if resp.HasField("qos"):
+                # closed-loop backpressure: the server's per-tenant
+                # pressure level rides every Sync/Push response
+                try:
+                    self.agent.apply_backpressure(
+                        int(resp.qos.pressure_level))
+                except Exception:
+                    log.exception("backpressure apply failed")
+                self.stats["pressure_level"] = \
+                    int(resp.qos.pressure_level)
         for rc in resp.commands:
             code, out = self._ops.run(rc.cmd, list(rc.args))
             with self._results_lock:
@@ -318,6 +328,7 @@ class Synchronizer:
         cfg.stats_interval_s = new.stats_interval_s
         cfg.guard = new.guard
         cfg.acls = new.acls
+        cfg.qos = new.qos
         labeler = getattr(self.agent, "labeler", None)
         if labeler is not None:  # pushed ACLs take effect live
             from deepflow_tpu.agent.labeler import AclRule

@@ -161,6 +161,18 @@ def main(argv: list[str] | None = None) -> int:
     p_org.add_argument("--assign", nargs=2, metavar=("GROUP", "ORG_ID"),
                        default=None)
 
+    p_qos = sub.add_parser(
+        "qos", help="multi-tenant overload control: per-tenant "
+                    "weights/quotas/pressure levels, admission + "
+                    "sampling counters; --set hot-applies a tenant "
+                    "policy")
+    p_qos.add_argument("--set", nargs="+", metavar="ORG_ID KEY=VAL",
+                       default=None,
+                       help="set tenant knobs: ORG_ID then one or more "
+                            "of weight=N | rate_fps=F | burst=F")
+    p_qos.add_argument("--json", action="store_true",
+                       help="raw /v1/qos JSON")
+
     p_repo = sub.add_parser("repo", help="agent package repo for OTA "
                                          "rollout (upload/list)")
     p_repo.add_argument("action", choices=["upload", "list"])
@@ -717,6 +729,59 @@ def main(argv: list[str] | None = None) -> int:
         print_table(["GROUP", "ORG_ID"],
                     [[g, o] for g, o in rows] or
                     [["(all groups)", out["default_org"]]])
+    elif args.cmd == "qos":
+        body = {"action": "list"}
+        if args.set:
+            if len(args.set) < 2:
+                raise SystemExit(
+                    "qos: --set takes ORG_ID then one or more KEY=VAL")
+            org_raw, kvs = args.set[0], args.set[1:]
+            try:
+                org_id = int(org_raw)
+            except ValueError:
+                raise SystemExit(
+                    f"qos: ORG_ID must be an integer, got {org_raw!r}")
+            body = {"action": "set", "org_id": org_id}
+            for kv in kvs:
+                key, sep, val = kv.partition("=")
+                if not sep or key not in ("weight", "rate_fps", "burst"):
+                    raise SystemExit(
+                        "qos: --set takes weight=N | rate_fps=F | burst=F")
+                try:
+                    body[key] = float(val)
+                except ValueError:
+                    raise SystemExit(
+                        f"qos: {key} must be a number, got {val!r}")
+        out = _api(args.server, "/v1/qos", body)
+        if args.json:
+            print(json.dumps(out, indent=2))
+            return 0
+        if not out.get("enabled"):
+            print("(qos disabled — DF_NO_QOS set, enabled: false, or "
+                  "a --role=querier replica)")
+            return 0
+        pressure = out.get("pressure", {})
+        levels = pressure.get("levels", {})
+        sampling = out.get("sampling", {})
+        rows = []
+        for org, t in sorted(out.get("tenants", {}).items(),
+                             key=lambda kv_: int(kv_[0])):
+            s = sampling.get(str(org), {})
+            d = t.get("depth", {})
+            rows.append([
+                org, t.get("weight", 1), t.get("rate_fps", 0) or "-",
+                levels.get(str(org), 0),
+                f"{s.get('rate', 1.0):.2f}",
+                t.get("admitted", 0), t.get("delivered", 0),
+                t.get("shed_quota", 0), t.get("shed_queue_full", 0),
+                f"{d.get('high', 0)}/{d.get('mid', 0)}/{d.get('low', 0)}",
+            ])
+        print(f"global pressure level: "
+              f"{pressure.get('global_level', 0)}")
+        print_table(["ORG", "WEIGHT", "RATE_FPS", "LEVEL", "SAMPLE",
+                     "ADMITTED", "DELIVERED", "SHED_QUOTA",
+                     "SHED_QFULL", "DEPTH H/M/L"],
+                    rows or [["(no tenant traffic yet)"] + [""] * 9])
     elif args.cmd == "repo":
         if args.action == "upload":
             if not args.file or not args.version:

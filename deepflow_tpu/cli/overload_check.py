@@ -1,0 +1,316 @@
+"""overload-check: closed-loop QoS gate under 10x synthetic overload.
+
+Three phases over the deepflow_tpu/qos subsystem (wired as
+`make overload-check`); any violated invariant exits non-zero:
+
+  A. END-TO-END OVERLOAD (real server + 3 durable senders, one per
+     tenant): each tenant offers bulk DFSTATS at ~10x its configured
+     frames-per-second quota while a HIGH-class STEP_METRICS stream
+     rides along.  Fails unless:
+       * zero HIGH-class loss — every STEP_METRICS row lands in the
+         store exactly once (quota never sheds HIGH, and pressure
+         sheds withhold the ack so the durable sender retransmits);
+       * every tenant's bulk overage is shed as dropped(quota) and the
+         per-tenant counters account every admitted frame (admission's
+         view and the receiver's drop attribution agree);
+       * no tenant is starved (every tenant lands bulk rows);
+       * ingest p99 ack latency stays bounded under the overload;
+       * every hop ledger (3 senders + server) balances:
+         emitted == delivered + dropped(reason) + in_flight.
+
+  B. WEIGHTED FAIRNESS (real AdmissionQueues, metered drain): tenants
+     weighted 4/2/1 pre-backlog 10x what the metered drain can move in
+     the window.  Fails unless each tenant's delivered share is within
+     2x of its configured weight share, no tenant is starved, and
+     every tenant's HIGH frames clear before its bulk (strict class
+     priority inside a tenant).
+
+  C. CLOSED LOOP (Qos facade, live pressure thread): a forced decoder
+     -fill spike must raise the pressure level within one interval and
+     cut the advertised head-sampling rate below 1; releasing the
+     spike must decay the level back to nominal one notch per decay_s.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+
+
+def _fail(msg: str) -> None:
+    print(f"overload-check: FAIL: {msg}")
+    sys.exit(1)
+
+
+def _check_ledgers(telemetry, who: str) -> None:
+    for h in telemetry.snapshot()["pipeline"]:
+        if h["emitted"] != h["delivered"] + h["dropped_total"] \
+                + h["in_flight"]:
+            _fail(f"{who} hop {h['hop']!r} ledger does not balance: {h}")
+
+
+MS = 1_000_000
+WEIGHTS = {1: 4, 2: 2, 3: 1}
+N_HIGH = 100        # STEP_METRICS frames per tenant
+BULK_PER_HIGH = 10  # DFSTATS frames interleaved per HIGH frame
+QUOTA_FPS = 40.0    # bulk quota; offered bulk rate is far above 10x this
+
+
+def _step_payload(org: int, i: int) -> bytes:
+    from deepflow_tpu.tpuprobe.stepmetrics import encode_step_payload
+    return encode_step_payload([{
+        "time": i * MS, "end_ns": i * MS + 500, "latency_ns": 500,
+        "run_id": org, "step": i, "job": f"overload-{org}",
+        "device_count": 4, "device_skew_ns": 0, "compute_ns": 1,
+        "collective_ns": 1, "straggler_device": 0, "straggler_lag_ns": 0,
+        "top_hlos": []}])
+
+
+def _stats_payload() -> bytes:
+    from deepflow_tpu.proto import pb
+    batch = pb.StatsBatch()
+    m = batch.metrics.add()
+    m.name = "overload_check_bulk"
+    m.timestamp_ns = time.time_ns()
+    m.values["v"] = 1.0
+    return batch.SerializeToString()
+
+
+class _AckLatency:
+    """p99 send->ack latency via the sender's contiguous watermark:
+    seqs are allocated in send order, so when the watermark advances to
+    frame k every frame up to k is acked."""
+
+    def __init__(self, sender):
+        self.sender = sender
+        self.send_times: list[float] = []
+        self.latencies: list[float] = []
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def sent(self) -> None:
+        self.send_times.append(time.monotonic())
+
+    def _run(self) -> None:
+        done = 0
+        while not self._stop.is_set():
+            acked = self.sender.stats["acked_seq"] - self.sender.seq_base
+            now = time.monotonic()
+            while done < min(acked, len(self.send_times)):
+                self.latencies.append(now - self.send_times[done])
+                done += 1
+            time.sleep(0.005)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+
+
+def _p99(xs: list[float]) -> float:
+    if not xs:
+        return 0.0
+    return sorted(xs)[min(len(xs) - 1, int(len(xs) * 0.99))]
+
+
+def _phase_a() -> None:
+    from deepflow_tpu.agent.sender import UniformSender
+    from deepflow_tpu.codec import MessageType
+    from deepflow_tpu.qos import QosConfig, TenantQos
+    from deepflow_tpu.server import Server
+    from deepflow_tpu.telemetry import Telemetry
+
+    cfg = QosConfig()
+    for org, w in WEIGHTS.items():
+        cfg.set_tenant(TenantQos(org_id=org, weight=w,
+                                 rate_fps=QUOTA_FPS, burst=QUOTA_FPS))
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0,
+                    qos_config=cfg).start()
+    senders, lats, tels = {}, {}, {}
+    try:
+        for org in WEIGHTS:
+            tels[org] = Telemetry("agent", enabled=True)
+            senders[org] = UniformSender(
+                [("127.0.0.1", server.ingest_port)], agent_id=org,
+                org_id=org, telemetry=tels[org]).start()
+            lats[org] = _AckLatency(senders[org])
+        t0 = time.monotonic()
+        for i in range(1, N_HIGH + 1):
+            for org, s in senders.items():
+                s.send(MessageType.STEP_METRICS, _step_payload(org, i))
+                lats[org].sent()
+                for _ in range(BULK_PER_HIGH):
+                    s.send(MessageType.DFSTATS, _stats_payload())
+                    lats[org].sent()
+            time.sleep(0.002)
+        offered_s = time.monotonic() - t0
+        offered_fps = N_HIGH * BULK_PER_HIGH / offered_s
+        if offered_fps < 10 * QUOTA_FPS:
+            _fail(f"phase A offered only {offered_fps:.0f} bulk fps per "
+                  f"tenant — not a 10x overload of quota {QUOTA_FPS}")
+        for s in senders.values():
+            s.flush_and_stop(timeout=60.0)
+
+        # zero HIGH-class loss, exactly once
+        want = len(WEIGHTS) * N_HIGH
+        if not server.wait_for_rows("profile.tpu_step_metrics", want,
+                                    timeout=30.0):
+            got = len(server.db.table("profile.tpu_step_metrics"))
+            _fail(f"HIGH loss under overload: {got}/{want} "
+                  f"STEP_METRICS rows")
+        time.sleep(0.5)
+        table = server.db.table("profile.tpu_step_metrics")
+        table.flush()
+        cols = table.column_concat(["run_id", "step"])
+        keys = list(zip(cols["run_id"].tolist(), cols["step"].tolist()))
+        if len(keys) != want or len(set(keys)) != want:
+            _fail(f"HIGH not exactly-once: {len(keys)} rows, "
+                  f"{len(set(keys))} unique of {want}")
+
+        tenants = server.qos.admission.tenant_snapshot()
+        drops = server.receiver.drop_attribution()["by_org"]
+        for org in WEIGHTS:
+            t = tenants.get(org)
+            if t is None:
+                _fail(f"tenant {org} never reached admission")
+            if t["shed_quota"] <= 0:
+                _fail(f"tenant {org} offered 10x quota but shed nothing: "
+                      f"{t}")
+            if t["delivered"] <= N_HIGH:
+                _fail(f"tenant {org} starved: only {t['delivered']} "
+                      f"frames delivered (HIGH alone is {N_HIGH})")
+            att = drops.get(str(org), {}).get("quota", 0)
+            if att != t["shed_quota"]:
+                _fail(f"tenant {org} drop attribution disagrees with "
+                      f"admission: {att} != {t['shed_quota']}")
+            p99 = _p99(lats[org].latencies)
+            if p99 > 10.0:
+                _fail(f"tenant {org} ingest p99 ack latency unbounded "
+                      f"under overload: {p99:.2f}s")
+        for org, tel in tels.items():
+            _check_ledgers(tel, f"sender-{org}")
+        _check_ledgers(server.telemetry, "server")
+        shed = sum(t["shed_quota"] for t in tenants.values())
+        p99s = {o: round(_p99(v.latencies), 3) for o, v in lats.items()}
+        print(f"overload-check: phase A OK — {want}/{want} HIGH exactly "
+              f"once at ~{offered_fps:.0f} bulk fps/tenant (quota "
+              f"{QUOTA_FPS:.0f}), {shed} bulk frames quota-shed and "
+              f"conserved, ack p99 by tenant {p99s}")
+    finally:
+        for latw in lats.values():
+            latw.stop()
+        for s in senders.values():
+            s.flush_and_stop(timeout=1.0)
+        server.stop()
+
+
+def _phase_b() -> None:
+    from deepflow_tpu.codec import MessageType
+    from deepflow_tpu.qos import AdmissionQueues, QosConfig, TenantQos
+
+    cfg = QosConfig(queue_frames=100_000)
+    for org, w in WEIGHTS.items():
+        cfg.set_tenant(TenantQos(org_id=org, weight=w))
+    capacity_fps = 4000.0
+    window_frames = 4000
+    backlog = 10 * window_frames // len(WEIGHTS)   # 10x per tenant
+    delivered: dict[int, dict[str, int]] = {
+        org: {"high": 0, "bulk": 0} for org in WEIGHTS}
+    total = {"n": 0}
+    lock = threading.Lock()
+
+    def metered_deliver(msg_type, lane, enq_ns, group):
+        # lane carries the org; sleeping here is the drain capacity cap
+        with lock:
+            if total["n"] >= window_frames:
+                return True  # window over: swallow the rest instantly
+            cls = "high" if msg_type == MessageType.STEP_METRICS \
+                else "bulk"
+            delivered[lane][cls] += len(group)
+            total["n"] += len(group)
+        time.sleep(len(group) / capacity_fps)
+        return True
+
+    aq = AdmissionQueues(cfg, metered_deliver)
+    n_high = 64
+    for org in WEIGHTS:
+        aq.submit(org, 0, MessageType.STEP_METRICS, org,
+                  [(None, b"")] * n_high, 0)
+        for _ in range((backlog - n_high) // 8):
+            aq.submit(org, 2, MessageType.DFSTATS, org,
+                      [(None, b"")] * 8, 0)
+    aq.start()
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        with lock:
+            if total["n"] >= window_frames:
+                break
+        time.sleep(0.01)
+    aq.stop()
+    with lock:
+        counted = {org: d["high"] + d["bulk"]
+                   for org, d in delivered.items()}
+        n = sum(counted.values())
+    wsum = sum(WEIGHTS.values())
+    for org, w in WEIGHTS.items():
+        if delivered[org]["high"] != n_high:
+            _fail(f"tenant {org} HIGH not fully drained inside the "
+                  f"contended window: {delivered[org]}")
+        share, want = counted[org] / n, w / wsum
+        if not want / 2 <= share <= want * 2:
+            _fail(f"tenant {org} delivered share {share:.3f} outside "
+                  f"2x of weight share {want:.3f} ({counted})")
+    shares = {o: round(counted[o] / n, 3) for o in WEIGHTS}
+    print(f"overload-check: phase B OK — DRR shares {shares} vs "
+          f"weights {WEIGHTS} over {n} contended frames, HIGH first")
+
+
+def _phase_c() -> None:
+    from deepflow_tpu.qos import Qos, QosConfig
+
+    cfg = QosConfig(interval_s=0.05, decay_s=0.2)
+    fill = {"v": 0.0}
+    qos = Qos(cfg)
+    qos.attach(lambda *a: True, decoder_fill=lambda: fill["v"])
+    qos.start()
+    try:
+        fill["v"] = 0.95
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline \
+                and qos.pressure.level(0) < 3:
+            time.sleep(0.01)
+        if qos.pressure.level(0) != 3:
+            _fail("pressure never reached critical under a 0.95 "
+                  f"decoder-fill spike: {qos.pressure.snapshot()}")
+        d = qos.directive(7)
+        if d["pressure_level"] != 3 or d["sample_rate"] >= 1.0:
+            _fail(f"directive does not reflect the spike: {d}")
+        if qos.sampler.rate_for(7) >= 1.0:
+            _fail("adaptive sampler still at full rate under critical "
+                  "pressure")
+        fill["v"] = 0.0
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and qos.pressure.level(0) > 0:
+            time.sleep(0.02)
+        if qos.pressure.level(0) != 0:
+            _fail(f"pressure never decayed back to nominal: "
+                  f"{qos.pressure.snapshot()}")
+        snap = qos.pressure.snapshot()
+        print(f"overload-check: phase C OK — spike raised to critical "
+              f"and decayed to nominal (raises={snap['raises']}, "
+              f"decays={snap['decays']})")
+    finally:
+        qos.stop()
+
+
+def main() -> int:
+    _phase_a()
+    _phase_b()
+    _phase_c()
+    print("overload-check: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

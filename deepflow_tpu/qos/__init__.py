@@ -1,0 +1,108 @@
+"""Closed-loop overload control & multi-tenant QoS (ROADMAP item 1).
+
+The loop, end to end:
+
+    agents --frames--> Receiver --submit--> AdmissionQueues (per-(org,
+    class) queues, token buckets, DRR drain) --> decoder queues
+                                  |
+    PressureController <-- depths + decoder fill + flusher backlog +
+                           ledger imbalance
+          |
+    Controller.Sync stamps SyncResponse.qos (per-tenant level 0..3)
+          |
+    agents degrade gracefully (sampler_hz, top-K HLO depth, batch
+    sizes) and the AdaptiveSampler head-samples bulk flow/L7 records
+    server-side, exemplars always kept, every decision ledgered.
+
+``Qos`` below is the facade the server constructs once and shares with
+the receiver (admission), the controller (directives), the decoders
+(sampler) and the querier (health/dfctl surfaces).  DF_NO_QOS=1 or
+``enabled: false`` turns the whole subsystem off — the receiver then
+dispatches exactly as before this subsystem existed.
+"""
+
+from __future__ import annotations
+
+from deepflow_tpu.qos.admission import AdmissionQueues, TokenBucket
+from deepflow_tpu.qos.config import (
+    PRESSURE_CRITICAL, PRESSURE_HIGH, PRESSURE_MILD, PRESSURE_NOMINAL,
+    QOS_DISABLED, QosConfig, TenantQos, sample_rate_for)
+from deepflow_tpu.qos.pressure import PressureController
+from deepflow_tpu.qos.sampling import AdaptiveSampler, sample_hash01
+
+__all__ = [
+    "AdaptiveSampler", "AdmissionQueues", "PressureController",
+    "PRESSURE_CRITICAL", "PRESSURE_HIGH", "PRESSURE_MILD",
+    "PRESSURE_NOMINAL", "QOS_DISABLED", "Qos", "QosConfig", "TenantQos",
+    "TokenBucket", "sample_hash01", "sample_rate_for",
+]
+
+
+class Qos:
+    """Everything the server needs in one object.  Construction wires
+    nothing — ``attach()`` is called once the receiver/decoder plumbing
+    exists, ``start()``/``stop()`` bracket the drain + pressure threads."""
+
+    def __init__(self, config: QosConfig | None = None,
+                 telemetry=None) -> None:
+        self.config = config or QosConfig()
+        self.enabled = bool(self.config.enabled) and not QOS_DISABLED
+        self.telemetry = telemetry
+        self.admission: AdmissionQueues | None = None
+        self.pressure: PressureController | None = None
+        self.sampler: AdaptiveSampler | None = None
+
+    def attach(self, deliver, hop=None, observe_seqs=None,
+               decoder_fill=None, flusher_backlog=None) -> "Qos":
+        self.admission = AdmissionQueues(
+            self.config, deliver, hop=hop, observe_seqs=observe_seqs)
+        self.pressure = PressureController(
+            self.config, admission=self.admission,
+            telemetry=self.telemetry, decoder_fill=decoder_fill,
+            flusher_backlog=flusher_backlog)
+        self.sampler = AdaptiveSampler(
+            self.config, pressure=self.pressure, telemetry=self.telemetry)
+        return self
+
+    def start(self) -> "Qos":
+        if self.admission is not None:
+            self.admission.start()
+        if self.pressure is not None:
+            self.pressure.start()
+        return self
+
+    def stop(self) -> None:
+        if self.admission is not None:
+            self.admission.drain_now()
+            self.admission.stop()
+        if self.pressure is not None:
+            self.pressure.stop()
+
+    def directive(self, org_id: int) -> dict | None:
+        if not self.enabled or self.pressure is None:
+            return None
+        return self.pressure.directive(org_id)
+
+    def reconfigure(self, config: QosConfig) -> None:
+        """Hot-apply a new tenant table (dfctl qos set)."""
+        self.config = config
+        if self.admission is not None:
+            self.admission.reconfigure(config)
+        if self.pressure is not None:
+            self.pressure.config = config
+        if self.sampler is not None:
+            self.sampler.config = config
+
+    def snapshot(self) -> dict:
+        """The /v1/health qos block."""
+        out: dict = {"enabled": self.enabled}
+        if not self.enabled:
+            return out
+        if self.admission is not None:
+            out["tenants"] = self.admission.tenant_snapshot()
+            out["admission"] = dict(self.admission.stats)
+        if self.pressure is not None:
+            out["pressure"] = self.pressure.snapshot()
+        if self.sampler is not None:
+            out["sampling"] = self.sampler.snapshot()
+        return out

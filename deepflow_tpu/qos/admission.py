@@ -1,0 +1,339 @@
+"""Admission & fair queuing in front of the decoder queues.
+
+Reference analog: server/ingester/droplet-queue's per-module queues plus
+the throttling in server/ingester/flow_log — reshaped into explicit
+multi-tenant scheduling: per-(org_id, priority-class) queues drained by
+deficit-weighted round-robin, fronted by per-tenant token buckets.
+
+Invariants (the overload gate in cli/overload_check.py asserts all
+three):
+
+* HIGH-class frames are never shed by quota.  Over-quota HIGH either
+  waits briefly for space (TCP backpressure through the handler thread)
+  or is dropped UNACKED with reason ``queue_full`` — the durable sender
+  retransmits, so end-to-end HIGH loss stays zero.
+* MID/LOW over quota are shed immediately with reason ``quota`` and the
+  seqs ARE observed (acked): a quota shed is policy, not pressure — a
+  retransmit would meet the same fate, so retransmitting it forever
+  would defeat the quota.
+* Every admission decision lands on the receiver's hop ledger, so
+  ``emitted == delivered + dropped + in_flight`` keeps holding with the
+  admission tier in the middle (in_flight = frames parked here).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+from deepflow_tpu.codec import PRIORITY_HIGH, PRIORITY_LOW
+
+_CLASSES = (0, 1, 2)  # PRIORITY_HIGH, PRIORITY_MID, PRIORITY_LOW
+
+
+class TokenBucket:
+    """Monotonic-clock token bucket; ``take`` is all-or-nothing."""
+
+    __slots__ = ("rate", "burst", "_tokens", "_last", "_lock")
+
+    def __init__(self, rate_fps: float, burst: float = 0.0) -> None:
+        self._lock = threading.Lock()
+        self.reconfigure(rate_fps, burst)
+
+    def reconfigure(self, rate_fps: float, burst: float = 0.0) -> None:
+        with getattr(self, "_lock", threading.Lock()):
+            self.rate = max(0.0, rate_fps)
+            # default depth: 2 seconds of refill (absorbs sender batching)
+            self.burst = burst if burst > 0 else max(64.0, 2.0 * self.rate)
+            self._tokens = self.burst
+            self._last = time.monotonic()
+
+    def take(self, n: int) -> bool:
+        if self.rate <= 0:
+            return True  # unlimited
+        with self._lock:
+            now = time.monotonic()
+            self._tokens = min(self.burst,
+                               self._tokens + (now - self._last) * self.rate)
+            self._last = now
+            if self._tokens >= n:
+                self._tokens -= n
+                return True
+            return False
+
+
+class _Tenant:
+    """One org's admission state: 3 class queues + bucket + DRR deficit."""
+
+    __slots__ = ("org_id", "weight", "bucket", "queues", "depth",
+                 "deficit", "stats")
+
+    def __init__(self, org_id: int, weight: int,
+                 bucket: TokenBucket | None) -> None:
+        self.org_id = org_id
+        self.weight = max(1, weight)
+        self.bucket = bucket
+        # entries: (enq_ns, msg_type, lane, group, nframes)
+        self.queues: dict[int, deque] = {c: deque() for c in _CLASSES}
+        self.depth: dict[int, int] = {c: 0 for c in _CLASSES}
+        self.deficit = 0
+        self.stats = {"admitted": 0, "delivered": 0, "shed_quota": 0,
+                      "shed_queue_full": 0, "high_wait_ns": 0}
+
+    def total_depth(self) -> int:
+        return self.depth[0] + self.depth[1] + self.depth[2]
+
+
+class AdmissionQueues:
+    """The fair-queuing tier between frame parse and the decoder queues.
+
+    ``submit()`` runs on receiver handler threads; one drain thread
+    moves admitted groups into the real per-message-type decoder queues
+    via the ``deliver`` callback in deficit-weighted round-robin order
+    (strict HIGH > MID > LOW within a tenant)."""
+
+    def __init__(self, config, deliver, hop=None,
+                 observe_seqs=None) -> None:
+        """deliver(msg_type, lane, enq_ns, group) -> bool: push one group
+        into its decoder queue; False means that queue is full right now.
+        hop: the receiver's HopLedger (delivered/dropped accounting moves
+        here when admission is in the middle).  observe_seqs(group):
+        mark policy-shed seqs handled so they still get acked."""
+        self.config = config
+        self._deliver = deliver
+        self._hop = hop
+        self._observe_seqs = observe_seqs
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._tenants: dict[int, _Tenant] = {}
+        self._order: list[int] = []   # DRR visiting order (insertion)
+        self._rr = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.stats = {"submitted": 0, "delivered": 0, "shed_quota": 0,
+                      "shed_queue_full": 0, "decoder_stalls": 0}
+
+    # -- config ---------------------------------------------------------------
+
+    def _tenant(self, org_id: int) -> _Tenant:
+        t = self._tenants.get(org_id)
+        if t is None:
+            tq = self.config.tenant(org_id)
+            bucket = (TokenBucket(tq.rate_fps, tq.burst)
+                      if tq.rate_fps > 0 else None)
+            t = _Tenant(org_id, tq.weight, bucket)
+            self._tenants[org_id] = t
+            self._order.append(org_id)
+        return t
+
+    def reconfigure(self, config) -> None:
+        """Hot-apply a new tenant table (dfctl qos set / controller)."""
+        with self._lock:
+            self.config = config
+            for org_id, t in self._tenants.items():
+                tq = config.tenant(org_id)
+                t.weight = max(1, tq.weight)
+                if tq.rate_fps > 0:
+                    if t.bucket is None:
+                        t.bucket = TokenBucket(tq.rate_fps, tq.burst)
+                    else:
+                        t.bucket.reconfigure(tq.rate_fps, tq.burst)
+                else:
+                    t.bucket = None
+
+    # -- producer side (receiver handler threads) ----------------------------
+
+    def submit(self, org_id: int, prio: int, msg_type, lane: int,
+               group: list, enq_ns: int) -> str:
+        """Admit one same-(org, msg_type) group.  Returns the decision:
+        ``admitted`` | ``quota`` (policy shed, acked) | ``queue_full``
+        (pressure shed, unacked -> retransmit)."""
+        n = len(group)
+        self.stats["submitted"] += n
+        with self._cond:
+            t = self._tenant(org_id)
+            # quota applies to MID/LOW only; HIGH backpressures instead
+            if prio != PRIORITY_HIGH and t.bucket is not None \
+                    and not t.bucket.take(n):
+                t.stats["shed_quota"] += n
+                self.stats["shed_quota"] += n
+                if self._hop is not None:
+                    self._hop.account(dropped=n, reason="quota")
+                if self._observe_seqs is not None:
+                    self._observe_seqs(group)
+                return "quota"
+            limit = self.config.queue_frames
+            if t.depth[prio] + n > limit:
+                if prio == PRIORITY_HIGH:
+                    # bounded wait for the drain to free space: this IS
+                    # the backpressure (the handler thread stalls, TCP
+                    # windows close, the sender sees a slow socket)
+                    deadline = time.monotonic() + self.config.high_block_s
+                    t0 = time.monotonic_ns()
+                    while t.depth[prio] + n > limit \
+                            and not self._stop.is_set():
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        self._cond.wait(remaining)
+                    t = self._tenant(org_id)  # re-fetch under lock
+                    t.stats["high_wait_ns"] += time.monotonic_ns() - t0
+                if t.depth[prio] + n > limit:
+                    t.stats["shed_queue_full"] += n
+                    self.stats["shed_queue_full"] += n
+                    if self._hop is not None:
+                        self._hop.account(dropped=n, reason="queue_full")
+                    # NOT observed: ack withheld, durable sender resends
+                    return "queue_full"
+            t.queues[prio].append((enq_ns, msg_type, lane, group, n))
+            t.depth[prio] += n
+            t.stats["admitted"] += n
+            self._cond.notify_all()
+        return "admitted"
+
+    # -- drain side (one thread, DRR) ----------------------------------------
+
+    def start(self) -> "AdmissionQueues":
+        self._thread = threading.Thread(
+            target=self._run, name="df-qos-drain", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    def drain_now(self, deadline_s: float = 2.0) -> None:
+        """Block until the admission tier is empty (shutdown path: the
+        server drains decoder queues after this, so nothing may still be
+        parked here)."""
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                if all(t.total_depth() == 0
+                       for t in self._tenants.values()):
+                    return
+            time.sleep(0.01)
+
+    def _pop_next(self):
+        """One DRR step under the lock: pick the next tenant with data
+        and deficit, pop its highest-priority group.  Returns
+        (tenant, entry) or None when everything is empty."""
+        with self._cond:
+            while not self._stop.is_set():
+                active = [o for o in self._order
+                          if self._tenants[o].total_depth() > 0]
+                if not active:
+                    self._cond.wait(0.25)
+                    if self._stop.is_set():
+                        return None
+                    continue
+                # visit tenants round-robin from the rotating cursor;
+                # each visit refills ONE quantum when the deficit is
+                # spent, serves while it lasts, then yields the turn —
+                # classic DRR, with frames as the cost unit
+                for _ in range(len(active)):
+                    org = active[self._rr % len(active)]
+                    t = self._tenants[org]
+                    if t.total_depth() == 0:
+                        t.deficit = 0  # no banking credit while idle
+                        self._rr += 1
+                        continue
+                    if t.deficit <= 0:
+                        t.deficit += t.weight * self.config.quantum_frames
+                    if t.deficit <= 0:
+                        # oversized earlier group: pay it off one
+                        # quantum per rotation before serving again
+                        self._rr += 1
+                        continue
+                    for prio in _CLASSES:
+                        if t.queues[prio]:
+                            entry = t.queues[prio].popleft()
+                            t.depth[prio] -= entry[4]
+                            t.deficit -= entry[4]
+                            if t.deficit <= 0:
+                                self._rr += 1
+                            self._cond.notify_all()  # HIGH waiters
+                            return t, entry
+                self._cond.wait(0.05)
+            return None
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            item = self._pop_next()
+            if item is None:
+                continue
+            t, (enq_ns, msg_type, lane, group, n) = item
+            # push into the decoder queue; a full decoder queue stalls
+            # the WHOLE drain (head-of-line by design: decoder lag is a
+            # global signal the PressureController folds in), except
+            # that MID/LOW give up after a bound and shed
+            attempts = 0
+            while not self._stop.is_set():
+                res = self._deliver(msg_type, lane, enq_ns, group)
+                if res is True:
+                    t.stats["delivered"] += n
+                    self.stats["delivered"] += n
+                    if self._hop is not None:
+                        self._hop.account(delivered=n)
+                    break
+                if res == "dropped":
+                    break  # consumed by policy; receiver accounted it
+                self.stats["decoder_stalls"] += 1
+                attempts += 1
+                if attempts >= 20 \
+                        and _prio_of(msg_type) != PRIORITY_HIGH:
+                    # ~1s of retries: shed MID/LOW rather than wedge the
+                    # admission tier behind a dead decoder; unacked, so
+                    # a durable sender retries once pressure clears
+                    t.stats["shed_queue_full"] += n
+                    self.stats["shed_queue_full"] += n
+                    if self._hop is not None:
+                        self._hop.account(dropped=n, reason="queue_full")
+                    break
+                time.sleep(0.05)
+
+    # -- introspection --------------------------------------------------------
+
+    def tenant_snapshot(self) -> dict:
+        """Per-tenant table for /v1/health and dfctl qos."""
+        out = {}
+        with self._lock:
+            for org_id in self._order:
+                t = self._tenants[org_id]
+                tq = self.config.tenant(org_id)
+                out[org_id] = {
+                    "org_id": org_id,
+                    "weight": t.weight,
+                    "rate_fps": tq.rate_fps,
+                    "depth": {"high": t.depth[0], "mid": t.depth[1],
+                              "low": t.depth[2]},
+                    **t.stats,
+                }
+        return out
+
+    def depth_fraction(self, org_id: int | None = None) -> float:
+        """Worst per-class fill fraction (pressure signal)."""
+        limit = max(1, self.config.queue_frames)
+        with self._lock:
+            tenants = ([self._tenants[org_id]]
+                       if org_id is not None and org_id in self._tenants
+                       else list(self._tenants.values()))
+            worst = 0.0
+            for t in tenants:
+                for c in _CLASSES:
+                    worst = max(worst, t.depth[c] / limit)
+        return min(1.0, worst)
+
+
+def _prio_of(msg_type) -> int:
+    from deepflow_tpu.codec import priority_of
+    try:
+        return priority_of(msg_type)
+    except Exception:
+        return PRIORITY_LOW

@@ -1,0 +1,167 @@
+"""Tenant QoS configuration: weights, quotas, pressure thresholds.
+
+Reference analog: server/ingester throttling config + the policy-driven
+resource-control spirit of gpu_ext (PAPERS.md) — small declarative
+policies applied at the admission point.  One ``QosConfig`` object is
+the single source of truth for the whole closed loop: the receiver's
+admission queues (deficit-weighted round-robin + token buckets), the
+``PressureController`` thresholds, and the adaptive sampler's per-level
+rates all read from it, and the controller distributes the per-tenant
+directive back to agents on the sync plane.
+
+Kill switch: ``DF_NO_QOS=1`` (same spirit as DF_NO_NATIVE /
+DF_NO_SELFMON) disables admission, pressure and sampling wholesale —
+the receiver falls back to the pre-QoS direct dispatch path.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from dataclasses import dataclass, field
+
+log = logging.getLogger("df.qos")
+
+QOS_DISABLED = os.environ.get("DF_NO_QOS", "") not in ("", "0")
+
+# Per-tenant pressure levels (ride back to agents on SyncResponse.qos):
+# 0 nominal, 1 mild (shrink batches), 2 high (halve sampler_hz / top-K,
+# head-sample bulk classes), 3 critical (floor everything).
+PRESSURE_NOMINAL = 0
+PRESSURE_MILD = 1
+PRESSURE_HIGH = 2
+PRESSURE_CRITICAL = 3
+
+
+@dataclass
+class TenantQos:
+    """One tenant's admission policy (org_id keys the wire header)."""
+
+    org_id: int
+    weight: int = 1          # DRR quantum multiplier (relative share)
+    rate_fps: float = 0.0    # MID/LOW token-bucket refill, frames/s
+    #                          (0 = unlimited; HIGH is NEVER quota-shed)
+    burst: float = 0.0       # bucket depth, frames (0 = auto: 2s of rate)
+
+    def to_dict(self) -> dict:
+        return {"org_id": self.org_id, "weight": self.weight,
+                "rate_fps": self.rate_fps, "burst": self.burst}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TenantQos":
+        t = cls(org_id=int(d.get("org_id", 0)))
+        t.weight = max(1, int(d.get("weight", 1)))
+        t.rate_fps = max(0.0, float(d.get("rate_fps", 0.0)))
+        t.burst = max(0.0, float(d.get("burst", 0.0)))
+        return t
+
+
+@dataclass
+class QosConfig:
+    """The whole closed loop's knobs.  ``tenants`` maps org_id ->
+    TenantQos; unknown orgs get the defaults (weight=default_weight,
+    unlimited rate) so an unconfigured deployment behaves like plain
+    fair queuing with no quotas."""
+
+    enabled: bool = True
+    # per-(tenant, class) admission queue bound, in frames.  Small by
+    # design: the admission tier is a scheduling buffer, not a spool —
+    # durability lives in the agent's retransmit window + disk spool.
+    queue_frames: int = 4096
+    quantum_frames: int = 64      # DRR quantum per weight unit
+    default_weight: int = 1
+    default_rate_fps: float = 0.0
+    # how long a handler thread waits for HIGH admission space before
+    # declaring queue_full (TCP backpressure window; the ack stays
+    # withheld either way so the durable sender retransmits)
+    high_block_s: float = 0.25
+    # adaptive head-sampling rate per pressure level (bulk classes only;
+    # error/slow exemplars are always kept)
+    sample_rates: tuple = (1.0, 1.0, 0.5, 0.1)
+    slow_exemplar_ms: float = 500.0   # rrt/duration above this = exemplar
+    # pressure thresholds on the folded 0..1 score
+    mild_score: float = 0.50
+    high_score: float = 0.75
+    critical_score: float = 0.90
+    decay_s: float = 2.0          # hysteresis: level steps DOWN at most
+    #                               one notch per decay_s below threshold
+    interval_s: float = 0.25      # pressure controller sampling period
+    tenants: dict = field(default_factory=dict)
+
+    def tenant(self, org_id: int) -> TenantQos:
+        t = self.tenants.get(org_id)
+        if t is None:
+            t = TenantQos(org_id=org_id, weight=self.default_weight,
+                          rate_fps=self.default_rate_fps)
+        return t
+
+    def set_tenant(self, t: TenantQos) -> None:
+        self.tenants[t.org_id] = t
+
+    def to_dict(self) -> dict:
+        return {
+            "enabled": self.enabled,
+            "queue_frames": self.queue_frames,
+            "quantum_frames": self.quantum_frames,
+            "default_weight": self.default_weight,
+            "default_rate_fps": self.default_rate_fps,
+            "high_block_s": self.high_block_s,
+            "sample_rates": list(self.sample_rates),
+            "slow_exemplar_ms": self.slow_exemplar_ms,
+            "mild_score": self.mild_score,
+            "high_score": self.high_score,
+            "critical_score": self.critical_score,
+            "decay_s": self.decay_s,
+            "interval_s": self.interval_s,
+            "tenants": {str(o): t.to_dict()
+                        for o, t in sorted(self.tenants.items())},
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "QosConfig":
+        c = cls()
+        for k in ("queue_frames", "quantum_frames", "default_weight"):
+            if k in d:
+                setattr(c, k, max(1, int(d[k])))
+        for k in ("default_rate_fps", "high_block_s", "slow_exemplar_ms",
+                  "mild_score", "high_score", "critical_score", "decay_s",
+                  "interval_s"):
+            if k in d:
+                setattr(c, k, float(d[k]))
+        if "enabled" in d:
+            c.enabled = bool(d["enabled"])
+        if "sample_rates" in d:
+            rates = [min(1.0, max(0.0, float(r))) for r in d["sample_rates"]]
+            while len(rates) < 4:
+                rates.append(rates[-1] if rates else 1.0)
+            c.sample_rates = tuple(rates[:4])
+        for key, td in (d.get("tenants") or {}).items():
+            td = dict(td)
+            td.setdefault("org_id", key)
+            t = TenantQos.from_dict(td)
+            if t.org_id > 0:
+                c.tenants[t.org_id] = t
+        return c
+
+    @classmethod
+    def load(cls, path: str | None = None) -> "QosConfig":
+        """Load from a JSON file (``--qos-config`` / DF_QOS_CONFIG); a
+        missing/empty path yields defaults.  A malformed file disables
+        QoS loudly rather than guessing at a policy."""
+        path = path or os.environ.get("DF_QOS_CONFIG", "")
+        if not path:
+            return cls()
+        try:
+            with open(path) as f:
+                return cls.from_dict(json.load(f))
+        except (OSError, ValueError) as e:
+            log.error("qos config %s unreadable (%s): QoS disabled", path, e)
+            c = cls()
+            c.enabled = False
+            return c
+
+
+def sample_rate_for(config: QosConfig, level: int) -> float:
+    rates = config.sample_rates
+    return rates[min(max(level, 0), len(rates) - 1)]

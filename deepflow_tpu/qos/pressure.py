@@ -1,0 +1,185 @@
+"""PressureController: fold ingest signals into per-tenant pressure.
+
+The closed loop's sensor + classifier.  Every ``interval_s`` it folds
+four live signals — admission queue depth (per tenant), decoder queue
+fill, flusher backlog, and hop-ledger imbalance — into a 0..1 score,
+then maps the score to a pressure LEVEL (0 nominal .. 3 critical) with
+hysteresis: levels rise immediately (overload must bite within one
+sync period) but step down at most one notch per ``decay_s`` (flapping
+agents between full-rate and floor would be worse than a slow recovery).
+
+The per-tenant level is what rides back to agents on
+``SyncResponse.qos`` (controller reads ``directive()``) and what the
+adaptive sampler keys its head-sampling rate off.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from deepflow_tpu.qos.config import sample_rate_for
+
+
+class PressureController:
+    """Samples signals on a timer thread; ``level()``/``directive()``
+    are lock-cheap reads from the last computed table."""
+
+    def __init__(self, config, admission=None, telemetry=None,
+                 decoder_fill=None, flusher_backlog=None) -> None:
+        """decoder_fill() -> 0..1 (worst decoder queue fraction);
+        flusher_backlog() -> 0..1 (pending rows vs flush threshold).
+        Both optional — absent signals contribute 0."""
+        self.config = config
+        self.admission = admission
+        self.telemetry = telemetry
+        self._decoder_fill = decoder_fill
+        self._flusher_backlog = flusher_backlog
+        self._lock = threading.Lock()
+        self._levels: dict[int, int] = {}
+        self._last_down: dict[int, float] = {}
+        self._global_level = 0
+        self._scores: dict[str, float] = {}
+        self._updated_ns = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.stats = {"evaluations": 0, "raises": 0, "decays": 0}
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> "PressureController":
+        self._thread = threading.Thread(
+            target=self._run, name="df-qos-pressure", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    def _run(self) -> None:
+        hb = (self.telemetry.heartbeat(
+            "qos.pressure", interval_hint_s=self.config.interval_s)
+            if self.telemetry is not None else None)
+        while not self._stop.wait(self.config.interval_s):
+            if hb is not None:
+                hb.beat(progress=self.stats["evaluations"])
+            try:
+                self.evaluate_once()
+            except Exception:  # never kill the loop; health shows stall
+                import logging
+                logging.getLogger("df.qos").exception(
+                    "pressure evaluation failed")
+
+    # -- scoring --------------------------------------------------------------
+
+    def _global_score(self) -> dict[str, float]:
+        scores = {"decoder_fill": 0.0, "flusher_backlog": 0.0,
+                  "ledger_imbalance": 0.0}
+        if self._decoder_fill is not None:
+            try:
+                scores["decoder_fill"] = min(
+                    1.0, max(0.0, float(self._decoder_fill())))
+            except Exception:
+                pass
+        if self._flusher_backlog is not None:
+            try:
+                scores["flusher_backlog"] = min(
+                    1.0, max(0.0, float(self._flusher_backlog())))
+            except Exception:
+                pass
+        if self.telemetry is not None:
+            # in-flight frames stuck across hops, normalized against the
+            # admission bound: a ledger that can't drain IS backlog
+            try:
+                imb = sum(abs(h["in_flight"])
+                          for h in self.telemetry.pipeline_snapshot())
+                scores["ledger_imbalance"] = min(
+                    1.0, imb / max(1, 4 * self.config.queue_frames))
+            except Exception:
+                pass
+        return scores
+
+    def _score_to_level(self, score: float) -> int:
+        c = self.config
+        if score >= c.critical_score:
+            return 3
+        if score >= c.high_score:
+            return 2
+        if score >= c.mild_score:
+            return 1
+        return 0
+
+    def _apply_hysteresis(self, org_id: int, target: int,
+                          now: float) -> int:
+        cur = self._levels.get(org_id, 0)
+        if target >= cur:
+            if target > cur:
+                self.stats["raises"] += 1
+                self._last_down[org_id] = now
+            return target
+        # step down one notch per decay_s
+        if now - self._last_down.get(org_id, 0.0) >= self.config.decay_s:
+            self._last_down[org_id] = now
+            self.stats["decays"] += 1
+            return cur - 1
+        return cur
+
+    def evaluate_once(self) -> dict[int, int]:
+        now = time.monotonic()
+        g = self._global_score()
+        base = max(g.values()) if g else 0.0
+        per_tenant: dict[int, float] = {}
+        if self.admission is not None:
+            for org_id in list(self.admission.tenant_snapshot()):
+                per_tenant[org_id] = max(
+                    base, self.admission.depth_fraction(org_id))
+        with self._lock:
+            self.stats["evaluations"] += 1
+            self._scores = dict(g, admission=max(
+                per_tenant.values(), default=0.0))
+            self._global_level = self._apply_hysteresis(
+                0, self._score_to_level(base), now)
+            levels = {}
+            for org_id, score in per_tenant.items():
+                levels[org_id] = self._apply_hysteresis(
+                    org_id, self._score_to_level(score), now)
+            # orgs with admission state gone quiet still decay
+            for org_id in list(self._levels):
+                if org_id != 0 and org_id not in levels:
+                    levels[org_id] = self._apply_hysteresis(
+                        org_id, 0, now)
+            levels[0] = self._global_level
+            self._levels = levels
+            self._updated_ns = time.time_ns()
+        return dict(levels)
+
+    # -- readers --------------------------------------------------------------
+
+    def level(self, org_id: int = 0) -> int:
+        with self._lock:
+            return self._levels.get(org_id, self._global_level)
+
+    def directive(self, org_id: int) -> dict:
+        """What the controller stamps onto SyncResponse.qos for an
+        agent of this org: level + the head-sampling rate in force +
+        the tenant's configured share (observability for the agent)."""
+        level = self.level(org_id)
+        tq = self.config.tenant(org_id)
+        return {"pressure_level": level,
+                "sample_rate": sample_rate_for(self.config, level),
+                "weight": tq.weight,
+                "rate_fps": tq.rate_fps,
+                "updated_ns": self._updated_ns}
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"levels": {str(k): v
+                               for k, v in sorted(self._levels.items())},
+                    "global_level": self._global_level,
+                    "scores": {k: round(v, 4)
+                               for k, v in self._scores.items()},
+                    "updated_ns": self._updated_ns,
+                    **self.stats}

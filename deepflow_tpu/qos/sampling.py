@@ -1,0 +1,99 @@
+"""Tail-aware adaptive sampling for bulk flow/L7 traffic under pressure.
+
+Reference analog: the reference agent's flow-log throttle
+(agent/src/sender npb/log throttling) — upgraded with the tail-aware
+stance of modern trace samplers: when a tenant's pressure level calls
+for shedding, BULK records are head-sampled with a deterministic
+per-tenant rate while error/slow exemplars are always kept (those are
+exactly the records an incident investigation needs).
+
+Determinism: the keep decision is ``hash(org_id, flow_key) < rate`` on
+a stable 32-bit mix, so retransmitted/replayed copies of the same
+record make the same decision on every node — no double counting, no
+coordination.
+
+Every decision is ledgered on the ``qos.sample`` hop
+(``dropped(reason="adaptive_sample")``) and the applied rate is
+recorded per (org, window) so queriers can reweight: an aggregate over
+a sampled window multiplies bulk counts by 1/rate (exemplars ride at
+weight 1 — they were never subject to the coin flip).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import zlib
+
+from deepflow_tpu.qos.config import sample_rate_for
+
+_HASH_DENOM = float(1 << 32)
+
+
+def sample_hash01(org_id: int, key: int) -> float:
+    """Stable [0,1) mix of (org, record key) — crc32 over the packed
+    pair; identical across processes and restarts."""
+    h = zlib.crc32((org_id & 0xFFFF).to_bytes(2, "big")
+                   + (key & 0xFFFFFFFFFFFFFFFF).to_bytes(8, "big"))
+    return (h & 0xFFFFFFFF) / _HASH_DENOM
+
+
+class AdaptiveSampler:
+    """One per server; the flow decoder consults it per record."""
+
+    def __init__(self, config, pressure=None, telemetry=None) -> None:
+        self.config = config
+        self.pressure = pressure
+        self._hop = (telemetry.hop("qos.sample")
+                     if telemetry is not None else None)
+        self._lock = threading.Lock()
+        # org -> {"rate", "kept", "dropped", "exemplars", "since_ns"}
+        self._by_org: dict[int, dict] = {}
+
+    def rate_for(self, org_id: int) -> float:
+        level = (self.pressure.level(org_id)
+                 if self.pressure is not None else 0)
+        return sample_rate_for(self.config, level)
+
+    def _org_state(self, org_id: int, rate: float) -> dict:
+        st = self._by_org.get(org_id)
+        if st is None:
+            st = self._by_org[org_id] = {
+                "rate": rate, "kept": 0, "dropped": 0, "exemplars": 0,
+                "since_ns": time.time_ns()}
+        st["rate"] = rate  # record the rate in force for reweighting
+        return st
+
+    def keep(self, org_id: int, key: int, exemplar: bool = False) -> bool:
+        """One record's fate.  ``key`` must be stable across resends
+        (flow_id).  Exemplars (errors / slow tails) are always kept."""
+        rate = self.rate_for(org_id)
+        if self._hop is not None:
+            self._hop.account(emitted=1)
+        with self._lock:
+            st = self._org_state(org_id, rate)
+            if exemplar:
+                st["exemplars"] += 1
+                st["kept"] += 1
+                if self._hop is not None:
+                    self._hop.account(delivered=1)
+                return True
+            if rate >= 1.0 or sample_hash01(org_id, key) < rate:
+                st["kept"] += 1
+                if self._hop is not None:
+                    self._hop.account(delivered=1)
+                return True
+            st["dropped"] += 1
+        if self._hop is not None:
+            self._hop.account(dropped=1, reason="adaptive_sample")
+        return False
+
+    def is_slow_ns(self, duration_ns: int) -> bool:
+        return duration_ns >= self.config.slow_exemplar_ms * 1e6
+
+    def snapshot(self) -> dict:
+        """Per-org table for /v1/health: applied rate + counters — the
+        record queriers need to reweight sampled windows."""
+        with self._lock:
+            return {str(org): dict(st)
+                    for org, st in sorted(self._by_org.items())}

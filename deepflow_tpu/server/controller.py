@@ -386,8 +386,12 @@ class Controller:
 
     def __init__(self, platform_table: PlatformInfoTable,
                  host: str = "127.0.0.1", port: int = 20035,
-                 pod_index=None, ring_provider=None) -> None:
+                 pod_index=None, ring_provider=None, qos=None) -> None:
         self.platform_table = platform_table
+        # closed-loop backpressure (deepflow_tpu/qos): each Sync response
+        # carries this agent's org pressure directive so the fleet
+        # degrades gracefully instead of overrunning the ingest tier
+        self.qos = qos
         self.pod_index = pod_index  # K8s genesis resource model (server's)
         # zero-arg callable -> HashRing | None: when a replication ring
         # is active its per-agent owner order (primary first) wins over
@@ -475,6 +479,16 @@ class Controller:
                                         or bool(addrs))
         for addr in addrs:
             resp.analyzer_addrs.append(addr)
+        qos = self.qos
+        if qos is not None and qos.enabled:
+            org = self.org_of_group(request.agent_group or "default")
+            d = qos.directive(org)
+            if d is not None:
+                resp.qos.pressure_level = int(d["pressure_level"])
+                resp.qos.sample_rate = float(d["sample_rate"])
+                resp.qos.weight = int(d["weight"])
+                resp.qos.rate_fps = float(d["rate_fps"])
+                resp.qos.updated_ns = int(d["updated_ns"])
         return resp
 
     def Ntp(self, request: pb.NtpRequest, context) -> pb.NtpResponse:

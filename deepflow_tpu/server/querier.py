@@ -87,6 +87,11 @@ class QuerierAPI:
         self.publisher = None
         self.readtier = None
         self.partial_cache = None
+        # closed-loop QoS (deepflow_tpu/qos): the facade + the
+        # receiver's per-tenant drop attribution, set by server.py on
+        # ingest nodes (querier replicas take no agent traffic)
+        self.qos = None
+        self.drop_attribution = None
         # zone-map pruning accounting flows into the same hop ledger the
         # rest of the pipeline reports through (query.scan hop)
         from deepflow_tpu.query import engine as _qengine
@@ -702,6 +707,35 @@ class QuerierAPI:
             self.controller.assign_org(group, org)
         return {"orgs": self.controller.org_assignments(),
                 "default_org": 1}
+
+    def qos_api(self, body: dict) -> dict:
+        """Multi-tenant QoS admin (deepflow_tpu/qos): list per-tenant
+        weights/quotas/pressure, or set a tenant's policy (hot-applied
+        to the live admission queues). Backs `dfctl qos`."""
+        if self.qos is None or not self.qos.enabled:
+            return {"enabled": False, "tenants": {}}
+        action = body.get("action", "list")
+        if action == "set":
+            from deepflow_tpu.qos import TenantQos
+            try:
+                org = int(body.get("org_id", 0))
+            except (TypeError, ValueError):
+                raise qengine.QueryError("org_id must be an integer")
+            if org < 1 or org > 0xFFFF:
+                raise qengine.QueryError("org_id out of range (1..65535)")
+            cfg = self.qos.config
+            cur = cfg.tenant(org)
+            t = TenantQos.from_dict({
+                "org_id": org,
+                "weight": body.get("weight", cur.weight),
+                "rate_fps": body.get("rate_fps", cur.rate_fps),
+                "burst": body.get("burst", cur.burst)})
+            cfg.set_tenant(t)
+            self.qos.reconfigure(cfg)
+        out = self.qos.snapshot()
+        if self.drop_attribution is not None:
+            out["drops"] = self.drop_attribution()
+        return out
 
     def _require_token(self, token: str | None, what: str) -> None:
         """Reject a gated control-plane action unless the caller presented
@@ -1636,6 +1670,14 @@ class QuerierAPI:
             storage = self.storage_provider()
             if storage is not None:
                 out["storage"] = storage
+        if self.qos is not None:
+            # overload-control state: admission queues, per-tenant
+            # pressure levels, adaptive-sampling rates + the receiver's
+            # per-(org, agent) drop attribution
+            qos = self.qos.snapshot()
+            if self.drop_attribution is not None:
+                qos["drops"] = self.drop_attribution()
+            out["qos"] = qos
         if self.membership is not None:
             out["cluster"] = {
                 "shard_id": self.shard_id,
@@ -1885,6 +1927,8 @@ class QuerierHTTP:
                         self._send(200, api.analyzers_api(body))
                     elif path == "/v1/orgs":
                         self._send(200, api.orgs_api(body))
+                    elif path == "/v1/qos":
+                        self._send(200, api.qos_api(body))
                     elif path == "/v1/repo":
                         self._send(200, api.repo_api(
                             body, token=self._token(body)))

@@ -29,7 +29,7 @@ import time
 
 from deepflow_tpu.codec import (
     FrameDecodeError, FrameHeader, MessageType, StreamDecoder, decode_frame,
-    decode_seq_base, encode_ack)
+    decode_seq_base, encode_ack, priority_of)
 
 log = logging.getLogger("df.receiver")
 
@@ -121,9 +121,13 @@ class Receiver:
     def __init__(self, host: str = "127.0.0.1", port: int = 20033,
                  queue_size: int = 4096, enable_udp: bool = True,
                  telemetry=None, ack_enabled: bool = True,
-                 chaos=None) -> None:
+                 chaos=None, qos=None) -> None:
         self.host = host
         self.port = port
+        # closed-loop QoS (deepflow_tpu/qos): when attached, frames are
+        # admitted through per-(org, priority-class) fair queues instead
+        # of being put straight onto the decoder queues
+        self._qos = qos if (qos is not None and qos.enabled) else None
         self._queues: dict[MessageType, queue.Queue] = {}
         self._queue_size = queue_size
         self._tcp: socketserver.ThreadingTCPServer | None = None
@@ -155,6 +159,13 @@ class Receiver:
         self.stats = {"frames": 0, "bytes": 0, "dropped": 0, "bad_frames": 0,
                       "connections": 0, "acks_sent": 0, "seq_bases": 0,
                       "udp_trailing_garbage": 0, "recv_ns": 0}
+        # per-tenant/per-agent drop attribution: a shed batched group is
+        # charged to every (org, agent, reason) it contained, never as
+        # one anonymous lump — the QoS counters and the hop ledger must
+        # agree per org.  Cold path only (drops), so a plain dict+lock.
+        self._drop_lock = threading.Lock()
+        self.drops_by_org: dict[int, dict[str, int]] = {}
+        self.drops_by_agent: dict[int, dict[str, int]] = {}
         if telemetry is None:
             from deepflow_tpu.telemetry import Telemetry
             telemetry = Telemetry("server", enabled=False)
@@ -186,6 +197,70 @@ class Receiver:
     @staticmethod
     def _lane_q(q, lane: int):
         return q[lane % len(q)] if isinstance(q, list) else q
+
+    def attach_qos(self, qos, flusher_backlog=None) -> None:
+        """Wire the QoS facade between frame parse and the decoder
+        queues (Server calls this before start(), after decoders have
+        registered their queues)."""
+        if qos is None or not qos.enabled:
+            return
+        qos.attach(self._deliver_admitted, hop=self._hop,
+                   observe_seqs=self._observe_seqs,
+                   decoder_fill=self.decoder_fill,
+                   flusher_backlog=flusher_backlog)
+        self._qos = qos
+
+    def _account_org_drop(self, group, reason: str) -> None:
+        """Attribute one shed group per (org, agent): the cold half of
+        satellite 'group-drop attribution' — ledger reasons stay flat,
+        the per-tenant split lives here and on /v1/health."""
+        with self._drop_lock:
+            for header, _ in group:
+                o = self.drops_by_org.setdefault(header.org_id, {})
+                o[reason] = o.get(reason, 0) + 1
+                a = self.drops_by_agent.setdefault(header.agent_id, {})
+                a[reason] = a.get(reason, 0) + 1
+
+    def drop_attribution(self) -> dict:
+        with self._drop_lock:
+            return {
+                "by_org": {str(k): dict(v)
+                           for k, v in sorted(self.drops_by_org.items())},
+                "by_agent": {str(k): dict(v)
+                             for k, v in
+                             sorted(self.drops_by_agent.items())},
+            }
+
+    def decoder_fill(self) -> float:
+        """Worst decoder-queue fill fraction (PressureController
+        signal)."""
+        worst = 0.0
+        for q in self._queues.values():
+            for qq in (q if isinstance(q, list) else [q]):
+                if qq.maxsize > 0:
+                    worst = max(worst, qq.qsize() / qq.maxsize)
+        return min(1.0, worst)
+
+    def _deliver_admitted(self, msg_type, lane: int, enq_ns: int,
+                          group: list):
+        """Admission drain -> decoder queue.  Returns True (delivered:
+        the drain accounts it), "dropped" (consumed by policy, already
+        accounted here) or False (decoder queue full right now —
+        the drain retries / sheds by class)."""
+        q = self._queues.get(msg_type)
+        if q is None:
+            n = len(group)
+            self.stats["dropped"] += n
+            self._hop.account(dropped=n, reason="no_handler")
+            self._account_org_drop(group, "no_handler")
+            self._observe_seqs(group)
+            return "dropped"
+        q = self._lane_q(q, lane)
+        try:
+            q.put_nowait((enq_ns, group))
+            return True
+        except queue.Full:
+            return False
 
     def _observe_seqs(self, frames: list[tuple[FrameHeader, bytes]]) -> None:
         """Mark seqs as handled WITHOUT a decoder pass (policy drops like
@@ -219,6 +294,10 @@ class Receiver:
         datagram). Queue items are (enqueue_ns, LIST of (header, payload))
         so consumers see one contract for both paths and can histogram
         their queue wait."""
+        if self._qos is not None:
+            # UDP lane affinity is per AGENT (no connection to pin to)
+            self._dispatch_qos([(header, payload)], header.agent_id)
+            return
         self.stats["frames"] += 1
         self.stats["bytes"] += len(payload)
         self._hop.account(emitted=1)
@@ -226,6 +305,7 @@ class Receiver:
         if q is None:
             self.stats["dropped"] += 1
             self._hop.account(dropped=1, reason="no_handler")
+            self._account_org_drop([(header, payload)], "no_handler")
             # acked anyway: "no decoder registered" is policy, not
             # pressure — a retransmit would meet the same fate
             self._observe_seqs([(header, payload)])
@@ -242,6 +322,7 @@ class Receiver:
             # the ack so a durable sender retransmits it later
             self.stats["dropped"] += 1
             self._hop.account(dropped=1, reason="queue_full")
+            self._account_org_drop([(header, payload)], "queue_full")
 
     def _dispatch_many(self, frames: list[tuple[FrameHeader, bytes]],
                        lane: int = 0) -> None:
@@ -251,6 +332,9 @@ class Receiver:
         queue.get wakeups on the decoder side); now it costs one.
         ``lane`` is the calling connection's affinity index (register
         with lanes > 1 to spread connections over distinct queues)."""
+        if self._qos is not None:
+            self._dispatch_qos(frames, lane)
+            return
         by_type: dict[MessageType, list] = {}
         for header, payload in frames:
             self.stats["frames"] += 1
@@ -266,6 +350,7 @@ class Receiver:
             if q is None:
                 self.stats["dropped"] += len(group)
                 self._hop.account(dropped=len(group), reason="no_handler")
+                self._account_org_drop(group, "no_handler")
                 self._observe_seqs(group)
                 continue
             q = self._lane_q(q, lane)
@@ -277,6 +362,45 @@ class Receiver:
                 # withheld so the durable sender retransmits the group
                 self.stats["dropped"] += len(group)
                 self._hop.account(dropped=len(group), reason="queue_full")
+                self._account_org_drop(group, "queue_full")
+
+    def _dispatch_qos(self, frames: list[tuple[FrameHeader, bytes]],
+                      lane: int = 0) -> None:
+        """QoS dispatch: group one recv's frames by (org, msg_type) and
+        admit each group through the fair-queuing tier.  The admission
+        drain delivers to the decoder queues in DRR order; this thread
+        only blocks when a tenant's HIGH queue is full (bounded wait =
+        TCP backpressure).  Hop accounting: emitted here, delivered /
+        dropped by the admission tier on the SAME receiver hop, so
+        conservation holds with frames parked in admission counted as
+        in_flight."""
+        groups: dict[tuple[int, MessageType], list] = {}
+        for header, payload in frames:
+            self.stats["frames"] += 1
+            self.stats["bytes"] += len(payload)
+            key = (header.org_id, header.msg_type)
+            group = groups.get(key)
+            if group is None:
+                group = groups[key] = []
+            group.append((header, payload))
+        self._hop.account(emitted=len(frames))
+        enq_ns = time.monotonic_ns()
+        admission = self._qos.admission
+        for (org_id, msg_type), group in groups.items():
+            if self._queues.get(msg_type) is None:
+                self.stats["dropped"] += len(group)
+                self._hop.account(dropped=len(group), reason="no_handler")
+                self._account_org_drop(group, "no_handler")
+                self._observe_seqs(group)
+                continue
+            verdict = admission.submit(
+                org_id, priority_of(msg_type), msg_type, lane, group,
+                enq_ns)
+            if verdict != "admitted":
+                # admission already accounted the hop ledger (and acked
+                # quota sheds); mirror into stats + per-tenant split
+                self.stats["dropped"] += len(group)
+                self._account_org_drop(group, verdict)
 
     # -- TCP -----------------------------------------------------------------
 

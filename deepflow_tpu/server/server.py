@@ -49,7 +49,8 @@ class Server:
                  objstore: str | None = None,
                  segcache_max_bytes: int = 256 << 20,
                  publish_interval_s: float = 2.0,
-                 readtier_poll_s: float = 2.0) -> None:
+                 readtier_poll_s: float = 2.0,
+                 qos_config=None) -> None:
         # disaggregated storage (store/objstore.py + store/segcache.py):
         # - role="ingest" (+ --objstore): after every tier commit the
         #   SegmentPublisher mirrors adopted segments + dict dumps into
@@ -147,6 +148,19 @@ class Server:
         self._selfstats_thread: threading.Thread | None = None
         self.receiver = Receiver(host=host, port=ingest_port,
                                  telemetry=self.telemetry)
+        # closed-loop overload control (deepflow_tpu/qos): admission
+        # fair-queuing in front of the decoders, a pressure controller
+        # feeding per-tenant backpressure into Controller.Sync, and an
+        # adaptive sampler the flow decoders consult under pressure.
+        # qos_config: a QosConfig, a JSON path, or None (defaults +
+        # DF_QOS_CONFIG). Querier replicas take no agent traffic.
+        from deepflow_tpu.qos import Qos, QosConfig
+        if isinstance(qos_config, str):
+            qos_config = QosConfig.load(qos_config)
+        elif qos_config is None:
+            qos_config = QosConfig.load()
+        self.qos = (Qos(qos_config, telemetry=self.telemetry)
+                    if self.role == "ingest" else None)
         self.decoders = []
         self.dedup = None  # shared DedupWindow, built in start()
         self.controller = None
@@ -159,7 +173,8 @@ class Server:
                 self.controller = Controller(
                     self.platform, host=host, port=sync_port,
                     pod_index=self.pod_index,
-                    ring_provider=self._current_ring)
+                    ring_provider=self._current_ring,
+                    qos=self.qos)
         from deepflow_tpu.server.alerting import (AlertEngine,
                                                   StepRegressionDetector)
         from deepflow_tpu.server.exporters import ExporterManager
@@ -188,6 +203,9 @@ class Server:
         # built after the api (rollup needs the db the api already holds)
         self.api.rollup = self.rollup
         self.api.storage_provider = self._storage_stats
+        # /v1/health qos block + /v1/qos tenant table + dfctl qos
+        self.api.qos = self.qos
+        self.api.drop_attribution = self.receiver.drop_attribution
         self._started = False
 
     def start_genesis(self, api_base: str | None = None, token: str = "",
@@ -223,7 +241,17 @@ class Server:
                           if self.compactor is not None else None),
             "genesis": (dict(self.genesis.stats)
                         if self.genesis is not None else None),
+            "qos": (self.qos.snapshot()
+                    if self.qos is not None else None),
+            "drop_attribution": self.receiver.drop_attribution(),
         }
+
+    def _flusher_backlog(self) -> float:
+        """Durability-gate depth as a 0..1 pressure signal: acks the
+        flusher has not yet released.  4096 pending seqs ≈ saturated."""
+        if self.durability is None:
+            return 0.0
+        return min(1.0, len(self.durability) / 4096.0)
 
     def _storage_stats(self) -> dict | None:
         """The /v1/health storage block: tier state + rollup horizons."""
@@ -442,10 +470,20 @@ class Server:
             (StatsDecoder, MessageType.DFSTATS),
             (EventDecoder, MessageType.EVENT),
         ]
+        qos_on = self.qos is not None and self.qos.enabled
+        if qos_on:
+            # builds admission/pressure/sampler against the receiver's
+            # deliver + ledger surfaces; must precede decoder
+            # construction (flow decoders hold the sampler) and
+            # receiver.start() (no un-admitted dispatch window)
+            self.receiver.attach_qos(self.qos,
+                                     flusher_backlog=self._flusher_backlog)
         for cls, mtype in pairs:
             kw = {}
             lanes = 1
             if cls is FlowLogDecoder:
+                if qos_on:
+                    kw["qos_sampler"] = self.qos.sampler
                 workers = self.ingest_workers or FlowLogDecoder.WORKERS
                 if self.ingest_workers:
                     kw["workers"] = self.ingest_workers
@@ -476,6 +514,8 @@ class Server:
                 self.compactor = Compactor(
                     self.db, interval_s=self.compact_interval_s,
                     telemetry=self.telemetry).start()
+        if qos_on:
+            self.qos.start()
         self.receiver.start()
         if self.objstore is not None and self.storage:
             # publish sealed state to the shared store so stateless
@@ -628,6 +668,11 @@ class Server:
             self._selfstats_thread = None
         if self.role == "ingest":
             self.receiver.stop()
+        if self.qos is not None:
+            # after receiver.stop() (no new submissions), before the
+            # decoder drain: parked admission frames flush into the
+            # decoder queues so the drain below commits them
+            self.qos.stop()
         for d in self.decoders:
             d.stop()  # joins workers, then drains the queue: acked
             # frames must reach the tables before the db persists
@@ -768,6 +813,11 @@ def main() -> None:
     parser.add_argument("--ha-k8s-lease", default=None,
                         help="K8s Lease object name for leader election "
                              "(no shared volume needed)")
+    parser.add_argument("--qos-config", default=None,
+                        help="JSON tenant-QoS policy (weights, "
+                             "rate_fps quotas, pressure thresholds); "
+                             "default $DF_QOS_CONFIG or built-ins. "
+                             "DF_NO_QOS=1 disables the subsystem")
     parser.add_argument("--no-controller", action="store_true")
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args()
@@ -795,6 +845,7 @@ def main() -> None:
                     segcache_max_bytes=args.segcache_max_mb << 20,
                     publish_interval_s=args.publish_interval_s,
                     readtier_poll_s=args.readtier_poll_s,
+                    qos_config=args.qos_config,
                     enable_controller=(not args.no_controller
                                        and args.role != "querier")).start()
     try:

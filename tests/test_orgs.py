@@ -192,6 +192,94 @@ def test_scoped_query_on_unscopable_table_refused():
         server.stop()
 
 
+def test_org_over_quota_leaves_other_org_unaffected():
+    """Multi-tenant QoS (deepflow_tpu/qos): org 2 blows through its
+    frames-per-second quota while org 1 sends the same traffic with no
+    quota — every org-1 row lands, org 2's overage is shed with reason
+    ``quota`` (acked: policy, not pressure) and shows up per-tenant in
+    /v1/health, and org 1's counters show zero sheds."""
+    from deepflow_tpu.qos import QosConfig, TenantQos
+    cfg = QosConfig()
+    cfg.set_tenant(TenantQos(org_id=2, weight=1, rate_fps=5.0, burst=8.0))
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0,
+                    qos_config=cfg).start()
+    try:
+        server.platform.update(AgentInfo(agent_id=1, host="h1"))
+        server.platform.update(AgentInfo(agent_id=2, host="h2", org_id=2))
+        n = 40
+
+        def doc_frame(agent_id, org_id, i):
+            docs = pb.DocumentBatch()
+            d = docs.docs.add()
+            d.timestamp_s = int(time.time()) - n + i
+            d.interval_s = 1
+            d.tag.ip_src = socket.inet_aton("10.0.0.1")
+            d.tag.ip_dst = socket.inet_aton("10.0.0.2")
+            d.tag.port = 443
+            d.tag.proto = 1
+            d.tag.l7_protocol = 1
+            d.tag.app_service = f"svc-{org_id}"
+            d.app_meter.request = 1
+            return encode_frame(
+                FrameHeader(MessageType.METRICS, agent_id=agent_id,
+                            org_id=org_id),
+                docs.SerializeToString())
+
+        s1 = socket.create_connection(("127.0.0.1", server.ingest_port))
+        s2 = socket.create_connection(("127.0.0.1", server.ingest_port))
+        for i in range(n):
+            s1.sendall(doc_frame(1, 1, i))
+            s2.sendall(doc_frame(2, 2, i))  # METRICS = MID: quota applies
+        s1.close()
+        s2.close()
+
+        # org 1 is COMPLETELY unaffected: all 40 rows arrive
+        deadline = time.time() + 10
+        rows1 = []
+        while time.time() < deadline:
+            rows1 = _post(server.query_port, "/v1/query/",
+                          {"sql": "SELECT app_service FROM "
+                                  "flow_metrics.application.1s",
+                           "org_id": 1})["result"]["values"]
+            if len(rows1) >= n:
+                break
+            time.sleep(0.1)
+        assert len(rows1) == n, len(rows1)
+        assert all(r[0] == "svc-1" for r in rows1)
+
+        import urllib.request as _rq
+        health = json.load(_rq.urlopen(
+            f"http://127.0.0.1:{server.query_port}/v1/health"))
+        tenants = health["qos"]["tenants"]
+        t1, t2 = tenants["1"], tenants["2"]
+        assert t1["delivered"] == n
+        assert t1["shed_quota"] == 0 and t1["shed_queue_full"] == 0
+        # org 2 is over quota: sheds happened and every frame is
+        # accounted (admitted + shed == sent — nothing vanished)
+        assert t2["shed_quota"] > 0
+        assert t2["admitted"] + t2["shed_quota"] \
+            + t2["shed_queue_full"] == n
+        # per-tenant drop attribution mirrors the shed, org 1 absent
+        drops = health["qos"]["drops"]["by_org"]
+        assert drops.get("2", {}).get("quota") == t2["shed_quota"]
+        assert "quota" not in drops.get("1", {})
+        # org 2's delivered rows are scoped away from org 1 queries
+        # (poll: delivered counts at admission, rows land a beat later)
+        rows2 = []
+        while time.time() < deadline:
+            rows2 = _post(server.query_port, "/v1/query/",
+                          {"sql": "SELECT app_service FROM "
+                                  "flow_metrics.application.1s",
+                           "org_id": 2})["result"]["values"]
+            if len(rows2) >= t2["delivered"]:
+                break
+            time.sleep(0.1)
+        assert len(rows2) == t2["delivered"] <= n
+        assert all(r[0] == "svc-2" for r in rows2)
+    finally:
+        server.stop()
+
+
 def test_serverside_events_visible_to_default_org():
     """Recorder/integration rows without an explicit org land in the
     DEFAULT org (column default 1), so org-1-scoped forensics queries

@@ -1,0 +1,396 @@
+"""Closed-loop overload control & multi-tenant QoS (deepflow_tpu/qos).
+
+Unit coverage for the subsystem's invariants: token-bucket quotas are
+all-or-nothing with refill; DRR delivers weighted shares under
+contention; HIGH-class frames are never quota-shed (and queue_full
+sheds withhold the ack while quota sheds observe it); pressure levels
+rise immediately and decay with hysteresis; adaptive sampling is
+deterministic, always keeps exemplars, and conserves on its hop
+ledger; the controller stamps ``SyncResponse.qos`` and the agent
+degrades/restores its probes from it; sender reconnect replay orders
+HIGH before MID/LOW.
+"""
+
+import threading
+import time
+import types
+
+import pytest
+
+from deepflow_tpu.codec import MessageType, priority_of
+from deepflow_tpu.qos import (
+    AdaptiveSampler, AdmissionQueues, PressureController, Qos, QosConfig,
+    TenantQos, TokenBucket, sample_hash01)
+
+
+class _RecHop:
+    """Hop-ledger stand-in: accumulates the same counters."""
+
+    def __init__(self):
+        self.emitted = 0
+        self.delivered = 0
+        self.dropped = 0
+        self.reasons = {}
+
+    def account(self, emitted=0, delivered=0, dropped=0, reason=None):
+        self.emitted += emitted
+        self.delivered += delivered
+        self.dropped += dropped
+        if dropped and reason:
+            self.reasons[reason] = self.reasons.get(reason, 0) + dropped
+
+
+class _FakeTelemetry:
+    def __init__(self):
+        self.h = _RecHop()
+
+    def hop(self, name):
+        return self.h
+
+
+# -- token bucket -------------------------------------------------------------
+
+def test_token_bucket_all_or_nothing_and_refill():
+    b = TokenBucket(100.0, burst=10.0)
+    assert b.take(10)          # full burst drains in one take
+    assert not b.take(10)      # empty: all-or-nothing, nothing partial
+    time.sleep(0.2)            # ~20 tokens refill, capped at burst 10
+    assert b.take(10)
+    assert not b.take(1000)    # can never exceed burst even after a wait
+
+
+def test_token_bucket_zero_rate_is_unlimited():
+    b = TokenBucket(0.0)
+    assert b.take(1_000_000)
+    assert b.take(1_000_000)
+
+
+# -- admission / DRR ----------------------------------------------------------
+
+def _group(n):
+    return [(None, b"")] * n
+
+
+def test_drr_delivers_weighted_shares_under_contention():
+    cfg = QosConfig()
+    cfg.set_tenant(TenantQos(org_id=1, weight=3))
+    cfg.set_tenant(TenantQos(org_id=2, weight=1))
+    deliveries = []
+    lock = threading.Lock()
+
+    def deliver(msg_type, lane, enq_ns, group):
+        with lock:
+            deliveries.append((lane, len(group)))  # lane carries the org
+        return True
+
+    aq = AdmissionQueues(cfg, deliver)
+    # backlog BOTH tenants before the drain starts so every DRR
+    # rotation sees contention
+    per_org = 960
+    for org in (1, 2):
+        for _ in range(per_org // 8):
+            assert aq.submit(org, 1, MessageType.METRICS, org,
+                             _group(8), 0) == "admitted"
+    aq.start()
+    deadline = time.monotonic() + 10
+    while aq.stats["delivered"] < 2 * per_org \
+            and time.monotonic() < deadline:
+        time.sleep(0.01)
+    aq.stop()
+    assert aq.stats["delivered"] == 2 * per_org
+    # during the contended first half, org 1 (weight 3) must get
+    # roughly 3x org 2's frames — allow 2x..4x for rotation phase
+    with lock:
+        half, counts = 0, {1: 0, 2: 0}
+        for lane, n in deliveries:
+            counts[lane] += n
+            half += n
+            if half >= per_org:
+                break
+    assert counts[2] > 0, "weight-1 tenant starved"
+    ratio = counts[1] / counts[2]
+    assert 2.0 <= ratio <= 4.0, (ratio, counts)
+
+
+def test_high_never_quota_shed_and_ack_discipline():
+    cfg = QosConfig()
+    cfg.set_tenant(TenantQos(org_id=5, weight=1, rate_fps=1.0, burst=4.0))
+    hop = _RecHop()
+    observed = []
+    aq = AdmissionQueues(cfg, lambda *a: True, hop=hop,
+                         observe_seqs=observed.append)
+    # MID within burst admits, then the bucket is dry -> quota shed,
+    # and the shed group IS observed (acked: policy, not pressure)
+    assert aq.submit(5, 1, MessageType.METRICS, 0, _group(4), 0) \
+        == "admitted"
+    assert aq.submit(5, 1, MessageType.METRICS, 0, _group(4), 0) == "quota"
+    assert len(observed) == 1 and len(observed[0]) == 4
+    assert hop.reasons == {"quota": 4}
+    # HIGH sails past the same dry bucket — quota never sheds HIGH
+    assert aq.submit(5, 0, MessageType.L7_LOG, 0, _group(4), 0) \
+        == "admitted"
+    snap = aq.tenant_snapshot()[5]
+    assert snap["shed_quota"] == 4
+    assert snap["admitted"] == 8
+    assert snap["depth"] == {"high": 4, "mid": 4, "low": 0}
+
+
+def test_high_queue_full_is_unacked_backpressure():
+    cfg = QosConfig(queue_frames=4, high_block_s=0.05)
+    hop = _RecHop()
+    observed = []
+    aq = AdmissionQueues(cfg, lambda *a: True, hop=hop,
+                         observe_seqs=observed.append)
+    # no drain running: the HIGH queue fills and stays full
+    assert aq.submit(7, 0, MessageType.L7_LOG, 0, _group(4), 0) \
+        == "admitted"
+    t0 = time.monotonic()
+    assert aq.submit(7, 0, MessageType.L7_LOG, 0, _group(1), 0) \
+        == "queue_full"
+    # it WAITED for the drain first (that wait is the backpressure) ...
+    assert time.monotonic() - t0 >= 0.04
+    # ... and the shed is NOT observed: ack withheld -> retransmit
+    assert observed == []
+    assert hop.reasons == {"queue_full": 1}
+    assert aq.tenant_snapshot()[7]["shed_queue_full"] == 1
+    assert aq.tenant_snapshot()[7]["high_wait_ns"] > 0
+
+
+def test_admission_conserves_on_hop_ledger():
+    cfg = QosConfig()
+    cfg.set_tenant(TenantQos(org_id=9, weight=1, rate_fps=1.0, burst=8.0))
+    hop = _RecHop()
+    aq = AdmissionQueues(cfg, lambda *a: True, hop=hop,
+                         observe_seqs=lambda g: None)
+    for _ in range(6):
+        aq.submit(9, 1, MessageType.METRICS, 0, _group(4), 0)
+    aq.start()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        with aq._lock:
+            if all(t.total_depth() == 0 for t in aq._tenants.values()):
+                break
+        time.sleep(0.01)
+    aq.stop()
+    # receiver accounts emitted=24 upstream; admission splits the rest
+    assert hop.delivered + hop.dropped == 24
+    assert hop.delivered == aq.stats["delivered"]
+    assert hop.reasons.get("quota", 0) == aq.stats["shed_quota"] > 0
+
+
+# -- pressure controller ------------------------------------------------------
+
+def test_pressure_raises_immediately_and_decays_stepwise():
+    cfg = QosConfig(decay_s=0.15)
+    fill = {"v": 0.0}
+    pc = PressureController(cfg, decoder_fill=lambda: fill["v"])
+    fill["v"] = 0.95
+    pc.evaluate_once()
+    assert pc.level(0) == 3                   # critical bites at once
+    fill["v"] = 0.0
+    pc.evaluate_once()
+    assert pc.level(0) == 3                   # hysteresis holds the level
+    time.sleep(0.2)
+    pc.evaluate_once()
+    assert pc.level(0) == 2                   # one notch per decay_s
+    pc.evaluate_once()
+    assert pc.level(0) == 2                   # not two notches at once
+    time.sleep(0.2)
+    pc.evaluate_once()
+    assert pc.level(0) == 1
+    fill["v"] = 0.80
+    pc.evaluate_once()
+    assert pc.level(0) == 2                   # re-raise is immediate
+    assert pc.stats["raises"] >= 2 and pc.stats["decays"] == 2
+    d = pc.directive(42)
+    assert d["pressure_level"] == 2
+    assert d["sample_rate"] == cfg.sample_rates[2]
+    assert d["weight"] == 1 and d["rate_fps"] == 0.0
+
+
+# -- adaptive sampling --------------------------------------------------------
+
+class _FakePressure:
+    def __init__(self, lvl=0):
+        self.lvl = lvl
+
+    def level(self, org_id=0):
+        return self.lvl
+
+
+def test_sampler_is_deterministic_and_rate_accurate():
+    tele = _FakeTelemetry()
+    sampler = AdaptiveSampler(QosConfig(), pressure=_FakePressure(2),
+                              telemetry=tele)  # level 2 -> rate 0.5
+    first = [sampler.keep(7, k) for k in range(2000)]
+    kept = sum(first)
+    assert 800 < kept < 1200                   # ~0.5 on a uniform hash
+    # identical keys -> identical decisions (replay/retransmit safe)
+    assert [sampler.keep(7, k) for k in range(2000)] == first
+    assert sample_hash01(7, 123) == sample_hash01(7, 123)
+    assert sample_hash01(7, 123) != sample_hash01(8, 123)
+    # conservation on the qos.sample hop
+    h = tele.h
+    assert h.emitted == h.delivered + h.dropped == 4000
+    assert h.reasons == {"adaptive_sample": h.dropped}
+
+
+def test_sampler_always_keeps_exemplars():
+    sampler = AdaptiveSampler(QosConfig(sample_rates=(1.0, 1.0, 0.5, 0.0)),
+                              pressure=_FakePressure(3))
+    assert all(sampler.keep(3, k, exemplar=True) for k in range(100))
+    assert not any(sampler.keep(3, k) for k in range(100))  # rate 0 bulk
+    st = sampler.snapshot()["3"]
+    assert st["exemplars"] == 100 and st["kept"] == 100
+    assert st["dropped"] == 100 and st["rate"] == 0.0
+    assert sampler.is_slow_ns(int(600e6))      # 600ms >= 500ms default
+    assert not sampler.is_slow_ns(int(10e6))
+
+
+# -- directive plumbing (controller Sync -> agent) ----------------------------
+
+def test_qos_directive_rides_sync_response():
+    grpc = pytest.importorskip("grpc")
+    from deepflow_tpu.proto import pb
+    from deepflow_tpu.server.controller import Controller
+    from deepflow_tpu.server.platform_info import PlatformInfoTable
+
+    qos = Qos(QosConfig())
+    qos.attach(lambda *a: True, decoder_fill=lambda: 0.95)
+    qos.pressure.evaluate_once()               # global -> critical
+    ctrl = Controller(PlatformInfoTable(), host="127.0.0.1", port=0,
+                      qos=qos).start()
+    ch = None
+    try:
+        ch = grpc.insecure_channel(f"127.0.0.1:{ctrl.port}")
+        sync = ch.unary_unary(
+            "/deepflow_tpu.Synchronizer/Sync",
+            request_serializer=pb.SyncRequest.SerializeToString,
+            response_deserializer=pb.SyncResponse.FromString)
+        resp = sync(pb.SyncRequest(ctrl_ip="10.9.0.1",
+                                   hostname="qos-agent"), timeout=10)
+        assert resp.HasField("qos")
+        assert resp.qos.pressure_level == 3
+        assert abs(resp.qos.sample_rate
+                   - QosConfig().sample_rates[3]) < 1e-9
+        assert resp.qos.weight == 1
+        assert resp.qos.updated_ns > 0
+    finally:
+        if ch is not None:
+            ch.close()
+        ctrl.stop()
+
+
+def test_disabled_qos_stamps_no_directive():
+    grpc = pytest.importorskip("grpc")
+    from deepflow_tpu.proto import pb
+    from deepflow_tpu.server.controller import Controller
+    from deepflow_tpu.server.platform_info import PlatformInfoTable
+
+    cfg = QosConfig()
+    cfg.enabled = False
+    ctrl = Controller(PlatformInfoTable(), host="127.0.0.1", port=0,
+                      qos=Qos(cfg)).start()
+    ch = None
+    try:
+        ch = grpc.insecure_channel(f"127.0.0.1:{ctrl.port}")
+        sync = ch.unary_unary(
+            "/deepflow_tpu.Synchronizer/Sync",
+            request_serializer=pb.SyncRequest.SerializeToString,
+            response_deserializer=pb.SyncResponse.FromString)
+        resp = sync(pb.SyncRequest(ctrl_ip="10.9.0.2",
+                                   hostname="no-qos"), timeout=10)
+        assert not resp.HasField("qos")
+    finally:
+        if ch is not None:
+            ch.close()
+        ctrl.stop()
+
+
+def test_agent_backpressure_scales_probes_and_restores():
+    from deepflow_tpu.agent.agent import Agent
+    from deepflow_tpu.agent.config import AgentConfig
+
+    a = Agent.__new__(Agent)                   # no sockets, no threads
+    a.config = AgentConfig()
+    a.pressure_level = 0
+    a._profiler_lock = threading.Lock()
+    hz = a.config.profiler.sample_hz
+    emit = a.config.profiler.emit_interval_s
+    a.sampler = types.SimpleNamespace(
+        period_s=1.0 / hz, period_us=int(1_000_000 / hz),
+        emit_interval_s=emit)
+    a.tpuprobe = None
+
+    a.apply_backpressure(2)
+    assert a.pressure_level == 2
+    want_hz = max(1.0, hz * a.config.qos.hz_scale[2])
+    assert abs(a.sampler.period_s - 1.0 / want_hz) < 1e-9
+    assert a.sampler.emit_interval_s == emit * a.config.qos.emit_scale[2]
+
+    a.apply_backpressure(0)                    # level 0 restores exactly
+    assert a.pressure_level == 0
+    assert abs(a.sampler.period_s - 1.0 / hz) < 1e-9
+    assert a.sampler.emit_interval_s == emit
+
+    a.apply_backpressure(99)                   # clamped to 3
+    assert a.pressure_level == 3
+    a.config.qos.enabled = False               # kill switch: inert
+    a.apply_backpressure(0)
+    assert a.pressure_level == 3
+
+
+# -- sender replay priority (satellite: HIGH before MID/LOW) ------------------
+
+class _FakeSpool:
+    on_evict = None
+
+    def __init__(self, entries):
+        self.entries = entries                 # (msg_type_int, seq, payload)
+
+    def replay(self, after_seq):
+        return [e for e in self.entries if e[1] > after_seq]
+
+    def pending_records(self):
+        return len(self.entries)
+
+    def max_seq(self):
+        return max((e[1] for e in self.entries), default=0)
+
+    def min_pending_seq(self):
+        return min((e[1] for e in self.entries), default=0)
+
+
+def test_reconnect_retransmit_replays_high_class_first():
+    from deepflow_tpu.agent.sender import UniformSender, _Frame
+
+    s = UniformSender([("127.0.0.1", 1)], durable=True)
+    base = s.seq_base
+    arrived = [(MessageType.DFSTATS, 1), (MessageType.L7_LOG, 2),
+               (MessageType.METRICS, 3), (MessageType.L7_LOG, 4),
+               (MessageType.DFSTATS, 5)]
+    for mt, i in arrived:
+        s._unacked[base + i] = _Frame(mt, b"", base + i, None)
+    s._close()
+    got = [(f.msg_type, f.seq - base) for f in s._pending]
+    assert got == [(MessageType.L7_LOG, 2), (MessageType.L7_LOG, 4),
+                   (MessageType.METRICS, 3), (MessageType.DFSTATS, 1),
+                   (MessageType.DFSTATS, 5)]
+    # class-major, seq within class — never plain seq order
+    assert [priority_of(mt) for mt, _ in got] == sorted(
+        priority_of(mt) for mt, _ in got)
+
+
+def test_spool_replay_orders_high_before_mid_low():
+    from deepflow_tpu.agent.sender import UniformSender
+
+    spool = _FakeSpool([(int(MessageType.DFSTATS), 101, b"a"),
+                        (int(MessageType.L7_LOG), 102, b"b"),
+                        (int(MessageType.METRICS), 103, b"c"),
+                        (int(MessageType.L7_LOG), 104, b"d")])
+    s = UniformSender([("127.0.0.1", 1)], durable=True, spool=spool)
+    s._load_replay()
+    assert [f.msg_type for f in s._pending] == [
+        MessageType.L7_LOG, MessageType.L7_LOG, MessageType.METRICS,
+        MessageType.DFSTATS]
+    assert s.stats["replayed"] == 4

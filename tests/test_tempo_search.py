@@ -84,9 +84,11 @@ def test_search_filters(server):
     out = get(server, "/api/search?limit=1")
     assert len(out["traces"]) == 1
     # bare search (no range) defaults to the last hour: historic fixture
-    # data is out of scope
+    # data is out of scope (dogfooded query traces from the searches
+    # above are legitimately inside the window, so only assert the
+    # fixture traces are absent)
     out = get(server, "/api/search", in_range=False)
-    assert out["traces"] == []
+    assert not {t["traceID"] for t in out["traces"]} & {"aaa", "bbb"}
     # end-only search is ALSO bounded (end-1h), not a full-history scan
     out = get(server, f"/api/search?end={END}", in_range=False)
     assert {t["traceID"] for t in out["traces"]} == {"aaa", "bbb"}
