@@ -3,7 +3,7 @@ JAX_ENV := env JAX_PLATFORMS=cpu
 
 .PHONY: test selfmon-check cluster-check steps-check chaos-check ha-check \
 	query-check ingest-check storage-check compaction-check readtier-check \
-	trace-check overload-check bench native
+	trace-check overload-check live-check bench native
 
 test:
 	timeout -k 10 870 $(JAX_ENV) $(PYTHON) -m pytest tests/ -q -m 'not slow' \
@@ -55,6 +55,15 @@ steps-check:
 # warm/cold cache latency report; exits non-zero on any divergence.
 query-check:
 	timeout -k 10 120 $(JAX_ENV) $(PYTHON) -m deepflow_tpu.cli.query_check
+
+# Live-observability gate: standing queries under a 1M-row window must
+# refresh incrementally >=10x faster than from-scratch and byte-identical
+# to it (and to the DF_STANDING=0 kill-switch), 3 concurrent subscribers
+# each see every generation exactly once, a breached alert fires via push
+# within 2s, a 3-shard federated delta recomputes only the changed shard,
+# and the query.standing / exporter.<kind> hop ledgers conserve.
+live-check:
+	timeout -k 10 600 $(JAX_ENV) $(PYTHON) -m deepflow_tpu.cli.live_check
 
 # Native ingest throughput gate: same L4 frames through the native
 # columnar path and the DF_NO_NATIVE pb fallback; exits non-zero unless

@@ -215,7 +215,8 @@ def _run_ingest(make_frame, n_batches: int = 400,
                 selfmon: bool | None = None,
                 no_native: bool = False,
                 storage_dir: str | None = None,
-                qos: bool | None = None) -> dict:
+                qos: bool | None = None,
+                standing: int | None = None) -> dict:
     """Send n_batches pre-serialized frames through the real receiver ->
     decoder -> columnar store; returns rows/s plus the per-stage split
     (recv parse, payload decode, dictionary encode, store write) so the
@@ -244,6 +245,27 @@ def _run_ingest(make_frame, n_batches: int = 400,
         server.start()
         try:
             frame, table_name, msg_type = make_frame()
+            if standing:
+                # a dashboard's worth of live queries riding the ingest
+                # table: dirty-marking happens on every append, so this
+                # is the standing-query cost the overhead gate measures
+                shapes = [
+                    "SELECT Count(*) AS n FROM t",
+                    "SELECT Sum(byte_tx) AS b FROM t",
+                    "SELECT Max(byte_tx) AS m FROM t",
+                    "SELECT Avg(packet_tx) AS p FROM t",
+                    "SELECT ip_src, Count(*) AS n FROM t GROUP BY ip_src",
+                    "SELECT ip_src, Sum(byte_tx) AS b FROM t "
+                    "GROUP BY ip_src",
+                    "SELECT ip_dst, Sum(packet_tx) AS p FROM t "
+                    "GROUP BY ip_dst",
+                    "SELECT ip_src, ip_dst, Count(*) AS n FROM t "
+                    "GROUP BY ip_src, ip_dst",
+                ]
+                for i in range(standing):
+                    server.standing.register(
+                        shapes[i % len(shapes)], name=f"bench-{i}",
+                        table=table_name)
             sock = socket.create_connection(
                 ("127.0.0.1", server.ingest_port))
             t0 = time.perf_counter()
@@ -335,6 +357,32 @@ def _bench_selfmon_overhead() -> dict:
         # perf guard in the same spirit as ingest/pps_below_target:
         # a telemetry-cost regression must be visible in-round
         "selfmon_overhead_above_gate": pct > 2.0,
+    }
+
+
+def _bench_standing_overhead() -> dict:
+    """Standing-query overhead gate (PR 18): eight registered live
+    queries dirty-mark on every ingest append, but the refolds run on
+    the registry's own thread — ingest throughput must not pay more
+    than 2% for a dashboard's worth of standing queries. Methodology:
+    adjacent on/off pairs, median of the per-pair ratios — host
+    throughput drifts more between back-to-back blocks than the 2%
+    being measured, so unpaired best-of-N flags phantom overhead;
+    pairing cancels the drift and the median drops scheduler-noise
+    tails (same reasoning as the query-trace gate's alternation)."""
+    pairs = []
+    for _ in range(5):
+        on = _run_ingest(_make_l4_frame, standing=8)["rows_per_sec"]
+        off = _run_ingest(_make_l4_frame)["rows_per_sec"]
+        pairs.append((on, off))
+    ratio = statistics.median(on / off for on, off in pairs if off)
+    pct = (1.0 - ratio) * 100.0
+    return {
+        "standing_rows_per_sec_on": max(p[0] for p in pairs),
+        "standing_rows_per_sec_off": max(p[1] for p in pairs),
+        "standing_queries": 8,
+        "standing_overhead_pct": round(max(0.0, pct), 2),
+        "standing_overhead_above_gate": pct > 2.0,
     }
 
 
@@ -1493,6 +1541,7 @@ def main() -> None:
     cpu_detail.update(_bench_packet_path())
     cpu_detail.update(_bench_ingest())
     cpu_detail.update(_bench_selfmon_overhead())
+    cpu_detail.update(_bench_standing_overhead())
     cpu_detail.update(_bench_qos_overhead())
     cpu_detail.update(_bench_transport())
     cpu_detail.update(_bench_steps())

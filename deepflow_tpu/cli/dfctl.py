@@ -13,6 +13,8 @@ Reference analog: cli/ctl/*.go (deepflow-ctl). Subcommands:
     dfctl promql 'histogram_quantile(0.95, rate(lat_bucket[5m]))'
     dfctl alert list|set <json>|delete <name>
     dfctl exporter list|add <json>|delete <endpoint>
+    dfctl watch "SELECT ..." --window 300
+    dfctl events --follow
     dfctl replay capture.pcap --ingest host:20033
 """
 
@@ -83,6 +85,33 @@ def _load_json_arg(spec: str) -> dict:
         return json.loads(spec)
     except json.JSONDecodeError as e:
         raise SystemExit(f"bad json spec: {e}\n{spec}")
+
+
+def _subscribe_updates(server: str, sid: str, use_poll: bool = False):
+    """Yield standing-query updates for one subscriber: SSE stream
+    first, transparent long-poll fallback (old servers, proxies that
+    buffer event streams)."""
+    if not use_poll:
+        try:
+            req = urllib.request.Request(
+                f"http://{server}/v1/subscribe?subscriber={sid}")
+            resp = urllib.request.urlopen(req, timeout=30)
+            while True:
+                line = resp.readline()
+                if not line:
+                    return
+                if line.startswith(b"data: "):
+                    yield json.loads(line[6:])
+            # unreachable
+        except (urllib.error.HTTPError, urllib.error.URLError):
+            pass  # fall through to long-poll
+    while True:
+        out = _api(server, "/v1/subscribe",
+                   {"action": "poll", "subscriber": sid,
+                    "timeout_s": 25})
+        yield from out["updates"]
+        if out.get("closed"):
+            return
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -281,6 +310,40 @@ def main(argv: list[str] | None = None) -> int:
     p_exec.add_argument("command")
     p_exec.add_argument("cargs", nargs="*")
     p_exec.add_argument("--timeout", type=float, default=30.0)
+
+    p_watch = sub.add_parser(
+        "watch", help="register a standing query and render live "
+                      "updates: the server maintains it incrementally "
+                      "and pushes each new generation over SSE "
+                      "(long-poll fallback)")
+    p_watch.add_argument("sql")
+    p_watch.add_argument("--name", default=None,
+                         help="standing-query name (default: derived)")
+    p_watch.add_argument("--table", default=None,
+                         help="explicit table (default: FROM clause)")
+    p_watch.add_argument("--window", type=float, default=0.0,
+                         metavar="SECONDS",
+                         help="sliding window anchored on newest data")
+    p_watch.add_argument("--org", type=int, default=None)
+    p_watch.add_argument("--poll", action="store_true",
+                         help="force long-poll instead of SSE")
+    p_watch.add_argument("--keep", action="store_true",
+                         help="leave the query registered on exit")
+    p_watch.add_argument("--count", type=int, default=0,
+                         help="exit after N updates (0 = forever)")
+
+    p_events = sub.add_parser(
+        "events", help="event.event rows (alerts, rule errors, step "
+                       "regressions); --follow tails new events over "
+                       "the standing-query push API")
+    p_events.add_argument("--follow", "-f", action="store_true")
+    p_events.add_argument("--type", default=None,
+                          help="filter by event_type")
+    p_events.add_argument("--limit", type=int, default=50)
+    p_events.add_argument("--poll", action="store_true",
+                          help="force long-poll instead of SSE")
+    p_events.add_argument("--count", type=int, default=0,
+                          help="follow: exit after N new events")
 
     p_exp = sub.add_parser("exporter")
     p_exp.add_argument("action", choices=["list", "add", "delete"])
@@ -939,6 +1002,90 @@ def main(argv: list[str] | None = None) -> int:
             out = _api(args.server, "/v1/alerts/delete",
                        {"name": args.spec})
             print(f"deleted: {out['deleted']}")
+    elif args.cmd == "watch":
+        reg = _api(args.server, "/v1/subscribe",
+                   {"action": "register", "sql": args.sql,
+                    "name": args.name, "table": args.table,
+                    "window_s": args.window,
+                    "org_id": args.org})["registered"]
+        qname = reg["name"]
+        sub_out = _api(args.server, "/v1/subscribe",
+                       {"action": "subscribe", "queries": [qname]})
+        sid = sub_out["subscriber"]
+        print(f"watching {qname} on {reg['table']} "
+              f"(window {reg['window_s'] or '-'}s, subscriber {sid}) "
+              f"— ^C to stop")
+        seen = 0
+        try:
+            for u in _subscribe_updates(args.server, sid,
+                                        use_poll=args.poll):
+                if u.get("query") != qname:
+                    continue
+                d = u.get("delta") or {}
+                print(f"\n== gen {u['gen']}  mode={u['mode']}  "
+                      f"refresh {u.get('refresh_ms', 0)}ms  "
+                      f"(+{len(d.get('added', []))} "
+                      f"-{len(d.get('removed', []))} rows)")
+                print_table(u["columns"], u["rows"])
+                seen += 1
+                if args.count and seen >= args.count:
+                    break
+        except KeyboardInterrupt:
+            pass
+        finally:
+            try:
+                _api(args.server, "/v1/subscribe",
+                     {"action": "unsubscribe", "subscriber": sid})
+                if not args.keep:
+                    _api(args.server, "/v1/subscribe",
+                         {"action": "unregister", "name": qname})
+            except SystemExit:
+                pass
+    elif args.cmd == "events":
+        ev_sql = ("SELECT time, event_type, resource_type, "
+                  "resource_name, description FROM event")
+        if args.type:
+            safe = args.type.replace("'", "")
+            ev_sql += f" WHERE event_type = '{safe}'"
+        if not args.follow:
+            out = _api(args.server, "/v1/query/",
+                       {"db": "event",
+                        "sql": ev_sql + f" ORDER BY time DESC "
+                                        f"LIMIT {args.limit}"})
+            r = out["result"]
+            print_table(r["columns"], r["values"])
+            return 0
+        reg = _api(args.server, "/v1/subscribe",
+                   {"action": "register", "sql": ev_sql,
+                    "table": "event.event"})["registered"]
+        sub_out = _api(args.server, "/v1/subscribe",
+                       {"action": "subscribe",
+                        "queries": [reg["name"]]})
+        sid = sub_out["subscriber"]
+        print(f"following event.event (subscriber {sid}) — ^C to stop")
+        first = True
+        seen = 0
+        try:
+            for u in _subscribe_updates(args.server, sid,
+                                        use_poll=args.poll):
+                added = (u.get("delta") or {}).get("added", [])
+                if first:
+                    # baseline snapshot: show the tail, then deltas only
+                    added = sorted(added)[-args.limit:]
+                    first = False
+                for row in added:
+                    print("  ".join(str(v) for v in row))
+                seen += len(added)
+                if args.count and seen >= args.count:
+                    break
+        except KeyboardInterrupt:
+            pass
+        finally:
+            try:
+                _api(args.server, "/v1/subscribe",
+                     {"action": "unsubscribe", "subscriber": sid})
+            except SystemExit:
+                pass
     elif args.cmd == "exporter":
         if args.action == "list":
             out = _api(args.server, "/v1/exporters")

@@ -1,0 +1,415 @@
+"""live-check: standing-query / push-subscription / streaming-alert gate.
+
+Proves the live-observability surface end to end against real servers:
+
+  * a 1M-row flow-log window with a registered dashboard standing query
+    under sustained ingest — incremental refresh must be >= 10x faster
+    than a from-scratch execute of the same windowed SQL at small
+    deltas, and byte-identical to it (DF_STANDING=0 kill-switch arm
+    must also be byte-identical);
+  * 3 concurrent subscribers each receive every generation exactly
+    once, in order, with the conserved ``query.standing`` hop ledger
+    balancing after they detach;
+  * a threshold alert breached by an append must fire (event.event row
+    written, rule firing) within 2 seconds — push evaluation, no poll;
+  * a 3-shard federated standing query stays byte-identical to a
+    single node holding the union, and a delta landing on ONE shard
+    recomputes only that shard (if_state machinery: the other shard
+    answers "unchanged");
+  * an exporter ships rows with a conserved ``exporter.<kind>`` ledger.
+
+Wired as `make live-check` — the CI gate for PR 18's live surface.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+BASE_NS = 1_600_000_000_000_000_000
+BUCKET_NS = 60_000_000_000
+N_BUCKETS = 30
+ROWS_TOTAL = 1_000_000
+GROUPS = 8
+SQL = ("SELECT app_service, Count(*) AS n, Sum(response_duration) AS s "
+       "FROM l7_flow_log GROUP BY app_service ORDER BY app_service")
+
+
+def _fail(msg: str) -> None:
+    print(f"live-check: FAIL: {msg}")
+    sys.exit(1)
+
+
+def _post(port: int, path: str, body: dict, timeout: float = 30) -> dict:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _get(port: int, path: str) -> dict:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def _canon(values) -> str:
+    return json.dumps(values, sort_keys=True, default=str)
+
+
+def _seed(table, rows: int = ROWS_TOTAL) -> None:
+    per_bucket = rows // N_BUCKETS
+    per_group = per_bucket // GROUPS
+    for b in range(N_BUCKETS):
+        for g in range(GROUPS):
+            i = np.arange(per_group, dtype=np.uint64)
+            table.append_columns(
+                {"time": BASE_NS + b * BUCKET_NS
+                 + (g * per_group + i) * 1_000,
+                 "app_service": f"svc-{g:03d}",
+                 "response_duration": (i * 37) % 5_000},
+                n=per_group)
+
+
+def _drain(port: int, sid: str, sink: list, stop: threading.Event) -> None:
+    """One subscriber: long-poll until stopped, recording every update."""
+    while not stop.is_set():
+        out = _post(port, "/v1/subscribe",
+                    {"action": "poll", "subscriber": sid,
+                     "timeout_s": 2})
+        sink.extend(out["updates"])
+        if out.get("closed"):
+            return
+
+
+def _local_arm() -> None:
+    from deepflow_tpu.query import engine
+    from deepflow_tpu.server import Server
+
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0,
+                    sync_port=0).start()
+    try:
+        table = server.db.table("flow_log.l7_flow_log")
+        t0 = time.perf_counter()
+        _seed(table)
+        print(f"live-check: seeded {len(table):,} rows in "
+              f"{time.perf_counter() - t0:.1f}s")
+
+        reg = _post(server.query_port, "/v1/subscribe",
+                    {"action": "register", "sql": SQL, "name": "dash",
+                     "table": "flow_log.l7_flow_log",
+                     "window_s": float(N_BUCKETS * 60)})["registered"]
+        if reg["gen"] != 1:
+            _fail(f"register did not return gen 1: {reg}")
+
+        # 3 concurrent subscribers, each with its own drain thread
+        sids, sinks, threads = [], [], []
+        stop = threading.Event()
+        for _ in range(3):
+            sid = _post(server.query_port, "/v1/subscribe",
+                        {"action": "subscribe",
+                         "queries": ["dash"]})["subscriber"]
+            sids.append(sid)
+            sink: list = []
+            sinks.append(sink)
+            th = threading.Thread(target=_drain,
+                                  args=(server.query_port, sid, sink,
+                                        stop), daemon=True)
+            th.start()
+            threads.append(th)
+
+        # sustained ingest: 10 small deltas into the newest bucket
+        deltas = 10
+        hi = BASE_NS + (N_BUCKETS - 1) * BUCKET_NS
+        for d in range(deltas):
+            table.append_rows([
+                {"time": hi + 50_000_000_000 + d * 1_000 + j,
+                 "app_service": "svc-000",
+                 "response_duration": 100 + j}
+                for j in range(200)])
+            time.sleep(0.35)   # > MIN_GAP_S: every delta becomes a gen
+
+        # wait until every subscriber has seen the final generation
+        sq = server.standing.get("dash")
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if all(any(u["gen"] == sq.gen for u in s) for s in sinks):
+                break
+            time.sleep(0.1)
+        stop.set()
+        for th in threads:
+            th.join(timeout=5)
+
+        # exactly-once per (subscriber, generation), in order, complete
+        for i, sink in enumerate(sinks):
+            gens = [u["gen"] for u in sink if u["query"] == "dash"]
+            if not gens:
+                _fail(f"subscriber {i} saw no updates")
+            if len(gens) != len(set(gens)):
+                _fail(f"subscriber {i} saw a generation twice: {gens}")
+            if gens != sorted(gens):
+                _fail(f"subscriber {i} saw generations out of order: "
+                      f"{gens}")
+            if gens != list(range(gens[0], gens[0] + len(gens))):
+                _fail(f"subscriber {i} has a generation gap: {gens}")
+            if gens[-1] != sq.gen:
+                _fail(f"subscriber {i} missed the final gen "
+                      f"{sq.gen}: {gens}")
+        n_gens = len([u for u in sinks[0] if u["query"] == "dash"])
+        print(f"live-check: {deltas} deltas -> {n_gens} generations, "
+              f"each delivered exactly once to 3 subscribers: OK")
+
+        # incremental >= 10x from-scratch on small deltas, byte-identical
+        inc_ms = [u["refresh_ms"] for u in sinks[0]
+                  if u["mode"] == "incremental"]
+        if len(inc_ms) < 3:
+            _fail(f"too few incremental refreshes: {inc_ms}")
+        _brange, wsel = server.standing._window(sq)
+        full_ms = []
+        for _ in range(5):
+            f0 = time.perf_counter()
+            ref = engine.execute(table, wsel)
+            full_ms.append((time.perf_counter() - f0) * 1e3)
+        if _canon(json.loads(_canon(ref.values))) != _canon(sq.rows):
+            _fail("standing rows diverge from from-scratch execute")
+        inc = statistics.median(inc_ms)
+        full = statistics.median(full_ms)
+        speedup = full / max(inc, 1e-9)
+        if speedup < 10.0:
+            _fail(f"incremental refresh only {speedup:.1f}x faster than "
+                  f"from-scratch ({inc:.2f}ms vs {full:.2f}ms; need 10x)")
+        print(f"live-check: incremental {inc:.2f}ms vs from-scratch "
+              f"{full:.2f}ms ({speedup:.1f}x, >=10x floor), "
+              f"byte-identical: OK")
+
+        # kill-switch arm: DF_STANDING=0 must give the same bytes
+        os.environ["DF_STANDING"] = "0"
+        try:
+            _post(server.query_port, "/v1/subscribe",
+                  {"action": "register", "sql": SQL, "name": "dash-off",
+                   "table": "flow_log.l7_flow_log",
+                   "window_s": float(N_BUCKETS * 60)})
+            off = server.standing.get("dash-off")
+            if off.counters["full"] < 1 or off.counters["incremental"]:
+                _fail(f"kill-switch arm still folded incrementally: "
+                      f"{off.counters}")
+            if _canon(off.rows) != _canon(sq.rows):
+                _fail("DF_STANDING=0 result diverges from incremental")
+        finally:
+            os.environ.pop("DF_STANDING", None)
+            _post(server.query_port, "/v1/subscribe",
+                  {"action": "unregister", "name": "dash-off"})
+        print("live-check: DF_STANDING=0 kill-switch byte-identical: OK")
+
+        # streaming alert: breach -> event row within 2s, no polling
+        _post(server.query_port, "/v1/alerts", {
+            "name": "errors-high", "db": "flow_log",
+            "sql": "SELECT Count(*) FROM l7_flow_log "
+                   "WHERE response_code = 500",
+            "op": ">", "threshold": 5, "interval_s": 999})
+        rule = server.alerts.rules["errors-high"]
+        if rule.standing_name != "alert:errors-high":
+            _fail(f"alert rule not standing-backed: {rule.standing_name}")
+        a0 = time.perf_counter()
+        table.append_rows([
+            {"time": hi + 55_000_000_000 + j, "app_service": "svc-000",
+             "response_code": 500, "response_duration": 1}
+            for j in range(10)])
+        while time.perf_counter() - a0 < 5.0 and not rule.firing:
+            time.sleep(0.01)
+        fire_s = time.perf_counter() - a0
+        if not rule.firing:
+            _fail("alert never fired after breaching append")
+        if fire_s > 2.0:
+            _fail(f"alert fired after {fire_s:.2f}s (need < 2s)")
+        ev = server.db.table("event.event")
+        deadline = time.time() + 5
+        while time.time() < deadline and not len(ev):
+            time.sleep(0.05)
+        r = engine.execute(
+            ev,
+            "SELECT resource_name FROM event WHERE event_type = 'alert'")
+        if not r.values or r.values[0][0] != "errors-high":
+            _fail(f"no alert event row: {r.values}")
+        print(f"live-check: alert fired {fire_s * 1e3:.0f}ms after the "
+              f"breaching append (push-evaluated, <2s gate): OK")
+
+        # detach everyone; the hop ledger must balance
+        for sid in sids:
+            _post(server.query_port, "/v1/subscribe",
+                  {"action": "unsubscribe", "subscriber": sid})
+        led = _get(server.query_port, "/v1/health")["standing"]["ledger"]
+        if led["emitted"] != led["delivered"] + led["dropped_total"] \
+                + led["in_flight"]:
+            _fail(f"query.standing ledger does not conserve: {led}")
+        if led["in_flight"] != 0:
+            _fail(f"updates stranded in flight after detach: {led}")
+        print(f"live-check: query.standing ledger conserved "
+              f"(emitted {led['emitted']} = delivered {led['delivered']}"
+              f" + dropped {led['dropped_total']}): OK")
+    finally:
+        server.stop()
+
+
+def _federated_arm() -> None:
+    from deepflow_tpu.query import engine
+    from deepflow_tpu.server import Server
+
+    def _rows(shard_tag: int, n: int, t_off: int = 0) -> list[dict]:
+        return [{"time": BASE_NS + t_off + i * 1_000_000,
+                 "app_service": f"svc-{(i + shard_tag) % 5:03d}",
+                 "response_duration": (i * 13) % 900}
+                for i in range(n)]
+
+    servers: list = []
+    try:
+        solo = Server(host="127.0.0.1", ingest_port=0, query_port=0,
+                      sync_port=0).start()
+        servers.append(solo)
+        seed = Server(host="127.0.0.1", ingest_port=0, query_port=0,
+                      sync_port=0, shard_id=1,
+                      cluster_advertise="").start()
+        servers.append(seed)
+        addr = f"127.0.0.1:{seed.query_port}"
+        shards = [seed]
+        for sid in (2, 3):
+            s = Server(host="127.0.0.1", ingest_port=0, query_port=0,
+                       sync_port=0, shard_id=sid,
+                       cluster_seed=addr).start()
+            servers.append(s)
+            shards.append(s)
+        deadline = time.time() + 15.0
+        while time.time() < deadline:
+            if len(seed.api.federation.remote_peers()) == 2:
+                break
+            time.sleep(0.2)
+        else:
+            _fail("federated arm: membership never converged")
+
+        for i, s in enumerate(shards):
+            rows = _rows(i, 2_000)
+            s.db.table("flow_log.l7_flow_log").append_rows(rows)
+            solo.db.table("flow_log.l7_flow_log").append_rows(rows)
+
+        _post(seed.query_port, "/v1/subscribe",
+              {"action": "register", "sql": SQL, "name": "fed",
+               "table": "flow_log.l7_flow_log"})
+        sub = _post(seed.query_port, "/v1/subscribe",
+                    {"action": "subscribe", "queries": ["fed"]})
+        sq = seed.standing.get("fed")
+        gen0 = sq.gen
+        # let a couple of warm federation ticks pass, then baseline
+        time.sleep(1.5)
+        refetched0 = sq.counters["fed_shards_refetched"]
+        warm0 = sq.counters["fed_warm"]
+
+        delta = _rows(7, 300, t_off=5_000_000_000)
+        shards[2].db.table("flow_log.l7_flow_log").append_rows(delta)
+        solo.db.table("flow_log.l7_flow_log").append_rows(delta)
+        deadline = time.time() + 10
+        while time.time() < deadline and sq.gen == gen0:
+            time.sleep(0.05)
+        if sq.gen == gen0:
+            _fail("federated arm: remote delta never produced a new gen")
+        time.sleep(1.0)   # settle back into warm ticks
+
+        want = engine.execute(
+            solo.db.table("flow_log.l7_flow_log"), SQL)
+        if _canon(json.loads(_canon(want.values))) != _canon(sq.rows):
+            _fail("federated standing rows diverge from single node")
+        refetched = sq.counters["fed_shards_refetched"] - refetched0
+        if not 1 <= refetched <= 2:
+            _fail(f"federated arm: expected only the changed shard to "
+                  f"recompute, saw {refetched} refetches")
+        if sq.counters["fed_shards_unchanged"] == 0:
+            _fail("federated arm: no shard ever answered 'unchanged'")
+        if sq.counters["fed_warm"] <= warm0:
+            _fail("federated arm: no warm (zero-work) tick observed")
+        out = _post(seed.query_port, "/v1/subscribe",
+                    {"action": "poll", "subscriber": sub["subscriber"],
+                     "timeout_s": 5})
+        gens = [u["gen"] for u in out["updates"] if u["query"] == "fed"]
+        if sq.gen not in gens:
+            _fail(f"federated arm: push missed gen {sq.gen}: {gens}")
+        print(f"live-check: 3-shard federated standing query "
+              f"byte-identical to single node; delta on one shard "
+              f"refetched {refetched} shard(s), others unchanged, "
+              f"warm ticks zero-work: OK")
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def _exporter_arm() -> None:
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from deepflow_tpu.server import Server
+
+    got = []
+
+    class H(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            got.append(self.rfile.read(n))
+            self.send_response(200)
+            self.end_headers()
+
+    sink = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=sink.serve_forever, daemon=True).start()
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0,
+                    sync_port=0).start()
+    try:
+        _post(server.query_port, "/v1/exporters", {
+            "type": "json-lines",
+            "endpoint": f"http://127.0.0.1:{sink.server_address[1]}/x",
+            "tables": ["application_log.log"]})
+        n = 40
+        for i in range(n):
+            _post(server.query_port, "/api/v1/log",
+                  {"service": "s", "message": f"m{i}"})
+        deadline = time.time() + 15
+        led = None
+        while time.time() < deadline:
+            ex = _get(server.query_port, "/v1/health").get("exporters", {})
+            led = next(iter(ex.values()), {}).get("ledger")
+            if led and led["delivered"] >= n:
+                break
+            time.sleep(0.1)
+        if not led:
+            _fail("no exporter ledger in /v1/health")
+        if led["emitted"] != led["delivered"] + led["dropped_total"] \
+                + led["in_flight"]:
+            _fail(f"exporter ledger does not conserve: {led}")
+        if led["delivered"] < n:
+            _fail(f"exporter delivered {led['delivered']}/{n}: {led}")
+        print(f"live-check: exporter.jsonlines ledger conserved "
+              f"(emitted {led['emitted']} = delivered {led['delivered']}"
+              f" + dropped {led['dropped_total']}): OK")
+    finally:
+        server.stop()
+        sink.shutdown()
+        sink.server_close()
+
+
+def main() -> int:
+    _local_arm()
+    _federated_arm()
+    _exporter_arm()
+    print("live-check: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -380,6 +380,8 @@ class FederationCoordinator:
             self._sql_cache.move_to_end(ck)
             info = dict(info)
             info["cache"] = "warm"
+            info["shards_unchanged"] = len(unchanged)
+            info["shards_refetched"] = 0
             return self._copy_result(ent["result"]), info
 
         if ent is not None and ent["local"] == local_token \
@@ -447,6 +449,9 @@ class FederationCoordinator:
                                         decoder=_decoder)
         info = dict(info)
         info["cache"] = "cold"
+        info["shards_unchanged"] = len(unchanged)
+        info["shards_refetched"] = len(
+            [sid for sid in parts_raw if sid not in unchanged])
         if cache_on:
             self._sql_cache[ck] = {
                 "local": local_token, "local_part": local_part,

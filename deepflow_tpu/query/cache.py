@@ -202,15 +202,25 @@ class QueryCache:
             return False
         return True
 
-    def _bucket_partials(self, table, query: S.Select, key: tuple):
+    def _bucket_partials(self, table, query: S.Select, key: tuple,
+                         bucket_range: tuple[int, int] | None = None,
+                         stats: dict | None = None):
         """Per-bucket encoded partials for an eligible aggregate query,
         reusing every bucket whose (write mark, dict gens) is unchanged.
-        None when the query/table isn't bucketable."""
+        None when the query/table isn't bucketable. bucket_range=(lo, hi)
+        folds only buckets with lo <= b < hi (the standing-query window
+        slice); stats, when given, is filled with bucket reuse counts."""
         if not self._bucketable(table, query):
             return None
         wm, marks, wide, div = table.bucket_marks()
         tc = getattr(table, "_time_col", None)
-        if div <= 0 or tc is None or wide or len(marks) > self.max_buckets:
+        if div <= 0 or tc is None or wide:
+            return None
+        sub = marks
+        if bucket_range is not None:
+            lo_b, hi_b = bucket_range
+            sub = {b: m for b, m in marks.items() if lo_b <= b < hi_b}
+        if len(sub) > self.max_buckets:
             return None
         gens = tuple((n, g) for n, g, _l in table.sync_state()[1])
         with self._lock:
@@ -221,11 +231,13 @@ class QueryCache:
                 while len(self._buckets) > self.max_entries:
                     self._buckets.popitem(last=False)
                     self.counters["evictions"] += 1
-            # buckets trimmed off the grid can never validate again
+            # buckets trimmed off the grid can never validate again —
+            # pruned against the FULL mark grid, so a windowed fold never
+            # evicts slices another caller of the same key still wants
             for b in [b for b in store if b not in marks]:
                 del store[b]
                 self.counters["bucket_pruned"] += 1
-        ordered = sorted(marks.items())
+        ordered = sorted(sub.items())
         slot: dict[int, dict] = {}
         stale: list[tuple[int, int]] = []
         for b, mark in ordered:
@@ -238,6 +250,9 @@ class QueryCache:
             else:
                 stale.append((b, mark))
         qtrace.annotate(buckets=len(ordered), bucket_stale=len(stale))
+        if stats is not None:
+            stats["buckets"] = len(ordered)
+            stats["bucket_hits"] = len(ordered) - len(stale)
         if stale and self.dist is not None:
             # ask a warm peer before scanning: each (mark, gens) was
             # captured BEFORE the fetch, so a write racing the network
@@ -258,7 +273,11 @@ class QueryCache:
                         slot[b] = part
                     else:
                         still.append((b, mark))
+                if stats is not None:
+                    stats["dist_hits"] = len(stale) - len(still)
                 stale = still
+        if stats is not None:
+            stats["scanned"] = len(stale)
         if stale:
             def _scan(bm):
                 b, _mark = bm
@@ -318,6 +337,41 @@ class QueryCache:
         except Exception:
             self._drop_buckets(key)
         return engine.execute_partial(table, query, encoded=True)
+
+    def standing_fold(self, table, sql: str, *, select=None,
+                      extra_key=None,
+                      bucket_range: tuple[int, int] | None = None
+                      ) -> tuple:
+        """Windowed incremental fold for standing queries
+        (query/standing.py): fold ONLY the 60s buckets inside
+        ``bucket_range``, reusing every cached slice (and the
+        distributed partial cache via the dist hook). Keys on the SAME
+        (table, sql, extra_key) as execute()/partial(), so standing and
+        ad-hoc evaluations of one query share warm buckets. Returns
+        (QueryResult | None, stats): None when the query isn't
+        bucketable or the window holds no marked buckets — the caller
+        falls back to a from-scratch execute."""
+        stats = {"buckets": 0, "bucket_hits": 0, "dist_hits": 0,
+                 "scanned": 0}
+        if not self._enabled():
+            return None, stats
+        key = (table.name, normalize_sql(sql), extra_key)
+        try:
+            query = select if select is not None else S.parse(sql)
+            parts = self._bucket_partials(table, query, key,
+                                          bucket_range=bucket_range,
+                                          stats=stats)
+            if not parts:
+                return None, stats
+            combined = engine.combine_partials(table, query, parts)
+            return engine.merge_partials(table, query, [combined]), stats
+        except engine._FastUnsupported:
+            self._drop_buckets(key)
+        except engine.QueryError:
+            raise
+        except Exception:
+            self._drop_buckets(key)
+        return None, stats
 
     def _drop_buckets(self, key: tuple) -> None:
         with self._lock:

@@ -46,6 +46,8 @@ class AlertRule:
         self.firing = False
         self.last_value: float | None = None
         self.last_eval_ns = 0
+        self.in_error = False          # rule_error hysteresis
+        self.standing_name: str | None = None  # push-evaluated when set
 
     def to_dict(self) -> dict:
         return {"name": self.name, "db": self.db_name, "sql": self.sql,
@@ -60,9 +62,70 @@ class AlertEngine:
         self.api = api  # QuerierAPI for table resolution (optional)
         self.rules: dict[str, AlertRule] = {}
         self._lock = threading.Lock()
+        # one eval at a time per engine: the push hook (standing-query
+        # refresher thread) and the timer loop both transition firing
+        # state; without this a breach could double-emit
+        self._eval_lock = threading.Lock()
+        self._standing = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
-        self.stats = {"evals": 0, "fired": 0, "resolved": 0, "errors": 0}
+        self.stats = {"evals": 0, "fired": 0, "resolved": 0, "errors": 0,
+                      "push_evals": 0, "rule_errors": 0}
+
+    # -- standing-query integration -------------------------------------------
+
+    @property
+    def standing(self):
+        return self._standing
+
+    @standing.setter
+    def standing(self, registry) -> None:
+        """Attach a StandingQueryRegistry: rules become standing queries
+        (``alert:<name>``) evaluated the moment an update is published,
+        instead of re-running their SQL on the poll timer."""
+        self._standing = registry
+        if registry is not None:
+            registry.hooks.append(self._on_standing_update)
+            with self._lock:
+                rules = list(self.rules.values())
+            for rule in rules:
+                self._register_standing(rule)
+
+    def _register_standing(self, rule: AlertRule) -> None:
+        reg = self._standing
+        if reg is None:
+            return
+        try:
+            table, _sel = self._resolve_table(rule)
+            reg.register(rule.sql, name=f"alert:{rule.name}",
+                         table=table.name)
+            rule.standing_name = f"alert:{rule.name}"
+        except Exception as e:
+            # not standing-capable (or registry down): the timer loop
+            # keeps evaluating this rule the classic way
+            rule.standing_name = None
+            log.debug("standing registration failed for %s: %s",
+                      rule.name, e)
+
+    def _on_standing_update(self, name: str, update: dict) -> None:
+        """Registry push hook. Runs on the refresher thread while the
+        standing query's own lock is held — so the value comes from the
+        update payload, never from registry.value_of()."""
+        if not name.startswith("alert:"):
+            return
+        with self._lock:
+            rule = self.rules.get(name[len("alert:"):])
+        if rule is None:
+            return
+        rows = update.get("rows") or []
+        value = rows[0][0] if rows and rows[0] else 0.0
+        if not isinstance(value, (int, float)):
+            return
+        self.stats["push_evals"] += 1
+        try:
+            self.eval_rule(rule, value=float(value))
+        except Exception as e:
+            self._rule_error(rule, e)
 
     # -- rule management ------------------------------------------------------
 
@@ -86,12 +149,18 @@ class AlertEngine:
                 rule.firing = prev.firing
                 rule.last_value = prev.last_value
                 rule.last_eval_ns = prev.last_eval_ns
+                rule.in_error = prev.in_error
             self.rules[rule.name] = rule
+        self._register_standing(rule)
         return rule
 
     def delete(self, name: str) -> bool:
         with self._lock:
-            return self.rules.pop(name, None) is not None
+            rule = self.rules.pop(name, None)
+        if rule is not None and rule.standing_name \
+                and self._standing is not None:
+            self._standing.unregister(rule.standing_name)
+        return rule is not None
 
     def list(self) -> list[dict]:
         with self._lock:
@@ -124,21 +193,52 @@ class AlertEngine:
                 f"alert query must yield a number, got {v!r}")
         return float(v)
 
-    def eval_rule(self, rule: AlertRule, now_ns: int | None = None) -> None:
+    def eval_rule(self, rule: AlertRule, now_ns: int | None = None,
+                  value: float | None = None) -> None:
+        """Evaluate one rule. ``value=None`` re-runs the rule's SQL
+        from scratch (submit-time dry-runs, direct calls); push and
+        timer paths pass the standing query's maintained value."""
         now = now_ns if now_ns is not None else time.time_ns()
-        value = self._query_value(rule)
-        rule.last_value = value
-        rule.last_eval_ns = now
-        self.stats["evals"] += 1
-        breach = _OPS[rule.op](value, rule.threshold)
-        if breach and not rule.firing:
-            rule.firing = True
-            self.stats["fired"] += 1
-            self._emit(rule, "alert", value, now)
-        elif not breach and rule.firing:
-            rule.firing = False
-            self.stats["resolved"] += 1
-            self._emit(rule, "alert-resolved", value, now)
+        if value is None:
+            value = self._query_value(rule)
+        with self._eval_lock:
+            rule.last_value = value
+            rule.last_eval_ns = now
+            rule.in_error = False
+            self.stats["evals"] += 1
+            breach = _OPS[rule.op](value, rule.threshold)
+            if breach and not rule.firing:
+                rule.firing = True
+                self.stats["fired"] += 1
+                self._emit(rule, "alert", value, now)
+            elif not breach and rule.firing:
+                rule.firing = False
+                self.stats["resolved"] += 1
+                self._emit(rule, "alert-resolved", value, now)
+
+    def _rule_error(self, rule: AlertRule, err: Exception,
+                    now_ns: int | None = None) -> None:
+        """A failed evaluation becomes a visible event.event row —
+        one per error transition (hysteresis like firing), so a broken
+        rule can't storm the events table."""
+        self.stats["errors"] += 1
+        log.exception("alert eval failed: %s", rule.name)
+        if rule.in_error:
+            return
+        rule.in_error = True
+        self.stats["rule_errors"] += 1
+        try:
+            self.db.table("event.event").append_rows([{
+                "time": now_ns if now_ns is not None else time.time_ns(),
+                "event_type": "rule_error",
+                "resource_type": "alert-rule",
+                "resource_name": rule.name,
+                "description": f"{type(err).__name__}: {err}",
+                "attrs": json.dumps({"severity": rule.severity,
+                                     "sql": rule.sql}),
+            }])
+        except Exception:
+            log.debug("rule_error event append failed", exc_info=True)
 
     def _emit(self, rule: AlertRule, etype: str, value: float,
               now_ns: int) -> None:
@@ -189,10 +289,25 @@ class AlertEngine:
                        if now - r.last_eval_ns >= r.interval_s * 1e9]
             for rule in due:
                 try:
-                    self.eval_rule(rule, now)
-                except Exception:
-                    self.stats["errors"] += 1
-                    log.exception("alert eval failed: %s", rule.name)
+                    value = None
+                    if rule.standing_name and self._standing is not None:
+                        # push covers transitions; the timer tick reads
+                        # the maintained value (exact while the change
+                        # token holds still) instead of re-querying
+                        value = self._standing.value_of(rule.standing_name)
+                    self.eval_rule(rule, now, value=value)
+                except Exception as e:
+                    self._rule_error(rule, e, now)
+
+    def snapshot(self) -> dict:
+        """The /v1/health alerting block."""
+        with self._lock:
+            rules = list(self.rules.values())
+        return {"rules": len(rules),
+                "firing": sorted(r.name for r in rules if r.firing),
+                "errored": sorted(r.name for r in rules if r.in_error),
+                "push": self._standing is not None,
+                "stats": dict(self.stats)}
 
 
 _STEP_SQL = ("SELECT time, end_ns, latency_ns, run_id, step, job, "

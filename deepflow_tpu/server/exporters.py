@@ -45,6 +45,24 @@ class BaseExporter:
         self.stats = {"exported": 0, "batches": 0, "dropped": 0,
                       "errors": 0, "spooled": 0, "replayed": 0,
                       "spool_dropped": 0}
+        # conserved hop ledger (emitted == delivered + dropped + in_flight;
+        # in_flight = queue + spool). Files spooled by a PREVIOUS process
+        # were never emitted in this ledger: they account emitted at
+        # adoption (first successful load), tracked via _spooled_rows.
+        self._hop = None
+        self._spooled_rows: dict[str, int] = {}  # fn -> rows, this ledger
+
+    @property
+    def kind(self) -> str:
+        return type(self).__name__.removesuffix("Exporter").lower()
+
+    def attach_telemetry(self, telemetry) -> "BaseExporter":
+        self._hop = telemetry.hop(f"exporter.{self.kind}")
+        return self
+
+    def _acct(self, **kw) -> None:
+        if self._hop is not None:
+            self._hop.account(**kw)
 
     def accepts(self, table: str) -> bool:
         return not self.TABLES or table in self.TABLES
@@ -52,11 +70,17 @@ class BaseExporter:
     def feed(self, table: str, rows: list[dict]) -> None:
         if not self.accepts(table):
             return
+        full = 0
         for row in rows:
             try:
                 self._q.put_nowait((table, row))
             except queue.Full:
-                self.stats["dropped"] += 1
+                full += 1
+        if full:
+            self.stats["dropped"] += full
+        # every accepted row enters the ledger; queue-full rows enter and
+        # immediately drop so the books still balance
+        self._acct(emitted=len(rows), dropped=full, reason="queue_full")
 
     def start(self) -> "BaseExporter":
         self._thread = threading.Thread(
@@ -85,6 +109,7 @@ class BaseExporter:
                         shipped = True
                         self.stats["exported"] += len(batch)
                         self.stats["batches"] += 1
+                        self._acct(delivered=len(batch))
                         break
                     except Exception as e:
                         self.stats["errors"] += 1
@@ -95,8 +120,11 @@ class BaseExporter:
                 if not shipped:
                     if self._spool(batch):
                         self.stats["spooled"] += len(batch)
+                        # spooled rows stay in_flight until replayed
                     else:
                         self.stats["dropped"] += len(batch)
+                        self._acct(dropped=len(batch),
+                                   reason="ship_failed")
                 batch = []
             # disk-driven replay: runs whether the spool predates this
             # process or filled this run, throttled between attempts
@@ -113,13 +141,23 @@ class BaseExporter:
                            if f.endswith(".spool"))
             while len(files) >= self.SPOOL_MAX_FILES:
                 victim = files.pop(0)  # oldest out; drops stay VISIBLE
+                n = 0
                 try:
                     import pickle as _p
                     with open(os.path.join(self.spool_dir, victim),
                               "rb") as f:
-                        self.stats["spool_dropped"] += len(_p.load(f))
+                        n = len(_p.load(f))
+                    self.stats["spool_dropped"] += n
                 except Exception:
                     pass
+                if victim in self._spooled_rows:
+                    # rows this ledger already emitted: close them out
+                    self._acct(dropped=self._spooled_rows.pop(victim),
+                               reason="spool_evict")
+                elif n:
+                    # foreign file (previous process): adopt-then-drop so
+                    # the eviction is visible without going negative
+                    self._acct(emitted=n, dropped=n, reason="spool_evict")
                 os.unlink(os.path.join(self.spool_dir, victim))
             self._spool_seq += 1
             path = os.path.join(
@@ -128,6 +166,7 @@ class BaseExporter:
             with open(path + ".tmp", "wb") as f:
                 pickle.dump(batch, f)
             os.replace(path + ".tmp", path)
+            self._spooled_rows[os.path.basename(path)] = len(batch)
             return True
         except OSError as e:
             log.warning("spool write failed: %s", e)
@@ -165,6 +204,13 @@ class BaseExporter:
                 attempts.pop(fn, None)
                 self.stats["replayed"] += len(batch)
                 self.stats["exported"] += len(batch)
+                if fn in self._spooled_rows:
+                    self._spooled_rows.pop(fn)
+                    self._acct(delivered=len(batch))
+                else:
+                    # foreign spool file: adopted into this ledger only
+                    # once it actually ships
+                    self._acct(emitted=len(batch), delivered=len(batch))
             except Exception as e:
                 # a file the destination deterministically rejects must not
                 # block everything behind it forever: quarantine after 5
@@ -181,6 +227,13 @@ class BaseExporter:
                         os.replace(path, path + ".bad")
                         self.stats["spool_dropped"] += n
                         attempts.pop(fn, None)
+                        if fn in self._spooled_rows:
+                            self._acct(
+                                dropped=self._spooled_rows.pop(fn),
+                                reason="spool_poison")
+                        elif n:
+                            self._acct(emitted=n, dropped=n,
+                                       reason="spool_poison")
                         log.warning("quarantined poison spool file %s", fn)
                         continue
                     except OSError:
@@ -448,8 +501,9 @@ class KafkaExporter(BaseExporter):
 
 
 class ExporterManager:
-    def __init__(self) -> None:
+    def __init__(self, telemetry=None) -> None:
         self.exporters: list[BaseExporter] = []
+        self.telemetry = telemetry
 
     def add(self, exporter: BaseExporter) -> BaseExporter:
         """Idempotent on (type, endpoint): re-adding returns the existing
@@ -458,6 +512,8 @@ class ExporterManager:
             if (type(e) is type(exporter)
                     and e.endpoint == exporter.endpoint):
                 return e
+        if self.telemetry is not None:
+            exporter.attach_telemetry(self.telemetry)
         self.exporters.append(exporter.start())
         return exporter
 
@@ -483,5 +539,10 @@ class ExporterManager:
             e.stop()
 
     def stats(self) -> dict:
-        return {f"{type(e).__name__}:{e.endpoint}": dict(e.stats)
-                for e in self.exporters}
+        out = {}
+        for e in self.exporters:
+            st = dict(e.stats)
+            if e._hop is not None:
+                st["ledger"] = e._hop.snapshot()
+            out[f"{type(e).__name__}:{e.endpoint}"] = st
+        return out

@@ -92,6 +92,9 @@ class QuerierAPI:
         # ingest nodes (querier replicas take no agent traffic)
         self.qos = None
         self.drop_attribution = None
+        # standing-query registry (query/standing.py), set by server.py:
+        # backs /v1/subscribe and the push-evaluated alert path
+        self.standing = None
         # zone-map pruning accounting flows into the same hop ledger the
         # rest of the pipeline reports through (query.scan hop)
         from deepflow_tpu.query import engine as _qengine
@@ -155,6 +158,45 @@ class QuerierAPI:
         self.exporters.add(exp)  # idempotent on (type, endpoint)
         return {"added": etype, "endpoint": endpoint,
                 "exporters": self.exporters.stats()}
+
+    def subscribe_api(self, body: dict) -> dict:
+        """POST /v1/subscribe — the standing-query control surface:
+        register/unregister queries, create subscribers, long-poll
+        drain. The GET side of the same path streams SSE."""
+        if self.standing is None:
+            raise qengine.QueryError("standing queries not running")
+        action = body.get("action", "list")
+        if action == "register":
+            sql = body.get("sql", "")
+            if not sql:
+                raise qengine.QueryError("sql required")
+            try:
+                window_s = float(body.get("window_s", 0) or 0)
+            except (TypeError, ValueError):
+                raise qengine.QueryError("window_s must be a number")
+            return {"registered": self.standing.register(
+                sql, name=body.get("name") or None,
+                table=body.get("table") or None,
+                window_s=window_s, org_id=body.get("org_id"),
+                verify=bool(body.get("verify", False)))}
+        if action == "unregister":
+            return {"unregistered": self.standing.unregister(
+                str(body.get("name", "")))}
+        if action == "list":
+            return {"queries": self.standing.list()}
+        if action == "subscribe":
+            names = body.get("queries")
+            return self.standing.subscribe(
+                [str(n) for n in names] if names else None)
+        if action == "poll":
+            return self.standing.poll(
+                str(body.get("subscriber", "")),
+                timeout_s=float(body.get("timeout_s", 25.0) or 25.0),
+                max_items=int(body.get("max", 64) or 64))
+        if action == "unsubscribe":
+            return {"unsubscribed": self.standing.unsubscribe(
+                str(body.get("subscriber", "")))}
+        raise qengine.QueryError(f"unknown subscribe action {action!r}")
 
     def exporters_delete(self, body: dict) -> dict:
         if self.exporters is None:
@@ -1694,6 +1736,19 @@ class QuerierAPI:
             out["readtier"] = self.readtier.snapshot()
         if self.partial_cache is not None:
             out["partial_cache"] = self.partial_cache.snapshot()
+        if self.standing is not None:
+            # standing queries: per-query generations/fold counters +
+            # the conserved query.standing push ledger
+            out["standing"] = self.standing.snapshot()
+        if self.exporters is not None:
+            ex = self.exporters.stats()
+            if ex:
+                # per-exporter counters now carry the conserved
+                # exporter.<kind> hop ledger (satellite: spool evictions
+                # and ship failures are accounted, never silent)
+                out["exporters"] = ex
+        if self.alerts is not None:
+            out["alerting"] = self.alerts.snapshot()
         # dogfooded query tracing: span counters + the query.trace hop
         # ledger (emitted == delivered + dropped + pending holds, same
         # conservation law as every frame hop)
@@ -1784,13 +1839,46 @@ class QuerierHTTP:
                     return body.get("token")
                 return None
 
+            def _sse(self, params: dict) -> None:
+                """GET /v1/subscribe?subscriber=ID — SSE stream of
+                standing-query updates (long-poll POST action=poll is
+                the fallback). One `data:` line per update; comment
+                keepalives every idle poll round."""
+                sid = params.get("subscriber", "")
+                if api.standing is None or not sid:
+                    self._send(400, {"error": "subscriber required "
+                                     "(POST action=subscribe first)"})
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Connection", "close")
+                self.end_headers()
+                try:
+                    while True:
+                        out = api.standing.poll(sid, timeout_s=10.0,
+                                                max_items=64)
+                        for u in out["updates"]:
+                            self.wfile.write(
+                                b"data: " + json.dumps(u).encode()
+                                + b"\n\n")
+                        if not out["updates"]:
+                            self.wfile.write(b": keepalive\n\n")
+                        self.wfile.flush()
+                        if out["closed"]:
+                            return
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    return  # client went away: the idle reaper cleans up
+
             def do_GET(self) -> None:
                 from urllib.parse import parse_qsl, urlparse
                 parsed = urlparse(self.path)
                 path = parsed.path.rstrip("/")
                 params = dict(parse_qsl(parsed.query))
                 try:
-                    if path in ("/v1/health", "/health"):
+                    if path == "/v1/subscribe":
+                        self._sse(params)
+                    elif path in ("/v1/health", "/health"):
                         self._send(200, api.health())
                     elif path == "/v1/cluster/peers":
                         self._send(200, api.cluster_peers())
@@ -1958,6 +2046,8 @@ class QuerierHTTP:
                         self._send(200, api.alerts_api("upsert", body))
                     elif path == "/v1/alerts/delete":
                         self._send(200, api.alerts_api("delete", body))
+                    elif path == "/v1/subscribe":
+                        self._send(200, api.subscribe_api(body))
                     elif path == "/v1/exporters":
                         self._send(200, api.exporters_api(body))
                     elif path == "/v1/exporters/delete":

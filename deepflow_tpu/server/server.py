@@ -179,7 +179,7 @@ class Server:
                                                   StepRegressionDetector)
         from deepflow_tpu.server.exporters import ExporterManager
         from deepflow_tpu.server.tracetree import TraceTreeBuilder
-        self.exporters = ExporterManager()
+        self.exporters = ExporterManager(telemetry=self.telemetry)
         self.alerts = AlertEngine(self.db)
         # step health: continuous regression watch over tpu_step_metrics
         self.step_detector = StepRegressionDetector(self.db)
@@ -203,6 +203,15 @@ class Server:
         # built after the api (rollup needs the db the api already holds)
         self.api.rollup = self.rollup
         self.api.storage_provider = self._storage_stats
+        # standing-query registry (query/standing.py): shares the api's
+        # QueryCache so standing folds and ad-hoc queries reuse the same
+        # warm bucket partials (and the distributed partial cache)
+        from deepflow_tpu.query.standing import StandingQueryRegistry
+        self.standing = StandingQueryRegistry(
+            self.db, self.api.query_cache, telemetry=self.telemetry,
+            resolver=self.api._resolve_table)
+        self.api.standing = self.standing
+        self.alerts.standing = self.standing  # push-evaluated rules
         # /v1/health qos block + /v1/qos tenant table + dfctl qos
         self.api.qos = self.qos
         self.api.drop_attribution = self.receiver.drop_attribution
@@ -396,6 +405,8 @@ class Server:
         self.http.start()
         if self._cluster_on:
             self._start_cluster()
+        # both roles: queriers serve /v1/subscribe push traffic too
+        self.standing.start()
         if self.role == "ingest":
             self.alerts.start()
             self.step_detector.start()
@@ -601,6 +612,9 @@ class Server:
             shard_id=self.shard_id)
         self.api.membership = self.membership
         self.api.federation = self.federation
+        # federated standing refreshes ride the if_state machinery:
+        # only shards whose change token moved recompute
+        self.standing.federation = self.federation
         if self.readtier is not None:
             # read-tier coordinator: freeze adopted snapshots across the
             # scatter, send the publish-gen handshake, and join the
@@ -702,6 +716,9 @@ class Server:
         # now in a table, so seeding dedup floors from this state on the
         # next start cannot mask an undecoded frame
         self._save_ack_state()
+        # before http.stop(): closing every subscriber unblocks any SSE
+        # handler thread parked in a long poll
+        self.standing.stop()
         self.http.stop()
         self._stop_singletons()
         self.alerts.stop()

@@ -121,6 +121,11 @@ class ColumnarTable:
         self._wide_mark = 0
         self._time_col = "time" if any(c.name == "time" for c in columns) \
             else None
+        # change listeners (query/standing.py): called OUTSIDE all table
+        # locks after any mutation that moves the watermark. Listeners
+        # must be cheap and non-blocking (they mark dirty + set an
+        # event); heavy work happens on the subscriber's own thread.
+        self._listeners: list = []
         # bucket width in the time column's native unit (ns for u64, s
         # otherwise); 60 s buckets match dashboard refresh granularity
         if self._time_col is not None:
@@ -161,6 +166,27 @@ class ColumnarTable:
                 self._note_span(int(min(seg)), int(max(seg)))
         except (TypeError, ValueError, OverflowError):
             self._wide_mark = self.watermark  # unparseable time: play safe
+
+    def add_listener(self, fn) -> None:
+        """Register a change callback: fn(table) fires after any mutation
+        that can change a query answer (append, flush commit, tier
+        publish/evict/compact, trim, load). Fired outside all table
+        locks; exceptions are swallowed (a broken listener must not
+        poison the write path)."""
+        with self._lock:
+            if fn not in self._listeners:
+                self._listeners = self._listeners + [fn]
+
+    def remove_listener(self, fn) -> None:
+        with self._lock:
+            self._listeners = [f for f in self._listeners if f is not fn]
+
+    def _notify(self) -> None:
+        for fn in self._listeners:  # list is swapped, never mutated
+            try:
+                fn(self)
+            except Exception:  # pragma: no cover - defensive
+                pass
 
     def bucket_marks(self) -> tuple[int, dict[int, int], int, int]:
         """(watermark, {bucket: mark}, wide_mark, bucket_div) snapshot."""
@@ -298,6 +324,8 @@ class ColumnarTable:
                     segs.get(self._time_col) if self._time_col else None)
             if s.rows >= self.chunk_rows:
                 self._seal_stripe(s)
+        if self._listeners:
+            self._notify()
 
     @staticmethod
     def _materialize(segs: list, spec) -> np.ndarray:
@@ -360,6 +388,8 @@ class ColumnarTable:
                 self._note_span(tmin, tmax)
             elif tier.rows:
                 self._wide_mark = self.watermark
+        if self._listeners:
+            self._notify()
 
     def take_flushable(self, seal: bool = True) -> dict | None:
         """Stage every sealed RAM chunk for a tier commit.
@@ -420,6 +450,8 @@ class ColumnarTable:
                 ch for ch in self._pending_flush
                 if ch is not payload["chunk"]]
             self.watermark += 1
+        if self._listeners:
+            self._notify()
 
     def note_tier_publish(self, rows: int, tmin=None, tmax=None) -> None:
         """Read-tier adoption bookkeeping (store/segcache.py): rows a
@@ -434,6 +466,8 @@ class ColumnarTable:
                 self._note_span(int(tmin), int(tmax))
             else:
                 self._wide_mark = self.watermark
+        if self._listeners:
+            self._notify()
 
     def note_tier_evict(self, rows: int, tmin=None, tmax=None) -> None:
         """Tier eviction bookkeeping: dropped rows leave the row count
@@ -447,6 +481,8 @@ class ColumnarTable:
                 self._note_span(int(tmin), int(tmax))
             else:
                 self._wide_mark = self.watermark
+        if self._listeners:
+            self._notify()
 
     def note_tier_compact(self) -> None:
         """Tier compaction bookkeeping: rows and answers are unchanged
@@ -457,6 +493,8 @@ class ColumnarTable:
         with self._lock:
             self.watermark += 1
             self._wide_mark = self.watermark
+        if self._listeners:
+            self._notify()
 
     # -- read path -----------------------------------------------------------
 
@@ -562,6 +600,8 @@ class ColumnarTable:
                             self._bucket_marks[b] = self.watermark
                 else:
                     self._wide_mark = self.watermark
+        if dropped and self._listeners:
+            self._notify()
         return dropped
 
     def compact_dictionaries(self, min_entries: int = 4096,
@@ -736,3 +776,5 @@ class ColumnarTable:
                     self._note_segment(ch.get(self._time_col))
             else:
                 self._wide_mark = self.watermark
+        if self._listeners:
+            self._notify()

@@ -213,6 +213,130 @@ def test_alert_reupsert_keeps_firing_state():
         server.stop()
 
 
+def test_exporter_ledger_conservation():
+    """The conserved exporter.<kind> hop ledger: every row accepted at
+    feed() is eventually delivered, dropped (with a reason) or still in
+    flight — on the success path AND with an unreachable endpoint."""
+    sink = Sink()
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0).start()
+    try:
+        _post(server.query_port, "/v1/exporters", {
+            "type": "json-lines",
+            "endpoint": f"http://127.0.0.1:{sink.port}/x",
+            "tables": ["event.event"]})
+        n = 5
+        for i in range(n):
+            _send_event(server, f"conserved-{i}")
+        server.wait_for_rows("event.event", n)
+        deadline = time.monotonic() + 10
+        led = None
+        while time.monotonic() < deadline:
+            st = next(iter(server.exporters.stats().values()))
+            led = st.get("ledger")
+            if led and led["delivered"] >= n:
+                break
+            time.sleep(0.1)
+        assert led and led["delivered"] >= n
+        assert led["emitted"] == (led["delivered"] + led["dropped_total"]
+                                  + led["in_flight"])
+        assert led["hop"] == "exporter.jsonlines"
+        # health surfaces the same ledger (satellite: ops can see it)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.query_port}/v1/health",
+                timeout=5) as resp:
+            health = json.loads(resp.read())
+        hled = next(iter(health["exporters"].values()))["ledger"]
+        assert hled["emitted"] == (hled["delivered"]
+                                   + hled["dropped_total"]
+                                   + hled["in_flight"])
+    finally:
+        server.stop()
+        sink.stop()
+
+
+def test_exporter_ledger_conserves_on_ship_failure():
+    """Rows shipped at a dead endpoint never vanish from the ledger:
+    they are dropped with a reason or spooled (still in flight)."""
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0).start()
+    try:
+        _post(server.query_port, "/v1/exporters", {
+            "type": "json-lines",
+            "endpoint": "http://127.0.0.1:1/unreachable",
+            "tables": ["event.event"]})
+        n = 4
+        for i in range(n):
+            _send_event(server, f"doomed-{i}")
+        server.wait_for_rows("event.event", n)
+        deadline = time.monotonic() + 15
+        led = None
+        while time.monotonic() < deadline:
+            st = next(iter(server.exporters.stats().values()))
+            led = st.get("ledger")
+            if led and led["emitted"] >= n \
+                    and led["delivered"] + led["dropped_total"] >= n:
+                break
+            time.sleep(0.1)
+        assert led and led["emitted"] >= n
+        assert led["emitted"] == (led["delivered"] + led["dropped_total"]
+                                  + led["in_flight"])
+        assert led["delivered"] == 0
+        assert led["dropped_total"] > 0  # ship_failed accounted, not lost
+        assert "ship_failed" in led["dropped"]
+    finally:
+        server.stop()
+
+
+def test_alert_rule_error_events():
+    """A rule whose query starts failing AFTER submit (schema drift,
+    table gone) emits one rule_error event.event row per error
+    transition — not one per evaluation — and shows up in the health
+    alerting block."""
+    server = Server(host="127.0.0.1", ingest_port=0, query_port=0).start()
+    try:
+        server.db.table("event.event").append_rows(
+            [{"time": 1, "event_type": "e"}])
+        _post(server.query_port, "/v1/alerts", {
+            "name": "drifted", "db": "event",
+            "sql": "SELECT Count(*) FROM event",
+            "op": ">", "threshold": 1e9, "interval_s": 0.2})
+        rule = server.alerts.rules["drifted"]
+        # schema drift after submit: dry-run passed, evals now fail.
+        # Detach the standing value feed (drift kills it too) so the
+        # timer re-queries — otherwise push evals keep succeeding on
+        # the maintained value and reset the error latch.
+        server.standing.unregister(rule.standing_name)
+        rule.sql = "SELECT Sum(no_such_column) FROM event"
+        rule.standing_name = None
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline \
+                and server.alerts.stats["rule_errors"] < 1:
+            time.sleep(0.1)
+        assert server.alerts.stats["rule_errors"] == 1
+        assert rule.in_error
+        snap = server.alerts.snapshot()
+        assert "drifted" in snap["errored"]
+        assert snap["stats"]["errors"] >= 1
+        ev = server.db.table("event.event")
+        ev.flush()
+        from deepflow_tpu.query import execute
+        r = execute(ev, "SELECT resource_name FROM e "
+                        "WHERE event_type = 'rule_error'")
+        assert r.values == [["drifted"]]  # one row per error transition
+        # still erroring on the next tick: no duplicate rule_error rows
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline \
+                and server.alerts.stats["errors"] < 2:
+            time.sleep(0.1)
+        assert server.alerts.stats["errors"] >= 2
+        assert server.alerts.stats["rule_errors"] == 1
+        ev.flush()
+        r = execute(ev, "SELECT Count(*) AS n FROM e "
+                        "WHERE event_type = 'rule_error'")
+        assert r.values[0][0] == 1
+    finally:
+        server.stop()
+
+
 def test_http_ingest_feeds_exporters():
     sink = Sink()
     server = Server(host="127.0.0.1", ingest_port=0, query_port=0).start()

@@ -1,0 +1,255 @@
+"""Standing queries: incremental maintenance byte-identity (ISSUE 18).
+
+The contract under test mirrors the query-parallel suite: every result
+a standing query publishes must be byte-identical to a from-scratch
+``engine.execute`` of the same windowed SQL — across window slides
+(bucket expiry), late/out-of-order arrivals into in-window buckets,
+and flushes/compactions racing the refresher mid-fold. On top of that,
+the push surface guarantees exactly-once per (subscriber, generation)
+and a conserved ``query.standing`` hop ledger.
+"""
+
+import threading
+import time
+
+import pytest
+
+from deepflow_tpu.query import engine
+from deepflow_tpu.query import standing as standing_mod
+from deepflow_tpu.query.cache import QueryCache, change_token
+from deepflow_tpu.query.standing import StandingQueryRegistry
+from deepflow_tpu.store import Database
+from deepflow_tpu.telemetry import Telemetry
+
+_ROW = {"ip_src": "1.1.1.1", "ip_dst": "2.2.2.2", "server_port": 80,
+        "protocol": 1, "host": "h1"}
+
+_SQL = ("SELECT ip_src, Sum(byte_tx) AS b, Count() AS c FROM t "
+        "GROUP BY ip_src ORDER BY ip_src")
+
+
+@pytest.fixture(autouse=True)
+def _fast_refresher(monkeypatch):
+    # the production debounce (2Hz ceiling) and duty-cycle budget would
+    # make every test here spend most of its wall time sleeping; the
+    # logic under test is identical at any cadence
+    monkeypatch.setattr(standing_mod, "MIN_GAP_S", 0.02)
+    monkeypatch.setattr(standing_mod, "REFRESH_BUDGET", 0.5)
+
+
+def _registry(db, telemetry=None):
+    return StandingQueryRegistry(db, QueryCache(),
+                                 telemetry=telemetry).start()
+
+
+def _batch(t_start, n, src_mod=3, byte0=0):
+    return [dict(_ROW, time=t_start + i, byte_tx=byte0 + i,
+                 packet_tx=1, ip_src=f"10.0.0.{i % src_mod}")
+            for i in range(n)]
+
+
+def _wait_gen(sq, gen, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if sq.gen > gen:
+            return sq.gen
+        time.sleep(0.01)
+    raise AssertionError(f"gen never advanced past {gen}")
+
+
+def _assert_identical(reg, sq, table):
+    _brange, wsel = reg._window(sq)
+    want = engine.execute(table, wsel)
+    with sq.lock:
+        got = (list(sq.columns), [list(r) for r in sq.rows])
+    assert got == (want.columns, want.values)
+
+
+def test_window_slide_byte_identity(tmp_path):
+    """A 5m window over a growing table: every slide (new bucket enters,
+    oldest expires) must stay byte-identical to a from-scratch execute
+    of the windowed SQL — expiry drops bucket partials, it must never
+    drop or double rows."""
+    db = Database(data_dir=str(tmp_path), storage=True)
+    t = db.table("flow_metrics.network.1s")
+    t0 = 6000  # bucket-aligned (6000 = 100 * 60)
+    t.append_rows(_batch(t0, 300))  # buckets 100..104
+    reg = _registry(db)
+    try:
+        reg.register(_SQL, name="w", table=t.name, window_s=300.0)
+        sq = reg.get("w")
+        assert sq.gen == 1
+        _assert_identical(reg, sq, t)
+        # slide the window 6 times: each append lands a NEW newest
+        # bucket, pushing the oldest one out of the 5-bucket window
+        for k in range(6):
+            gen = sq.gen
+            # byte0 offset keeps the new bucket's aggregates distinct
+            # from the expiring one's — identical content would make
+            # the slide a (correct) no-op and no generation would move
+            t.append_rows(_batch(t0 + 300 + k * 60, 60,
+                                 byte0=1000 + k * 7))
+            _wait_gen(sq, gen)
+            _assert_identical(reg, sq, t)
+        assert sq.counters["incremental"] >= 1
+    finally:
+        reg.stop()
+
+
+def test_late_out_of_order_rows(tmp_path):
+    """Late arrivals into an OLDER in-window bucket re-dirty exactly
+    that bucket; rows older than the window must not resurrect it."""
+    db = Database(data_dir=str(tmp_path), storage=True)
+    t = db.table("flow_metrics.network.1s")
+    t0 = 6000
+    t.append_rows(_batch(t0, 600))  # buckets 100..109
+    reg = _registry(db)
+    try:
+        reg.register(_SQL, name="w", table=t.name, window_s=300.0)
+        sq = reg.get("w")
+        # late rows into the OLDEST still-in-window bucket, descending
+        gen = sq.gen
+        late = _batch(t0 + 300, 40, byte0=999)
+        t.append_rows(list(reversed(late)))
+        _wait_gen(sq, gen)
+        _assert_identical(reg, sq, t)
+        # rows below the window: the result must be the one the window
+        # defines — identical to from-scratch, which excludes them
+        with sq.lock:
+            before = [list(r) for r in sq.rows]
+        def _visits():
+            return sq.counters["refreshes"] + sq.counters["skipped"]
+        v0 = _visits()
+        t.append_rows(_batch(t0, 40, byte0=555))
+        # the dirty mark fires either way, but the RESULT must not move
+        # (no gen bump) — wait for the refresher to visit the query
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and _visits() == v0:
+            time.sleep(0.01)
+        assert _visits() > v0
+        _assert_identical(reg, sq, t)
+        with sq.lock:
+            assert [list(r) for r in sq.rows] == before
+    finally:
+        reg.stop()
+
+
+def test_flush_compaction_mid_fold(tmp_path):
+    """Flushes swap RAM chunks for mmap'd segments underneath the
+    refresher (the PR 10 race, aimed at the standing fold): with
+    verify=True every refresh self-checks against a from-scratch
+    execute at the same token, so one churn loop proves the fold never
+    reads a half-swapped table."""
+    db = Database(data_dir=str(tmp_path), storage=True)
+    t = db.table("flow_metrics.network.1s")
+    t.append_rows(_batch(6000, 600))
+    reg = _registry(db)
+    try:
+        reg.register(_SQL, name="r", table=t.name, verify=True)
+        sq = reg.get("r")
+        stop = threading.Event()
+        errs: list = []
+
+        def _churn():
+            try:
+                k = 0
+                while not stop.is_set():
+                    t.append_rows(_batch(8000 + k * 50, 50, byte0=k))
+                    db.flush_to_tier()
+                    k += 1
+                    time.sleep(0.005)
+            except Exception as e:
+                errs.append(e)
+
+        th = threading.Thread(target=_churn)
+        th.start()
+        try:
+            deadline = time.monotonic() + 3.0
+            while time.monotonic() < deadline and sq.gen < 8:
+                time.sleep(0.02)
+        finally:
+            stop.set()
+            th.join(timeout=10)
+        assert not errs
+        assert sq.gen >= 8, "refresher starved during churn"
+        assert sq.counters["verify_failures"] == 0
+        # quiesce: the refresher has folded up to the table's current
+        # change token, so the maintained rows equal a fresh execute
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline \
+                and sq.token != change_token(t):
+            time.sleep(0.05)
+        assert sq.token == change_token(t), "refresher never caught up"
+        _assert_identical(reg, sq, t)
+        assert sq.counters["refreshes"] >= 8
+    finally:
+        reg.stop()
+
+
+def test_exactly_once_delivery_and_ledger(tmp_path):
+    """Two subscribers each see every generation exactly once and in
+    order; after they detach, the query.standing hop ledger conserves
+    with nothing left in flight."""
+    db = Database(data_dir=str(tmp_path), storage=True)
+    t = db.table("flow_metrics.network.1s")
+    t.append_rows(_batch(6000, 120))
+    tel = Telemetry(component="server", enabled=True)
+    reg = _registry(db, telemetry=tel)
+    try:
+        reg.register(_SQL, name="q", table=t.name)
+        sq = reg.get("q")
+        subs = [reg.subscribe(["q"])["subscriber"] for _ in range(2)]
+        seen = {sid: [] for sid in subs}
+        for k in range(5):
+            gen = sq.gen
+            t.append_rows(_batch(6200 + k * 10, 10, byte0=k * 13))
+            _wait_gen(sq, gen)
+        final = sq.gen
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            for sid in subs:
+                out = reg.poll(sid, timeout_s=0.05)
+                seen[sid].extend(u["gen"] for u in out["updates"]
+                                 if u["query"] == "q")
+            if all(final in g for g in seen.values()):
+                break
+        for sid in subs:
+            gens = seen[sid]
+            assert gens, "subscriber saw nothing"
+            assert len(gens) == len(set(gens)), f"duplicate gen: {gens}"
+            assert gens == sorted(gens), f"out of order: {gens}"
+            assert gens == list(range(gens[0], gens[0] + len(gens))), \
+                f"generation gap: {gens}"
+            assert gens[-1] == final
+        for sid in subs:
+            reg.unsubscribe(sid)
+        led = tel.hop("query.standing").snapshot()
+        assert led["emitted"] == (led["delivered"]
+                                  + led["dropped_total"]
+                                  + led["in_flight"])
+        assert led["in_flight"] == 0
+        assert led["delivered"] > 0
+    finally:
+        reg.stop()
+
+
+def test_kill_switch_byte_identity(tmp_path, monkeypatch):
+    """DF_STANDING=0 forces every refresh through the from-scratch
+    path — same registry surface, identical bytes."""
+    db = Database(data_dir=str(tmp_path), storage=True)
+    t = db.table("flow_metrics.network.1s")
+    t.append_rows(_batch(6000, 400))
+    reg = _registry(db)
+    try:
+        reg.register(_SQL, name="inc", table=t.name, window_s=300.0)
+        monkeypatch.setenv("DF_STANDING", "0")
+        reg.register(_SQL, name="off", table=t.name, window_s=300.0)
+        inc, off = reg.get("inc"), reg.get("off")
+        assert off.counters["full"] >= 1
+        assert off.counters["incremental"] == 0
+        with inc.lock:
+            want = [list(r) for r in inc.rows]
+        with off.lock:
+            assert [list(r) for r in off.rows] == want
+    finally:
+        reg.stop()
