@@ -3,7 +3,7 @@ JAX_ENV := env JAX_PLATFORMS=cpu
 
 .PHONY: test selfmon-check cluster-check steps-check chaos-check ha-check \
 	query-check ingest-check storage-check compaction-check readtier-check \
-	trace-check overload-check live-check bench native
+	trace-check overload-check live-check scrub-check bench native
 
 test:
 	timeout -k 10 870 $(JAX_ENV) $(PYTHON) -m pytest tests/ -q -m 'not slow' \
@@ -107,6 +107,17 @@ trace-check:
 # that fails to raise-then-decay the advertised level.
 overload-check:
 	timeout -k 10 300 $(JAX_ENV) $(PYTHON) -m deepflow_tpu.cli.overload_check
+
+# Self-healing storage gate: a 3-shard federated cluster under
+# sustained ingest takes bit-flips into sealed segments, a corrupted
+# object-store blob, and ENOSPC into one shard's flush path; exits
+# non-zero unless every corruption is detected by the checksum scrub,
+# quarantined through the manifest, and repaired from the healthy
+# copy (queries annotated degraded in the gap, byte-identical to the
+# expected aggregates after), acks HOLD through the full disk with
+# zero HIGH loss after recovery, and every hop ledger conserves.
+scrub-check:
+	timeout -k 10 600 $(JAX_ENV) $(PYTHON) -m deepflow_tpu.cli.scrub_check
 
 bench:
 	$(JAX_ENV) $(PYTHON) bench.py

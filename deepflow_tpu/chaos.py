@@ -52,6 +52,8 @@ class ChaosConfig:
     stall_s: float = 0.0        # accept-then-stall duration (receiver)
     stall_p: float = 0.0        # P(stall on accept)
     disk_full: float = 0.0      # P(ENOSPC on a spool append)
+    tier_enospc: float = 0.0    # P(ENOSPC on a tier flush commit)
+    objstore_eio: float = 0.0   # P(EIO on an objstore blob write)
 
     @classmethod
     def parse(cls, spec: str) -> "ChaosConfig":
@@ -88,7 +90,8 @@ class ChaosInjector:
         self._lock = threading.Lock()
         self.stats = {"conn_refused": 0, "conn_reset": 0,
                       "partial_writes": 0, "stalls": 0, "disk_full": 0,
-                      "latency_injections": 0}
+                      "latency_injections": 0, "tier_enospc": 0,
+                      "objstore_eio": 0}
 
     def _hit(self, p: float) -> bool:
         if p <= 0.0:
@@ -147,6 +150,69 @@ class ChaosInjector:
         if self._hit(self.config.disk_full):
             self.stats["disk_full"] += 1
             raise OSError(errno.ENOSPC, "chaos: no space left on device")
+
+    def on_tier_write(self) -> None:
+        """Called by TieredStore.commit before writing segments: a full
+        data disk fails the WHOLE commit (no manifest rename, no acks) —
+        the flusher requeues and backs off, agents keep retransmitting."""
+        if self._hit(self.config.tier_enospc):
+            self.stats["tier_enospc"] += 1
+            raise OSError(errno.ENOSPC,
+                          "chaos: no space left on device (tier)")
+
+    def on_objstore_write(self) -> None:
+        """Called by ObjStore.put_if_absent before staging a blob: an
+        I/O error on the shared store must fail the publish (pointer
+        never advances to a blob that isn't there), never tear it."""
+        if self._hit(self.config.objstore_eio):
+            self.stats["objstore_eio"] += 1
+            raise OSError(errno.EIO, "chaos: I/O error (objstore)")
+
+
+def corrupt_segment(path: str, seed: int = 0,
+                    mode: str = "bit_flip") -> dict:
+    """Inject silent data corruption into a sealed segment file — the
+    scrub harness's fault, not a runtime hook.
+
+    ``bit_flip`` parses the footer to find a column block and flips one
+    bit INSIDE it: the footer (and its crc) stay valid, the file still
+    opens, only the block checksum can catch it — exactly the disk-rot
+    shape the scrubber exists for. ``truncate`` cuts the file mid-byte
+    (torn-file shape: Segment.open refuses it outright).
+
+    Returns {"mode", "column", "offset"} describing the damage."""
+    import json as _json
+    import struct as _struct
+    rng = random.Random(seed)
+    size = os.path.getsize(path)
+    if mode == "truncate":
+        cut = max(1, size // 2 + rng.randrange(-size // 4 or 1,
+                                               size // 4 or 2))
+        with open(path, "rb+") as f:
+            f.truncate(min(cut, size - 1))
+        return {"mode": "truncate", "column": None, "offset": cut}
+    tail = _struct.Struct("<II8s")
+    with open(path, "rb+") as f:
+        f.seek(size - tail.size)
+        flen, _, magic = tail.unpack(f.read(tail.size))
+        if magic != b"DFSEGEND":
+            raise ValueError(f"{path}: not a sealed segment")
+        f.seek(size - tail.size - flen)
+        footer = _json.loads(f.read(flen))
+        cols = {k: v for k, v in footer.get("cols", {}).items()
+                if v.get("nbytes", 0) > 0}
+        if not cols:
+            raise ValueError(f"{path}: no non-empty column block")
+        name = rng.choice(sorted(cols))
+        c = cols[name]
+        off = c["off"] + rng.randrange(c["nbytes"])
+        f.seek(off)
+        b = f.read(1)
+        f.seek(off)
+        f.write(bytes([b[0] ^ (1 << rng.randrange(8))]))
+        f.flush()
+        os.fsync(f.fileno())
+    return {"mode": "bit_flip", "column": name, "offset": off}
 
 
 def chaos_from_env() -> ChaosInjector | None:

@@ -176,6 +176,19 @@ def main(argv: list[str] | None = None) -> int:
     p_seg.add_argument("--json", action="store_true",
                        help="raw /v1/segments JSON")
 
+    p_fsck = sub.add_parser(
+        "fsck", help="verify every block checksum of every sealed "
+                     "segment now; corrupt segments are quarantined "
+                     "and repaired from their published object-store "
+                     "copy (the background scrubber's on-demand form)")
+    p_fsck.add_argument("table", nargs="?", default=None,
+                        help="limit to one table (default: all)")
+    p_fsck.add_argument("--no-repair", action="store_true",
+                        help="report only: leave corrupt segments in "
+                             "service (no quarantine, no repair)")
+    p_fsck.add_argument("--json", action="store_true",
+                        help="raw /v1/fsck JSON")
+
     p_rt = sub.add_parser(
         "readtier", help="stateless querier view: adopted publish gens "
                          "per ingest shard, per-table adopted "
@@ -717,6 +730,44 @@ def main(argv: list[str] | None = None) -> int:
         print_table(["TABLE", "SEGMENT", "FMT", "ROWS", "BYTES", "RUN",
                      "SORTED_BY", "ZONES", "INDEXED", "CODECS"], rows)
         print(f"\ncompact_gen: {out.get('compact_gen', 0)}")
+    elif args.cmd == "fsck":
+        path = "/v1/fsck"
+        q = []
+        if args.table:
+            q.append(f"table={args.table}")
+        if args.no_repair:
+            q.append("repair=0")
+        if q:
+            path += "?" + "&".join(q)
+        out = _api(args.server, path)
+        if not out.get("storage"):
+            print("(storage tier disabled — start the server with "
+                  "--storage)")
+            return 0
+        if args.json:
+            print(json.dumps(out, indent=2))
+            return 0
+        rows = []
+        for name, t in sorted(out.get("tables", {}).items()):
+            q_info = t.get("quarantined") or {}
+            rows.append([
+                name, t["segments"], t["clean"], t["unverifiable"],
+                len(t["corrupt"]), len(t["repaired"]),
+                len(t["repair_failed"]), len(q_info),
+                t["blocks_checked"], t["bytes"]])
+        print_table(["TABLE", "SEGS", "CLEAN", "UNVERIF", "CORRUPT",
+                     "REPAIRED", "REPAIR_FAIL", "QUARANTINED",
+                     "BLOCKS", "BYTES"], rows)
+        for name, t in sorted(out.get("tables", {}).items()):
+            for c in t["corrupt"]:
+                print(f"  corrupt: {name}/{c['file']} "
+                      f"blocks={','.join(c['blocks'])}")
+            for fn, info in sorted((t.get("quarantined") or {}).items()):
+                print(f"  quarantined: {name}/{fn} "
+                      f"reason={info.get('reason', '?')} "
+                      f"rows={info.get('rows', 0)}")
+        print(f"\nfsck: {'OK' if out.get('ok') else 'DEGRADED'}")
+        return 0 if out.get("ok") else 1
     elif args.cmd == "readtier":
         h = _api(args.server, "/v1/health")
         rt = h.get("readtier")

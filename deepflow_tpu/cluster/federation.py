@@ -311,6 +311,17 @@ class FederationCoordinator:
                                                    hop_name="cluster.sql")
             sc.annotate(answered=len(results),
                         missing=len(info.get("missing_shards", [])))
+        # integrity degradation: any shard serving with quarantined
+        # (corrupt, awaiting-repair) segments says so on every reply —
+        # including "unchanged" short-circuits, so a quarantine that
+        # appears between two identical queries still surfaces. Same
+        # honesty contract as missing_shards, different cause.
+        deg_shards = {str(sid): r["degraded"]
+                      for sid, r in results.items()
+                      if isinstance(r, dict) and r.get("degraded")}
+        if deg_shards:
+            info = dict(info)
+            info["degraded_shards"] = deg_shards
         local = db.table(table.name) if db is not self.db else table
         ring = self.ring()
         # the local partial's validity depends on the claim view too:

@@ -86,6 +86,13 @@ class Flusher:
         self.compress = (os.cpu_count() or 1) > 1
         self.stats = {"flushes": 0, "rows_flushed": 0, "seqs_released": 0,
                       "errors": 0, "flush_ns": 0}
+        # consecutive failed commits (disk full, I/O error): drives the
+        # run loop's bounded exponential backoff AND the write-pressure
+        # signal Server._flusher_backlog feeds the PressureController —
+        # sustained write failure sheds load at the agents instead of
+        # letting the gate grow without bound. Reset on the first
+        # successful flush.
+        self.consec_errors = 0
         if telemetry is None:
             from deepflow_tpu.telemetry import Telemetry
             telemetry = Telemetry("server", enabled=False)
@@ -120,9 +127,11 @@ class Flusher:
                                              compress=self.compress)
             except Exception:
                 self.stats["errors"] += 1
+                self.consec_errors += 1
                 if pend and self.gate is not None:
                     self.gate.requeue(pend)
                 raise
+            self.consec_errors = 0
             # release: the acks now describe durable state
             if self.seq_tracker is not None:
                 for agent_id, seq in pend:
@@ -156,12 +165,23 @@ class Flusher:
         hb = self._telemetry.heartbeat(
             "flusher", interval_hint_s=max(1.0, self.interval_s))
         hb.beat()
-        while not self._stop.wait(self.interval_s):
+        while True:
+            # bounded exponential backoff after failed commits: a full
+            # disk gets probed at 1x, 2x, 4x ... up to 30s, not hammered
+            # every interval; gate entries stay parked (acks withheld)
+            # so the transport spool absorbs the stall
+            wait = self.interval_s
+            if self.consec_errors:
+                wait = min(self.interval_s * (2 ** min(
+                    self.consec_errors, 6)), 30.0)
+            if self._stop.wait(wait):
+                return
             hb.beat(progress=self.stats["flushes"])
             try:
                 self.flush_once()
             except Exception:
-                log.exception("tier flush failed")
+                log.exception("tier flush failed (attempt %d)",
+                              self.consec_errors)
 
 
 class Compactor:

@@ -87,6 +87,9 @@ class QuerierAPI:
         self.publisher = None
         self.readtier = None
         self.partial_cache = None
+        # background integrity scrubber (store/scrub.py), set by
+        # server.py: backs /v1/fsck repair and the health scrub block
+        self.scrubber = None
         # closed-loop QoS (deepflow_tpu/qos): the facade + the
         # receiver's per-tenant drop attribution, set by server.py on
         # ingest nodes (querier replicas take no agent traffic)
@@ -317,13 +320,15 @@ class QuerierAPI:
                 if isinstance(info, dict):
                     ex.annotate(shards=int(info.get("shards", 1)),
                                 cache=str(info.get("cache", "")))
-            return {"result": result.to_dict(), "debug": debug,
-                    "federation": info}
+            return self._annotate_degraded(
+                {"result": result.to_dict(), "debug": debug,
+                 "federation": info}, table.name)
         if sketch is not None:
             result, info = sketch
             debug["datasource"] = info
             qtrace.annotate(datasource=str(info))
-            return {"result": result.to_dict(), "debug": debug}
+            return self._annotate_degraded(
+                {"result": result.to_dict(), "debug": debug}, table.name)
         # org scoping rewrote the AST, not the text — fold it into the
         # cache key so scoped variants of one SQL string don't collide
         with qtrace.span("execute", path="local") as ex:
@@ -331,7 +336,42 @@ class QuerierAPI:
                 table, sql_text, select=select,
                 extra_key=None if org is None else ("org", org))
             ex.annotate(rows=len(result.values))
-        return {"result": result.to_dict(), "debug": debug}
+        return self._annotate_degraded(
+            {"result": result.to_dict(), "debug": debug}, table.name)
+
+    def _degraded_for(self, table_name: str) -> dict | None:
+        """Quarantine marker for a table: rows the integrity scrubber
+        pulled from service (corrupt segments) and has not repaired
+        yet. None when the table serves its full history."""
+        store = getattr(self.db, "tier_store", None)
+        if store is None:
+            return None
+        info = store.quarantine_info(table_name)
+        if not info:
+            return None
+        return {"reason": "segment_quarantine", **info}
+
+    def _annotate_degraded(self, out: dict, table_name: str) -> dict:
+        """Attach the degraded marker + a human warning to a query
+        response — the same short-answer honesty contract federation's
+        missing_shards uses: results during a quarantine gap are
+        SERVED, but never silently presented as complete. Remote-shard
+        markers gathered by the scatter ride in under federation."""
+        deg = self._degraded_for(table_name)
+        fed = out.get("federation")
+        fed_deg = (fed.get("degraded_shards")
+                   if isinstance(fed, dict) else None)
+        if deg is not None:
+            out["degraded"] = deg
+            out.setdefault("warnings", []).append(
+                f"results may be incomplete: {deg['rows']} rows in "
+                f"{deg['segments']} quarantined segment(s) of "
+                f"{table_name} await repair")
+        if fed_deg:
+            out.setdefault("warnings", []).append(
+                f"results may be incomplete: quarantined segments on "
+                f"shard(s) {sorted(fed_deg)} await repair")
+        return out
 
     # -- EXPLAIN [ANALYZE] ---------------------------------------------------
 
@@ -1505,8 +1545,17 @@ class QuerierAPI:
                 self._org_scope(select, table, org)
             if not body.get("enc"):
                 # pre-encoding coordinator: decoded partial, old wire form
-                return qengine.execute_partial(table, select)
-            return self._sql_partial_enc(body, table, select, org)
+                out = qengine.execute_partial(table, select)
+            else:
+                out = self._sql_partial_enc(body, table, select, org)
+            # shard-side degraded marker: computed fresh per call (an
+            # unchanged-token short-circuit reply still reports a NEW
+            # quarantine), merged by the coordinator into
+            # federation.degraded_shards
+            deg = self._degraded_for(str(body.get("table") or ""))
+            if deg is not None:
+                out["degraded"] = deg
+            return out
         if op == "promql_raw":
             from deepflow_tpu.query import promql
             vs = promql.VectorSelector(
@@ -1684,6 +1733,56 @@ class QuerierAPI:
                 tables[name] = rows
         return {"tables": tables, "storage": True,
                 "compact_gen": store.compact_gen}
+
+    def fsck(self, table: str | None = None,
+             repair: bool = True) -> dict:
+        """On-demand integrity check (the `dfctl fsck` backend): verify
+        every block checksum of every sealed local segment NOW, without
+        waiting for the background scrubber's paced walk. Corrupt
+        segments go through the same quarantine + repair path the
+        scrubber uses (repair=False reports only). Pre-checksum (v1/
+        early-v2) segments count as unverifiable, never as corrupt."""
+        store = getattr(self.db, "tier_store", None)
+        if store is None:
+            return {"storage": False, "tables": {}}
+        self.db._ensure_loaded()
+        scrub = self.scrubber
+        if scrub is None and repair:
+            from deepflow_tpu.store.scrub import Scrubber
+            scrub = Scrubber(self.db, shard_id=self.shard_id,
+                             telemetry=self.telemetry)
+        names = [table] if table else sorted(store.tables())
+        tables: dict[str, dict] = {}
+        for name in names:
+            tt = store.tier(name)
+            res = {"segments": 0, "blocks_checked": 0, "bytes": 0,
+                   "clean": 0, "unverifiable": 0, "corrupt": [],
+                   "repaired": [], "repair_failed": []}
+            for seg in tt.segments():
+                v = seg.verify()
+                res["segments"] += 1
+                res["blocks_checked"] += v["checked"]
+                res["bytes"] += v["bytes"]
+                if v["corrupt"]:
+                    fn = os.path.basename(seg.path)
+                    res["corrupt"].append({"file": fn,
+                                           "blocks": v["corrupt"]})
+                    if scrub is not None:
+                        ok = scrub.quarantine_and_repair(
+                            name, seg, f"fsck:{','.join(v['corrupt'])}")
+                        res["repaired" if ok
+                            else "repair_failed"].append(fn)
+                elif v["verifiable"]:
+                    res["clean"] += 1
+                else:
+                    res["unverifiable"] += 1
+            q = store.quarantined().get(name)
+            if q:
+                res["quarantined"] = q
+            tables[name] = res
+        return {"storage": True, "tables": tables,
+                "ok": not any(t["corrupt"] or t.get("quarantined")
+                              for t in tables.values())}
 
     def health(self) -> dict:
         """Liveness + the self-telemetry spine: per-stage heartbeat
@@ -1890,6 +1989,11 @@ class QuerierHTTP:
                         self._send(200, api.segments(
                             table=params.get("table") or None,
                             v1_only=params.get("v1") in ("1", "true")))
+                    elif path == "/v1/fsck":
+                        self._send(200, api.fsck(
+                            table=params.get("table") or None,
+                            repair=params.get("repair")
+                            not in ("0", "false")))
                     elif path == "/v1/alerts":
                         self._send(200, api.alerts_api("list", {}))
                     elif path == "/v1/exporters":

@@ -44,9 +44,11 @@ class Server:
                  storage: bool = False,
                  flush_interval_s: float = 1.0,
                  compact_interval_s: float = 60.0,
+                 scrub_interval_s: float = 30.0,
                  storage_max_bytes: int = 0,
                  role: str = "ingest",
                  objstore: str | None = None,
+                 objstore_mirrors=None,
                  segcache_max_bytes: int = 256 << 20,
                  publish_interval_s: float = 2.0,
                  readtier_poll_s: float = 2.0,
@@ -62,6 +64,10 @@ class Server:
         #   live/unpublished rows via the publish-gen handshake.
         self.role = role if role in ("ingest", "querier") else "ingest"
         self.objstore_path = objstore
+        # read-only alternate objstore roots (other replicas' stores):
+        # fetches fail over to them when the primary copy is missing or
+        # corrupt — blobs are immutable, so any copy is byte-identical
+        self.objstore_mirrors = list(objstore_mirrors or [])
         self.segcache_max_bytes = max(1 << 20, int(segcache_max_bytes))
         self.publish_interval_s = publish_interval_s
         self.readtier_poll_s = readtier_poll_s
@@ -113,6 +119,7 @@ class Server:
                             and self.role == "ingest")
         self.flush_interval_s = flush_interval_s
         self.compact_interval_s = compact_interval_s
+        self.scrub_interval_s = scrub_interval_s
         self.storage_max_bytes = max(0, int(storage_max_bytes))
         # a querier's tables are pure views over adopted remote
         # segments: no local persistence, no recovery — its data_dir
@@ -124,6 +131,7 @@ class Server:
             shard_id=shard_id, storage=self.storage)
         self.flusher = None
         self.compactor = None
+        self.scrubber = None
         self.durability = None
         if self.storage:
             from deepflow_tpu.server.flusher import DurabilityGate
@@ -248,6 +256,8 @@ class Server:
                         if self.flusher is not None else None),
             "compactor": (dict(self.compactor.stats)
                           if self.compactor is not None else None),
+            "scrubber": (dict(self.scrubber.stats)
+                         if self.scrubber is not None else None),
             "genesis": (dict(self.genesis.stats)
                         if self.genesis is not None else None),
             "qos": (self.qos.snapshot()
@@ -257,10 +267,18 @@ class Server:
 
     def _flusher_backlog(self) -> float:
         """Durability-gate depth as a 0..1 pressure signal: acks the
-        flusher has not yet released.  4096 pending seqs ≈ saturated."""
+        flusher has not yet released.  4096 pending seqs ≈ saturated.
+        Sustained commit failure (full/faulty disk) saturates the
+        signal directly — the gate may still be shallow right after the
+        first failed flush, but nothing will drain it, so pressure must
+        reach the agents before the spool does all the absorbing."""
         if self.durability is None:
             return 0.0
-        return min(1.0, len(self.durability) / 4096.0)
+        depth = min(1.0, len(self.durability) / 4096.0)
+        if self.flusher is not None and self.flusher.consec_errors:
+            depth = max(depth, min(
+                1.0, self.flusher.consec_errors / 3.0))
+        return depth
 
     def _storage_stats(self) -> dict | None:
         """The /v1/health storage block: tier state + rollup horizons."""
@@ -269,6 +287,10 @@ class Server:
         snap = self.db.tier_store.snapshot()
         snap["gate_pending"] = (len(self.durability)
                                 if self.durability is not None else 0)
+        if self.flusher is not None:
+            snap["flush_consec_errors"] = self.flusher.consec_errors
+        if self.scrubber is not None:
+            snap["scrub"] = self.scrubber.snapshot()
         snap["rollup_horizons"] = {
             f"{fam}.{sfx}": wm
             for (fam, sfx), wm in self.rollup.horizons().items()}
@@ -366,7 +388,13 @@ class Server:
                 raw = json.load(f)
             return {int(k): int(v) for k, v in raw.items()}
         except (OSError, ValueError):
+            # a torn/corrupt floors file is treated as ABSENT, never
+            # fatal: floors restart from the tier manifest's copy (or
+            # zero) and dedup re-absorbs the retransmits. Ledgered so
+            # the recovery is visible, not silent.
             log.warning("ack state unreadable; starting fresh", exc_info=True)
+            self.telemetry.hop("storage").account(
+                emitted=1, dropped=1, reason="state_corrupt")
             return {}
 
     def _save_ack_state(self) -> None:
@@ -395,9 +423,23 @@ class Server:
     def start(self) -> "Server":
         if self.db.data_dir:
             self.db.load()  # resume persisted tables
+        # storage-scope chaos (DF_CHAOS tier_enospc/objstore_eio knobs)
+        # hooks into the tier commit and blob publish paths; None (the
+        # default) costs the hot paths one attribute check
+        from deepflow_tpu.chaos import chaos_from_env
+        chaos = chaos_from_env()
+        if self.db.tier_store is not None:
+            self.db.tier_store.chaos = chaos
+            if self.db.tier_store.stats.get("manifest_corrupt"):
+                # recovery met an unreadable MANIFEST.json and scavenged
+                # the segment files instead — ledgered, never silent
+                self.telemetry.hop("storage").account(
+                    emitted=1, dropped=1, reason="state_corrupt")
         if self.objstore_path is not None:
             from deepflow_tpu.store.objstore import ObjStore
-            self.objstore = ObjStore(self.objstore_path)
+            self.objstore = ObjStore(self.objstore_path,
+                                     mirrors=self.objstore_mirrors)
+            self.objstore.chaos = chaos
         if self.role == "querier":
             self._start_readtier()
         else:
@@ -525,6 +567,14 @@ class Server:
                 self.compactor = Compactor(
                     self.db, interval_s=self.compact_interval_s,
                     telemetry=self.telemetry).start()
+            if self.scrub_interval_s > 0:
+                from deepflow_tpu.store.scrub import Scrubber
+                self.scrubber = Scrubber(
+                    self.db, objstore=self.objstore,
+                    shard_id=self.shard_id,
+                    interval_s=self.scrub_interval_s,
+                    telemetry=self.telemetry).start()
+                self.api.scrubber = self.scrubber
         if qos_on:
             self.qos.start()
         self.receiver.start()
@@ -561,6 +611,16 @@ class Server:
         self.readtier = ReadTier(self.db, self.objstore, self.segcache,
                                  shard_id=self.shard_id)
         self.api.readtier = self.readtier
+        if self.scrub_interval_s > 0:
+            # a querier scrubs its CACHED copies: a corrupt one is
+            # discarded and transparently re-fetched on the next pin
+            from deepflow_tpu.store.scrub import Scrubber
+            self.scrubber = Scrubber(
+                self.db, segcache=self.segcache,
+                shard_id=self.shard_id,
+                interval_s=self.scrub_interval_s,
+                telemetry=self.telemetry).start()
+            self.api.scrubber = self.scrubber
         try:
             self.readtier.poll()  # first adoption before serving
         except Exception:
@@ -694,6 +754,11 @@ class Server:
                 d.flush()  # stateful reducers drain pending windows
                 # BEFORE the db persists (the file_agg tail otherwise
                 # vanishes on every restart)
+        if self.scrubber is not None:
+            # before the final flush: a quarantine is a manifest commit
+            # too — stop the scrubber racing the shutdown renames
+            self.scrubber.stop()
+            self.scrubber = None
         if self.compactor is not None:
             # before the final flush: a mid-commit compaction and the
             # flush both rename the manifest; stop the race first
@@ -802,6 +867,11 @@ def main() -> None:
                         help="tier compaction cadence (storage mode): "
                              "merge small sealed segments into sorted "
                              "format-v2 runs; 0 disables")
+    parser.add_argument("--scrub-interval-s", type=float, default=30.0,
+                        help="background integrity-scrub cadence "
+                             "(storage/querier modes): verify segment "
+                             "block checksums, quarantine + repair "
+                             "corrupt segments; 0 disables")
     parser.add_argument("--storage-max-mb", type=int, default=0,
                         help="on-disk tier size budget per node; the "
                              "janitor evicts oldest segments past it "
@@ -817,6 +887,12 @@ def main() -> None:
                              "nodes publish sealed segments + manifest "
                              "pointers there; queriers adopt them "
                              "(required for --role querier)")
+    parser.add_argument("--objstore-mirror", action="append",
+                        default=None, metavar="DIR",
+                        help="read-only alternate object-store root "
+                             "(repeatable): fetches fail over to it "
+                             "when the primary copy is missing or "
+                             "fails checksum verification")
     parser.add_argument("--segcache-max-mb", type=int, default=256,
                         help="querier local segment-cache byte budget; "
                              "least-recently-used segments past it are "
@@ -857,8 +933,10 @@ def main() -> None:
                     storage=args.storage,
                     flush_interval_s=args.flush_interval_s,
                     compact_interval_s=args.compact_interval_s,
+                    scrub_interval_s=args.scrub_interval_s,
                     storage_max_bytes=args.storage_max_mb << 20,
                     role=args.role, objstore=args.objstore,
+                    objstore_mirrors=args.objstore_mirror,
                     segcache_max_bytes=args.segcache_max_mb << 20,
                     publish_interval_s=args.publish_interval_s,
                     readtier_poll_s=args.readtier_poll_s,

@@ -72,16 +72,27 @@ class ObjStore:
     same key converge (the content is immutable by contract, so either
     rename winning yields the same bytes)."""
 
-    def __init__(self, root: str) -> None:
+    def __init__(self, root: str,
+                 mirrors: list[str] | None = None) -> None:
         self.root = root
         self._blobs = os.path.join(root, _BLOB_DIR)
         self._ptrs = os.path.join(root, _PTR_DIR)
         os.makedirs(self._blobs, exist_ok=True)
         os.makedirs(self._ptrs, exist_ok=True)
+        # read-only alternate replica roots (a second NFS mount, a
+        # backup bucket): fetch/get_bytes fall over to them when the
+        # primary blob is missing or unreadable — the segcache/repair
+        # paths' "alternate replica's published copy"
+        self.mirrors = list(mirrors or [])
+        self._mirror_blob_dirs = [os.path.join(m, _BLOB_DIR)
+                                  for m in self.mirrors]
+        # fault injection (chaos.ChaosInjector or None): consulted
+        # before staging a blob write
+        self.chaos = None
         self._lock = threading.Lock()
         self.stats = {"puts": 0, "put_skipped": 0, "gets": 0,
                       "deletes": 0, "pointer_swaps": 0,
-                      "bytes_up": 0, "bytes_down": 0}
+                      "bytes_up": 0, "bytes_down": 0, "mirror_hits": 0}
 
     # -- blobs ---------------------------------------------------------------
 
@@ -89,6 +100,10 @@ class ObjStore:
         if key.startswith(("/", "..")) or "/../" in key:
             raise ValueError(f"bad object key {key!r}")
         return os.path.join(self._blobs, *key.split("/"))
+
+    def _mirror_paths(self, key: str) -> list[str]:
+        parts = key.split("/")
+        return [os.path.join(d, *parts) for d in self._mirror_blob_dirs]
 
     def exists(self, key: str) -> bool:
         return os.path.exists(self._blob_path(key))
@@ -103,6 +118,10 @@ class ObjStore:
             with self._lock:
                 self.stats["put_skipped"] += 1
             return False
+        if self.chaos is not None:
+            # I/O fault injection: the put fails BEFORE any bytes land,
+            # so a failed publish can never leave a torn blob behind
+            self.chaos.on_objstore_write()
         os.makedirs(os.path.dirname(path), exist_ok=True)
         tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
         try:
@@ -128,8 +147,22 @@ class ObjStore:
         return True
 
     def get_bytes(self, key: str) -> bytes:
-        with open(self._blob_path(key), "rb") as f:
-            data = f.read()
+        try:
+            with open(self._blob_path(key), "rb") as f:
+                data = f.read()
+        except OSError:
+            data = None
+            for alt in self._mirror_paths(key):
+                try:
+                    with open(alt, "rb") as f:
+                        data = f.read()
+                    break
+                except OSError:
+                    continue
+            if data is None:
+                raise
+            with self._lock:
+                self.stats["mirror_hits"] += 1
         with self._lock:
             self.stats["gets"] += 1
             self.stats["bytes_down"] += len(data)
@@ -138,24 +171,33 @@ class ObjStore:
     def fetch(self, key: str, dst: str) -> int:
         """Copy a blob to a local path (the segcache fill). Returns the
         byte size. Raises FileNotFoundError when the blob was GC'd
-        between pointer read and fetch — the caller skips and re-polls."""
-        path = self._blob_path(key)
+        between pointer read and fetch — the caller skips and re-polls.
+        A primary miss/error falls over to the mirror roots first: the
+        alternate replica's copy of an immutable blob is byte-identical
+        by contract (and the caller checksum-verifies it anyway)."""
         tmp = f"{dst}.tmp.{os.getpid()}.{threading.get_ident()}"
         os.makedirs(os.path.dirname(dst), exist_ok=True)
-        try:
-            shutil.copyfile(path, tmp)
-            os.replace(tmp, dst)
-        except OSError:
+        sources = [self._blob_path(key)] + self._mirror_paths(key)
+        err: OSError | None = None
+        for i, path in enumerate(sources):
             try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            raise
-        size = os.path.getsize(dst)
-        with self._lock:
-            self.stats["gets"] += 1
-            self.stats["bytes_down"] += size
-        return size
+                shutil.copyfile(path, tmp)
+                os.replace(tmp, dst)
+            except OSError as e:
+                err = err or e
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                continue
+            size = os.path.getsize(dst)
+            with self._lock:
+                if i:
+                    self.stats["mirror_hits"] += 1
+                self.stats["gets"] += 1
+                self.stats["bytes_down"] += size
+            return size
+        raise err if err is not None else FileNotFoundError(key)
 
     def delete(self, key: str) -> bool:
         try:

@@ -36,6 +36,7 @@ import json
 import logging
 import os
 import threading
+import time
 import weakref
 from collections import OrderedDict, deque
 from collections.abc import Mapping
@@ -64,9 +65,15 @@ class SegmentCache:
     unlink either way — this only bounds DISK usage honestly)."""
 
     def __init__(self, root: str, store, max_bytes: int = 256 << 20,
-                 telemetry=None) -> None:
+                 telemetry=None, alt_stores=None) -> None:
         self.root = root
         self.store = store
+        # alternate replicas' object stores (read-only): a fetch that
+        # fails against the primary — missing blob, I/O error, or a
+        # copy that fails checksum verification — falls over to these
+        # in order. Blobs are immutable, so any replica's copy of the
+        # same key is byte-identical by contract.
+        self.alt_stores = list(alt_stores or [])
         self.max_bytes = int(max_bytes)
         os.makedirs(root, exist_ok=True)
         self._wipe_leftovers()
@@ -76,12 +83,19 @@ class SegmentCache:
         # pin releases that could not take _lock (finalizer fired in a
         # thread already holding it); drained by pin/discard/snapshot
         self._pending: "deque[dict]" = deque()
+        # per-key fetch failure state: key -> (consec_fails, next_try
+        # monotonic). A key whose every source just failed is not
+        # re-hammered on each scan — retries back off exponentially
+        # (bounded at 30s) and the query gets the error immediately.
+        self._backoff: dict[tuple, tuple[int, float]] = {}
         self._hop = (telemetry.hop("readtier.segcache")
                      if telemetry else None)
         self.stats = {"fetches": 0, "hits": 0, "misses": 0,
                       "evictions": 0, "deferred_unlinks": 0,
                       "rows_evicted": 0, "bytes_evicted": 0,
-                      "fetch_errors": 0, "bytes": 0, "segments": 0}
+                      "fetch_errors": 0, "fetch_failover": 0,
+                      "fetch_corrupt": 0, "fetch_backoffs": 0,
+                      "bytes": 0, "segments": 0}
 
     def _wipe_leftovers(self) -> None:
         # a restarted querier starts cold: files from a previous process
@@ -156,16 +170,52 @@ class SegmentCache:
 
     def _fetch(self, rseg) -> dict:
         from deepflow_tpu.store import objstore
-        from deepflow_tpu.store.segment import Segment
+        from deepflow_tpu.store.segment import Segment, SegmentError
+        with self._lock:
+            bo = self._backoff.get(rseg.key)
+            if bo is not None and time.monotonic() < bo[1]:
+                self.stats["fetch_backoffs"] += 1
+                raise OSError(
+                    f"segcache: fetch of {rseg.key} backing off "
+                    f"after {bo[0]} failures")
         dst_dir = os.path.join(self.root, str(rseg.shard), rseg.table)
         os.makedirs(dst_dir, exist_ok=True)
         dst = os.path.join(dst_dir, rseg.fn)
         key = objstore.seg_key(rseg.shard, rseg.table, rseg.fn)
-        size = self.store.fetch(key, dst)
-        seg = Segment.open(dst)
-        return {"key": rseg.key, "seg": seg, "size": size, "path": dst,
-                "rows": seg.rows, "refs": 0, "condemned": False,
-                "unlinked": False}
+        err: Exception | None = None
+        for i, store in enumerate([self.store] + self.alt_stores):
+            try:
+                size = store.fetch(key, dst)
+                seg = Segment.open(dst)
+                # verify-on-fetch: a copy that fails its block crcs is
+                # discarded HERE, before any scan maps it — the next
+                # source (an alternate replica's copy) gets its turn
+                v = seg.verify()
+                if v["corrupt"]:
+                    self.stats["fetch_corrupt"] += 1
+                    raise SegmentError(
+                        f"{key}: fetched copy corrupt "
+                        f"(blocks {v['corrupt']})")
+            except (OSError, SegmentError) as e:
+                err = e
+                try:
+                    os.unlink(dst)
+                except OSError:
+                    pass
+                continue
+            if i:
+                self.stats["fetch_failover"] += 1
+            with self._lock:
+                self._backoff.pop(rseg.key, None)
+            return {"key": rseg.key, "seg": seg, "size": size,
+                    "path": dst, "rows": seg.rows, "refs": 0,
+                    "condemned": False, "unlinked": False}
+        with self._lock:
+            fails = (self._backoff.get(rseg.key) or (0, 0.0))[0] + 1
+            self._backoff[rseg.key] = (fails, time.monotonic() + min(
+                0.5 * (2 ** min(fails, 6)), 30.0))
+        assert err is not None
+        raise err
 
     # -- eviction -------------------------------------------------------------
 
@@ -260,11 +310,19 @@ class SegmentCache:
         except OSError:
             pass
 
+    def entries(self) -> list[tuple[tuple, dict]]:
+        """Point-in-time (key, entry) pairs — the scrubber's walk
+        surface. Entries may be discarded concurrently; callers treat
+        each one as best-effort."""
+        with self._lock:
+            return list(self._entries.items())
+
     def snapshot(self) -> dict:
         self._drain_releases()
         with self._lock:
             out = dict(self.stats)
         out["max_bytes"] = self.max_bytes
+        out["backoff_keys"] = len(self._backoff)
         return out
 
 

@@ -123,6 +123,26 @@ class SegmentError(Exception):
     """Unreadable/torn segment file. recovery policy: drop the file."""
 
 
+class ChecksumError(SegmentError):
+    """A column/index block's crc32 does not match its footer record:
+    the file parsed fine (footer crc passed) but a block's bytes rotted
+    after the write — bit flip, bad sector, torn page. recovery policy:
+    QUARANTINE the segment (never serve it) and repair from a published
+    copy; unlike a torn file there is nothing wrong with the metadata,
+    so the file is kept on disk for repair/forensics."""
+
+    def __init__(self, path: str, block: str) -> None:
+        super().__init__(f"{path}: block {block!r} crc mismatch")
+        self.path = path
+        self.block = block
+
+
+# kill switch + bench baseline: DF_NO_CRC=1 skips writing (and therefore
+# verifying) block checksums — segments written this way are readable
+# forever but never verifiable, exactly like pre-checksum files
+_crc_enabled = not os.environ.get("DF_NO_CRC")
+
+
 def _pad(f, align: int = _ALIGN) -> int:
     pos = f.tell()
     rem = pos % align
@@ -419,11 +439,17 @@ def write_segment(path: str, chunk, time_col: str | None = None,
                    else len(blob),
                    "dtype": arr.dtype.str, "codec": codec,
                    "raw_nbytes": raw.nbytes, **meta}
+            if _crc_enabled:
+                # per-block crc32 (additive field — readers without it
+                # treat the block as unverifiable, never unreadable)
+                ent["crc"] = zlib.crc32(blob) & 0xFFFFFFFF
             if ranked is not None:
                 ioff = _pad(f)
                 f.write(ranked[2])
                 ent["idmap_off"] = ioff
                 ent["idmap_nbytes"] = len(ranked[2])
+                if _crc_enabled:
+                    ent["idmap_crc"] = zlib.crc32(ranked[2]) & 0xFFFFFFFF
                 ent["zstr"] = _zstr_bounds(ranked[3])
             if z is not None:
                 ent["zmin"], ent["zmax"] = z
@@ -439,6 +465,8 @@ def write_segment(path: str, chunk, time_col: str | None = None,
                     f.write(bl)
                     ent["bloom"] = {"off": boff, "nbytes": len(bl),
                                     "k": _BLOOM_K}
+                    if _crc_enabled:
+                        ent["bloom"]["crc"] = zlib.crc32(bl) & 0xFFFFFFFF
                     if dicts is not None and name in dicts \
                             and "zstr" not in ent:
                         d = dicts[name]
@@ -598,7 +626,7 @@ class Segment:
 
     __slots__ = ("path", "rows", "tmin", "tmax", "time_col", "dict_gens",
                  "nbytes", "zones", "fmt", "run", "sorted_by", "_mm",
-                 "_cols", "_cache", "_lock", "_indexes")
+                 "_cols", "_cache", "_lock", "_indexes", "_crc_ok")
 
     def __init__(self, path: str, footer: dict, mm, nbytes: int) -> None:
         self.path = path
@@ -628,6 +656,10 @@ class Segment:
         self._cache: dict[str, np.ndarray] = {}
         self._lock = threading.Lock()
         self._indexes: dict[str, object] = {}
+        # blocks whose crc already matched THIS mapping: a Segment object
+        # is one mmap generation, so the hot query path pays one crc pass
+        # per block per open, ~zero after warm-up
+        self._crc_ok: set[str] = set()
 
     @classmethod
     def open(cls, path: str) -> "Segment":
@@ -729,11 +761,65 @@ class Segment:
                 f"{path}: column {name!r} holds {have} bytes, "
                 f"schema wants {want}")
 
+    def _check_crc(self, block: str, off: int, nbytes: int, crc) -> None:
+        """Verify one block's crc against the footer record (no-op for
+        pre-checksum blocks: crc None). Memoized per mmap generation in
+        ``_crc_ok`` so repeat touches cost a set lookup."""
+        if crc is None or block in self._crc_ok:
+            return
+        got = zlib.crc32(self._mm[off:off + nbytes]) & 0xFFFFFFFF
+        if got != crc:
+            raise ChecksumError(self.path, block)
+        with self._lock:
+            self._crc_ok.add(block)
+
+    def verify(self) -> dict:
+        """Full checksum pass over every column/index block (the scrub
+        and fsck entry point). Pre-checksum blocks (v1, or written under
+        DF_NO_CRC) are counted but never accused: readable, never
+        verifiable. Unlike the first-touch path this recomputes every
+        crc — bytes can rot after a block was memoized clean — and
+        refreshes the memo both ways: clean blocks won't pay a second
+        pass at query time, corrupt ones lose their alibi."""
+        blocks = checked = nbytes = 0
+        corrupt: list[str] = []
+        for name, c in self._cols.items():
+            todo = [(name, c.get("off"), c.get("nbytes"), c.get("crc")),
+                    (f"idmap:{name}", c.get("idmap_off"),
+                     c.get("idmap_nbytes"), c.get("idmap_crc"))]
+            b = c.get("bloom")
+            if b is not None:
+                todo.append((f"bloom:{name}", b.get("off"),
+                             b.get("nbytes"), b.get("crc")))
+            for block, off, nb, crc in todo:
+                if off is None:
+                    continue
+                blocks += 1
+                nbytes += nb
+                if crc is None:
+                    continue
+                checked += 1
+                got = zlib.crc32(self._mm[off:off + nb]) & 0xFFFFFFFF
+                with self._lock:
+                    if got == crc:
+                        self._crc_ok.add(block)
+                    else:
+                        self._crc_ok.discard(block)
+                if got != crc:
+                    corrupt.append(block)
+        return {"blocks": blocks, "checked": checked, "bytes": nbytes,
+                "corrupt": corrupt,
+                "verifiable": checked > 0 or blocks == 0}
+
     def column(self, name: str) -> np.ndarray:
         a = self._cache.get(name)
         if a is not None:
             return a
         c = self._cols[name]
+        if _crc_enabled:
+            # verify-on-first-touch: the block's bytes are about to be
+            # decoded/viewed — one crc pass per mmap generation
+            self._check_crc(name, c["off"], c["nbytes"], c.get("crc"))
         dt = np.dtype(c["dtype"])
         codec = c["codec"]
         if codec == "raw":
@@ -779,6 +865,9 @@ class Segment:
         a = self._cache.get(key)
         if a is None:
             c = self._cols[name]
+            if _crc_enabled:
+                self._check_crc(key, c["idmap_off"], c["idmap_nbytes"],
+                                c.get("idmap_crc"))
             a = np.frombuffer(self._mm, dtype=np.uint32,
                               count=int(c["card"]),
                               offset=c["idmap_off"])
@@ -803,6 +892,12 @@ class Segment:
         c = self._cols.get(name)
         if c is None:
             return True
+        b = c.get("bloom")
+        if _crc_enabled and b is not None and name not in self._indexes:
+            # outside self._lock (non-reentrant; _check_crc takes it to
+            # memoize) — a racing duplicate check is benign
+            self._check_crc(f"bloom:{name}", b["off"], b["nbytes"],
+                            b.get("crc"))
         with self._lock:
             idx = self._indexes.get(name)
             if idx is None:
@@ -845,3 +940,58 @@ class Segment:
         return (f"Segment({os.path.basename(self.path)}, v{self.fmt}, "
                 f"rows={self.rows}, t=[{self.tmin},{self.tmax}], "
                 f"{self.nbytes}B)")
+
+
+def verify_buffer(buf, name: str = "<buf>") -> dict:
+    """Checksum-verify a whole segment held in memory — the scrub path
+    for objstore blobs, which have no mmap and no Segment object.
+
+    Returns {"ok", "verifiable", "corrupt", "reason"}:
+      * unparseable/torn (bad magic/tail/footer)  -> ok=False, "torn..."
+      * parseable, block crc mismatch             -> ok=False, corrupt=[..]
+      * parseable pre-checksum (v1 / DF_NO_CRC)   -> ok=True, verifiable=False
+    """
+    mv = memoryview(buf)
+    size = len(mv)
+    try:
+        if size < len(MAGIC) + _TAIL.size:
+            raise SegmentError("truncated")
+        if bytes(mv[:len(MAGIC)]) not in (MAGIC, MAGIC_V2):
+            raise SegmentError("bad magic")
+        flen, fcrc, tail = _TAIL.unpack(mv[size - _TAIL.size:])
+        if tail != TAIL_MAGIC:
+            raise SegmentError("bad tail magic (torn write)")
+        foot_off = size - _TAIL.size - flen
+        if flen <= 0 or foot_off < len(MAGIC):
+            raise SegmentError(f"bad footer length {flen}")
+        fb = mv[foot_off:foot_off + flen]
+        if (zlib.crc32(fb) & 0xFFFFFFFF) != fcrc:
+            raise SegmentError("footer crc mismatch")
+        footer = json.loads(bytes(fb))
+        cols = footer.get("cols")
+        if not isinstance(cols, dict):
+            raise SegmentError("malformed footer")
+    except (SegmentError, struct.error, ValueError) as e:
+        return {"ok": False, "verifiable": False, "corrupt": [],
+                "reason": f"torn: {name}: {e}"}
+    corrupt: list[str] = []
+    checked = 0
+    for cname, c in cols.items():
+        todo = [(cname, c.get("off"), c.get("nbytes"), c.get("crc")),
+                (f"idmap:{cname}", c.get("idmap_off"),
+                 c.get("idmap_nbytes"), c.get("idmap_crc"))]
+        b = c.get("bloom")
+        if isinstance(b, dict):
+            todo.append((f"bloom:{cname}", b.get("off"), b.get("nbytes"),
+                         b.get("crc")))
+        for block, off, nb, crc in todo:
+            if off is None or crc is None:
+                continue
+            checked += 1
+            if not isinstance(off, int) or not isinstance(nb, int) \
+                    or off < 0 or nb < 0 or off + nb > foot_off \
+                    or (zlib.crc32(mv[off:off + nb]) & 0xFFFFFFFF) != crc:
+                corrupt.append(block)
+    return {"ok": not corrupt, "verifiable": checked > 0,
+            "corrupt": corrupt,
+            "reason": f"crc: {name}: {corrupt}" if corrupt else ""}

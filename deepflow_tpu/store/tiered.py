@@ -109,6 +109,13 @@ class TableTier:
         # AND compaction) — surfaced in the tier snapshot so ops can see
         # what choose_codec actually picked (ISSUE 11 satellite)
         self.codec_counts: dict[str, int] = {}
+        # fn -> {reason, rows, bytes, tmin, tmax}: segments pulled from
+        # service after failing checksum verification. The manifest
+        # vouches for these names (recovery must neither serve nor
+        # torn-tail-delete them — the file is the repair/forensics
+        # evidence) but they never join _segments until repaired.
+        # Mutated only under TieredStore._lock, like next_id.
+        self.quarantined: dict[str, dict] = {}
 
     # -- read side ----------------------------------------------------------
 
@@ -259,7 +266,14 @@ class TieredStore:
                       "bytes_evicted": 0,
                       "runs_built": 0, "segments_replaced": 0,
                       "compact_rows": 0, "bytes_before": 0,
-                      "bytes_after": 0, "segments_migrated": 0}
+                      "bytes_after": 0, "segments_migrated": 0,
+                      "segments_quarantined": 0, "rows_quarantined": 0,
+                      "segments_repaired": 0,
+                      "manifest_corrupt": 0, "segments_scavenged": 0}
+        # fault injection (chaos.ChaosInjector or None): consulted at
+        # the top of every segment-writing commit so scrub-check can
+        # exercise the ENOSPC degradation path in-process
+        self.chaos = None
         # observed write-cost of each codec choice (deferred import:
         # query.costmodel must not be imported at store import time —
         # query/__init__ imports the engine which imports the store)
@@ -293,7 +307,9 @@ class TieredStore:
             "ack_floors": {str(k): v for k, v in self.ack_floors.items()},
             "tables": {
                 name: {"next_id": tt.next_id,
-                       "segments": tt.manifest_names()}
+                       "segments": tt.manifest_names(),
+                       **({"quarantined": tt.quarantined}
+                          if tt.quarantined else {})}
                 for name, tt in self._tables.items()},
         }
         path = self._manifest_path()
@@ -315,14 +331,23 @@ class TieredStore:
         with self._lock:
             path = self._manifest_path()
             doc = {}
+            scavenge = False
             if os.path.exists(path):
                 try:
                     with open(path) as f:
                         doc = json.load(f)
                 except (OSError, ValueError):
-                    log.warning("tier manifest unreadable; starting empty",
-                                exc_info=True)
+                    # corrupt manifest (torn JSON, bad sector): SCAVENGE
+                    # instead of starting empty — adopt every readable
+                    # .seg file on disk. Deliberate tradeoff: ack floors
+                    # restart from ack_state.json alone, so the worst
+                    # case is bounded duplicates (the last uncommitted
+                    # flush retransmits), never total data loss.
+                    log.warning("tier manifest unreadable; scavenging "
+                                "readable segments", exc_info=True)
+                    self.stats["manifest_corrupt"] += 1
                     doc = {}
+                    scavenge = True
             self.npz_imported = bool(doc.get("npz_imported", False))
             self.flush_gen = int(doc.get("flush_gen", 0))
             self.evict_gen = int(doc.get("evict_gen", 0))
@@ -333,7 +358,15 @@ class TieredStore:
             for name, ent in doc.get("tables", {}).items():
                 tt = self.tier(name)
                 tt.next_id = int(ent.get("next_id", 1))
+                q = ent.get("quarantined")
+                if isinstance(q, dict):
+                    # quarantined files stay on disk awaiting repair but
+                    # are NEVER opened or served
+                    tt.quarantined = {str(fn): dict(info)
+                                      for fn, info in q.items()}
                 for fn in ent.get("segments", []):
+                    if fn in tt.quarantined:
+                        continue
                     p = os.path.join(tt.dir, fn)
                     try:
                         tt._add(Segment.open(p))
@@ -345,9 +378,14 @@ class TieredStore:
                             os.unlink(p)
                         except OSError:
                             pass
+            if scavenge:
+                dropped |= self._scavenge()
             # torn tail: segment files the manifest never committed
+            # (quarantined names are vouched for — they are evidence,
+            # not tail)
             listed = {name: {os.path.basename(s.path)
                              for s in tt.segments()}
+                      | set(tt.quarantined)
                       for name, tt in self._tables.items()}
             for entry in os.listdir(self.root):
                 tdir = os.path.join(self.root, entry)
@@ -364,8 +402,48 @@ class TieredStore:
                             os.unlink(os.path.join(tdir, fn))
                         except OSError:
                             pass
-            if dropped:
+            if dropped or scavenge:
                 self._write_manifest()
+
+    def _scavenge(self) -> bool:
+        """Corrupt-manifest recovery: adopt every readable .seg file on
+        disk (Segment.open's footer validation filters torn ones) and
+        rebuild next_id past the highest adopted file. Caller holds
+        self._lock and rewrites the manifest afterwards."""
+        adopted = False
+        try:
+            entries = os.listdir(self.root)
+        except OSError:
+            return False
+        for entry in sorted(entries):
+            tdir = os.path.join(self.root, entry)
+            if not os.path.isdir(tdir):
+                continue
+            tt = self.tier(entry)
+            max_id = 0
+            for fn in sorted(os.listdir(tdir)):
+                if not fn.endswith(".seg") or ".tmp." in fn:
+                    continue
+                p = os.path.join(tdir, fn)
+                try:
+                    tt._add(Segment.open(p))
+                except SegmentError as e:
+                    log.warning("scavenge: dropping torn segment: %s", e)
+                    self.stats["torn_dropped"] += 1
+                    try:
+                        os.unlink(p)
+                    except OSError:
+                        pass
+                    continue
+                adopted = True
+                self.stats["segments_scavenged"] += 1
+                try:
+                    max_id = max(max_id,
+                                 int(fn[len("seg_"):-len(".seg")], 10))
+                except ValueError:
+                    pass
+            tt.next_id = max(tt.next_id, max_id + 1)
+        return adopted
 
     def validate_dicts(self, name: str, dicts: dict) -> list[Segment]:
         """Drop segments whose recorded dict generations exceed what the
@@ -396,6 +474,90 @@ class TieredStore:
                     pass
         return bad
 
+    # -- quarantine + repair (data-integrity layer) ---------------------------
+
+    def quarantine(self, name: str, seg: Segment, reason: str) -> dict:
+        """Pull a corrupt segment from service through the ONE manifest
+        commit point: after the rename it is never served again — by
+        this process, by recovery, or by a restart. The FILE stays on
+        disk as repair/forensics evidence (recovery's torn-tail sweep
+        vouches for quarantined names). Returns what was quarantined
+        (the caller owns the ``segment_quarantine`` ledger entry and the
+        table watermark/rows bookkeeping, eviction-style)."""
+        fn = os.path.basename(seg.path)
+        with self._lock:
+            tt = self.tier(name)
+            victims = [s for s in tt.segments()
+                       if os.path.basename(s.path) == fn]
+            if not victims and fn in tt.quarantined:
+                return {"file": fn, "rows": 0, "already": True}
+            tt._remove(victims)
+            info = {"reason": str(reason)[:200], "rows": seg.rows,
+                    "bytes": seg.nbytes, "tmin": seg.tmin,
+                    "tmax": seg.tmax}
+            tt.quarantined[fn] = info
+            self._write_manifest()
+            self.stats["segments_quarantined"] += 1
+            self.stats["rows_quarantined"] += seg.rows
+            log.warning("quarantined %s/%s (%s): %d rows out of service",
+                        name, fn, reason, seg.rows)
+            return {"file": fn, "rows": seg.rows, "bytes": seg.nbytes,
+                    "tmin": seg.tmin, "tmax": seg.tmax, "already": False}
+
+    def unquarantine(self, name: str, seg: Segment) -> dict | None:
+        """Swap a repaired, RE-VERIFIED segment back into service (one
+        manifest commit). ``seg`` must be a freshly opened Segment over
+        the repaired file at its original path."""
+        fn = os.path.basename(seg.path)
+        with self._lock:
+            tt = self.tier(name)
+            info = tt.quarantined.pop(fn, None)
+            if info is None:
+                return None
+            tt._add(seg)
+            self._write_manifest()
+            self.stats["segments_repaired"] += 1
+            log.info("repaired %s/%s: %d rows back in service",
+                     name, fn, seg.rows)
+            return info
+
+    def drop_quarantined(self, name: str, fn: str) -> dict | None:
+        """Give up on a quarantined file (no healthy copy anywhere):
+        manifest first, then unlink — the rows are lost and the CALLER
+        must ledger them dropped."""
+        with self._lock:
+            tt = self._tables.get(name)
+            info = tt.quarantined.pop(fn, None) if tt else None
+            if info is None:
+                return None
+            self._write_manifest()
+            try:
+                os.unlink(os.path.join(tt.dir, fn))
+            except OSError:
+                pass
+            return info
+
+    def quarantined(self) -> dict[str, dict[str, dict]]:
+        """{table -> {fn -> info}} of everything awaiting repair."""
+        with self._lock:
+            return {name: dict(tt.quarantined)
+                    for name, tt in self._tables.items()
+                    if tt.quarantined}
+
+    def quarantine_info(self, name: str) -> dict | None:
+        """Degraded-query annotation input: what this table is currently
+        missing (None when whole). Same contract as federation's
+        missing_shards — queries in the repair gap say so, never
+        silently return short."""
+        with self._lock:
+            tt = self._tables.get(name)
+            if tt is None or not tt.quarantined:
+                return None
+            return {"segments": len(tt.quarantined),
+                    "rows": sum(int(i.get("rows", 0) or 0)
+                                for i in tt.quarantined.values()),
+                    "files": sorted(tt.quarantined)}
+
     # -- commit --------------------------------------------------------------
 
     def commit(self, writes: dict[str, dict],
@@ -411,6 +573,11 @@ class TieredStore:
         drained EVERY table's RAM chunks into ``writes``, so from this
         commit on the npz chunk dirs hold nothing the tier doesn't."""
         with self._lock:
+            if writes and self.chaos is not None:
+                # disk-fault injection point: raises OSError(ENOSPC).
+                # The flusher catches it, requeues the gate entries and
+                # backs off — acks stay withheld, agents retransmit.
+                self.chaos.on_tier_write()
             rows = 0
             nseg = 0
             dirty_dirs: set[str] = set()
@@ -721,6 +888,12 @@ class TieredStore:
                                 "runs": len({s.run for s in segs
                                              if s.run is not None}),
                                 "codec_counts": dict(tt.codec_counts)}
+                if tt.quarantined:
+                    tables[name]["quarantined_segments"] = \
+                        len(tt.quarantined)
+                    tables[name]["quarantined_rows"] = sum(
+                        int(i.get("rows", 0) or 0)
+                        for i in tt.quarantined.values())
             out = {"root": self.root, "flush_gen": self.flush_gen,
                    "evict_gen": self.evict_gen,
                    "compact_gen": self.compact_gen,

@@ -1,0 +1,354 @@
+"""Self-healing storage (ISSUE 20): block checksums, quarantine +
+repair through the manifest, the background scrubber, verify-on-fetch
+in the segment cache, corrupt-state recovery, and disk-fault
+degradation of the flush path."""
+
+import json
+import os
+import types
+
+import numpy as np
+import pytest
+
+from deepflow_tpu import chaos as chaos_mod
+from deepflow_tpu.chaos import ChaosConfig, ChaosInjector
+from deepflow_tpu.server.flusher import DurabilityGate, Flusher
+from deepflow_tpu.server.receiver import SeqAckTracker
+from deepflow_tpu.store import Database
+from deepflow_tpu.store import objstore as objstore_mod
+from deepflow_tpu.store import segment as segment_mod
+from deepflow_tpu.store.objstore import ObjStore
+from deepflow_tpu.store.scrub import Scrubber
+from deepflow_tpu.store.segcache import SegmentCache
+from deepflow_tpu.store.segment import (ChecksumError, Segment,
+                                        write_segment, verify_buffer)
+from deepflow_tpu.store.tiered import TieredStore
+
+NET = "flow_metrics.network.1s"
+
+
+def _chunk(n=200, t0=1000):
+    return {"time": np.arange(t0, t0 + n, dtype=np.uint32),
+            "v": np.arange(n, dtype=np.uint64),
+            "w": (np.arange(n, dtype=np.uint64) * 7) % 1000}
+
+
+def _fill_net(db, n=50, t0=1_754_000_000):
+    t = db.table(NET)
+    t.append_rows([{"ip_src": "10.0.0.1", "ip_dst": "10.9.9.9",
+                    "server_port": 443, "protocol": 1, "host": "h",
+                    "byte_tx": 100 + i, "packet_tx": 1,
+                    "rtt_sum": 10, "rtt_count": 1, "time": t0 + i}
+                   for i in range(n)])
+    return t
+
+
+# -- per-block checksums ----------------------------------------------------
+
+def test_checksum_roundtrip(tmp_path):
+    p = str(tmp_path / "seg_00000001.seg")
+    write_segment(p, _chunk(), time_col="time")
+    seg = Segment.open(p)
+    v = seg.verify()
+    assert v["verifiable"] and not v["corrupt"]
+    assert v["checked"] == v["blocks"] > 0
+    # footer carries the additive crc field on every column block
+    assert all("crc" in c for c in seg._cols.values())
+    assert np.array_equal(seg.column("v"), _chunk()["v"])
+
+
+def test_bit_flip_caught_on_first_touch(tmp_path):
+    p = str(tmp_path / "seg_00000001.seg")
+    write_segment(p, _chunk(), time_col="time")
+    info = chaos_mod.corrupt_segment(p, seed=3, mode="bit_flip")
+    seg = Segment.open(p)  # opens fine: footer crc still intact
+    with pytest.raises(ChecksumError) as ei:
+        seg.column(info["column"])
+    assert ei.value.block == info["column"]
+    v = seg.verify()
+    assert info["column"] in v["corrupt"]
+
+
+def test_verify_recomputes_after_memoized_clean_read(tmp_path):
+    """Bytes can rot AFTER a block was read (and memoized) clean — the
+    scrub pass must recompute, not trust the first-touch memo."""
+    p = str(tmp_path / "seg_00000001.seg")
+    write_segment(p, _chunk(), time_col="time")
+    seg = Segment.open(p)
+    seg.column("v")  # memoizes v as clean
+    assert not seg.verify()["corrupt"]
+    info = chaos_mod.corrupt_segment(p, seed=11, mode="bit_flip")
+    v = seg.verify()  # same open segment, same mmap
+    assert info["column"] in v["corrupt"]
+
+
+def test_pre_checksum_segment_readable_never_verifiable(tmp_path):
+    # v1 writer: no crc fields at all
+    p1 = str(tmp_path / "v1.seg")
+    write_segment(p1, _chunk(), time_col="time", fmt=1)
+    s1 = Segment.open(p1)
+    v = s1.verify()
+    assert not v["verifiable"] and v["checked"] == 0
+    assert np.array_equal(s1.column("v"), _chunk()["v"])
+    # v2 written under the DF_NO_CRC kill-switch
+    p2 = str(tmp_path / "nocrc.seg")
+    saved = segment_mod._crc_enabled
+    segment_mod._crc_enabled = False
+    try:
+        write_segment(p2, _chunk(), time_col="time")
+    finally:
+        segment_mod._crc_enabled = saved
+    s2 = Segment.open(p2)
+    assert not s2.verify()["verifiable"]
+    assert np.array_equal(s2.column("v"), _chunk()["v"])
+
+
+def test_verify_buffer_clean_torn_flipped_precrc(tmp_path):
+    p = str(tmp_path / "seg.seg")
+    write_segment(p, _chunk(), time_col="time")
+    buf = open(p, "rb").read()
+    assert verify_buffer(buf) == {"ok": True, "verifiable": True,
+                                  "corrupt": [], "reason": ""}
+    torn = verify_buffer(buf[:len(buf) // 2])
+    assert not torn["ok"] and torn["reason"].startswith("torn")
+    info = chaos_mod.corrupt_segment(p, seed=5, mode="bit_flip")
+    flipped = verify_buffer(open(p, "rb").read())
+    assert not flipped["ok"] and info["column"] in flipped["corrupt"]
+    pv1 = str(tmp_path / "v1.seg")
+    write_segment(pv1, _chunk(), time_col="time", fmt=1)
+    pre = verify_buffer(open(pv1, "rb").read())
+    assert pre["ok"] and not pre["verifiable"]
+
+
+# -- scrub -> quarantine -> repair ------------------------------------------
+
+def _seed_tier_with_blob(tmp_path, shard=1):
+    """One flushed segment + its published objstore blob."""
+    db = Database(data_dir=str(tmp_path / "data"), storage=True)
+    _fill_net(db)
+    assert db.flush_to_tier() == 50
+    obj = ObjStore(str(tmp_path / "obj"))
+    tt = db.tier_store.tables()[NET]
+    seg = tt.segments()[0]
+    fn = os.path.basename(seg.path)
+    obj.put_if_absent(objstore_mod.seg_key(shard, NET, fn),
+                      src_path=seg.path)
+    return db, obj, seg, fn
+
+
+def test_scrub_quarantines_and_repairs(tmp_path):
+    db, obj, seg, fn = _seed_tier_with_blob(tmp_path)
+    chaos_mod.corrupt_segment(seg.path, seed=2, mode="bit_flip")
+    scrub = Scrubber(db, objstore=obj, shard_id=1)
+    cyc = scrub.scrub_once(max_bytes=0)
+    assert cyc["corrupt"] == 1 and cyc["repaired"] == 1
+    assert scrub.stats["quarantined"] == 1
+    assert db.tier_store.quarantine_info(NET) is None  # back in service
+    tt = db.tier_store.tables()[NET]
+    assert not tt.segments()[0].verify()["corrupt"]
+    assert len(db.table(NET)) == 50
+    # conserved hop ledger: every scanned segment accounted
+    for h in scrub._telemetry.snapshot()["pipeline"]:
+        assert h["emitted"] == h["delivered"] + h["dropped_total"] \
+            + h["in_flight"], h
+
+
+def test_quarantine_survives_restart_then_retry_repairs(tmp_path):
+    db, obj, seg, fn = _seed_tier_with_blob(tmp_path)
+    key = objstore_mod.seg_key(1, NET, fn)
+    stash = obj.get_bytes(key)
+    obj.delete(key)  # no healthy copy anywhere
+    chaos_mod.corrupt_segment(seg.path, seed=4, mode="bit_flip")
+    scrub = Scrubber(db, objstore=obj, shard_id=1)
+    cyc = scrub.scrub_once(max_bytes=0)
+    assert cyc["corrupt"] == 1 and cyc["repair_failed"] >= 1
+    qi = db.tier_store.quarantine_info(NET)
+    assert qi and qi["rows"] == 50
+    assert len(db.table(NET)) == 0  # never served while quarantined
+
+    # restart on the same dir: the manifest keeps it out of service
+    db2 = Database(data_dir=str(tmp_path / "data"), storage=True)
+    db2.load()
+    assert db2.tier_store.quarantine_info(NET)["rows"] == 50
+    assert len(db2.table(NET)) == 0
+
+    # the healthy copy comes back: the retry pass repairs + re-admits
+    obj.put_if_absent(key, data=stash)
+    scrub2 = Scrubber(db2, objstore=obj, shard_id=1)
+    cyc = scrub2.scrub_once(max_bytes=0)
+    assert cyc["repaired"] == 1
+    assert db2.tier_store.quarantine_info(NET) is None
+    assert len(db2.table(NET)) == 50
+
+
+def test_scrub_republishes_corrupt_blob_from_local(tmp_path):
+    db, obj, seg, fn = _seed_tier_with_blob(tmp_path)
+    key = objstore_mod.seg_key(1, NET, fn)
+    obj.delete(key)
+    obj.put_if_absent(key, data=_corrupt_copy(tmp_path, seg.path))
+    scrub = Scrubber(db, objstore=obj, shard_id=1)
+    scrub.scrub_once(max_bytes=0)
+    assert scrub.stats["blobs_corrupt"] == 1
+    assert scrub.stats["blobs_republished"] == 1
+    assert verify_buffer(obj.get_bytes(key))["ok"]
+
+
+def test_scrub_byte_budget_resumes_with_cursor(tmp_path):
+    db = Database(data_dir=str(tmp_path / "data"), storage=True)
+    for i in range(3):
+        _fill_net(db, n=20, t0=1_754_000_000 + i * 1000)
+        db.flush_to_tier()
+    assert len(db.tier_store.tables()[NET].segments()) == 3
+    scrub = Scrubber(db)
+    cyc = scrub.scrub_once(max_bytes=1)  # budget exhausts after 1 unit
+    assert cyc["scanned"] == 1
+    seen = cyc["scanned"]
+    for _ in range(2):
+        seen += scrub.scrub_once(max_bytes=1)["scanned"]
+    assert seen == 3  # the cursor walked every segment, not the head 3x
+
+
+# -- corrupt-state recovery -------------------------------------------------
+
+def test_manifest_truncation_scavenges_segments(tmp_path):
+    db = Database(data_dir=str(tmp_path / "data"), storage=True)
+    _fill_net(db)
+    db.flush_to_tier()
+    man = os.path.join(str(tmp_path / "data"), "segments",
+                       "MANIFEST.json")
+    raw = open(man, "rb").read()
+    with open(man, "wb") as f:
+        f.write(raw[:len(raw) // 2])  # mid-byte truncation
+    db2 = Database(data_dir=str(tmp_path / "data"), storage=True)
+    db2.load()
+    assert db2.tier_store.stats["manifest_corrupt"] == 1
+    assert db2.tier_store.stats["segments_scavenged"] == 1
+    assert len(db2.table(NET)) == 50  # rows adopted, not lost
+
+
+def test_corrupt_ack_state_treated_as_absent(tmp_path):
+    from deepflow_tpu.server.server import Server
+    srv = Server(data_dir=str(tmp_path), storage=True)
+    path = srv._ack_state_path()
+    with open(path, "w") as f:
+        f.write('{"7": 41')  # mid-byte truncation: invalid JSON
+    assert srv._load_ack_state() == {}
+    hops = {h["hop"]: h for h in srv.telemetry.snapshot()["pipeline"]}
+    if "storage" in hops:  # ledgered when telemetry is enabled
+        assert hops["storage"]["dropped_total"] >= 1
+
+
+# -- object store: torn blobs, mirrors --------------------------------------
+
+def test_put_if_absent_never_exposes_torn_blob(tmp_path, monkeypatch):
+    """Writer dies between staging and rename: the key must stay
+    absent and the leftover temp file must stay invisible."""
+    obj = ObjStore(str(tmp_path / "obj"))
+    monkeypatch.setattr(objstore_mod.os, "replace",
+                        lambda *a: (_ for _ in ()).throw(
+                            KeyboardInterrupt("killed mid-put")))
+    with pytest.raises(KeyboardInterrupt):
+        obj.put_if_absent("seg/1/t/a.seg", data=b"x" * 64)
+    monkeypatch.undo()
+    assert not obj.exists("seg/1/t/a.seg")
+    assert obj.list_keys("seg/1") == []
+    with pytest.raises(OSError):
+        obj.get_bytes("seg/1/t/a.seg")
+    # and a later writer with the same key wins cleanly
+    obj.put_if_absent("seg/1/t/a.seg", data=b"y" * 64)
+    assert obj.get_bytes("seg/1/t/a.seg") == b"y" * 64
+
+
+def test_objstore_mirror_failover(tmp_path):
+    mirror = ObjStore(str(tmp_path / "m"))
+    mirror.put_if_absent("seg/1/t/a.seg", data=b"z" * 64)
+    obj = ObjStore(str(tmp_path / "obj"), mirrors=[str(tmp_path / "m")])
+    assert obj.get_bytes("seg/1/t/a.seg") == b"z" * 64
+    assert obj.stats["mirror_hits"] == 1
+
+
+# -- segment cache: verify-on-fetch, backoff, failover ----------------------
+
+def _rseg(shard, table, fn):
+    return types.SimpleNamespace(key=(shard, table, fn), shard=shard,
+                                 table=table, fn=fn)
+
+
+def _corrupt_copy(tmp_path, src: str) -> bytes:
+    """Bytes of src with one bit flipped INSIDE a column block (a blind
+    byte flip can land in inter-block padding and verify clean)."""
+    import shutil
+    p = str(tmp_path / "corrupt_copy.seg")
+    shutil.copyfile(src, p)
+    chaos_mod.corrupt_segment(p, seed=13, mode="bit_flip")
+    return open(p, "rb").read()
+
+
+def test_segcache_fetch_verifies_and_fails_over(tmp_path):
+    seg_path = str(tmp_path / "seg_00000001.seg")
+    write_segment(seg_path, _chunk(), time_col="time")
+    key = objstore_mod.seg_key(1, "t", "seg_00000001.seg")
+    # primary holds a corrupt copy, the alternate replica a clean one
+    prim = ObjStore(str(tmp_path / "prim"))
+    prim.put_if_absent(key, data=_corrupt_copy(tmp_path, seg_path))
+    alt = ObjStore(str(tmp_path / "alt"))
+    alt.put_if_absent(key, src_path=seg_path)
+    cache = SegmentCache(str(tmp_path / "cache"), prim,
+                         alt_stores=[alt])
+    ent = cache._fetch(_rseg(1, "t", "seg_00000001.seg"))
+    assert ent["rows"] == 200
+    assert cache.stats["fetch_corrupt"] == 1
+    assert cache.stats["fetch_failover"] == 1
+    assert not ent["seg"].verify()["corrupt"]
+
+
+def test_segcache_fetch_backoff_after_all_sources_fail(tmp_path):
+    prim = ObjStore(str(tmp_path / "prim"))  # empty: every fetch fails
+    cache = SegmentCache(str(tmp_path / "cache"), prim)
+    r = _rseg(1, "t", "seg_00000001.seg")
+    with pytest.raises(OSError):
+        cache._fetch(r)
+    assert r.key in cache._backoff
+    with pytest.raises(OSError, match="backing off"):
+        cache._fetch(r)  # inside the backoff window: fails fast
+    assert cache.stats["fetch_backoffs"] == 1
+    # a successful fetch clears the state
+    seg_path = str(tmp_path / "seg_00000001.seg")
+    write_segment(seg_path, _chunk(), time_col="time")
+    prim.put_if_absent(objstore_mod.seg_key(1, "t", "seg_00000001.seg"),
+                       src_path=seg_path)
+    cache._backoff[r.key] = (1, 0.0)  # window expired
+    ent = cache._fetch(r)
+    assert ent["rows"] == 200 and r.key not in cache._backoff
+
+
+# -- disk-fault degradation of the flush path -------------------------------
+
+def test_enospc_flush_requeues_and_recovers(tmp_path):
+    db = Database(data_dir=str(tmp_path), storage=True)
+    _fill_net(db, n=5)
+    gate = DurabilityGate()
+    tracker = SeqAckTracker()
+    tracker.seed(3, -1)
+    gate.add(3, 0)
+    fl = Flusher(db, gate=gate, seq_tracker=tracker)
+    db.tier_store.chaos = ChaosInjector(ChaosConfig(
+        enabled=True, seed=1, tier_enospc=1.0))
+    for i in range(2):
+        with pytest.raises(OSError):
+            fl.flush_once()
+        assert fl.consec_errors == i + 1
+        assert len(gate) == 1             # acks stay parked
+        assert tracker.contiguous(3) == -1
+    db.tier_store.chaos = None            # disk recovers
+    assert fl.flush_once() == 5
+    assert fl.consec_errors == 0
+    assert len(gate) == 0
+    assert tracker.contiguous(3) == 0     # released after the commit
+
+
+def test_tiered_commit_chaos_hook_only_on_writes(tmp_path):
+    ts = TieredStore(str(tmp_path / "segments"))
+    ts.chaos = ChaosInjector(ChaosConfig(enabled=True, seed=1,
+                                         tier_enospc=1.0))
+    assert ts.commit({}) == 0  # nothing to write: no fault surface
